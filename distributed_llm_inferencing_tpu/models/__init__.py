@@ -1,0 +1,2 @@
+from distributed_llm_inferencing_tpu.models.config import ModelConfig  # noqa: F401
+from distributed_llm_inferencing_tpu.models.registry import get_config, list_models  # noqa: F401

@@ -1,0 +1,71 @@
+"""Model configuration.
+
+One dataclass covers every supported family (GPT-2, OPT, Llama/Mistral,
+Mixtral); the fields are the union of what those architectures need. The
+reference framework had no config object at all — architecture handling was
+an attribute sniff on the HF module tree (reference: shard_model.py:40-50);
+here the config is the single source of truth for shapes, partitioning and
+weight conversion.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    # Identity
+    name: str = "gpt2"
+    family: str = "gpt2"  # gpt2 | opt | llama | mixtral
+
+    # Core dimensions
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_layers: int = 12
+    num_heads: int = 12
+    num_kv_heads: int = 12  # < num_heads => GQA
+    head_dim: int = 64
+    max_position_embeddings: int = 1024
+
+    # Architecture switches
+    norm_type: str = "layernorm"  # layernorm | rmsnorm
+    norm_eps: float = 1e-5
+    activation: str = "gelu"  # gelu | silu
+    gated_mlp: bool = False  # llama-style SwiGLU (gate+up) vs plain fc
+    position_embedding: str = "learned"  # learned | rope
+    rope_theta: float = 10000.0
+    attn_bias: bool = True
+    mlp_bias: bool = True
+    tie_word_embeddings: bool = True
+    sliding_window: Optional[int] = None  # Mistral-style local attention
+
+    # Mixture-of-experts (Mixtral). num_experts == 0 => dense MLP.
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+
+    # Numerics
+    dtype: str = "bfloat16"  # activation/weight dtype on device
+
+    def __post_init__(self):
+        assert self.num_heads % self.num_kv_heads == 0, (
+            f"num_heads={self.num_heads} must be divisible by "
+            f"num_kv_heads={self.num_kv_heads}"
+        )
+
+    @property
+    def q_dim(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.num_kv_heads * self.head_dim
+
+    @property
+    def is_moe(self) -> bool:
+        return self.num_experts > 0
+
+    def replace(self, **kw) -> "ModelConfig":
+        return dataclasses.replace(self, **kw)

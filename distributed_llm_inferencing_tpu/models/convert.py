@@ -1,0 +1,234 @@
+"""HF checkpoint -> JAX pytree conversion.
+
+The TPU-native replacement for the reference's model ingestion
+(reference: worker/app.py:117-121 ``AutoModelForCausalLM.from_pretrained``
+and the shard_model CLI's layer copying, shard_model.py:71-91): we read an
+HF checkpoint ONCE into the stacked-layer pytree of models/transformer.py.
+Sharding is a PartitionSpec assignment at load time (parallel/sharding.py),
+not a file rewrite — no full-size "shards" with random out-of-range weights
+(the reference's flaw, SURVEY.md §2.4).
+
+Entry points:
+- ``config_from_hf(hf_config)`` — map a transformers config to ModelConfig
+- ``convert_state_dict(cfg, state_dict)`` — torch/numpy state dict -> pytree
+- ``load_hf_model(path_or_model)`` — local checkpoint dir or in-memory HF
+  model -> (ModelConfig, params). Works fully offline.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from distributed_llm_inferencing_tpu.models.config import ModelConfig
+
+
+def _np(t):
+    """torch tensor | np array -> float32 numpy."""
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().float().numpy()
+    return np.asarray(t, dtype=np.float32)
+
+
+def config_from_hf(hf_config) -> ModelConfig:
+    mt = hf_config.model_type
+    if mt == "gpt2":
+        return ModelConfig(
+            name=getattr(hf_config, "name_or_path", "gpt2") or "gpt2",
+            family="gpt2", vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.n_embd,
+            intermediate_size=hf_config.n_inner or 4 * hf_config.n_embd,
+            num_layers=hf_config.n_layer, num_heads=hf_config.n_head,
+            num_kv_heads=hf_config.n_head,
+            head_dim=hf_config.n_embd // hf_config.n_head,
+            max_position_embeddings=hf_config.n_positions,
+            norm_type="layernorm", norm_eps=hf_config.layer_norm_epsilon,
+            activation="gelu", gated_mlp=False, position_embedding="learned",
+            attn_bias=True, mlp_bias=True, tie_word_embeddings=True)
+    if mt == "opt":
+        if getattr(hf_config, "word_embed_proj_dim", hf_config.hidden_size) != hf_config.hidden_size:
+            raise NotImplementedError(
+                "OPT variants with word_embed_proj_dim != hidden_size "
+                "(opt-350m) need the embed projection; not yet wired.")
+        if not getattr(hf_config, "do_layer_norm_before", True):
+            raise NotImplementedError("post-LN OPT variants not supported")
+        return ModelConfig(
+            name=getattr(hf_config, "name_or_path", "opt") or "opt",
+            family="opt", vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            intermediate_size=hf_config.ffn_dim,
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            num_kv_heads=hf_config.num_attention_heads,
+            head_dim=hf_config.hidden_size // hf_config.num_attention_heads,
+            max_position_embeddings=hf_config.max_position_embeddings,
+            norm_type="layernorm", activation="relu", gated_mlp=False,
+            position_embedding="learned", attn_bias=True, mlp_bias=True,
+            tie_word_embeddings=True)
+    if mt in ("llama", "mistral", "mixtral"):
+        num_experts = getattr(hf_config, "num_local_experts", 0) if mt == "mixtral" else 0
+        return ModelConfig(
+            name=getattr(hf_config, "name_or_path", mt) or mt,
+            family="llama", vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            intermediate_size=hf_config.intermediate_size,
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            num_kv_heads=getattr(hf_config, "num_key_value_heads",
+                                 hf_config.num_attention_heads),
+            head_dim=getattr(hf_config, "head_dim", None)
+            or hf_config.hidden_size // hf_config.num_attention_heads,
+            max_position_embeddings=hf_config.max_position_embeddings,
+            norm_type="rmsnorm", norm_eps=hf_config.rms_norm_eps,
+            activation="silu", gated_mlp=True, position_embedding="rope",
+            rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+            attn_bias=getattr(hf_config, "attention_bias", False),
+            mlp_bias=getattr(hf_config, "mlp_bias", False),
+            tie_word_embeddings=getattr(hf_config, "tie_word_embeddings", False),
+            sliding_window=getattr(hf_config, "sliding_window", None),
+            num_experts=num_experts,
+            num_experts_per_tok=getattr(hf_config, "num_experts_per_tok", 2))
+    raise NotImplementedError(f"unsupported HF model_type {mt!r}")
+
+
+def _stack(dicts):
+    """list of {leaf: np [..]} -> {leaf: np [L, ..]} recursively."""
+    out = {}
+    for k in dicts[0]:
+        if isinstance(dicts[0][k], dict):
+            out[k] = _stack([d[k] for d in dicts])
+        else:
+            out[k] = np.stack([d[k] for d in dicts])
+    return out
+
+
+def convert_state_dict(cfg: ModelConfig, sd, dtype=None):
+    """HF state dict (name -> torch tensor/np array) -> our param pytree."""
+    dtype = dtype or jnp.dtype(cfg.dtype)
+    fam = cfg.family
+    D = cfg.hidden_size
+
+    def get(name):
+        return _np(sd[name])
+
+    if fam == "gpt2":
+        def layer(i):
+            p = f"transformer.h.{i}."
+            cattn_w = get(p + "attn.c_attn.weight")  # [D, 3D] (Conv1D: in,out)
+            cattn_b = get(p + "attn.c_attn.bias")
+            return {
+                "attn_norm": {"scale": get(p + "ln_1.weight"),
+                              "bias": get(p + "ln_1.bias")},
+                "q": {"w": cattn_w[:, :D], "b": cattn_b[:D]},
+                "k": {"w": cattn_w[:, D:2 * D], "b": cattn_b[D:2 * D]},
+                "v": {"w": cattn_w[:, 2 * D:], "b": cattn_b[2 * D:]},
+                "o": {"w": get(p + "attn.c_proj.weight"),
+                      "b": get(p + "attn.c_proj.bias")},
+                "mlp_norm": {"scale": get(p + "ln_2.weight"),
+                             "bias": get(p + "ln_2.bias")},
+                "up": {"w": get(p + "mlp.c_fc.weight"),
+                       "b": get(p + "mlp.c_fc.bias")},
+                "down": {"w": get(p + "mlp.c_proj.weight"),
+                         "b": get(p + "mlp.c_proj.bias")},
+            }
+        params = {
+            "embed": {"tokens": get("transformer.wte.weight"),
+                      "positions": get("transformer.wpe.weight")},
+            "layers": _stack([layer(i) for i in range(cfg.num_layers)]),
+            "final_norm": {"scale": get("transformer.ln_f.weight"),
+                           "bias": get("transformer.ln_f.bias")},
+        }
+    elif fam == "opt":
+        def layer(i):
+            p = f"model.decoder.layers.{i}."
+            def lin(n):  # torch Linear stores [out, in] -> transpose
+                return {"w": get(p + n + ".weight").T, "b": get(p + n + ".bias")}
+            return {
+                "attn_norm": {"scale": get(p + "self_attn_layer_norm.weight"),
+                              "bias": get(p + "self_attn_layer_norm.bias")},
+                "q": lin("self_attn.q_proj"),
+                "k": lin("self_attn.k_proj"),
+                "v": lin("self_attn.v_proj"),
+                "o": lin("self_attn.out_proj"),
+                "mlp_norm": {"scale": get(p + "final_layer_norm.weight"),
+                             "bias": get(p + "final_layer_norm.bias")},
+                "up": lin("fc1"),
+                "down": lin("fc2"),
+            }
+        params = {
+            "embed": {
+                "tokens": get("model.decoder.embed_tokens.weight"),
+                # OPT's learned positions are offset by 2 internally
+                # (transformers OPTLearnedPositionalEmbedding); slice here so
+                # position p indexes row p.
+                "positions": get("model.decoder.embed_positions.weight")[2:],
+            },
+            "layers": _stack([layer(i) for i in range(cfg.num_layers)]),
+            "final_norm": {
+                "scale": get("model.decoder.final_layer_norm.weight"),
+                "bias": get("model.decoder.final_layer_norm.bias")},
+        }
+    elif fam == "llama":
+        def layer(i):
+            p = f"model.layers.{i}."
+            def lin(n):
+                out = {"w": get(p + n + ".weight").T}
+                if p + n + ".bias" in sd:  # attention_bias / mlp_bias variants
+                    out["b"] = get(p + n + ".bias")
+                return out
+            lp = {
+                "attn_norm": {"scale": get(p + "input_layernorm.weight")},
+                "q": lin("self_attn.q_proj"),
+                "k": lin("self_attn.k_proj"),
+                "v": lin("self_attn.v_proj"),
+                "o": lin("self_attn.o_proj"),
+                "mlp_norm": {"scale": get(p + "post_attention_layernorm.weight")},
+            }
+            if cfg.is_moe:
+                lp["router"] = {"w": get(p + "block_sparse_moe.gate.weight").T}
+                ex = [f"block_sparse_moe.experts.{e}." for e in range(cfg.num_experts)]
+                lp["experts"] = {
+                    "gate": {"w": np.stack([get(p + e + "w1.weight").T for e in ex])},
+                    "down": {"w": np.stack([get(p + e + "w2.weight").T for e in ex])},
+                    "up": {"w": np.stack([get(p + e + "w3.weight").T for e in ex])},
+                }
+            else:
+                lp["gate"] = lin("mlp.gate_proj")
+                lp["up"] = lin("mlp.up_proj")
+                lp["down"] = lin("mlp.down_proj")
+            return lp
+        params = {
+            "embed": {"tokens": get("model.embed_tokens.weight")},
+            "layers": _stack([layer(i) for i in range(cfg.num_layers)]),
+            "final_norm": {"scale": get("model.norm.weight")},
+        }
+        if not cfg.tie_word_embeddings:
+            params["lm_head"] = {"w": get("lm_head.weight").T}
+    else:
+        raise NotImplementedError(fam)
+
+    return _to_jax(params, dtype)
+
+
+def _to_jax(tree, dtype):
+    if isinstance(tree, dict):
+        return {k: _to_jax(v, dtype) for k, v in tree.items()}
+    return jnp.asarray(tree, dtype)
+
+
+def load_hf_model(path_or_model, dtype=None):
+    """Load a local HF checkpoint directory or an in-memory HF model.
+
+    Returns (ModelConfig, params). Fully offline: paths must exist locally
+    (the reference relied on HF-hub downloads per worker,
+    worker/app.py:117-121; here checkpoint distribution is explicit).
+    """
+    if isinstance(path_or_model, str):
+        import transformers
+        model = transformers.AutoModelForCausalLM.from_pretrained(
+            path_or_model, local_files_only=True)
+    else:
+        model = path_or_model
+    cfg = config_from_hf(model.config)
+    params = convert_state_dict(cfg, dict(model.state_dict()), dtype=dtype)
+    return cfg, params

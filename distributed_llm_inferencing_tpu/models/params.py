@@ -1,0 +1,84 @@
+"""Parameter initialization (random) for the unified transformer.
+
+Used by tests, benchmarks and the dry-run path — real checkpoints come from
+models/convert.py. Shapes follow the schema documented in
+models/transformer.py; every per-layer leaf is stacked with a leading [L]
+axis.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from distributed_llm_inferencing_tpu.models.config import ModelConfig
+
+
+def init_params(cfg: ModelConfig, key, dtype=None):
+    dtype = dtype or jnp.dtype(cfg.dtype)
+    L, D, I = cfg.num_layers, cfg.hidden_size, cfg.intermediate_size
+    keys = iter(jax.random.split(key, 32))
+
+    def w(shape, scale=0.02):
+        return (jax.random.normal(next(keys), shape, jnp.float32) * scale).astype(dtype)
+
+    def zeros(shape):
+        return jnp.zeros(shape, dtype)
+
+    def ones(shape):
+        return jnp.ones(shape, dtype)
+
+    def norm_p():
+        p = {"scale": ones((L, D))}
+        if cfg.norm_type == "layernorm":
+            p["bias"] = zeros((L, D))
+        return p
+
+    def lin(din, dout, bias):
+        p = {"w": w((L, din, dout))}
+        if bias:
+            p["b"] = zeros((L, dout))
+        return p
+
+    layers = {
+        "attn_norm": norm_p(),
+        "q": lin(D, cfg.q_dim, cfg.attn_bias),
+        "k": lin(D, cfg.kv_dim, cfg.attn_bias),
+        "v": lin(D, cfg.kv_dim, cfg.attn_bias),
+        "o": lin(cfg.q_dim, D, cfg.attn_bias),
+        "mlp_norm": norm_p(),
+    }
+    if cfg.is_moe:
+        E = cfg.num_experts
+        layers["router"] = {"w": w((L, D, E))}
+        layers["experts"] = {
+            "gate": {"w": w((L, E, D, I))},
+            "up": {"w": w((L, E, D, I))},
+            "down": {"w": w((L, E, I, D))},
+        }
+    else:
+        layers["up"] = lin(D, I, cfg.mlp_bias)
+        if cfg.gated_mlp:
+            layers["gate"] = {"w": w((L, D, I))}
+        layers["down"] = lin(I, D, cfg.mlp_bias)
+
+    params = {
+        "embed": {"tokens": w((cfg.vocab_size, D))},
+        "layers": layers,
+        "final_norm": (
+            {"scale": ones((D,)), "bias": zeros((D,))}
+            if cfg.norm_type == "layernorm" else {"scale": ones((D,))}),
+    }
+    if cfg.position_embedding == "learned":
+        params["embed"]["positions"] = w((cfg.max_position_embeddings, D))
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = {"w": w((D, cfg.vocab_size))}
+    return params
+
+
+def param_count(params) -> int:
+    return sum(x.size for x in jax.tree_util.tree_leaves(params))
+
+
+def param_bytes(params) -> int:
+    return sum(x.size * x.dtype.itemsize for x in jax.tree_util.tree_leaves(params))

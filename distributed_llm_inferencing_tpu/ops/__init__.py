@@ -1,0 +1,1 @@
+from distributed_llm_inferencing_tpu.ops import attention, kvcache, norms, rope, sampling  # noqa: F401

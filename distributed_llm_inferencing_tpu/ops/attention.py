@@ -1,0 +1,65 @@
+"""Attention: causal/cached multi-head attention with GQA and sliding window.
+
+The reference's attention lives inside vendored HF/torch kernels
+(reference: worker/app.py:297-305 just calls model.generate()). Here it is
+an explicit XLA program: einsum QK^T on the MXU, f32 softmax, einsum PV —
+written so XLA fuses mask+softmax into the matmuls. A Pallas
+flash-attention kernel (ops/pallas/flash_attention.py) covers the long-
+sequence regime; this module is the reference implementation and the
+fallback on non-TPU backends.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+NEG_INF = -1e30  # large-but-finite: keeps softmax well-defined on all-masked rows
+
+
+def repeat_kv(x, n_rep: int):
+    """[B,S,Hkv,hd] -> [B,S,Hkv*n_rep,hd] by repeating each kv head."""
+    if n_rep == 1:
+        return x
+    b, s, h, d = x.shape
+    x = jnp.broadcast_to(x[:, :, :, None, :], (b, s, h, n_rep, d))
+    return x.reshape(b, s, h * n_rep, d)
+
+
+def attend(
+    q,                   # [B, Sq, H, hd]
+    k,                   # [B, Skv, Hkv, hd]
+    v,                   # [B, Skv, Hkv, hd]
+    q_positions,         # [B, Sq] absolute position of each query token
+    kv_positions,        # [B, Skv] absolute position of each kv slot
+    kv_valid,            # [B, Skv] bool — slot holds a real token
+    sliding_window: Optional[int] = None,
+):
+    """Causal attention over a (possibly cached, possibly padded) KV set.
+
+    Masking rule: query at position p may attend kv at position t iff
+    t <= p, the slot is valid, and (no window or p - t < window).
+    Works for prefill (Sq == Skv) and single-token decode (Sq == 1) alike.
+    """
+    B, Sq, H, hd = q.shape
+    Hkv = k.shape[2]
+    k = repeat_kv(k, H // Hkv)
+    v = repeat_kv(v, H // Hkv)
+
+    scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+    # [B, H, Sq, Skv]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+
+    causal = kv_positions[:, None, :] <= q_positions[:, :, None]  # [B,Sq,Skv]
+    mask = causal & kv_valid[:, None, :]
+    if sliding_window is not None:
+        in_window = (q_positions[:, :, None] - kv_positions[:, None, :]) < sliding_window
+        mask = mask & in_window
+    logits = jnp.where(mask[:, None, :, :], logits, NEG_INF)
+
+    probs = jnp.exp(logits - jnp.max(logits, axis=-1, keepdims=True))
+    probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)

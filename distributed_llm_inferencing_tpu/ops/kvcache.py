@@ -1,0 +1,67 @@
+"""Static-shape KV cache.
+
+The reference had no KV-cache management at all — it was implicit inside HF
+``model.generate()`` (SURVEY.md §2.4). On TPU the cache must be a
+static-shape device-resident buffer so the decode step compiles once:
+
+- ``k``/``v``: [L, B, max_seq, Hkv, hd] stacked over layers (leading layer
+  axis lines up with the stacked layer params so ``lax.scan`` over layers
+  carries one cache slice per step).
+- ``lengths``: [B] int32 — how many slots are filled per sequence.
+
+Updates use ``lax.dynamic_update_slice_in_dim`` at the current length; the
+buffers are donated by the engine's jitted step functions so decode is
+in-place on device.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from distributed_llm_inferencing_tpu.models.config import ModelConfig
+
+
+class KVCache(NamedTuple):
+    k: jax.Array        # [L, B, S, Hkv, hd]
+    v: jax.Array        # [L, B, S, Hkv, hd]
+    lengths: jax.Array  # [B] int32 — filled slots (same for all layers)
+
+    @property
+    def max_seq(self) -> int:
+        return self.k.shape[2]
+
+    def positions(self):
+        """[B, S] absolute position of each slot (slot index)."""
+        B, S = self.k.shape[1], self.k.shape[2]
+        return jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+
+    def valid(self):
+        """[B, S] bool — slot holds a real token."""
+        return self.positions() < self.lengths[:, None]
+
+
+def init_cache(cfg: ModelConfig, batch: int, max_seq: int,
+               dtype=None) -> KVCache:
+    dtype = dtype or jnp.dtype(cfg.dtype)
+    shape = (cfg.num_layers, batch, max_seq, cfg.num_kv_heads, cfg.head_dim)
+    return KVCache(
+        k=jnp.zeros(shape, dtype),
+        v=jnp.zeros(shape, dtype),
+        lengths=jnp.zeros((batch,), jnp.int32),
+    )
+
+
+def write_block(cache_layer, new, starts):
+    """Per-sequence cache write for one layer's buffer.
+
+    cache_layer: [B,S,Hkv,hd]; new: [B,s,Hkv,hd]; starts: [B] int32 — the
+    slot where each sequence's block begins. Clamps at capacity (XLA
+    dynamic_update_slice semantics); the engine enforces that sequences never
+    exceed max_seq.
+    """
+    return jax.vmap(
+        lambda c, n, st: jax.lax.dynamic_update_slice_in_dim(c, n, st, axis=0)
+    )(cache_layer, new.astype(cache_layer.dtype), starts)

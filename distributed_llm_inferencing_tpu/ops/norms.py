@@ -1,0 +1,34 @@
+"""Normalization layers (functional).
+
+Computation is done in float32 regardless of param/activation dtype — the
+standard TPU recipe (bf16 matmuls, f32 reductions).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def layer_norm(x, scale, bias, eps: float = 1e-5):
+    """LayerNorm over the last axis. scale/bias: [D]."""
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+    y = (xf - mean) / jnp.sqrt(var + eps)
+    y = y * scale.astype(jnp.float32) + bias.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def rms_norm(x, scale, eps: float = 1e-5):
+    """RMSNorm over the last axis (llama-style). scale: [D]."""
+    xf = x.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    y = xf * (1.0 / jnp.sqrt(ms + eps))
+    y = y * scale.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def norm(x, params, norm_type: str, eps: float):
+    if norm_type == "rmsnorm":
+        return rms_norm(x, params["scale"], eps)
+    return layer_norm(x, params["scale"], params["bias"], eps)

@@ -1,0 +1,33 @@
+"""Rotary position embeddings (RoPE), llama/HF convention.
+
+HF "rotate_half" layout: the head_dim is split into two halves; frequency i
+applies to dims (i, i + head_dim//2). Matches transformers'
+LlamaRotaryEmbedding so converted checkpoints are bit-compatible.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_freqs(head_dim: int, theta: float):
+    """inv_freq: [head_dim//2] float32."""
+    return 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+
+
+def apply_rope(x, positions, theta: float):
+    """Apply RoPE.
+
+    x: [B, S, H, hd]; positions: [B, S] int32 absolute positions.
+    Returns same shape/dtype as x.
+    """
+    hd = x.shape[-1]
+    inv_freq = rope_freqs(hd, theta)  # [hd/2]
+    # angles: [B, S, hd/2]
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq
+    cos = jnp.cos(angles)[:, :, None, :]  # [B,S,1,hd/2]
+    sin = jnp.sin(angles)[:, :, None, :]
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., : hd // 2], xf[..., hd // 2:]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)

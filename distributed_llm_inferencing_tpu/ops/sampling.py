@@ -1,0 +1,73 @@
+"""Token sampling: temperature / top-k / top-p / greedy, fully in XLA.
+
+Mirrors the reference's (hardcoded) sampling configuration —
+do_sample=True, top_p=0.95, top_k=50, temperature=0.8
+(reference: worker/app.py:297-305) — as the defaults of an explicit
+SamplingParams, and implements the pipeline as a jit-friendly pure function
+so it fuses into the decode step instead of running host-side per token.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    # Defaults mirror reference worker/app.py:297-305.
+    temperature: float = 0.8
+    top_k: int = 50
+    top_p: float = 0.95
+    do_sample: bool = True
+
+    @staticmethod
+    def greedy() -> "SamplingParams":
+        return SamplingParams(do_sample=False)
+
+
+def _mask_top_k(logits, k: int):
+    """Keep the k largest logits per row, set the rest to -inf."""
+    if k <= 0 or k >= logits.shape[-1]:
+        return logits
+    kth = jax.lax.top_k(logits, k)[0][..., -1:]  # [., 1] k-th largest value
+    return jnp.where(logits < kth, -jnp.inf, logits)
+
+
+def _mask_top_p(logits, p: float):
+    """Nucleus filtering: keep the smallest prefix of the sorted distribution
+    with cumulative probability >= p (the token crossing the threshold is
+    kept, matching HF's TopPLogitsWarper)."""
+    if p >= 1.0:
+        return logits
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # sorted position i is removed if the cumulative mass *before* it >= p
+    keep_sorted = (cum - probs) < p
+    # threshold logit = smallest kept logit
+    num_keep = jnp.sum(keep_sorted, axis=-1, keepdims=True)  # >= 1
+    thresh = jnp.take_along_axis(sorted_logits, num_keep - 1, axis=-1)
+    return jnp.where(logits < thresh, -jnp.inf, logits)
+
+
+def sample(logits, key, params: SamplingParams,
+           ban_tokens: Optional[jax.Array] = None):
+    """Sample next tokens. logits: [..., V] float; returns [...] int32.
+
+    The transform order (temperature -> top_k -> top_p) matches HF
+    generate()'s LogitsProcessor ordering so outputs are comparable.
+    """
+    logits = logits.astype(jnp.float32)
+    if ban_tokens is not None:
+        logits = jnp.where(ban_tokens, -jnp.inf, logits)
+    if not params.do_sample:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    t = max(params.temperature, 1e-6)
+    logits = logits / t
+    logits = _mask_top_k(logits, params.top_k)
+    logits = _mask_top_p(logits, params.top_p)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
