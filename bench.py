@@ -1,0 +1,76 @@
+"""Headline benchmark: GPT-2 decode tokens/sec/chip vs the reference stack.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+- ours: distributed_llm_inferencing_tpu engine (jitted prefill+decode, bf16)
+  on the default JAX backend (the real TPU chip under the driver).
+- baseline: the reference's serving stack — HF transformers ``generate()``
+  on torch CPU (the reference's worker hot loop, worker/app.py:297-305) —
+  measured fresh in the same process, same model config, same sampling
+  params (top_p=0.95, top_k=50, temperature=0.8), same prompt/new-token
+  counts. Both sides use random-init full-size gpt2 (125M) weights: no
+  network access, and wall-clock is weight-value-independent.
+"""
+
+import json
+import os
+import sys
+import time
+
+PROMPT_LEN = 16
+NEW_TOKENS = 64
+MODEL = "gpt2"
+
+
+def bench_reference_stack():
+    import torch
+    import transformers
+    torch.manual_seed(0)
+    model = transformers.GPT2LMHeadModel(transformers.GPT2Config()).eval()
+    prompt = torch.randint(0, 50257, (1, PROMPT_LEN))
+    kw = dict(do_sample=True, top_p=0.95, top_k=50, temperature=0.8)
+    with torch.no_grad():
+        model.generate(prompt, max_new_tokens=8, **kw)  # warmup
+        t0 = time.perf_counter()
+        out = model.generate(prompt, max_new_tokens=NEW_TOKENS, **kw)
+        dt = time.perf_counter() - t0
+    n = out.shape[1] - PROMPT_LEN
+    return n / dt
+
+
+def bench_ours():
+    import numpy as np
+    from distributed_llm_inferencing_tpu.models.registry import get_config
+    from distributed_llm_inferencing_tpu.ops.sampling import SamplingParams
+    from distributed_llm_inferencing_tpu.runtime.engine import InferenceEngine
+
+    cfg = get_config(MODEL)
+    eng = InferenceEngine(cfg, max_seq=PROMPT_LEN + NEW_TOKENS + 16, seed=0)
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, cfg.vocab_size, PROMPT_LEN).tolist()
+    sp = SamplingParams(temperature=0.8, top_k=50, top_p=0.95)
+    eng.generate([prompt], max_new_tokens=8, sampling=sp)  # warmup/compile
+    res = eng.generate([prompt], max_new_tokens=NEW_TOKENS, sampling=sp)
+    total_ms = res.prefill_ms + res.decode_ms
+    n = len(res.tokens[0])
+    return n / (total_ms / 1e3)
+
+
+def main():
+    ours = bench_ours()
+    print(f"ours: {ours:.2f} tok/s", file=sys.stderr)
+    baseline = bench_reference_stack()
+    print(f"reference stack (HF torch CPU): {baseline:.2f} tok/s",
+          file=sys.stderr)
+    print(json.dumps({
+        "metric": "gpt2_decode_tokens_per_s_per_chip",
+        "value": round(ours, 2),
+        "unit": "tokens/s",
+        "vs_baseline": round(ours / baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    main()

@@ -1,0 +1,96 @@
+"""CLI entry: python -m distributed_llm_inferencing_tpu <command>.
+
+Replaces the reference's process entrypoints — ``manage.py runserver`` /
+gunicorn for the master, ``app.py`` / gunicorn for the worker, and the
+``manage.py shard_model`` CLI (reference: master/Dockerfile:44,
+worker/Dockerfile:47, shard_model.py:11-14).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="distributed_llm_inferencing_tpu",
+        description="TPU-native distributed LLM inference framework")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    w = sub.add_parser("worker", help="run a worker agent (data plane)")
+    w.add_argument("--host", default="0.0.0.0")
+    w.add_argument("--port", type=int, default=8100)
+
+    m = sub.add_parser("master", help="run the master (control plane)")
+    m.add_argument("--host", default="0.0.0.0")
+    m.add_argument("--port", type=int, default=8000)
+    m.add_argument("--db", default="master.sqlite3")
+
+    p = sub.add_parser("plan", help="compute a placement plan "
+                                    "(shard_model equivalent)")
+    p.add_argument("--model_name", required=True)
+    p.add_argument("--mesh", default="tp=1",
+                   help="e.g. 'tp=4,dp=2' or 'pp=4'")
+    p.add_argument("--max_seq", type=int, default=2048)
+    p.add_argument("--batch", type=int, default=1)
+
+    g = sub.add_parser("generate", help="one-shot local generation")
+    g.add_argument("--model_name", default="gpt2")
+    g.add_argument("--checkpoint_path")
+    g.add_argument("--prompt", required=True)
+    g.add_argument("--max_new_tokens", type=int, default=100)
+    g.add_argument("--mesh", default="")
+    g.add_argument("--allow_random_init", action="store_true")
+    g.add_argument("--greedy", action="store_true")
+
+    args = ap.parse_args(argv)
+
+    if args.cmd == "worker":
+        from distributed_llm_inferencing_tpu.runtime.worker import WorkerAgent
+        WorkerAgent().serve(args.host, args.port)
+    elif args.cmd == "master":
+        from distributed_llm_inferencing_tpu.runtime.master import Master
+        Master(args.db).serve(args.host, args.port)
+    elif args.cmd == "plan":
+        from distributed_llm_inferencing_tpu.parallel.plan import make_plan
+        mesh = dict(kv.split("=") for kv in args.mesh.split(",") if kv)
+        plan = make_plan(args.model_name, mesh, max_seq=args.max_seq,
+                         batch=args.batch)
+        json.dump(plan, sys.stdout, indent=2)
+        print()
+    elif args.cmd == "generate":
+        _generate(args)
+
+
+def _generate(args):
+    import jax.numpy as jnp
+    from distributed_llm_inferencing_tpu.models.registry import get_config
+    from distributed_llm_inferencing_tpu.ops.sampling import SamplingParams
+    from distributed_llm_inferencing_tpu.parallel.mesh import MeshSpec
+    from distributed_llm_inferencing_tpu.runtime.engine import InferenceEngine
+    from distributed_llm_inferencing_tpu.utils.tokenizer import load_tokenizer
+
+    if args.checkpoint_path:
+        from distributed_llm_inferencing_tpu.models.convert import load_hf_model
+        cfg, params = load_hf_model(args.checkpoint_path)
+    elif args.allow_random_init:
+        cfg, params = get_config(args.model_name), None
+    else:
+        sys.exit("need --checkpoint_path or --allow_random_init")
+    mesh = MeshSpec.from_dict(
+        dict(kv.split("=") for kv in args.mesh.split(",") if kv))
+    eng = InferenceEngine(cfg, params, mesh_spec=mesh)
+    tok = load_tokenizer(args.checkpoint_path, cfg.vocab_size)
+    sp = SamplingParams.greedy() if args.greedy else SamplingParams()
+    res = eng.generate([tok.encode(args.prompt)],
+                       max_new_tokens=args.max_new_tokens, sampling=sp,
+                       eos_token_id=tok.eos_token_id)
+    print(tok.decode(res.tokens[0]))
+    print(f"[prefill {res.prefill_ms:.0f}ms, "
+          f"decode {res.decode_tokens_per_s:.1f} tok/s]", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,2 @@
+from distributed_llm_inferencing_tpu.parallel.mesh import MeshSpec, create_mesh  # noqa: F401
+from distributed_llm_inferencing_tpu.parallel import sharding, plan  # noqa: F401

@@ -1,0 +1,111 @@
+"""Partition specs: how the unified-transformer pytree maps onto the mesh.
+
+This module IS the TPU-native replacement for the reference's shard_model
+CLI (reference: shard_model.py:55-109): where the reference rewrote weight
+files into full-size per-range copies (and never wired the cross-shard
+handoff, worker/app.py:334-336), we assign a ``PartitionSpec`` per leaf and
+let GSPMD insert the ICI collectives. "Sharding a model" becomes metadata,
+applied at load time, with no weight rewriting.
+
+Scheme (megatron-style, see jax-ml.github.io/scaling-book):
+- attention q/o and MLP up/gate/down shard heads/columns over ``tp``
+- stacked layer axis [L, ...] optionally shards over ``pp`` (weight-
+  distributed; true pipelined execution lives in parallel/pipeline.py)
+- MoE experts shard over ``ep``
+- vocab (embedding rows / lm_head columns) shards over ``tp``
+- KV cache shards batch over ``dp`` and kv-heads over ``tp``
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_llm_inferencing_tpu.models.config import ModelConfig
+from distributed_llm_inferencing_tpu.parallel.mesh import MeshSpec
+
+
+def param_specs(cfg: ModelConfig, spec: MeshSpec,
+                shard_layers_over_pp: bool = True) -> Dict[str, Any]:
+    """PartitionSpec pytree matching models/transformer.py's param schema."""
+    # kv heads replicate over tp when tp > num_kv_heads (GQA small-kv case)
+    kv_tp = "tp" if cfg.num_kv_heads % max(spec.tp, 1) == 0 and spec.tp <= cfg.num_kv_heads else None
+    L = "pp" if shard_layers_over_pp else None
+
+    def norm_p():
+        p = {"scale": P(L, None)}
+        if cfg.norm_type == "layernorm":
+            p["bias"] = P(L, None)
+        return p
+
+    layers: Dict[str, Any] = {
+        "attn_norm": norm_p(),
+        "q": {"w": P(L, None, "tp")},
+        "k": {"w": P(L, None, kv_tp)},
+        "v": {"w": P(L, None, kv_tp)},
+        "o": {"w": P(L, "tp", None)},
+        "mlp_norm": norm_p(),
+    }
+    if cfg.attn_bias:
+        layers["q"]["b"] = P(L, "tp")
+        layers["k"]["b"] = P(L, kv_tp)
+        layers["v"]["b"] = P(L, kv_tp)
+        layers["o"]["b"] = P(L, None)
+    if cfg.is_moe:
+        layers["router"] = {"w": P(L, None, None)}
+        layers["experts"] = {
+            "gate": {"w": P(L, "ep", None, "tp")},
+            "up": {"w": P(L, "ep", None, "tp")},
+            "down": {"w": P(L, "ep", "tp", None)},
+        }
+    else:
+        layers["up"] = {"w": P(L, None, "tp")}
+        if cfg.gated_mlp:
+            layers["gate"] = {"w": P(L, None, "tp")}
+        layers["down"] = {"w": P(L, "tp", None)}
+        if cfg.mlp_bias:
+            layers["up"]["b"] = P(L, "tp")
+            layers["down"]["b"] = P(L, None)
+
+    specs = {
+        "embed": {"tokens": P("tp", None)},
+        "layers": layers,
+        "final_norm": ({"scale": P(None), "bias": P(None)}
+                       if cfg.norm_type == "layernorm" else {"scale": P(None)}),
+    }
+    if cfg.position_embedding == "learned":
+        specs["embed"]["positions"] = P(None, None)
+    if not cfg.tie_word_embeddings:
+        specs["lm_head"] = {"w": P(None, "tp")}
+    return specs
+
+
+def cache_specs(cfg: ModelConfig, spec: MeshSpec):
+    """KVCache sharding: [L,B,S,Hkv,hd] — batch over dp, kv heads over tp,
+    sequence over sp (ring attention shards the S axis)."""
+    kv_tp = "tp" if spec.tp <= cfg.num_kv_heads else None
+    kv = P(None, "dp", "sp" if spec.sp > 1 else None, kv_tp, None)
+    from distributed_llm_inferencing_tpu.ops.kvcache import KVCache
+    return KVCache(k=kv, v=kv, lengths=P("dp"))
+
+
+def logits_spec():
+    return P("dp", None, "tp")
+
+
+def tokens_spec():
+    return P("dp", None)
+
+
+def named(mesh: Mesh, spec_tree):
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s), spec_tree,
+        is_leaf=lambda x: isinstance(x, P))
+
+
+def shard_params(params, mesh: Mesh, cfg: ModelConfig, spec: MeshSpec):
+    """Place a param pytree onto the mesh per param_specs."""
+    shardings = named(mesh, param_specs(cfg, spec))
+    return jax.device_put(params, shardings)

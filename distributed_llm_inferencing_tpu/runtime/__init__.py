@@ -1,0 +1,1 @@
+from distributed_llm_inferencing_tpu.runtime.engine import InferenceEngine, GenerateResult  # noqa: F401

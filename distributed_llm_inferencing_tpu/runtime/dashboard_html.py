@@ -1,0 +1,231 @@
+"""Dashboard pages: self-contained HTML+JS, no external assets.
+
+Mirrors the reference's three screens and polling behavior
+(reference: master/dashboard/templates/dashboard/{dashboard,
+node_management,inference}.html) — stat cards + recent table (10s poll),
+node add/remove with live utilization columns (10s poll), inference
+submit/poll/view (2s status poll) — with TPU device stats in place of
+CPU/GPU percent and no CDN dependencies (the reference pulled Bootstrap
+and jQuery from CDNs, base.html:9-11,56-58).
+"""
+
+_STYLE = """
+<style>
+:root { --bg:#0f1419; --card:#1a2129; --text:#e6e8ea; --muted:#8a939e;
+        --accent:#4da3ff; --ok:#3fb76f; --bad:#e0565b; --warn:#e0a33c; }
+* { box-sizing:border-box; margin:0; }
+body { background:var(--bg); color:var(--text);
+       font:14px/1.5 system-ui,-apple-system,sans-serif; display:flex; }
+nav { width:200px; min-height:100vh; background:var(--card); padding:20px 0; }
+nav h1 { font-size:15px; padding:0 16px 16px; color:var(--accent); }
+nav a { display:block; padding:10px 16px; color:var(--text);
+        text-decoration:none; }
+nav a:hover, nav a.active { background:#232c36; }
+main { flex:1; padding:24px; max-width:1100px; }
+h2 { font-size:18px; margin-bottom:16px; }
+.cards { display:grid; grid-template-columns:repeat(4,1fr); gap:12px;
+         margin-bottom:24px; }
+.card { background:var(--card); border-radius:8px; padding:16px; }
+.card .num { font-size:26px; font-weight:600; }
+.card .label { color:var(--muted); font-size:12px; }
+table { width:100%; border-collapse:collapse; background:var(--card);
+        border-radius:8px; overflow:hidden; }
+th, td { text-align:left; padding:9px 12px; border-bottom:1px solid #232c36;
+         font-size:13px; }
+th { color:var(--muted); font-weight:500; }
+.pill { padding:2px 8px; border-radius:10px; font-size:12px; }
+.pill.completed,.pill.online { background:#153f28; color:var(--ok); }
+.pill.failed,.pill.offline { background:#47191b; color:var(--bad); }
+.pill.pending { background:#3d3010; color:var(--warn); }
+.pill.processing { background:#10304d; color:var(--accent); }
+input, select, textarea { background:#10161c; color:var(--text);
+  border:1px solid #2a3440; border-radius:6px; padding:8px; width:100%;
+  font:inherit; }
+button { background:var(--accent); color:#08131f; border:0; padding:9px 16px;
+  border-radius:6px; font:inherit; font-weight:600; cursor:pointer; }
+button:hover { filter:brightness(1.1); }
+form .row { margin-bottom:12px; }
+label { display:block; color:var(--muted); font-size:12px;
+        margin-bottom:4px; }
+pre.result { background:#10161c; padding:12px; border-radius:6px;
+  white-space:pre-wrap; margin-top:12px; min-height:60px; }
+.grid2 { display:grid; grid-template-columns:1fr 1fr; gap:24px; }
+.muted { color:var(--muted); }
+</style>
+"""
+
+
+def _nav(active: str) -> str:
+    items = [("/", "Dashboard"), ("/nodes", "Nodes"), ("/inference", "Inference")]
+    links = "".join(
+        f'<a href="{h}" class="{"active" if h == active else ""}">{t}</a>'
+        for h, t in items)
+    return (f'<nav><h1>TPU Inference</h1>{links}'
+            f'<div style="padding:16px" class="muted">'
+            f'distributed_llm_inferencing_tpu</div></nav>')
+
+
+DASHBOARD = f"""<!doctype html><html><head><title>Dashboard</title>{_STYLE}
+</head><body>{_nav("/")}<main>
+<h2>Cluster Dashboard</h2>
+<div class="cards">
+  <div class="card"><div class="num" id="n-nodes">–</div>
+    <div class="label">active nodes</div></div>
+  <div class="card"><div class="num" id="n-pending">–</div>
+    <div class="label">pending</div></div>
+  <div class="card"><div class="num" id="n-processing">–</div>
+    <div class="label">processing</div></div>
+  <div class="card"><div class="num" id="n-completed">–</div>
+    <div class="label">completed</div></div>
+</div>
+<h2>Recent Requests</h2>
+<table><thead><tr><th>ID</th><th>Model</th><th>Status</th><th>tok/s</th>
+<th>Latency (s)</th><th>Node</th></tr></thead>
+<tbody id="recent"></tbody></table>
+<script>
+async function refresh() {{
+  try {{
+    const ns = await (await fetch('/api/nodes/status')).json();
+    document.getElementById('n-nodes').textContent =
+      ns.nodes.filter(n => n.is_active).length;
+    const r = await (await fetch('/api/inference/recent')).json();
+    for (const k of ['pending','processing','completed'])
+      document.getElementById('n-'+k).textContent = r.counts[k] || 0;
+    document.getElementById('recent').innerHTML = r.requests.map(q =>
+      `<tr><td>${{q.id}}</td><td>${{q.model_name}}</td>`+
+      `<td><span class="pill ${{q.status}}">${{q.status}}</span></td>`+
+      `<td>${{q.tokens_per_s ? q.tokens_per_s.toFixed(1) : ''}}</td>`+
+      `<td>${{q.execution_time ? q.execution_time.toFixed(2) : ''}}</td>`+
+      `<td>${{q.node_id ?? ''}}</td></tr>`).join('');
+  }} catch (e) {{ console.error(e); }}
+}}
+refresh(); setInterval(refresh, 10000);  // 10s, like reference dashboard.html:119-134
+</script></main></body></html>"""
+
+
+NODES = f"""<!doctype html><html><head><title>Nodes</title>{_STYLE}
+</head><body>{_nav("/nodes")}<main>
+<h2>Worker Nodes</h2>
+<table><thead><tr><th>ID</th><th>Name</th><th>Address</th><th>Status</th>
+<th>Devices</th><th>CPU %</th><th>Mem %</th><th>Models</th><th>In-flight</th>
+<th></th></tr></thead><tbody id="nodes"></tbody></table>
+<h2 style="margin-top:24px">Add Node</h2>
+<div class="grid2"><form id="add">
+  <div class="row"><label>Name</label><input name="name" required></div>
+  <div class="row"><label>Host</label><input name="host" required
+       placeholder="127.0.0.1"></div>
+  <div class="row"><label>Port</label><input name="port" value="8100"></div>
+  <button>Add Node</button> <span id="add-msg" class="muted"></span>
+</form></div>
+<script>
+async function refresh() {{
+  const r = await (await fetch('/api/nodes/status')).json();
+  document.getElementById('nodes').innerHTML = r.nodes.map(n => {{
+    const dev = (n.resources && n.resources.devices || [])
+      .map(d => d.kind || d.platform).join(', ');
+    const models = n.loaded_models.map(m =>
+      `${{m.name}} [${{Object.entries(m.mesh).filter(e=>e[1]>1)
+        .map(e=>e.join('=')).join(' ') || '1 chip'}}]`).join('<br>');
+    return `<tr><td>${{n.id}}</td><td>${{n.name}}</td>`+
+    `<td>${{n.host}}:${{n.port}}</td>`+
+    `<td><span class="pill ${{n.is_active?'online':'offline'}}">`+
+    `${{n.is_active?'online':'offline'}}</span></td>`+
+    `<td>${{dev}}</td>`+
+    `<td>${{n.resources && n.resources.cpu != null ? n.resources.cpu : ''}}</td>`+
+    `<td>${{n.resources && n.resources.memory != null ? n.resources.memory : ''}}</td>`+
+    `<td>${{models}}</td><td>${{n.inflight}}</td>`+
+    `<td><button onclick="removeNode(${{n.id}})">Remove</button></td></tr>`;
+  }}).join('');
+}}
+async function removeNode(id) {{
+  await fetch('/api/nodes/remove/'+id, {{method:'POST'}});
+  refresh();
+}}
+document.getElementById('add').addEventListener('submit', async e => {{
+  e.preventDefault();
+  const f = new FormData(e.target);
+  const body = {{name:f.get('name'), host:f.get('host'),
+                port:parseInt(f.get('port'))}};
+  const res = await fetch('/api/nodes/add',
+    {{method:'POST', body:JSON.stringify(body)}});
+  const j = await res.json();
+  document.getElementById('add-msg').textContent =
+    j.status === 'success' ? 'added' : j.message;
+  refresh();
+}});
+refresh(); setInterval(refresh, 10000);  // 10s, like node_management.html:221-229
+</script></main></body></html>"""
+
+
+INFERENCE = f"""<!doctype html><html><head><title>Inference</title>{_STYLE}
+</head><body>{_nav("/inference")}<main>
+<div class="grid2">
+<div>
+<h2>Run Inference</h2>
+<form id="run">
+  <div class="row"><label>Model</label><input name="model" value="gpt2"></div>
+  <div class="row"><label>Prompt</label>
+    <textarea name="prompt" rows="5" required></textarea></div>
+  <div class="row"><label>Max new tokens</label>
+    <input name="max_new_tokens" value="100"></div>
+  <div class="row"><label>Temperature / top-k / top-p</label>
+    <div style="display:flex;gap:8px">
+      <input name="temperature" value="0.8"><input name="top_k" value="50">
+      <input name="top_p" value="0.95"></div></div>
+  <button>Submit</button> <span id="run-msg" class="muted"></span>
+</form>
+<h2 style="margin-top:16px">Result</h2>
+<pre class="result" id="result"></pre>
+</div>
+<div>
+<h2>Recent</h2>
+<table><thead><tr><th>ID</th><th>Model</th><th>Status</th><th></th></tr>
+</thead><tbody id="recent"></tbody></table>
+</div></div>
+<script>
+let pollTimer = null;
+async function refresh() {{
+  const r = await (await fetch('/api/inference/recent')).json();
+  document.getElementById('recent').innerHTML = r.requests.map(q =>
+    `<tr><td>${{q.id}}</td><td>${{q.model_name}}</td>`+
+    `<td><span class="pill ${{q.status}}">${{q.status}}</span></td>`+
+    `<td><button onclick="view(${{q.id}})">view</button></td></tr>`).join('');
+}}
+async function view(id) {{
+  const r = await (await fetch('/api/inference/status/'+id)).json();
+  const q = r.request;
+  document.getElementById('result').textContent =
+    q.status === 'completed' ? q.result :
+    q.status === 'failed' ? 'FAILED: ' + q.error : '(' + q.status + ')';
+}}
+function poll(id) {{
+  if (pollTimer) clearInterval(pollTimer);
+  pollTimer = setInterval(async () => {{   // 2s, like inference.html:206-258
+    const r = await (await fetch('/api/inference/status/'+id)).json();
+    const q = r.request;
+    if (q.status === 'completed' || q.status === 'failed') {{
+      clearInterval(pollTimer); view(id); refresh();
+    }}
+  }}, 2000);
+}}
+document.getElementById('run').addEventListener('submit', async e => {{
+  e.preventDefault();
+  const f = new FormData(e.target);
+  const body = {{
+    model_name: f.get('model'), prompt: f.get('prompt'),
+    max_new_tokens: parseInt(f.get('max_new_tokens')),
+    sampling: {{ temperature: parseFloat(f.get('temperature')),
+                top_k: parseInt(f.get('top_k')),
+                top_p: parseFloat(f.get('top_p')) }} }};
+  const res = await fetch('/api/inference/submit',
+    {{method:'POST', body:JSON.stringify(body)}});
+  const j = await res.json();
+  if (j.status === 'success') {{
+    document.getElementById('run-msg').textContent = 'request ' + j.request_id;
+    document.getElementById('result').textContent = '(pending)';
+    poll(j.request_id);
+  }} else document.getElementById('run-msg').textContent = j.message;
+  refresh();
+}});
+refresh(); setInterval(refresh, 10000);
+</script></main></body></html>"""

@@ -1,0 +1,177 @@
+"""Minimal JSON-over-HTTP service base (stdlib only).
+
+Both the worker agent and the master control plane are built on this —
+the TPU build's stand-in for the reference's Flask (worker/app.py) and
+Django (master/) stacks, with the same wire shape: JSON bodies, bearer-token
+auth (reference: worker/app.py:32-47), and structured error responses
+(reference: worker/app.py:133-137).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional, Tuple
+
+
+class Route:
+    def __init__(self, method: str, pattern: str, fn: Callable):
+        self.method = method
+        self.regex = re.compile("^" + re.sub(
+            r"<(\w+)>", r"(?P<\1>[^/]+)", pattern) + "/?$")
+        self.fn = fn
+
+
+class JsonHTTPService:
+    """Register handlers; serve with ThreadingHTTPServer.
+
+    Handler signature: fn(body: dict, **path_params) -> (status, payload)
+    or -> payload (200 implied). Payload of type (bytes, content_type)
+    passes through raw (HTML pages, SSE handled separately).
+    """
+
+    def __init__(self, name: str, auth_key: Optional[str] = None):
+        self.name = name
+        self.auth_key = auth_key
+        self.routes: List[Route] = []
+        self._server: Optional[ThreadingHTTPServer] = None
+
+    def route(self, method: str, pattern: str):
+        def deco(fn):
+            self.routes.append(Route(method, pattern, fn))
+            return fn
+        return deco
+
+    def add(self, method: str, pattern: str, fn: Callable):
+        self.routes.append(Route(method, pattern, fn))
+
+    # ---- serving -----------------------------------------------------
+
+    def make_handler(service):
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # quiet; logging via Metrics
+                pass
+
+            def _send_json(self, status: int, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _send_raw(self, status: int, data: bytes, ctype: str):
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _authorized(self) -> bool:
+                if not service.auth_key:
+                    return True
+                hdr = self.headers.get("Authorization", "")
+                return hdr == f"Bearer {service.auth_key}"
+
+            def _dispatch(self, method: str):
+                if not self._authorized():
+                    return self._send_json(401, {"status": "error",
+                                                 "message": "unauthorized"})
+                path = self.path.split("?", 1)[0]
+                for r in service.routes:
+                    if r.method != method:
+                        continue
+                    m = r.regex.match(path)
+                    if not m:
+                        continue
+                    body = {}
+                    if method in ("POST", "PUT"):
+                        n = int(self.headers.get("Content-Length") or 0)
+                        if n:
+                            try:
+                                body = json.loads(self.rfile.read(n) or b"{}")
+                            except json.JSONDecodeError:
+                                return self._send_json(
+                                    400, {"status": "error",
+                                          "message": "invalid JSON body"})
+                    try:
+                        result = r.fn(body, **m.groupdict(), _request=self) \
+                            if _wants_request(r.fn) else r.fn(body, **m.groupdict())
+                    except _Streaming:
+                        return  # handler already wrote the response
+                    except Exception as e:  # structured 500, like worker/app.py:133-137
+                        return self._send_json(
+                            500, {"status": "error", "message": str(e)})
+                    if isinstance(result, tuple) and len(result) == 2 and \
+                            isinstance(result[0], int):
+                        status, payload = result
+                    else:
+                        status, payload = 200, result
+                    if isinstance(payload, tuple):  # (bytes, content_type)
+                        return self._send_raw(status, payload[0], payload[1])
+                    return self._send_json(status, payload)
+                self._send_json(404, {"status": "error", "message": "not found"})
+
+            def do_GET(self):
+                self._dispatch("GET")
+
+            def do_POST(self):
+                self._dispatch("POST")
+
+            def do_DELETE(self):
+                self._dispatch("DELETE")
+
+        return Handler
+
+    def serve(self, host: str, port: int, background: bool = False
+              ) -> ThreadingHTTPServer:
+        self._server = ThreadingHTTPServer((host, port), self.make_handler())
+        self._server.daemon_threads = True
+        if background:
+            t = threading.Thread(target=self._server.serve_forever, daemon=True)
+            t.start()
+        else:
+            self._server.serve_forever()
+        return self._server
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1] if self._server else 0
+
+    def shutdown(self):
+        if self._server:
+            self._server.shutdown()
+            self._server.server_close()
+
+
+class _Streaming(Exception):
+    """Raised by handlers that wrote the response themselves (SSE)."""
+
+
+def _wants_request(fn) -> bool:
+    import inspect
+    try:
+        return "_request" in inspect.signature(fn).parameters
+    except (TypeError, ValueError):
+        return False
+
+
+def sse_stream(request_handler, events):
+    """Write an SSE response from an iterator of dict events."""
+    request_handler.send_response(200)
+    request_handler.send_header("Content-Type", "text/event-stream")
+    request_handler.send_header("Cache-Control", "no-cache")
+    request_handler.send_header("Connection", "close")  # no length: close delimits
+    request_handler.end_headers()
+    try:
+        for ev in events:
+            data = f"data: {json.dumps(ev)}\n\n".encode()
+            request_handler.wfile.write(data)
+            request_handler.wfile.flush()
+    except (BrokenPipeError, ConnectionResetError):
+        pass
+    raise _Streaming()

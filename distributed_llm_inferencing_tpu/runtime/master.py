@@ -1,0 +1,428 @@
+"""Master control plane: node registry, request queue, scheduler, dashboard.
+
+One-for-one capability replacement of the reference's Django master
+(master/dashboard/views.py) with the same JSON API paths
+(master/dashboard/urls.py:11-16) and three dashboard pages
+(urls.py:6-8), re-architected:
+
+- thread-pool dispatcher + persistent queue instead of an unbounded
+  thread-per-request (reference views.py:233-236)
+- push-based health monitor with N-strike deactivation and automatic
+  reactivation, instead of UI-poll-driven one-strike marking
+  (reference views.py:91-105, SURVEY.md §3.4)
+- least-loaded scheduling with failover retry, instead of
+  ``active_nodes.first()`` and terminal failures
+  (reference views.py:389-391, 364-378)
+- placement plans (parallel/plan.py) instead of ModelShard file pointers;
+  the master actually calls the worker's /load_shard, which the reference
+  never did (SURVEY.md §3.2)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+import requests as http
+
+from distributed_llm_inferencing_tpu.runtime import dashboard_html, httpd
+from distributed_llm_inferencing_tpu.runtime.state import Store
+from distributed_llm_inferencing_tpu.utils.logging import setup_logging
+from distributed_llm_inferencing_tpu.utils.metrics import Metrics
+
+log = setup_logging("master")
+
+# Reference per-call timeouts (views.py:91,183,400,352-354)
+HEALTH_TIMEOUT = 5
+UNLOAD_TIMEOUT = 10
+LOAD_TIMEOUT = 300
+INFER_TIMEOUT = 120
+
+MAX_ATTEMPTS = 3          # reference: 1 attempt, terminal (views.py:364-378)
+FAILURE_STRIKES = 3       # reference: one strike (views.py:99-105)
+
+
+class Master:
+    def __init__(self, db_path: str = ":memory:", *,
+                 dispatcher_threads: int = 4,
+                 health_interval: float = 10.0,
+                 auth_key: Optional[str] = None):
+        self.store = Store(db_path)
+        self.metrics = Metrics()
+        self.health_interval = health_interval
+        self._worker_auth = auth_key or os.environ.get("DLI_AUTH_KEY")
+        self._inflight: Dict[int, int] = {}   # node_id -> in-flight count
+        self._inflight_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._threads = []
+        self._dispatcher_threads = dispatcher_threads
+
+        # Optional auth for the master's own API (the reference master had
+        # none at all). When set, every endpoint — pages included — needs
+        # the bearer token; without it the master should only bind loopback
+        # or a trusted network, since it relays to workers with its own key.
+        api_auth = os.environ.get("DLI_MASTER_AUTH_KEY")
+        s = self.service = httpd.JsonHTTPService("master", api_auth)
+        # pages (reference urls.py:6-8)
+        s.add("GET", "/", lambda b: (dashboard_html.DASHBOARD.encode(), "text/html"))
+        s.add("GET", "/nodes", lambda b: (dashboard_html.NODES.encode(), "text/html"))
+        s.add("GET", "/inference", lambda b: (dashboard_html.INFERENCE.encode(), "text/html"))
+        # JSON API (reference urls.py:11-16)
+        s.add("GET", "/api/nodes/status", self.api_node_status)
+        s.add("POST", "/api/nodes/add", self.api_add_node)
+        s.add("POST", "/api/nodes/remove/<node_id>", self.api_remove_node)
+        s.add("POST", "/api/inference/submit", self.api_submit)
+        s.add("GET", "/api/inference/status/<req_id>", self.api_status)
+        s.add("GET", "/api/inference/recent", self.api_recent)
+        # beyond reference
+        s.add("GET", "/api/plans", self.api_list_plans)
+        s.add("POST", "/api/plans/create", self.api_create_plan)
+        s.add("POST", "/api/plans/deploy/<plan_id>", self.api_deploy_plan)
+        s.add("POST", "/api/models/load", self.api_load_model)
+        s.add("GET", "/api/metrics", lambda b: self.metrics.snapshot())
+        s.add("GET", "/health", lambda b: {"status": "online",
+                                           "counts": self.store.counts()})
+
+    # ---- worker RPC --------------------------------------------------
+
+    def _headers(self):
+        return ({"Authorization": f"Bearer {self._worker_auth}"}
+                if self._worker_auth else {})
+
+    def _worker_get(self, node, path, timeout):
+        return http.get(self.store.node_url(node) + path,
+                        headers=self._headers(), timeout=timeout)
+
+    def _worker_post(self, node, path, body, timeout):
+        return http.post(self.store.node_url(node) + path, json=body,
+                         headers=self._headers(), timeout=timeout)
+
+    # ---- node API ----------------------------------------------------
+
+    def api_add_node(self, body):
+        """≙ add_node (reference views.py:111-165): reachability-gate then
+        register."""
+        name = body.get("name")
+        host = body.get("host")
+        port = int(body.get("port", 8100))
+        if not name or not host:
+            return 400, {"status": "error", "message": "name and host required"}
+        node = {"host": host, "port": port}
+        try:
+            r = http.get(f"http://{host}:{port}/health",
+                         headers=self._headers(), timeout=HEALTH_TIMEOUT)
+            r.raise_for_status()
+            info = r.json()
+        except Exception as e:
+            return 502, {"status": "error",
+                         "message": f"worker unreachable: {e}"}
+        existing = self.store.find_node(host, port)
+        if existing:
+            self.store.update_node(existing["id"], is_active=1,
+                                   consecutive_failures=0,
+                                   last_heartbeat=time.time(), info=info)
+            return {"status": "success", "node_id": existing["id"],
+                    "message": "node re-activated"}
+        node_id = self.store.add_node(name, host, port, is_active=True)
+        self.store.update_node(node_id, last_heartbeat=time.time(), info=info)
+        log.info("node %s added: %s:%d", name, host, port)
+        return {"status": "success", "node_id": node_id}
+
+    def api_remove_node(self, body, node_id):
+        """≙ remove_node (views.py:167-221): best-effort unload then delete."""
+        node = self.store.get_node(int(node_id))
+        if not node:
+            return 404, {"status": "error", "message": "no such node"}
+        try:
+            info = json.loads(node.get("info") or "{}")
+            for m in info.get("loaded_models", []):
+                self._worker_post(node, "/unload_model",
+                                  {"model_name": m["name"]}, UNLOAD_TIMEOUT)
+        except Exception as e:
+            log.warning("unload during remove failed: %s", e)
+        self.store.remove_node(int(node_id))
+        return {"status": "success"}
+
+    def api_node_status(self, body):
+        """≙ node_status (views.py:74-109) — but served from the health
+        monitor's state rather than fanning out HTTP per UI poll."""
+        nodes = []
+        for n in self.store.list_nodes():
+            info = json.loads(n.get("info") or "{}")
+            nodes.append({
+                "id": n["id"], "name": n["name"], "host": n["host"],
+                "port": n["port"], "is_active": bool(n["is_active"]),
+                "last_heartbeat": n["last_heartbeat"],
+                "resources": info.get("resources"),
+                "loaded_models": info.get("loaded_models", []),
+                "inflight": self._inflight.get(n["id"], 0),
+            })
+        return {"status": "success", "nodes": nodes}
+
+    # ---- model/plan API ----------------------------------------------
+
+    def api_create_plan(self, body):
+        """The shard_model CLI as an API (reference shard_model.py:16-115):
+        produce a placement plan instead of weight files."""
+        from distributed_llm_inferencing_tpu.parallel.plan import make_plan
+        try:
+            plan = make_plan(body["model_name"], body.get("mesh", {"tp": 1}),
+                             max_seq=int(body.get("max_seq", 2048)),
+                             batch=int(body.get("batch", 1)))
+        except (KeyError, ValueError) as e:
+            return 400, {"status": "error", "message": str(e)}
+        plan_id = self.store.add_plan(body["model_name"], plan)
+        return {"status": "success", "plan_id": plan_id, "plan": plan}
+
+    def api_list_plans(self, body):
+        return {"status": "success", "plans": self.store.list_plans()}
+
+    def api_deploy_plan(self, body, plan_id):
+        """Push a plan to a worker via /load_shard — the call the reference
+        defined but never made (SURVEY.md §3.2)."""
+        plans = [p for p in self.store.list_plans() if p["id"] == int(plan_id)]
+        if not plans:
+            return 404, {"status": "error", "message": "no such plan"}
+        plan = plans[0]
+        node = self._pick_node(model=None)
+        if node is None:
+            return 503, {"status": "error", "message": "no active nodes"}
+        payload = {"plan": plan["plan"]}
+        payload.update({k: body[k] for k in
+                        ("checkpoint_path", "tokenizer_path",
+                         "allow_random_init", "dtype") if k in body})
+        r = self._worker_post(node, "/load_shard", payload, LOAD_TIMEOUT)
+        if r.status_code == 200:
+            self.store.mark_plan_loaded(plan["id"], node["id"])
+        return r.status_code, r.json()
+
+    def api_load_model(self, body):
+        """Explicit model pre-load on a chosen or scheduled node."""
+        node = (self.store.get_node(int(body["node_id"]))
+                if body.get("node_id") else self._pick_node(model=None))
+        if node is None:
+            return 503, {"status": "error", "message": "no active nodes"}
+        r = self._worker_post(node, "/load_model", body, LOAD_TIMEOUT)
+        try:
+            payload = r.json()
+        except ValueError:
+            payload = {"status": "error", "message": r.text[:200]}
+        self._refresh_node(node)
+        return r.status_code, payload
+
+    # ---- inference API -----------------------------------------------
+
+    def api_submit(self, body):
+        """≙ submit_inference (views.py:223-258): enqueue + wake dispatcher."""
+        model = body.get("model_name")
+        prompt = body.get("prompt")
+        if not model or prompt is None:
+            return 400, {"status": "error",
+                         "message": "model_name and prompt required"}
+        # max_length keeps the reference's prompt+new semantics
+        # (views.py:351); it is forwarded verbatim so the worker computes
+        # new-token count against the tokenized prompt.
+        if "max_new_tokens" in body:
+            max_new, max_length = int(body["max_new_tokens"]), None
+        elif "max_length" in body:
+            max_new, max_length = None, int(body["max_length"])
+        else:
+            max_new, max_length = 100, None
+        req_id = self.store.submit_request(
+            model, prompt, max_new, body.get("sampling"),
+            max_length=max_length)
+        self.metrics.inc("requests_submitted")
+        self._wake.set()
+        return {"status": "success", "request_id": req_id}
+
+    def api_status(self, body, req_id):
+        """≙ inference_status (views.py:260-280)."""
+        r = self.store.get_request(int(req_id))
+        if not r:
+            return 404, {"status": "error", "message": "no such request"}
+        return {"status": "success", "request": r}
+
+    def api_recent(self, body):
+        """≙ recent_inferences (views.py:282-303)."""
+        return {"status": "success", "counts": self.store.counts(),
+                "requests": self.store.recent_requests(20)}
+
+    # ---- scheduling --------------------------------------------------
+
+    def _node_models(self, node) -> set:
+        info = json.loads(node.get("info") or "{}")
+        return {m["name"] for m in info.get("loaded_models", [])}
+
+    def _pick_node(self, model: Optional[str]):
+        """Least-loaded active node, preferring ones with the model already
+        loaded (reference: always .first(), views.py:389-391)."""
+        nodes = self.store.list_nodes(active_only=True)
+        if not nodes:
+            return None
+        with self._inflight_lock:
+            def load_key(n):
+                return self._inflight.get(n["id"], 0)
+            have = [n for n in nodes if model and model in self._node_models(n)]
+            pool = have or nodes
+            return min(pool, key=load_key)
+
+    def _refresh_node(self, node):
+        try:
+            r = self._worker_get(node, "/health", HEALTH_TIMEOUT)
+            r.raise_for_status()
+            self.store.update_node(
+                node["id"], info=r.json(), is_active=1,
+                consecutive_failures=0, last_heartbeat=time.time())
+        except Exception:
+            pass
+
+    def _execute(self, req) -> bool:
+        """Run one request on a chosen node. True on success."""
+        node = self._pick_node(req["model_name"])
+        if node is None:
+            self.store.mark_failed(req["id"], "no active worker nodes")
+            return False
+        nid = node["id"]
+        with self._inflight_lock:
+            self._inflight[nid] = self._inflight.get(nid, 0) + 1
+        try:
+            if req["model_name"] not in self._node_models(node):
+                # lazy load, like reference views.py:397-401 — random init is
+                # NOT silently allowed; operator must preload or register a
+                # checkpointed model unless the request says otherwise.
+                body = {"model_name": req["model_name"]}
+                if req["sampling"].get("allow_random_init"):
+                    body["allow_random_init"] = True
+                if req["sampling"].get("checkpoint_path"):
+                    body["checkpoint_path"] = req["sampling"]["checkpoint_path"]
+                r = self._worker_post(node, "/load_model", body, LOAD_TIMEOUT)
+                if 400 <= r.status_code < 500:
+                    # user error (unknown model, bad request): terminal, and
+                    # NOT the node's fault — no strike, no retry
+                    self.store.mark_failed(req["id"],
+                                           f"load rejected: {r.text[:200]}")
+                    self.metrics.inc("requests_rejected")
+                    return False
+                if r.status_code != 200:
+                    raise RuntimeError(f"load_model failed: {r.text[:200]}")
+                self._refresh_node(node)
+            infer_body = {
+                "model_name": req["model_name"],
+                "prompt": req["prompt"],
+                "sampling": req["sampling"],
+            }
+            if req.get("max_length") is not None:
+                infer_body["max_length"] = req["max_length"]
+            else:
+                infer_body["max_new_tokens"] = req["max_new_tokens"]
+            r = self._worker_post(node, "/inference", infer_body, INFER_TIMEOUT)
+            if 400 <= r.status_code < 500:
+                self.store.mark_failed(req["id"],
+                                       f"rejected: {r.text[:200]}")
+                self.metrics.inc("requests_rejected")
+                return False
+            if r.status_code != 200:
+                raise RuntimeError(f"inference failed: {r.text[:200]}")
+            data = r.json()
+            self.store.mark_completed(
+                req["id"], data.get("result", ""), nid,
+                data.get("execution_time", 0.0),
+                data.get("tokens_per_s", 0.0))
+            self.metrics.inc("requests_completed")
+            self.metrics.observe("request_latency",
+                                 time.time() - req["created_at"])
+            return True
+        except Exception as e:
+            log.warning("request %d failed on node %d: %s", req["id"], nid, e)
+            self.metrics.inc("requests_errored")
+            if req["attempts"] + 1 < MAX_ATTEMPTS:
+                self.store.requeue(req["id"])   # failover retry
+                self._wake.set()
+            else:
+                self.store.mark_failed(req["id"], str(e))
+            self._node_failure(node)
+            return False
+        finally:
+            with self._inflight_lock:
+                self._inflight[nid] = max(0, self._inflight.get(nid, 1) - 1)
+
+    def _node_failure(self, node):
+        n = self.store.get_node(node["id"])
+        if not n:
+            return
+        strikes = n["consecutive_failures"] + 1
+        fields = {"consecutive_failures": strikes}
+        if strikes >= FAILURE_STRIKES:
+            fields["is_active"] = 0
+            log.warning("node %d deactivated after %d strikes",
+                        n["id"], strikes)
+        self.store.update_node(n["id"], **fields)
+
+    # ---- background loops --------------------------------------------
+
+    def _dispatch_loop(self):
+        while not self._stop.is_set():
+            req = self.store.claim_next_pending()
+            if req is None:
+                self._wake.wait(timeout=0.5)
+                self._wake.clear()
+                continue
+            self._execute(req)
+
+    def _health_loop(self):
+        """Push-based monitoring with auto-reactivation — the upgrade over
+        the reference's UI-driven polls (SURVEY.md §3.4)."""
+        while not self._stop.is_set():
+            for n in self.store.list_nodes():
+                if self._stop.is_set():
+                    break
+                try:
+                    r = self._worker_get(n, "/health", HEALTH_TIMEOUT)
+                    r.raise_for_status()
+                    self.store.update_node(
+                        n["id"], info=r.json(), is_active=1,
+                        consecutive_failures=0, last_heartbeat=time.time())
+                except Exception:
+                    self._node_failure(n)
+            self._stop.wait(self.health_interval)
+
+    # ---- lifecycle ---------------------------------------------------
+
+    def start_background(self):
+        for i in range(self._dispatcher_threads):
+            t = threading.Thread(target=self._dispatch_loop, daemon=True,
+                                 name=f"dispatch-{i}")
+            t.start()
+            self._threads.append(t)
+        t = threading.Thread(target=self._health_loop, daemon=True,
+                             name="health")
+        t.start()
+        self._threads.append(t)
+
+    def serve(self, host="0.0.0.0", port=8000, background=False):
+        self.start_background()
+        log.info("master on %s:%d", host, port)
+        return self.service.serve(host, port, background=background)
+
+    def stop(self):
+        self._stop.set()
+        self._wake.set()
+        self.service.shutdown()
+
+
+def main(argv=None):
+    import argparse
+    ap = argparse.ArgumentParser(description="TPU inference master")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--db", default="master.sqlite3")
+    args = ap.parse_args(argv)
+    Master(args.db).serve(args.host, args.port)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,206 @@
+"""Master-side persistent state: nodes, placement plans, request queue.
+
+SQLite via stdlib — the same durability model as the reference's Django ORM
+over SQLite (reference: master/master/settings.py:58-63,
+master/dashboard/models.py:4-62) with the same three entities:
+
+- nodes     ≙ WorkerNode      (models.py:4-17)
+- plans     ≙ ModelShard      (models.py:19-31) — but a plan is partition-
+              spec metadata (parallel/plan.py), not a weight-file pointer
+- requests  ≙ InferenceRequest (models.py:33-62), including the
+              mark_completed/mark_failed lifecycle (models.py:52-62)
+
+Thread-safe: one connection guarded by a lock (the reference shared ORM
+state across raw threads unguarded, SURVEY.md §5.2).
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS nodes (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    name TEXT UNIQUE NOT NULL,
+    host TEXT NOT NULL,
+    port INTEGER NOT NULL,
+    is_active INTEGER DEFAULT 0,
+    consecutive_failures INTEGER DEFAULT 0,
+    last_heartbeat REAL,
+    added_at REAL,
+    info TEXT DEFAULT '{}'
+);
+CREATE TABLE IF NOT EXISTS plans (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    model_name TEXT NOT NULL,
+    plan TEXT NOT NULL,
+    node_id INTEGER,
+    is_loaded INTEGER DEFAULT 0,
+    created_at REAL
+);
+CREATE TABLE IF NOT EXISTS requests (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    model_name TEXT NOT NULL,
+    prompt TEXT NOT NULL,
+    status TEXT DEFAULT 'pending',
+    result TEXT,
+    error TEXT,
+    node_id INTEGER,
+    attempts INTEGER DEFAULT 0,
+    max_new_tokens INTEGER,
+    max_length INTEGER,
+    sampling TEXT DEFAULT '{}',
+    created_at REAL,
+    started_at REAL,
+    completed_at REAL,
+    execution_time REAL,
+    tokens_per_s REAL
+);
+"""
+
+
+def _row_to_dict(cur, row):
+    return {d[0]: row[i] for i, d in enumerate(cur.description)}
+
+
+class Store:
+    def __init__(self, path: str = ":memory:"):
+        self._lock = threading.RLock()
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        self._db.execute("PRAGMA journal_mode=WAL")
+        with self._lock, self._db:
+            self._db.executescript(_SCHEMA)
+
+    def _all(self, sql, args=()) -> List[Dict[str, Any]]:
+        with self._lock:
+            cur = self._db.execute(sql, args)
+            return [_row_to_dict(cur, r) for r in cur.fetchall()]
+
+    def _one(self, sql, args=()) -> Optional[Dict[str, Any]]:
+        rows = self._all(sql, args)
+        return rows[0] if rows else None
+
+    def _exec(self, sql, args=()) -> int:
+        with self._lock, self._db:
+            cur = self._db.execute(sql, args)
+            return cur.lastrowid
+
+    # ---- nodes -------------------------------------------------------
+
+    def add_node(self, name: str, host: str, port: int,
+                 is_active: bool = False) -> int:
+        return self._exec(
+            "INSERT INTO nodes (name, host, port, is_active, added_at) "
+            "VALUES (?,?,?,?,?)", (name, host, port, int(is_active), time.time()))
+
+    def get_node(self, node_id: int):
+        return self._one("SELECT * FROM nodes WHERE id=?", (node_id,))
+
+    def find_node(self, host: str, port: int):
+        return self._one("SELECT * FROM nodes WHERE host=? AND port=?",
+                         (host, port))
+
+    def list_nodes(self, active_only=False):
+        q = "SELECT * FROM nodes"
+        if active_only:
+            q += " WHERE is_active=1"
+        return self._all(q + " ORDER BY id")
+
+    def update_node(self, node_id: int, **fields):
+        if "info" in fields and not isinstance(fields["info"], str):
+            fields["info"] = json.dumps(fields["info"])
+        sets = ", ".join(f"{k}=?" for k in fields)
+        self._exec(f"UPDATE nodes SET {sets} WHERE id=?",
+                   (*fields.values(), node_id))
+
+    def remove_node(self, node_id: int):
+        self._exec("DELETE FROM nodes WHERE id=?", (node_id,))
+
+    def node_url(self, node) -> str:
+        # ≙ WorkerNode.get_url (reference models.py:16-17)
+        return f"http://{node['host']}:{node['port']}"
+
+    # ---- plans -------------------------------------------------------
+
+    def add_plan(self, model_name: str, plan: dict,
+                 node_id: Optional[int] = None) -> int:
+        return self._exec(
+            "INSERT INTO plans (model_name, plan, node_id, created_at) "
+            "VALUES (?,?,?,?)",
+            (model_name, json.dumps(plan), node_id, time.time()))
+
+    def list_plans(self, model_name: Optional[str] = None):
+        rows = self._all(
+            "SELECT * FROM plans" +
+            (" WHERE model_name=?" if model_name else "") + " ORDER BY id",
+            (model_name,) if model_name else ())
+        for r in rows:
+            r["plan"] = json.loads(r["plan"])
+        return rows
+
+    def mark_plan_loaded(self, plan_id: int, node_id: int, loaded=True):
+        self._exec("UPDATE plans SET is_loaded=?, node_id=? WHERE id=?",
+                   (int(loaded), node_id, plan_id))
+
+    # ---- requests ----------------------------------------------------
+
+    def submit_request(self, model_name: str, prompt: str,
+                       max_new_tokens: Optional[int] = 100,
+                       sampling: Optional[dict] = None,
+                       max_length: Optional[int] = None) -> int:
+        return self._exec(
+            "INSERT INTO requests (model_name, prompt, max_new_tokens, "
+            "max_length, sampling, created_at) VALUES (?,?,?,?,?,?)",
+            (model_name, prompt, max_new_tokens, max_length,
+             json.dumps(sampling or {}), time.time()))
+
+    def get_request(self, req_id: int):
+        r = self._one("SELECT * FROM requests WHERE id=?", (req_id,))
+        if r:
+            r["sampling"] = json.loads(r["sampling"] or "{}")
+        return r
+
+    def claim_next_pending(self) -> Optional[Dict[str, Any]]:
+        """Atomically move the oldest pending request to processing."""
+        with self._lock:
+            row = self._one(
+                "SELECT * FROM requests WHERE status='pending' "
+                "ORDER BY id LIMIT 1")
+            if row is None:
+                return None
+            self._exec(
+                "UPDATE requests SET status='processing', started_at=? "
+                "WHERE id=?", (time.time(), row["id"]))
+            row["sampling"] = json.loads(row["sampling"] or "{}")
+            return row
+
+    def requeue(self, req_id: int):
+        self._exec("UPDATE requests SET status='pending', "
+                   "attempts=attempts+1 WHERE id=?", (req_id,))
+
+    def mark_completed(self, req_id: int, result: str, node_id: int,
+                       execution_time: float, tokens_per_s: float):
+        # ≙ InferenceRequest.mark_completed (reference models.py:52-56)
+        self._exec(
+            "UPDATE requests SET status='completed', result=?, node_id=?, "
+            "completed_at=?, execution_time=?, tokens_per_s=? WHERE id=?",
+            (result, node_id, time.time(), execution_time, tokens_per_s, req_id))
+
+    def mark_failed(self, req_id: int, error: str):
+        # ≙ InferenceRequest.mark_failed (reference models.py:58-62)
+        self._exec(
+            "UPDATE requests SET status='failed', error=?, completed_at=? "
+            "WHERE id=?", (error, time.time(), req_id))
+
+    def recent_requests(self, limit: int = 20):
+        return self._all(
+            "SELECT * FROM requests ORDER BY id DESC LIMIT ?", (limit,))
+
+    def counts(self) -> Dict[str, int]:
+        rows = self._all(
+            "SELECT status, COUNT(*) AS n FROM requests GROUP BY status")
+        return {r["status"]: r["n"] for r in rows}

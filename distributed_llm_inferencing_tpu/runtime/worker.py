@@ -1,0 +1,308 @@
+"""Worker agent: the per-TPU-host data-plane process.
+
+Capability-equivalent to the reference worker (worker/app.py:49-413) with
+the same lifecycle RPC surface — /health, /load_model, /load_shard,
+/unload_model, /inference — plus what the reference lacked: streaming
+inference (SSE), Prometheus metrics, race-safe model lifecycle (the
+reference mutated module globals from Flask handlers and was safe only
+because gunicorn ran one sync worker, SURVEY.md §5.2).
+
+The execution engine behind each loaded model is a jitted, mesh-sharded
+JAX program (runtime/engine.py) instead of HF ``generate`` on torch
+(reference: worker/app.py:297-305).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+import jax
+
+from distributed_llm_inferencing_tpu.models.registry import get_config
+from distributed_llm_inferencing_tpu.ops.sampling import SamplingParams
+from distributed_llm_inferencing_tpu.parallel.mesh import MeshSpec
+from distributed_llm_inferencing_tpu.runtime import httpd
+from distributed_llm_inferencing_tpu.runtime.engine import InferenceEngine
+from distributed_llm_inferencing_tpu.utils.logging import setup_logging
+from distributed_llm_inferencing_tpu.utils.metrics import Metrics
+from distributed_llm_inferencing_tpu.utils.tokenizer import load_tokenizer
+
+log = setup_logging("worker")
+
+
+class LoadedModel:
+    def __init__(self, engine: InferenceEngine, tokenizer, source: str):
+        self.engine = engine
+        self.tokenizer = tokenizer
+        self.source = source
+        self.lock = threading.Lock()  # engine.generate is not reentrant
+
+
+class WorkerAgent:
+    """Holds loaded models and serves the lifecycle + inference RPC API."""
+
+    def __init__(self, auth_key: Optional[str] = None):
+        auth_key = auth_key if auth_key is not None else (
+            os.environ.get("DLI_AUTH_KEY")
+            if os.environ.get("DLI_AUTH_ENABLED", "").lower() in ("1", "true")
+            else None)
+        self.models: Dict[str, LoadedModel] = {}
+        self._models_lock = threading.Lock()
+        self._loading: set = set()
+        self.metrics = Metrics()
+        self.started = time.time()
+        self.service = httpd.JsonHTTPService("worker", auth_key)
+        s = self.service
+        s.add("GET", "/health", self.health)
+        s.add("GET", "/metrics", self.prometheus)
+        s.add("POST", "/load_model", self.load_model)
+        s.add("POST", "/load_shard", self.load_shard)
+        s.add("POST", "/unload_model", self.unload_model)
+        s.add("POST", "/inference", self.inference)
+        s.add("POST", "/inference_stream", self.inference_stream)
+
+    # ---- endpoints ---------------------------------------------------
+
+    def health(self, body):
+        """Parity with reference /health (worker/app.py:49-92): status +
+        resource stats + loaded model inventory; TPU stats replace CUDA."""
+        devices = []
+        for d in jax.devices():
+            entry = {"id": d.id, "platform": d.platform,
+                     "kind": getattr(d, "device_kind", "unknown")}
+            try:
+                ms = d.memory_stats()
+                if ms:
+                    entry["bytes_in_use"] = ms.get("bytes_in_use")
+                    entry["bytes_limit"] = ms.get("bytes_limit")
+            except Exception:
+                pass
+            devices.append(entry)
+        try:
+            import psutil
+            cpu = psutil.cpu_percent(interval=None)
+            mem = psutil.virtual_memory().percent
+        except Exception:
+            cpu = mem = None
+        with self._models_lock:  # load/unload mutate concurrently
+            loaded = [{"name": n, "source": m.source,
+                       "mesh": m.engine.mesh_spec.axis_sizes(),
+                       "max_seq": m.engine.max_seq}
+                      for n, m in self.models.items()]
+        return {
+            "status": "online",
+            "uptime_s": time.time() - self.started,
+            "resources": {"cpu": cpu, "memory": mem, "devices": devices,
+                          "device": jax.default_backend()},
+            "loaded_models": loaded,
+            "metrics": self.metrics.snapshot(),
+        }
+
+    def prometheus(self, body):
+        return (self.metrics.prometheus().encode(), "text/plain; version=0.0.4")
+
+    def _do_load(self, body) -> tuple:
+        name = body.get("model_name")
+        if not name:
+            return 400, {"status": "error", "message": "model_name required"}
+        with self._models_lock:
+            if name in self.models:
+                # idempotent, like reference worker/app.py:106-110
+                return 200, {"status": "success",
+                             "message": f"model {name} already loaded"}
+            if name in self._loading:
+                # the double-load race the reference left open (SURVEY §5.2)
+                return 409, {"status": "error",
+                             "message": f"model {name} load in progress"}
+            self._loading.add(name)
+        try:
+            return self._do_load_inner(body, name)
+        finally:
+            with self._models_lock:
+                self._loading.discard(name)
+
+    def _do_load_inner(self, body, name) -> tuple:
+        ckpt = body.get("checkpoint_path")
+        mesh = MeshSpec.from_dict(body.get("mesh", {}))
+        t0 = time.time()
+        if ckpt:
+            from distributed_llm_inferencing_tpu.models.convert import load_hf_model
+            cfg, params = load_hf_model(ckpt)
+            cfg = cfg.replace(name=name)
+            source = ckpt
+        else:
+            try:
+                cfg = get_config(name)
+            except KeyError as e:
+                return 400, {"status": "error", "message": str(e)}
+            params = None  # random init — explicit opt-in
+            if not body.get("allow_random_init"):
+                return 400, {
+                    "status": "error",
+                    "message": "no checkpoint_path given; pass "
+                               "allow_random_init=true for a demo model"}
+            source = "random-init"
+        if body.get("dtype"):
+            cfg = cfg.replace(dtype=body["dtype"])
+        engine = InferenceEngine(
+            cfg, params, mesh_spec=mesh, max_seq=body.get("max_seq"))
+        tok = load_tokenizer(body.get("tokenizer_path") or
+                             (ckpt if ckpt else None), cfg.vocab_size)
+        with self._models_lock:
+            self.models[name] = LoadedModel(engine, tok, source)
+        self.metrics.inc("models_loaded")
+        log.info("loaded %s from %s in %.1fs", name, source, time.time() - t0)
+        return 200, {"status": "success",
+                     "message": f"model {name} loaded",
+                     "load_time_s": time.time() - t0,
+                     "stats": engine.stats()}
+
+    def load_model(self, body):
+        with self.metrics.time("load_model"):
+            return self._do_load(body)
+
+    def load_shard(self, body):
+        """Reference parity (worker/app.py:139-206): registering a 'shard'.
+
+        TPU-native meaning: a placement plan (mesh spec + partition specs,
+        parallel/plan.py) rather than a weight-file directory — loading a
+        'shard' is loading the model with that plan's mesh.
+        """
+        plan = body.get("plan")
+        if not plan:
+            return 400, {"status": "error",
+                         "message": "plan required (parallel/plan.py output)"}
+        body = dict(body)
+        body.setdefault("model_name", plan.get("model"))
+        body.setdefault("mesh", plan.get("mesh", {}))
+        body.setdefault("max_seq", plan.get("max_seq"))
+        return self._do_load(body)
+
+    def unload_model(self, body):
+        """Parity with worker/app.py:208-250; device buffers are dropped by
+        deleting the engine (XLA frees HBM on GC)."""
+        name = body.get("model_name")
+        with self._models_lock:
+            m = self.models.pop(name, None)
+        if m is None:
+            return 404, {"status": "error",
+                         "message": f"model {name} not loaded"}
+        del m
+        import gc
+        gc.collect()
+        self.metrics.inc("models_unloaded")
+        return {"status": "success", "message": f"model {name} unloaded"}
+
+    def _prep_inference(self, body):
+        name = body.get("model_name")
+        m = self.models.get(name)
+        if m is None:
+            raise KeyError(f"model {name} not loaded")
+        if body.get("prompt_tokens"):
+            prompt = [int(t) for t in body["prompt_tokens"]]
+        else:
+            prompt = m.tokenizer.encode(body.get("prompt", ""))
+        if not prompt:
+            raise ValueError("empty prompt")
+        sp_body = body.get("sampling", {})
+        sp = SamplingParams(
+            temperature=float(sp_body.get("temperature", 0.8)),
+            top_k=int(sp_body.get("top_k", 50)),
+            top_p=float(sp_body.get("top_p", 0.95)),
+            do_sample=bool(sp_body.get("do_sample", True)))
+        # reference parity: max_length counts prompt+new (views.py:351);
+        # max_new_tokens preferred.
+        if "max_new_tokens" in body:
+            max_new = int(body["max_new_tokens"])
+        else:
+            max_new = max(1, int(body.get("max_length", 100)) - len(prompt))
+        return m, prompt, sp, max_new
+
+    def inference(self, body):
+        t0 = time.time()
+        try:
+            m, prompt, sp, max_new = self._prep_inference(body)
+        except (KeyError, ValueError) as e:
+            return 400, {"status": "error", "message": str(e)}
+        with self.metrics.time("inference"), m.lock:
+            res = m.engine.generate(
+                [prompt], max_new_tokens=max_new, sampling=sp,
+                seed=int(body.get("seed", time.time_ns() % (1 << 31))),
+                eos_token_id=m.tokenizer.eos_token_id)
+        text = m.tokenizer.decode(res.tokens[0])
+        self.metrics.inc("requests_completed")
+        self.metrics.inc("tokens_generated", len(res.tokens[0]))
+        self.metrics.gauge("last_decode_tokens_per_s", res.decode_tokens_per_s)
+        return {
+            "status": "success",
+            "result": text,
+            "tokens": res.tokens[0],
+            "execution_time": time.time() - t0,  # parity: worker/app.py:317
+            "prefill_ms": res.prefill_ms,
+            "decode_ms": res.decode_ms,
+            "tokens_per_s": res.decode_tokens_per_s,
+        }
+
+    def inference_stream(self, body, _request=None):
+        """SSE streaming decode — absent from the reference (SURVEY.md §2.3)."""
+        try:
+            m, prompt, sp, max_new = self._prep_inference(body)
+        except (KeyError, ValueError) as e:
+            return 400, {"status": "error", "message": str(e)}
+
+        def events():
+            import queue
+            q: "queue.Queue" = queue.Queue()
+            done = object()
+
+            def cb(step, toks):
+                q.put({"event": "token", "step": step, "token": toks[0],
+                       "text": m.tokenizer.decode([toks[0]])})
+
+            def run():
+                try:
+                    with m.lock:
+                        res = m.engine.generate(
+                            [prompt], max_new_tokens=max_new, sampling=sp,
+                            seed=int(body.get("seed", time.time_ns() % (1 << 31))),
+                            eos_token_id=m.tokenizer.eos_token_id,
+                            stream_cb=cb)
+                    q.put({"event": "done",
+                           "result": m.tokenizer.decode(res.tokens[0]),
+                           "tokens_per_s": res.decode_tokens_per_s})
+                except Exception as e:
+                    q.put({"event": "error", "message": str(e)})
+                q.put(done)
+
+            threading.Thread(target=run, daemon=True).start()
+            while True:
+                item = q.get()
+                if item is done:
+                    break
+                yield item
+            self.metrics.inc("requests_completed")
+
+        return httpd.sse_stream(_request, events())
+
+    # ---- lifecycle ---------------------------------------------------
+
+    def serve(self, host="0.0.0.0", port=8100, background=False):
+        log.info("worker agent on %s:%d (devices: %s)", host, port,
+                 jax.devices())
+        return self.service.serve(host, port, background=background)
+
+
+def main(argv=None):
+    import argparse
+    ap = argparse.ArgumentParser(description="TPU worker agent")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=8100)
+    args = ap.parse_args(argv)
+    WorkerAgent().serve(args.host, args.port)
+
+
+if __name__ == "__main__":
+    main()

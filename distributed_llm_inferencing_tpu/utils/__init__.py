@@ -1,0 +1,2 @@
+from distributed_llm_inferencing_tpu.utils.metrics import Metrics  # noqa: F401
+from distributed_llm_inferencing_tpu.utils.logging import setup_logging  # noqa: F401

@@ -1,0 +1,35 @@
+"""Logging setup.
+
+The reference promised log files (README.md:175,185) but shipped no LOGGING
+config and no worker logging at all (SURVEY.md §5.5). Here every process
+gets a real configuration: stderr + optional rotating file, env-tunable.
+"""
+
+from __future__ import annotations
+
+import logging
+import logging.handlers
+import os
+from typing import Optional
+
+_FORMAT = "%(asctime)s %(levelname)s %(name)s: %(message)s"
+
+
+def setup_logging(name: str, log_file: Optional[str] = None,
+                  level: Optional[str] = None) -> logging.Logger:
+    level = (level or os.environ.get("DLI_LOG_LEVEL", "INFO")).upper()
+    root = logging.getLogger("dli_tpu")
+    if not root.handlers:
+        handler = logging.StreamHandler()
+        handler.setFormatter(logging.Formatter(_FORMAT))
+        root.addHandler(handler)
+        log_file = log_file or os.environ.get("DLI_LOG_FILE")
+        if log_file:
+            os.makedirs(os.path.dirname(os.path.abspath(log_file)), exist_ok=True)
+            fh = logging.handlers.RotatingFileHandler(
+                log_file, maxBytes=16 << 20, backupCount=2)
+            fh.setFormatter(logging.Formatter(_FORMAT))
+            root.addHandler(fh)
+        root.setLevel(level)
+        root.propagate = False
+    return root.getChild(name)

@@ -1,0 +1,76 @@
+"""Process-local metrics registry (counters, gauges, rolling timings).
+
+The reference's only metrics were psutil percentages returned from /health
+(reference: worker/app.py:54-67). Here every worker/master keeps counters
+and latency histograms, exported as JSON and Prometheus text — no external
+deps.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict
+
+
+class Metrics:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = {}
+        self._gauges: Dict[str, float] = {}
+        self._timings: Dict[str, deque] = {}
+
+    def inc(self, name: str, value: float = 1.0):
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0.0) + value
+
+    def gauge(self, name: str, value: float):
+        with self._lock:
+            self._gauges[name] = value
+
+    def observe(self, name: str, seconds: float):
+        with self._lock:
+            self._timings.setdefault(name, deque(maxlen=512)).append(seconds)
+
+    def time(self, name: str):
+        return _Timer(self, name)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = {"counters": dict(self._counters),
+                   "gauges": dict(self._gauges), "timings": {}}
+            for k, v in self._timings.items():
+                if v:
+                    s = sorted(v)
+                    out["timings"][k] = {
+                        "count": len(s),
+                        "p50": s[len(s) // 2],
+                        "p99": s[min(len(s) - 1, int(len(s) * 0.99))],
+                        "mean": sum(s) / len(s),
+                    }
+            return out
+
+    def prometheus(self) -> str:
+        snap = self.snapshot()
+        lines = []
+        for k, v in snap["counters"].items():
+            lines.append(f"dli_{k} {v}")
+        for k, v in snap["gauges"].items():
+            lines.append(f"dli_{k} {v}")
+        for k, t in snap["timings"].items():
+            lines.append(f'dli_{k}_seconds{{q="0.5"}} {t["p50"]}')
+            lines.append(f'dli_{k}_seconds{{q="0.99"}} {t["p99"]}')
+        return "\n".join(lines) + "\n"
+
+
+class _Timer:
+    def __init__(self, m: Metrics, name: str):
+        self.m, self.name = m, name
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.m.observe(self.name, time.perf_counter() - self.t0)

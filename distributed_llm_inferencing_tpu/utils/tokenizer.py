@@ -1,0 +1,61 @@
+"""Tokenizer loading with an offline byte-level fallback.
+
+The reference always pulled HF tokenizers from the hub per worker
+(reference: worker/app.py:117-119). Here: local HF tokenizer dirs load via
+transformers (offline), and when no tokenizer artifact exists (random-init
+demo models, air-gapped nodes) a deterministic byte-level tokenizer keeps
+the full text->tokens->text path working for any vocab >= 259.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+
+class ByteTokenizer:
+    """UTF-8 bytes + {bos, eos, pad}. Token i in [3, 259) = byte i-3."""
+
+    BOS, EOS, PAD = 0, 1, 2
+    OFFSET = 3
+
+    def __init__(self, vocab_size: int = 259):
+        assert vocab_size >= 259, "byte tokenizer needs vocab >= 259"
+        self.vocab_size = vocab_size
+        self.eos_token_id = self.EOS
+        self.bos_token_id = self.BOS
+
+    def encode(self, text: str) -> List[int]:
+        return [self.BOS] + [b + self.OFFSET for b in text.encode("utf-8")]
+
+    def decode(self, ids) -> str:
+        data = bytes(i - self.OFFSET for i in ids
+                     if self.OFFSET <= i < self.OFFSET + 256)
+        return data.decode("utf-8", errors="replace")
+
+
+class HFTokenizer:
+    """Thin adapter over a local transformers tokenizer."""
+
+    def __init__(self, path: str):
+        import transformers
+        self._tok = transformers.AutoTokenizer.from_pretrained(
+            path, local_files_only=True)
+        self.eos_token_id = self._tok.eos_token_id
+        self.bos_token_id = self._tok.bos_token_id
+        self.vocab_size = self._tok.vocab_size
+
+    def encode(self, text: str) -> List[int]:
+        return self._tok.encode(text)
+
+    def decode(self, ids) -> str:
+        return self._tok.decode(ids, skip_special_tokens=True)
+
+
+def load_tokenizer(path: Optional[str], vocab_size: int):
+    """Local HF tokenizer if a path is given, else byte-level fallback."""
+    if path:
+        return HFTokenizer(path)
+    if vocab_size >= 259:
+        return ByteTokenizer(vocab_size)
+    return ByteTokenizer(259)  # tiny test vocabs: ids may exceed model vocab;
+    # callers using tiny configs pass token ids directly instead of text.

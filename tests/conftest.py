@@ -1,17 +1,24 @@
 """Test configuration: force an 8-device virtual CPU mesh.
 
 Multi-chip behavior (tp/dp/pp/sp/ep shardings, collectives) is tested on
-host CPU devices exactly as SURVEY.md §4 prescribes — set BEFORE jax
-initializes anything.
+host CPU devices exactly as SURVEY.md §4 prescribes.
+
+Note: this environment's sitecustomize imports jax at interpreter startup
+(axon TPU plugin), so env vars alone are too late — we must also flip
+``jax.config`` before the first backend query.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,132 @@
+"""InferenceEngine behavior tests (CPU mesh)."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from distributed_llm_inferencing_tpu.models import convert
+from distributed_llm_inferencing_tpu.models.registry import get_config
+from distributed_llm_inferencing_tpu.ops.sampling import SamplingParams
+from distributed_llm_inferencing_tpu.parallel.mesh import MeshSpec
+from distributed_llm_inferencing_tpu.runtime.engine import InferenceEngine
+
+
+def _tiny_hf_engine(mesh_spec=None):
+    import torch, transformers
+    torch.manual_seed(0)
+    hf = transformers.GPT2LMHeadModel(transformers.GPT2Config(
+        vocab_size=96, n_positions=64, n_embd=32, n_layer=2, n_head=4)).eval()
+    cfg, params = convert.load_hf_model(hf, dtype=jnp.float32)
+    cfg = cfg.replace(dtype="float32", name="tiny-hf-gpt2")
+    eng = InferenceEngine(cfg, params, mesh_spec=mesh_spec, max_seq=64)
+    return hf, eng
+
+
+def test_greedy_matches_hf_generate():
+    import torch
+    hf, eng = _tiny_hf_engine()
+    prompt = [3, 17, 52, 9]
+    res = eng.generate([prompt], max_new_tokens=10,
+                       sampling=SamplingParams.greedy())
+    with torch.no_grad():
+        ref = hf.generate(torch.tensor([prompt]), max_new_tokens=10,
+                          do_sample=False)
+    assert prompt + res.tokens[0] == ref[0].tolist()
+    assert res.steps == 10
+    assert res.prefill_ms > 0 and res.decode_ms > 0
+
+
+def test_ragged_batch_greedy_matches_single():
+    _, eng = _tiny_hf_engine()
+    a, b = [5, 6, 7, 8, 9, 10], [11, 12]
+    batched = eng.generate([a, b], max_new_tokens=6,
+                           sampling=SamplingParams.greedy())
+    sole_a = eng.generate([a], max_new_tokens=6, sampling=SamplingParams.greedy())
+    sole_b = eng.generate([b], max_new_tokens=6, sampling=SamplingParams.greedy())
+    assert batched.tokens[0] == sole_a.tokens[0]
+    assert batched.tokens[1] == sole_b.tokens[0]
+
+
+def test_streaming_callback_sees_every_token():
+    _, eng = _tiny_hf_engine()
+    seen = []
+    res = eng.generate([[1, 2, 3]], max_new_tokens=5,
+                       sampling=SamplingParams.greedy(),
+                       stream_cb=lambda step, toks: seen.append((step, toks[0])))
+    assert [t for _, t in seen] == res.tokens[0]
+    assert [s for s, _ in seen] == list(range(5))
+
+
+def test_eos_stops_decode():
+    _, eng = _tiny_hf_engine()
+    # find which token greedy emits first, use it as "eos"
+    probe = eng.generate([[1, 2, 3]], max_new_tokens=3,
+                         sampling=SamplingParams.greedy())
+    eos = probe.tokens[0][1]
+    res = eng.generate([[1, 2, 3]], max_new_tokens=20,
+                       sampling=SamplingParams.greedy(), eos_token_id=eos)
+    assert res.steps < 20
+    assert eos not in res.tokens[0]
+
+
+def test_context_window_guard():
+    _, eng = _tiny_hf_engine()
+    with pytest.raises(ValueError, match="exceeds engine max_seq"):
+        eng.generate([[1] * 30], max_new_tokens=40)
+    with pytest.raises(ValueError, match="empty prompt"):
+        eng.generate([[]], max_new_tokens=4)
+
+
+def test_sampling_reproducible_by_seed():
+    _, eng = _tiny_hf_engine()
+    sp = SamplingParams(temperature=0.8, top_k=50, top_p=0.95)
+    r1 = eng.generate([[4, 5, 6]], max_new_tokens=8, sampling=sp, seed=123)
+    r2 = eng.generate([[4, 5, 6]], max_new_tokens=8, sampling=sp, seed=123)
+    r3 = eng.generate([[4, 5, 6]], max_new_tokens=8, sampling=sp, seed=124)
+    assert r1.tokens == r2.tokens
+    assert r1.tokens != r3.tokens or True  # different seed may coincide on tiny vocab
+
+
+def test_engine_on_tp_dp_mesh_matches_single_device():
+    _, ref_eng = _tiny_hf_engine()
+    _, mesh_eng = _tiny_hf_engine(mesh_spec=MeshSpec(dp=2, tp=2))
+    prompts = [[3, 1, 4, 1, 5], [9, 2, 6]]
+    ref = ref_eng.generate(prompts, max_new_tokens=6, sampling=SamplingParams.greedy())
+    got = mesh_eng.generate(prompts, max_new_tokens=6, sampling=SamplingParams.greedy())
+    assert ref.tokens == got.tokens
+
+
+def test_dp_mesh_pads_odd_batch():
+    """dp=2 with a single prompt must work (batch padded internally)."""
+    _, eng = _tiny_hf_engine(mesh_spec=MeshSpec(dp=2))
+    _, ref = _tiny_hf_engine()
+    got = eng.generate([[7, 8, 9]], max_new_tokens=4,
+                       sampling=SamplingParams.greedy())
+    want = ref.generate([[7, 8, 9]], max_new_tokens=4,
+                        sampling=SamplingParams.greedy())
+    assert got.tokens == want.tokens
+    assert len(got.tokens) == 1
+
+
+def test_bucket_capped_at_max_seq():
+    """Non-bucket max_seq: prefill bucket must not exceed cache capacity."""
+    import torch, transformers
+    torch.manual_seed(0)
+    hf = transformers.GPT2LMHeadModel(transformers.GPT2Config(
+        vocab_size=96, n_positions=100, n_embd=16, n_layer=2, n_head=2)).eval()
+    from distributed_llm_inferencing_tpu.models import convert as cv
+    cfg, params = cv.load_hf_model(hf, dtype=jnp.float32)
+    cfg = cfg.replace(dtype="float32")
+    eng = InferenceEngine(cfg, params, max_seq=100)
+    res = eng.generate([[1] * 70], max_new_tokens=10,
+                       sampling=SamplingParams.greedy())
+    assert len(res.tokens[0]) == 10
+
+
+def test_engine_stats():
+    _, eng = _tiny_hf_engine()
+    eng.generate([[1, 2]], max_new_tokens=2, sampling=SamplingParams.greedy())
+    s = eng.stats()
+    assert s["model"] == "tiny-hf-gpt2"
+    assert s["compiled_prefill_buckets"] == [16]
+    assert s["params"] > 0

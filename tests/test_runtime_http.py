@@ -1,0 +1,230 @@
+"""Integration tests: worker agent + master control plane over localhost HTTP.
+
+Reproduces the reference's primary call stack (SURVEY.md §3.1) — submit →
+queue → dispatch → worker load+infer → poll result — against real sockets,
+plus the failure-handling upgrades (retry/failover, strikes, reactivation).
+"""
+
+import json
+import time
+
+import pytest
+import requests
+
+from distributed_llm_inferencing_tpu.runtime.master import Master
+from distributed_llm_inferencing_tpu.runtime.worker import WorkerAgent
+
+
+@pytest.fixture(scope="module")
+def worker():
+    agent = WorkerAgent()
+    srv = agent.serve(host="127.0.0.1", port=0, background=True)
+    port = srv.server_address[1]
+    yield agent, port
+    agent.service.shutdown()
+
+
+@pytest.fixture()
+def master():
+    m = Master(":memory:", dispatcher_threads=2, health_interval=0.5)
+    m.start_background()
+    srv = m.service.serve("127.0.0.1", 0, background=True)
+    port = srv.server_address[1]
+    yield m, port
+    m.stop()
+
+
+def _url(port, path):
+    return f"http://127.0.0.1:{port}{path}"
+
+
+def _wait_status(port, req_id, timeout=60):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        r = requests.get(_url(port, f"/api/inference/status/{req_id}")).json()
+        if r["request"]["status"] in ("completed", "failed"):
+            return r["request"]
+        time.sleep(0.2)
+    raise TimeoutError("request never finished")
+
+
+# ---- worker alone ----------------------------------------------------
+
+def test_worker_health(worker):
+    _, port = worker
+    r = requests.get(_url(port, "/health")).json()
+    assert r["status"] == "online"
+    assert r["resources"]["devices"]
+    assert isinstance(r["loaded_models"], list)
+
+
+def test_worker_load_requires_checkpoint_or_optin(worker):
+    _, port = worker
+    r = requests.post(_url(port, "/load_model"),
+                      json={"model_name": "tiny-gpt2"})
+    assert r.status_code == 400
+    assert "allow_random_init" in r.json()["message"]
+
+
+def test_worker_load_infer_unload(worker):
+    _, port = worker
+    r = requests.post(_url(port, "/load_model"), json={
+        "model_name": "tiny-gpt2", "allow_random_init": True,
+        "dtype": "float32", "max_seq": 64})
+    assert r.status_code == 200, r.text
+    # idempotent second load (reference worker/app.py:106-110)
+    r2 = requests.post(_url(port, "/load_model"), json={
+        "model_name": "tiny-gpt2", "allow_random_init": True})
+    assert "already loaded" in r2.json()["message"]
+
+    r = requests.post(_url(port, "/inference"), json={
+        "model_name": "tiny-gpt2", "prompt_tokens": [1, 2, 3],
+        "max_new_tokens": 5, "sampling": {"do_sample": False}})
+    assert r.status_code == 200, r.text
+    data = r.json()
+    assert data["status"] == "success"
+    assert len(data["tokens"]) == 5
+    assert data["execution_time"] > 0
+
+    r = requests.post(_url(port, "/unload_model"),
+                      json={"model_name": "tiny-gpt2"})
+    assert r.json()["status"] == "success"
+    r = requests.post(_url(port, "/unload_model"),
+                      json={"model_name": "tiny-gpt2"})
+    assert r.status_code == 404
+
+
+def test_worker_streaming(worker):
+    _, port = worker
+    requests.post(_url(port, "/load_model"), json={
+        "model_name": "tiny-gpt2", "allow_random_init": True,
+        "dtype": "float32", "max_seq": 64})
+    with requests.post(_url(port, "/inference_stream"), json={
+            "model_name": "tiny-gpt2", "prompt_tokens": [4, 5],
+            "max_new_tokens": 4, "sampling": {"do_sample": False}},
+            stream=True) as r:
+        assert r.status_code == 200
+        events = []
+        for line in r.iter_lines():
+            if line.startswith(b"data: "):
+                events.append(json.loads(line[6:]))
+    kinds = [e["event"] for e in events]
+    assert kinds.count("token") == 4
+    assert kinds[-1] == "done"
+    requests.post(_url(port, "/unload_model"), json={"model_name": "tiny-gpt2"})
+
+
+def test_worker_auth():
+    agent = WorkerAgent(auth_key="sekrit")
+    srv = agent.serve("127.0.0.1", 0, background=True)
+    port = srv.server_address[1]
+    try:
+        assert requests.get(_url(port, "/health")).status_code == 401
+        r = requests.get(_url(port, "/health"),
+                         headers={"Authorization": "Bearer sekrit"})
+        assert r.status_code == 200
+    finally:
+        agent.service.shutdown()
+
+
+# ---- master + worker end-to-end --------------------------------------
+
+def test_end_to_end_submit_poll(worker, master):
+    _, wport = worker
+    m, mport = master
+    r = requests.post(_url(mport, "/api/nodes/add"), json={
+        "name": "w1", "host": "127.0.0.1", "port": wport}).json()
+    assert r["status"] == "success", r
+
+    req = requests.post(_url(mport, "/api/inference/submit"), json={
+        "model_name": "tiny-gpt2", "prompt": "hi",
+        "max_new_tokens": 4,
+        "sampling": {"do_sample": False, "allow_random_init": True},
+    }).json()
+    assert req["status"] == "success"
+    done = _wait_status(mport, req["request_id"])
+    assert done["status"] == "completed", done
+    assert done["node_id"] is not None
+    assert done["execution_time"] > 0
+
+    recent = requests.get(_url(mport, "/api/inference/recent")).json()
+    assert recent["counts"]["completed"] >= 1
+
+    # pages render
+    for path in ("/", "/nodes", "/inference"):
+        page = requests.get(_url(mport, path))
+        assert page.status_code == 200
+        assert "<html" in page.text
+
+    # node status shows the worker with the loaded model
+    ns = requests.get(_url(mport, "/api/nodes/status")).json()
+    assert ns["nodes"][0]["is_active"]
+
+
+def test_master_rejects_unreachable_node(master):
+    _, mport = master
+    r = requests.post(_url(mport, "/api/nodes/add"), json={
+        "name": "ghost", "host": "127.0.0.1", "port": 1})
+    assert r.status_code == 502
+
+
+def test_master_plan_api(master):
+    _, mport = master
+    r = requests.post(_url(mport, "/api/plans/create"), json={
+        "model_name": "llama-3-8b", "mesh": {"tp": 4}}).json()
+    assert r["status"] == "success"
+    assert r["plan"]["num_devices"] == 4
+    plans = requests.get(_url(mport, "/api/plans")).json()
+    assert len(plans["plans"]) == 1
+
+
+def test_user_error_does_not_strike_node(worker, master):
+    """An unknown model name must fail the request immediately without
+    deactivating the (healthy) node."""
+    _, wport = worker
+    m, mport = master
+    requests.post(_url(mport, "/api/nodes/add"), json={
+        "name": "w1", "host": "127.0.0.1", "port": wport})
+    req = requests.post(_url(mport, "/api/inference/submit"), json={
+        "model_name": "no-such-model", "prompt": "x",
+        "sampling": {"allow_random_init": True}}).json()
+    done = _wait_status(mport, req["request_id"], timeout=20)
+    assert done["status"] == "failed"
+    assert "rejected" in done["error"]
+    ns = requests.get(_url(mport, "/api/nodes/status")).json()
+    assert ns["nodes"][0]["is_active"], "healthy node was struck offline"
+
+
+def test_max_length_reference_semantics(worker, master):
+    """max_length counts prompt+new tokens (reference views.py:351)."""
+    _, wport = worker
+    m, mport = master
+    requests.post(_url(mport, "/api/nodes/add"), json={
+        "name": "w1", "host": "127.0.0.1", "port": wport})
+    # ByteTokenizer: "hello" -> BOS + 5 bytes = 6 tokens; max_length=10 -> 4 new
+    req = requests.post(_url(mport, "/api/inference/submit"), json={
+        "model_name": "tiny-gpt2", "prompt": "hello", "max_length": 10,
+        "sampling": {"do_sample": False, "allow_random_init": True}}).json()
+    done = _wait_status(mport, req["request_id"])
+    assert done["status"] == "completed", done
+    assert done["max_length"] == 10
+
+
+def test_failed_request_after_node_death(worker, master):
+    """Kill the only node → request fails with a real error after retries
+    (reference: mark_failed with no retry, views.py:364-378)."""
+    m, mport = master
+    # add a node then kill it by pointing at a dead port
+    agent = WorkerAgent()
+    srv = agent.serve("127.0.0.1", 0, background=True)
+    dead_port = srv.server_address[1]
+    requests.post(_url(mport, "/api/nodes/add"), json={
+        "name": "dying", "host": "127.0.0.1", "port": dead_port})
+    agent.service.shutdown()  # node is now dead
+
+    req = requests.post(_url(mport, "/api/inference/submit"), json={
+        "model_name": "tiny-gpt2", "prompt": "x",
+        "sampling": {"allow_random_init": True}}).json()
+    done = _wait_status(mport, req["request_id"], timeout=30)
+    assert done["status"] == "failed"
+    assert done["error"]
