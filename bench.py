@@ -50,7 +50,8 @@ def bench_ours():
     rng = np.random.default_rng(0)
     prompt = rng.integers(0, cfg.vocab_size, PROMPT_LEN).tolist()
     sp = SamplingParams(temperature=0.8, top_k=50, top_p=0.95)
-    eng.generate([prompt], max_new_tokens=8, sampling=sp)  # warmup/compile
+    # warmup/compile (same chunk programs as the timed run)
+    eng.generate([prompt], max_new_tokens=NEW_TOKENS, sampling=sp)
     res = eng.generate([prompt], max_new_tokens=NEW_TOKENS, sampling=sp)
     total_ms = res.prefill_ms + res.decode_ms
     n = len(res.tokens[0])

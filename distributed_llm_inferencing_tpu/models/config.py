@@ -49,6 +49,10 @@ class ModelConfig:
     # Numerics
     dtype: str = "bfloat16"  # activation/weight dtype on device
 
+    # Attention kernel backend: auto | xla | pallas | pallas_interpret
+    # (trace-time static; see ops/attention.py resolve_backend)
+    attn_backend: str = "auto"
+
     def __post_init__(self):
         assert self.num_heads % self.num_kv_heads == 0, (
             f"num_heads={self.num_heads} must be divisible by "

@@ -41,7 +41,8 @@ import jax
 import jax.numpy as jnp
 
 from distributed_llm_inferencing_tpu.models.config import ModelConfig
-from distributed_llm_inferencing_tpu.ops.attention import attend
+from distributed_llm_inferencing_tpu.ops.attention import (
+    attend_decode, attend_prefill, resolve_backend)
 from distributed_llm_inferencing_tpu.ops.kvcache import KVCache, write_block
 from distributed_llm_inferencing_tpu.ops.norms import norm
 from distributed_llm_inferencing_tpu.ops.rope import apply_rope
@@ -99,12 +100,16 @@ def _moe(x, lp, cfg: ModelConfig):
 
 
 def _block(x, lp, cache_k, cache_v, *, cfg: ModelConfig, q_positions,
-           kv_positions, kv_valid, write_starts):
+           write_starts, new_lengths, is_prefill, backend):
     """One transformer block with cache read/update.
 
     x: [B,s,D]; cache_k/v: [B,S,Hkv,hd] (this layer's slice);
     write_starts: [B] int32 slot where this token block begins, per sequence.
     Returns (x_out, new_cache_k, new_cache_v).
+
+    Two attention regimes (ops/attention.py): prefill attends the fresh
+    K/V block directly — O(s^2) instead of O(s * max_seq) over the mostly
+    empty cache — while decode attends the cache.
     """
     B, s, D = x.shape
     h = norm(x, lp["attn_norm"], cfg.norm_type, cfg.norm_eps)
@@ -119,8 +124,13 @@ def _block(x, lp, cache_k, cache_v, *, cfg: ModelConfig, q_positions,
     cache_k = write_block(cache_k, k, write_starts)
     cache_v = write_block(cache_v, v, write_starts)
 
-    attn = attend(q, cache_k, cache_v, q_positions, kv_positions, kv_valid,
-                  sliding_window=cfg.sliding_window)
+    if is_prefill:
+        attn = attend_prefill(q, k, v, sliding_window=cfg.sliding_window,
+                              backend=backend)
+    else:
+        attn = attend_decode(q, cache_k, cache_v, new_lengths,
+                             sliding_window=cfg.sliding_window,
+                             backend=backend)
     attn = _linear(attn.reshape(B, s, cfg.num_heads * cfg.head_dim), lp["o"])
     x = x + attn
 
@@ -137,6 +147,7 @@ def forward(
     write_starts,                # [B] int32 — first cache slot this block occupies
     q_positions,                 # [B, s] int32 — absolute positions of `tokens`
     new_lengths,                 # [B] int32 — cache lengths after this block
+    is_prefill: bool = False,    # static: fresh-KV attention regime
 ) -> Tuple[jax.Array, KVCache]:
     """Run the model over a block of tokens, updating the cache.
 
@@ -145,8 +156,8 @@ def forward(
     (logits [B,s,V] float32, updated cache).
 
     Invariant: cache slot index == absolute token position (the engine always
-    writes blocks contiguously per sequence), so kv_positions is just the
-    slot index and validity is slot < length.
+    writes blocks contiguously per sequence), so kv positions are the slot
+    index and validity is slot < length.
     """
     B, s = tokens.shape
     x = jnp.take(params["embed"]["tokens"], tokens, axis=0)
@@ -160,16 +171,18 @@ def forward(
                        axis=0)
         x = x + pos.astype(x.dtype)
 
-    S = cache.max_seq
-    kv_positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
-    kv_valid = kv_positions < new_lengths[:, None]
+    # Conservative device count for 'auto': the engine pins a concrete
+    # backend for its own programs; direct callers (tests, dryrun) get
+    # pallas only when the whole process sees a single device, since the
+    # pallas kernels are single-program (no GSPMD partitioning rule).
+    backend = resolve_backend(cfg.attn_backend, jax.device_count())
 
     def body(x, layer_in):
         lp, ck, cv = layer_in
         x, ck, cv = _block(
             x, lp, ck, cv, cfg=cfg, q_positions=q_positions,
-            kv_positions=kv_positions, kv_valid=kv_valid,
-            write_starts=write_starts)
+            write_starts=write_starts, new_lengths=new_lengths,
+            is_prefill=is_prefill, backend=backend)
         return x, (ck, cv)
 
     x, (new_k, new_v) = jax.lax.scan(
@@ -197,7 +210,7 @@ def prefill(params, cfg: ModelConfig, tokens, lengths, cache: KVCache):
     q_pos = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (B, s))
     return forward(params, cfg, tokens, cache,
                    write_starts=jnp.zeros((B,), jnp.int32),
-                   q_positions=q_pos, new_lengths=lengths)
+                   q_positions=q_pos, new_lengths=lengths, is_prefill=True)
 
 
 def decode_step(params, cfg: ModelConfig, tokens, cache: KVCache):

@@ -1,18 +1,29 @@
 """Attention: causal/cached multi-head attention with GQA and sliding window.
 
 The reference's attention lives inside vendored HF/torch kernels
-(reference: worker/app.py:297-305 just calls model.generate()). Here it is
-an explicit XLA program: einsum QK^T on the MXU, f32 softmax, einsum PV —
-written so XLA fuses mask+softmax into the matmuls. A Pallas
-flash-attention kernel (ops/pallas/flash_attention.py) covers the long-
-sequence regime; this module is the reference implementation and the
-fallback on non-TPU backends.
+(reference: worker/app.py:297-305 just calls model.generate()). Here there
+are two backends behind one dispatch:
+
+- **xla** (this module): einsum QK^T on the MXU, f32 softmax, einsum PV —
+  written so XLA fuses mask+softmax into the matmuls. Reference
+  implementation and the fallback on non-TPU hosts / multi-device meshes.
+- **pallas** (ops/pallas/flash_attention.py): hand-tiled online-softmax
+  kernels for the two hot regimes (prefill flash attention, cached flash
+  decode).
+
+Backend choice is a trace-time static: ``resolve_backend(cfg.attn_backend)``
+— "auto" picks pallas on a single-device TPU backend, xla otherwise
+(multi-device programs go through GSPMD, which partitions the einsum
+formulation; the pallas kernels enter the sharded path via shard_map in
+parallel/ring.py).
 """
 
 from __future__ import annotations
 
+import os
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 
 NEG_INF = -1e30  # large-but-finite: keeps softmax well-defined on all-masked rows
@@ -63,3 +74,59 @@ def attend(
     probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
     return out.astype(q.dtype)
+
+
+# ----------------------------------------------------------------------
+# Backend dispatch (trace-time static)
+# ----------------------------------------------------------------------
+
+def resolve_backend(requested: str = "auto", n_devices: int = 1) -> str:
+    """'auto' | 'xla' | 'pallas' | 'pallas_interpret' -> concrete backend.
+
+    ``DLI_ATTENTION`` overrides (test/debug escape hatch). Pallas kernels
+    are single-program kernels, so auto only picks them when the enclosing
+    jit program spans one device.
+    """
+    requested = os.environ.get("DLI_ATTENTION", requested)
+    if requested in ("xla", "pallas", "pallas_interpret"):
+        return requested
+    if jax.default_backend() == "tpu" and n_devices == 1:
+        return "pallas"
+    return "xla"
+
+
+def attend_prefill(q, k, v, *, sliding_window: Optional[int] = None,
+                   backend: str = "xla"):
+    """Causal self-attention over the fresh (uncached) K/V block.
+
+    Prefill never needs the cache or a validity mask: causality restricts
+    every real query row to real slots at or before it, and rows past a
+    sequence's length are garbage the engine never reads.
+    """
+    if backend.startswith("pallas"):
+        from distributed_llm_inferencing_tpu.ops.pallas import flash_attention
+        return flash_attention(
+            q, k, v, sliding_window=sliding_window,
+            interpret=(backend == "pallas_interpret"))
+    B, S, _, _ = q.shape
+    pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    return attend(q, k, v, pos, pos, jnp.ones((B, S), bool),
+                  sliding_window=sliding_window)
+
+
+def attend_decode(q, cache_k, cache_v, lengths, *,
+                  sliding_window: Optional[int] = None,
+                  backend: str = "xla"):
+    """Single-token cached attention. ``lengths`` counts filled slots
+    including the token just written; the query is at ``lengths - 1``."""
+    if backend.startswith("pallas"):
+        from distributed_llm_inferencing_tpu.ops.pallas import flash_decode
+        return flash_decode(
+            q, cache_k, cache_v, lengths, sliding_window=sliding_window,
+            interpret=(backend == "pallas_interpret"))
+    B, S = cache_k.shape[0], cache_k.shape[1]
+    kv_pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    kv_valid = kv_pos < lengths[:, None]
+    q_pos = (lengths - 1)[:, None]
+    return attend(q, cache_k, cache_v, q_pos, kv_pos, kv_valid,
+                  sliding_window=sliding_window)

@@ -1,0 +1,20 @@
+"""Hand-written TPU Pallas kernels for the hot ops.
+
+The reference's native compute layer was vendored torch/CUDA kernels behind
+HF ``model.generate()`` (reference: worker/app.py:297-305, SURVEY.md §2.5).
+This package is the TPU-native equivalent: Mosaic-compiled kernels for the
+two attention regimes —
+
+- ``flash_attention``: tiled online-softmax causal attention for prefill
+  (compute-bound, MXU-saturating)
+- ``flash_decode``: single-token cached attention streaming the KV cache
+  from HBM (bandwidth-bound)
+
+Both run in interpreter mode on CPU for tests (tests/test_pallas_attention.py)
+and compiled on TPU via ops/attention.py's backend dispatch.
+"""
+
+from distributed_llm_inferencing_tpu.ops.pallas.flash_attention import (  # noqa: F401
+    flash_attention,
+    flash_decode,
+)

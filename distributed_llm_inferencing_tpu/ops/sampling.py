@@ -60,6 +60,12 @@ def sample(logits, key, params: SamplingParams,
 
     The transform order (temperature -> top_k -> top_p) matches HF
     generate()'s LogitsProcessor ordering so outputs are comparable.
+
+    Hot path: when top_k is active, the nucleus filter runs on the top-k
+    subset only — one ``lax.top_k`` instead of a full-vocab sort per decode
+    step. This is exact, not an approximation: after the top-k warper the
+    distribution is supported on those k tokens, so HF's subsequent top-p
+    softmax/cumsum sees exactly the same values.
     """
     logits = logits.astype(jnp.float32)
     if ban_tokens is not None:
@@ -68,6 +74,18 @@ def sample(logits, key, params: SamplingParams,
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     t = max(params.temperature, 1e-6)
     logits = logits / t
-    logits = _mask_top_k(logits, params.top_k)
+
+    V = logits.shape[-1]
+    if 0 < params.top_k < V:
+        vals, idx = jax.lax.top_k(logits, params.top_k)  # sorted descending
+        if params.top_p < 1.0:
+            probs = jax.nn.softmax(vals, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            # sorted position i is removed if the cumulative mass *before*
+            # it >= p (the crossing token is kept, per HF TopPLogitsWarper)
+            vals = jnp.where((cum - probs) < params.top_p, vals, -jnp.inf)
+        j = jax.random.categorical(key, vals, axis=-1)
+        return jnp.take_along_axis(idx, j[..., None], axis=-1)[..., 0].astype(jnp.int32)
+
     logits = _mask_top_p(logits, params.top_p)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)

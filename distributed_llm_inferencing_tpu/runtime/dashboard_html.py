@@ -55,6 +55,15 @@ pre.result { background:#10161c; padding:12px; border-radius:6px;
 """
 
 
+# HTML-escape for every server-sourced string interpolated into innerHTML
+# (node names, model names etc. arrive via the unauthenticated JSON API —
+# without this, a crafted model_name is stored XSS against the operator).
+_ESC = """
+function esc(s) { return String(s).replace(/[&<>"']/g, c => (
+  {'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c])); }
+"""
+
+
 def _nav(active: str) -> str:
     items = [("/", "Dashboard"), ("/nodes", "Nodes"), ("/inference", "Inference")]
     links = "".join(
@@ -82,7 +91,7 @@ DASHBOARD = f"""<!doctype html><html><head><title>Dashboard</title>{_STYLE}
 <table><thead><tr><th>ID</th><th>Model</th><th>Status</th><th>tok/s</th>
 <th>Latency (s)</th><th>Node</th></tr></thead>
 <tbody id="recent"></tbody></table>
-<script>
+<script>{_ESC}
 async function refresh() {{
   try {{
     const ns = await (await fetch('/api/nodes/status')).json();
@@ -92,7 +101,7 @@ async function refresh() {{
     for (const k of ['pending','processing','completed'])
       document.getElementById('n-'+k).textContent = r.counts[k] || 0;
     document.getElementById('recent').innerHTML = r.requests.map(q =>
-      `<tr><td>${{q.id}}</td><td>${{q.model_name}}</td>`+
+      `<tr><td>${{q.id}}</td><td>${{esc(q.model_name)}}</td>`+
       `<td><span class="pill ${{q.status}}">${{q.status}}</span></td>`+
       `<td>${{q.tokens_per_s ? q.tokens_per_s.toFixed(1) : ''}}</td>`+
       `<td>${{q.execution_time ? q.execution_time.toFixed(2) : ''}}</td>`+
@@ -117,17 +126,17 @@ NODES = f"""<!doctype html><html><head><title>Nodes</title>{_STYLE}
   <div class="row"><label>Port</label><input name="port" value="8100"></div>
   <button>Add Node</button> <span id="add-msg" class="muted"></span>
 </form></div>
-<script>
+<script>{_ESC}
 async function refresh() {{
   const r = await (await fetch('/api/nodes/status')).json();
   document.getElementById('nodes').innerHTML = r.nodes.map(n => {{
-    const dev = (n.resources && n.resources.devices || [])
-      .map(d => d.kind || d.platform).join(', ');
+    const dev = esc((n.resources && n.resources.devices || [])
+      .map(d => d.kind || d.platform).join(', '));
     const models = n.loaded_models.map(m =>
-      `${{m.name}} [${{Object.entries(m.mesh).filter(e=>e[1]>1)
-        .map(e=>e.join('=')).join(' ') || '1 chip'}}]`).join('<br>');
-    return `<tr><td>${{n.id}}</td><td>${{n.name}}</td>`+
-    `<td>${{n.host}}:${{n.port}}</td>`+
+      `${{esc(m.name)}} [${{esc(Object.entries(m.mesh).filter(e=>e[1]>1)
+        .map(e=>e.join('=')).join(' ') || '1 chip')}}]`).join('<br>');
+    return `<tr><td>${{n.id}}</td><td>${{esc(n.name)}}</td>`+
+    `<td>${{esc(n.host)}}:${{esc(n.port)}}</td>`+
     `<td><span class="pill ${{n.is_active?'online':'offline'}}">`+
     `${{n.is_active?'online':'offline'}}</span></td>`+
     `<td>${{dev}}</td>`+
@@ -182,12 +191,12 @@ INFERENCE = f"""<!doctype html><html><head><title>Inference</title>{_STYLE}
 <table><thead><tr><th>ID</th><th>Model</th><th>Status</th><th></th></tr>
 </thead><tbody id="recent"></tbody></table>
 </div></div>
-<script>
+<script>{_ESC}
 let pollTimer = null;
 async function refresh() {{
   const r = await (await fetch('/api/inference/recent')).json();
   document.getElementById('recent').innerHTML = r.requests.map(q =>
-    `<tr><td>${{q.id}}</td><td>${{q.model_name}}</td>`+
+    `<tr><td>${{q.id}}</td><td>${{esc(q.model_name)}}</td>`+
     `<td><span class="pill ${{q.status}}">${{q.status}}</span></td>`+
     `<td><button onclick="view(${{q.id}})">view</button></td></tr>`).join('');
 }}

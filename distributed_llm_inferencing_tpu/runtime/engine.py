@@ -68,10 +68,16 @@ class InferenceEngine:
                  mesh_spec: Optional[MeshSpec] = None,
                  max_seq: Optional[int] = None,
                  seed: int = 0):
-        self.cfg = cfg
         self.mesh_spec = mesh_spec or MeshSpec()
         validate_spec(self.mesh_spec, cfg)
         self.mesh = create_mesh(self.mesh_spec)
+        # Pin the attention backend now that the program's device span is
+        # known (pallas kernels are single-program; GSPMD partitions the
+        # xla formulation on multi-device meshes).
+        from distributed_llm_inferencing_tpu.ops.attention import resolve_backend
+        self.cfg = cfg = cfg.replace(
+            attn_backend=resolve_backend(cfg.attn_backend,
+                                         self.mesh_spec.num_devices))
         self.max_seq = min(max_seq or cfg.max_position_embeddings,
                            cfg.max_position_embeddings)
 
@@ -100,23 +106,38 @@ class InferenceEngine:
 
         return jax.jit(fn, donate_argnums=(3,))
 
-    def _decode_jitted(self, sp: SamplingParams):
+    # Chunk sizes for the scanned decode loop. Any max_new_tokens is a
+    # greedy sum of these, so at most len(DECODE_CHUNKS) programs compile
+    # per sampling config and the host syncs once per chunk, not per token
+    # (the per-token dispatch+transfer pattern is what made the reference's
+    # serving loop unshippable on an accelerator behind a network hop).
+    DECODE_CHUNKS = (32, 8, 1)
+
+    def _decode_jitted(self, sp: SamplingParams, T: int):
         # per-instance cache (an lru_cache on the method would pin the
         # engine — and its HBM-resident params — in a class-global cache,
         # defeating /unload_model)
-        fn = self._decode_fns.get(sp)
+        fn = self._decode_fns.get((sp, T))
         if fn is None:
             cfg = self.cfg
 
             def raw(params, tokens, cache, key):
-                logits, cache = transformer.decode_step(params, cfg, tokens, cache)
-                nxt = sample(logits[:, 0], key, sp)
-                return nxt, cache
+                def step(carry, _):
+                    cur, cache, key = carry
+                    key, sub = jax.random.split(key)
+                    logits, cache = transformer.decode_step(
+                        params, cfg, cur[:, None], cache)
+                    nxt = sample(logits[:, 0], sub, sp)
+                    return (nxt, cache, key), nxt
+
+                (cur, cache, key), toks = jax.lax.scan(
+                    step, (tokens, cache, key), length=T)
+                return toks, cur, cache, key   # toks: [T, B]
 
             fn = jax.jit(raw, donate_argnums=(2,))
-            if len(self._decode_fns) >= 8:
+            if len(self._decode_fns) >= 24:
                 self._decode_fns.pop(next(iter(self._decode_fns)))
-            self._decode_fns[sp] = fn
+            self._decode_fns[(sp, T)] = fn
         return fn
 
     # ---- public API --------------------------------------------------
@@ -141,6 +162,9 @@ class InferenceEngine:
         lens = [len(p) for p in prompts]
         if not lens or min(lens) < 1:
             raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            return GenerateResult(tokens=[[] for _ in range(n_real)],
+                                  prefill_ms=0.0, decode_ms=0.0, steps=0)
         max_len = max(lens)
         if max_len + max_new_tokens > self.max_seq:
             raise ValueError(
@@ -174,30 +198,64 @@ class InferenceEngine:
             key = jax.random.PRNGKey(seed)
             key, sub = jax.random.split(key)
             cur = sample(last_logits, sub, sp)
-            cur.block_until_ready()
+
+            # Host syncs are the enemy: on a remote-attached chip one
+            # device->host round trip costs tens of ms. Sync per chunk only
+            # when the host must see tokens mid-flight (eos early-exit /
+            # streaming); otherwise queue every chunk dispatch and sync ONCE.
+            incremental = (eos_token_id is not None) or (stream_cb is not None)
+
+            if incremental:
+                cur.block_until_ready()
             t1 = time.perf_counter()
 
-            decode = self._decode_jitted(sp)
-            out = [[int(cur[i])] for i in range(B)]
-            done = [(i >= n_real) or
-                    (eos_token_id is not None and out[i][0] == eos_token_id)
-                    for i in range(B)]
-            if stream_cb:
-                stream_cb(0, [int(cur[i]) for i in range(n_real)])
-
             steps = 1
-            while steps < max_new_tokens and not all(done):
-                key, sub = jax.random.split(key)
-                cur, cache = decode(self.params, cur[:, None], cache, sub)
-                toks = np.asarray(cur)
-                for i in range(B):
-                    if not done[i]:
-                        out[i].append(int(toks[i]))
-                        if eos_token_id is not None and toks[i] == eos_token_id:
-                            done[i] = True
+            remaining = max_new_tokens - 1
+            if not incremental:
+                first_dev = cur          # prefill's sample (never donated)
+                chunks_dev = []
+                while remaining > 0:
+                    T = next(c for c in self.DECODE_CHUNKS if c <= remaining)
+                    decode = self._decode_jitted(sp, T)
+                    toks_dev, cur, cache, key = decode(
+                        self.params, cur, cache, key)
+                    chunks_dev.append(toks_dev)
+                    steps += T
+                    remaining -= T
+                # ONE sync for the whole request
+                first, host_chunks = jax.device_get((first_dev, chunks_dev))
+                toks_all = (np.concatenate(host_chunks, axis=0)
+                            if host_chunks else np.zeros((0, B), np.int32))
+                out = [[int(first[i])] + [int(t) for t in toks_all[:, i]]
+                       for i in range(B)]
+            else:
+                out = [[int(cur[i])] for i in range(B)]
+                done = [(i >= n_real) or
+                        (eos_token_id is not None and out[i][0] == eos_token_id)
+                        for i in range(B)]
                 if stream_cb:
-                    stream_cb(steps, toks[:n_real].tolist())
-                steps += 1
+                    stream_cb(0, [int(cur[i]) for i in range(n_real)])
+
+                while remaining > 0 and not all(done):
+                    T = next(c for c in self.DECODE_CHUNKS if c <= remaining)
+                    decode = self._decode_jitted(sp, T)
+                    toks_dev, cur, cache, key = decode(self.params, cur, cache, key)
+                    toks = np.asarray(toks_dev)    # [T, B] — one sync per chunk
+                    for t in range(T):
+                        # stream exactly what lands in `out` this step;
+                        # finished sequences surface as None
+                        emit = [None if done[i] else int(toks[t, i])
+                                for i in range(n_real)]
+                        for i in range(B):
+                            if not done[i]:
+                                out[i].append(int(toks[t, i]))
+                                if (eos_token_id is not None
+                                        and toks[t, i] == eos_token_id):
+                                    done[i] = True
+                        if stream_cb and any(e is not None for e in emit):
+                            stream_cb(steps + t, emit)
+                    steps += T
+                    remaining -= T
             t2 = time.perf_counter()
 
         out = out[:n_real]  # drop dp-padding rows

@@ -51,6 +51,9 @@ class Master:
                  health_interval: float = 10.0,
                  auth_key: Optional[str] = None):
         self.store = Store(db_path)
+        n = self.store.recover_stale_processing()
+        if n:
+            log.info("requeued %d request(s) stranded by a previous run", n)
         self.metrics = Metrics()
         self.health_interval = health_interval
         self._worker_auth = auth_key or os.environ.get("DLI_AUTH_KEY")
@@ -127,7 +130,13 @@ class Master:
                                    last_heartbeat=time.time(), info=info)
             return {"status": "success", "node_id": existing["id"],
                     "message": "node re-activated"}
-        node_id = self.store.add_node(name, host, port, is_active=True)
+        import sqlite3
+        try:
+            node_id = self.store.add_node(name, host, port, is_active=True)
+        except sqlite3.IntegrityError:
+            return 400, {"status": "error",
+                         "message": f"node name {name!r} already registered "
+                                    "at a different address"}
         self.store.update_node(node_id, last_heartbeat=time.time(), info=info)
         log.info("node %s added: %s:%d", name, host, port)
         return {"status": "success", "node_id": node_id}
@@ -344,7 +353,12 @@ class Master:
                 self._wake.set()
             else:
                 self.store.mark_failed(req["id"], str(e))
-            self._node_failure(node)
+            # A read timeout means the worker is slow/busy (its generate
+            # lock serializes requests), not dead — striking it would
+            # deactivate healthy nodes under load. Connection-level errors
+            # do strike.
+            if not isinstance(e, http.exceptions.Timeout):
+                self._node_failure(node)
             return False
         finally:
             with self._inflight_lock:

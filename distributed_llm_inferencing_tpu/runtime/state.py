@@ -182,6 +182,16 @@ class Store:
         self._exec("UPDATE requests SET status='pending', "
                    "attempts=attempts+1 WHERE id=?", (req_id,))
 
+    def recover_stale_processing(self) -> int:
+        """Requeue requests stranded in 'processing' by a master crash —
+        the reference left these stuck forever (no recovery path at all,
+        SURVEY.md §5.3). Called once at master startup."""
+        with self._lock, self._db:
+            cur = self._db.execute(
+                "UPDATE requests SET status='pending' "
+                "WHERE status='processing'")
+            return cur.rowcount
+
     def mark_completed(self, req_id: int, result: str, node_id: int,
                        execution_time: float, tokens_per_s: float):
         # ≙ InferenceRequest.mark_completed (reference models.py:52-56)

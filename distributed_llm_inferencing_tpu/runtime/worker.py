@@ -259,6 +259,8 @@ class WorkerAgent:
             done = object()
 
             def cb(step, toks):
+                if toks[0] is None:   # sequence already finished (post-eos)
+                    return
                 q.put({"event": "token", "step": step, "token": toks[0],
                        "text": m.tokenizer.decode([toks[0]])})
 
