@@ -100,7 +100,7 @@ def _moe(x, lp, cfg: ModelConfig):
 
 
 def _block(x, lp, cache_k, cache_v, *, cfg: ModelConfig, q_positions,
-           write_starts, new_lengths, is_prefill, backend):
+           write_starts, new_lengths, is_prefill, backend, mesh=None):
     """One transformer block with cache read/update.
 
     x: [B,s,D]; cache_k/v: [B,S,Hkv,hd] (this layer's slice);
@@ -124,7 +124,16 @@ def _block(x, lp, cache_k, cache_v, *, cfg: ModelConfig, q_positions,
     cache_k = write_block(cache_k, k, write_starts)
     cache_v = write_block(cache_v, v, write_starts)
 
-    if is_prefill:
+    if is_prefill and mesh is not None and mesh.shape.get("sp", 1) > 1:
+        # sequence-parallel long-context path: ring attention over sp
+        # (parallel/ring.py) — K/V chunks rotate via ppermute, no device
+        # ever holds the full sequence
+        from distributed_llm_inferencing_tpu.parallel.ring import (
+            ring_attend_prefill)
+        attn = ring_attend_prefill(
+            q, k, v, q_positions, new_lengths, mesh=mesh,
+            sliding_window=cfg.sliding_window)
+    elif is_prefill:
         attn = attend_prefill(q, k, v, sliding_window=cfg.sliding_window,
                               backend=backend)
     else:
@@ -148,6 +157,7 @@ def forward(
     q_positions,                 # [B, s] int32 — absolute positions of `tokens`
     new_lengths,                 # [B] int32 — cache lengths after this block
     is_prefill: bool = False,    # static: fresh-KV attention regime
+    mesh=None,                   # static: enables the sp ring-attention path
 ) -> Tuple[jax.Array, KVCache]:
     """Run the model over a block of tokens, updating the cache.
 
@@ -182,7 +192,7 @@ def forward(
         x, ck, cv = _block(
             x, lp, ck, cv, cfg=cfg, q_positions=q_positions,
             write_starts=write_starts, new_lengths=new_lengths,
-            is_prefill=is_prefill, backend=backend)
+            is_prefill=is_prefill, backend=backend, mesh=mesh)
         return x, (ck, cv)
 
     x, (new_k, new_v) = jax.lax.scan(
@@ -199,18 +209,23 @@ def forward(
     return logits, KVCache(k=new_k, v=new_v, lengths=new_lengths)
 
 
-def prefill(params, cfg: ModelConfig, tokens, lengths, cache: KVCache):
+def prefill(params, cfg: ModelConfig, tokens, lengths, cache: KVCache,
+            mesh=None):
     """Prefill a right-padded prompt block. tokens [B,S0], lengths [B].
 
     Padding tokens beyond each sequence's length land in cache slots that the
     validity mask excludes and that later decode steps overwrite in order, so
     ragged batches need no re-packing.
+
+    Pass ``mesh`` (with an sp axis of size > 1) to run attention
+    sequence-parallel via ring attention (parallel/ring.py).
     """
     B, s = tokens.shape
     q_pos = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (B, s))
     return forward(params, cfg, tokens, cache,
                    write_starts=jnp.zeros((B,), jnp.int32),
-                   q_positions=q_pos, new_lengths=lengths, is_prefill=True)
+                   q_positions=q_pos, new_lengths=lengths, is_prefill=True,
+                   mesh=mesh)
 
 
 def decode_step(params, cfg: ModelConfig, tokens, cache: KVCache):

@@ -91,6 +91,12 @@ def validate_spec(spec: MeshSpec, cfg) -> None:
             f"tp={spec.tp} must divide intermediate_size={cfg.intermediate_size}")
     if cfg.num_layers % spec.pp:
         raise ValueError(f"pp={spec.pp} must divide num_layers={cfg.num_layers}")
+    if spec.sp > 1 and spec.tp > 1 and (
+            spec.tp > cfg.num_kv_heads or cfg.num_kv_heads % spec.tp):
+        raise ValueError(
+            f"sp={spec.sp} with tp={spec.tp} needs tp to divide "
+            f"num_kv_heads={cfg.num_kv_heads}: the ring-attention path "
+            "shards kv heads over tp (parallel/ring.py)")
     if spec.ep > 1:
         if not cfg.is_moe:
             raise ValueError("ep>1 on a dense model")

@@ -1,0 +1,137 @@
+"""Ring attention: sequence-parallel causal attention over the ``sp`` axis.
+
+Long-context support the reference never had (SURVEY.md §5.7 — its notion
+of sequence scaling was "whatever HF generate does on one device",
+max_length=100). Here the sequence axis is sharded over the mesh's ``sp``
+axis and attention runs as a ring:
+
+- each device holds one contiguous chunk of Q and one chunk of K/V
+- K/V chunks (with their absolute positions and validity) rotate around
+  the ring via ``jax.lax.ppermute`` — neighbour hops that ride ICI
+- every hop folds the visiting chunk into a running online-softmax
+  accumulator (m, l, o), exactly the flash-attention recurrence, so no
+  device ever materializes the full [S, S] score matrix or the full K/V
+
+This is the blockwise-parallel formulation of Liu et al.'s Ring Attention
+(see PAPERS.md); with sp devices the per-device attention memory drops from
+O(S^2) to O((S/sp)^2 * sp) time and O(S/sp) activation residency, which is
+what makes million-token contexts fit.
+
+Masking travels with the data: each K/V block carries its absolute
+positions and a validity bitmap, so causality, ragged batch lengths and
+sliding windows all reduce to the same position arithmetic used by the
+dense path (ops/attention.py:attend) and the output is bit-equivalent in
+f32 up to summation order.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_llm_inferencing_tpu.ops.attention import NEG_INF, repeat_kv
+
+
+def _masked_scores(q, k, q_pos, kv_pos, kv_valid, sliding_window):
+    """[B,H,Sq,Skv] f32 masked scores for one (Q chunk, KV chunk) pair."""
+    hd = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    mask = (kv_pos[:, None, :] <= q_pos[:, :, None]) & kv_valid[:, None, :]
+    if sliding_window is not None:
+        mask = mask & ((q_pos[:, :, None] - kv_pos[:, None, :])
+                       < sliding_window)
+    return jnp.where(mask[:, None, :, :], s, NEG_INF)
+
+
+def _ring_body(q, k, v, q_pos, kv_pos, kv_valid, *, axis: str,
+               sliding_window: Optional[int]):
+    """Per-device ring loop. Shapes are LOCAL chunks:
+    q [B,Sq,H,hd], k/v [B,Sk,Hkv,hd], q_pos [B,Sq], kv_pos [B,Sk],
+    kv_valid [B,Sk]. Returns [B,Sq,H,hd] in q.dtype.
+    """
+    n = jax.lax.psum(1, axis)
+    B, Sq, H, hd = q.shape
+    n_rep = H // k.shape[2]
+
+    m0 = jnp.full((B, H, Sq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, Sq), jnp.float32)
+    o0 = jnp.zeros((B, Sq, H, hd), jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(_, carry):
+        k, v, kv_pos, kv_valid, m, l, o = carry
+        kf = repeat_kv(k, n_rep)
+        vf = repeat_kv(v, n_rep)
+        s = _masked_scores(q, kf, q_pos, kv_pos, kv_valid, sliding_window)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))          # [B,H,Sq]
+        alpha = jnp.exp(m - m_new)
+        # explicit zero for masked entries: on a fully-masked row
+        # s == m_new == NEG_INF and exp(s - m_new) would be 1, not 0
+        p = jnp.where(s > NEG_INF * 0.5,
+                      jnp.exp(s - m_new[..., None]), 0.0)    # [B,H,Sq,Sk]
+        l = l * alpha + jnp.sum(p, axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p, vf.astype(jnp.float32))
+        o = o * alpha.transpose(0, 2, 1)[..., None] + pv
+        # rotate the visiting KV block to the next device (ICI neighbour)
+        k, v, kv_pos, kv_valid = jax.tree.map(
+            lambda x: jax.lax.ppermute(x, axis, perm),
+            (k, v, kv_pos, kv_valid))
+        return k, v, kv_pos, kv_valid, m_new, l, o
+
+    *_, m, l, o = jax.lax.fori_loop(
+        0, n, step, (k, v, kv_pos, kv_valid, m0, l0, o0))
+    # rows with no valid kv (padding rows) have l == 0; emit zeros not NaN
+    l = jnp.maximum(l, 1e-30)
+    return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+
+
+def ring_attend_prefill(
+    q,            # [B, S, H, hd]   (global/logical shapes)
+    k,            # [B, S, Hkv, hd]
+    v,            # [B, S, Hkv, hd]
+    q_positions,  # [B, S] int32 absolute positions
+    lengths,      # [B] int32 — valid tokens per sequence
+    *,
+    mesh: Mesh,
+    sliding_window: Optional[int] = None,
+):
+    """Sequence-parallel causal prefill attention via shard_map over sp.
+
+    Callable from inside an outer jit (GSPMD) program; S must divide by
+    the mesh's sp size. dp shards batch, tp shards heads, and each
+    (dp, tp) slice runs an independent ring over sp.
+    """
+    sp = mesh.shape["sp"]
+    tp = mesh.shape["tp"]
+    B, S, H, hd = q.shape
+    Hkv = k.shape[2]
+    if S % sp:
+        raise ValueError(f"ring attention needs sp={sp} | seq={S}")
+    if H % tp:
+        raise ValueError(f"tp={tp} must divide num_heads={H}")
+    from distributed_llm_inferencing_tpu.parallel.sharding import kv_head_axis
+    kv_tp = kv_head_axis(Hkv, tp)
+    if tp > 1 and kv_tp is None:
+        raise ValueError(
+            f"ring attention with tp={tp} needs tp <= num_kv_heads={Hkv} "
+            "(kv replication across tp is not supported on the ring path)")
+
+    kv_valid = q_positions < lengths[:, None]   # [B, S]
+
+    body = functools.partial(_ring_body, axis="sp",
+                             sliding_window=sliding_window)
+    q_spec = P("dp", "sp", "tp", None)
+    kv_spec = P("dp", "sp", kv_tp, None)
+    pos_spec = P("dp", "sp")
+    return jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(q_spec, kv_spec, kv_spec, pos_spec, pos_spec, pos_spec),
+        out_specs=q_spec,
+        check_vma=False,
+    )(q, k, v, q_positions, q_positions, kv_valid)

@@ -27,11 +27,18 @@ from distributed_llm_inferencing_tpu.models.config import ModelConfig
 from distributed_llm_inferencing_tpu.parallel.mesh import MeshSpec
 
 
+def kv_head_axis(num_kv_heads: int, tp: int):
+    """The one GQA kv-over-tp rule: kv heads shard over tp iff they divide
+    evenly; otherwise they replicate (tp > num_kv_heads small-kv case).
+    Shared by param/cache specs here and the ring path (parallel/ring.py)."""
+    return "tp" if (tp <= num_kv_heads and num_kv_heads % max(tp, 1) == 0) \
+        else None
+
+
 def param_specs(cfg: ModelConfig, spec: MeshSpec,
                 shard_layers_over_pp: bool = True) -> Dict[str, Any]:
     """PartitionSpec pytree matching models/transformer.py's param schema."""
-    # kv heads replicate over tp when tp > num_kv_heads (GQA small-kv case)
-    kv_tp = "tp" if cfg.num_kv_heads % max(spec.tp, 1) == 0 and spec.tp <= cfg.num_kv_heads else None
+    kv_tp = kv_head_axis(cfg.num_kv_heads, spec.tp)
     L = "pp" if shard_layers_over_pp else None
 
     def norm_p():
@@ -85,7 +92,7 @@ def param_specs(cfg: ModelConfig, spec: MeshSpec,
 def cache_specs(cfg: ModelConfig, spec: MeshSpec):
     """KVCache sharding: [L,B,S,Hkv,hd] — batch over dp, kv heads over tp,
     sequence over sp (ring attention shards the S axis)."""
-    kv_tp = "tp" if spec.tp <= cfg.num_kv_heads else None
+    kv_tp = kv_head_axis(cfg.num_kv_heads, spec.tp)
     kv = P(None, "dp", "sp" if spec.sp > 1 else None, kv_tp, None)
     from distributed_llm_inferencing_tpu.ops.kvcache import KVCache
     return KVCache(k=kv, v=kv, lengths=P("dp"))

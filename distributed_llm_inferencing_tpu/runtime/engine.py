@@ -80,6 +80,12 @@ class InferenceEngine:
                                          self.mesh_spec.num_devices))
         self.max_seq = min(max_seq or cfg.max_position_embeddings,
                            cfg.max_position_embeddings)
+        # sequence parallelism shards the cache S axis: keep it divisible
+        # (round DOWN — exceeding the model's position window would admit
+        # positions past learned-embedding rows / the trained RoPE range)
+        sp = self.mesh_spec.sp
+        if sp > 1 and self.max_seq % sp:
+            self.max_seq -= self.max_seq % sp
 
         if params is None:
             params = init_params(cfg, jax.random.PRNGKey(seed))
@@ -95,9 +101,12 @@ class InferenceEngine:
 
     def _build_prefill(self, s0: int):
         cfg = self.cfg
+        # sp>1 routes prefill attention through the ring (parallel/ring.py)
+        mesh = self.mesh if self.mesh_spec.sp > 1 else None
 
         def fn(params, tokens, lengths, cache):
-            logits, cache = transformer.prefill(params, cfg, tokens, lengths, cache)
+            logits, cache = transformer.prefill(params, cfg, tokens, lengths,
+                                                cache, mesh=mesh)
             # gather last valid logit per sequence: [B,V]
             idx = jnp.maximum(lengths - 1, 0)
             last = jnp.take_along_axis(
@@ -181,6 +190,9 @@ class InferenceEngine:
         # bucket capped at cache capacity (max_len <= max_seq is guaranteed
         # by the guard above, so s0 >= max_len always holds)
         s0 = min(_bucket(max_len), self.max_seq)
+        sp_deg = self.mesh_spec.sp
+        if sp_deg > 1 and s0 % sp_deg:  # ring needs sp-divisible blocks
+            s0 = min(s0 + sp_deg - s0 % sp_deg, self.max_seq)
         tokens = np.zeros((B, s0), np.int32)
         for i, p in enumerate(prompts):
             tokens[i, :len(p)] = p
