@@ -99,6 +99,33 @@ def _moe(x, lp, cfg: ModelConfig):
     return out.astype(x.dtype)
 
 
+def embed(params, cfg: ModelConfig, tokens, q_positions):
+    """Token (+ learned position) embedding. Shared by the scanned forward
+    below and the pipelined executor (parallel/pipeline.py)."""
+    x = jnp.take(params["embed"]["tokens"], tokens, axis=0)
+    x = x.astype(jnp.dtype(cfg.dtype))
+    if cfg.position_embedding == "learned":
+        # Positions are clipped only as jit-safety; the engine rejects
+        # requests whose prompt+max_new_tokens exceed the context window
+        # (runtime/engine.py), so clipping never silently engages.
+        pos = jnp.take(params["embed"]["positions"],
+                       jnp.clip(q_positions, 0, cfg.max_position_embeddings - 1),
+                       axis=0)
+        x = x + pos.astype(x.dtype)
+    return x
+
+
+def unembed(params, cfg: ModelConfig, x):
+    """Final norm + logits head, f32. Shared with parallel/pipeline.py."""
+    x = norm(x, params["final_norm"], cfg.norm_type, cfg.norm_eps)
+    if cfg.tie_word_embeddings:
+        logits = jnp.einsum("bsd,vd->bsv", x,
+                            params["embed"]["tokens"].astype(x.dtype))
+    else:
+        logits = _linear(x, params["lm_head"])
+    return logits.astype(jnp.float32)
+
+
 def _block(x, lp, cache_k, cache_v, *, cfg: ModelConfig, q_positions,
            write_starts, new_lengths, is_prefill, backend, mesh=None):
     """One transformer block with cache read/update.
@@ -170,16 +197,7 @@ def forward(
     index and validity is slot < length.
     """
     B, s = tokens.shape
-    x = jnp.take(params["embed"]["tokens"], tokens, axis=0)
-    x = x.astype(jnp.dtype(cfg.dtype))
-    if cfg.position_embedding == "learned":
-        # Positions are clipped only as jit-safety; the engine rejects
-        # requests whose prompt+max_new_tokens exceed the context window
-        # (runtime/engine.py), so clipping never silently engages in practice.
-        pos = jnp.take(params["embed"]["positions"],
-                       jnp.clip(q_positions, 0, cfg.max_position_embeddings - 1),
-                       axis=0)
-        x = x + pos.astype(x.dtype)
+    x = embed(params, cfg, tokens, q_positions)
 
     # Conservative device count for 'auto': the engine pins a concrete
     # backend for its own programs; direct callers (tests, dryrun) get
@@ -198,14 +216,7 @@ def forward(
     x, (new_k, new_v) = jax.lax.scan(
         body, x, (params["layers"], cache.k, cache.v))
 
-    x = norm(x, params["final_norm"], cfg.norm_type, cfg.norm_eps)
-    if cfg.tie_word_embeddings:
-        logits = jnp.einsum("bsd,vd->bsv", x,
-                            params["embed"]["tokens"].astype(x.dtype))
-    else:
-        logits = _linear(x, params["lm_head"])
-    logits = logits.astype(jnp.float32)
-
+    logits = unembed(params, cfg, x)
     return logits, KVCache(k=new_k, v=new_v, lengths=new_lengths)
 
 

@@ -91,10 +91,15 @@ def validate_spec(spec: MeshSpec, cfg) -> None:
             f"tp={spec.tp} must divide intermediate_size={cfg.intermediate_size}")
     if cfg.num_layers % spec.pp:
         raise ValueError(f"pp={spec.pp} must divide num_layers={cfg.num_layers}")
-    if spec.sp > 1 and spec.tp > 1 and (
-            spec.tp > cfg.num_kv_heads or cfg.num_kv_heads % spec.tp):
+    if spec.sp > 1 and spec.pp > 1:
         raise ValueError(
-            f"sp={spec.sp} with tp={spec.tp} needs tp to divide "
+            "sp and pp cannot both exceed 1 yet: the pipelined executor "
+            "(parallel/pipeline.py) does not route through ring attention")
+    if spec.sp > 1 and spec.tp > cfg.num_kv_heads:
+        # (tp <= num_kv_heads non-divisibility is already rejected above;
+        # the rule itself lives in sharding.kv_head_axis)
+        raise ValueError(
+            f"sp={spec.sp} with tp={spec.tp} needs tp <= "
             f"num_kv_heads={cfg.num_kv_heads}: the ring-attention path "
             "shards kv heads over tp (parallel/ring.py)")
     if spec.ep > 1:

@@ -1,0 +1,202 @@
+"""True pipeline parallelism: microbatched cross-stage execution over ``pp``.
+
+This is the capability the reference designed for but never implemented —
+its shard metadata had start_layer/end_layer (reference: shard_model.py:
+98-106) but inference used only the first shard with no activation handoff
+(reference: worker/app.py:334-336, views.py:337-340). Here the handoff is
+real and TPU-native: a GPipe-style schedule inside ``jax.shard_map``,
+manual over the ``pp`` mesh axis only, with activations hopping
+stage -> stage+1 via ``jax.lax.ppermute`` (ICI neighbours). Tensor/data
+parallelism inside each stage stays under GSPMD (auto axes), so pp composes
+with tp/dp without re-implementing their collectives.
+
+Schedule: with P stages and M microbatches, tick t (0 <= t < M+P-1) has
+stage p working on microbatch (t - p). The pipeline bubble is (P-1)/(M+P-1)
+of the ticks; callers pick M to amortize it. Each stage owns L/P layers and
+the matching slice of the KV cache ([L, ...] sharded over pp), so cache
+updates are stage-local.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_llm_inferencing_tpu.models.config import ModelConfig
+from distributed_llm_inferencing_tpu.ops.kvcache import KVCache
+
+
+def _stage_body(x, layers_p, ck, cv, q_positions, write_starts, new_lengths,
+                *, cfg: ModelConfig, is_prefill: bool, backend: str):
+    """Run this stage's local layers over one microbatch.
+
+    x [mb,s,D]; layers_p leaves [L_loc,...]; ck/cv [L_loc,mb,S,Hkv,hd].
+    """
+    from distributed_llm_inferencing_tpu.models.transformer import _block
+
+    def body(x, layer_in):
+        lp, k, v = layer_in
+        x, k, v = _block(x, lp, k, v, cfg=cfg, q_positions=q_positions,
+                         write_starts=write_starts, new_lengths=new_lengths,
+                         is_prefill=is_prefill, backend=backend, mesh=None)
+        return x, (k, v)
+
+    x, (ck, cv) = jax.lax.scan(body, x, (layers_p, ck, cv))
+    return x, ck, cv
+
+
+def pipelined_apply(
+    params,
+    cfg: ModelConfig,
+    tokens,                # [B, s] int32
+    cache: KVCache,        # k/v [L, B, S, Hkv, hd]
+    write_starts,          # [B] int32
+    q_positions,           # [B, s] int32
+    new_lengths,           # [B] int32
+    *,
+    mesh: Mesh,
+    n_micro: int,
+    is_prefill: bool = False,
+) -> Tuple[jax.Array, KVCache]:
+    """Full forward (embed -> pipelined blocks -> norm -> logits) with the
+    layer stack executed as a P-stage pipeline. Drop-in replacement for
+    models/transformer.forward when the mesh has pp > 1.
+    """
+    pp = mesh.shape["pp"]
+    B, s = tokens.shape
+    if B % n_micro:
+        raise ValueError(f"batch {B} must divide into n_micro={n_micro}")
+    mb = B // n_micro
+    L = cache.k.shape[0]
+    if L % pp:
+        raise ValueError(f"pp={pp} must divide num_layers={L}")
+
+    # ---- embed (replicated over pp; shared with transformer.forward) ----
+    from distributed_llm_inferencing_tpu.models import transformer as tf
+    x = tf.embed(params, cfg, tokens, q_positions)
+
+    backend = "xla"  # pipeline stages span devices; GSPMD partitions attention
+
+    body = functools.partial(_pipeline_shardmap_body, cfg=cfg,
+                             is_prefill=is_prefill, backend=backend,
+                             n_micro=n_micro, mb=mb)
+    layer_spec = jax.tree.map(lambda _: P("pp"), params["layers"])
+    cache_spec = P("pp")
+    out = jax.shard_map(
+        body, mesh=mesh, axis_names={"pp"},
+        in_specs=(P(), layer_spec, cache_spec, cache_spec, P(), P(), P()),
+        out_specs=(P(), cache_spec, cache_spec),
+        check_vma=False,
+    )(x, params["layers"], cache.k, cache.v, q_positions, write_starts,
+      new_lengths)
+    x, new_k, new_v = out
+
+    # ---- final norm + logits (replicated, shared helper) ----
+    return tf.unembed(params, cfg, x), KVCache(k=new_k, v=new_v,
+                                               lengths=new_lengths)
+
+
+def pipelined_prefill(params, cfg: ModelConfig, tokens, lengths,
+                      cache: KVCache, *, mesh: Mesh, n_micro: int):
+    """Pipelined analogue of models/transformer.prefill."""
+    B, s = tokens.shape
+    q_pos = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (B, s))
+    return pipelined_apply(params, cfg, tokens, cache,
+                           write_starts=jnp.zeros((B,), jnp.int32),
+                           q_positions=q_pos, new_lengths=lengths,
+                           mesh=mesh, n_micro=n_micro, is_prefill=True)
+
+
+def pipelined_decode_step(params, cfg: ModelConfig, tokens,
+                          cache: KVCache, *, mesh: Mesh, n_micro: int):
+    """Pipelined analogue of models/transformer.decode_step."""
+    q_pos = cache.lengths[:, None]
+    return pipelined_apply(params, cfg, tokens, cache,
+                           write_starts=cache.lengths, q_positions=q_pos,
+                           new_lengths=cache.lengths + 1,
+                           mesh=mesh, n_micro=n_micro, is_prefill=False)
+
+
+def pick_n_micro(batch: int, pp: int, requested=None) -> int:
+    """Largest divisor of ``batch`` up to 2*pp: enough microbatches to
+    amortize the (pp-1)-tick bubble while keeping per-tick matmuls fat.
+
+    A requested count is a target, not a contract: request batches arrive
+    in any size, so a non-dividing value clamps to gcd instead of failing
+    a live request at trace time.
+    """
+    if requested:
+        import math
+        return max(1, math.gcd(requested, batch))
+    return next(m for m in range(min(batch, 2 * pp), 0, -1) if batch % m == 0)
+
+
+def _pipeline_shardmap_body(x, layers_p, ck, cv, q_positions, write_starts,
+                            new_lengths, *, cfg, is_prefill, backend,
+                            n_micro, mb):
+    """Manual-over-pp region: GPipe schedule with ppermute handoff.
+
+    Local views: x [B,s,D] (replicated over pp), layers_p leaves
+    [L/pp, ...], ck/cv [L/pp, B, S, Hkv, hd]. dp/tp/sp dims stay global
+    here (auto axes, GSPMD).
+    """
+    pp = jax.lax.psum(1, "pp")
+    stage = jax.lax.axis_index("pp")
+    B, s, D = x.shape
+    perm = [(i, (i + 1) % pp) for i in range(pp)]
+
+    state = jnp.zeros((mb, s, D), x.dtype)
+    outputs = jnp.zeros((B, s, D), x.dtype)
+
+    def mb_rows(arr, m):
+        return jax.lax.dynamic_slice_in_dim(arr, m * mb, mb, axis=0)
+
+    def tick(t, carry):
+        state, outputs, ck, cv = carry
+        # stage 0 ingests microbatch t (zeros once the feed runs dry)
+        feed = jnp.where(t < n_micro,
+                         mb_rows(x, jnp.minimum(t, n_micro - 1)), 0.0)
+        state = jnp.where(stage == 0, feed, state)
+
+        # this stage processes microbatch m = t - stage (if in range)
+        m = t - stage
+        valid = (m >= 0) & (m < n_micro)
+        m_safe = jnp.clip(m, 0, n_micro - 1)
+        qp = mb_rows(q_positions, m_safe)
+        ws = mb_rows(write_starts, m_safe)
+        nl = mb_rows(new_lengths, m_safe)
+        ck_m = jax.lax.dynamic_slice_in_dim(ck, m_safe * mb, mb, axis=1)
+        cv_m = jax.lax.dynamic_slice_in_dim(cv, m_safe * mb, mb, axis=1)
+
+        new_state, ck_new, cv_new = _stage_body(
+            state, layers_p, ck_m, cv_m, qp, ws, nl,
+            cfg=cfg, is_prefill=is_prefill, backend=backend)
+
+        # merge cache/output only when this tick did real work
+        ck = jax.lax.dynamic_update_slice_in_dim(
+            ck, jnp.where(valid, ck_new, ck_m), m_safe * mb, axis=1)
+        cv = jax.lax.dynamic_update_slice_in_dim(
+            cv, jnp.where(valid, cv_new, cv_m), m_safe * mb, axis=1)
+        state = jnp.where(valid, new_state, state)
+
+        # last stage emits finished microbatches
+        is_last = stage == pp - 1
+        old = mb_rows(outputs, m_safe)
+        outputs = jax.lax.dynamic_update_slice_in_dim(
+            outputs, jnp.where(valid & is_last, state, old),
+            m_safe * mb, axis=0)
+
+        # hand activations to the next stage (ICI neighbour hop)
+        state = jax.lax.ppermute(state, "pp", perm)
+        return state, outputs, ck, cv
+
+    state, outputs, ck, cv = jax.lax.fori_loop(
+        0, n_micro + pp - 1, tick, (state, outputs, ck, cv))
+
+    # every stage but the last holds zeros; psum replicates the result
+    outputs = jax.lax.psum(outputs, "pp")
+    return outputs, ck, cv

@@ -17,6 +17,12 @@ This is the blockwise-parallel formulation of Liu et al.'s Ring Attention
 O(S^2) to O((S/sp)^2 * sp) time and O(S/sp) activation residency, which is
 what makes million-token contexts fit.
 
+Scope: the ring covers **prefill** (where the O(S^2) cost lives). Decode
+with sp > 1 attends the sp-sharded cache through the dense path under
+GSPMD, which partitions the [B,1,S] score reduction with collectives —
+correct, but its per-step comm is not yet the blockwise-minimal schedule;
+a dedicated ring decode is tracked as a follow-up.
+
 Masking travels with the data: each K/V block carries its absolute
 positions and a validity bitmap, so causality, ragged batch lengths and
 sliding windows all reduce to the same position arithmetic used by the

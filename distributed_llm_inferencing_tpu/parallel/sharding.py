@@ -93,7 +93,8 @@ def cache_specs(cfg: ModelConfig, spec: MeshSpec):
     """KVCache sharding: [L,B,S,Hkv,hd] — batch over dp, kv heads over tp,
     sequence over sp (ring attention shards the S axis)."""
     kv_tp = kv_head_axis(cfg.num_kv_heads, spec.tp)
-    kv = P(None, "dp", "sp" if spec.sp > 1 else None, kv_tp, None)
+    L = "pp" if spec.pp > 1 else None  # stage-local cache slices
+    kv = P(L, "dp", "sp" if spec.sp > 1 else None, kv_tp, None)
     from distributed_llm_inferencing_tpu.ops.kvcache import KVCache
     return KVCache(k=kv, v=kv, lengths=P("dp"))
 

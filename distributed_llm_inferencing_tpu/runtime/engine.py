@@ -67,8 +67,10 @@ class InferenceEngine:
     def __init__(self, cfg: ModelConfig, params=None, *,
                  mesh_spec: Optional[MeshSpec] = None,
                  max_seq: Optional[int] = None,
-                 seed: int = 0):
+                 seed: int = 0,
+                 pipeline_microbatches: Optional[int] = None):
         self.mesh_spec = mesh_spec or MeshSpec()
+        self._n_micro = pipeline_microbatches
         validate_spec(self.mesh_spec, cfg)
         self.mesh = create_mesh(self.mesh_spec)
         # Pin the attention backend now that the program's device span is
@@ -101,12 +103,21 @@ class InferenceEngine:
 
     def _build_prefill(self, s0: int):
         cfg = self.cfg
-        # sp>1 routes prefill attention through the ring (parallel/ring.py)
+        # sp>1 routes prefill attention through the ring (parallel/ring.py);
+        # pp>1 routes the whole stack through the pipelined executor
         mesh = self.mesh if self.mesh_spec.sp > 1 else None
+        pp = self.mesh_spec.pp
 
         def fn(params, tokens, lengths, cache):
-            logits, cache = transformer.prefill(params, cfg, tokens, lengths,
-                                                cache, mesh=mesh)
+            if pp > 1:
+                from distributed_llm_inferencing_tpu.parallel import pipeline
+                logits, cache = pipeline.pipelined_prefill(
+                    params, cfg, tokens, lengths, cache, mesh=self.mesh,
+                    n_micro=pipeline.pick_n_micro(tokens.shape[0], pp,
+                                                  self._n_micro))
+            else:
+                logits, cache = transformer.prefill(
+                    params, cfg, tokens, lengths, cache, mesh=mesh)
             # gather last valid logit per sequence: [B,V]
             idx = jnp.maximum(lengths - 1, 0)
             last = jnp.take_along_axis(
@@ -130,12 +141,23 @@ class InferenceEngine:
         if fn is None:
             cfg = self.cfg
 
+            pp = self.mesh_spec.pp
+            mesh, n_micro_req = self.mesh, self._n_micro
+
             def raw(params, tokens, cache, key):
                 def step(carry, _):
                     cur, cache, key = carry
                     key, sub = jax.random.split(key)
-                    logits, cache = transformer.decode_step(
-                        params, cfg, cur[:, None], cache)
+                    if pp > 1:
+                        from distributed_llm_inferencing_tpu.parallel import (
+                            pipeline)
+                        logits, cache = pipeline.pipelined_decode_step(
+                            params, cfg, cur[:, None], cache, mesh=mesh,
+                            n_micro=pipeline.pick_n_micro(
+                                cur.shape[0], pp, n_micro_req))
+                    else:
+                        logits, cache = transformer.decode_step(
+                            params, cfg, cur[:, None], cache)
                     nxt = sample(logits[:, 0], sub, sp)
                     return (nxt, cache, key), nxt
 
