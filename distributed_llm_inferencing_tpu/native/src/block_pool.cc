@@ -1,0 +1,244 @@
+// Native runtime memory manager: paged KV-cache block pool + radix prefix
+// cache.
+//
+// The reference framework had no KV-cache management at all (its cache lived
+// inside HF ``model.generate()``, reference: worker/app.py:297-305); its only
+// native layer was vendored torch/CUDA kernels (SURVEY.md §2.5). In the
+// TPU-native rebuild the device-side compute is XLA/Pallas, and *this* is the
+// host-side native runtime: the allocator that decides which HBM cache blocks
+// each sequence owns, with ref-counted prefix sharing so identical prompt
+// prefixes reuse blocks instead of recomputing them.
+//
+// Design:
+//  - BlockPool: fixed pool of `num_blocks` block ids, free-list allocation,
+//    per-block refcount (shared prefix blocks have refcount > 1).
+//  - RadixCache: a radix tree over token ids at block granularity. Each edge
+//    holds exactly `block_size` tokens and maps to one block id. `match`
+//    returns the longest cached prefix (in whole blocks) and bumps refcounts;
+//    `insert` records freshly prefilled blocks.
+//  - Eviction: refcount-0 *leaves* are indexed in an ordered evictable set
+//    keyed by (last_use, block), so LRU eviction under memory pressure is
+//    O(log n) per block instead of a full-tree walk on the serving hot path.
+//
+// Exposed as a C ABI (extern "C") consumed via ctypes from
+// distributed_llm_inferencing_tpu/native/__init__.py — no pybind11 in this
+// image, and a C ABI keeps the boundary minimal.
+
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <memory>
+#include <set>
+#include <utility>
+#include <vector>
+
+namespace {
+
+struct RadixNode {
+  // Edge from parent: `tokens` (exactly block_size of them) -> this node.
+  std::vector<int32_t> tokens;
+  int32_t block = -1;  // block id holding this edge's KV
+  RadixNode* parent = nullptr;
+  std::map<std::vector<int32_t>, std::unique_ptr<RadixNode>> children;
+  uint64_t last_use = 0;
+  bool in_evictable = false;
+
+  bool is_leaf() const { return children.empty(); }
+};
+
+struct Pool {
+  int32_t num_blocks = 0;
+  int32_t block_size = 0;
+  std::vector<int32_t> refcount;   // per block
+  std::deque<int32_t> free_list;
+  // Radix prefix cache. Nodes own their children; root owns everything.
+  RadixNode root;
+  // block id -> node (for blocks registered in the radix tree)
+  std::vector<RadixNode*> block_node;
+  // refcount-0 leaves, LRU-ordered: (last_use, block) -> node
+  std::set<std::pair<uint64_t, int32_t>> evictable;
+  uint64_t clock = 0;
+  // stats
+  int64_t hits = 0, misses = 0, evictions = 0;
+
+  explicit Pool(int32_t n, int32_t bs) : num_blocks(n), block_size(bs) {
+    refcount.assign(n, 0);
+    block_node.assign(n, nullptr);
+    for (int32_t i = 0; i < n; ++i) free_list.push_back(i);
+  }
+
+  int32_t free_count() const { return (int32_t)free_list.size(); }
+
+  void evictable_add(RadixNode* n) {
+    if (!n->in_evictable && n != &root && n->is_leaf() && n->block >= 0 &&
+        refcount[n->block] == 0) {
+      evictable.insert({n->last_use, n->block});
+      n->in_evictable = true;
+    }
+  }
+
+  void evictable_remove(RadixNode* n) {
+    if (n->in_evictable) {
+      evictable.erase({n->last_use, n->block});
+      n->in_evictable = false;
+    }
+  }
+
+  void touch(RadixNode* n) {
+    // Refresh last_use, repositioning in the evictable index if present.
+    bool was = n->in_evictable;
+    if (was) evictable_remove(n);
+    n->last_use = clock;
+    if (was) evictable_add(n);
+  }
+
+  // Evict the LRU refcount-0 leaf, returning its block to the free list.
+  bool evict_one() {
+    if (evictable.empty()) return false;
+    auto it = evictable.begin();
+    RadixNode* victim = block_node[it->second];
+    evictable.erase(it);
+    victim->in_evictable = false;
+    free_list.push_back(victim->block);
+    block_node[victim->block] = nullptr;
+    ++evictions;
+    RadixNode* parent = victim->parent;
+    parent->children.erase(victim->tokens);
+    evictable_add(parent);  // parent may now be an evictable leaf
+    return true;
+  }
+
+  // Allocate n fresh blocks (refcount 1). Returns false if impossible even
+  // after eviction.
+  bool alloc(int32_t n, int32_t* out) {
+    while (free_count() < n) {
+      if (!evict_one()) return false;
+    }
+    for (int32_t i = 0; i < n; ++i) {
+      int32_t b = free_list.front();
+      free_list.pop_front();
+      refcount[b] = 1;
+      out[i] = b;
+    }
+    return true;
+  }
+
+  void ref(int32_t block) {
+    ++refcount[block];
+    if (block_node[block]) evictable_remove(block_node[block]);
+  }
+
+  void unref(int32_t block) {
+    if (refcount[block] > 0 && --refcount[block] == 0) {
+      // Blocks outside the prefix cache free immediately; cached blocks stay
+      // resident (evictable) until the pool needs them.
+      if (block_node[block] == nullptr) {
+        free_list.push_back(block);
+      } else {
+        evictable_add(block_node[block]);
+      }
+    }
+  }
+
+  // Longest-prefix match over whole blocks. tokens has len entries; writes
+  // up to len/block_size matched block ids; returns the number matched.
+  // Matched blocks get a refcount bump (caller owns one reference each).
+  int32_t match(const int32_t* tokens, int32_t len, int32_t* out_blocks) {
+    int32_t n_full = len / block_size;
+    RadixNode* cur = &root;
+    int32_t matched = 0;
+    ++clock;
+    for (int32_t i = 0; i < n_full; ++i) {
+      std::vector<int32_t> key(tokens + i * block_size,
+                               tokens + (i + 1) * block_size);
+      auto it = cur->children.find(key);
+      if (it == cur->children.end()) break;
+      cur = it->second.get();
+      touch(cur);
+      out_blocks[matched++] = cur->block;
+      ref(cur->block);
+    }
+    if (matched) ++hits; else ++misses;
+    return matched;
+  }
+
+  // Register freshly-filled blocks for this token prefix (the prefix
+  // INCLUDING any blocks already matched). skip = number of leading blocks
+  // already present in the tree; blocks[] holds len/block_size - skip ids.
+  void insert(const int32_t* tokens, int32_t len, const int32_t* blocks,
+              int32_t skip) {
+    int32_t n_full = len / block_size;
+    RadixNode* cur = &root;
+    ++clock;
+    for (int32_t i = 0; i < n_full; ++i) {
+      std::vector<int32_t> key(tokens + i * block_size,
+                               tokens + (i + 1) * block_size);
+      auto it = cur->children.find(key);
+      if (it != cur->children.end()) {
+        cur = it->second.get();
+        touch(cur);
+        continue;
+      }
+      if (i < skip) break;  // inconsistent skip; bail safely
+      auto node = std::make_unique<RadixNode>();
+      node->tokens = key;
+      node->block = blocks[i - skip];
+      node->parent = cur;
+      node->last_use = clock;
+      block_node[node->block] = node.get();
+      evictable_remove(cur);  // cur gains a child: no longer an evictable leaf
+      RadixNode* raw = node.get();
+      cur->children[key] = std::move(node);
+      cur = raw;
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* dli_pool_create(int32_t num_blocks, int32_t block_size) {
+  return new Pool(num_blocks, block_size);
+}
+
+void dli_pool_destroy(void* p) { delete static_cast<Pool*>(p); }
+
+int32_t dli_pool_free_count(void* p) {
+  return static_cast<Pool*>(p)->free_count();
+}
+
+int32_t dli_pool_alloc(void* p, int32_t n, int32_t* out) {
+  return static_cast<Pool*>(p)->alloc(n, out) ? 1 : 0;
+}
+
+void dli_pool_ref(void* p, int32_t block) { static_cast<Pool*>(p)->ref(block); }
+
+void dli_pool_unref(void* p, const int32_t* blocks, int32_t n) {
+  Pool* pool = static_cast<Pool*>(p);
+  for (int32_t i = 0; i < n; ++i) pool->unref(blocks[i]);
+}
+
+int32_t dli_pool_match(void* p, const int32_t* tokens, int32_t len,
+                       int32_t* out_blocks) {
+  return static_cast<Pool*>(p)->match(tokens, len, out_blocks);
+}
+
+void dli_pool_insert(void* p, const int32_t* tokens, int32_t len,
+                     const int32_t* blocks, int32_t skip) {
+  static_cast<Pool*>(p)->insert(tokens, len, blocks, skip);
+}
+
+void dli_pool_stats(void* p, int64_t* out3) {
+  Pool* pool = static_cast<Pool*>(p);
+  out3[0] = pool->hits;
+  out3[1] = pool->misses;
+  out3[2] = pool->evictions;
+}
+
+int32_t dli_pool_refcount(void* p, int32_t block) {
+  return static_cast<Pool*>(p)->refcount[block];
+}
+
+}  // extern "C"

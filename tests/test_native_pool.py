@@ -1,0 +1,164 @@
+"""Native block pool + radix prefix cache (native/src/block_pool.cc).
+
+Covers what the continuous batcher relies on: alloc/free accounting,
+ref-counted prefix sharing, LRU eviction of unreferenced cached blocks, and
+C++ ≡ Python-fallback semantics (differential test with random ops).
+"""
+
+import random
+
+import pytest
+
+from distributed_llm_inferencing_tpu.native import BlockPool
+
+
+@pytest.fixture(params=["native", "python"])
+def pool_kind(request):
+    return request.param
+
+
+def make_pool(kind, num_blocks=16, block_size=4):
+    p = BlockPool(num_blocks, block_size, force_python=(kind == "python"))
+    if kind == "native" and not p.is_native:
+        pytest.skip("g++ unavailable; native path not built")
+    return p
+
+
+def test_alloc_free_roundtrip(pool_kind):
+    p = make_pool(pool_kind)
+    assert p.free_count() == 16
+    a = p.alloc(5)
+    assert len(a) == 5 and len(set(a)) == 5
+    assert p.free_count() == 11
+    p.release(a)
+    assert p.free_count() == 16
+
+
+def test_alloc_exhaustion(pool_kind):
+    p = make_pool(pool_kind, num_blocks=4)
+    a = p.alloc(4)
+    assert a is not None
+    assert p.alloc(1) is None     # nothing evictable: all blocks referenced
+    p.release(a[:1])
+    assert p.alloc(1) is not None
+
+
+def test_prefix_match_and_share(pool_kind):
+    p = make_pool(pool_kind, num_blocks=16, block_size=4)
+    tokens = list(range(12))          # 3 full blocks
+    blocks, n = p.match_prefix(tokens)
+    assert blocks == [] and n == 0
+    fresh = p.alloc(3)
+    p.insert_prefix(tokens, fresh, skip=0)
+
+    # same prompt again: full hit, refcount bumped
+    blocks2, n2 = p.match_prefix(tokens)
+    assert blocks2 == fresh and n2 == 12
+    assert p.refcount(fresh[0]) == 2
+
+    # longer prompt sharing the first 2 blocks
+    longer = tokens[:8] + [99, 98, 97, 96]
+    blocks3, n3 = p.match_prefix(longer)
+    assert blocks3 == fresh[:2] and n3 == 8
+    tail = p.alloc(1)
+    p.insert_prefix(longer, tail, skip=2)
+    blocks4, n4 = p.match_prefix(longer)
+    assert blocks4 == fresh[:2] + tail and n4 == 12
+    p.release(blocks2 + blocks3 + blocks4 + fresh + tail)
+
+
+def test_eviction_lru(pool_kind):
+    p = make_pool(pool_kind, num_blocks=4, block_size=2)
+    a = p.alloc(2)
+    p.insert_prefix([1, 2, 3, 4], a, skip=0)
+    b = p.alloc(2)
+    p.insert_prefix([9, 9, 8, 8], b, skip=0)
+    # both sequences released: all 4 blocks cached, refcount 0
+    p.release(a)
+    p.release(b)
+    assert p.free_count() == 0
+    # touch prefix A so B becomes LRU, then release so BOTH chains are
+    # refcount-0 evictable and only recency picks the victim
+    got, _ = p.match_prefix([1, 2, 3, 4])
+    assert got == a
+    p.release(got)
+    # allocating 2 must evict B's leaf then its parent (LRU), not A's
+    c = p.alloc(2)
+    assert c is not None and set(c) == set(b)
+    # A's chain must still be matchable
+    got2, n = p.match_prefix([1, 2, 3, 4])
+    assert got2 == a and n == 4
+    assert p.stats()["evictions"] >= 2
+
+
+def test_cached_block_not_freed_while_referenced(pool_kind):
+    p = make_pool(pool_kind, num_blocks=2, block_size=2)
+    a = p.alloc(2)
+    p.insert_prefix([5, 6, 7, 8], a, skip=0)
+    # a second sequence shares the prefix
+    shared, n = p.match_prefix([5, 6, 7, 8])
+    assert shared == a and n == 4
+    p.release(a)            # first sequence done; second still holds refs
+    assert p.alloc(1) is None   # nothing evictable
+    p.release(shared)
+    assert p.alloc(1) is not None
+
+
+def test_insert_validation(pool_kind):
+    p = make_pool(pool_kind, num_blocks=8, block_size=4)
+    with pytest.raises(ValueError):
+        p.insert_prefix(list(range(8)), [], skip=0)   # needs 2 blocks
+    with pytest.raises(ValueError):
+        p.release([-1])
+    with pytest.raises(ValueError):
+        p.release([8])
+    # sub-block prefix: no full blocks to insert — a silent no-op
+    p.insert_prefix([1, 2, 3], [], skip=0)
+    assert p.free_count() == 8
+
+
+def test_differential_native_vs_python():
+    """Random op sequence must behave identically in C++ and Python."""
+    native = BlockPool(32, 4)
+    if not native.is_native:
+        pytest.skip("g++ unavailable")
+    py = BlockPool(32, 4, force_python=True)
+    rng = random.Random(0)
+    held = []   # parallel lists of (native_blocks, py_blocks)
+
+    for step in range(300):
+        op = rng.choice(["alloc", "release", "match", "insert"])
+        if op == "alloc":
+            n = rng.randint(1, 4)
+            a, b = native.alloc(n), py.alloc(n)
+            assert (a is None) == (b is None), f"step {step}"
+            if a is not None:
+                held.append((a, b, None))
+        elif op == "release" and held:
+            a, b, _ = held.pop(rng.randrange(len(held)))
+            native.release(a)
+            py.release(b)
+        elif op == "match":
+            toks = [rng.randint(0, 3) for _ in range(rng.randint(0, 16))]
+            (na, nn), (pa, pn) = native.match_prefix(toks), py.match_prefix(toks)
+            assert nn == pn, f"step {step}: match len {nn} != {pn}"
+            if na:
+                held.append((na, pa, None))
+        elif op == "insert":
+            toks = [rng.randint(0, 3) for _ in range(rng.randint(4, 16))]
+            (ma, mn), (mb, _) = native.match_prefix(toks), py.match_prefix(toks)
+            need = len(toks) // 4 - len(ma)
+            fa, fb = native.alloc(need), py.alloc(need)
+            assert (fa is None) == (fb is None)
+            if fa is not None:
+                native.insert_prefix(toks, fa, skip=len(ma))
+                py.insert_prefix(toks, fb, skip=len(mb))
+                held.append((ma + fa, mb + fb, None))
+            else:
+                native.release(ma)
+                py.release(mb)
+        assert native.free_count() == py.free_count(), f"step {step}"
+
+    s_n, s_p = native.stats(), py.stats()
+    assert s_n["prefix_hits"] == s_p["prefix_hits"]
+    assert s_n["evictions"] == s_p["evictions"]
