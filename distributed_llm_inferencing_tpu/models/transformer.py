@@ -126,19 +126,16 @@ def unembed(params, cfg: ModelConfig, x):
     return logits.astype(jnp.float32)
 
 
-def _block(x, lp, cache_k, cache_v, *, cfg: ModelConfig, q_positions,
-           write_starts, new_lengths, is_prefill, backend, mesh=None):
-    """One transformer block with cache read/update.
+def _block_body(x, lp, cfg: ModelConfig, q_positions, attend_write):
+    """One transformer block: norm → QKV (+RoPE) → attend → norm → MLP/MoE.
 
-    x: [B,s,D]; cache_k/v: [B,S,Hkv,hd] (this layer's slice);
-    write_starts: [B] int32 slot where this token block begins, per sequence.
-    Returns (x_out, new_cache_k, new_cache_v).
-
-    Two attention regimes (ops/attention.py): prefill attends the fresh
-    K/V block directly — O(s^2) instead of O(s * max_seq) over the mostly
-    empty cache — while decode attends the cache.
+    The single definition of the block structure, shared by the dense path
+    (_block) and the paged serving paths (paged_decode_step /
+    paged_prefill_tail) so the three can never diverge. ``attend_write(q,
+    k, v) -> (attn [B,s,H,hd], cache_out)`` owns the regime-specific part:
+    cache update + attention formulation.
     """
-    B, s, D = x.shape
+    B, s, _ = x.shape
     h = norm(x, lp["attn_norm"], cfg.norm_type, cfg.norm_eps)
     q = _linear(h, lp["q"]).reshape(B, s, cfg.num_heads, cfg.head_dim)
     k = _linear(h, lp["k"]).reshape(B, s, cfg.num_kv_heads, cfg.head_dim)
@@ -148,31 +145,50 @@ def _block(x, lp, cache_k, cache_v, *, cfg: ModelConfig, q_positions,
         q = apply_rope(q, q_positions, cfg.rope_theta)
         k = apply_rope(k, q_positions, cfg.rope_theta)
 
-    cache_k = write_block(cache_k, k, write_starts)
-    cache_v = write_block(cache_v, v, write_starts)
-
-    if is_prefill and mesh is not None and mesh.shape.get("sp", 1) > 1:
-        # sequence-parallel long-context path: ring attention over sp
-        # (parallel/ring.py) — K/V chunks rotate via ppermute, no device
-        # ever holds the full sequence
-        from distributed_llm_inferencing_tpu.parallel.ring import (
-            ring_attend_prefill)
-        attn = ring_attend_prefill(
-            q, k, v, q_positions, new_lengths, mesh=mesh,
-            sliding_window=cfg.sliding_window)
-    elif is_prefill:
-        attn = attend_prefill(q, k, v, sliding_window=cfg.sliding_window,
-                              backend=backend)
-    else:
-        attn = attend_decode(q, cache_k, cache_v, new_lengths,
-                             sliding_window=cfg.sliding_window,
-                             backend=backend)
+    attn, cache_out = attend_write(q, k, v)
     attn = _linear(attn.reshape(B, s, cfg.num_heads * cfg.head_dim), lp["o"])
     x = x + attn
 
     h = norm(x, lp["mlp_norm"], cfg.norm_type, cfg.norm_eps)
     moe_out = _moe(h, lp, cfg) if cfg.is_moe else _mlp(h, lp, cfg)
-    return x + moe_out, cache_k, cache_v
+    return x + moe_out, cache_out
+
+
+def _block(x, lp, cache_k, cache_v, *, cfg: ModelConfig, q_positions,
+           write_starts, new_lengths, is_prefill, backend, mesh=None):
+    """One transformer block over the dense cache.
+
+    x: [B,s,D]; cache_k/v: [B,S,Hkv,hd] (this layer's slice);
+    write_starts: [B] int32 slot where this token block begins, per sequence.
+    Returns (x_out, new_cache_k, new_cache_v).
+
+    Two attention regimes (ops/attention.py): prefill attends the fresh
+    K/V block directly — O(s^2) instead of O(s * max_seq) over the mostly
+    empty cache — while decode attends the cache.
+    """
+    def attend_write(q, k, v):
+        ck = write_block(cache_k, k, write_starts)
+        cv = write_block(cache_v, v, write_starts)
+        if is_prefill and mesh is not None and mesh.shape.get("sp", 1) > 1:
+            # sequence-parallel long-context path: ring attention over sp
+            # (parallel/ring.py) — K/V chunks rotate via ppermute, no device
+            # ever holds the full sequence
+            from distributed_llm_inferencing_tpu.parallel.ring import (
+                ring_attend_prefill)
+            attn = ring_attend_prefill(
+                q, k, v, q_positions, new_lengths, mesh=mesh,
+                sliding_window=cfg.sliding_window)
+        elif is_prefill:
+            attn = attend_prefill(q, k, v, sliding_window=cfg.sliding_window,
+                                  backend=backend)
+        else:
+            attn = attend_decode(q, ck, cv, new_lengths,
+                                 sliding_window=cfg.sliding_window,
+                                 backend=backend)
+        return attn, (ck, cv)
+
+    x, (ck, cv) = _block_body(x, lp, cfg, q_positions, attend_write)
+    return x, ck, cv
 
 
 def forward(
@@ -249,3 +265,94 @@ def decode_step(params, cfg: ModelConfig, tokens, cache: KVCache):
     return forward(params, cfg, tokens, cache,
                    write_starts=cache.lengths, q_positions=q_pos,
                    new_lengths=cache.lengths + 1)
+
+
+# ----------------------------------------------------------------------
+# Paged-cache forward passes (continuous-batching serving path)
+# ----------------------------------------------------------------------
+
+def paged_decode_step(params, cfg: ModelConfig, tokens, paged,
+                      block_tables, context_lens):
+    """One decode step over the paged cache for R serving slots.
+
+    tokens: [R] next token per slot; paged: ops.paged_kvcache.PagedKVCache;
+    block_tables: [R, MB] int32; context_lens: [R] — cached tokens per slot
+    BEFORE this step (the new token writes at that position).
+
+    Inactive slots must point at a reserved dummy block with context_len 0
+    (the batcher guarantees this); their writes land in the dummy block and
+    their outputs are discarded. Returns (logits [R, V] f32, new paged).
+    """
+    from distributed_llm_inferencing_tpu.ops.paged_kvcache import (
+        PagedKVCache, paged_attend_decode, write_token)
+    r = tokens.shape[0]
+    backend = resolve_backend(cfg.attn_backend, jax.device_count())
+    q_pos = context_lens[:, None]                       # [R, 1]
+    x = embed(params, cfg, tokens[:, None], q_pos)      # [R, 1, D]
+
+    def body(x, layer_in):
+        lp, ck, cv = layer_in                           # ck: [NB, bs, Hkv, hd]
+
+        def attend_write(q, k, v):
+            nk = write_token(ck, k[:, 0], block_tables, context_lens)
+            nv = write_token(cv, v[:, 0], block_tables, context_lens)
+            attn = paged_attend_decode(
+                q, nk, nv, block_tables, context_lens + 1,
+                sliding_window=cfg.sliding_window, backend=backend)
+            return attn, (nk, nv)
+
+        return _block_body(x, lp, cfg, q_pos, attend_write)
+
+    x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], paged.k,
+                                               paged.v))
+    logits = unembed(params, cfg, x)[:, 0]              # [R, V]
+    return logits, PagedKVCache(k=new_k, v=new_v)
+
+
+def paged_prefill_tail(params, cfg: ModelConfig, tokens, tail_len,
+                       tail_blocks, prefix_blocks, prefix_len, paged):
+    """Prefill a prompt tail into paged blocks, attending a cached prefix.
+
+    The prefix (``prefix_len`` tokens in ``prefix_blocks``, a radix-cache
+    hit) is NOT recomputed — its K/V is gathered from shared blocks per
+    layer. Fresh tail K/V is scattered into ``tail_blocks``.
+
+    tokens: [1, T] right-padded tail (T a multiple of block_size);
+    tail_len: [1] real tail tokens; tail_blocks: [T // bs] int32;
+    prefix_blocks: [1, PB] (dummy-padded); prefix_len: [1].
+    Returns (last-token logits [1, V] f32, new paged).
+    """
+    from distributed_llm_inferencing_tpu.ops.paged_kvcache import (
+        PagedKVCache, paged_attend_prefix, write_block_run)
+    b, t = tokens.shape
+    if b != 1:
+        raise ValueError(
+            f"paged_prefill_tail admits one sequence at a time, got batch {b} "
+            "(tail_blocks is unbatched; the batcher serializes admissions)")
+    q_pos = prefix_len[:, None] + jnp.broadcast_to(
+        jnp.arange(t, dtype=jnp.int32), (b, t))
+    tail_valid = jnp.arange(t, dtype=jnp.int32)[None, :] < tail_len[:, None]
+    x = embed(params, cfg, tokens, q_pos)
+
+    def body(x, layer_in):
+        lp, ck, cv = layer_in
+
+        def attend_write(q, k, v):
+            nk = write_block_run(ck, k[0], tail_blocks)
+            nv = write_block_run(cv, v[0], tail_blocks)
+            attn = paged_attend_prefix(
+                q, k, v, nk, nv, prefix_blocks, prefix_len, q_pos, tail_valid,
+                sliding_window=cfg.sliding_window)
+            return attn, (nk, nv)
+
+        return _block_body(x, lp, cfg, q_pos, attend_write)
+
+    x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], paged.k,
+                                               paged.v))
+    # project only the last real position through the vocab head ([D,V] over
+    # one row, not T padded rows)
+    last_x = jnp.take_along_axis(
+        x, jnp.maximum(tail_len - 1, 0)[:, None, None].astype(jnp.int32),
+        axis=1)                                         # [1, 1, D]
+    last = unembed(params, cfg, last_x)[:, 0]           # [1, V]
+    return last, PagedKVCache(k=new_k, v=new_v)

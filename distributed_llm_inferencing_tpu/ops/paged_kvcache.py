@@ -1,0 +1,154 @@
+"""Paged KV cache: block-pooled cache buffers + paged attention.
+
+The dense cache (ops/kvcache.py) gives every sequence a full ``max_seq``
+stripe of HBM — fine for one-shot ``engine.generate`` batches, wasteful for
+a serving pool where sequences have wildly different lengths and shared
+prompt prefixes. The paged cache is the TPU-native analogue of
+vLLM/PagedAttention:
+
+- ``k``/``v``: [L, NB, bs, Hkv, hd] — a pool of NB fixed-size blocks per
+  layer. Which blocks a sequence owns is *host-side* state, managed by the
+  native C++ allocator (native/src/block_pool.cc) with ref-counted radix
+  prefix sharing.
+- ``block_tables``: [R, MB] int32 — per serving *slot*, the block ids
+  covering its sequence, in order. Slot count R and max-blocks MB are
+  static; XLA sees only fixed shapes.
+- ``context_lens``: [R] int32 — tokens currently cached per slot. The
+  invariant is position p of a slot's sequence lives in
+  ``block_tables[r, p // bs]`` at offset ``p % bs``.
+
+Attention over the paged cache gathers each slot's blocks back into a
+contiguous [R, MB*bs, ...] view (XLA gather rides HBM at full bandwidth;
+a hand-tiled Pallas variant that skips the materialization is
+ops/pallas/paged_attention.py).
+
+The reference framework has no counterpart at any level — its KV cache was
+implicit inside HF ``generate`` (SURVEY.md §2.4).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from distributed_llm_inferencing_tpu.models.config import ModelConfig
+from distributed_llm_inferencing_tpu.ops.attention import attend
+
+
+class PagedKVCache(NamedTuple):
+    k: jax.Array   # [L, NB, bs, Hkv, hd]
+    v: jax.Array   # [L, NB, bs, Hkv, hd]
+
+    @property
+    def num_blocks(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def block_size(self) -> int:
+        return self.k.shape[2]
+
+
+def init_paged_cache(cfg: ModelConfig, num_blocks: int, block_size: int,
+                     dtype=None) -> PagedKVCache:
+    dtype = dtype or jnp.dtype(cfg.dtype)
+    shape = (cfg.num_layers, num_blocks, block_size, cfg.num_kv_heads,
+             cfg.head_dim)
+    return PagedKVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+
+def write_token(cache_layer, new, block_tables, positions):
+    """Scatter one new token per slot into a layer's block pool.
+
+    cache_layer: [NB, bs, Hkv, hd]; new: [R, Hkv, hd];
+    block_tables: [R, MB]; positions: [R] — the position being written.
+    """
+    bs = cache_layer.shape[1]
+    blk = jnp.take_along_axis(
+        block_tables, (positions // bs)[:, None], axis=1)[:, 0]   # [R]
+    off = positions % bs
+    return cache_layer.at[blk, off].set(new.astype(cache_layer.dtype))
+
+
+def write_block_run(cache_layer, new_blocks, block_ids):
+    """Scatter a run of whole blocks (a prefilled tail) into the pool.
+
+    cache_layer: [NB, bs, Hkv, hd]; new_blocks: [T, Hkv, hd] with T a
+    multiple of bs; block_ids: [T // bs].
+    """
+    bs = cache_layer.shape[1]
+    t = new_blocks.shape[0]
+    reshaped = new_blocks.reshape(t // bs, bs, *new_blocks.shape[1:])
+    return cache_layer.at[block_ids].set(reshaped.astype(cache_layer.dtype))
+
+
+def gather_seq(cache_layer, block_tables):
+    """[NB, bs, Hkv, hd] + [R, MB] -> contiguous [R, MB*bs, Hkv, hd]."""
+    g = cache_layer[block_tables]            # [R, MB, bs, Hkv, hd]
+    r, mb, bs = g.shape[0], g.shape[1], g.shape[2]
+    return g.reshape(r, mb * bs, *g.shape[3:])
+
+
+def paged_attend_decode(q, cache_k_layer, cache_v_layer, block_tables,
+                        context_lens,
+                        sliding_window: Optional[int] = None,
+                        backend: str = "xla"):
+    """Single-token attention over the paged cache.
+
+    q: [R, 1, H, hd]; context_lens: [R] — filled slots INCLUDING the token
+    just written (the query sits at context_lens - 1).
+
+    backend "pallas" routes to the block-table-driven kernel
+    (ops/pallas/paged_attention.py) which skips the gather
+    materialization below.
+    """
+    if backend.startswith("pallas"):
+        from distributed_llm_inferencing_tpu.ops.pallas.paged_attention import (
+            paged_flash_decode)
+        return paged_flash_decode(
+            q, cache_k_layer, cache_v_layer, block_tables, context_lens,
+            sliding_window=sliding_window,
+            interpret=(backend == "pallas_interpret"))
+    r, mb = block_tables.shape
+    bs = cache_k_layer.shape[1]
+    k = gather_seq(cache_k_layer, block_tables)
+    v = gather_seq(cache_v_layer, block_tables)
+    kv_pos = jnp.broadcast_to(jnp.arange(mb * bs, dtype=jnp.int32),
+                              (r, mb * bs))
+    kv_valid = kv_pos < context_lens[:, None]
+    q_pos = (context_lens - 1)[:, None]
+    return attend(q, k, v, q_pos, kv_pos, kv_valid,
+                  sliding_window=sliding_window)
+
+
+def paged_attend_prefix(q, k_new, v_new, cache_k_layer, cache_v_layer,
+                        prefix_blocks, prefix_len, q_positions, tail_valid,
+                        sliding_window: Optional[int] = None):
+    """Tail-prefill attention: fresh tail K/V plus a cached prefix.
+
+    This is what makes prefix-cache hits save *compute*, not just memory:
+    the tail's queries attend the prefix KV gathered straight from shared
+    cache blocks — the prefix is never re-run through the model.
+
+    q, k_new, v_new: [B, T, ...] fresh tail projections (B=1 per admission);
+    prefix_blocks: [B, PB] block ids covering the cached prefix (dummy-padded);
+    prefix_len: [B] — real cached tokens (<= PB*bs);
+    q_positions: [B, T] — absolute positions of tail tokens (prefix_len + i);
+    tail_valid: [B, T] — tail rows that hold real tokens.
+    """
+    b, t = q.shape[0], q.shape[1]
+    bs = cache_k_layer.shape[1]
+    pb = prefix_blocks.shape[1]
+    kp = gather_seq(cache_k_layer, prefix_blocks)   # [B, PB*bs, Hkv, hd]
+    vp = gather_seq(cache_v_layer, prefix_blocks)
+    p = pb * bs
+    prefix_pos = jnp.broadcast_to(jnp.arange(p, dtype=jnp.int32), (b, p))
+    prefix_valid = prefix_pos < prefix_len[:, None]
+
+    k_all = jnp.concatenate([kp, k_new.astype(kp.dtype)], axis=1)
+    v_all = jnp.concatenate([vp, v_new.astype(vp.dtype)], axis=1)
+    kv_pos = jnp.concatenate([prefix_pos, q_positions], axis=1)
+    kv_valid = jnp.concatenate([prefix_valid, tail_valid], axis=1)
+    return attend(q, k_all, v_all, q_positions, kv_pos, kv_valid,
+                  sliding_window=sliding_window)

@@ -1,0 +1,140 @@
+"""Pallas TPU kernel: paged-attention decode.
+
+The XLA formulation of paged decode (ops/paged_kvcache.py
+``paged_attend_decode``) first gathers every slot's blocks into a contiguous
+[R, MB*bs, Hkv, hd] buffer — an extra HBM round trip of the whole working
+set per layer per step. This kernel skips the materialization: the grid
+walks (slot, kv-head, block-table entry) and the *scalar-prefetched* block
+table drives the BlockSpec index map, so each step DMAs its [bs, hd] K/V
+tile straight from the block pool at the right address. Online softmax
+accumulates across a slot's blocks in VMEM scratch, exactly like
+flash_decode (ops/pallas/flash_attention.py); blocks past the slot's
+context length skip their FLOPs.
+
+No reference counterpart at any level — the reference's attention lived
+inside vendored torch kernels behind HF ``generate`` (SURVEY.md §2.5).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _paged_decode_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                         m_scr, l_scr, acc_scr, *, block_size: int,
+                         scale: float, sliding_window: Optional[int]):
+    j = pl.program_id(2)
+    n_blocks = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    r = pl.program_id(0)
+    length = len_ref[r]                 # valid kv positions: [0, length)
+    kv_start = j * block_size
+
+    # Block-table entries past the sequence skip their FLOPs. (Their DMA
+    # still happens — the static grid is the price of one compiled program
+    # for every slot mix; MB*bs tracks the longest active sequence.)
+    @pl.when(kv_start < length)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)             # [G, hd]
+        k = k_ref[0, 0].astype(jnp.float32)             # [bs, hd]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [G, bs]
+
+        g = q.shape[0]
+        kv_pos = kv_start + jax.lax.broadcasted_iota(
+            jnp.int32, (g, block_size), 1)
+        mask = kv_pos < length          # causal: query sits at length - 1
+        if sliding_window is not None:
+            mask &= ((length - 1) - kv_pos) < sliding_window
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_scr[:, :1] = l_scr[:, :1] * alpha + jnp.sum(p, axis=-1,
+                                                      keepdims=True)
+        v = v_ref[0, 0].astype(jnp.float32)             # [bs, hd]
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_scr[:] = acc_scr[:] * alpha + pv
+        m_scr[:, :1] = m_new
+
+    @pl.when(j == n_blocks - 1)
+    def _finish():
+        l = l_scr[:, :1]
+        o_ref[0, 0] = jnp.where(
+            l > 0, acc_scr[:] / jnp.where(l > 0, l, 1.0), 0.0
+        ).astype(o_ref.dtype)
+
+
+def paged_flash_decode(
+    q,                    # [R, 1, H, hd] — one query token per slot
+    k_pool,               # [NB, bs, Hkv, hd] — one layer's block pool
+    v_pool,               # [NB, bs, Hkv, hd]
+    block_tables,         # [R, MB] int32 — pool block ids per slot
+    context_lens,         # [R] int32 — fill AFTER this token's write
+    *,
+    sliding_window: Optional[int] = None,
+    interpret: bool = False,
+):
+    """Paged single-token attention without gather materialization."""
+    r, one, h, hd = q.shape
+    assert one == 1, "paged_flash_decode takes exactly one query token"
+    nb, bs, hkv, _ = k_pool.shape
+    g = h // hkv
+    mb = block_tables.shape[1]
+    scale = float(1.0 / (hd ** 0.5))
+
+    qt = q.reshape(r, h, hd).reshape(r, hkv, g, hd)
+    kt = jnp.transpose(k_pool, (0, 2, 1, 3))   # [NB, Hkv, bs, hd]
+    vt = jnp.transpose(v_pool, (0, 2, 1, 3))
+
+    kernel = functools.partial(
+        _paged_decode_kernel, block_size=bs, scale=scale,
+        sliding_window=sliding_window)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,          # block_tables, context_lens
+        grid=(r, hkv, mb),
+        in_specs=[
+            pl.BlockSpec((1, 1, g, hd),
+                         lambda ri, hi, j, bt, lens: (ri, hi, 0, 0)),
+            pl.BlockSpec((1, 1, bs, hd),
+                         lambda ri, hi, j, bt, lens: (bt[ri, j], hi, 0, 0)),
+            pl.BlockSpec((1, 1, bs, hd),
+                         lambda ri, hi, j, bt, lens: (bt[ri, j], hi, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, g, hd),
+                               lambda ri, hi, j, bt, lens: (ri, hi, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((g, 128), jnp.float32),   # running max
+            pltpu.VMEM((g, 128), jnp.float32),   # running denominator
+            pltpu.VMEM((g, hd), jnp.float32),    # output accumulator
+        ],
+    )
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((r, hkv, g, hd), q.dtype),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), context_lens.astype(jnp.int32),
+      qt, kt, vt)
+    return out.reshape(r, h, hd)[:, None]
