@@ -89,3 +89,49 @@ def sample(logits, key, params: SamplingParams,
 
     logits = _mask_top_p(logits, params.top_p)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+def sample_batch(logits, seeds, steps, temps, top_ks, top_ps, do_sample):
+    """Per-row-parameterized sampling for the continuous batcher.
+
+    logits: [R, V]; seeds/steps: [R] int32 — each row draws from its OWN
+    PRNG stream ``fold_in(PRNGKey(seed), step)``, so a request's output is
+    a pure function of (params, prompt, seed), reproducible regardless of
+    what other requests share its decode steps or how admission/preemption
+    interleaves them. temps/top_ps: [R] f32; top_ks: [R] int32 (0
+    disables); do_sample: [R] bool (False -> greedy). Sampling parameters
+    are data, not trace constants — one compiled program covers any mix of
+    requests.
+
+    Exactness over the single-config fast path in ``sample``: one full-vocab
+    descending sort per step gives every row its exact k-th-largest and
+    nucleus thresholds. R is the (small, static) slot count, so the sort is
+    [R, V] — a few hundred microseconds, dwarfed by the model step.
+    """
+    logits = logits.astype(jnp.float32)
+    r, v = logits.shape
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]            # [R, V]
+    # top-k threshold: k-th largest value (k clamped into [1, V]; k<=0 -> V)
+    k = jnp.where(top_ks <= 0, v, jnp.clip(top_ks, 1, v))
+    kth = jnp.take_along_axis(sorted_desc, (k - 1)[:, None], axis=-1)
+    masked = jnp.where(scaled < kth, -jnp.inf, scaled)
+    # top-p on the post-top-k distribution (HF warper order), thresholds
+    # computed on the sorted view with the same top-k mask applied
+    sorted_masked = jnp.where(
+        jnp.arange(v)[None, :] < k[:, None], sorted_desc, -jnp.inf)
+    probs = jax.nn.softmax(sorted_masked, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = (cum - probs) < top_ps[:, None]                      # crossing token kept
+    num_keep = jnp.maximum(jnp.sum(keep, axis=-1, keepdims=True), 1)
+    thresh = jnp.take_along_axis(sorted_masked, num_keep - 1, axis=-1)
+    masked = jnp.where(masked < thresh, -jnp.inf, masked)
+
+    keys = jax.vmap(
+        lambda s, t: jax.random.fold_in(jax.random.PRNGKey(s), t)
+    )(seeds, steps)
+    sampled = jax.vmap(
+        lambda k, l: jax.random.categorical(k, l))(keys, masked)
+    greedy = jnp.argmax(logits, axis=-1)
+    return jnp.where(do_sample, sampled, greedy).astype(jnp.int32)

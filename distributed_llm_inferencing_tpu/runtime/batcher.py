@@ -1,0 +1,486 @@
+"""Continuous batching over the paged KV cache.
+
+The reference served one prompt per blocking HTTP request, fully serialized
+per worker (1 gunicorn sync worker, reference: worker/Dockerfile:47,
+worker/app.py:252-330). The engine (runtime/engine.py) batches only within
+one ``generate`` call. This scheduler is the serving-native upgrade: a
+fixed pool of decode *slots* advances every active request one token per
+jitted step, admitting queued requests into freed slots mid-flight —
+in-flight batching, so short and long generations share the chip without
+head-of-line blocking.
+
+Memory is paged (ops/paged_kvcache.py): which HBM blocks each sequence
+owns is decided host-side by the native C++ allocator
+(native/src/block_pool.cc), whose radix tree lets requests with a shared
+prompt prefix reuse already-prefilled blocks — admission then prefills
+only the tail (models/transformer.py paged_prefill_tail). Under memory
+pressure the youngest slot is preempted back to the queue (its prefix
+stays warm in the radix cache, so the re-run is mostly a cache hit).
+
+Per-request sampling params ride the jitted decode step as data
+(ops/sampling.py sample_batch), so one compiled program serves any mix of
+greedy/temperature/top-k/top-p requests.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import time
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_llm_inferencing_tpu.models import transformer
+from distributed_llm_inferencing_tpu.models.config import ModelConfig
+from distributed_llm_inferencing_tpu.models.params import init_params
+from distributed_llm_inferencing_tpu.native import BlockPool
+from distributed_llm_inferencing_tpu.ops.paged_kvcache import init_paged_cache
+from distributed_llm_inferencing_tpu.ops.sampling import (
+    SamplingParams, sample_batch)
+
+TAIL_BUCKETS_X_BS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512)  # × block_size
+PREFIX_BUCKETS = (0, 1, 2, 4, 8, 16, 32, 64, 128, 256, 512)  # blocks
+
+
+@dataclasses.dataclass
+class BatchRequest:
+    """One queued/active generation. The handle the caller waits on."""
+    prompt: List[int]
+    max_new_tokens: int
+    sampling: SamplingParams
+    eos_token_id: Optional[int] = None
+    stream_cb: Optional[Callable[[int], None]] = None
+    seed: int = 0    # output is a pure fn of (params, prompt, seed)
+    # results
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    error: Optional[str] = None
+    done: threading.Event = dataclasses.field(default_factory=threading.Event)
+    # timing
+    submitted_at: float = dataclasses.field(default_factory=time.time)
+    first_token_at: Optional[float] = None
+    finished_at: Optional[float] = None
+    # internal scheduling state
+    _blocks: List[int] = dataclasses.field(default_factory=list)
+    _preemptions: int = 0
+    _cancelled: bool = False
+
+    def wait(self, timeout: Optional[float] = None) -> List[int]:
+        if not self.done.wait(timeout):
+            raise TimeoutError("generation still running")
+        if self.error:
+            raise RuntimeError(self.error)
+        return self.tokens
+
+    def cancel(self):
+        """Ask the scheduler to drop this request (frees its slot/blocks at
+        the next step; already-generated tokens are kept)."""
+        self._cancelled = True
+
+    @property
+    def ttft_ms(self) -> Optional[float]:
+        if self.first_token_at is None:
+            return None
+        return (self.first_token_at - self.submitted_at) * 1e3
+
+
+class ContinuousBatcher:
+    """Slot-based continuous batching scheduler (single-program; the model
+    itself may still be mesh-sharded by the caller's params placement).
+
+    Drive it either with an owned background thread (``start()``/``stop()``)
+    or synchronously via ``step()`` (tests, custom loops).
+    """
+
+    def __init__(self, cfg: ModelConfig, params=None, *,
+                 num_blocks: int = 512, block_size: int = 16,
+                 slots: int = 8, max_seq: Optional[int] = None,
+                 seed: int = 0, force_python_pool: bool = False):
+        self.cfg = cfg = cfg.replace(attn_backend=_backend(cfg))
+        self.block_size = block_size
+        self.slots = slots
+        self.max_seq = min(max_seq or cfg.max_position_embeddings,
+                           cfg.max_position_embeddings)
+        self.max_blocks = -(-self.max_seq // block_size)
+        if params is None:
+            params = init_params(cfg, jax.random.PRNGKey(seed))
+        self.params = params
+
+        # +1: block 0 is the reserved dummy every inactive table entry
+        # points at, so it never carries real KV
+        self.pool = BlockPool(num_blocks + 1, block_size,
+                              force_python=force_python_pool)
+        [self._dummy] = self.pool.alloc(1)
+        self.paged = init_paged_cache(cfg, num_blocks + 1, block_size)
+        self.block_tables = np.full((slots, self.max_blocks), self._dummy,
+                                    np.int32)
+        self.context_lens = np.zeros((slots,), np.int32)
+        self.active: List[Optional[BatchRequest]] = [None] * slots
+        self._admit_order: collections.deque = collections.deque()  # slot ids
+
+        self.queue: collections.deque = collections.deque()
+        self._lock = threading.Lock()
+        self._work = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._step_count = 0
+        self._tokens_out = 0
+
+        self._prefill_fns = {}
+        self._decode_fn = None
+        self._sample1 = None
+
+    # ---- public API ---------------------------------------------------
+
+    def submit(self, prompt: Sequence[int], max_new_tokens: int = 100,
+               sampling: Optional[SamplingParams] = None,
+               eos_token_id: Optional[int] = None,
+               stream_cb: Optional[Callable[[int], None]] = None,
+               seed: Optional[int] = None) -> BatchRequest:
+        if not prompt:
+            raise ValueError("empty prompt")
+        if seed is None:
+            seed = time.time_ns() % (1 << 31)
+        req = BatchRequest(prompt=list(map(int, prompt)),
+                           max_new_tokens=int(max_new_tokens),
+                           sampling=sampling or SamplingParams(),
+                           eos_token_id=eos_token_id, stream_cb=stream_cb,
+                           seed=int(seed))
+        if len(req.prompt) + req.max_new_tokens > self.max_seq:
+            raise ValueError(
+                f"prompt ({len(req.prompt)}) + max_new_tokens "
+                f"({req.max_new_tokens}) exceeds max_seq {self.max_seq}")
+        with self._lock:
+            self.queue.append(req)
+        self._work.set()
+        return req
+
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._loop, daemon=True,
+                                            name="batcher")
+            self._thread.start()
+
+    def stop(self):
+        """Stop the loop and fail every in-flight/queued request, so no
+        client blocks until its timeout on an unloading worker."""
+        self._stop.set()
+        self._work.set()
+        if self._thread:
+            self._thread.join(timeout=30)
+            self._thread = None
+        for slot in range(self.slots):
+            req = self.active[slot]
+            if req is not None:
+                req.error = req.error or "scheduler stopped"
+                self._finish_slot(slot)
+        with self._lock:
+            drained = list(self.queue)
+            self.queue.clear()
+        for req in drained:
+            req.error = "scheduler stopped"
+            req.done.set()
+
+    def stats(self) -> dict:
+        return {
+            "slots": self.slots,
+            "active": sum(a is not None for a in self.active),
+            "queued": len(self.queue),
+            "steps": self._step_count,
+            "tokens_out": self._tokens_out,
+            "block_size": self.block_size,
+            "blocks_free": self.pool.free_count(),
+            "pool": self.pool.stats(),
+        }
+
+    # ---- compiled steps ----------------------------------------------
+
+    def _prefill_jit(self, t: int, pb: int):
+        fn = self._prefill_fns.get((t, pb))
+        if fn is None:
+            cfg = self.cfg
+            fn = jax.jit(
+                lambda p, toks, tl, tb, pfb, pfl, paged:
+                transformer.paged_prefill_tail(p, cfg, toks, tl, tb, pfb,
+                                               pfl, paged),
+                donate_argnums=(6,))
+            self._prefill_fns[(t, pb)] = fn
+        return fn
+
+    def _decode_jit(self):
+        if self._decode_fn is None:
+            cfg = self.cfg
+
+            def step(params, tokens, paged, bt, cl, seeds, steps, temps, tks,
+                     tps, ds):
+                logits, paged = transformer.paged_decode_step(
+                    params, cfg, tokens, paged, bt, cl)
+                nxt = sample_batch(logits, seeds, steps, temps, tks, tps, ds)
+                return nxt, paged
+
+            self._decode_fn = jax.jit(step, donate_argnums=(2,))
+        return self._decode_fn
+
+    # ---- scheduling ---------------------------------------------------
+
+    def _bucket_tail(self, n: int) -> int:
+        for m in TAIL_BUCKETS_X_BS:
+            if n <= m * self.block_size:
+                return min(m * self.block_size,
+                           self.max_blocks * self.block_size)
+        raise ValueError(f"tail of {n} tokens exceeds buckets")
+
+    def _bucket_prefix(self, nb: int) -> int:
+        for m in PREFIX_BUCKETS:
+            if nb <= m:
+                return min(m, self.max_blocks) if m else 0
+        raise ValueError(f"prefix of {nb} blocks exceeds buckets")
+
+    def _admit_one(self, req: BatchRequest, slot: int) -> bool:
+        """Prefill req into `slot`. False if blocks are unavailable.
+
+        For a preempted request the already-generated tokens are part of
+        the prefill (generation resumes where it left off — streamed
+        tokens are never re-emitted).
+        """
+        bs = self.block_size
+        prompt = req.prompt + req.tokens
+        n = len(prompt)
+        # Leave >=1 token for the tail: prefill must produce the last
+        # token's logits (a fully-cached prompt would have nothing to run).
+        prefix_blocks, cached = self.pool.match_prefix(prompt[:n - 1])
+        tail_len = n - cached
+        t = self._bucket_tail(tail_len)
+        tail_alloc = self.pool.alloc(t // bs)
+        if tail_alloc is None:
+            self.pool.release(prefix_blocks)
+            return False
+        tail_real = tail_alloc[: -(-tail_len // bs)]
+        tail_extra = tail_alloc[len(tail_real):]
+
+        pb = self._bucket_prefix(len(prefix_blocks))
+        pfb = np.full((1, max(pb, 1)), self._dummy, np.int32)
+        pfb[0, :len(prefix_blocks)] = prefix_blocks
+        toks = np.zeros((1, t), np.int32)
+        toks[0, :tail_len] = prompt[cached:]
+
+        fn = self._prefill_jit(t, max(pb, 1))
+        t0 = time.perf_counter()
+        last, self.paged = fn(
+            self.params, jnp.asarray(toks),
+            jnp.asarray([tail_len], jnp.int32),
+            jnp.asarray(tail_alloc, jnp.int32),
+            jnp.asarray(pfb), jnp.asarray([cached], jnp.int32), self.paged)
+        sp = req.sampling
+        if self._sample1 is None:
+            self._sample1 = jax.jit(sample_batch)
+        first = int(self._sample1(
+            last,
+            jnp.asarray([req.seed], jnp.int32),
+            jnp.asarray([len(req.tokens)], jnp.int32),
+            jnp.asarray([sp.temperature], jnp.float32),
+            jnp.asarray([sp.top_k], jnp.int32),
+            jnp.asarray([sp.top_p], jnp.float32),
+            jnp.asarray([sp.do_sample]))[0])
+        self.pool.release(tail_extra)   # padding blocks beyond the real tail
+
+        # register the prompt's full blocks in the radix cache
+        n_full = n // bs
+        skip = cached // bs
+        if n_full > skip:
+            self.pool.insert_prefix(prompt[:n_full * bs],
+                                    tail_real[:n_full - skip], skip)
+
+        req._blocks = prefix_blocks + tail_real
+        self.block_tables[slot, :] = self._dummy
+        owned = prefix_blocks + tail_real
+        self.block_tables[slot, :len(owned)] = owned
+        self.context_lens[slot] = n
+        self.active[slot] = req
+        self._admit_order.append(slot)
+        if req.first_token_at is None:
+            req.first_token_at = time.time()
+        self._emit(req, first)
+        if req.done.is_set() or len(req.tokens) >= req.max_new_tokens:
+            self._finish_slot(slot)
+        return True
+
+    def _emit(self, req: BatchRequest, token: int):
+        """Append a sampled token; mark done on eos (eos not kept)."""
+        if req.eos_token_id is not None and token == req.eos_token_id:
+            self._finish_req(req)
+            return
+        req.tokens.append(token)
+        self._tokens_out += 1
+        if req.stream_cb:
+            try:
+                req.stream_cb(token)
+            except Exception:
+                pass
+
+    def _finish_req(self, req: BatchRequest):
+        self.pool.release(req._blocks)
+        req._blocks = []
+        req.finished_at = time.time()
+        req.done.set()
+
+    def _finish_slot(self, slot: int):
+        req = self.active[slot]
+        self.active[slot] = None
+        self.block_tables[slot, :] = self._dummy
+        self.context_lens[slot] = 0
+        if slot in self._admit_order:
+            self._admit_order.remove(slot)
+        if req is not None and not req.done.is_set():
+            self._finish_req(req)
+
+    def _preempt_youngest(self) -> bool:
+        """Free the most recently admitted slot, requeueing its request."""
+        if not self._admit_order:
+            return False
+        slot = self._admit_order.pop()
+        req = self.active[slot]
+        self.active[slot] = None
+        self.block_tables[slot, :] = self._dummy
+        self.context_lens[slot] = 0
+        if req is not None:
+            self.pool.release(req._blocks)
+            req._blocks = []
+            req._preemptions += 1
+            if req._preemptions > 5:
+                req.error = "preempted repeatedly: KV pool too small"
+                req.done.set()
+            else:
+                # generated tokens are kept; re-admission prefills
+                # prompt+tokens and resumes (see _admit_one)
+                with self._lock:
+                    self.queue.appendleft(req)
+        return True
+
+    def _ensure_growth(self, slot: int) -> bool:
+        """Make sure the slot owns the block its next token writes into."""
+        pos = int(self.context_lens[slot])
+        bi = pos // self.block_size
+        if bi >= self.max_blocks:
+            return False
+        if self.block_tables[slot, bi] != self._dummy:
+            return True
+        got = self.pool.alloc(1)
+        if got is None:
+            return False
+        self.block_tables[slot, bi] = got[0]
+        self.active[slot]._blocks.extend(got)
+        return True
+
+    # ---- the step -----------------------------------------------------
+
+    def step(self) -> int:
+        """Admit + one decode step. Returns number of active slots."""
+        # drop cancelled slots first — frees their blocks for admission
+        for slot in range(self.slots):
+            req = self.active[slot]
+            if req is not None and req._cancelled:
+                req.error = req.error or "cancelled"
+                self._finish_slot(slot)
+        # admission into free slots
+        while True:
+            free = [i for i, a in enumerate(self.active) if a is None]
+            if not free:
+                break
+            with self._lock:
+                req = self.queue.popleft() if self.queue else None
+            if req is None:
+                break
+            if req._cancelled:
+                req.error = req.error or "cancelled"
+                req.done.set()
+                continue
+            try:
+                admitted = self._admit_one(req, free[0])
+            except ValueError as e:
+                req.error = str(e)
+                req.done.set()
+                continue
+            if not admitted:
+                # Free memory by preempting the youngest slot, then retry
+                # this request FIRST next step (it goes in front of the
+                # preempted one, or ping-pong would starve it).
+                preempted = self._preempt_youngest()
+                if not preempted and not self._admit_order:
+                    # no active slots to free: this prompt can never fit
+                    req.error = "KV block pool exhausted"
+                    req.done.set()
+                else:
+                    with self._lock:
+                        self.queue.appendleft(req)
+                break
+
+        active = [i for i, a in enumerate(self.active) if a is not None]
+        if not active:
+            return 0
+
+        # growth blocks for sequences crossing a block boundary
+        for slot in range(self.slots):
+            while (self.active[slot] is not None
+                   and not self._ensure_growth(slot)):
+                # _preempt_youngest may free `slot` itself — the loop
+                # condition re-checks before retrying
+                if not self._preempt_youngest():
+                    self.active[slot].error = "cannot grow KV allocation"
+                    self._finish_slot(slot)
+                    break
+        active = [i for i, a in enumerate(self.active) if a is not None]
+        if not active:
+            return 0
+
+        r = self.slots
+        tokens = np.zeros((r,), np.int32)
+        seeds = np.zeros((r,), np.int32)
+        steps = np.zeros((r,), np.int32)
+        temps = np.full((r,), 1.0, np.float32)
+        tks = np.zeros((r,), np.int32)
+        tps = np.ones((r,), np.float32)
+        ds = np.zeros((r,), bool)
+        for i in active:
+            req = self.active[i]
+            tokens[i] = req.tokens[-1]
+            seeds[i] = req.seed
+            steps[i] = len(req.tokens)
+            temps[i] = req.sampling.temperature
+            tks[i] = req.sampling.top_k
+            tps[i] = req.sampling.top_p
+            ds[i] = req.sampling.do_sample
+
+        fn = self._decode_jit()
+        nxt, self.paged = fn(
+            self.params, jnp.asarray(tokens), self.paged,
+            jnp.asarray(self.block_tables), jnp.asarray(self.context_lens),
+            jnp.asarray(seeds), jnp.asarray(steps), jnp.asarray(temps),
+            jnp.asarray(tks), jnp.asarray(tps), jnp.asarray(ds))
+        nxt = np.asarray(nxt)   # ONE host sync per step for all slots
+        self._step_count += 1
+
+        for i in active:
+            req = self.active[i]
+            self.context_lens[i] += 1
+            self._emit(req, int(nxt[i]))
+            if req.done.is_set() or len(req.tokens) >= req.max_new_tokens:
+                self._finish_slot(i)
+        return len([a for a in self.active if a is not None])
+
+    # ---- background loop ----------------------------------------------
+
+    def _loop(self):
+        while not self._stop.is_set():
+            busy = self.step()
+            if not busy and not self.queue:
+                self._work.wait(timeout=0.05)
+                self._work.clear()
+
+
+def _backend(cfg: ModelConfig) -> str:
+    from distributed_llm_inferencing_tpu.ops.attention import resolve_backend
+    return resolve_backend(cfg.attn_backend, 1)

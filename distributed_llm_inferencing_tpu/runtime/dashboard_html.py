@@ -133,7 +133,8 @@ async function refresh() {{
     const dev = esc((n.resources && n.resources.devices || [])
       .map(d => d.kind || d.platform).join(', '));
     const models = n.loaded_models.map(m =>
-      `${{esc(m.name)}} [${{esc(Object.entries(m.mesh).filter(e=>e[1]>1)
+      `${{esc(m.name)}} [${{esc(m.serving === 'batched' ? 'batched'
+        : Object.entries(m.mesh || {{}}).filter(e=>e[1]>1)
         .map(e=>e.join('=')).join(' ') || '1 chip')}}]`).join('<br>');
     return `<tr><td>${{n.id}}</td><td>${{esc(n.name)}}</td>`+
     `<td>${{esc(n.host)}}:${{esc(n.port)}}</td>`+

@@ -34,10 +34,11 @@ log = setup_logging("worker")
 
 
 class LoadedModel:
-    def __init__(self, engine: InferenceEngine, tokenizer, source: str):
-        self.engine = engine
+    def __init__(self, engine, tokenizer, source: str, batcher=None):
+        self.engine = engine            # None in batched serving mode
         self.tokenizer = tokenizer
         self.source = source
+        self.batcher = batcher          # ContinuousBatcher or None
         self.lock = threading.Lock()  # engine.generate is not reentrant
 
 
@@ -88,10 +89,17 @@ class WorkerAgent:
         except Exception:
             cpu = mem = None
         with self._models_lock:  # load/unload mutate concurrently
-            loaded = [{"name": n, "source": m.source,
-                       "mesh": m.engine.mesh_spec.axis_sizes(),
-                       "max_seq": m.engine.max_seq}
-                      for n, m in self.models.items()]
+            loaded = []
+            for n, m in self.models.items():
+                if m.batcher is not None:
+                    loaded.append({"name": n, "source": m.source,
+                                   "serving": "batched",
+                                   "max_seq": m.batcher.max_seq,
+                                   "scheduler": m.batcher.stats()})
+                else:
+                    loaded.append({"name": n, "source": m.source,
+                                   "mesh": m.engine.mesh_spec.axis_sizes(),
+                                   "max_seq": m.engine.max_seq})
         return {
             "status": "online",
             "uptime_s": time.time() - self.started,
@@ -147,18 +155,40 @@ class WorkerAgent:
             source = "random-init"
         if body.get("dtype"):
             cfg = cfg.replace(dtype=body["dtype"])
-        engine = InferenceEngine(
-            cfg, params, mesh_spec=mesh, max_seq=body.get("max_seq"))
         tok = load_tokenizer(body.get("tokenizer_path") or
                              (ckpt if ckpt else None), cfg.vocab_size)
+        if body.get("serving") == "batched":
+            # Continuous batching over the paged KV cache
+            # (runtime/batcher.py) — requests share decode steps instead of
+            # serializing behind the per-model lock.
+            if mesh.num_devices > 1:
+                return 400, {"status": "error",
+                             "message": "batched serving is single-program; "
+                                        "drop the mesh or use default mode"}
+            from distributed_llm_inferencing_tpu.runtime.batcher import (
+                ContinuousBatcher)
+            batcher = ContinuousBatcher(
+                cfg, params,
+                num_blocks=int(body.get("kv_blocks", 512)),
+                block_size=int(body.get("kv_block_size", 16)),
+                slots=int(body.get("slots", 8)),
+                max_seq=body.get("max_seq"))
+            batcher.start()
+            lm = LoadedModel(None, tok, source, batcher=batcher)
+            stats = batcher.stats()
+        else:
+            engine = InferenceEngine(
+                cfg, params, mesh_spec=mesh, max_seq=body.get("max_seq"))
+            lm = LoadedModel(engine, tok, source)
+            stats = engine.stats()
         with self._models_lock:
-            self.models[name] = LoadedModel(engine, tok, source)
+            self.models[name] = lm
         self.metrics.inc("models_loaded")
         log.info("loaded %s from %s in %.1fs", name, source, time.time() - t0)
         return 200, {"status": "success",
                      "message": f"model {name} loaded",
                      "load_time_s": time.time() - t0,
-                     "stats": engine.stats()}
+                     "stats": stats}
 
     def load_model(self, body):
         with self.metrics.time("load_model"):
@@ -190,6 +220,8 @@ class WorkerAgent:
         if m is None:
             return 404, {"status": "error",
                          "message": f"model {name} not loaded"}
+        if m.batcher is not None:
+            m.batcher.stop()
         del m
         import gc
         gc.collect()
@@ -227,6 +259,31 @@ class WorkerAgent:
             m, prompt, sp, max_new = self._prep_inference(body)
         except (KeyError, ValueError) as e:
             return 400, {"status": "error", "message": str(e)}
+        if m.batcher is not None:
+            # batched serving: enqueue and wait — no per-model lock, the
+            # batcher interleaves this request with others in flight
+            try:
+                with self.metrics.time("inference"):
+                    req = m.batcher.submit(
+                        prompt, max_new_tokens=max_new, sampling=sp,
+                        eos_token_id=m.tokenizer.eos_token_id,
+                        seed=body.get("seed"))
+                    toks = req.wait(timeout=float(body.get("timeout", 300)))
+            except TimeoutError as e:
+                req.cancel()   # free the slot; don't generate for nobody
+                return 408, {"status": "error", "message": str(e)}
+            except (ValueError, RuntimeError) as e:
+                return 400, {"status": "error", "message": str(e)}
+            self.metrics.inc("requests_completed")
+            self.metrics.inc("tokens_generated", len(toks))
+            return {
+                "status": "success",
+                "result": m.tokenizer.decode(toks),
+                "tokens": toks,
+                "execution_time": time.time() - t0,
+                "ttft_ms": req.ttft_ms,
+                "scheduler": m.batcher.stats(),
+            }
         with self.metrics.time("inference"), m.lock:
             res = m.engine.generate(
                 [prompt], max_new_tokens=max_new, sampling=sp,
@@ -258,6 +315,27 @@ class WorkerAgent:
             q: "queue.Queue" = queue.Queue()
             done = object()
 
+            def run_batched():
+                step = [0]
+
+                def cb(token):
+                    q.put({"event": "token", "step": step[0], "token": token,
+                           "text": m.tokenizer.decode([token])})
+                    step[0] += 1
+
+                try:
+                    req = m.batcher.submit(
+                        prompt, max_new_tokens=max_new, sampling=sp,
+                        eos_token_id=m.tokenizer.eos_token_id, stream_cb=cb,
+                        seed=body.get("seed"))
+                    toks = req.wait(timeout=float(body.get("timeout", 300)))
+                    q.put({"event": "done",
+                           "result": m.tokenizer.decode(toks),
+                           "ttft_ms": req.ttft_ms})
+                except Exception as e:
+                    q.put({"event": "error", "message": str(e)})
+                q.put(done)
+
             def cb(step, toks):
                 if toks[0] is None:   # sequence already finished (post-eos)
                     return
@@ -279,7 +357,9 @@ class WorkerAgent:
                     q.put({"event": "error", "message": str(e)})
                 q.put(done)
 
-            threading.Thread(target=run, daemon=True).start()
+            threading.Thread(
+                target=run_batched if m.batcher is not None else run,
+                daemon=True).start()
             while True:
                 item = q.get()
                 if item is done:

@@ -1,0 +1,210 @@
+"""Continuous batcher: scheduling, prefix reuse, preemption, streaming.
+
+Oracle for token content is the dense-cache engine in greedy mode (dense ≡
+paged is pinned separately in tests/test_paged.py).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llm_inferencing_tpu.models.params import init_params
+from distributed_llm_inferencing_tpu.models.registry import get_config
+from distributed_llm_inferencing_tpu.ops.sampling import SamplingParams
+from distributed_llm_inferencing_tpu.runtime.batcher import ContinuousBatcher
+from distributed_llm_inferencing_tpu.runtime.engine import InferenceEngine
+
+CFG = get_config("tiny-llama").replace(dtype="float32", attn_backend="xla")
+PARAMS = init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
+RNG = np.random.default_rng(0)
+
+
+def run_until_done(b, reqs, max_steps=400):
+    for _ in range(max_steps):
+        b.step()
+        if all(r.done.is_set() for r in reqs):
+            return
+    raise AssertionError(
+        f"not done after {max_steps} steps: "
+        f"{[(r.done.is_set(), r.error, len(r.tokens)) for r in reqs]}")
+
+
+def dense_greedy(prompt, n):
+    eng = InferenceEngine(CFG, PARAMS, max_seq=128)
+    return eng.generate([prompt], max_new_tokens=n,
+                        sampling=SamplingParams.greedy()).tokens[0]
+
+
+def test_single_request_matches_engine():
+    b = ContinuousBatcher(CFG, PARAMS, num_blocks=64, block_size=8,
+                          slots=4, max_seq=128)
+    prompt = RNG.integers(0, CFG.vocab_size, 13).tolist()
+    r = b.submit(prompt, max_new_tokens=20, sampling=SamplingParams.greedy())
+    run_until_done(b, [r])
+    assert r.wait() == dense_greedy(prompt, 20)
+    assert r.ttft_ms is not None and r.finished_at is not None
+
+
+def test_concurrent_mixed_sampling():
+    """Slots advance together; per-slot sampling params are independent."""
+    b = ContinuousBatcher(CFG, PARAMS, num_blocks=128, block_size=8,
+                          slots=4, max_seq=128)
+    greedy_prompt = RNG.integers(0, CFG.vocab_size, 9).tolist()
+    reqs = [b.submit(greedy_prompt, max_new_tokens=15,
+                     sampling=SamplingParams.greedy())]
+    for i in range(5):   # more requests than slots -> queueing
+        p = RNG.integers(0, CFG.vocab_size, 5 + i).tolist()
+        reqs.append(b.submit(p, max_new_tokens=10 + i,
+                             sampling=SamplingParams(temperature=0.7)))
+    run_until_done(b, reqs)
+    for i, r in enumerate(reqs):
+        assert r.error is None, r.error
+        want = 15 if i == 0 else 10 + (i - 1)
+        assert len(r.tokens) == want
+    # the greedy request must be bit-identical to the engine even though it
+    # shared decode steps with sampling requests
+    assert reqs[0].tokens == dense_greedy(greedy_prompt, 15)
+
+
+def test_prefix_cache_reuse_across_requests():
+    b = ContinuousBatcher(CFG, PARAMS, num_blocks=64, block_size=8,
+                          slots=2, max_seq=128)
+    sys_prompt = RNG.integers(0, CFG.vocab_size, 24).tolist()  # 3 full blocks
+    p1 = sys_prompt + RNG.integers(0, CFG.vocab_size, 4).tolist()
+    r1 = b.submit(p1, max_new_tokens=5, sampling=SamplingParams.greedy())
+    run_until_done(b, [r1])
+    misses_before = b.pool.stats()["prefix_misses"]
+
+    p2 = sys_prompt + RNG.integers(0, CFG.vocab_size, 6).tolist()
+    r2 = b.submit(p2, max_new_tokens=5, sampling=SamplingParams.greedy())
+    run_until_done(b, [r2])
+    st = b.pool.stats()
+    assert st["prefix_hits"] >= 1, st      # shared blocks were reused
+    assert st["prefix_misses"] == misses_before
+    assert r2.wait() == dense_greedy(p2, 5)   # reuse didn't change tokens
+
+
+def test_identical_prompt_full_hit():
+    b = ContinuousBatcher(CFG, PARAMS, num_blocks=64, block_size=8,
+                          slots=2, max_seq=128)
+    prompt = RNG.integers(0, CFG.vocab_size, 17).tolist()
+    r1 = b.submit(prompt, max_new_tokens=6, sampling=SamplingParams.greedy())
+    run_until_done(b, [r1])
+    r2 = b.submit(prompt, max_new_tokens=6, sampling=SamplingParams.greedy())
+    run_until_done(b, [r2])
+    assert r1.wait() == r2.wait()
+
+
+def test_preemption_under_memory_pressure():
+    """A pool too small for all requests still completes every request
+    correctly via preempt-and-resume."""
+    b = ContinuousBatcher(CFG, PARAMS, num_blocks=10, block_size=8,
+                          slots=3, max_seq=80)
+    prompts = [RNG.integers(0, CFG.vocab_size, 12).tolist() for _ in range(3)]
+    reqs = [b.submit(p, max_new_tokens=12, sampling=SamplingParams.greedy())
+            for p in prompts]
+    run_until_done(b, reqs)
+    for p, r in zip(prompts, reqs):
+        assert r.error is None, r.error
+        assert r.wait() == dense_greedy(p, 12)
+
+
+def test_pool_exhausted_is_reported():
+    b = ContinuousBatcher(CFG, PARAMS, num_blocks=2, block_size=8,
+                          slots=2, max_seq=64)
+    r = b.submit(RNG.integers(0, CFG.vocab_size, 30).tolist(),
+                 max_new_tokens=4)
+    for _ in range(20):
+        b.step()
+        if r.done.is_set():
+            break
+    assert r.error and "exhausted" in r.error
+    with pytest.raises(RuntimeError):
+        r.wait()
+
+
+def test_streaming_and_eos():
+    b = ContinuousBatcher(CFG, PARAMS, num_blocks=64, block_size=8,
+                          slots=2, max_seq=128)
+    prompt = RNG.integers(0, CFG.vocab_size, 11).tolist()
+    full = dense_greedy(prompt, 10)
+    # use the 4th generated token as "eos": generation must stop before it
+    eos = full[3]
+    want = full[:3] if eos not in full[:3] else None
+    seen = []
+    r = b.submit(prompt, max_new_tokens=10, sampling=SamplingParams.greedy(),
+                 eos_token_id=eos, stream_cb=seen.append)
+    run_until_done(b, [r])
+    got = r.wait()
+    if want is not None:
+        assert got == want
+    assert seen == got          # streamed exactly the kept tokens, in order
+    assert eos not in got
+
+
+def test_seeded_sampling_reproducible_across_interleavings():
+    """A request's sampled output depends only on (params, prompt, seed) —
+    not on what else shares its decode steps."""
+    prompt = RNG.integers(0, CFG.vocab_size, 10).tolist()
+    sp = SamplingParams(temperature=0.9, top_k=40, top_p=0.9)
+
+    b1 = ContinuousBatcher(CFG, PARAMS, num_blocks=64, block_size=8,
+                           slots=4, max_seq=128)
+    alone = b1.submit(prompt, max_new_tokens=12, sampling=sp, seed=1234)
+    run_until_done(b1, [alone])
+
+    b2 = ContinuousBatcher(CFG, PARAMS, num_blocks=64, block_size=8,
+                           slots=4, max_seq=128)
+    noise = [b2.submit(RNG.integers(0, CFG.vocab_size, 6 + i).tolist(),
+                       max_new_tokens=20, sampling=sp, seed=i)
+             for i in range(3)]
+    crowded = b2.submit(prompt, max_new_tokens=12, sampling=sp, seed=1234)
+    run_until_done(b2, noise + [crowded])
+    assert crowded.wait() == alone.wait()
+
+
+def test_cancel_frees_slot():
+    b = ContinuousBatcher(CFG, PARAMS, num_blocks=64, block_size=8,
+                          slots=2, max_seq=128)
+    r = b.submit(RNG.integers(0, CFG.vocab_size, 8).tolist(),
+                 max_new_tokens=100, sampling=SamplingParams.greedy())
+    b.step()
+    assert not r.done.is_set()
+    r.cancel()
+    b.step()
+    assert r.done.is_set() and r.error == "cancelled"
+    assert b.stats()["active"] == 0
+    # its blocks came back
+    assert b.pool.free_count() > 0
+
+
+def test_stop_drains_inflight_requests():
+    b = ContinuousBatcher(CFG, PARAMS, num_blocks=64, block_size=8,
+                          slots=1, max_seq=128)
+    active = b.submit(RNG.integers(0, CFG.vocab_size, 8).tolist(),
+                      max_new_tokens=100)
+    queued = b.submit(RNG.integers(0, CFG.vocab_size, 8).tolist(),
+                      max_new_tokens=100)
+    b.step()
+    b.stop()   # no thread started; must still fail both requests
+    assert active.done.is_set() and queued.done.is_set()
+    with pytest.raises(RuntimeError, match="stopped"):
+        queued.wait(timeout=1)
+
+
+def test_background_thread_serving():
+    b = ContinuousBatcher(CFG, PARAMS, num_blocks=64, block_size=8,
+                          slots=4, max_seq=128)
+    b.start()
+    try:
+        prompt = RNG.integers(0, CFG.vocab_size, 8).tolist()
+        reqs = [b.submit(prompt, max_new_tokens=8,
+                         sampling=SamplingParams.greedy())
+                for _ in range(6)]
+        outs = [r.wait(timeout=300) for r in reqs]
+        assert all(o == outs[0] for o in outs)
+    finally:
+        b.stop()
+    st = b.stats()
+    assert st["active"] == 0 and st["tokens_out"] >= 48
