@@ -36,6 +36,15 @@ def main(argv=None):
     p.add_argument("--max_seq", type=int, default=2048)
     p.add_argument("--batch", type=int, default=1)
 
+    c = sub.add_parser("convert", help="HF checkpoint -> native sharded "
+                                       "checkpoint (models/checkpoint.py)")
+    c.add_argument("--checkpoint_path", help="local HF checkpoint dir")
+    c.add_argument("--model_name", help="registry name (with "
+                                        "--allow_random_init, for testing)")
+    c.add_argument("--allow_random_init", action="store_true")
+    c.add_argument("--out", required=True)
+    c.add_argument("--dtype")
+
     g = sub.add_parser("generate", help="one-shot local generation")
     g.add_argument("--model_name", default="gpt2")
     g.add_argument("--checkpoint_path")
@@ -60,6 +69,24 @@ def main(argv=None):
                          batch=args.batch)
         json.dump(plan, sys.stdout, indent=2)
         print()
+    elif args.cmd == "convert":
+        from distributed_llm_inferencing_tpu.models import checkpoint
+        if args.checkpoint_path:
+            cfg = checkpoint.convert_hf_to_native(
+                args.checkpoint_path, args.out, dtype=args.dtype)
+        elif args.allow_random_init and args.model_name:
+            import jax
+            from distributed_llm_inferencing_tpu.models.params import init_params
+            from distributed_llm_inferencing_tpu.models.registry import get_config
+            cfg = get_config(args.model_name)
+            if args.dtype:
+                cfg = cfg.replace(dtype=args.dtype)
+            checkpoint.save_checkpoint(
+                args.out, cfg, init_params(cfg, jax.random.PRNGKey(0)))
+        else:
+            sys.exit("need --checkpoint_path, or --model_name with "
+                     "--allow_random_init")
+        print(f"saved native checkpoint for {cfg.name} -> {args.out}")
     elif args.cmd == "generate":
         _generate(args)
 

@@ -64,6 +64,11 @@ class WorkerAgent:
         s.add("POST", "/unload_model", self.unload_model)
         s.add("POST", "/inference", self.inference)
         s.add("POST", "/inference_stream", self.inference_stream)
+        s.add("POST", "/profile/start", self.profile_start)
+        s.add("POST", "/profile/stop", self.profile_stop)
+        s.add("GET", "/memory_profile", self.memory_profile)
+        self._profile_dir: Optional[str] = None
+        self._profile_lock = threading.Lock()
 
     # ---- endpoints ---------------------------------------------------
 
@@ -134,9 +139,27 @@ class WorkerAgent:
 
     def _do_load_inner(self, body, name) -> tuple:
         ckpt = body.get("checkpoint_path")
+        native = body.get("native_checkpoint")
         mesh = MeshSpec.from_dict(body.get("mesh", {}))
         t0 = time.time()
-        if ckpt:
+        if body.get("serving") == "batched" and mesh.num_devices > 1:
+            # validate BEFORE any (possibly huge) checkpoint restore
+            return 400, {"status": "error",
+                         "message": "batched serving is single-program; "
+                                    "drop the mesh or use default mode"}
+        if native:
+            # converted-once artifact (models/checkpoint.py): no torch on
+            # the serving path, restore is sharded when a mesh is in play
+            from distributed_llm_inferencing_tpu.models import checkpoint
+            from distributed_llm_inferencing_tpu.parallel.mesh import create_mesh
+            cfg, params = checkpoint.load_checkpoint(
+                native,
+                mesh=create_mesh(mesh) if mesh.num_devices > 1 else None,
+                mesh_spec=mesh if mesh.num_devices > 1 else None,
+                dtype=body.get("dtype"))
+            cfg = cfg.replace(name=name)
+            source = native
+        elif ckpt:
             from distributed_llm_inferencing_tpu.models.convert import load_hf_model
             cfg, params = load_hf_model(ckpt)
             cfg = cfg.replace(name=name)
@@ -155,16 +178,14 @@ class WorkerAgent:
             source = "random-init"
         if body.get("dtype"):
             cfg = cfg.replace(dtype=body["dtype"])
-        tok = load_tokenizer(body.get("tokenizer_path") or
-                             (ckpt if ckpt else None), cfg.vocab_size)
+        from distributed_llm_inferencing_tpu.utils.tokenizer import has_tokenizer
+        tok = load_tokenizer(
+            body.get("tokenizer_path") or ckpt
+            or (native if has_tokenizer(native) else None), cfg.vocab_size)
         if body.get("serving") == "batched":
             # Continuous batching over the paged KV cache
             # (runtime/batcher.py) — requests share decode steps instead of
             # serializing behind the per-model lock.
-            if mesh.num_devices > 1:
-                return 400, {"status": "error",
-                             "message": "batched serving is single-program; "
-                                        "drop the mesh or use default mode"}
             from distributed_llm_inferencing_tpu.runtime.batcher import (
                 ContinuousBatcher)
             batcher = ContinuousBatcher(
@@ -368,6 +389,39 @@ class WorkerAgent:
             self.metrics.inc("requests_completed")
 
         return httpd.sse_stream(_request, events())
+
+    # ---- profiling ----------------------------------------------------
+    # The reference's only timing was wall-clock execution_time per request
+    # (reference: worker/app.py:271,317; SURVEY.md §5.1). These endpoints
+    # expose real device traces: XLA op timelines viewable in
+    # TensorBoard/Perfetto, plus a live HBM profile.
+
+    def profile_start(self, body):
+        path = body.get("trace_dir") or "/tmp/dli_trace"
+        import jax.profiler
+        with self._profile_lock:   # check-then-act vs concurrent handlers
+            if self._profile_dir is not None:
+                return 409, {"status": "error",
+                             "message": f"trace already running -> "
+                                        f"{self._profile_dir}"}
+            jax.profiler.start_trace(path)
+            self._profile_dir = path
+        return {"status": "success", "trace_dir": path}
+
+    def profile_stop(self, body):
+        import jax.profiler
+        with self._profile_lock:
+            if self._profile_dir is None:
+                return 409, {"status": "error", "message": "no trace running"}
+            jax.profiler.stop_trace()
+            path, self._profile_dir = self._profile_dir, None
+        return {"status": "success", "trace_dir": path,
+                "message": "open with tensorboard --logdir or xprof"}
+
+    def memory_profile(self, body):
+        """Live device-memory profile (pprof protobuf), HBM ground truth."""
+        import jax.profiler
+        return (jax.profiler.device_memory_profile(), "application/protobuf")
 
     # ---- lifecycle ---------------------------------------------------
 

@@ -51,6 +51,15 @@ class HFTokenizer:
         return self._tok.decode(ids, skip_special_tokens=True)
 
 
+def has_tokenizer(path: Optional[str]) -> bool:
+    """True if `path` holds HF tokenizer artifacts."""
+    import os
+    return bool(path) and any(
+        os.path.exists(os.path.join(path, f))
+        for f in ("tokenizer.json", "tokenizer_config.json", "vocab.json",
+                  "spiece.model", "tokenizer.model"))
+
+
 def load_tokenizer(path: Optional[str], vocab_size: int):
     """Local HF tokenizer if a path is given, else byte-level fallback."""
     if path:

@@ -94,6 +94,26 @@ def test_streaming_batched(worker):
     assert done["result"]  # decoded text present
 
 
+def test_profiler_endpoints(worker, tmp_path):
+    _, port = worker
+    d = str(tmp_path / "trace")
+    r = requests.post(_url(port, "/profile/start"), json={"trace_dir": d})
+    assert r.status_code == 200
+    # double-start is rejected
+    assert requests.post(_url(port, "/profile/start"), json={}).status_code == 409
+    requests.post(_url(port, "/inference"), json={
+        "model_name": "tiny-llama", "prompt_tokens": [1, 2, 3],
+        "max_new_tokens": 2, "sampling": {"do_sample": False}}, timeout=300)
+    r = requests.post(_url(port, "/profile/stop"), json={})
+    assert r.status_code == 200
+    import glob
+    assert glob.glob(d + "/**/*.xplane.pb", recursive=True), \
+        "trace produced no xplane"
+    assert requests.post(_url(port, "/profile/stop"), json={}).status_code == 409
+    m = requests.get(_url(port, "/memory_profile"))
+    assert m.status_code == 200 and len(m.content) > 0
+
+
 def test_unload_stops_batcher(worker):
     agent, port = worker
     # load a second batched model and unload it; its batcher thread stops
