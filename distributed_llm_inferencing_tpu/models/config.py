@@ -41,6 +41,12 @@ class ModelConfig:
     mlp_bias: bool = True
     tie_word_embeddings: bool = True
     sliding_window: Optional[int] = None  # Mistral-style local attention
+    # OPT-350m specifics (reference's second arch family, shard_model.py:46):
+    # token embeds live in a smaller space with linear project_in/out...
+    embed_proj_dim: Optional[int] = None
+    # ...and blocks normalize AFTER the residual add (do_layer_norm_before
+    # = False), with no final norm before the head.
+    post_norm: bool = False
 
     # Mixture-of-experts (Mixtral). num_experts == 0 => dense MLP.
     num_experts: int = 0

@@ -46,13 +46,10 @@ def config_from_hf(hf_config) -> ModelConfig:
             activation="gelu", gated_mlp=False, position_embedding="learned",
             attn_bias=True, mlp_bias=True, tie_word_embeddings=True)
     if mt == "opt":
-        if getattr(hf_config, "word_embed_proj_dim", hf_config.hidden_size) != hf_config.hidden_size:
-            raise NotImplementedError(
-                "OPT variants with word_embed_proj_dim != hidden_size "
-                "(opt-350m) need the embed projection; not yet wired.")
-        if not getattr(hf_config, "do_layer_norm_before", True):
-            raise NotImplementedError("post-LN OPT variants not supported")
+        proj = getattr(hf_config, "word_embed_proj_dim", hf_config.hidden_size)
         return ModelConfig(
+            embed_proj_dim=proj if proj != hf_config.hidden_size else None,
+            post_norm=not getattr(hf_config, "do_layer_norm_before", True),
             name=getattr(hf_config, "name_or_path", "opt") or "opt",
             family="opt", vocab_size=hf_config.vocab_size,
             hidden_size=hf_config.hidden_size,
@@ -164,10 +161,16 @@ def convert_state_dict(cfg: ModelConfig, sd, dtype=None):
                 "positions": get("model.decoder.embed_positions.weight")[2:],
             },
             "layers": _stack([layer(i) for i in range(cfg.num_layers)]),
-            "final_norm": {
-                "scale": get("model.decoder.final_layer_norm.weight"),
-                "bias": get("model.decoder.final_layer_norm.bias")},
         }
+        if not cfg.post_norm:   # opt-350m (post-LN) has no final norm
+            params["final_norm"] = {
+                "scale": get("model.decoder.final_layer_norm.weight"),
+                "bias": get("model.decoder.final_layer_norm.bias")}
+        if cfg.embed_proj_dim:
+            params["embed"]["project_in"] = {
+                "w": get("model.decoder.project_in.weight").T}
+            params["embed"]["project_out"] = {
+                "w": get("model.decoder.project_out.weight").T}
     elif fam == "llama":
         def layer(i):
             p = f"model.layers.{i}."

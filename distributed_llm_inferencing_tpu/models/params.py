@@ -62,13 +62,18 @@ def init_params(cfg: ModelConfig, key, dtype=None):
             layers["gate"] = {"w": w((L, D, I))}
         layers["down"] = lin(I, D, cfg.mlp_bias)
 
+    E = cfg.embed_proj_dim or D
     params = {
-        "embed": {"tokens": w((cfg.vocab_size, D))},
+        "embed": {"tokens": w((cfg.vocab_size, E))},
         "layers": layers,
-        "final_norm": (
-            {"scale": ones((D,)), "bias": zeros((D,))}
-            if cfg.norm_type == "layernorm" else {"scale": ones((D,))}),
     }
+    if not cfg.post_norm:   # post-LN models (opt-350m) have no final norm
+        params["final_norm"] = (
+            {"scale": ones((D,)), "bias": zeros((D,))}
+            if cfg.norm_type == "layernorm" else {"scale": ones((D,))})
+    if cfg.embed_proj_dim:
+        params["embed"]["project_in"] = {"w": w((E, D))}
+        params["embed"]["project_out"] = {"w": w((D, E))}
     if cfg.position_embedding == "learned":
         params["embed"]["positions"] = w((cfg.max_position_embeddings, D))
     if not cfg.tie_word_embeddings:

@@ -80,11 +80,9 @@ register(_gpt2("gpt2-large", 1280, 36, 20))
 register(_gpt2("gpt2-xl", 1600, 48, 25))
 
 # --- OPT family (reference: facebook/opt-350m hint, inference.html:23) ---
-# NOTE: opt-350m itself is deliberately absent: it uses
-# word_embed_proj_dim=512 != hidden and post-LN, which convert.config_from_hf
-# rejects; listing it here would advertise a config that can't load the real
-# checkpoint. TODO: wire the embed projection + post-LN block order.
 register(_opt("opt-125m", 768, 3072, 12, 12))
+register(_opt("opt-350m", 1024, 4096, 24, 16).replace(
+    embed_proj_dim=512, post_norm=True))
 register(_opt("opt-1.3b", 2048, 8192, 24, 32))
 
 # --- Llama 3 family (BASELINE.md configs 2 & 5) ---

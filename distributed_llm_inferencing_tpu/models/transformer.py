@@ -17,7 +17,10 @@ XLA program designed for the TPU:
 
 Param pytree schema (all leaves jnp arrays; optional leaves absent, never None):
 
-    {"embed": {"tokens": [V,D], "positions": [P,D]?},
+    {"embed": {"tokens": [V,E], "positions": [P,D]?,
+               # E = embed_proj_dim or D; projections present iff
+               # cfg.embed_proj_dim (opt-350m):
+               "project_in": {"w": [E,D]}?, "project_out": {"w": [D,E]}?},
      "layers": {
         "attn_norm": {"scale": [L,D], "bias": [L,D]?},
         "q"|"k"|"v"|"o": {"w": [L,din,dout], "b": [L,dout]?},
@@ -28,7 +31,7 @@ Param pytree schema (all leaves jnp arrays; optional leaves absent, never None):
         "router": {"w": [L,D,E]},
         "experts": {"up": {"w": [L,E,D,I]}, "gate": {"w": [L,E,D,I]}, "down": {"w": [L,E,I,D]}},
      },
-     "final_norm": {"scale": [D], "bias": [D]?},
+     "final_norm": {"scale": [D], "bias": [D]?},  # absent when cfg.post_norm
      "lm_head": {"w": [D,V]}?   # absent when tie_word_embeddings
     }
 """
@@ -104,6 +107,8 @@ def embed(params, cfg: ModelConfig, tokens, q_positions):
     below and the pipelined executor (parallel/pipeline.py)."""
     x = jnp.take(params["embed"]["tokens"], tokens, axis=0)
     x = x.astype(jnp.dtype(cfg.dtype))
+    if "project_in" in params["embed"]:   # opt-350m: embed dim < hidden dim
+        x = _linear(x, params["embed"]["project_in"])
     if cfg.position_embedding == "learned":
         # Positions are clipped only as jit-safety; the engine rejects
         # requests whose prompt+max_new_tokens exceed the context window
@@ -116,8 +121,16 @@ def embed(params, cfg: ModelConfig, tokens, q_positions):
 
 
 def unembed(params, cfg: ModelConfig, x):
-    """Final norm + logits head, f32. Shared with parallel/pipeline.py."""
-    x = norm(x, params["final_norm"], cfg.norm_type, cfg.norm_eps)
+    """Final norm + logits head, f32. Shared with parallel/pipeline.py.
+
+    Post-LN models (opt-350m) have no final norm — each block already
+    normalized its residual output; the embed projection (if any) maps
+    back to the embedding dim before the tied head.
+    """
+    if not cfg.post_norm:
+        x = norm(x, params["final_norm"], cfg.norm_type, cfg.norm_eps)
+    if "project_out" in params["embed"]:
+        x = _linear(x, params["embed"]["project_out"])
     if cfg.tie_word_embeddings:
         logits = jnp.einsum("bsd,vd->bsv", x,
                             params["embed"]["tokens"].astype(x.dtype))
@@ -134,9 +147,13 @@ def _block_body(x, lp, cfg: ModelConfig, q_positions, attend_write):
     paged_prefill_tail) so the three can never diverge. ``attend_write(q,
     k, v) -> (attn [B,s,H,hd], cache_out)`` owns the regime-specific part:
     cache update + attention formulation.
+
+    cfg.post_norm flips pre-LN (norm -> sublayer -> residual) to the
+    post-LN order opt-350m uses (sublayer -> residual -> norm).
     """
     B, s, _ = x.shape
-    h = norm(x, lp["attn_norm"], cfg.norm_type, cfg.norm_eps)
+    h = x if cfg.post_norm else norm(x, lp["attn_norm"], cfg.norm_type,
+                                     cfg.norm_eps)
     q = _linear(h, lp["q"]).reshape(B, s, cfg.num_heads, cfg.head_dim)
     k = _linear(h, lp["k"]).reshape(B, s, cfg.num_kv_heads, cfg.head_dim)
     v = _linear(h, lp["v"]).reshape(B, s, cfg.num_kv_heads, cfg.head_dim)
@@ -148,10 +165,16 @@ def _block_body(x, lp, cfg: ModelConfig, q_positions, attend_write):
     attn, cache_out = attend_write(q, k, v)
     attn = _linear(attn.reshape(B, s, cfg.num_heads * cfg.head_dim), lp["o"])
     x = x + attn
+    if cfg.post_norm:
+        x = norm(x, lp["attn_norm"], cfg.norm_type, cfg.norm_eps)
 
-    h = norm(x, lp["mlp_norm"], cfg.norm_type, cfg.norm_eps)
+    h = x if cfg.post_norm else norm(x, lp["mlp_norm"], cfg.norm_type,
+                                     cfg.norm_eps)
     moe_out = _moe(h, lp, cfg) if cfg.is_moe else _mlp(h, lp, cfg)
-    return x + moe_out, cache_out
+    x = x + moe_out
+    if cfg.post_norm:
+        x = norm(x, lp["mlp_norm"], cfg.norm_type, cfg.norm_eps)
+    return x, cache_out
 
 
 def _block(x, lp, cache_k, cache_v, *, cfg: ModelConfig, q_positions,

@@ -79,9 +79,14 @@ def param_specs(cfg: ModelConfig, spec: MeshSpec,
     specs = {
         "embed": {"tokens": P("tp", None)},
         "layers": layers,
-        "final_norm": ({"scale": P(None), "bias": P(None)}
-                       if cfg.norm_type == "layernorm" else {"scale": P(None)}),
     }
+    if not cfg.post_norm:
+        specs["final_norm"] = (
+            {"scale": P(None), "bias": P(None)}
+            if cfg.norm_type == "layernorm" else {"scale": P(None)})
+    if cfg.embed_proj_dim:   # opt-350m embed projections: small, replicated
+        specs["embed"]["project_in"] = {"w": P(None, None)}
+        specs["embed"]["project_out"] = {"w": P(None, None)}
     if cfg.position_embedding == "learned":
         specs["embed"]["positions"] = P(None, None)
     if not cfg.tie_word_embeddings:

@@ -179,9 +179,9 @@ class WorkerAgent:
         if body.get("dtype"):
             cfg = cfg.replace(dtype=body["dtype"])
         from distributed_llm_inferencing_tpu.utils.tokenizer import has_tokenizer
-        tok = load_tokenizer(
-            body.get("tokenizer_path") or ckpt
-            or (native if has_tokenizer(native) else None), cfg.vocab_size)
+        tok_dir = body.get("tokenizer_path") or next(
+            (d for d in (ckpt, native) if has_tokenizer(d)), None)
+        tok = load_tokenizer(tok_dir, cfg.vocab_size)
         if body.get("serving") == "batched":
             # Continuous batching over the paged KV cache
             # (runtime/batcher.py) — requests share decode steps instead of
