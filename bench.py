@@ -30,13 +30,15 @@ def bench_reference_stack():
     model = transformers.GPT2LMHeadModel(transformers.GPT2Config()).eval()
     prompt = torch.randint(0, 50257, (1, PROMPT_LEN))
     kw = dict(do_sample=True, top_p=0.95, top_k=50, temperature=0.8)
+    best = 0.0
     with torch.no_grad():
         model.generate(prompt, max_new_tokens=8, **kw)  # warmup
-        t0 = time.perf_counter()
-        out = model.generate(prompt, max_new_tokens=NEW_TOKENS, **kw)
-        dt = time.perf_counter() - t0
-    n = out.shape[1] - PROMPT_LEN
-    return n / dt
+        for _ in range(3):   # best-of-3, same methodology as bench_ours
+            t0 = time.perf_counter()
+            out = model.generate(prompt, max_new_tokens=NEW_TOKENS, **kw)
+            dt = time.perf_counter() - t0
+            best = max(best, (out.shape[1] - PROMPT_LEN) / dt)
+    return best
 
 
 def bench_ours():
@@ -50,12 +52,15 @@ def bench_ours():
     rng = np.random.default_rng(0)
     prompt = rng.integers(0, cfg.vocab_size, PROMPT_LEN).tolist()
     sp = SamplingParams(temperature=0.8, top_k=50, top_p=0.95)
-    # warmup/compile (same chunk programs as the timed run)
+    # warmup/compile (same chunk programs as the timed runs)
     eng.generate([prompt], max_new_tokens=NEW_TOKENS, sampling=sp)
-    res = eng.generate([prompt], max_new_tokens=NEW_TOKENS, sampling=sp)
-    total_ms = res.prefill_ms + res.decode_ms
-    n = len(res.tokens[0])
-    return n / (total_ms / 1e3)
+    best = 0.0
+    for _ in range(3):   # best-of-3: the chip is tunnel-attached and the
+        # per-dispatch RPC latency is noisy run to run
+        res = eng.generate([prompt], max_new_tokens=NEW_TOKENS, sampling=sp)
+        total_ms = res.prefill_ms + res.decode_ms
+        best = max(best, len(res.tokens[0]) / (total_ms / 1e3))
+    return best
 
 
 def main():

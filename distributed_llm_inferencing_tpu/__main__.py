@@ -36,6 +36,28 @@ def main(argv=None):
     p.add_argument("--max_seq", type=int, default=2048)
     p.add_argument("--batch", type=int, default=1)
 
+    a = sub.add_parser("admin", help="operate on a running master "
+                                     "(≙ reference Django admin, admin.py:4-19)")
+    a.add_argument("--master", default="http://127.0.0.1:8000")
+    a.add_argument("--auth_key", default=None)
+    asub = a.add_subparsers(dest="admin_cmd", required=True)
+    asub.add_parser("nodes", help="list nodes with live status")
+    an = asub.add_parser("add-node", help="register a worker")
+    an.add_argument("--name", required=True)
+    an.add_argument("--node_host", required=True)
+    an.add_argument("--node_port", type=int, default=8100)
+    ar = asub.add_parser("remove-node", help="deregister a worker")
+    ar.add_argument("--node_id", type=int, required=True)
+    asub.add_parser("requests", help="recent inference requests + counts")
+    asub.add_parser("plans", help="list placement plans")
+    al = asub.add_parser("load-model", help="load a model on a worker")
+    al.add_argument("--model_name", required=True)
+    al.add_argument("--node_id", type=int)
+    al.add_argument("--native_checkpoint")
+    al.add_argument("--checkpoint_path")
+    al.add_argument("--serving", choices=["batched"])
+    al.add_argument("--allow_random_init", action="store_true")
+
     c = sub.add_parser("convert", help="HF checkpoint -> native sharded "
                                        "checkpoint (models/checkpoint.py)")
     c.add_argument("--checkpoint_path", help="local HF checkpoint dir")
@@ -69,6 +91,8 @@ def main(argv=None):
                          batch=args.batch)
         json.dump(plan, sys.stdout, indent=2)
         print()
+    elif args.cmd == "admin":
+        _admin(args)
     elif args.cmd == "convert":
         from distributed_llm_inferencing_tpu.models import checkpoint
         if args.checkpoint_path:
@@ -89,6 +113,50 @@ def main(argv=None):
         print(f"saved native checkpoint for {cfg.name} -> {args.out}")
     elif args.cmd == "generate":
         _generate(args)
+
+
+def _admin(args):
+    """Thin HTTP client for the master's API — the CRUD surface the
+    reference exposed only through Django admin (admin.py:4-19)."""
+    import requests
+    base = args.master.rstrip("/")
+    headers = ({"Authorization": f"Bearer {args.auth_key}"}
+               if args.auth_key else {})
+
+    def show(resp):
+        try:
+            json.dump(resp.json(), sys.stdout, indent=2)
+            print()
+        except ValueError:
+            print(resp.status_code, resp.text[:500])
+        if resp.status_code != 200:
+            sys.exit(1)
+
+    if args.admin_cmd == "nodes":
+        show(requests.get(f"{base}/api/nodes/status", headers=headers,
+                          timeout=30))
+    elif args.admin_cmd == "add-node":
+        show(requests.post(f"{base}/api/nodes/add", headers=headers, json={
+            "name": args.name, "host": args.node_host,
+            "port": args.node_port}, timeout=30))
+    elif args.admin_cmd == "remove-node":
+        show(requests.post(f"{base}/api/nodes/remove/{args.node_id}",
+                           headers=headers, json={}, timeout=30))
+    elif args.admin_cmd == "requests":
+        show(requests.get(f"{base}/api/inference/recent", headers=headers,
+                          timeout=30))
+    elif args.admin_cmd == "plans":
+        show(requests.get(f"{base}/api/plans", headers=headers, timeout=30))
+    elif args.admin_cmd == "load-model":
+        body = {"model_name": args.model_name}
+        for k in ("node_id", "native_checkpoint", "checkpoint_path",
+                  "serving"):
+            if getattr(args, k, None):
+                body[k] = getattr(args, k)
+        if args.allow_random_init:
+            body["allow_random_init"] = True
+        show(requests.post(f"{base}/api/models/load", headers=headers,
+                           json=body, timeout=600))
 
 
 def _generate(args):

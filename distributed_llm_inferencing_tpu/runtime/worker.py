@@ -67,6 +67,7 @@ class WorkerAgent:
         s.add("POST", "/profile/start", self.profile_start)
         s.add("POST", "/profile/stop", self.profile_stop)
         s.add("GET", "/memory_profile", self.memory_profile)
+        s.add("POST", "/ssh_setup", self.ssh_setup)
         self._profile_dir: Optional[str] = None
         self._profile_lock = threading.Lock()
 
@@ -422,6 +423,49 @@ class WorkerAgent:
         """Live device-memory profile (pprof protobuf), HBM ground truth."""
         import jax.profiler
         return (jax.profiler.device_memory_profile(), "application/protobuf")
+
+    def ssh_setup(self, body):
+        """Reference parity (worker/app.py:374-413): probe an SSH
+        connection with the given credentials, then close it. Like the
+        reference this is a connectivity TEST only — no tunnel is kept.
+        Unlike the reference (which imported paramiko unconditionally but
+        never declared it, SURVEY.md §5.9) the dependency is optional, and
+        unlike the reference the endpoint demands worker auth: an open
+        /ssh_setup is an SSRF/port-scan primitive and can be pointed at
+        the operator's own key files."""
+        if self.service.auth_key is None:
+            return 403, {"status": "error",
+                         "message": "/ssh_setup requires worker auth "
+                                    "(set DLI_AUTH_ENABLED + DLI_AUTH_KEY)"}
+        try:
+            import paramiko
+        except ImportError:
+            return 501, {"status": "error",
+                         "message": "paramiko not installed on this worker"}
+        host = body.get("host")
+        username = body.get("username")
+        if not host or not username:
+            return 400, {"status": "error",
+                         "message": "host and username required"}
+        client = paramiko.SSHClient()
+        client.set_missing_host_key_policy(paramiko.AutoAddPolicy())
+        try:
+            kw = {"hostname": host, "port": int(body.get("port", 22)),
+                  "username": username, "timeout": 10}
+            if body.get("key_path"):
+                kw["key_filename"] = body["key_path"]
+            elif body.get("password"):
+                kw["password"] = body["password"]
+            else:
+                return 400, {"status": "error",
+                             "message": "password or key_path required"}
+            client.connect(**kw)
+            return {"status": "success",
+                    "message": f"SSH connection to {host} verified"}
+        except Exception as e:
+            return 502, {"status": "error", "message": f"SSH failed: {e}"}
+        finally:
+            client.close()
 
     # ---- lifecycle ---------------------------------------------------
 
