@@ -22,6 +22,19 @@ def main(argv=None):
     w = sub.add_parser("worker", help="run a worker agent (data plane)")
     w.add_argument("--host", default="0.0.0.0")
     w.add_argument("--port", type=int, default=8100)
+    # Multi-host slice (runtime/multihost.py): every host joins one
+    # jax.distributed job; process 0 is the lockstep leader serving the
+    # public API, the rest co-execute forwarded ops in sequence order.
+    w.add_argument("--coordinator", help="host:port of the jax.distributed "
+                                         "coordinator (multi-host slices)")
+    w.add_argument("--process_id", type=int, default=None)
+    w.add_argument("--num_processes", type=int, default=None)
+    w.add_argument("--followers",
+                   help="leader only: comma-separated follower host:port "
+                        "worker addresses (processes 1..N-1)")
+    w.add_argument("--platform",
+                   help="force the jax platform (tpu|cpu) before device "
+                        "init — e.g. cpu for transport testing")
 
     m = sub.add_parser("master", help="run the master (control plane)")
     m.add_argument("--host", default="0.0.0.0")
@@ -79,8 +92,29 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     if args.cmd == "worker":
+        if getattr(args, "platform", None):
+            import jax
+            jax.config.update("jax_platforms", args.platform)
         from distributed_llm_inferencing_tpu.runtime.worker import WorkerAgent
-        WorkerAgent().serve(args.host, args.port)
+        if args.coordinator:
+            import os
+            from distributed_llm_inferencing_tpu.runtime.multihost import (
+                LockstepFollower, LockstepLeader, init_multihost)
+            pid, n = init_multihost(args.coordinator, args.num_processes,
+                                    args.process_id)
+            agent = WorkerAgent()
+            if pid == 0:
+                followers = [f for f in (args.followers or "").split(",") if f]
+                if n > 1 and len(followers) != n - 1:
+                    sys.exit(f"leader needs --followers with {n - 1} "
+                             "worker addresses")
+                LockstepLeader(agent, followers,
+                               auth_key=os.environ.get("DLI_AUTH_KEY"))
+            else:
+                LockstepFollower(agent)
+            agent.serve(args.host, args.port)
+        else:
+            WorkerAgent().serve(args.host, args.port)
     elif args.cmd == "master":
         from distributed_llm_inferencing_tpu.runtime.master import Master
         Master(args.db).serve(args.host, args.port)

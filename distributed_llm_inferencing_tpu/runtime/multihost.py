@@ -1,0 +1,345 @@
+"""Multi-host lockstep serving: one pjit program spanning TPU hosts.
+
+The reference's "distributed" execution was per-hop HTTP between
+independent single-device workers (SURVEY.md §2.6). On a multi-host TPU
+slice the data plane is instead ONE SPMD program: every host joins a
+``jax.distributed`` job, a ``Mesh`` spans all hosts' chips, and XLA
+collectives ride ICI/DCN inside the jitted step. What the framework must
+guarantee is the *control* invariant that SPMD imposes: **every process
+launches the same programs in the same order**, or collectives deadlock.
+
+This module provides that guarantee for the worker RPC surface:
+
+- The **leader** (process 0) serves the public API. Every state-changing
+  or compute op (load/unload/inference) is assigned a global sequence
+  number, forwarded to every follower's ``/lockstep`` endpoint, and
+  executed locally through the same sequence-ordered executor.
+- **Followers** serve only ``/lockstep``: they enqueue forwarded ops and
+  execute them strictly in sequence order, discarding results — their
+  role is to co-execute the SPMD programs so the leader's collectives
+  have partners. Direct calls to their mutating endpoints return 409.
+
+Determinism notes (what makes co-execution bit-identical): the leader
+resolves the sampling ``seed`` before forwarding (engine outputs are a
+pure function of (params, prompt, seed)); random-init uses a fixed seed;
+checkpoints/tokenizers load from the same paths on every host. Batched
+serving (runtime/batcher.py) makes timing-dependent scheduling decisions
+and is therefore leader-rejected on multi-host slices — mesh-sharded
+engine mode is the multi-host path.
+
+Tested with multi-process CPU ``jax.distributed`` clusters
+(tests/test_multihost.py) — the same code path as real multi-host TPU.
+"""
+
+from __future__ import annotations
+
+import heapq
+import queue
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import requests as http
+
+from distributed_llm_inferencing_tpu.runtime import httpd
+from distributed_llm_inferencing_tpu.utils.logging import setup_logging
+
+log = setup_logging("multihost")
+
+FORWARD_TIMEOUT = 30
+
+
+class LockstepExecutor:
+    """Executes submitted thunks strictly in sequence-number order."""
+
+    def __init__(self):
+        self._heap: list = []
+        self._cv = threading.Condition()
+        self._next = 0
+        self._stopped = False
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="lockstep-exec")
+        self._thread.start()
+
+    def submit(self, seq: int, fn: Callable):
+        box = {"done": threading.Event(), "result": None, "error": None}
+        with self._cv:
+            heapq.heappush(self._heap, (seq, id(box), fn, box))
+            self._cv.notify_all()
+        return box
+
+    def run(self, seq: int, fn: Callable):
+        box = self.submit(seq, fn)
+        box["done"].wait()
+        if box["error"] is not None:
+            raise box["error"]
+        return box["result"]
+
+    def stop(self):
+        with self._cv:
+            self._stopped = True
+            self._cv.notify_all()
+
+    def _loop(self):
+        while True:
+            with self._cv:
+                # drop stale entries (seq already executed) so a duplicate
+                # can never wedge the queue
+                while self._heap and self._heap[0][0] < self._next:
+                    _, _, _, stale = heapq.heappop(self._heap)
+                    stale["error"] = RuntimeError("stale sequence number")
+                    stale["done"].set()
+                while not (self._heap and self._heap[0][0] == self._next):
+                    if self._stopped:
+                        return
+                    self._cv.wait(0.5)
+                    while self._heap and self._heap[0][0] < self._next:
+                        _, _, _, stale = heapq.heappop(self._heap)
+                        stale["error"] = RuntimeError("stale sequence number")
+                        stale["done"].set()
+                seq, _, fn, box = heapq.heappop(self._heap)
+                self._next += 1
+            try:
+                box["result"] = fn()
+            except Exception as e:  # surfaced to the waiting handler
+                box["error"] = e
+            box["done"].set()
+
+
+def _try(fn, *args):
+    try:
+        fn(*args)
+        return None
+    except Exception as e:
+        return e
+
+
+def _replace_route(service: httpd.JsonHTTPService, method: str,
+                   pattern: str, fn: Callable):
+    probe = httpd.Route(method, pattern, fn)
+    for r in service.routes:
+        if r.method == method and r.regex.pattern == probe.regex.pattern:
+            r.fn = fn
+            return
+    service.routes.append(probe)
+
+
+MIRRORED_OPS = ("load_model", "load_shard", "unload_model", "inference")
+
+
+class LockstepLeader:
+    """Wraps a WorkerAgent's service as the slice leader."""
+
+    def __init__(self, agent, followers: List[str],
+                 auth_key: Optional[str] = None):
+        self.agent = agent
+        self.followers = [f if f.startswith("http") else f"http://{f}"
+                          for f in followers]
+        self._auth = auth_key
+        self.exec = LockstepExecutor()
+        self._mirror_lock = threading.Lock()
+        self._seq = 0
+        self._degraded: Optional[str] = None
+        s = agent.service
+        for op in MIRRORED_OPS:
+            _replace_route(s, "POST", f"/{op}", self._make_handler(op))
+        _replace_route(s, "POST", "/inference_stream", self.inference_stream)
+
+    def _headers(self):
+        return ({"Authorization": f"Bearer {self._auth}"}
+                if self._auth else {})
+
+    def _mirror(self, op: str, body: dict) -> int:
+        """Assign a sequence number and forward to every follower.
+
+        Forwards run concurrently (latency = max follower RTT, not sum).
+        A failed forward means some hosts hold ops others don't — SPMD
+        consistency is unrecoverable without a restart, so the slice is
+        marked permanently degraded: the leader submits a local noop for
+        the consumed seq (its own executor never wedges on the gap) and
+        every later mirrored op is refused fast with 503.
+        """
+        from concurrent.futures import ThreadPoolExecutor
+        with self._mirror_lock:
+            if self._degraded:
+                raise RuntimeError(self._degraded)
+            seq = self._seq
+            self._seq += 1
+
+            def fwd(f):
+                r = http.post(f"{f}/lockstep",
+                              json={"seq": seq, "op": op, "body": body},
+                              headers=self._headers(),
+                              timeout=FORWARD_TIMEOUT)
+                r.raise_for_status()
+
+            if self.followers:
+                with ThreadPoolExecutor(len(self.followers)) as pool:
+                    errs = [e for e in pool.map(
+                        lambda f: _try(fwd, f), self.followers)
+                        if e is not None]
+            else:
+                errs = []
+            if errs:
+                self._degraded = (
+                    f"lockstep forward of {op} failed ({errs[0]}); slice "
+                    "degraded — restart the slice workers to recover")
+                log.error(self._degraded)
+                self.exec.submit(seq, lambda: None)   # fill the gap locally
+                raise RuntimeError(self._degraded)
+            return seq
+
+    def _prepare(self, op: str, body: dict) -> dict:
+        body = dict(body)
+        if op in ("inference", "inference_stream"):
+            # identical RNG stream on every host
+            body.setdefault("seed", time.time_ns() % (1 << 31))
+        if op in ("load_model", "load_shard") \
+                and body.get("serving") == "batched":
+            raise ValueError(
+                "batched serving makes timing-dependent scheduling "
+                "decisions and cannot run in lockstep across hosts; use "
+                "mesh-sharded engine mode on multi-host slices")
+        return body
+
+    def _make_handler(self, op: str):
+        local = getattr(self.agent, op)
+
+        def handler(body):
+            try:
+                body = self._prepare(op, body)
+            except ValueError as e:
+                return 400, {"status": "error", "message": str(e)}
+            try:
+                seq = self._mirror(op, body)
+            except RuntimeError as e:
+                return 503, {"status": "error", "message": str(e)}
+            return self.exec.run(seq, lambda: local(body))
+
+        handler.__name__ = f"lockstep_{op}"
+        return handler
+
+    def inference_stream(self, body, _request=None):
+        """Leader streams SSE to the client; followers co-execute the same
+        generation as a plain inference (same seed/eos ⇒ same program
+        sequence; only host-side sync timing differs)."""
+        try:
+            body = self._prepare("inference_stream", body)
+            m, prompt, sp, max_new = self.agent._prep_inference(body)
+        except (KeyError, ValueError) as e:
+            return 400, {"status": "error", "message": str(e)}
+        if m.batcher is not None:
+            return 400, {"status": "error",
+                         "message": "batched serving unsupported in lockstep"}
+        try:
+            seq = self._mirror("inference_stream", body)
+        except RuntimeError as e:
+            return 503, {"status": "error", "message": str(e)}
+
+        q: "queue.Queue" = queue.Queue()
+        done = object()
+
+        def cb(step, toks):
+            if toks[0] is not None:
+                q.put({"event": "token", "step": step, "token": toks[0],
+                       "text": m.tokenizer.decode([toks[0]])})
+
+        def local():
+            try:
+                with m.lock:
+                    res = m.engine.generate(
+                        [prompt], max_new_tokens=max_new, sampling=sp,
+                        seed=int(body["seed"]),
+                        eos_token_id=m.tokenizer.eos_token_id, stream_cb=cb)
+                q.put({"event": "done",
+                       "result": m.tokenizer.decode(res.tokens[0]),
+                       "tokens_per_s": res.decode_tokens_per_s})
+            except Exception as e:
+                q.put({"event": "error", "message": str(e)})
+            q.put(done)
+
+        self.exec.submit(seq, local)
+
+        def events():
+            while True:
+                item = q.get()
+                if item is done:
+                    break
+                yield item
+
+        return httpd.sse_stream(_request, events())
+
+
+class LockstepFollower:
+    """Wraps a WorkerAgent's service as a follower: executes forwarded ops
+    in order; rejects direct mutating calls."""
+
+    def __init__(self, agent):
+        self.agent = agent
+        self.exec = LockstepExecutor()
+        self._seen_lock = threading.Lock()
+        self._seen: set = set()
+        if agent.service.auth_key is None:
+            log.warning(
+                "lockstep follower has NO auth key: /lockstep is slice "
+                "control — bind to a trusted network or set "
+                "DLI_AUTH_ENABLED + DLI_AUTH_KEY on every worker")
+        self._ops: Dict[str, Callable] = {
+            "load_model": agent.load_model,
+            "load_shard": agent.load_shard,
+            "unload_model": agent.unload_model,
+            "inference": agent.inference,
+            # co-execute the leader's stream as a plain generation: same
+            # seed and eos give the identical jit/collective sequence
+            "inference_stream": agent.inference,
+            "noop": lambda body: {"status": "noop"},
+        }
+        s = agent.service
+        s.add("POST", "/lockstep", self.lockstep)
+        for op in MIRRORED_OPS + ("inference_stream",):
+            _replace_route(s, "POST", f"/{op}", self._rejected(op))
+
+    def _rejected(self, op):
+        def handler(body, _request=None):
+            return 409, {"status": "error",
+                         "message": f"this worker is a lockstep follower; "
+                                    f"send {op} to the slice leader"}
+        handler.__name__ = f"follower_reject_{op}"
+        return handler
+
+    def lockstep(self, body):
+        seq = body.get("seq")
+        op = body.get("op")
+        if not isinstance(seq, int) or seq < 0 or op not in self._ops:
+            return 400, {"status": "error", "message": "bad lockstep op"}
+        with self._seen_lock:
+            # duplicates/stale seqs would wedge or desync the ordered
+            # executor — refuse them at the door
+            if seq in self._seen or seq < self.exec._next:
+                return 409, {"status": "error",
+                             "message": f"sequence {seq} already received"}
+            self._seen.add(seq)
+        fn = self._ops[op]
+        payload = body.get("body", {})
+
+        def run():
+            try:
+                r = fn(payload)
+                status = r[0] if isinstance(r, tuple) else 200
+                if status != 200:
+                    log.warning("lockstep %s (seq %d) returned %s: %s",
+                                op, seq, status, r)
+            except Exception as e:
+                log.error("lockstep %s (seq %d) raised: %s", op, seq, e)
+
+        self.exec.submit(int(seq), run)
+        return {"status": "queued", "seq": seq}
+
+
+def init_multihost(coordinator: str, num_processes: int, process_id: int):
+    """Join the slice's jax.distributed job (before any jax device use)."""
+    import jax
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    return jax.process_index(), jax.process_count()
