@@ -79,6 +79,8 @@ def main(argv=None):
     c.add_argument("--allow_random_init", action="store_true")
     c.add_argument("--out", required=True)
     c.add_argument("--dtype")
+    c.add_argument("--quantize", choices=["int8"],
+                   help="store int8 weight-only quantized weights")
 
     g = sub.add_parser("generate", help="one-shot local generation")
     g.add_argument("--model_name", default="gpt2")
@@ -131,7 +133,8 @@ def main(argv=None):
         from distributed_llm_inferencing_tpu.models import checkpoint
         if args.checkpoint_path:
             cfg = checkpoint.convert_hf_to_native(
-                args.checkpoint_path, args.out, dtype=args.dtype)
+                args.checkpoint_path, args.out, dtype=args.dtype,
+                quantize=args.quantize)
         elif args.allow_random_init and args.model_name:
             import jax
             from distributed_llm_inferencing_tpu.models.params import init_params
@@ -139,6 +142,8 @@ def main(argv=None):
             cfg = get_config(args.model_name)
             if args.dtype:
                 cfg = cfg.replace(dtype=args.dtype)
+            if args.quantize:
+                cfg = cfg.replace(quant=args.quantize)
             checkpoint.save_checkpoint(
                 args.out, cfg, init_params(cfg, jax.random.PRNGKey(0)))
         else:

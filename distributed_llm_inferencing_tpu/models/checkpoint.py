@@ -95,12 +95,15 @@ def load_checkpoint(path: str, *, mesh=None, mesh_spec=None,
 
 
 def convert_hf_to_native(hf_path: str, out_path: str,
-                         dtype: Optional[str] = None) -> ModelConfig:
+                         dtype: Optional[str] = None,
+                         quantize: Optional[str] = None) -> ModelConfig:
     """One-shot HF → native conversion (the ``convert`` CLI verb).
 
     After this, serving never touches torch/transformers for weights again
     — the reference re-ran its HF load on every worker cold start
-    (reference: worker/app.py:117-121).
+    (reference: worker/app.py:117-121). With ``quantize="int8"`` the
+    checkpoint itself stores int8 matmul weights (ops/quant.py): half the
+    bytes on disk and on restore.
     """
     from distributed_llm_inferencing_tpu.models.convert import load_hf_model
     cfg, params = load_hf_model(hf_path)
@@ -109,6 +112,10 @@ def convert_hf_to_native(hf_path: str, out_path: str,
         params = jax.tree.map(
             lambda x: x.astype(jnp.dtype(dtype))
             if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
+    if quantize:
+        from distributed_llm_inferencing_tpu.ops.quant import maybe_quantize
+        cfg = cfg.replace(quant=quantize)
+        params = maybe_quantize(params, cfg)
     save_checkpoint(out_path, cfg, params)
     # carry the tokenizer along so the native dir is self-contained (the
     # worker falls back to byte-level tokenization without one)

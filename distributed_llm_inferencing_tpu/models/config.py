@@ -54,6 +54,9 @@ class ModelConfig:
 
     # Numerics
     dtype: str = "bfloat16"  # activation/weight dtype on device
+    # Weight-only quantization (ops/quant.py): None | "int8". Halves the
+    # HBM weight traffic of decode and doubles fit-per-chip.
+    quant: Optional[str] = None
 
     # Attention kernel backend: auto | xla | pallas | pallas_interpret
     # (trace-time static; see ops/attention.py resolve_backend)

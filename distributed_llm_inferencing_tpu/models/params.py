@@ -78,6 +78,9 @@ def init_params(cfg: ModelConfig, key, dtype=None):
         params["embed"]["positions"] = w((cfg.max_position_embeddings, D))
     if not cfg.tie_word_embeddings:
         params["lm_head"] = {"w": w((D, cfg.vocab_size))}
+    if cfg.quant:
+        from distributed_llm_inferencing_tpu.ops.quant import maybe_quantize
+        params = maybe_quantize(params, cfg)
     return params
 
 

@@ -52,7 +52,13 @@ from distributed_llm_inferencing_tpu.ops.rope import apply_rope
 
 
 def _linear(x, p):
-    y = jnp.einsum("...d,df->...f", x, p["w"])
+    if "q" in p:   # int8 weight-only (ops/quant.py): per-out-channel scale
+        # commutes with the contraction, so it applies to the [.., dout]
+        # output — the MXU reads int8 weights, no dequantized temporary
+        y = jnp.einsum("...d,df->...f", x, p["q"].astype(x.dtype))
+        y = y * p["scale"].astype(x.dtype)
+    else:
+        y = jnp.einsum("...d,df->...f", x, p["w"])
     if "b" in p:
         y = y + p["b"]
     return y.astype(x.dtype)
@@ -94,10 +100,17 @@ def _moe(x, lp, cfg: ModelConfig):
     gate = jnp.where(probs >= kth, probs, 0.0)
     gate = gate / jnp.sum(gate, axis=-1, keepdims=True)     # [...,E]
 
+    def ew(operand, p, eq):
+        """Expert einsum with optional int8 weights (scale on output)."""
+        if "q" in p:
+            y = jnp.einsum(eq, operand, p["q"].astype(operand.dtype))
+            return y * p["scale"].astype(operand.dtype)
+        return jnp.einsum(eq, operand, p["w"])
+
     ex = lp["experts"]
-    h = _act(jnp.einsum("...d,edi->...ei", x, ex["gate"]["w"]), cfg.activation)
-    h = h * jnp.einsum("...d,edi->...ei", x, ex["up"]["w"])
-    out = jnp.einsum("...ei,eid->...ed", h, ex["down"]["w"])  # [...,E,D]
+    h = _act(ew(x, ex["gate"], "...d,edi->...ei"), cfg.activation)
+    h = h * ew(x, ex["up"], "...d,edi->...ei")
+    out = ew(h, ex["down"], "...ei,eid->...ed")  # [...,E,D]
     out = jnp.einsum("...ed,...e->...d", out.astype(jnp.float32), gate)
     return out.astype(x.dtype)
 

@@ -1,0 +1,89 @@
+"""Weight-only int8 quantization (per-output-channel symmetric).
+
+Decode is HBM-bandwidth-bound: every step streams the full weight set
+through the MXU. Storing matmul weights as int8 halves that traffic vs
+bf16 — and doubles the model size that fits one chip. Activations stay
+bf16; accuracy cost of per-channel weight-only int8 is negligible for
+serving (the standard vLLM/TGI weight-only trade).
+
+Scheme: for a weight ``w [..., din, dout]``, ``scale[..., dout] =
+max|w|/127`` over din, ``q = round(w / scale)``. Because the scale is
+per *output* channel it commutes with the contraction:
+
+    y = x @ (q * scale) == (x @ q) * scale
+
+so the kernel runs ``x_bf16 @ q->bf16`` (int8 reads, MXU-native
+convert) and applies one cheap [dout] multiply on the output — no
+weight-sized dequantized temporary ever exists.
+
+A quantized leaf is ``{"q": int8[..., din, dout], "scale":
+f32[..., dout]}`` (+"b" unchanged); models/transformer.py's ``_linear``
+and ``_moe`` dispatch on the presence of "q". No reference counterpart
+at any level (SURVEY.md §2.5 — its compute was vendored torch/CUDA).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# leaves quantized under params["layers"] / params root
+_LINEAR_LEAVES = ("q", "k", "v", "o", "up", "gate", "down")
+
+
+def quantize_weight(w) -> dict:
+    """w [..., din, dout] -> {"q": int8, "scale": f32 [..., dout]}."""
+    w32 = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(w32), axis=-2)              # [..., dout]
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w32 / scale[..., None, :]), -127, 127)
+    return {"q": q.astype(jnp.int8), "scale": scale}
+
+
+def is_quantized(p: dict) -> bool:
+    return isinstance(p, dict) and "q" in p
+
+
+def _quant_linear(p: dict) -> dict:
+    if is_quantized(p) or "w" not in p:
+        return p
+    out = dict(p)
+    w = out.pop("w")
+    out.update(quantize_weight(w))
+    return out
+
+
+def quantize_params(params, cfg) -> dict:
+    """Quantize the big matmul weights of a transformer param pytree.
+
+    Covered: per-layer q/k/v/o, MLP up/gate/down, MoE expert weights, and
+    the untied lm_head. Kept in float: embeddings (gather-addressed and,
+    when tied, shared with the head), norms, biases, MoE router (tiny,
+    routing-critical). Idempotent.
+    """
+    params = dict(params)
+    layers = dict(params["layers"])
+    for name in _LINEAR_LEAVES:
+        if name in layers:
+            layers[name] = _quant_linear(layers[name])
+    if "experts" in layers:
+        layers["experts"] = {k: _quant_linear(v)
+                             for k, v in layers["experts"].items()}
+    params["layers"] = layers
+    if "lm_head" in params:
+        params["lm_head"] = _quant_linear(params["lm_head"])
+    return params
+
+
+def maybe_quantize(params, cfg):
+    """Apply cfg.quant to a (possibly already quantized) param tree."""
+    if cfg.quant is None:
+        return params
+    if cfg.quant != "int8":
+        raise ValueError(f"unknown quant mode {cfg.quant!r}")
+    return quantize_params(params, cfg)
+
+
+def dequantize_weight(p: dict):
+    """Materialize the float weight (tests / conversion tooling)."""
+    return p["q"].astype(jnp.float32) * p["scale"][..., None, :]

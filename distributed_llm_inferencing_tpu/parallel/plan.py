@@ -32,7 +32,8 @@ def _leaf_entries(cfg: ModelConfig, specs, prefix=""):
 
 
 def _param_shapes(cfg: ModelConfig) -> Dict[str, tuple]:
-    """Shapes per param leaf without materializing arrays."""
+    """(shape, itemsize) per param leaf without materializing arrays —
+    itemsize is per-leaf since int8 quant mixes widths (ops/quant.py)."""
     import jax
     from distributed_llm_inferencing_tpu.models.params import init_params
     shapes = jax.eval_shape(
@@ -44,7 +45,7 @@ def _param_shapes(cfg: ModelConfig) -> Dict[str, tuple]:
             if isinstance(v, dict):
                 walk(v, f"{prefix}{k}.")
             else:
-                flat[f"{prefix}{k}"] = tuple(v.shape)
+                flat[f"{prefix}{k}"] = (tuple(v.shape), v.dtype.itemsize)
     walk(shapes)
     return flat
 
@@ -63,7 +64,7 @@ def make_plan(model: str | ModelConfig, mesh: Dict[str, int] | MeshSpec,
     total = 0
     per_device = 0
     leaves = {}
-    for path, shape in shapes.items():
+    for path, (shape, itemsize) in shapes.items():
         n = 1
         for d in shape:
             n *= d
@@ -71,8 +72,8 @@ def make_plan(model: str | ModelConfig, mesh: Dict[str, int] | MeshSpec,
         for axis in pspecs.get(path, []):
             if axis is not None:
                 shard_factor *= axis_sizes[axis]
-        total += n * bytes_per_el
-        per_device += n * bytes_per_el // shard_factor
+        total += n * itemsize
+        per_device += n * itemsize // shard_factor
         leaves[path] = {"shape": list(shape), "spec": pspecs.get(path)}
 
     # KV cache per device

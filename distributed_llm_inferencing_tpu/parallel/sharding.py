@@ -47,12 +47,19 @@ def param_specs(cfg: ModelConfig, spec: MeshSpec,
             p["bias"] = P(L, None)
         return p
 
+    def lin(spec: P) -> Dict[str, Any]:
+        """Leaf specs for a linear weight; int8 quant (ops/quant.py) adds
+        a per-out-channel scale sharded like the weight's last axis."""
+        if not cfg.quant:
+            return {"w": spec}
+        return {"q": spec, "scale": P(*(spec[:-2] + spec[-1:]))}
+
     layers: Dict[str, Any] = {
         "attn_norm": norm_p(),
-        "q": {"w": P(L, None, "tp")},
-        "k": {"w": P(L, None, kv_tp)},
-        "v": {"w": P(L, None, kv_tp)},
-        "o": {"w": P(L, "tp", None)},
+        "q": lin(P(L, None, "tp")),
+        "k": lin(P(L, None, kv_tp)),
+        "v": lin(P(L, None, kv_tp)),
+        "o": lin(P(L, "tp", None)),
         "mlp_norm": norm_p(),
     }
     if cfg.attn_bias:
@@ -63,15 +70,15 @@ def param_specs(cfg: ModelConfig, spec: MeshSpec,
     if cfg.is_moe:
         layers["router"] = {"w": P(L, None, None)}
         layers["experts"] = {
-            "gate": {"w": P(L, "ep", None, "tp")},
-            "up": {"w": P(L, "ep", None, "tp")},
-            "down": {"w": P(L, "ep", "tp", None)},
+            "gate": lin(P(L, "ep", None, "tp")),
+            "up": lin(P(L, "ep", None, "tp")),
+            "down": lin(P(L, "ep", "tp", None)),
         }
     else:
-        layers["up"] = {"w": P(L, None, "tp")}
+        layers["up"] = lin(P(L, None, "tp"))
         if cfg.gated_mlp:
-            layers["gate"] = {"w": P(L, None, "tp")}
-        layers["down"] = {"w": P(L, "tp", None)}
+            layers["gate"] = lin(P(L, None, "tp"))
+        layers["down"] = lin(P(L, "tp", None))
         if cfg.mlp_bias:
             layers["up"]["b"] = P(L, "tp")
             layers["down"]["b"] = P(L, None)
@@ -90,7 +97,7 @@ def param_specs(cfg: ModelConfig, spec: MeshSpec,
     if cfg.position_embedding == "learned":
         specs["embed"]["positions"] = P(None, None)
     if not cfg.tie_word_embeddings:
-        specs["lm_head"] = {"w": P(None, "tp")}
+        specs["lm_head"] = lin(P(None, "tp"))
     return specs
 
 

@@ -107,6 +107,9 @@ class ContinuousBatcher:
         self.max_blocks = -(-self.max_seq // block_size)
         if params is None:
             params = init_params(cfg, jax.random.PRNGKey(seed))
+        else:
+            from distributed_llm_inferencing_tpu.ops.quant import maybe_quantize
+            params = maybe_quantize(params, cfg)
         self.params = params
 
         # +1: block 0 is the reserved dummy every inactive table entry

@@ -34,7 +34,6 @@ Tested with multi-process CPU ``jax.distributed`` clusters
 from __future__ import annotations
 
 import heapq
-import queue
 import threading
 import time
 from typing import Callable, Dict, List, Optional
@@ -222,52 +221,25 @@ class LockstepLeader:
     def inference_stream(self, body, _request=None):
         """Leader streams SSE to the client; followers co-execute the same
         generation as a plain inference (same seed/eos ⇒ same program
-        sequence; only host-side sync timing differs)."""
+        sequence; only host-side sync timing differs).
+
+        Model resolution happens INSIDE the sequence slot (via the
+        worker's engine_stream_events), so the stream observes exactly
+        the state the lockstep order establishes — e.g. an earlier
+        mirrored unload fails it identically on every host instead of
+        generating against a stale engine only the leader still holds.
+        """
         try:
             body = self._prepare("inference_stream", body)
-            m, prompt, sp, max_new = self.agent._prep_inference(body)
-        except (KeyError, ValueError) as e:
+        except ValueError as e:
             return 400, {"status": "error", "message": str(e)}
-        if m.batcher is not None:
-            return 400, {"status": "error",
-                         "message": "batched serving unsupported in lockstep"}
         try:
             seq = self._mirror("inference_stream", body)
         except RuntimeError as e:
             return 503, {"status": "error", "message": str(e)}
-
-        q: "queue.Queue" = queue.Queue()
-        done = object()
-
-        def cb(step, toks):
-            if toks[0] is not None:
-                q.put({"event": "token", "step": step, "token": toks[0],
-                       "text": m.tokenizer.decode([toks[0]])})
-
-        def local():
-            try:
-                with m.lock:
-                    res = m.engine.generate(
-                        [prompt], max_new_tokens=max_new, sampling=sp,
-                        seed=int(body["seed"]),
-                        eos_token_id=m.tokenizer.eos_token_id, stream_cb=cb)
-                q.put({"event": "done",
-                       "result": m.tokenizer.decode(res.tokens[0]),
-                       "tokens_per_s": res.decode_tokens_per_s})
-            except Exception as e:
-                q.put({"event": "error", "message": str(e)})
-            q.put(done)
-
-        self.exec.submit(seq, local)
-
-        def events():
-            while True:
-                item = q.get()
-                if item is done:
-                    break
-                yield item
-
-        return httpd.sse_stream(_request, events())
+        ev = self.agent.engine_stream_events(
+            body, lambda fn: self.exec.submit(seq, fn))
+        return httpd.sse_stream(_request, ev)
 
 
 class LockstepFollower:
@@ -319,6 +291,10 @@ class LockstepFollower:
                 return 409, {"status": "error",
                              "message": f"sequence {seq} already received"}
             self._seen.add(seq)
+            if len(self._seen) > 4096:   # drop already-executed entries:
+                # seq < _next is rejected above regardless of membership
+                nxt = self.exec._next
+                self._seen = {s for s in self._seen if s >= nxt}
         fn = self._ops[op]
         payload = body.get("body", {})
 

@@ -179,6 +179,8 @@ class WorkerAgent:
             source = "random-init"
         if body.get("dtype"):
             cfg = cfg.replace(dtype=body["dtype"])
+        if body.get("quantize"):
+            cfg = cfg.replace(quant=body["quantize"])
         from distributed_llm_inferencing_tpu.utils.tokenizer import has_tokenizer
         tok_dir = body.get("tokenizer_path") or next(
             (d for d in (ckpt, native) if has_tokenizer(d)), None)
@@ -325,12 +327,67 @@ class WorkerAgent:
             "tokens_per_s": res.decode_tokens_per_s,
         }
 
+    def engine_stream_events(self, body, schedule):
+        """Engine-mode SSE event stream. ``schedule(fn)`` runs the blocking
+        generation (a daemon thread here; the lockstep leader schedules it
+        at the op's sequence slot instead — runtime/multihost.py). Prep
+        happens INSIDE fn so it observes whatever model state the
+        scheduled order establishes (e.g. after an earlier unload)."""
+        import queue
+        q: "queue.Queue" = queue.Queue()
+        done = object()
+
+        def run():
+            try:
+                m, prompt, sp, max_new = self._prep_inference(body)
+                if m.batcher is not None:
+                    raise ValueError(
+                        "engine_stream_events is for engine-mode models")
+
+                def cb(step, toks):
+                    if toks[0] is None:  # sequence finished (post-eos)
+                        return
+                    q.put({"event": "token", "step": step, "token": toks[0],
+                           "text": m.tokenizer.decode([toks[0]])})
+
+                with m.lock:
+                    res = m.engine.generate(
+                        [prompt], max_new_tokens=max_new, sampling=sp,
+                        seed=int(body.get("seed",
+                                          time.time_ns() % (1 << 31))),
+                        eos_token_id=m.tokenizer.eos_token_id,
+                        stream_cb=cb)
+                q.put({"event": "done",
+                       "result": m.tokenizer.decode(res.tokens[0]),
+                       "tokens_per_s": res.decode_tokens_per_s})
+            except Exception as e:
+                q.put({"event": "error", "message": str(e)})
+            q.put(done)
+
+        schedule(run)
+
+        def events():
+            while True:
+                item = q.get()
+                if item is done:
+                    break
+                yield item
+            self.metrics.inc("requests_completed")
+
+        return events()
+
     def inference_stream(self, body, _request=None):
         """SSE streaming decode — absent from the reference (SURVEY.md §2.3)."""
-        try:
-            m, prompt, sp, max_new = self._prep_inference(body)
-        except (KeyError, ValueError) as e:
-            return 400, {"status": "error", "message": str(e)}
+        m = self.models.get(body.get("model_name"))
+        if m is None:
+            return 400, {"status": "error",
+                         "message": f"model {body.get('model_name')} "
+                                    "not loaded"}
+        if m.batcher is None:
+            ev = self.engine_stream_events(
+                body, lambda fn: threading.Thread(target=fn,
+                                                  daemon=True).start())
+            return httpd.sse_stream(_request, ev)
 
         def events():
             import queue
@@ -346,6 +403,7 @@ class WorkerAgent:
                     step[0] += 1
 
                 try:
+                    _, prompt, sp, max_new = self._prep_inference(body)
                     req = m.batcher.submit(
                         prompt, max_new_tokens=max_new, sampling=sp,
                         eos_token_id=m.tokenizer.eos_token_id, stream_cb=cb,
@@ -358,30 +416,7 @@ class WorkerAgent:
                     q.put({"event": "error", "message": str(e)})
                 q.put(done)
 
-            def cb(step, toks):
-                if toks[0] is None:   # sequence already finished (post-eos)
-                    return
-                q.put({"event": "token", "step": step, "token": toks[0],
-                       "text": m.tokenizer.decode([toks[0]])})
-
-            def run():
-                try:
-                    with m.lock:
-                        res = m.engine.generate(
-                            [prompt], max_new_tokens=max_new, sampling=sp,
-                            seed=int(body.get("seed", time.time_ns() % (1 << 31))),
-                            eos_token_id=m.tokenizer.eos_token_id,
-                            stream_cb=cb)
-                    q.put({"event": "done",
-                           "result": m.tokenizer.decode(res.tokens[0]),
-                           "tokens_per_s": res.decode_tokens_per_s})
-                except Exception as e:
-                    q.put({"event": "error", "message": str(e)})
-                q.put(done)
-
-            threading.Thread(
-                target=run_batched if m.batcher is not None else run,
-                daemon=True).start()
+            threading.Thread(target=run_batched, daemon=True).start()
             while True:
                 item = q.get()
                 if item is done:

@@ -144,15 +144,24 @@ def test_follower_rejects_direct_calls(slice2):
 
 def test_follower_rejects_stale_or_duplicate_seq(slice2):
     """A replayed or stale sequence number must be refused at the door —
-    accepted duplicates would wedge or desync the ordered executor."""
+    accepted duplicates would wedge or desync the ordered executor.
+    Self-contained: uses a far-future noop seq so it neither depends on
+    earlier tests having consumed seqs nor perturbs slice state."""
     _, fport = slice2
-    # seq 0 was consumed by the module's earlier load_model
+    far = 999_983
     r = requests.post(f"http://127.0.0.1:{fport}/lockstep", json={
-        "seq": 0, "op": "unload_model", "body": {"model_name": "x"}},
+        "seq": far, "op": "noop", "body": {}}, timeout=30)
+    assert r.status_code == 200
+    # exact replay of an already-received seq
+    r = requests.post(f"http://127.0.0.1:{fport}/lockstep", json={
+        "seq": far, "op": "unload_model", "body": {"model_name": "x"}},
         timeout=30)
     assert r.status_code == 409
     r = requests.post(f"http://127.0.0.1:{fport}/lockstep", json={
         "seq": "nope", "op": "inference", "body": {}}, timeout=30)
+    assert r.status_code == 400
+    r = requests.post(f"http://127.0.0.1:{fport}/lockstep", json={
+        "seq": -3, "op": "noop", "body": {}}, timeout=30)
     assert r.status_code == 400
 
 

@@ -1,0 +1,110 @@
+"""Weight-only int8 quantization (ops/quant.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llm_inferencing_tpu.models import transformer
+from distributed_llm_inferencing_tpu.models.params import (
+    init_params, param_bytes)
+from distributed_llm_inferencing_tpu.models.registry import get_config
+from distributed_llm_inferencing_tpu.ops.kvcache import init_cache
+from distributed_llm_inferencing_tpu.ops.quant import (
+    dequantize_weight, maybe_quantize, quantize_weight)
+from distributed_llm_inferencing_tpu.ops.sampling import SamplingParams
+from distributed_llm_inferencing_tpu.runtime.engine import InferenceEngine
+
+
+def test_quantize_roundtrip_error():
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.standard_normal((64, 32)), jnp.float32)
+    q = quantize_weight(w)
+    assert q["q"].dtype == jnp.int8 and q["scale"].shape == (32,)
+    err = np.abs(np.asarray(dequantize_weight(q)) - np.asarray(w))
+    # per-channel symmetric int8: max error is scale/2 per channel
+    assert np.all(err <= np.asarray(q["scale"]) / 2 + 1e-7)
+
+
+@pytest.mark.parametrize("model", ["tiny-gpt2", "tiny-llama", "tiny-mixtral"])
+def test_quantized_logits_close(model):
+    cfg = get_config(model).replace(dtype="float32", attn_backend="xla")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    qcfg = cfg.replace(quant="int8")
+    qparams = maybe_quantize(params, qcfg)
+    # big matmul weights are int8 now
+    assert qparams["layers"]["q"]["q"].dtype == jnp.int8
+    assert param_bytes(qparams) < 0.75 * param_bytes(params)
+
+    toks = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 12)),
+        jnp.int32)
+    lens = jnp.full((2,), 12, jnp.int32)
+
+    def fwd(cfg_, p):
+        cache = init_cache(cfg_, 2, 16, dtype=jnp.float32)
+        logits, _ = transformer.prefill(p, cfg_, toks, lens, cache)
+        return np.asarray(logits)
+
+    full = fwd(cfg, params)
+    quant = fwd(qcfg, qparams)
+    # weight-only int8 should track full precision closely on random nets
+    rel = np.abs(quant - full) / (np.abs(full).mean() + 1e-6)
+    assert rel.mean() < 0.05, rel.mean()
+
+
+def test_engine_generate_int8_and_sharded():
+    cfg = get_config("tiny-llama").replace(dtype="float32",
+                                           attn_backend="xla", quant="int8")
+    params = init_params(get_config("tiny-llama").replace(dtype="float32"),
+                         jax.random.PRNGKey(0), dtype=jnp.float32)
+    prompt = np.random.default_rng(1).integers(0, 256, 9).tolist()
+
+    eng = InferenceEngine(cfg, params, max_seq=64)
+    r1 = eng.generate([prompt], max_new_tokens=8,
+                      sampling=SamplingParams.greedy())
+    assert len(r1.tokens[0]) == 8
+
+    from distributed_llm_inferencing_tpu.parallel.mesh import MeshSpec
+    eng2 = InferenceEngine(cfg, params, mesh_spec=MeshSpec(tp=2), max_seq=64)
+    r2 = eng2.generate([prompt], max_new_tokens=8,
+                       sampling=SamplingParams.greedy())
+    # same quantized weights; tp=2 reduction order may flip argmax ties on
+    # random nets, so compare trajectories only up to first divergence
+    assert r2.tokens[0][0] == r1.tokens[0][0]
+
+
+def test_batcher_int8():
+    from distributed_llm_inferencing_tpu.runtime.batcher import (
+        ContinuousBatcher)
+    cfg = get_config("tiny-llama").replace(dtype="float32",
+                                           attn_backend="xla", quant="int8")
+    b = ContinuousBatcher(cfg, num_blocks=32, block_size=8, slots=2,
+                          max_seq=64)
+    r = b.submit([1, 2, 3, 4], max_new_tokens=6,
+                 sampling=SamplingParams.greedy())
+    for _ in range(20):
+        b.step()
+        if r.done.is_set():
+            break
+    assert r.wait() and len(r.tokens) == 6
+
+
+def test_plan_accounts_int8_bytes():
+    from distributed_llm_inferencing_tpu.parallel.plan import make_plan
+    full = make_plan("llama-3-8b", {"tp": 1})
+    q = make_plan(get_config("llama-3-8b").replace(quant="int8"), {"tp": 1})
+    # weights dominate an 8B model: int8 plan must be close to half
+    assert q["param_bytes_total"] < 0.62 * full["param_bytes_total"]
+
+
+def test_quantized_checkpoint_roundtrip(tmp_path):
+    from distributed_llm_inferencing_tpu.models import checkpoint
+    cfg = get_config("tiny-llama").replace(dtype="float32", quant="int8")
+    params = init_params(cfg, jax.random.PRNGKey(2), dtype=jnp.float32)
+    checkpoint.save_checkpoint(str(tmp_path / "q"), cfg, params)
+    cfg2, params2 = checkpoint.load_checkpoint(str(tmp_path / "q"))
+    assert cfg2.quant == "int8"
+    assert params2["layers"]["up"]["q"].dtype == jnp.int8
+    np.testing.assert_array_equal(np.asarray(params["layers"]["up"]["q"]),
+                                  np.asarray(params2["layers"]["up"]["q"]))
