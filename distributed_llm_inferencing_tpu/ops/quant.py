@@ -44,8 +44,16 @@ def is_quantized(p: dict) -> bool:
     return isinstance(p, dict) and "q" in p
 
 
-def _quant_linear(p: dict) -> dict:
+def _quant_linear(p: dict, donate: bool) -> dict:
     if is_quantized(p) or "w" not in p:
+        return p
+    if donate:
+        # free each float leaf as soon as its int8 twin exists: peak extra
+        # memory is one stacked weight, not a whole second model
+        w = p.pop("w")
+        q = quantize_weight(w)
+        del w
+        p.update(q)
         return p
     out = dict(p)
     w = out.pop("w")
@@ -53,35 +61,43 @@ def _quant_linear(p: dict) -> dict:
     return out
 
 
-def quantize_params(params, cfg) -> dict:
+def quantize_params(params, cfg, donate: bool = False) -> dict:
     """Quantize the big matmul weights of a transformer param pytree.
 
     Covered: per-layer q/k/v/o, MLP up/gate/down, MoE expert weights, and
     the untied lm_head. Kept in float: embeddings (gather-addressed and,
     when tied, shared with the head), norms, biases, MoE router (tiny,
     routing-critical). Idempotent.
+
+    ``donate=True`` mutates the input tree, dropping each float weight as
+    it converts — use when the caller owns the tree and won't reuse the
+    float leaves (the worker load path), so a model that only fits
+    quantized can actually be loaded-then-quantized.
     """
-    params = dict(params)
-    layers = dict(params["layers"])
+    if not donate:
+        params = dict(params)
+        params["layers"] = dict(params["layers"])
+    layers = params["layers"]
     for name in _LINEAR_LEAVES:
         if name in layers:
-            layers[name] = _quant_linear(layers[name])
+            layers[name] = _quant_linear(layers[name], donate)
     if "experts" in layers:
-        layers["experts"] = {k: _quant_linear(v)
-                             for k, v in layers["experts"].items()}
-    params["layers"] = layers
+        if not donate:
+            layers["experts"] = dict(layers["experts"])
+        for k in layers["experts"]:
+            layers["experts"][k] = _quant_linear(layers["experts"][k], donate)
     if "lm_head" in params:
-        params["lm_head"] = _quant_linear(params["lm_head"])
+        params["lm_head"] = _quant_linear(params["lm_head"], donate)
     return params
 
 
-def maybe_quantize(params, cfg):
+def maybe_quantize(params, cfg, donate: bool = False):
     """Apply cfg.quant to a (possibly already quantized) param tree."""
     if cfg.quant is None:
         return params
     if cfg.quant != "int8":
         raise ValueError(f"unknown quant mode {cfg.quant!r}")
-    return quantize_params(params, cfg)
+    return quantize_params(params, cfg, donate=donate)
 
 
 def dequantize_weight(p: dict):

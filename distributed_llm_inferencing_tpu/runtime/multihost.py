@@ -231,7 +231,11 @@ class LockstepLeader:
         """
         try:
             body = self._prepare("inference_stream", body)
-        except ValueError as e:
+            # pre-validation only (proper 400s); the authoritative prep
+            # re-runs inside the sequence slot against lockstep-ordered
+            # state
+            self.agent._prep_inference(body)
+        except (KeyError, ValueError) as e:
             return 400, {"status": "error", "message": str(e)}
         try:
             seq = self._mirror("inference_stream", body)

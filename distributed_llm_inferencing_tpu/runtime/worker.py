@@ -181,6 +181,14 @@ class WorkerAgent:
             cfg = cfg.replace(dtype=body["dtype"])
         if body.get("quantize"):
             cfg = cfg.replace(quant=body["quantize"])
+            if params is not None:
+                # donate: the float tree is ours and never reused, so each
+                # weight frees as its int8 twin lands (peak ≈ float model +
+                # one stacked weight, not 1.5x). Pre-baked int8 checkpoints
+                # (`convert --quantize int8`) skip even that.
+                from distributed_llm_inferencing_tpu.ops.quant import (
+                    maybe_quantize)
+                params = maybe_quantize(params, cfg, donate=True)
         from distributed_llm_inferencing_tpu.utils.tokenizer import has_tokenizer
         tok_dir = body.get("tokenizer_path") or next(
             (d for d in (ckpt, native) if has_tokenizer(d)), None)
@@ -257,7 +265,7 @@ class WorkerAgent:
         m = self.models.get(name)
         if m is None:
             raise KeyError(f"model {name} not loaded")
-        if body.get("prompt_tokens"):
+        if "prompt_tokens" in body:
             prompt = [int(t) for t in body["prompt_tokens"]]
         else:
             prompt = m.tokenizer.encode(body.get("prompt", ""))
@@ -378,11 +386,13 @@ class WorkerAgent:
 
     def inference_stream(self, body, _request=None):
         """SSE streaming decode — absent from the reference (SURVEY.md §2.3)."""
-        m = self.models.get(body.get("model_name"))
-        if m is None:
-            return 400, {"status": "error",
-                         "message": f"model {body.get('model_name')} "
-                                    "not loaded"}
+        try:
+            # validate up front so bad requests get a proper 400, matching
+            # /inference; execution still re-preps inside the stream thread
+            # (the lockstep leader relies on in-slot prep)
+            m, _, _, _ = self._prep_inference(body)
+        except (KeyError, ValueError) as e:
+            return 400, {"status": "error", "message": str(e)}
         if m.batcher is None:
             ev = self.engine_stream_events(
                 body, lambda fn: threading.Thread(target=fn,

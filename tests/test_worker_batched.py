@@ -94,6 +94,19 @@ def test_streaming_batched(worker):
     assert done["result"]  # decoded text present
 
 
+def test_stream_validation_is_http_400(worker):
+    """Bad stream requests fail with a status code, not a 200+SSE error —
+    same contract as /inference."""
+    _, port = worker
+    r = requests.post(_url(port, "/inference_stream"), json={
+        "model_name": "tiny-llama", "prompt_tokens": [],
+        "max_new_tokens": 4}, timeout=60)
+    assert r.status_code == 400
+    r = requests.post(_url(port, "/inference_stream"), json={
+        "model_name": "no-such-model", "prompt_tokens": [1]}, timeout=60)
+    assert r.status_code == 400
+
+
 def test_profiler_endpoints(worker, tmp_path):
     _, port = worker
     d = str(tmp_path / "trace")
