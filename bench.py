@@ -1,26 +1,36 @@
 """Headline benchmark: GPT-2 decode tokens/sec/chip vs the reference stack.
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+Prints ONE JSON line (always, rc=0 even if the TPU is down):
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
 
 - ours: distributed_llm_inferencing_tpu engine (jitted prefill+decode, bf16)
-  on the default JAX backend (the real TPU chip under the driver).
+  on the default JAX backend (the real TPU chip under the driver). If the
+  TPU backend is unavailable or hangs (probed hang-proof via
+  utils/platform.ensure_backend), the whole bench re-runs on CPU and the
+  line carries {"platform": "cpu", "degraded": true}.
 - baseline: the reference's serving stack — HF transformers ``generate()``
   on torch CPU (the reference's worker hot loop, worker/app.py:297-305) —
   measured fresh in the same process, same model config, same sampling
   params (top_p=0.95, top_k=50, temperature=0.8), same prompt/new-token
   counts. Both sides use random-init full-size gpt2 (125M) weights: no
   network access, and wall-clock is weight-value-independent.
+
+Extra keys (best-effort; omitted rather than fatal when they fail):
+  gpt2_xl_int8_tokens_per_s   — 1.5B model, int8 weight-only quant, batch 1
+  batched_throughput_tokens_per_s — 8 concurrent requests through the
+                                    continuous batcher (runtime/batcher.py)
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
 
 PROMPT_LEN = 16
 NEW_TOKENS = 64
 MODEL = "gpt2"
+_FALLBACK_ENV = "_DLI_BENCH_CPU_FALLBACK"
 
 
 def bench_reference_stack():
@@ -41,40 +51,133 @@ def bench_reference_stack():
     return best
 
 
-def bench_ours():
+def _sampling():
+    from distributed_llm_inferencing_tpu.ops.sampling import SamplingParams
+    return SamplingParams(temperature=0.8, top_k=50, top_p=0.95)
+
+
+def bench_engine(model=MODEL, quant=None, new_tokens=NEW_TOKENS, repeats=3,
+                 dtype=None):
+    """Best-of-N decode tok/s for one engine-mode model, batch 1."""
     import numpy as np
     from distributed_llm_inferencing_tpu.models.registry import get_config
-    from distributed_llm_inferencing_tpu.ops.sampling import SamplingParams
     from distributed_llm_inferencing_tpu.runtime.engine import InferenceEngine
 
-    cfg = get_config(MODEL)
-    eng = InferenceEngine(cfg, max_seq=PROMPT_LEN + NEW_TOKENS + 16, seed=0)
+    cfg = get_config(model)
+    if quant:
+        cfg = cfg.replace(quant=quant)
+    if dtype:
+        cfg = cfg.replace(dtype=dtype)
+    eng = InferenceEngine(cfg, max_seq=PROMPT_LEN + new_tokens + 16, seed=0)
     rng = np.random.default_rng(0)
     prompt = rng.integers(0, cfg.vocab_size, PROMPT_LEN).tolist()
-    sp = SamplingParams(temperature=0.8, top_k=50, top_p=0.95)
+    sp = _sampling()
     # warmup/compile (same chunk programs as the timed runs)
-    eng.generate([prompt], max_new_tokens=NEW_TOKENS, sampling=sp)
+    eng.generate([prompt], max_new_tokens=new_tokens, sampling=sp)
     best = 0.0
-    for _ in range(3):   # best-of-3: the chip is tunnel-attached and the
-        # per-dispatch RPC latency is noisy run to run
-        res = eng.generate([prompt], max_new_tokens=NEW_TOKENS, sampling=sp)
+    for _ in range(repeats):   # best-of-N: the chip is tunnel-attached and
+        # the per-dispatch RPC latency is noisy run to run
+        res = eng.generate([prompt], max_new_tokens=new_tokens, sampling=sp)
         total_ms = res.prefill_ms + res.decode_ms
         best = max(best, len(res.tokens[0]) / (total_ms / 1e3))
     return best
 
 
-def main():
-    ours = bench_ours()
-    print(f"ours: {ours:.2f} tok/s", file=sys.stderr)
+def bench_batched(n_requests=8, new_tokens=NEW_TOKENS, dtype=None):
+    """Aggregate throughput: n concurrent requests through the continuous
+    batcher (the serving path the reference fully serialized,
+    reference worker/Dockerfile:47)."""
+    import numpy as np
+    from distributed_llm_inferencing_tpu.models.registry import get_config
+    from distributed_llm_inferencing_tpu.runtime.batcher import (
+        ContinuousBatcher)
+
+    cfg = get_config(MODEL)
+    if dtype:
+        cfg = cfg.replace(dtype=dtype)
+    b = ContinuousBatcher(cfg, num_blocks=256, block_size=16,
+                          slots=n_requests,
+                          max_seq=PROMPT_LEN + new_tokens + 16, seed=0)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size, PROMPT_LEN).tolist()
+               for _ in range(n_requests)]
+    sp = _sampling()
+    b.start()
+    try:
+        # warmup (compile the prefill/decode programs)
+        b.submit(prompts[0], max_new_tokens=4, sampling=sp).wait(timeout=600)
+        t0 = time.perf_counter()
+        reqs = [b.submit(p, max_new_tokens=new_tokens, sampling=sp, seed=i)
+                for i, p in enumerate(prompts)]
+        total = sum(len(r.wait(timeout=600)) for r in reqs)
+        dt = time.perf_counter() - t0
+    finally:
+        b.stop()
+    return total / dt
+
+
+def run_all(platform, degraded):
+    result = {
+        "metric": "gpt2_decode_tokens_per_s_per_chip",
+        "value": 0.0,
+        "unit": "tokens/s",
+        "vs_baseline": 0.0,
+        "platform": platform,
+        "degraded": degraded,
+    }
+    # bf16 is software-emulated on host CPU; use f32 there so the degraded
+    # number reflects the machine, not the emulation
+    dtype = "float32" if platform == "cpu" else None
+    ours = bench_engine(dtype=dtype)
+    result["value"] = round(ours, 2)
+    print(f"ours: {ours:.2f} tok/s [{platform}]", file=sys.stderr)
+    try:
+        tput = bench_batched(dtype=dtype)
+        result["batched_throughput_tokens_per_s"] = round(tput, 2)
+        print(f"batched x8: {tput:.2f} tok/s", file=sys.stderr)
+    except Exception as e:  # extras never break the contract line
+        print(f"batched bench skipped: {e!r}", file=sys.stderr)
+    if platform != "cpu":  # 1.5B random-init is pointlessly slow on host cpu
+        try:
+            xl = bench_engine("gpt2-xl", quant="int8", new_tokens=32,
+                              repeats=2)
+            result["gpt2_xl_int8_tokens_per_s"] = round(xl, 2)
+            print(f"gpt2-xl int8: {xl:.2f} tok/s", file=sys.stderr)
+        except Exception as e:
+            print(f"gpt2-xl bench skipped: {e!r}", file=sys.stderr)
     baseline = bench_reference_stack()
     print(f"reference stack (HF torch CPU): {baseline:.2f} tok/s",
           file=sys.stderr)
-    print(json.dumps({
-        "metric": "gpt2_decode_tokens_per_s_per_chip",
-        "value": round(ours, 2),
-        "unit": "tokens/s",
-        "vs_baseline": round(ours / baseline, 3),
-    }))
+    if baseline > 0:
+        result["vs_baseline"] = round(ours / baseline, 3)
+    return result
+
+
+def main():
+    from distributed_llm_inferencing_tpu.utils.platform import ensure_backend
+    if os.environ.get(_FALLBACK_ENV):
+        info = {"platform": "cpu", "degraded": True}
+        ensure_backend("cpu")
+    else:
+        info = ensure_backend()
+    try:
+        result = run_all(info["platform"], info["degraded"])
+    except Exception as e:
+        if info["platform"] != "cpu":
+            # TPU probed fine but died mid-run: re-exec the whole bench on
+            # CPU so the driver still gets a parsed line with rc=0
+            print(f"TPU run failed ({e!r}); re-running on cpu",
+                  file=sys.stderr)
+            env = {**os.environ, _FALLBACK_ENV: "1", "DLI_PLATFORM": "cpu"}
+            r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                               env=env)
+            sys.exit(r.returncode)
+        # even a CPU failure must not lose the line
+        print(f"bench failed on cpu: {e!r}", file=sys.stderr)
+        result = {"metric": "gpt2_decode_tokens_per_s_per_chip",
+                  "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0,
+                  "platform": "cpu", "degraded": True, "error": repr(e)}
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

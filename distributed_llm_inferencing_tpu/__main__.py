@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 
@@ -17,6 +18,12 @@ def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="distributed_llm_inferencing_tpu",
         description="TPU-native distributed LLM inference framework")
+    ap.add_argument("--platform", dest="global_platform", default=None,
+                    help="force the jax platform for ANY subcommand "
+                         "(tpu|cpu); also honored via DLI_PLATFORM. "
+                         "Unset: worker/generate probe the TPU and degrade "
+                         "to cpu if it is unavailable; convert runs on cpu "
+                         "(host-side weight transform needs no chip)")
     sub = ap.add_subparsers(dest="cmd", required=True)
 
     w = sub.add_parser("worker", help="run a worker agent (data plane)")
@@ -93,13 +100,27 @@ def main(argv=None):
 
     args = ap.parse_args(argv)
 
+    # Platform policy (utils/platform.py): explicit request wins; jax-using
+    # commands otherwise probe the accelerator hang-proof and degrade to
+    # cpu — a dead/held TPU chip must never hang or crash the CLI
+    # (round-1 failure mode: BENCH_r01 rc=1, convert-subprocess hang).
+    from distributed_llm_inferencing_tpu.utils.platform import (
+        ensure_backend, force_platform)
+    requested = (getattr(args, "platform", None) or args.global_platform
+                 or os.environ.get("DLI_PLATFORM") or None)
+    if args.cmd in ("worker", "generate"):
+        info = ensure_backend(requested)
+        if info["degraded"]:
+            print("warning: TPU backend unavailable, running on cpu",
+                  file=sys.stderr)
+    elif args.cmd == "convert":
+        force_platform(requested or "cpu")
+    elif requested:
+        force_platform(requested)
+
     if args.cmd == "worker":
-        if getattr(args, "platform", None):
-            import jax
-            jax.config.update("jax_platforms", args.platform)
         from distributed_llm_inferencing_tpu.runtime.worker import WorkerAgent
         if args.coordinator:
-            import os
             from distributed_llm_inferencing_tpu.runtime.multihost import (
                 LockstepFollower, LockstepLeader, init_multihost)
             pid, n = init_multihost(args.coordinator, args.num_processes,

@@ -51,6 +51,13 @@ class ModelConfig:
     # Mixture-of-experts (Mixtral). num_experts == 0 => dense MLP.
     num_experts: int = 0
     num_experts_per_tok: int = 2
+    # Dispatch strategy (models/transformer.py _moe): "dense" computes all
+    # experts for every token (right trade at decode batch sizes);
+    # "capacity" does GShard-style top-k einsum dispatch with a fixed
+    # per-expert capacity (right trade for batched prefill throughput);
+    # "auto" picks by token count.
+    moe_dispatch: str = "auto"
+    moe_capacity_factor: float = 1.25
 
     # Numerics
     dtype: str = "bfloat16"  # activation/weight dtype on device

@@ -17,11 +17,13 @@ This is the blockwise-parallel formulation of Liu et al.'s Ring Attention
 O(S^2) to O((S/sp)^2 * sp) time and O(S/sp) activation residency, which is
 what makes million-token contexts fit.
 
-Scope: the ring covers **prefill** (where the O(S^2) cost lives). Decode
-with sp > 1 attends the sp-sharded cache through the dense path under
-GSPMD, which partitions the [B,1,S] score reduction with collectives —
-correct, but its per-step comm is not yet the blockwise-minimal schedule;
-a dedicated ring decode is tracked as a follow-up.
+Scope: the ring rotation covers **prefill** (where the O(S^2) cost
+lives). Decode with sp > 1 runs ``ring_attend_decode`` — the
+flash-decoding formulation: with a single query token there is nothing to
+pipeline around a ring, so each device reduces its own cache shard to an
+online-softmax partial (m, l, o) and ONE pmax+psum combine over sp merges
+them. Per step that moves O(B·H·hd) bytes over ICI instead of the
+gather-the-world pattern GSPMD picks for the dense formulation.
 
 Masking travels with the data: each K/V block carries its absolute
 positions and a validity bitmap, so causality, ragged batch lengths and
@@ -95,6 +97,78 @@ def _ring_body(q, k, v, q_pos, kv_pos, kv_valid, *, axis: str,
     # rows with no valid kv (padding rows) have l == 0; emit zeros not NaN
     l = jnp.maximum(l, 1e-30)
     return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+
+
+def _decode_body(q, k, v, kv_pos, kv_valid, lengths, *, axis: str,
+                 sliding_window: Optional[int]):
+    """Per-device partial attention over the LOCAL cache shard + combine.
+
+    q [B,1,H,hd] (replicated over sp), k/v [B,Sk,Hkv,hd] (the local S/sp
+    shard), kv_pos/kv_valid [B,Sk], lengths [B] (replicated).
+    """
+    B, Sq, H, hd = q.shape
+    n_rep = H // k.shape[2]
+    q_pos = (lengths - 1)[:, None]                                  # [B,1]
+
+    kf = repeat_kv(k, n_rep)
+    s = _masked_scores(q, kf, q_pos, kv_pos, kv_valid, sliding_window)
+    m_loc = jnp.max(s, axis=-1)                                     # [B,H,1]
+    p = jnp.where(s > NEG_INF * 0.5, jnp.exp(s - m_loc[..., None]), 0.0)
+    l_loc = jnp.sum(p, axis=-1)                                     # [B,H,1]
+    vf = repeat_kv(v, n_rep)
+    o_loc = jnp.einsum("bhqk,bkhd->bqhd", p, vf.astype(jnp.float32))
+
+    # single combine across sp: rescale partials to the global max
+    m_g = jax.lax.pmax(m_loc, axis)
+    scale = jnp.exp(m_loc - m_g)                                    # [B,H,1]
+    l_g = jax.lax.psum(l_loc * scale, axis)
+    o_g = jax.lax.psum(o_loc * scale.transpose(0, 2, 1)[..., None], axis)
+    l_g = jnp.maximum(l_g, 1e-30)
+    return (o_g / l_g.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+
+
+def ring_attend_decode(
+    q,            # [B, 1, H, hd]
+    cache_k,      # [B, S, Hkv, hd] — sp-sharded on S
+    cache_v,      # [B, S, Hkv, hd]
+    lengths,      # [B] int32 — valid cache tokens INCLUDING the new one
+    *,
+    mesh: Mesh,
+    sliding_window: Optional[int] = None,
+):
+    """Single-token attention over the sp-sharded dense cache.
+
+    The new token's K/V must already be written into the cache (the write
+    is a GSPMD scatter outside this call). Replaces the dense-under-GSPMD
+    fallback: per device one [B,H,1,S/sp] reduction, then one
+    pmax+psum combine of O(B·H·hd) partials.
+    """
+    sp = mesh.shape["sp"]
+    tp = mesh.shape["tp"]
+    B, S = cache_k.shape[0], cache_k.shape[1]
+    H, Hkv = q.shape[2], cache_k.shape[2]
+    if S % sp:
+        raise ValueError(f"ring decode needs sp={sp} | cache_len={S}")
+    from distributed_llm_inferencing_tpu.parallel.sharding import kv_head_axis
+    kv_tp = kv_head_axis(Hkv, tp)
+    if tp > 1 and kv_tp is None:
+        raise ValueError(
+            f"ring decode with tp={tp} needs tp <= num_kv_heads={Hkv}")
+
+    kv_pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    kv_valid = kv_pos < lengths[:, None]
+
+    body = functools.partial(_decode_body, axis="sp",
+                             sliding_window=sliding_window)
+    q_spec = P("dp", None, "tp", None)
+    kv_spec = P("dp", "sp", kv_tp, None)
+    pos_spec = P("dp", "sp")
+    return jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(q_spec, kv_spec, kv_spec, pos_spec, pos_spec, P("dp")),
+        out_specs=q_spec,
+        check_vma=False,
+    )(q, cache_k, cache_v, kv_pos, kv_valid, lengths)
 
 
 def ring_attend_prefill(

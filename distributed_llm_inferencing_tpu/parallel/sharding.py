@@ -111,6 +111,18 @@ def cache_specs(cfg: ModelConfig, spec: MeshSpec):
     return KVCache(k=kv, v=kv, lengths=P("dp"))
 
 
+def paged_cache_specs(cfg: ModelConfig, spec: MeshSpec):
+    """PagedKVCache sharding: [L, NB, bs, Hkv, hd] — kv heads over tp.
+
+    The block axes (NB, bs) stay replicated: which blocks a slot owns is
+    host-side scheduler state (runtime/batcher.py), identical on every
+    device, so only the head dimension is worth splitting."""
+    kv_tp = kv_head_axis(cfg.num_kv_heads, spec.tp)
+    kv = P(None, None, None, kv_tp, None)
+    from distributed_llm_inferencing_tpu.ops.paged_kvcache import PagedKVCache
+    return PagedKVCache(k=kv, v=kv)
+
+
 def logits_spec():
     return P("dp", None, "tp")
 

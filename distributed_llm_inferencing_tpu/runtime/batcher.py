@@ -41,6 +41,9 @@ from distributed_llm_inferencing_tpu.native import BlockPool
 from distributed_llm_inferencing_tpu.ops.paged_kvcache import init_paged_cache
 from distributed_llm_inferencing_tpu.ops.sampling import (
     SamplingParams, sample_batch)
+from distributed_llm_inferencing_tpu.parallel import sharding as shd
+from distributed_llm_inferencing_tpu.parallel.mesh import (
+    MeshSpec, create_mesh, validate_spec)
 
 TAIL_BUCKETS_X_BS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512)  # × block_size
 PREFIX_BUCKETS = (0, 1, 2, 4, 8, 16, 32, 64, 128, 256, 512)  # blocks
@@ -88,8 +91,14 @@ class BatchRequest:
 
 
 class ContinuousBatcher:
-    """Slot-based continuous batching scheduler (single-program; the model
-    itself may still be mesh-sharded by the caller's params placement).
+    """Slot-based continuous batching scheduler.
+
+    One jitted program per step; the model may be mesh-sharded (tensor /
+    expert parallel) — params and the paged cache carry NamedShardings and
+    GSPMD partitions the step's matmuls/attention over ICI. Batch-dim
+    parallelism (dp), pipeline stages (pp), and sequence sharding (sp) are
+    rejected: the slot scheduler owns the batch dimension, and its
+    per-step host round trip is incompatible with stage/sequence pipelining.
 
     Drive it either with an owned background thread (``start()``/``stop()``)
     or synchronously via ``step()`` (tests, custom loops).
@@ -98,8 +107,18 @@ class ContinuousBatcher:
     def __init__(self, cfg: ModelConfig, params=None, *,
                  num_blocks: int = 512, block_size: int = 16,
                  slots: int = 8, max_seq: Optional[int] = None,
-                 seed: int = 0, force_python_pool: bool = False):
-        self.cfg = cfg = cfg.replace(attn_backend=_backend(cfg))
+                 seed: int = 0, force_python_pool: bool = False,
+                 mesh_spec: Optional[MeshSpec] = None):
+        self.mesh_spec = mesh_spec or MeshSpec()
+        for ax in ("dp", "pp", "sp"):
+            if getattr(self.mesh_spec, ax) > 1:
+                raise ValueError(
+                    f"batched serving shards tensors only (tp/ep); "
+                    f"{ax}={getattr(self.mesh_spec, ax)} unsupported")
+        self.cfg = cfg = cfg.replace(
+            attn_backend=_backend(cfg, self.mesh_spec.num_devices))
+        validate_spec(self.mesh_spec, cfg)
+        self.mesh = create_mesh(self.mesh_spec)
         self.block_size = block_size
         self.slots = slots
         self.max_seq = min(max_seq or cfg.max_position_embeddings,
@@ -110,14 +129,18 @@ class ContinuousBatcher:
         else:
             from distributed_llm_inferencing_tpu.ops.quant import maybe_quantize
             params = maybe_quantize(params, cfg)
-        self.params = params
+        with self.mesh:
+            self.params = shd.shard_params(params, self.mesh, cfg,
+                                           self.mesh_spec)
 
         # +1: block 0 is the reserved dummy every inactive table entry
         # points at, so it never carries real KV
         self.pool = BlockPool(num_blocks + 1, block_size,
                               force_python=force_python_pool)
         [self._dummy] = self.pool.alloc(1)
-        self.paged = init_paged_cache(cfg, num_blocks + 1, block_size)
+        self.paged = jax.device_put(
+            init_paged_cache(cfg, num_blocks + 1, block_size),
+            shd.named(self.mesh, shd.paged_cache_specs(cfg, self.mesh_spec)))
         self.block_tables = np.full((slots, self.max_blocks), self._dummy,
                                     np.int32)
         self.context_lens = np.zeros((slots,), np.int32)
@@ -135,6 +158,15 @@ class ContinuousBatcher:
         self._prefill_fns = {}
         self._decode_fn = None
         self._sample1 = None
+
+        # Multi-host seam (runtime/multihost.py): when set, every device
+        # program this scheduler launches is routed through
+        # ``program_hook(kind, payload, run)`` — the lockstep leader
+        # broadcasts (kind, payload) to follower hosts, which ``replay()``
+        # the identical program, then calls ``run()`` in sequence order.
+        # The *scheduling decisions* stay leader-local; only their compiled
+        # consequences are replicated, so followers need no pool/queue.
+        self.program_hook = None
 
     # ---- public API ---------------------------------------------------
 
@@ -190,6 +222,7 @@ class ContinuousBatcher:
     def stats(self) -> dict:
         return {
             "slots": self.slots,
+            "mesh": self.mesh_spec.axis_sizes(),
             "active": sum(a is not None for a in self.active),
             "queued": len(self.queue),
             "steps": self._step_count,
@@ -226,6 +259,62 @@ class ContinuousBatcher:
 
             self._decode_fn = jax.jit(step, donate_argnums=(2,))
         return self._decode_fn
+
+    # ---- program launch (shared by the scheduler and lockstep replay) --
+
+    def _run_admit(self, a: dict) -> int:
+        """Launch the admission programs (tail prefill + first-token
+        sample) from a JSON-safe arg dict. Pure device-program execution:
+        no scheduler state is read, so a follower replaying the leader's
+        args evolves its cache shard bit-identically."""
+        toks = np.asarray([a["toks"]], np.int32)
+        pfb = np.asarray([a["pfb"]], np.int32)
+        fn = self._prefill_jit(toks.shape[1], pfb.shape[1])
+        with self.mesh:
+            last, self.paged = fn(
+                self.params, jnp.asarray(toks),
+                jnp.asarray([a["tail_len"]], jnp.int32),
+                jnp.asarray(a["tail_alloc"], jnp.int32),
+                jnp.asarray(pfb), jnp.asarray([a["cached"]], jnp.int32),
+                self.paged)
+            if self._sample1 is None:
+                self._sample1 = jax.jit(sample_batch)
+            return int(self._sample1(
+                last,
+                jnp.asarray([a["seed"]], jnp.int32),
+                jnp.asarray([a["step"]], jnp.int32),
+                jnp.asarray([a["temperature"]], jnp.float32),
+                jnp.asarray([a["top_k"]], jnp.int32),
+                jnp.asarray([a["top_p"]], jnp.float32),
+                jnp.asarray([a["do_sample"]]))[0])
+
+    def _run_decode(self, a: dict) -> np.ndarray:
+        """Launch one decode step's program from a JSON-safe arg dict."""
+        fn = self._decode_jit()
+        with self.mesh:
+            nxt, self.paged = fn(
+                self.params, jnp.asarray(a["tokens"], jnp.int32), self.paged,
+                jnp.asarray(a["bt"], jnp.int32),
+                jnp.asarray(a["cl"], jnp.int32),
+                jnp.asarray(a["seeds"], jnp.int32),
+                jnp.asarray(a["steps"], jnp.int32),
+                jnp.asarray(a["temps"], jnp.float32),
+                jnp.asarray(a["tks"], jnp.int32),
+                jnp.asarray(a["tps"], jnp.float32),
+                jnp.asarray(a["ds"], bool))
+            return np.asarray(nxt)   # ONE host sync per step for all slots
+
+    def replay(self, kind: str, args: dict):
+        """Re-execute a program the lockstep leader broadcast. SPMD
+        correctness requires every host to launch identical programs in
+        identical order — the caller (LockstepFollower) provides the
+        ordering; identical args provide the identity."""
+        if kind == "admit":
+            self._run_admit(args)
+        elif kind == "decode":
+            self._run_decode(args)
+        else:
+            raise ValueError(f"unknown batcher program kind {kind!r}")
 
     # ---- scheduling ---------------------------------------------------
 
@@ -270,24 +359,21 @@ class ContinuousBatcher:
         toks = np.zeros((1, t), np.int32)
         toks[0, :tail_len] = prompt[cached:]
 
-        fn = self._prefill_jit(t, max(pb, 1))
-        t0 = time.perf_counter()
-        last, self.paged = fn(
-            self.params, jnp.asarray(toks),
-            jnp.asarray([tail_len], jnp.int32),
-            jnp.asarray(tail_alloc, jnp.int32),
-            jnp.asarray(pfb), jnp.asarray([cached], jnp.int32), self.paged)
         sp = req.sampling
-        if self._sample1 is None:
-            self._sample1 = jax.jit(sample_batch)
-        first = int(self._sample1(
-            last,
-            jnp.asarray([req.seed], jnp.int32),
-            jnp.asarray([len(req.tokens)], jnp.int32),
-            jnp.asarray([sp.temperature], jnp.float32),
-            jnp.asarray([sp.top_k], jnp.int32),
-            jnp.asarray([sp.top_p], jnp.float32),
-            jnp.asarray([sp.do_sample]))[0])
+        admit_args = {
+            "toks": toks[0].tolist(), "tail_len": int(tail_len),
+            "tail_alloc": [int(b) for b in tail_alloc],
+            "pfb": pfb[0].tolist(), "cached": int(cached),
+            "seed": int(req.seed), "step": len(req.tokens),
+            "temperature": float(sp.temperature), "top_k": int(sp.top_k),
+            "top_p": float(sp.top_p), "do_sample": bool(sp.do_sample),
+        }
+        t0 = time.perf_counter()
+        if self.program_hook is not None:
+            first = self.program_hook("admit", admit_args,
+                                      lambda: self._run_admit(admit_args))
+        else:
+            first = self._run_admit(admit_args)
         self.pool.release(tail_extra)   # padding blocks beyond the real tail
 
         # register the prompt's full blocks in the radix cache
@@ -457,13 +543,17 @@ class ContinuousBatcher:
             tps[i] = req.sampling.top_p
             ds[i] = req.sampling.do_sample
 
-        fn = self._decode_jit()
-        nxt, self.paged = fn(
-            self.params, jnp.asarray(tokens), self.paged,
-            jnp.asarray(self.block_tables), jnp.asarray(self.context_lens),
-            jnp.asarray(seeds), jnp.asarray(steps), jnp.asarray(temps),
-            jnp.asarray(tks), jnp.asarray(tps), jnp.asarray(ds))
-        nxt = np.asarray(nxt)   # ONE host sync per step for all slots
+        decode_args = {
+            "tokens": tokens.tolist(), "bt": self.block_tables.tolist(),
+            "cl": self.context_lens.tolist(), "seeds": seeds.tolist(),
+            "steps": steps.tolist(), "temps": temps.tolist(),
+            "tks": tks.tolist(), "tps": tps.tolist(), "ds": ds.tolist(),
+        }
+        if self.program_hook is not None:
+            nxt = self.program_hook("decode", decode_args,
+                                    lambda: self._run_decode(decode_args))
+        else:
+            nxt = self._run_decode(decode_args)
         self._step_count += 1
 
         for i in active:
@@ -478,12 +568,29 @@ class ContinuousBatcher:
 
     def _loop(self):
         while not self._stop.is_set():
-            busy = self.step()
+            try:
+                busy = self.step()
+            except Exception as e:
+                # e.g. the lockstep hook reporting a degraded slice: fail
+                # every waiter fast instead of letting them block to their
+                # timeouts against a dead scheduler
+                for slot in range(self.slots):
+                    if self.active[slot] is not None:
+                        self.active[slot].error = f"scheduler error: {e}"
+                        self._finish_slot(slot)
+                with self._lock:
+                    drained = list(self.queue)
+                    self.queue.clear()
+                for req in drained:
+                    req.error = f"scheduler error: {e}"
+                    req.done.set()
+                self._stop.set()
+                return
             if not busy and not self.queue:
                 self._work.wait(timeout=0.05)
                 self._work.clear()
 
 
-def _backend(cfg: ModelConfig) -> str:
+def _backend(cfg: ModelConfig, num_devices: int = 1) -> str:
     from distributed_llm_inferencing_tpu.ops.attention import resolve_backend
-    return resolve_backend(cfg.attn_backend, 1)
+    return resolve_backend(cfg.attn_backend, num_devices)

@@ -87,7 +87,13 @@ DASHBOARD = f"""<!doctype html><html><head><title>Dashboard</title>{_STYLE}
   <div class="card"><div class="num" id="n-completed">–</div>
     <div class="label">completed</div></div>
 </div>
-<h2>Recent Requests</h2>
+<h2>Batched Serving</h2>
+<table><thead><tr><th>Node</th><th>Model</th><th>Mesh</th>
+<th>Slots</th><th>Queued</th><th>Tokens out</th><th>Blocks free</th>
+<th>Prefix hit rate</th></tr></thead>
+<tbody id="serving"><tr><td colspan="8" class="muted">no batched models
+</td></tr></tbody></table>
+<h2 style="margin-top:24px">Recent Requests</h2>
 <table><thead><tr><th>ID</th><th>Model</th><th>Status</th><th>tok/s</th>
 <th>Latency (s)</th><th>Node</th></tr></thead>
 <tbody id="recent"></tbody></table>
@@ -97,6 +103,24 @@ async function refresh() {{
     const ns = await (await fetch('/api/nodes/status')).json();
     document.getElementById('n-nodes').textContent =
       ns.nodes.filter(n => n.is_active).length;
+    // live continuous-batcher internals (runtime/batcher.py stats(),
+    // carried on /health -> node info): slots, queue, prefix-cache hits
+    const rows = [];
+    for (const n of ns.nodes)
+      for (const m of n.loaded_models || [])
+        if (m.serving === 'batched' && m.scheduler) {{
+          const s = m.scheduler, p = s.pool || {{}};
+          const hits = p.prefix_hits || 0, miss = p.prefix_misses || 0;
+          const hr = (hits + miss) ? (100 * hits / (hits + miss)).toFixed(0) + '%' : '–';
+          const mesh = Object.entries(s.mesh || {{}}).filter(e => e[1] > 1)
+            .map(e => e.join('=')).join(' ') || '1 chip';
+          rows.push(`<tr><td>${{esc(n.name)}}</td><td>${{esc(m.name)}}</td>`+
+            `<td>${{esc(mesh)}}</td><td>${{s.active}}/${{s.slots}}</td>`+
+            `<td>${{s.queued}}</td><td>${{s.tokens_out}}</td>`+
+            `<td>${{s.blocks_free}}</td><td>${{hr}}</td></tr>`);
+        }}
+    document.getElementById('serving').innerHTML = rows.join('') ||
+      '<tr><td colspan="8" class="muted">no batched models</td></tr>';
     const r = await (await fetch('/api/inference/recent')).json();
     for (const k of ['pending','processing','completed'])
       document.getElementById('n-'+k).textContent = r.counts[k] || 0;
@@ -118,6 +142,11 @@ NODES = f"""<!doctype html><html><head><title>Nodes</title>{_STYLE}
 <table><thead><tr><th>ID</th><th>Name</th><th>Address</th><th>Status</th>
 <th>Devices</th><th>CPU %</th><th>Mem %</th><th>Models</th><th>In-flight</th>
 <th></th></tr></thead><tbody id="nodes"></tbody></table>
+<h2 style="margin-top:24px">Placement Plans</h2>
+<table><thead><tr><th>ID</th><th>Model</th><th>Mesh</th><th>Devices</th>
+<th>HBM/device</th><th>Max seq</th><th>Node</th><th>Loaded</th></tr></thead>
+<tbody id="plans"><tr><td colspan="8" class="muted">no plans</td></tr>
+</tbody></table>
 <h2 style="margin-top:24px">Add Node</h2>
 <div class="grid2"><form id="add">
   <div class="row"><label>Name</label><input name="name" required></div>
@@ -127,7 +156,27 @@ NODES = f"""<!doctype html><html><head><title>Nodes</title>{_STYLE}
   <button>Add Node</button> <span id="add-msg" class="muted"></span>
 </form></div>
 <script>{_ESC}
+function gib(b) {{ return b >= 2**30 ? (b/2**30).toFixed(1)+' GiB'
+  : b >= 2**20 ? (b/2**20).toFixed(1)+' MiB' : (b/2**10).toFixed(0)+' KiB'; }}
+async function refreshPlans() {{
+  // shard-placement visibility (≙ reference node_management.html:154-171,
+  // which showed ModelShard rows): placement plans + where they landed
+  const r = await (await fetch('/api/plans')).json();
+  document.getElementById('plans').innerHTML = (r.plans || []).map(p => {{
+    const plan = p.plan || {{}};
+    const mesh = Object.entries(plan.mesh || {{}}).filter(e => e[1] > 1)
+      .map(e => e.join('=')).join(' ') || '1 chip';
+    return `<tr><td>${{p.id}}</td><td>${{esc(p.model_name)}}</td>`+
+    `<td>${{esc(mesh)}}</td><td>${{plan.num_devices ?? ''}}</td>`+
+    `<td>${{plan.hbm_per_device_estimate ?
+            gib(plan.hbm_per_device_estimate) : ''}}</td>`+
+    `<td>${{plan.max_seq ?? ''}}</td><td>${{p.node_id ?? '–'}}</td>`+
+    `<td><span class="pill ${{p.is_loaded ? 'online' : 'pending'}}">`+
+    `${{p.is_loaded ? 'deployed' : 'planned'}}</span></td></tr>`;
+  }}).join('') || '<tr><td colspan="8" class="muted">no plans</td></tr>';
+}}
 async function refresh() {{
+  refreshPlans();
   const r = await (await fetch('/api/nodes/status')).json();
   document.getElementById('nodes').innerHTML = r.nodes.map(n => {{
     const dev = esc((n.resources && n.resources.devices || [])

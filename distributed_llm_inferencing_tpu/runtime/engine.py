@@ -160,7 +160,8 @@ class InferenceEngine:
                                 cur.shape[0], pp, n_micro_req))
                     else:
                         logits, cache = transformer.decode_step(
-                            params, cfg, cur[:, None], cache)
+                            params, cfg, cur[:, None], cache,
+                            mesh=(mesh if self.mesh_spec.sp > 1 else None))
                     nxt = sample(logits[:, 0], sub, sp)
                     return (nxt, cache, key), nxt
 
@@ -209,6 +210,12 @@ class InferenceEngine:
         # pad batch to a dp-divisible size with dummy rows (trimmed below)
         dp = self.mesh_spec.dp
         B = -(-n_real // dp) * dp
+        if B == 1 and jax.default_backend() == "cpu":
+            # XLA-CPU strength-reduces M=1 dots whose weight operand is a
+            # scan slice into naive kLoop fusions (~10-20x slower than the
+            # dot kernel); a dummy second batch row keeps the real dot.
+            # TPU/GPU never take this branch.
+            B = 2
         prompts = list(prompts) + [[0]] * (B - n_real)
         lens = lens + [1] * (B - n_real)
 

@@ -40,6 +40,12 @@ HEALTH_TIMEOUT = 5
 UNLOAD_TIMEOUT = 10
 LOAD_TIMEOUT = 300
 INFER_TIMEOUT = 120
+# Budget the worker gets for the request itself: strictly less than the
+# master's HTTP timeout, so the worker 408s (and frees its batcher slot)
+# BEFORE the master gives up — the reference had the opposite relation
+# (master 120s vs worker holding gunicorn 300s, views.py:352 vs
+# worker/Dockerfile:47) and a timed-out generation kept running for nobody.
+WORKER_INFER_BUDGET = INFER_TIMEOUT - 5
 
 MAX_ATTEMPTS = 3          # reference: 1 attempt, terminal (views.py:364-378)
 FAILURE_STRIKES = 3       # reference: one strike (views.py:99-105)
@@ -59,6 +65,7 @@ class Master:
         self._worker_auth = auth_key or os.environ.get("DLI_AUTH_KEY")
         self._inflight: Dict[int, int] = {}   # node_id -> in-flight count
         self._inflight_lock = threading.Lock()
+        self._processing: Dict[int, dict] = {}  # req_id -> node (for cancel)
         self._stop = threading.Event()
         self._wake = threading.Event()
         self._threads = []
@@ -81,6 +88,7 @@ class Master:
         s.add("POST", "/api/inference/submit", self.api_submit)
         s.add("GET", "/api/inference/status/<req_id>", self.api_status)
         s.add("GET", "/api/inference/recent", self.api_recent)
+        s.add("POST", "/api/inference/cancel/<req_id>", self.api_cancel)
         # beyond reference
         s.add("GET", "/api/plans", self.api_list_plans)
         s.add("POST", "/api/plans/create", self.api_create_plan)
@@ -260,6 +268,38 @@ class Master:
         return {"status": "success", "counts": self.store.counts(),
                 "requests": self.store.recent_requests(20)}
 
+    def api_cancel(self, body, req_id):
+        """Cancel a pending or in-flight request — no reference counterpart
+        (its failures were terminal and its generations uncancellable,
+        SURVEY.md §5.3). In-flight: relay to the worker's /cancel (frees
+        the batcher slot); pending: fail it before any node picks it up."""
+        req_id = int(req_id)
+        r = self.store.get_request(req_id)
+        if not r:
+            return 404, {"status": "error", "message": "no such request"}
+        if r["status"] in ("completed", "failed"):
+            return 409, {"status": "error",
+                         "message": f"request already {r['status']}"}
+        node = self._processing.get(req_id)
+        if node is not None:
+            try:
+                w = self._worker_post(node, "/cancel",
+                                      {"request_tag": str(req_id)}, 10)
+                if w.status_code == 200:
+                    return {"status": "success",
+                            "message": "cancel relayed to worker"}
+                # engine-mode generations are not cancellable mid-program
+                # (the worker registers tags for batched requests only)
+                return 409, {"status": "error",
+                             "message": f"worker cannot cancel: "
+                                        f"{w.text[:200]}"}
+            except Exception as e:
+                return 502, {"status": "error",
+                             "message": f"cancel relay failed: {e}"}
+        self.store.mark_failed(req_id, "cancelled by user")
+        self.metrics.inc("requests_cancelled")
+        return {"status": "success", "message": "request cancelled"}
+
     # ---- scheduling --------------------------------------------------
 
     def _node_models(self, node) -> set:
@@ -323,12 +363,21 @@ class Master:
                 "model_name": req["model_name"],
                 "prompt": req["prompt"],
                 "sampling": req["sampling"],
+                # worker-side generation budget < our HTTP timeout, and a
+                # tag so we (or an operator) can cancel mid-flight
+                "timeout": WORKER_INFER_BUDGET,
+                "request_tag": str(req["id"]),
             }
             if req.get("max_length") is not None:
                 infer_body["max_length"] = req["max_length"]
             else:
                 infer_body["max_new_tokens"] = req["max_new_tokens"]
-            r = self._worker_post(node, "/inference", infer_body, INFER_TIMEOUT)
+            self._processing[req["id"]] = node
+            try:
+                r = self._worker_post(node, "/inference", infer_body,
+                                      INFER_TIMEOUT)
+            finally:
+                self._processing.pop(req["id"], None)
             if 400 <= r.status_code < 500:
                 self.store.mark_failed(req["id"],
                                        f"rejected: {r.text[:200]}")
@@ -348,6 +397,15 @@ class Master:
         except Exception as e:
             log.warning("request %d failed on node %d: %s", req["id"], nid, e)
             self.metrics.inc("requests_errored")
+            if isinstance(e, http.exceptions.Timeout):
+                # our timeout fired first (clock skew / slow network):
+                # best-effort cancel so the worker stops generating for
+                # nobody and frees its batcher slot
+                try:
+                    self._worker_post(node, "/cancel",
+                                      {"request_tag": str(req["id"])}, 10)
+                except Exception:
+                    pass
             if req["attempts"] + 1 < MAX_ATTEMPTS:
                 self.store.requeue(req["id"])   # failover retry
                 self._wake.set()

@@ -23,9 +23,12 @@ Determinism notes (what makes co-execution bit-identical): the leader
 resolves the sampling ``seed`` before forwarding (engine outputs are a
 pure function of (params, prompt, seed)); random-init uses a fixed seed;
 checkpoints/tokenizers load from the same paths on every host. Batched
-serving (runtime/batcher.py) makes timing-dependent scheduling decisions
-and is therefore leader-rejected on multi-host slices — mesh-sharded
-engine mode is the multi-host path.
+serving (runtime/batcher.py) makes timing-dependent scheduling decisions,
+so its REQUESTS are not mirrored; instead the leader's scheduler
+broadcasts each *device program launch* (admission prefill / decode step)
+with its full input set via ``batcher_program`` ops, and followers replay
+them in sequence order — leader-decided schedule, SPMD-identical
+execution (the round-2 leader-broadcast admission design).
 
 Tested with multi-process CPU ``jax.distributed`` clusters
 (tests/test_multihost.py) — the same code path as real multi-host TPU.
@@ -193,12 +196,6 @@ class LockstepLeader:
         if op in ("inference", "inference_stream"):
             # identical RNG stream on every host
             body.setdefault("seed", time.time_ns() % (1 << 31))
-        if op in ("load_model", "load_shard") \
-                and body.get("serving") == "batched":
-            raise ValueError(
-                "batched serving makes timing-dependent scheduling "
-                "decisions and cannot run in lockstep across hosts; use "
-                "mesh-sharded engine mode on multi-host slices")
         return body
 
     def _make_handler(self, op: str):
@@ -209,14 +206,44 @@ class LockstepLeader:
                 body = self._prepare(op, body)
             except ValueError as e:
                 return 400, {"status": "error", "message": str(e)}
+            if op == "inference" and self._is_batched(body):
+                # batched serving: the REQUEST is leader-local scheduler
+                # input, not an SPMD op — the batcher's device programs are
+                # mirrored one by one via its program_hook instead
+                return local(body)
             try:
                 seq = self._mirror(op, body)
             except RuntimeError as e:
                 return 503, {"status": "error", "message": str(e)}
-            return self.exec.run(seq, lambda: local(body))
+            result = self.exec.run(seq, lambda: local(body))
+            if op in ("load_model", "load_shard"):
+                self._attach_batcher_hooks()
+            return result
 
         handler.__name__ = f"lockstep_{op}"
         return handler
+
+    def _is_batched(self, body) -> bool:
+        m = self.agent.models.get(body.get("model_name"))
+        return m is not None and getattr(m, "batcher", None) is not None
+
+    def _attach_batcher_hooks(self):
+        """Route every batched model's device programs through the mirror.
+
+        Scheduling stays leader-local (admission, preemption, block
+        allocation are host-side state only the leader holds); what crosses
+        hosts is the resulting *program launches*, each with its full
+        JSON-safe input set, which followers replay in sequence order —
+        identical programs, identical order, identical cache evolution."""
+        for name, m in self.agent.models.items():
+            b = getattr(m, "batcher", None)
+            if b is not None and b.program_hook is None:
+                def hook(kind, args, run, _name=name):
+                    seq = self._mirror("batcher_program",
+                                       {"model_name": _name, "kind": kind,
+                                        "args": args})
+                    return self.exec.run(seq, run)
+                b.program_hook = hook
 
     def inference_stream(self, body, _request=None):
         """Leader streams SSE to the client; followers co-execute the same
@@ -237,6 +264,10 @@ class LockstepLeader:
             self.agent._prep_inference(body)
         except (KeyError, ValueError) as e:
             return 400, {"status": "error", "message": str(e)}
+        if self._is_batched(body):
+            # leader-local streaming; device programs mirror via the
+            # batcher's program_hook (see _attach_batcher_hooks)
+            return self.agent.inference_stream(body, _request=_request)
         try:
             seq = self._mirror("inference_stream", body)
         except RuntimeError as e:
@@ -268,12 +299,23 @@ class LockstepFollower:
             # co-execute the leader's stream as a plain generation: same
             # seed and eos give the identical jit/collective sequence
             "inference_stream": agent.inference,
+            # replay one batched-scheduler device program (admission
+            # prefill or decode step) with the leader's exact inputs
+            "batcher_program": self._batcher_program,
             "noop": lambda body: {"status": "noop"},
         }
         s = agent.service
         s.add("POST", "/lockstep", self.lockstep)
         for op in MIRRORED_OPS + ("inference_stream",):
             _replace_route(s, "POST", f"/{op}", self._rejected(op))
+
+    def _batcher_program(self, body):
+        m = self.agent.models.get(body.get("model_name"))
+        if m is None or m.batcher is None:
+            return 409, {"status": "error",
+                         "message": "no such batched model on this host"}
+        m.batcher.replay(body.get("kind"), body.get("args") or {})
+        return {"status": "success"}
 
     def _rejected(self, op):
         def handler(body, _request=None):

@@ -64,12 +64,17 @@ class WorkerAgent:
         s.add("POST", "/unload_model", self.unload_model)
         s.add("POST", "/inference", self.inference)
         s.add("POST", "/inference_stream", self.inference_stream)
+        s.add("POST", "/cancel", self.cancel)
         s.add("POST", "/profile/start", self.profile_start)
         s.add("POST", "/profile/stop", self.profile_stop)
         s.add("GET", "/memory_profile", self.memory_profile)
         s.add("POST", "/ssh_setup", self.ssh_setup)
         self._profile_dir: Optional[str] = None
         self._profile_lock = threading.Lock()
+        # request_tag -> in-flight batcher request, so a caller (the master
+        # on its own timeout, or an operator) can cancel and free the slot
+        self._tagged: Dict[str, object] = {}
+        self._tagged_lock = threading.Lock()
 
     # ---- endpoints ---------------------------------------------------
 
@@ -143,11 +148,15 @@ class WorkerAgent:
         native = body.get("native_checkpoint")
         mesh = MeshSpec.from_dict(body.get("mesh", {}))
         t0 = time.time()
-        if body.get("serving") == "batched" and mesh.num_devices > 1:
-            # validate BEFORE any (possibly huge) checkpoint restore
+        if body.get("serving") == "batched" and any(
+                getattr(mesh, ax) > 1 for ax in ("dp", "pp", "sp")):
+            # validate BEFORE any (possibly huge) checkpoint restore; the
+            # batcher shards tensors (tp/ep) but owns the batch dimension
+            # itself (runtime/batcher.py)
             return 400, {"status": "error",
-                         "message": "batched serving is single-program; "
-                                    "drop the mesh or use default mode"}
+                         "message": "batched serving supports tp/ep mesh "
+                                    "axes only; drop dp/pp/sp or use "
+                                    "default mode"}
         if native:
             # converted-once artifact (models/checkpoint.py): no torch on
             # the serving path, restore is sharded when a mesh is in play
@@ -204,7 +213,8 @@ class WorkerAgent:
                 num_blocks=int(body.get("kv_blocks", 512)),
                 block_size=int(body.get("kv_block_size", 16)),
                 slots=int(body.get("slots", 8)),
-                max_seq=body.get("max_seq"))
+                max_seq=body.get("max_seq"),
+                mesh_spec=mesh)
             batcher.start()
             lm = LoadedModel(None, tok, source, batcher=batcher)
             stats = batcher.stats()
@@ -294,18 +304,26 @@ class WorkerAgent:
         if m.batcher is not None:
             # batched serving: enqueue and wait — no per-model lock, the
             # batcher interleaves this request with others in flight
+            tag = body.get("request_tag")
             try:
                 with self.metrics.time("inference"):
                     req = m.batcher.submit(
                         prompt, max_new_tokens=max_new, sampling=sp,
                         eos_token_id=m.tokenizer.eos_token_id,
                         seed=body.get("seed"))
+                    if tag:
+                        with self._tagged_lock:
+                            self._tagged[str(tag)] = req
                     toks = req.wait(timeout=float(body.get("timeout", 300)))
             except TimeoutError as e:
                 req.cancel()   # free the slot; don't generate for nobody
                 return 408, {"status": "error", "message": str(e)}
             except (ValueError, RuntimeError) as e:
                 return 400, {"status": "error", "message": str(e)}
+            finally:
+                if tag:
+                    with self._tagged_lock:
+                        self._tagged.pop(str(tag), None)
             self.metrics.inc("requests_completed")
             self.metrics.inc("tokens_generated", len(toks))
             return {
@@ -435,6 +453,29 @@ class WorkerAgent:
             self.metrics.inc("requests_completed")
 
         return httpd.sse_stream(_request, events())
+
+    def cancel(self, body):
+        """Cancel an in-flight tagged batched request, freeing its slot.
+
+        The reference had no cancellation at all — a master-side timeout
+        left the worker generating for nobody (SURVEY.md §2.3 one blocking
+        request; the master's 120s timeout vs the worker's open-ended
+        generate). Engine-mode requests are not cancellable mid-program
+        (one jitted chunk runs to completion); the batcher drops the slot
+        at its next step.
+        """
+        tag = body.get("request_tag")
+        if not tag:
+            return 400, {"status": "error", "message": "request_tag required"}
+        with self._tagged_lock:
+            req = self._tagged.get(str(tag))
+        if req is None:
+            return 404, {"status": "error",
+                         "message": f"no in-flight request tagged {tag!r}"}
+        req.cancel()
+        self.metrics.inc("requests_cancelled")
+        return {"status": "success",
+                "message": f"cancel requested for {tag!r}"}
 
     # ---- profiling ----------------------------------------------------
     # The reference's only timing was wall-clock execution_time per request

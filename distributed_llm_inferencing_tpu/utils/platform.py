@@ -1,0 +1,83 @@
+"""Robust JAX platform selection for every process entrypoint.
+
+The reference never faced this problem (torch device selection is a
+one-liner, reference worker/app.py:26); on TPU hosts the backend can be
+*temporarily unavailable* (chip held by another process, tunnel down) and
+— worse — backend init can HANG rather than raise, so in-process
+try/except is not enough.  This module makes platform choice explicit and
+hang-proof:
+
+- ``force_platform(p)`` pins the platform **before** first backend init.
+  Note: this environment pre-imports jax at interpreter startup
+  (sitecustomize TPU plugin), so env vars alone are too late —
+  ``jax.config.update`` is the only reliable switch.
+- ``probe_default_backend(timeout)`` initializes the default backend in a
+  **subprocess** with a hard timeout, so a hanging TPU init cannot hang
+  the caller.
+- ``ensure_backend()`` is the one entrypoints call: honor an explicit
+  request (``--platform`` / ``DLI_PLATFORM``), else probe the default
+  (TPU) backend with retry+backoff, else degrade to CPU and say so.
+
+Every CLI subcommand and ``bench.py`` route through this, so a dead chip
+produces a *degraded CPU run with rc=0*, never a crash or a hang.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from typing import Optional
+
+_PROBE_SRC = (
+    "import jax, sys\n"
+    "sys.stdout.write(jax.devices()[0].platform)\n"
+    "sys.stdout.flush()\n"
+)
+
+
+def force_platform(platform: str) -> None:
+    """Pin the JAX platform before any backend init (cpu|tpu|...)."""
+    import jax
+    jax.config.update("jax_platforms", platform)
+
+
+def probe_default_backend(timeout: float = 75.0) -> Optional[str]:
+    """Try default-backend init in a subprocess; return its platform name,
+    or None if init failed OR hung past ``timeout`` seconds."""
+    env = dict(os.environ)
+    env.pop("DLI_PLATFORM", None)  # probe the true default
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC],
+            capture_output=True, text=True, timeout=timeout, env=env)
+    except (subprocess.TimeoutExpired, OSError):
+        return None
+    out = r.stdout.strip()
+    return out if r.returncode == 0 and out else None
+
+
+def ensure_backend(requested: Optional[str] = None,
+                   probe_timeout: float = 75.0,
+                   attempts: int = 2,
+                   backoff_s: float = 5.0) -> dict:
+    """Decide the platform for this process. Call BEFORE any jax.devices().
+
+    Returns ``{"platform": str, "degraded": bool}`` — degraded means the
+    accelerator was requested implicitly (default) but unavailable, and we
+    pinned CPU so the process still runs.
+    """
+    requested = requested or os.environ.get("DLI_PLATFORM") or None
+    if requested:
+        force_platform(requested)
+        return {"platform": requested, "degraded": False}
+    last = None
+    for i in range(attempts):
+        if i:
+            time.sleep(backoff_s * i)
+        last = probe_default_backend(probe_timeout)
+        if last:
+            return {"platform": last, "degraded": False}
+    force_platform("cpu")
+    return {"platform": "cpu", "degraded": True}

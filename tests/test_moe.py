@@ -1,0 +1,97 @@
+"""MoE dispatch strategies: dense compute-all-experts vs GShard-style
+capacity dispatch (models/transformer.py _moe_dense/_moe_capacity).
+
+Golden property: with capacity sized so no token drops, capacity dispatch
+must reproduce the dense path exactly (same top-k gates, same expert
+math) — sharded or not.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from distributed_llm_inferencing_tpu.models import transformer
+from distributed_llm_inferencing_tpu.models.params import init_params
+from distributed_llm_inferencing_tpu.models.registry import get_config
+from distributed_llm_inferencing_tpu.ops.kvcache import init_cache
+from distributed_llm_inferencing_tpu.parallel import sharding as shd
+from distributed_llm_inferencing_tpu.parallel.mesh import (
+    MeshSpec, create_mesh, validate_spec)
+
+BASE = get_config("tiny-mixtral").replace(dtype="float32",
+                                          attn_backend="xla")
+# capacity C = factor * k * N / E; factor = E/k makes C = N: zero drops
+# regardless of how unbalanced the router is
+NO_DROP = float(BASE.num_experts) / BASE.num_experts_per_tok
+PARAMS = init_params(BASE, jax.random.PRNGKey(0), dtype=jnp.float32)
+RNG = np.random.default_rng(0)
+
+
+def _prefill_logits(cfg, params, tokens, mesh=None, spec=None):
+    B, S = tokens.shape
+    cache = init_cache(cfg, B, S, dtype=jnp.float32)
+    lengths = jnp.full((B,), S, jnp.int32)
+    if mesh is None:
+        logits, _ = transformer.prefill(params, cfg, tokens, lengths, cache)
+        return np.asarray(logits)
+    with mesh:
+        p = shd.shard_params(params, mesh, cfg, spec)
+        cache = jax.device_put(cache,
+                               shd.named(mesh, shd.cache_specs(cfg, spec)))
+        logits, _ = jax.jit(
+            lambda p, t, l, c: transformer.prefill(p, cfg, t, l, c)
+        )(p, tokens, lengths, cache)
+    return np.asarray(logits)
+
+
+def test_capacity_matches_dense_no_drops():
+    tokens = jnp.asarray(
+        RNG.integers(0, BASE.vocab_size, (2, 24)), jnp.int32)
+    ref = _prefill_logits(BASE.replace(moe_dispatch="dense"), PARAMS, tokens)
+    got = _prefill_logits(
+        BASE.replace(moe_dispatch="capacity",
+                     moe_capacity_factor=NO_DROP), PARAMS, tokens)
+    np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_capacity_ep_sharded_matches_unsharded():
+    cfg = BASE.replace(moe_dispatch="capacity", moe_capacity_factor=NO_DROP)
+    spec = MeshSpec(ep=2, tp=2)
+    validate_spec(spec, cfg)
+    tokens = jnp.asarray(
+        RNG.integers(0, BASE.vocab_size, (2, 24)), jnp.int32)
+    ref = _prefill_logits(cfg, PARAMS, tokens)
+    got = _prefill_logits(cfg, PARAMS, tokens, create_mesh(spec), spec)
+    np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_capacity_overflow_drops_are_finite():
+    """A deliberately tiny capacity must degrade (dropped tokens), never
+    produce NaNs or crash — the load-shedding contract."""
+    cfg = BASE.replace(moe_dispatch="capacity", moe_capacity_factor=0.25)
+    tokens = jnp.asarray(
+        RNG.integers(0, BASE.vocab_size, (1, 32)), jnp.int32)
+    out = _prefill_logits(cfg, PARAMS, tokens)
+    assert np.isfinite(out).all()
+
+
+def test_auto_picks_dense_for_decode_and_capacity_for_prefill():
+    from distributed_llm_inferencing_tpu.models.transformer import (
+        _MOE_AUTO_DENSE_MAX_TOKENS)
+    # decode-shaped input (N = 8) -> dense; prefill-shaped -> capacity.
+    # Pin by checking auto ≡ explicit on both shapes.
+    cfg_auto = BASE.replace(moe_dispatch="auto",
+                            moe_capacity_factor=NO_DROP)
+    small = jnp.asarray(RNG.integers(0, BASE.vocab_size, (1, 8)), jnp.int32)
+    assert small.size <= _MOE_AUTO_DENSE_MAX_TOKENS
+    np.testing.assert_array_equal(
+        _prefill_logits(cfg_auto, PARAMS, small),
+        _prefill_logits(BASE.replace(moe_dispatch="dense"), PARAMS, small))
+    big = jnp.asarray(RNG.integers(0, BASE.vocab_size, (2, 48)), jnp.int32)
+    assert big.size > _MOE_AUTO_DENSE_MAX_TOKENS
+    np.testing.assert_array_equal(
+        _prefill_logits(cfg_auto, PARAMS, big),
+        _prefill_logits(BASE.replace(moe_dispatch="capacity",
+                                     moe_capacity_factor=NO_DROP),
+                        PARAMS, big))

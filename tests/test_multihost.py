@@ -165,10 +165,41 @@ def test_follower_rejects_stale_or_duplicate_seq(slice2):
     assert r.status_code == 400
 
 
-def test_batched_rejected_on_multihost(slice2):
+def test_batched_serving_on_multihost(slice2):
+    """Round-2: batched serving spans the slice — the tp=2 mesh covers
+    both processes, so every batcher program's collectives cross hosts;
+    completion is only possible if the follower replays each leader
+    program (a missing partner deadlocks the collective). Requests also
+    reproduce exactly, proving the slice stays in lockstep."""
+    import threading
     lport, _ = slice2
-    r = requests.post(f"http://127.0.0.1:{lport}/load_model", json={
+    url = f"http://127.0.0.1:{lport}"
+    r = requests.post(url + "/load_model", json={
         "model_name": "tiny-gpt2", "allow_random_init": True,
-        "serving": "batched"}, timeout=60)
-    assert r.status_code == 400
-    assert "lockstep" in r.json()["message"]
+        "serving": "batched", "kv_blocks": 32, "kv_block_size": 8,
+        "slots": 2, "max_seq": 64, "dtype": "float32",
+        "mesh": {"tp": 2}}, timeout=300)
+    assert r.status_code == 200, r.text
+
+    prompts = [[3, 5, 7], [2, 4, 6, 8]]
+    results = {}
+
+    def go(i):
+        results[i] = requests.post(url + "/inference", json={
+            "model_name": "tiny-gpt2", "prompt_tokens": prompts[i],
+            "max_new_tokens": 6, "seed": 11 + i}, timeout=300).json()
+
+    threads = [threading.Thread(target=go, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    for i in range(2):
+        assert results[i]["status"] == "success", results[i]
+        assert len(results[i]["tokens"]) == 6
+
+    # identical request ⇒ identical tokens (pure fn of params/prompt/seed)
+    r2 = requests.post(url + "/inference", json={
+        "model_name": "tiny-gpt2", "prompt_tokens": prompts[0],
+        "max_new_tokens": 6, "seed": 11}, timeout=300).json()
+    assert r2["tokens"] == results[0]["tokens"]

@@ -1,0 +1,51 @@
+"""Platform selection robustness (utils/platform.py).
+
+Round-1 failure mode: the TPU backend hung/errored at init and took the
+bench + CLI down with it (BENCH_r01 rc=1). These tests pin the contract:
+explicit request wins, probe failure degrades to cpu, and the probe is a
+subprocess with a hard timeout so a hang cannot propagate.
+"""
+
+import subprocess
+import sys
+
+from distributed_llm_inferencing_tpu.utils import platform as plat
+
+
+def test_explicit_request_is_not_degraded(monkeypatch):
+    monkeypatch.delenv("DLI_PLATFORM", raising=False)
+    info = plat.ensure_backend("cpu")
+    assert info == {"platform": "cpu", "degraded": False}
+
+
+def test_env_request_wins(monkeypatch):
+    monkeypatch.setenv("DLI_PLATFORM", "cpu")
+    info = plat.ensure_backend()
+    assert info == {"platform": "cpu", "degraded": False}
+
+
+def test_probe_failure_degrades_to_cpu(monkeypatch):
+    monkeypatch.delenv("DLI_PLATFORM", raising=False)
+    monkeypatch.setattr(plat, "probe_default_backend", lambda timeout: None)
+    info = plat.ensure_backend(attempts=2, backoff_s=0.0)
+    assert info == {"platform": "cpu", "degraded": True}
+
+
+def test_probe_success_is_used(monkeypatch):
+    monkeypatch.delenv("DLI_PLATFORM", raising=False)
+    monkeypatch.setattr(plat, "probe_default_backend", lambda timeout: "tpu")
+    info = plat.ensure_backend()
+    assert info == {"platform": "tpu", "degraded": False}
+
+
+def test_probe_timeout_kills_hung_init(monkeypatch):
+    # a probe command that hangs forever must return None at the timeout,
+    # not hang the caller
+    real_run = subprocess.run
+
+    def hang_run(cmd, **kw):
+        return real_run([sys.executable, "-c", "import time; time.sleep(60)"],
+                        **kw)
+
+    monkeypatch.setattr(plat.subprocess, "run", hang_run)
+    assert plat.probe_default_backend(timeout=1.0) is None

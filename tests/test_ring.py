@@ -121,3 +121,53 @@ def test_ring_rejects_kv_replication():
     with pytest.raises(ValueError, match="kv"):
         ring.ring_attend_prefill(q, k, k, pos, jnp.ones((1,), jnp.int32),
                                  mesh=mesh)
+
+
+# ---- ring decode (flash-decoding combine over sp) -----------------------
+
+
+@pytest.mark.parametrize("spec,window", [
+    (MeshSpec(sp=4), None),
+    (MeshSpec(sp=8), None),
+    (MeshSpec(dp=2, sp=2, tp=2), None),
+    (MeshSpec(sp=4), 7),
+])
+def test_ring_decode_matches_dense(spec, window):
+    """One-token attention over an sp-sharded cache == dense attention."""
+    rng = np.random.default_rng(1)
+    B, S, H, Hkv, hd = 4, 32, 4, 2, 8
+    q = jnp.asarray(rng.standard_normal((B, 1, H, hd)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, S, Hkv, hd)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, S, Hkv, hd)), jnp.float32)
+    lengths = jnp.asarray([S, S - 5, 17, 1], jnp.int32)  # ragged
+
+    # dense reference: query sits at position length-1
+    pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    valid = pos < lengths[:, None]
+    ref = np.asarray(attend(q, k, v, (lengths - 1)[:, None], pos, valid,
+                            sliding_window=window))
+
+    mesh = create_mesh(spec)
+    with mesh:
+        got = jax.jit(lambda q, k, v, l: ring.ring_attend_decode(
+            q, k, v, l, mesh=mesh, sliding_window=window))(q, k, v, lengths)
+    np.testing.assert_allclose(np.asarray(got), ref, atol=1e-5, rtol=1e-5)
+
+
+def test_sp_tp_decode_trajectory_matches_dense():
+    """sp=2 x tp=2 engine: full greedy trajectory == single-device engine
+    (VERDICT round-1 item 5 done-condition)."""
+    from distributed_llm_inferencing_tpu.ops.sampling import SamplingParams
+    from distributed_llm_inferencing_tpu.runtime.engine import InferenceEngine
+
+    cfg = get_config("tiny-llama").replace(dtype="float32")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    prompt = np.random.default_rng(7).integers(
+        1, cfg.vocab_size, 19).tolist()
+    sp_eng = InferenceEngine(cfg, params, mesh_spec=MeshSpec(sp=2, tp=2),
+                             max_seq=64)
+    ref_eng = InferenceEngine(cfg, params, max_seq=64)
+    g = SamplingParams.greedy()
+    got = sp_eng.generate([prompt], max_new_tokens=12, sampling=g)
+    ref = ref_eng.generate([prompt], max_new_tokens=12, sampling=g)
+    assert got.tokens == ref.tokens

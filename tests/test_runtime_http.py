@@ -292,3 +292,70 @@ def test_admin_cli(worker, master):
     node_id = [n["id"] for n in nodes["nodes"] if n["name"] == "adm1"][0]
     out = run("remove-node", "--node_id", str(node_id))
     assert out["status"] == "success"
+
+
+def test_master_cancel_frees_worker_slot(master):
+    """Master-side cancel reaches the worker's batcher and frees the slot
+    (VERDICT round-1 item 7 done-condition)."""
+    m, mport = master
+    agent = WorkerAgent()
+    srv = agent.serve(host="127.0.0.1", port=0, background=True)
+    wport = srv.server_address[1]
+    try:
+        r = requests.post(_url(wport, "/load_model"), json={
+            "model_name": "tiny-llama", "allow_random_init": True,
+            "serving": "batched", "kv_blocks": 64, "kv_block_size": 8,
+            "slots": 2, "max_seq": 128, "dtype": "float32",
+        }, timeout=300)
+        assert r.status_code == 200, r.text
+        r = requests.post(_url(mport, "/api/nodes/add"), json={
+            "name": "cancel-node", "host": "127.0.0.1", "port": wport,
+        }, timeout=30)
+        assert r.status_code == 200, r.text
+
+        r = requests.post(_url(mport, "/api/inference/submit"), json={
+            "model_name": "tiny-llama", "prompt": "hello world",
+            "max_new_tokens": 110,
+        }, timeout=30)
+        req_id = r.json()["request_id"]
+
+        # wait until it's actually running on the worker, then cancel
+        deadline = time.time() + 60
+        cancelled = False
+        while time.time() < deadline and not cancelled:
+            c = requests.post(
+                _url(mport, f"/api/inference/cancel/{req_id}"), timeout=30)
+            if c.status_code == 200 and "relayed" in c.json()["message"]:
+                cancelled = True
+            elif c.status_code == 409 and "already" in c.json()["message"]:
+                raise AssertionError(f"finished before cancel: {c.json()}")
+            time.sleep(0.1)
+        assert cancelled
+
+        req = _wait_status(mport, req_id)
+        assert req["status"] == "failed"
+        assert "cancel" in req["error"]
+
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            st = requests.get(_url(wport, "/health")).json()[
+                "loaded_models"][0]["scheduler"]
+            if st["active"] == 0:
+                break
+            time.sleep(0.2)
+        assert st["active"] == 0, st
+    finally:
+        agent.service.shutdown()
+
+
+def test_dashboard_pages_surface_serving_internals(master):
+    """The three pages render, and the round-2 additions are present:
+    batcher stats on the dashboard, placement plans on the nodes page
+    (≙ reference node_management.html:154-171 shard table)."""
+    _, mport = master
+    dash = requests.get(_url(mport, "/")).text
+    assert "Batched Serving" in dash and "Prefix hit rate" in dash
+    nodes = requests.get(_url(mport, "/nodes")).text
+    assert "Placement Plans" in nodes and "/api/plans" in nodes
+    inf = requests.get(_url(mport, "/inference")).text
+    assert "Run Inference" in inf

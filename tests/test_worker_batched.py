@@ -8,6 +8,7 @@ concurrent requests share decode steps.
 
 import json
 import threading
+import time
 
 import pytest
 import requests
@@ -141,3 +142,106 @@ def test_unload_stops_batcher(worker):
                       json={"model_name": "tiny-gpt2"}, timeout=60)
     assert r.status_code == 200
     assert b._thread is None
+
+
+def test_batched_with_tp_mesh():
+    """Round-2 lift: batched serving accepts a tp mesh (the old 400 is
+    gone); dp/pp/sp on the batcher still 400s before any restore."""
+    agent = WorkerAgent()
+    srv = agent.serve(host="127.0.0.1", port=0, background=True)
+    port = srv.server_address[1]
+    try:
+        r = requests.post(_url(port, "/load_model"), json={
+            "model_name": "tiny-llama", "allow_random_init": True,
+            "serving": "batched", "kv_blocks": 32, "kv_block_size": 8,
+            "slots": 2, "max_seq": 64, "dtype": "float32",
+            "mesh": {"tp": 2},
+        }, timeout=300)
+        assert r.status_code == 200, r.text
+        h = requests.get(_url(port, "/health")).json()
+        [m] = h["loaded_models"]
+        assert m["scheduler"]["mesh"]["tp"] == 2
+        r = requests.post(_url(port, "/inference"), json={
+            "model_name": "tiny-llama", "prompt_tokens": [2, 4, 6],
+            "max_new_tokens": 5, "sampling": {"do_sample": False},
+        }, timeout=300)
+        assert r.status_code == 200, r.text
+        assert len(r.json()["tokens"]) == 5
+
+        r = requests.post(_url(port, "/load_model"), json={
+            "model_name": "tiny-gpt2", "allow_random_init": True,
+            "serving": "batched", "mesh": {"dp": 2}, "dtype": "float32",
+        }, timeout=60)
+        assert r.status_code == 400
+        assert "tp/ep" in r.json()["message"]
+    finally:
+        agent.service.shutdown()
+
+
+def test_timeout_and_cancel_free_slots():
+    """A request that exceeds its budget 408s AND releases its batcher
+    slot; a tagged in-flight request can be cancelled via /cancel
+    (round-2 master↔worker timeout/cancel story)."""
+    agent = WorkerAgent()
+    srv = agent.serve(host="127.0.0.1", port=0, background=True)
+    port = srv.server_address[1]
+    try:
+        r = requests.post(_url(port, "/load_model"), json={
+            "model_name": "tiny-llama", "allow_random_init": True,
+            "serving": "batched", "kv_blocks": 64, "kv_block_size": 8,
+            "slots": 2, "max_seq": 512, "dtype": "float32",
+        }, timeout=300)
+        assert r.status_code == 200, r.text
+
+        # 1) worker-side budget: long generation, tiny timeout -> 408
+        r = requests.post(_url(port, "/inference"), json={
+            "model_name": "tiny-llama", "prompt_tokens": [1, 2, 3],
+            "max_new_tokens": 120, "timeout": 0.5,
+        }, timeout=60)
+        assert r.status_code == 408, r.text
+        deadline = time.time() + 30
+        while time.time() < deadline:   # cancel lands at the next step
+            st = requests.get(_url(port, "/health")).json()[
+                "loaded_models"][0]["scheduler"]
+            if st["active"] == 0:
+                break
+            time.sleep(0.2)
+        assert st["active"] == 0, st
+
+        # 2) tagged cancel: kick off a long request, cancel it mid-flight
+        results = {}
+
+        def go():
+            results["r"] = requests.post(_url(port, "/inference"), json={
+                "model_name": "tiny-llama", "prompt_tokens": [5, 6, 7],
+                "max_new_tokens": 120, "request_tag": "req-42",
+            }, timeout=120)
+
+        t = threading.Thread(target=go)
+        t.start()
+        deadline = time.time() + 30
+        cancelled = False
+        while time.time() < deadline and not cancelled:
+            c = requests.post(_url(port, "/cancel"),
+                              json={"request_tag": "req-42"}, timeout=10)
+            cancelled = c.status_code == 200
+            time.sleep(0.1)
+        assert cancelled
+        t.join(timeout=60)
+        r = results["r"]
+        assert r.status_code == 400 and "cancel" in r.json()["message"]
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            st = requests.get(_url(port, "/health")).json()[
+                "loaded_models"][0]["scheduler"]
+            if st["active"] == 0:
+                break
+            time.sleep(0.2)
+        assert st["active"] == 0, st
+
+        # unknown tag -> 404
+        c = requests.post(_url(port, "/cancel"),
+                          json={"request_tag": "nope"}, timeout=10)
+        assert c.status_code == 404
+    finally:
+        agent.service.shutdown()
