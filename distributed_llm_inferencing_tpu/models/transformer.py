@@ -416,26 +416,83 @@ def paged_decode_step(params, cfg: ModelConfig, tokens, paged,
     return logits, PagedKVCache(k=new_k, v=new_v)
 
 
+def paged_decode_chunk(params, cfg: ModelConfig, k: int, tokens, paged,
+                       block_tables, context_lens, seeds, steps0, temps,
+                       tks, tps, ds, budget, eos_ids, dummy_block: int):
+    """Run K decode steps + sampling entirely on device for R serving slots.
+
+    The continuous batcher's throughput lever: one dispatched program
+    advances every active slot up to ``k`` tokens, so the host syncs once
+    per chunk instead of once per token (the same chunked-scan trade the
+    engine makes, runtime/engine.py DECODE_CHUNKS — a per-token host round
+    trip is what made the reference's loop unshippable behind a network
+    hop, reference worker/app.py:297-305).
+
+    Per-slot lifecycle runs as data inside the scan:
+    - ``budget[r]``: how many tokens slot r may still emit (0 = inactive).
+      A slot is *alive* until its budget is spent or it samples its eos.
+    - ``eos_ids[r]``: per-slot eos token (-1 = none). The eos token itself
+      is not emitted (mirrors the host-side scheduler semantics).
+    - Dead slots keep running (lax.scan needs static shapes) but their
+      cache writes are redirected to the reserved ``dummy_block`` and
+      their outputs masked out of ``emits``.
+
+    Sampling folds ``steps0 + t`` into each slot's own PRNG stream, so a
+    request's tokens stay a pure function of (params, prompt, seed) —
+    bit-identical whether decoded one token or K tokens per dispatch.
+
+    tokens: [R] last emitted token per slot; steps0: [R] tokens emitted so
+    far. Returns (toks [K, R] int32, emits [K, R] bool, new paged); the
+    emitted tokens of slot r are ``toks[:emits[:, r].sum(), r]``.
+    """
+    from distributed_llm_inferencing_tpu.ops.sampling import sample_batch
+
+    def body(carry, t):
+        cur, paged, cl, alive = carry
+        bt_eff = jnp.where(alive[:, None], block_tables, dummy_block)
+        cl_eff = jnp.where(alive, cl, 0)
+        logits, paged = paged_decode_step(params, cfg, cur, paged, bt_eff,
+                                          cl_eff)
+        nxt = sample_batch(logits, seeds, steps0 + t, temps, tks, tps, ds)
+        is_eos = alive & (eos_ids >= 0) & (nxt == eos_ids)
+        emit = alive & ~is_eos
+        new_cl = cl + alive.astype(cl.dtype)   # advance iff wrote this step
+        new_alive = emit & (t + 1 < budget)
+        return (nxt, paged, new_cl, new_alive), (nxt, emit)
+
+    (_, paged, _, _), (toks, emits) = jax.lax.scan(
+        body, (tokens, paged, context_lens, budget > 0),
+        jnp.arange(k, dtype=jnp.int32))
+    return toks, emits, paged
+
+
 def paged_prefill_tail(params, cfg: ModelConfig, tokens, tail_len,
                        tail_blocks, prefix_blocks, prefix_len, paged):
-    """Prefill a prompt tail into paged blocks, attending a cached prefix.
+    """Prefill a WAVE of prompt tails into paged blocks, each attending its
+    own cached prefix.
 
-    The prefix (``prefix_len`` tokens in ``prefix_blocks``, a radix-cache
-    hit) is NOT recomputed — its K/V is gathered from shared blocks per
-    layer. Fresh tail K/V is scattered into ``tail_blocks``.
+    Each row's prefix (``prefix_len[b]`` tokens in ``prefix_blocks[b]``, a
+    radix-cache hit) is NOT recomputed — its K/V is gathered from shared
+    blocks per layer. Fresh tail K/V is scattered into ``tail_blocks``.
+    Batching admissions into one program is what keeps burst TTFT at one
+    dispatch round trip instead of one per queued request (the reference
+    served admissions fully serialized, worker/app.py:252-330).
 
-    tokens: [1, T] right-padded tail (T a multiple of block_size);
-    tail_len: [1] real tail tokens; tail_blocks: [T // bs] int32;
-    prefix_blocks: [1, PB] (dummy-padded); prefix_len: [1].
-    Returns (last-token logits [1, V] f32, new paged).
+    tokens: [B, T] right-padded tails (T a multiple of block_size);
+    tail_len: [B] real tail tokens (>= 1; padding rows use 1);
+    tail_blocks: [B, T // bs] int32 (padding rows all-dummy; legacy
+    unbatched [T // bs] accepted when B == 1);
+    prefix_blocks: [B, PB] (dummy-padded); prefix_len: [B].
+    Returns (last-token logits [B, V] f32, new paged).
     """
     from distributed_llm_inferencing_tpu.ops.paged_kvcache import (
         PagedKVCache, paged_attend_prefix, write_block_run)
     b, t = tokens.shape
-    if b != 1:
+    if tail_blocks.ndim == 1:
+        tail_blocks = tail_blocks[None]
+    if tail_blocks.shape[0] != b:
         raise ValueError(
-            f"paged_prefill_tail admits one sequence at a time, got batch {b} "
-            "(tail_blocks is unbatched; the batcher serializes admissions)")
+            f"tail_blocks batch {tail_blocks.shape[0]} != tokens batch {b}")
     q_pos = prefix_len[:, None] + jnp.broadcast_to(
         jnp.arange(t, dtype=jnp.int32), (b, t))
     tail_valid = jnp.arange(t, dtype=jnp.int32)[None, :] < tail_len[:, None]
@@ -445,8 +502,8 @@ def paged_prefill_tail(params, cfg: ModelConfig, tokens, tail_len,
         lp, ck, cv = layer_in
 
         def attend_write(q, k, v):
-            nk = write_block_run(ck, k[0], tail_blocks)
-            nv = write_block_run(cv, v[0], tail_blocks)
+            nk = write_block_run(ck, k, tail_blocks)
+            nv = write_block_run(cv, v, tail_blocks)
             attn = paged_attend_prefix(
                 q, k, v, nk, nv, prefix_blocks, prefix_len, q_pos, tail_valid,
                 sliding_window=cfg.sliding_window)
@@ -457,9 +514,9 @@ def paged_prefill_tail(params, cfg: ModelConfig, tokens, tail_len,
     x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], paged.k,
                                                paged.v))
     # project only the last real position through the vocab head ([D,V] over
-    # one row, not T padded rows)
+    # one row per sequence, not T padded rows)
     last_x = jnp.take_along_axis(
         x, jnp.maximum(tail_len - 1, 0)[:, None, None].astype(jnp.int32),
-        axis=1)                                         # [1, 1, D]
-    last = unembed(params, cfg, last_x)[:, 0]           # [1, V]
+        axis=1)                                         # [B, 1, D]
+    last = unembed(params, cfg, last_x)[:, 0]           # [B, V]
     return last, PagedKVCache(k=new_k, v=new_v)

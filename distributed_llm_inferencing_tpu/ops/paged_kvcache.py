@@ -72,15 +72,21 @@ def write_token(cache_layer, new, block_tables, positions):
 
 
 def write_block_run(cache_layer, new_blocks, block_ids):
-    """Scatter a run of whole blocks (a prefilled tail) into the pool.
+    """Scatter runs of whole blocks (prefilled tails) into the pool.
 
-    cache_layer: [NB, bs, Hkv, hd]; new_blocks: [T, Hkv, hd] with T a
-    multiple of bs; block_ids: [T // bs].
+    cache_layer: [NB, bs, Hkv, hd]; new_blocks: [B, T, Hkv, hd] (or
+    unbatched [T, Hkv, hd]) with T a multiple of bs; block_ids:
+    [B, T // bs] (or [T // bs]). Rows of a batched admission wave scatter
+    in one op; duplicate ids may only occur on the reserved dummy block
+    (padding rows), where last-write-wins garbage is by design.
     """
+    if new_blocks.ndim == 3:
+        new_blocks, block_ids = new_blocks[None], block_ids[None]
     bs = cache_layer.shape[1]
-    t = new_blocks.shape[0]
-    reshaped = new_blocks.reshape(t // bs, bs, *new_blocks.shape[1:])
-    return cache_layer.at[block_ids].set(reshaped.astype(cache_layer.dtype))
+    b, t = new_blocks.shape[:2]
+    reshaped = new_blocks.reshape(b * (t // bs), bs, *new_blocks.shape[2:])
+    return cache_layer.at[block_ids.reshape(-1)].set(
+        reshaped.astype(cache_layer.dtype))
 
 
 def gather_seq(cache_layer, block_tables):

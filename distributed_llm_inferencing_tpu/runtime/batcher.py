@@ -4,20 +4,34 @@ The reference served one prompt per blocking HTTP request, fully serialized
 per worker (1 gunicorn sync worker, reference: worker/Dockerfile:47,
 worker/app.py:252-330). The engine (runtime/engine.py) batches only within
 one ``generate`` call. This scheduler is the serving-native upgrade: a
-fixed pool of decode *slots* advances every active request one token per
-jitted step, admitting queued requests into freed slots mid-flight —
-in-flight batching, so short and long generations share the chip without
+fixed pool of decode *slots* advances every active request together,
+admitting queued requests into freed slots mid-flight — in-flight
+batching, so short and long generations share the chip without
 head-of-line blocking.
+
+Two dispatch-amortization levers keep the host off the critical path (a
+host round trip to a tunnel-attached chip costs tens of ms):
+
+- **Chunked decode**: each scheduler step launches ONE program that runs
+  up to K decode iterations on device (models/transformer.py
+  paged_decode_chunk) with per-slot budget/eos lifecycle as data. The
+  host syncs once per K tokens, and admission/growth/preemption decisions
+  happen at chunk boundaries (growth blocks for the whole chunk are
+  pre-allocated before dispatch).
+- **Wave admission**: queued requests are admitted in waves — one batched
+  tail-prefill program per (tail, prefix) bucket with first-token
+  sampling fused in, so a burst of N requests costs 1-2 dispatches of
+  TTFT, not 2N.
 
 Memory is paged (ops/paged_kvcache.py): which HBM blocks each sequence
 owns is decided host-side by the native C++ allocator
 (native/src/block_pool.cc), whose radix tree lets requests with a shared
 prompt prefix reuse already-prefilled blocks — admission then prefills
-only the tail (models/transformer.py paged_prefill_tail). Under memory
-pressure the youngest slot is preempted back to the queue (its prefix
-stays warm in the radix cache, so the re-run is mostly a cache hit).
+only the tail. Under memory pressure the youngest slot is preempted back
+to the queue (its prefix stays warm in the radix cache, so the re-run is
+mostly a cache hit).
 
-Per-request sampling params ride the jitted decode step as data
+Per-request sampling params ride the jitted programs as data
 (ops/sampling.py sample_batch), so one compiled program serves any mix of
 greedy/temperature/top-k/top-p requests.
 """
@@ -80,7 +94,7 @@ class BatchRequest:
 
     def cancel(self):
         """Ask the scheduler to drop this request (frees its slot/blocks at
-        the next step; already-generated tokens are kept)."""
+        the next chunk boundary; already-generated tokens are kept)."""
         self._cancelled = True
 
     @property
@@ -88,6 +102,12 @@ class BatchRequest:
         if self.first_token_at is None:
             return None
         return (self.first_token_at - self.submitted_at) * 1e3
+
+    @property
+    def latency_ms(self) -> Optional[float]:
+        if self.finished_at is None:
+            return None
+        return (self.finished_at - self.submitted_at) * 1e3
 
 
 class ContinuousBatcher:
@@ -98,11 +118,20 @@ class ContinuousBatcher:
     GSPMD partitions the step's matmuls/attention over ICI. Batch-dim
     parallelism (dp), pipeline stages (pp), and sequence sharding (sp) are
     rejected: the slot scheduler owns the batch dimension, and its
-    per-step host round trip is incompatible with stage/sequence pipelining.
+    chunk-boundary host round trip is incompatible with stage/sequence
+    pipelining.
 
     Drive it either with an owned background thread (``start()``/``stop()``)
     or synchronously via ``step()`` (tests, custom loops).
     """
+
+    # Decode-chunk sizes (tokens per dispatched program), tried in order.
+    # Each step picks the largest chunk some active slot can fill; per-slot
+    # budget/eos masks handle slots that finish mid-chunk. Mirrors the
+    # engine's DECODE_CHUNKS trade (runtime/engine.py): bigger chunks
+    # amortize dispatch RTT, at the cost of chunk-granularity admission/
+    # cancellation latency.
+    DECODE_CHUNKS = (32, 16, 8, 4, 2, 1)
 
     def __init__(self, cfg: ModelConfig, params=None, *,
                  num_blocks: int = 512, block_size: int = 16,
@@ -155,9 +184,8 @@ class ContinuousBatcher:
         self._step_count = 0
         self._tokens_out = 0
 
-        self._prefill_fns = {}
-        self._decode_fn = None
-        self._sample1 = None
+        self._prefill_fns = {}   # (tail, prefix, wave) -> compiled admit
+        self._decode_fns = {}    # chunk k -> compiled decode chunk
 
         # Multi-host seam (runtime/multihost.py): when set, every device
         # program this scheduler launches is routed through
@@ -166,6 +194,9 @@ class ContinuousBatcher:
         # the identical program, then calls ``run()`` in sequence order.
         # The *scheduling decisions* stay leader-local; only their compiled
         # consequences are replicated, so followers need no pool/queue.
+        # Chunked decode + wave admission make this one broadcast per K
+        # tokens / per admission wave, not per token (round-2's per-token
+        # mirror was the multi-host throughput ceiling).
         self.program_hook = None
 
     # ---- public API ---------------------------------------------------
@@ -229,70 +260,79 @@ class ContinuousBatcher:
             "tokens_out": self._tokens_out,
             "block_size": self.block_size,
             "blocks_free": self.pool.free_count(),
+            "chunk_sizes": sorted(self._decode_fns),
             "pool": self.pool.stats(),
         }
 
     # ---- compiled steps ----------------------------------------------
 
-    def _prefill_jit(self, t: int, pb: int):
-        fn = self._prefill_fns.get((t, pb))
+    def _admit_jit(self, t: int, pb: int, b: int):
+        """Wave-admission program: batched tail prefill + fused first-token
+        sampling — one dispatch per (tail-bucket, prefix-bucket) group."""
+        key = (t, pb, b)
+        fn = self._prefill_fns.get(key)
         if fn is None:
             cfg = self.cfg
-            fn = jax.jit(
-                lambda p, toks, tl, tb, pfb, pfl, paged:
-                transformer.paged_prefill_tail(p, cfg, toks, tl, tb, pfb,
-                                               pfl, paged),
-                donate_argnums=(6,))
-            self._prefill_fns[(t, pb)] = fn
+
+            def admit(p, toks, tl, tb, pfb, pfl, paged, seeds, steps, temps,
+                      tks, tps, ds):
+                last, paged = transformer.paged_prefill_tail(
+                    p, cfg, toks, tl, tb, pfb, pfl, paged)
+                first = sample_batch(last, seeds, steps, temps, tks, tps, ds)
+                return first, paged
+
+            fn = jax.jit(admit, donate_argnums=(6,))
+            self._prefill_fns[key] = fn
         return fn
 
-    def _decode_jit(self):
-        if self._decode_fn is None:
-            cfg = self.cfg
+    def _decode_jit(self, k: int):
+        """K-token decode chunk (transformer.paged_decode_chunk), one host
+        sync per K tokens for all slots."""
+        fn = self._decode_fns.get(k)
+        if fn is None:
+            cfg, dummy = self.cfg, self._dummy
 
-            def step(params, tokens, paged, bt, cl, seeds, steps, temps, tks,
-                     tps, ds):
-                logits, paged = transformer.paged_decode_step(
-                    params, cfg, tokens, paged, bt, cl)
-                nxt = sample_batch(logits, seeds, steps, temps, tks, tps, ds)
-                return nxt, paged
+            def chunk(p, tokens, paged, bt, cl, seeds, steps0, temps, tks,
+                      tps, ds, budget, eos_ids):
+                return transformer.paged_decode_chunk(
+                    p, cfg, k, tokens, paged, bt, cl, seeds, steps0, temps,
+                    tks, tps, ds, budget, eos_ids, dummy)
 
-            self._decode_fn = jax.jit(step, donate_argnums=(2,))
-        return self._decode_fn
+            fn = jax.jit(chunk, donate_argnums=(2,))
+            self._decode_fns[k] = fn
+        return fn
 
     # ---- program launch (shared by the scheduler and lockstep replay) --
 
-    def _run_admit(self, a: dict) -> int:
-        """Launch the admission programs (tail prefill + first-token
-        sample) from a JSON-safe arg dict. Pure device-program execution:
-        no scheduler state is read, so a follower replaying the leader's
-        args evolves its cache shard bit-identically."""
-        toks = np.asarray([a["toks"]], np.int32)
-        pfb = np.asarray([a["pfb"]], np.int32)
-        fn = self._prefill_jit(toks.shape[1], pfb.shape[1])
+    def _run_admit(self, a: dict) -> np.ndarray:
+        """Launch one admission wave's program from a JSON-safe arg dict.
+        Pure device-program execution: no scheduler state is read, so a
+        follower replaying the leader's args evolves its cache shard
+        bit-identically. Returns first tokens [B]."""
+        toks = np.asarray(a["toks"], np.int32)
+        tb = np.asarray(a["tail_alloc"], np.int32)
+        pfb = np.asarray(a["pfb"], np.int32)
+        fn = self._admit_jit(toks.shape[1], pfb.shape[1], toks.shape[0])
         with self.mesh:
-            last, self.paged = fn(
+            first, self.paged = fn(
                 self.params, jnp.asarray(toks),
-                jnp.asarray([a["tail_len"]], jnp.int32),
-                jnp.asarray(a["tail_alloc"], jnp.int32),
-                jnp.asarray(pfb), jnp.asarray([a["cached"]], jnp.int32),
-                self.paged)
-            if self._sample1 is None:
-                self._sample1 = jax.jit(sample_batch)
-            return int(self._sample1(
-                last,
-                jnp.asarray([a["seed"]], jnp.int32),
-                jnp.asarray([a["step"]], jnp.int32),
-                jnp.asarray([a["temperature"]], jnp.float32),
-                jnp.asarray([a["top_k"]], jnp.int32),
-                jnp.asarray([a["top_p"]], jnp.float32),
-                jnp.asarray([a["do_sample"]]))[0])
+                jnp.asarray(a["tail_len"], jnp.int32), jnp.asarray(tb),
+                jnp.asarray(pfb), jnp.asarray(a["cached"], jnp.int32),
+                self.paged,
+                jnp.asarray(a["seeds"], jnp.int32),
+                jnp.asarray(a["steps"], jnp.int32),
+                jnp.asarray(a["temps"], jnp.float32),
+                jnp.asarray(a["tks"], jnp.int32),
+                jnp.asarray(a["tps"], jnp.float32),
+                jnp.asarray(a["ds"], bool))
+            return np.asarray(first)   # ONE host sync per admission wave
 
-    def _run_decode(self, a: dict) -> np.ndarray:
-        """Launch one decode step's program from a JSON-safe arg dict."""
-        fn = self._decode_jit()
+    def _run_decode(self, a: dict):
+        """Launch one decode chunk's program from a JSON-safe arg dict.
+        Returns (toks [K, R], emits [K, R]) as host arrays."""
+        fn = self._decode_jit(int(a["k"]))
         with self.mesh:
-            nxt, self.paged = fn(
+            toks, emits, self.paged = fn(
                 self.params, jnp.asarray(a["tokens"], jnp.int32), self.paged,
                 jnp.asarray(a["bt"], jnp.int32),
                 jnp.asarray(a["cl"], jnp.int32),
@@ -301,8 +341,11 @@ class ContinuousBatcher:
                 jnp.asarray(a["temps"], jnp.float32),
                 jnp.asarray(a["tks"], jnp.int32),
                 jnp.asarray(a["tps"], jnp.float32),
-                jnp.asarray(a["ds"], bool))
-            return np.asarray(nxt)   # ONE host sync per step for all slots
+                jnp.asarray(a["ds"], bool),
+                jnp.asarray(a["budget"], jnp.int32),
+                jnp.asarray(a["eos"], jnp.int32))
+            # ONE host sync per K-token chunk for all slots
+            return jax.device_get((toks, emits))
 
     def replay(self, kind: str, args: dict):
         """Re-execute a program the lockstep leader broadcast. SPMD
@@ -331,8 +374,30 @@ class ContinuousBatcher:
                 return min(m, self.max_blocks) if m else 0
         raise ValueError(f"prefix of {nb} blocks exceeds buckets")
 
-    def _admit_one(self, req: BatchRequest, slot: int) -> bool:
-        """Prefill req into `slot`. False if blocks are unavailable.
+    @staticmethod
+    def _bucket_wave(n: int) -> int:
+        b = 1
+        while b < n:
+            b *= 2
+        return b
+
+    def _shared_wave_blocks(self, wave: List[dict], prompt: List[int]) -> int:
+        """Longest common full-block prefix (in blocks) between `prompt`
+        and any prompt already in the admission wave."""
+        bs = self.block_size
+        best = 0
+        for m in wave:
+            n = 0
+            for a, b in zip(m["prompt"], prompt):
+                if a != b:
+                    break
+                n += 1
+            best = max(best, n // bs)
+        return best
+
+    def _prep_admit(self, req: BatchRequest) -> Optional[dict]:
+        """Host-side admission prep: radix prefix match + block allocation.
+        None if blocks are unavailable (caller decides preempt/requeue).
 
         For a preempted request the already-generated tokens are part of
         the prefill (generation resumes where it left off — streamed
@@ -344,43 +409,156 @@ class ContinuousBatcher:
         # Leave >=1 token for the tail: prefill must produce the last
         # token's logits (a fully-cached prompt would have nothing to run).
         prefix_blocks, cached = self.pool.match_prefix(prompt[:n - 1])
-        tail_len = n - cached
-        t = self._bucket_tail(tail_len)
-        tail_alloc = self.pool.alloc(t // bs)
-        if tail_alloc is None:
+        tail_alloc = []
+        try:
+            tail_len = n - cached
+            t = self._bucket_tail(tail_len)      # may raise ValueError
+            tail_alloc = self.pool.alloc(t // bs)
+            if tail_alloc is None:
+                self.pool.release(prefix_blocks)
+                return None
+            pb = max(self._bucket_prefix(len(prefix_blocks)), 1)
+        except ValueError:
+            # refuse-the-request path: drop the references this prep took,
+            # or repeated oversized requests pin radix blocks forever
             self.pool.release(prefix_blocks)
-            return False
-        tail_real = tail_alloc[: -(-tail_len // bs)]
-        tail_extra = tail_alloc[len(tail_real):]
+            self.pool.release(tail_alloc or [])
+            raise
+        return {"t": t, "pb": pb, "n": n, "cached": cached,
+                "tail_len": tail_len, "prompt": prompt,
+                "prefix_blocks": prefix_blocks, "tail_alloc": tail_alloc}
 
-        pb = self._bucket_prefix(len(prefix_blocks))
-        pfb = np.full((1, max(pb, 1)), self._dummy, np.int32)
-        pfb[0, :len(prefix_blocks)] = prefix_blocks
-        toks = np.zeros((1, t), np.int32)
-        toks[0, :tail_len] = prompt[cached:]
+    def _admit_wave(self):
+        """Admit queued requests into free slots as bucketed waves: one
+        batched program per (tail, prefix) bucket group."""
+        wave: List[dict] = []
+        taken: set = set()
+        while True:
+            free = [i for i, a in enumerate(self.active)
+                    if a is None and i not in taken]
+            if not free:
+                break
+            with self._lock:
+                req = self.queue.popleft() if self.queue else None
+            if req is None:
+                break
+            if req._cancelled:
+                req.error = req.error or "cancelled"
+                req.done.set()
+                continue
+            try:
+                prep = self._prep_admit(req)
+            except ValueError as e:
+                req.error = str(e)
+                req.done.set()
+                continue
+            if (prep is not None and wave
+                    and (self._shared_wave_blocks(wave, prep["prompt"])
+                         * self.block_size > prep["cached"])):
+                # an earlier wave member is about to insert a longer shared
+                # prefix into the radix cache than this request would hit
+                # now — defer one chunk so the re-match reuses those blocks
+                # (saves both the blocks and the prefill compute)
+                self.pool.release(prep["prefix_blocks"])
+                self.pool.release(prep["tail_alloc"])
+                with self._lock:
+                    self.queue.appendleft(req)
+                break
+            if prep is None:
+                if wave:
+                    # part of the wave is already allocated — admit it now,
+                    # retry this request FIRST next step
+                    with self._lock:
+                        self.queue.appendleft(req)
+                    break
+                # Free memory by preempting the youngest slot, then retry
+                # this request FIRST next step (it goes in front of the
+                # preempted one, or ping-pong would starve it).
+                preempted = self._preempt_youngest()
+                if not preempted and not self._admit_order:
+                    # no active slots to free: this prompt can never fit
+                    req.error = "KV block pool exhausted"
+                    req.done.set()
+                else:
+                    with self._lock:
+                        self.queue.appendleft(req)
+                break
+            prep["req"] = req
+            prep["slot"] = free[0]
+            taken.add(free[0])
+            wave.append(prep)
 
-        sp = req.sampling
+        if not wave:
+            return
+        groups: dict = {}
+        for m in wave:
+            groups.setdefault((m["t"], m["pb"]), []).append(m)
+        for (t, pb), members in groups.items():
+            self._admit_group(t, pb, members)
+
+    def _admit_group(self, t: int, pb: int, members: List[dict]):
+        """One batched admission program for wave members sharing a
+        (tail-bucket, prefix-bucket); rows padded to a power-of-two wave
+        size (padding rows write only the reserved dummy block)."""
+        bs = self.block_size
+        b = self._bucket_wave(len(members))
+        toks = np.zeros((b, t), np.int32)
+        tail_len = np.ones((b,), np.int32)
+        tail_blocks = np.full((b, t // bs), self._dummy, np.int32)
+        pfb = np.full((b, pb), self._dummy, np.int32)
+        cached = np.zeros((b,), np.int32)
+        seeds = np.zeros((b,), np.int32)
+        steps = np.zeros((b,), np.int32)
+        temps = np.full((b,), 1.0, np.float32)
+        tks = np.zeros((b,), np.int32)
+        tps = np.ones((b,), np.float32)
+        ds = np.zeros((b,), bool)
+        for j, m in enumerate(members):
+            req = m["req"]
+            toks[j, :m["tail_len"]] = m["prompt"][m["cached"]:]
+            tail_len[j] = m["tail_len"]
+            tail_blocks[j, :] = m["tail_alloc"]
+            pfb[j, :len(m["prefix_blocks"])] = m["prefix_blocks"]
+            cached[j] = m["cached"]
+            sp = req.sampling
+            seeds[j] = req.seed
+            steps[j] = len(req.tokens)
+            temps[j] = sp.temperature
+            tks[j] = sp.top_k
+            tps[j] = sp.top_p
+            ds[j] = sp.do_sample
+
         admit_args = {
-            "toks": toks[0].tolist(), "tail_len": int(tail_len),
-            "tail_alloc": [int(b) for b in tail_alloc],
-            "pfb": pfb[0].tolist(), "cached": int(cached),
-            "seed": int(req.seed), "step": len(req.tokens),
-            "temperature": float(sp.temperature), "top_k": int(sp.top_k),
-            "top_p": float(sp.top_p), "do_sample": bool(sp.do_sample),
+            "toks": toks.tolist(), "tail_len": tail_len.tolist(),
+            "tail_alloc": tail_blocks.tolist(), "pfb": pfb.tolist(),
+            "cached": cached.tolist(), "seeds": seeds.tolist(),
+            "steps": steps.tolist(), "temps": temps.tolist(),
+            "tks": tks.tolist(), "tps": tps.tolist(), "ds": ds.tolist(),
         }
-        t0 = time.perf_counter()
         if self.program_hook is not None:
             first = self.program_hook("admit", admit_args,
                                       lambda: self._run_admit(admit_args))
         else:
             first = self._run_admit(admit_args)
-        self.pool.release(tail_extra)   # padding blocks beyond the real tail
+        for j, m in enumerate(members):
+            self._post_admit(m, int(first[j]))
+
+    def _post_admit(self, m: dict, first: int):
+        """Register one admitted wave member: release padding blocks, enter
+        the prompt's full blocks into the radix cache, bind the slot, and
+        emit the fused-sampled first token."""
+        req, slot = m["req"], m["slot"]
+        bs = self.block_size
+        n, cached, tail_len = m["n"], m["cached"], m["tail_len"]
+        tail_alloc, prefix_blocks = m["tail_alloc"], m["prefix_blocks"]
+        tail_real = tail_alloc[: -(-tail_len // bs)]
+        self.pool.release(tail_alloc[len(tail_real):])  # padding blocks
 
         # register the prompt's full blocks in the radix cache
         n_full = n // bs
         skip = cached // bs
         if n_full > skip:
-            self.pool.insert_prefix(prompt[:n_full * bs],
+            self.pool.insert_prefix(m["prompt"][:n_full * bs],
                                     tail_real[:n_full - skip], skip)
 
         req._blocks = prefix_blocks + tail_real
@@ -395,7 +573,6 @@ class ContinuousBatcher:
         self._emit(req, first)
         if req.done.is_set() or len(req.tokens) >= req.max_new_tokens:
             self._finish_slot(slot)
-        return True
 
     def _emit(self, req: BatchRequest, token: int):
         """Append a sampled token; mark done on eos (eos not kept)."""
@@ -444,77 +621,61 @@ class ContinuousBatcher:
                 req.done.set()
             else:
                 # generated tokens are kept; re-admission prefills
-                # prompt+tokens and resumes (see _admit_one)
+                # prompt+tokens and resumes (see _prep_admit)
                 with self._lock:
                     self.queue.appendleft(req)
         return True
 
-    def _ensure_growth(self, slot: int) -> bool:
-        """Make sure the slot owns the block its next token writes into."""
-        pos = int(self.context_lens[slot])
-        bi = pos // self.block_size
-        if bi >= self.max_blocks:
+    def _ensure_growth(self, slot: int, k: int = 1) -> bool:
+        """Make sure the slot owns every block a k-step chunk can write
+        (positions [cl, cl + min(k, remaining) - 1]) — allocated up front
+        so the whole chunk runs without host intervention."""
+        req = self.active[slot]
+        pos0 = int(self.context_lens[slot])
+        k_eff = max(1, min(k, req.max_new_tokens - len(req.tokens)))
+        bi0 = pos0 // self.block_size
+        bi1 = (pos0 + k_eff - 1) // self.block_size
+        if bi1 >= self.max_blocks:
             return False
-        if self.block_tables[slot, bi] != self._dummy:
+        need = [bi for bi in range(bi0, bi1 + 1)
+                if self.block_tables[slot, bi] == self._dummy]
+        if not need:
             return True
-        got = self.pool.alloc(1)
+        got = self.pool.alloc(len(need))
         if got is None:
             return False
-        self.block_tables[slot, bi] = got[0]
-        self.active[slot]._blocks.extend(got)
+        for bi, blk in zip(need, got):
+            self.block_tables[slot, bi] = blk
+        req._blocks.extend(got)
         return True
 
     # ---- the step -----------------------------------------------------
 
     def step(self) -> int:
-        """Admit + one decode step. Returns number of active slots."""
+        """Admit a wave + one K-token decode chunk. Returns active slots."""
         # drop cancelled slots first — frees their blocks for admission
         for slot in range(self.slots):
             req = self.active[slot]
             if req is not None and req._cancelled:
                 req.error = req.error or "cancelled"
                 self._finish_slot(slot)
-        # admission into free slots
-        while True:
-            free = [i for i, a in enumerate(self.active) if a is None]
-            if not free:
-                break
-            with self._lock:
-                req = self.queue.popleft() if self.queue else None
-            if req is None:
-                break
-            if req._cancelled:
-                req.error = req.error or "cancelled"
-                req.done.set()
-                continue
-            try:
-                admitted = self._admit_one(req, free[0])
-            except ValueError as e:
-                req.error = str(e)
-                req.done.set()
-                continue
-            if not admitted:
-                # Free memory by preempting the youngest slot, then retry
-                # this request FIRST next step (it goes in front of the
-                # preempted one, or ping-pong would starve it).
-                preempted = self._preempt_youngest()
-                if not preempted and not self._admit_order:
-                    # no active slots to free: this prompt can never fit
-                    req.error = "KV block pool exhausted"
-                    req.done.set()
-                else:
-                    with self._lock:
-                        self.queue.appendleft(req)
-                break
+
+        self._admit_wave()
 
         active = [i for i, a in enumerate(self.active) if a is not None]
         if not active:
             return 0
 
-        # growth blocks for sequences crossing a block boundary
+        # chunk size: the largest some active slot can fill (per-slot
+        # budgets mask the rest)
+        max_rem = max(self.active[i].max_new_tokens
+                      - len(self.active[i].tokens) for i in active)
+        k = next(c for c in self.DECODE_CHUNKS if c <= max_rem)
+
+        # growth blocks for every position this chunk can write
         for slot in range(self.slots):
             while (self.active[slot] is not None
-                   and not self._ensure_growth(slot)):
+                   and not self._ensure_growth(slot, k)):
                 # _preempt_youngest may free `slot` itself — the loop
                 # condition re-checks before retrying
                 if not self._preempt_youngest():
@@ -533,6 +694,8 @@ class ContinuousBatcher:
         tks = np.zeros((r,), np.int32)
         tps = np.ones((r,), np.float32)
         ds = np.zeros((r,), bool)
+        budget = np.zeros((r,), np.int32)
+        eos = np.full((r,), -1, np.int32)
         for i in active:
             req = self.active[i]
             tokens[i] = req.tokens[-1]
@@ -542,25 +705,36 @@ class ContinuousBatcher:
             tks[i] = req.sampling.top_k
             tps[i] = req.sampling.top_p
             ds[i] = req.sampling.do_sample
+            budget[i] = min(k, req.max_new_tokens - len(req.tokens))
+            if req.eos_token_id is not None:
+                eos[i] = req.eos_token_id
 
         decode_args = {
+            "k": int(k),
             "tokens": tokens.tolist(), "bt": self.block_tables.tolist(),
             "cl": self.context_lens.tolist(), "seeds": seeds.tolist(),
             "steps": steps.tolist(), "temps": temps.tolist(),
             "tks": tks.tolist(), "tps": tps.tolist(), "ds": ds.tolist(),
+            "budget": budget.tolist(), "eos": eos.tolist(),
         }
         if self.program_hook is not None:
-            nxt = self.program_hook("decode", decode_args,
-                                    lambda: self._run_decode(decode_args))
+            toks, emits = self.program_hook(
+                "decode", decode_args, lambda: self._run_decode(decode_args))
         else:
-            nxt = self._run_decode(decode_args)
+            toks, emits = self._run_decode(decode_args)
         self._step_count += 1
 
         for i in active:
             req = self.active[i]
-            self.context_lens[i] += 1
-            self._emit(req, int(nxt[i]))
-            if req.done.is_set() or len(req.tokens) >= req.max_new_tokens:
+            # emits[:, i] is True exactly for this slot's emitted prefix
+            # (monotone: once dead — eos or budget — never true again; the
+            # device masks eos out, so _emit's eos branch can't re-trigger)
+            cnt = int(emits[:, i].sum())
+            for tok in toks[:cnt, i]:
+                self._emit(req, int(tok))
+            self.context_lens[i] += cnt
+            hit_eos = cnt < int(budget[i])   # stopped before its budget
+            if hit_eos or len(req.tokens) >= req.max_new_tokens:
                 self._finish_slot(i)
         return len([a for a in self.active if a is not None])
 

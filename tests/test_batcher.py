@@ -209,6 +209,90 @@ def test_background_thread_serving():
     st = b.stats()
     assert st["active"] == 0 and st["tokens_out"] >= 48
 
+class OpCounter:
+    """program_hook stand-in that counts dispatched programs by kind."""
+
+    def __init__(self):
+        self.ops = []
+
+    def __call__(self, kind, args, run):
+        self.ops.append((kind, args))
+        return run()
+
+    def count(self, kind):
+        return sum(1 for k, _ in self.ops if k == kind)
+
+
+def test_chunked_decode_amortizes_dispatches():
+    """K-token on-device chunks: a 40-token generation costs a handful of
+    dispatched programs, not one per token (the round-2 batcher's 6.6x
+    regression vs the engine)."""
+    b = ContinuousBatcher(CFG, PARAMS, num_blocks=128, block_size=8,
+                          slots=4, max_seq=128)
+    counter = OpCounter()
+    b.program_hook = counter
+    prompts = [RNG.integers(0, CFG.vocab_size, 12).tolist() for _ in range(4)]
+    reqs = [b.submit(p, max_new_tokens=40, sampling=SamplingParams.greedy())
+            for p in prompts]
+    run_until_done(b, reqs)
+    for p, r in zip(prompts, reqs):
+        assert r.wait() == dense_greedy(p, 40)
+    # burst of 4 same-bucket prompts = ONE admission program; 39 post-first
+    # tokens = chunks 32+4+2+1 = 4 decode programs
+    assert counter.count("admit") == 1, counter.ops
+    assert counter.count("decode") <= 6, counter.ops
+    assert len(counter.ops) <= 7
+
+
+def test_wave_admission_one_dispatch_for_burst():
+    """A burst of same-bucket requests admits in one batched program with
+    first-token sampling fused in (no separate sample dispatch)."""
+    b = ContinuousBatcher(CFG, PARAMS, num_blocks=128, block_size=8,
+                          slots=8, max_seq=128)
+    counter = OpCounter()
+    b.program_hook = counter
+    prompts = [RNG.integers(0, CFG.vocab_size, 9).tolist() for _ in range(6)]
+    reqs = [b.submit(p, max_new_tokens=1, sampling=SamplingParams.greedy())
+            for p in prompts]
+    run_until_done(b, reqs)
+    assert counter.count("admit") == 1
+    assert counter.count("decode") == 0
+    for p, r in zip(prompts, reqs):
+        assert r.wait() == dense_greedy(p, 1)
+
+
+def test_eos_mid_chunk_stops_on_device():
+    """Per-slot eos masks inside the chunk: tokens after the eos step are
+    never emitted even though the program ran past it."""
+    b = ContinuousBatcher(CFG, PARAMS, num_blocks=64, block_size=8,
+                          slots=2, max_seq=128)
+    prompt = RNG.integers(0, CFG.vocab_size, 11).tolist()
+    full = dense_greedy(prompt, 30)
+    eos = full[10]   # eos lands mid-chunk (after the 32-chunk starts)
+    first = full.index(eos)
+    r = b.submit(prompt, max_new_tokens=30, sampling=SamplingParams.greedy(),
+                 eos_token_id=eos)
+    run_until_done(b, [r])
+    assert r.wait() == full[:first]
+    assert b.stats()["active"] == 0 and b.pool.free_count() > 0
+
+
+def test_mixed_budgets_mid_chunk():
+    """Slots with different max_new_tokens share chunks; budget masks stop
+    each at its own limit."""
+    b = ContinuousBatcher(CFG, PARAMS, num_blocks=128, block_size=8,
+                          slots=4, max_seq=128)
+    prompts = [RNG.integers(0, CFG.vocab_size, 7 + i).tolist()
+               for i in range(4)]
+    wants = [3, 17, 33, 50]
+    reqs = [b.submit(p, max_new_tokens=w, sampling=SamplingParams.greedy())
+            for p, w in zip(prompts, wants)]
+    run_until_done(b, reqs)
+    for p, w, r in zip(prompts, wants, reqs):
+        assert len(r.wait()) == w
+        assert r.wait() == dense_greedy(p, w)
+
+
 # ---- mesh-sharded batching (tensor/expert parallel) ---------------------
 # The batcher's single program partitions over a tp/ep mesh via GSPMD
 # (runtime/batcher.py mesh_spec) — the round-2 lift of the old
