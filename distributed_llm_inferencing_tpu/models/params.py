@@ -22,6 +22,18 @@ def init_params(cfg: ModelConfig, key, dtype=None):
     def w(shape, scale=0.02):
         return (jax.random.normal(next(keys), shape, jnp.float32) * scale).astype(dtype)
 
+    def w_q(shape, scale=0.02):
+        # cfg.quant="int8": emit the linear weight ALREADY quantized —
+        # random int8 levels with the per-output-channel scale a real
+        # quantized checkpoint would carry (ops/quant.py schema). Peak
+        # memory is the int8 model itself; init-bf16-then-quantize would
+        # transiently need 2x, which for the 8B flagship exceeds one
+        # chip's HBM. Values are random either way — identical layout,
+        # dtypes and compute to a converted int8 checkpoint.
+        q = jax.random.randint(next(keys), shape, -127, 128, jnp.int8)
+        return {"q": q, "scale": jnp.full(shape[:-2] + shape[-1:],
+                                          scale / 127.0, jnp.float32)}
+
     def zeros(shape):
         return jnp.zeros(shape, dtype)
 
@@ -34,11 +46,16 @@ def init_params(cfg: ModelConfig, key, dtype=None):
             p["bias"] = zeros((L, D))
         return p
 
+    quant8 = cfg.quant == "int8"
+
     def lin(din, dout, bias):
-        p = {"w": w((L, din, dout))}
+        p = w_q((L, din, dout)) if quant8 else {"w": w((L, din, dout))}
         if bias:
             p["b"] = zeros((L, dout))
         return p
+
+    def ew(shape):
+        return w_q(shape) if quant8 else {"w": w(shape)}
 
     layers = {
         "attn_norm": norm_p(),
@@ -50,16 +67,16 @@ def init_params(cfg: ModelConfig, key, dtype=None):
     }
     if cfg.is_moe:
         E = cfg.num_experts
-        layers["router"] = {"w": w((L, D, E))}
+        layers["router"] = {"w": w((L, D, E))}   # kept float (ops/quant.py)
         layers["experts"] = {
-            "gate": {"w": w((L, E, D, I))},
-            "up": {"w": w((L, E, D, I))},
-            "down": {"w": w((L, E, I, D))},
+            "gate": ew((L, E, D, I)),
+            "up": ew((L, E, D, I)),
+            "down": ew((L, E, I, D)),
         }
     else:
         layers["up"] = lin(D, I, cfg.mlp_bias)
         if cfg.gated_mlp:
-            layers["gate"] = {"w": w((L, D, I))}
+            layers["gate"] = ew((L, D, I))
         layers["down"] = lin(I, D, cfg.mlp_bias)
 
     E = cfg.embed_proj_dim or D
@@ -77,8 +94,10 @@ def init_params(cfg: ModelConfig, key, dtype=None):
     if cfg.position_embedding == "learned":
         params["embed"]["positions"] = w((cfg.max_position_embeddings, D))
     if not cfg.tie_word_embeddings:
-        params["lm_head"] = {"w": w((D, cfg.vocab_size))}
+        params["lm_head"] = ew((D, cfg.vocab_size))
     if cfg.quant:
+        # no-op for the leaves w_q already emitted; covers any remaining
+        # float linear (and validates the quant mode)
         from distributed_llm_inferencing_tpu.ops.quant import maybe_quantize
         params = maybe_quantize(params, cfg)
     return params

@@ -80,17 +80,23 @@ def attend(
 # Backend dispatch (trace-time static)
 # ----------------------------------------------------------------------
 
-def resolve_backend(requested: str = "auto", n_devices: int = 1) -> str:
+def resolve_backend(requested: str = "auto", n_devices: int = 1,
+                    op: str = "dense") -> str:
     """'auto' | 'xla' | 'pallas' | 'pallas_interpret' -> concrete backend.
 
     ``DLI_ATTENTION`` overrides (test/debug escape hatch). Pallas kernels
     are single-program kernels, so auto only picks them when the enclosing
     jit program spans one device.
+
+    ``op="paged"`` (the continuous batcher's block-table decode): auto
+    resolves to xla — measured on v5e at serving shapes the XLA gather
+    formulation beats the pallas paged kernel ~2x per step (see
+    ops/paged_kvcache.paged_attend_decode). Explicit "pallas" is honored.
     """
     requested = os.environ.get("DLI_ATTENTION", requested)
     if requested in ("xla", "pallas", "pallas_interpret"):
         return requested
-    if jax.default_backend() == "tpu" and n_devices == 1:
+    if op != "paged" and jax.default_backend() == "tpu" and n_devices == 1:
         return "pallas"
     return "xla"
 

@@ -107,7 +107,12 @@ def paged_attend_decode(q, cache_k_layer, cache_v_layer, block_tables,
 
     backend "pallas" routes to the block-table-driven kernel
     (ops/pallas/paged_attention.py) which skips the gather
-    materialization below.
+    materialization below. "auto" resolves to the XLA gather formulation:
+    measured on v5e at serving shapes (R=8, short contexts) the gather
+    path is ~2x faster per step than the current pallas kernel — the
+    gather is a dense contiguous read XLA streams at full HBM bandwidth,
+    while the kernel's per-slot block walk is grid-serialized. Revisit
+    when contexts are long enough that gathering MB*bs dominates.
     """
     if backend.startswith("pallas"):
         from distributed_llm_inferencing_tpu.ops.pallas.paged_attention import (

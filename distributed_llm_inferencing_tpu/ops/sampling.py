@@ -91,6 +91,13 @@ def sample(logits, key, params: SamplingParams,
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
+# Static prefix width for sample_batch's fast path. Rows whose top_k fits
+# inside it sample exactly from one lax.top_k — no full-vocab sort, which
+# on TPU (bitonic network over [R, 50k+]) costs more than a whole decode
+# step of a 125M model.
+PREFIX_K = 128
+
+
 def sample_batch(logits, seeds, steps, temps, top_ks, top_ps, do_sample):
     """Per-row-parameterized sampling for the continuous batcher.
 
@@ -103,35 +110,59 @@ def sample_batch(logits, seeds, steps, temps, top_ks, top_ps, do_sample):
     are data, not trace constants — one compiled program covers any mix of
     requests.
 
-    Exactness over the single-config fast path in ``sample``: one full-vocab
-    descending sort per step gives every row its exact k-th-largest and
-    nucleus thresholds. R is the (small, static) slot count, so the sort is
-    [R, V] — a few hundred microseconds, dwarfed by the model step.
+    Two tiers, chosen per step by ``lax.cond``:
+    - **prefix** (hot): rows with 0 < k <= PREFIX_K (every realistic
+      serving config; the reference hardcoded k=50, worker/app.py:301)
+      sample from ``lax.top_k(PREFIX_K)``. Exact: the k-masked
+      distribution's support lies inside the prefix, so softmax/top-p
+      thresholds over the prefix equal the full-vocab computation.
+    - **full** (cold): any sampling row with k == 0 (disabled) or
+      k > PREFIX_K pays the full-vocab descending sort.
+    A row's draw mechanism depends only on its OWN k — covered rows take
+    the prefix draw in both branches — so chunk-mates with exotic configs
+    never change another request's tokens.
     """
     logits = logits.astype(jnp.float32)
     r, v = logits.shape
+    ks = min(PREFIX_K, v)
     scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
-
-    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]            # [R, V]
-    # top-k threshold: k-th largest value (k clamped into [1, V]; k<=0 -> V)
     k = jnp.where(top_ks <= 0, v, jnp.clip(top_ks, 1, v))
-    kth = jnp.take_along_axis(sorted_desc, (k - 1)[:, None], axis=-1)
-    masked = jnp.where(scaled < kth, -jnp.inf, scaled)
-    # top-p on the post-top-k distribution (HF warper order), thresholds
-    # computed on the sorted view with the same top-k mask applied
-    sorted_masked = jnp.where(
-        jnp.arange(v)[None, :] < k[:, None], sorted_desc, -jnp.inf)
-    probs = jax.nn.softmax(sorted_masked, axis=-1)
-    cum = jnp.cumsum(probs, axis=-1)
-    keep = (cum - probs) < top_ps[:, None]                      # crossing token kept
-    num_keep = jnp.maximum(jnp.sum(keep, axis=-1, keepdims=True), 1)
-    thresh = jnp.take_along_axis(sorted_masked, num_keep - 1, axis=-1)
-    masked = jnp.where(masked < thresh, -jnp.inf, masked)
+    covered = k <= ks
 
     keys = jax.vmap(
         lambda s, t: jax.random.fold_in(jax.random.PRNGKey(s), t)
     )(seeds, steps)
-    sampled = jax.vmap(
-        lambda k, l: jax.random.categorical(k, l))(keys, masked)
+    vals, idx = jax.lax.top_k(scaled, ks)               # [R, KS] descending
+
+    def _nucleus_mask(sorted_vals, width):
+        """Mask sorted-descending logits to top-k ∩ top-p (HF warper
+        order: the token crossing the p threshold is kept)."""
+        m = jnp.where(jnp.arange(sorted_vals.shape[-1])[None, :] < width,
+                      sorted_vals, -jnp.inf)
+        probs = jax.nn.softmax(m, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep = (cum - probs) < top_ps[:, None]
+        num_keep = jnp.maximum(jnp.sum(keep, axis=-1, keepdims=True), 1)
+        thresh = jnp.take_along_axis(m, num_keep - 1, axis=-1)
+        return jnp.where(m < thresh, -jnp.inf, m), thresh
+
+    def prefix_draw():
+        m, _ = _nucleus_mask(vals, jnp.minimum(k, ks)[:, None])
+        j = jax.vmap(lambda kk, l: jax.random.categorical(kk, l))(keys, m)
+        return jnp.take_along_axis(idx, j[:, None], axis=-1)[:, 0]
+
+    def full_draw():
+        sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+        kth = jnp.take_along_axis(sorted_desc, (k - 1)[:, None], axis=-1)
+        _, thresh = _nucleus_mask(sorted_desc, k[:, None])
+        masked = jnp.where((scaled < kth) | (scaled < thresh), -jnp.inf,
+                           scaled)
+        return jax.vmap(
+            lambda kk, l: jax.random.categorical(kk, l))(keys, masked)
+
+    sampled = jax.lax.cond(
+        jnp.all(covered | ~do_sample),
+        prefix_draw,
+        lambda: jnp.where(covered, prefix_draw(), full_draw()))
     greedy = jnp.argmax(logits, axis=-1)
     return jnp.where(do_sample, sampled, greedy).astype(jnp.int32)

@@ -131,7 +131,12 @@ class ContinuousBatcher:
     # engine's DECODE_CHUNKS trade (runtime/engine.py): bigger chunks
     # amortize dispatch RTT, at the cost of chunk-granularity admission/
     # cancellation latency.
-    DECODE_CHUNKS = (32, 16, 8, 4, 2, 1)
+    DECODE_CHUNKS = (64, 32, 16, 8, 4, 2, 1)
+    # A dispatch round trip costs ~10-15 decode steps of compute on a
+    # tunnel-attached chip, so rounding the chunk UP past the largest
+    # remaining budget (budget masks make overshoot steps dead compute)
+    # is a win as long as the overshoot stays small.
+    CHUNK_OVERSHOOT_MAX = 8
 
     def __init__(self, cfg: ModelConfig, params=None, *,
                  num_blocks: int = 512, block_size: int = 16,
@@ -266,6 +271,11 @@ class ContinuousBatcher:
 
     # ---- compiled steps ----------------------------------------------
 
+    # Args cross host->device as TWO packed arrays (int32 + f32) per
+    # dispatch, unpacked on device: on a tunnel-attached chip every
+    # eager transfer pays a network round trip, and 13 tiny arrays per
+    # chunk cost more than the chunk itself.
+
     def _admit_jit(self, t: int, pb: int, b: int):
         """Wave-admission program: batched tail prefill + fused first-token
         sampling — one dispatch per (tail-bucket, prefix-bucket) group."""
@@ -273,33 +283,43 @@ class ContinuousBatcher:
         fn = self._prefill_fns.get(key)
         if fn is None:
             cfg = self.cfg
+            nb = t // self.block_size
 
-            def admit(p, toks, tl, tb, pfb, pfl, paged, seeds, steps, temps,
-                      tks, tps, ds):
+            def admit(p, ints, floats, paged):
+                toks = ints[:b * t].reshape(b, t)
+                tb = ints[b * t:b * (t + nb)].reshape(b, nb)
+                pfb = ints[b * (t + nb):b * (t + nb + pb)].reshape(b, pb)
+                tl, pfl, seeds, steps, tks, ds = (
+                    ints[b * (t + nb + pb):].reshape(6, b))
+                temps, tps = floats
                 last, paged = transformer.paged_prefill_tail(
                     p, cfg, toks, tl, tb, pfb, pfl, paged)
-                first = sample_batch(last, seeds, steps, temps, tks, tps, ds)
+                first = sample_batch(last, seeds, steps, temps, tks, tps,
+                                     ds.astype(bool))
                 return first, paged
 
-            fn = jax.jit(admit, donate_argnums=(6,))
+            fn = jax.jit(admit, donate_argnums=(3,))
             self._prefill_fns[key] = fn
         return fn
 
-    def _decode_jit(self, k: int):
+    def _decode_jit(self, k: int, r: int, mb: int):
         """K-token decode chunk (transformer.paged_decode_chunk), one host
         sync per K tokens for all slots."""
-        fn = self._decode_fns.get(k)
+        fn = self._decode_fns.get((k, r, mb))
         if fn is None:
             cfg, dummy = self.cfg, self._dummy
 
-            def chunk(p, tokens, paged, bt, cl, seeds, steps0, temps, tks,
-                      tps, ds, budget, eos_ids):
+            def chunk(p, ints, floats, paged):
+                bt = ints[:r * mb].reshape(r, mb)
+                (tokens, cl, seeds, steps0, tks, budget, eos_ids,
+                 ds) = ints[r * mb:].reshape(8, r)
+                temps, tps = floats
                 return transformer.paged_decode_chunk(
                     p, cfg, k, tokens, paged, bt, cl, seeds, steps0, temps,
-                    tks, tps, ds, budget, eos_ids, dummy)
+                    tks, tps, ds.astype(bool), budget, eos_ids, dummy)
 
-            fn = jax.jit(chunk, donate_argnums=(2,))
-            self._decode_fns[k] = fn
+            fn = jax.jit(chunk, donate_argnums=(3,))
+            self._decode_fns[(k, r, mb)] = fn
         return fn
 
     # ---- program launch (shared by the scheduler and lockstep replay) --
@@ -312,38 +332,37 @@ class ContinuousBatcher:
         toks = np.asarray(a["toks"], np.int32)
         tb = np.asarray(a["tail_alloc"], np.int32)
         pfb = np.asarray(a["pfb"], np.int32)
-        fn = self._admit_jit(toks.shape[1], pfb.shape[1], toks.shape[0])
+        b = toks.shape[0]
+        ints = np.concatenate([
+            toks.reshape(-1), tb.reshape(-1), pfb.reshape(-1),
+            np.asarray(a["tail_len"], np.int32),
+            np.asarray(a["cached"], np.int32),
+            np.asarray(a["seeds"], np.int32),
+            np.asarray(a["steps"], np.int32),
+            np.asarray(a["tks"], np.int32),
+            np.asarray(a["ds"], np.int32)])
+        floats = np.stack([np.asarray(a["temps"], np.float32),
+                           np.asarray(a["tps"], np.float32)])
+        fn = self._admit_jit(toks.shape[1], pfb.shape[1], b)
         with self.mesh:
-            first, self.paged = fn(
-                self.params, jnp.asarray(toks),
-                jnp.asarray(a["tail_len"], jnp.int32), jnp.asarray(tb),
-                jnp.asarray(pfb), jnp.asarray(a["cached"], jnp.int32),
-                self.paged,
-                jnp.asarray(a["seeds"], jnp.int32),
-                jnp.asarray(a["steps"], jnp.int32),
-                jnp.asarray(a["temps"], jnp.float32),
-                jnp.asarray(a["tks"], jnp.int32),
-                jnp.asarray(a["tps"], jnp.float32),
-                jnp.asarray(a["ds"], bool))
+            first, self.paged = fn(self.params, jnp.asarray(ints),
+                                   jnp.asarray(floats), self.paged)
             return np.asarray(first)   # ONE host sync per admission wave
 
     def _run_decode(self, a: dict):
         """Launch one decode chunk's program from a JSON-safe arg dict.
         Returns (toks [K, R], emits [K, R]) as host arrays."""
-        fn = self._decode_jit(int(a["k"]))
+        bt = np.asarray(a["bt"], np.int32)
+        r, mb = bt.shape
+        ints = np.concatenate([bt.reshape(-1)] + [
+            np.asarray(a[key], np.int32) for key in
+            ("tokens", "cl", "seeds", "steps", "tks", "budget", "eos", "ds")])
+        floats = np.stack([np.asarray(a["temps"], np.float32),
+                           np.asarray(a["tps"], np.float32)])
+        fn = self._decode_jit(int(a["k"]), r, mb)
         with self.mesh:
-            toks, emits, self.paged = fn(
-                self.params, jnp.asarray(a["tokens"], jnp.int32), self.paged,
-                jnp.asarray(a["bt"], jnp.int32),
-                jnp.asarray(a["cl"], jnp.int32),
-                jnp.asarray(a["seeds"], jnp.int32),
-                jnp.asarray(a["steps"], jnp.int32),
-                jnp.asarray(a["temps"], jnp.float32),
-                jnp.asarray(a["tks"], jnp.int32),
-                jnp.asarray(a["tps"], jnp.float32),
-                jnp.asarray(a["ds"], bool),
-                jnp.asarray(a["budget"], jnp.int32),
-                jnp.asarray(a["eos"], jnp.int32))
+            toks, emits, self.paged = fn(self.params, jnp.asarray(ints),
+                                         jnp.asarray(floats), self.paged)
             # ONE host sync per K-token chunk for all slots
             return jax.device_get((toks, emits))
 
@@ -666,11 +685,17 @@ class ContinuousBatcher:
         if not active:
             return 0
 
-        # chunk size: the largest some active slot can fill (per-slot
-        # budgets mask the rest)
+        # chunk size: cover the largest remaining budget in one dispatch
+        # when the overshoot is small (dead compute beats a round trip);
+        # otherwise the largest chunk some active slot can fill
         max_rem = max(self.active[i].max_new_tokens
                       - len(self.active[i].tokens) for i in active)
-        k = next(c for c in self.DECODE_CHUNKS if c <= max_rem)
+        up = min((c for c in self.DECODE_CHUNKS if c >= max_rem),
+                 default=None)
+        if up is not None and up - max_rem <= self.CHUNK_OVERSHOOT_MAX:
+            k = up
+        else:
+            k = next(c for c in self.DECODE_CHUNKS if c <= max_rem)
 
         # growth blocks for every position this chunk can write
         for slot in range(self.slots):
@@ -767,4 +792,4 @@ class ContinuousBatcher:
 
 def _backend(cfg: ModelConfig, num_devices: int = 1) -> str:
     from distributed_llm_inferencing_tpu.ops.attention import resolve_backend
-    return resolve_backend(cfg.attn_backend, num_devices)
+    return resolve_backend(cfg.attn_backend, num_devices, op="paged")

@@ -108,3 +108,32 @@ def test_quantized_checkpoint_roundtrip(tmp_path):
     assert params2["layers"]["up"]["q"].dtype == jnp.int8
     np.testing.assert_array_equal(np.asarray(params["layers"]["up"]["q"]),
                                   np.asarray(params2["layers"]["up"]["q"]))
+
+
+def test_random_init_emits_int8_directly():
+    """cfg.quant='int8' random init produces quantized leaves WITHOUT ever
+    materializing the float tree (the 8B flagship would not fit one chip's
+    HBM through an init-bf16-then-quantize path)."""
+    cfg = get_config("tiny-llama").replace(dtype="float32", quant="int8")
+    p = init_params(cfg, jax.random.PRNGKey(0))
+    for leaf in ("q", "k", "v", "o", "up", "gate", "down"):
+        assert "w" not in p["layers"][leaf]
+        assert p["layers"][leaf]["q"].dtype == jnp.int8
+        assert p["layers"][leaf]["scale"].dtype == jnp.float32
+    # norms/embeddings stay float (ops/quant.py policy)
+    assert p["embed"]["tokens"].dtype == jnp.float32
+    # the engine runs it end to end
+    from distributed_llm_inferencing_tpu.runtime.engine import InferenceEngine
+    from distributed_llm_inferencing_tpu.ops.sampling import SamplingParams
+    eng = InferenceEngine(cfg, p, max_seq=64)
+    out = eng.generate([[3, 5, 7, 11]], max_new_tokens=6,
+                       sampling=SamplingParams.greedy())
+    assert len(out.tokens[0]) == 6
+
+
+def test_random_init_int8_moe_experts():
+    cfg = get_config("tiny-mixtral").replace(dtype="float32", quant="int8")
+    p = init_params(cfg, jax.random.PRNGKey(1))
+    for k in ("gate", "up", "down"):
+        assert p["layers"]["experts"][k]["q"].dtype == jnp.int8
+    assert "w" in p["layers"]["router"]   # router kept float: routing-critical
