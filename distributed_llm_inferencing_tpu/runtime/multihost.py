@@ -129,8 +129,26 @@ def _replace_route(service: httpd.JsonHTTPService, method: str,
 MIRRORED_OPS = ("load_model", "load_shard", "unload_model", "inference")
 
 
+RECOVERY_POLL_S = 2.0   # degraded-leader probe cadence for follower return
+
+
 class LockstepLeader:
-    """Wraps a WorkerAgent's service as the slice leader."""
+    """Wraps a WorkerAgent's service as the slice leader.
+
+    Elastic recovery: when a mirror forward fails the slice degrades
+    (mirrored ops 503 fast), but a background probe keeps polling the
+    followers; once every follower answers /health again the leader runs
+    the epoch-bumped recovery protocol — reset each follower's lockstep
+    state (/lockstep/reset), restart sequence numbering, and replay the
+    model-establishing ops (load_model/load_shard bodies it remembered)
+    through the normal mirrored path so every host reconstructs identical
+    state. Serving then resumes without manual surgery. ``POST
+    /lockstep/recover`` triggers the same protocol on demand.
+
+    On a real TPU slice the restarted host must additionally rejoin
+    ``jax.distributed`` before serving (data-plane collectives span hosts);
+    the control protocol above is identical either way.
+    """
 
     def __init__(self, agent, followers: List[str],
                  auth_key: Optional[str] = None):
@@ -141,11 +159,18 @@ class LockstepLeader:
         self.exec = LockstepExecutor()
         self._mirror_lock = threading.Lock()
         self._seq = 0
+        self._epoch = 0
         self._degraded: Optional[str] = None
+        self._loaded: Dict[str, dict] = {}   # model -> last load body
+        self._recovery_thread: Optional[threading.Thread] = None
+        self._handlers: Dict[str, Callable] = {}
         s = agent.service
         for op in MIRRORED_OPS:
-            _replace_route(s, "POST", f"/{op}", self._make_handler(op))
+            self._handlers[op] = self._make_handler(op)
+            _replace_route(s, "POST", f"/{op}", self._handlers[op])
         _replace_route(s, "POST", "/inference_stream", self.inference_stream)
+        _replace_route(s, "POST", "/lockstep/recover", self.recover_endpoint)
+        _replace_route(s, "GET", "/lockstep/status", self.status)
 
     def _headers(self):
         return ({"Authorization": f"Bearer {self._auth}"}
@@ -185,9 +210,11 @@ class LockstepLeader:
             if errs:
                 self._degraded = (
                     f"lockstep forward of {op} failed ({errs[0]}); slice "
-                    "degraded — restart the slice workers to recover")
+                    "degraded — auto-recovery engaged (or POST "
+                    "/lockstep/recover once the followers are back)")
                 log.error(self._degraded)
                 self.exec.submit(seq, lambda: None)   # fill the gap locally
+                self._start_recovery()
                 raise RuntimeError(self._degraded)
             return seq
 
@@ -218,6 +245,14 @@ class LockstepLeader:
             result = self.exec.run(seq, lambda: local(body))
             if op in ("load_model", "load_shard"):
                 self._attach_batcher_hooks()
+            # remember state-establishing ops so recovery can replay them
+            status = result[0] if isinstance(result, tuple) else 200
+            name = body.get("model_name")
+            if status == 200 and name:
+                if op in ("load_model", "load_shard"):
+                    self._loaded[name] = {"op": op, "body": dict(body)}
+                elif op == "unload_model":
+                    self._loaded.pop(name, None)
             return result
 
         handler.__name__ = f"lockstep_{op}"
@@ -226,6 +261,116 @@ class LockstepLeader:
     def _is_batched(self, body) -> bool:
         m = self.agent.models.get(body.get("model_name"))
         return m is not None and getattr(m, "batcher", None) is not None
+
+    # ---- elastic recovery --------------------------------------------
+
+    def status(self, body):
+        with self._mirror_lock:
+            return {"status": "ok", "role": "leader", "epoch": self._epoch,
+                    "next_seq": self._seq, "degraded": self._degraded,
+                    "loaded": sorted(self._loaded)}
+
+    def _followers_healthy(self) -> bool:
+        for f in self.followers:
+            try:
+                r = http.get(f"{f}/health", headers=self._headers(),
+                             timeout=5)
+                if r.status_code != 200:
+                    return False
+            except Exception:
+                return False
+        return True
+
+    def _start_recovery(self):
+        if (self._recovery_thread is None
+                or not self._recovery_thread.is_alive()):
+            self._recovery_thread = threading.Thread(
+                target=self._recovery_loop, daemon=True,
+                name="lockstep-recovery")
+            self._recovery_thread.start()
+
+    def _recovery_loop(self):
+        while True:
+            time.sleep(RECOVERY_POLL_S)
+            with self._mirror_lock:
+                if not self._degraded:
+                    return
+            if not self._followers_healthy():
+                continue
+            try:
+                self.recover({})
+                return
+            except Exception as e:
+                log.warning("lockstep recovery attempt failed: %s", e)
+
+    def recover_endpoint(self, body):
+        try:
+            return self.recover(body or {})
+        except Exception as e:
+            return 503, {"status": "error", "message": f"recovery failed: {e}"}
+
+    def recover(self, body):
+        """Epoch-bumped slice recovery: reset every follower's lockstep
+        state, restart sequence numbering, replay model loads.
+
+        ``{"force": true}`` runs the protocol even when the leader does
+        not consider the slice degraded (operator escape hatch for states
+        the leader cannot see). Epochs are adopted from the followers
+        first, so a restarted leader (epoch back at 0) can still reset
+        followers that lived through earlier epochs.
+        """
+        with self._mirror_lock:
+            if not self._degraded and not body.get("force"):
+                return {"status": "success",
+                        "message": "slice not degraded; nothing to recover "
+                                   "(pass {\"force\": true} to override)"}
+            for f in self.followers:   # adopt the highest epoch out there
+                try:
+                    st = http.get(f"{f}/lockstep/status",
+                                  headers=self._headers(), timeout=5).json()
+                    self._epoch = max(self._epoch, int(st.get("epoch", 0)))
+                except Exception:
+                    pass   # unreachable follower fails the reset below
+            self._epoch += 1
+            epoch = self._epoch
+            for f in self.followers:
+                r = http.post(f"{f}/lockstep/reset", json={"epoch": epoch},
+                              headers=self._headers(),
+                              timeout=FORWARD_TIMEOUT)
+                r.raise_for_status()
+            self._seq = 0
+            # fresh executor: its _next restarts at 0 alongside the seq
+            # counter (the old one would treat replayed seq 0 as stale)
+            self.exec.stop()
+            self.exec = LockstepExecutor()
+            self._degraded = None
+            reloads = list(self._loaded.items())
+            self._loaded = {}
+        # Rebuild every model on every host through the normal mirrored
+        # path: the leader drops its own copy first so leader and follower
+        # reconstruct identical fresh state (engines are deterministic from
+        # (checkpoint|seed); a batcher's radix/paged caches start empty on
+        # all hosts, so no follower can be asked to read blocks it never
+        # filled).
+        errors = []
+        for name, entry in reloads:
+            try:
+                self.agent.unload_model({"model_name": name})
+                result = self._handlers[entry["op"]](entry["body"])
+                status = result[0] if isinstance(result, tuple) else 200
+                if status != 200:
+                    errors.append(f"{name}: {result}")
+            except Exception as e:
+                errors.append(f"{name}: {e}")
+        if errors:
+            with self._mirror_lock:
+                self._degraded = f"recovery replay failed: {errors[0]}"
+            self._start_recovery()
+            raise RuntimeError(self._degraded)
+        log.info("lockstep slice recovered (epoch %d, %d model(s) replayed)",
+                 epoch, len(reloads))
+        return {"status": "success", "epoch": epoch,
+                "models_replayed": [n for n, _ in reloads]}
 
     def _attach_batcher_hooks(self):
         """Route every batched model's device programs through the mirror.
@@ -286,6 +431,10 @@ class LockstepFollower:
         self.exec = LockstepExecutor()
         self._seen_lock = threading.Lock()
         self._seen: set = set()
+        self._epoch = 0
+        self._last_recv = -1   # forwards are serialized: seqs must arrive
+        # consecutively, so any gap proves this follower missed ops (e.g.
+        # it restarted between mirrors) and must refuse until reset
         if agent.service.auth_key is None:
             log.warning(
                 "lockstep follower has NO auth key: /lockstep is slice "
@@ -306,8 +455,39 @@ class LockstepFollower:
         }
         s = agent.service
         s.add("POST", "/lockstep", self.lockstep)
+        s.add("POST", "/lockstep/reset", self.reset)
+        s.add("GET", "/lockstep/status", self.status)
         for op in MIRRORED_OPS + ("inference_stream",):
             _replace_route(s, "POST", f"/{op}", self._rejected(op))
+
+    def status(self, body):
+        return {"status": "ok", "role": "follower", "epoch": self._epoch,
+                "next_seq": self.exec._next, "last_recv": self._last_recv,
+                "loaded": sorted(self.agent.models)}
+
+    def reset(self, body):
+        """Leader-ordered epoch reset: wipe lockstep ordering state and all
+        models so the recovery replay rebuilds this host identically to the
+        leader (runs before the leader re-opens mirroring, so no forwarded
+        op can race the wipe)."""
+        epoch = body.get("epoch")
+        if not isinstance(epoch, int) or epoch <= self._epoch:
+            return 409, {"status": "error",
+                         "message": f"stale epoch {epoch!r} "
+                                    f"(current {self._epoch})"}
+        self._epoch = epoch
+        self.exec.stop()
+        self.exec = LockstepExecutor()
+        with self._seen_lock:
+            self._seen = set()
+            self._last_recv = -1
+        for name in list(self.agent.models):
+            try:
+                self.agent.unload_model({"model_name": name})
+            except Exception as e:
+                log.warning("reset: unload of %s failed: %s", name, e)
+        log.info("lockstep follower reset to epoch %d", epoch)
+        return {"status": "success", "epoch": epoch}
 
     def _batcher_program(self, body):
         m = self.agent.models.get(body.get("model_name"))
@@ -336,6 +516,16 @@ class LockstepFollower:
             if seq in self._seen or seq < self.exec._next:
                 return 409, {"status": "error",
                              "message": f"sequence {seq} already received"}
+            # the leader serializes forwards, so seqs arrive consecutively;
+            # a gap means THIS follower missed ops (it restarted between
+            # mirrors) — refusing makes the leader degrade and run
+            # recovery instead of queueing an op that can never execute
+            if seq != self._last_recv + 1:
+                return 409, {"status": "error",
+                             "message": f"lockstep gap: expected "
+                                        f"{self._last_recv + 1}, got {seq} "
+                                        "(follower needs reset)"}
+            self._last_recv = seq
             self._seen.add(seq)
             if len(self._seen) > 4096:   # drop already-executed entries:
                 # seq < _next is rejected above regardless of membership
