@@ -17,6 +17,8 @@ Entry points:
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 import jax.numpy as jnp
 
@@ -219,17 +221,43 @@ def _to_jax(tree, dtype):
     return jnp.asarray(tree, dtype)
 
 
-def load_hf_model(path_or_model, dtype=None):
-    """Load a local HF checkpoint directory or an in-memory HF model.
+def allow_download() -> bool:
+    """Hub downloads are opt-in: offline-by-default is the safe serving
+    posture (a worker must not silently reach the internet), but the
+    reference's download-any-model-by-name capability (worker/app.py:117-121,
+    cache dir worker/app.py:19-20) is available behind DLI_ALLOW_DOWNLOAD=1."""
+    return os.environ.get("DLI_ALLOW_DOWNLOAD", "") == "1"
 
-    Returns (ModelConfig, params). Fully offline: paths must exist locally
-    (the reference relied on HF-hub downloads per worker,
+
+def hub_cache_dir() -> str:
+    """Where opted-in downloads land (≙ reference MODEL_CACHE_DIR,
+    worker/app.py:19-20). Shared across workers via a mounted volume the
+    same way the reference's compose file did (docker-compose.yml:12)."""
+    return os.environ.get(
+        "DLI_MODEL_CACHE", os.path.join(os.path.expanduser("~"),
+                                        ".cache", "dli_models"))
+
+
+def load_hf_model(path_or_model, dtype=None):
+    """Load a local HF checkpoint directory, a hub id (opt-in), or an
+    in-memory HF model.
+
+    Returns (ModelConfig, params). Offline by default: paths must exist
+    locally (the reference relied on HF-hub downloads per worker,
     worker/app.py:117-121; here checkpoint distribution is explicit).
+    With ``DLI_ALLOW_DOWNLOAD=1`` a non-local name is fetched from the
+    hub into ``hub_cache_dir()`` once and reused thereafter.
     """
     if isinstance(path_or_model, str):
         import transformers
+        local_only = not allow_download() or os.path.isdir(path_or_model)
+        # redirect the cache only when an actual download is permitted —
+        # offline hub-id loads must keep resolving against the standard
+        # HF cache a user may already have populated
+        kw = ({"cache_dir": hub_cache_dir()}
+              if not local_only and not os.path.isdir(path_or_model) else {})
         model = transformers.AutoModelForCausalLM.from_pretrained(
-            path_or_model, local_files_only=True)
+            path_or_model, local_files_only=local_only, **kw)
     else:
         model = path_or_model
     cfg = config_from_hf(model.config)

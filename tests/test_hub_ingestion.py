@@ -1,0 +1,71 @@
+"""Opt-in HF-hub model ingestion (models/convert.py load_hf_model).
+
+Offline-by-default is the framework's posture; DLI_ALLOW_DOWNLOAD=1
+restores the reference's download-any-model-by-name capability
+(reference worker/app.py:117-121, cache at worker/app.py:19-20).
+All tests run offline against a mocked ``from_pretrained``.
+"""
+
+import numpy as np
+import pytest
+import transformers
+
+from distributed_llm_inferencing_tpu.models import convert
+
+
+class _Captured(Exception):
+    def __init__(self, kwargs):
+        self.kwargs = kwargs
+
+
+@pytest.fixture()
+def capture_from_pretrained(monkeypatch):
+    calls = {}
+
+    def fake(name, **kw):
+        calls["name"] = name
+        calls.update(kw)
+        raise _Captured(kw)
+
+    monkeypatch.setattr(transformers.AutoModelForCausalLM, "from_pretrained",
+                        staticmethod(fake))
+    return calls
+
+
+def test_offline_by_default(monkeypatch, capture_from_pretrained):
+    monkeypatch.delenv("DLI_ALLOW_DOWNLOAD", raising=False)
+    assert not convert.allow_download()
+    with pytest.raises(_Captured) as e:
+        convert.load_hf_model("gpt2")
+    assert e.value.kwargs["local_files_only"] is True
+
+
+def test_env_gate_enables_hub_download(monkeypatch, capture_from_pretrained):
+    monkeypatch.setenv("DLI_ALLOW_DOWNLOAD", "1")
+    monkeypatch.setenv("DLI_MODEL_CACHE", "/tmp/dli-test-cache")
+    with pytest.raises(_Captured) as e:
+        convert.load_hf_model("gpt2")
+    assert e.value.kwargs["local_files_only"] is False
+    assert e.value.kwargs["cache_dir"] == "/tmp/dli-test-cache"
+
+
+def test_local_dir_stays_local_even_when_enabled(
+        monkeypatch, tmp_path, capture_from_pretrained):
+    monkeypatch.setenv("DLI_ALLOW_DOWNLOAD", "1")
+    with pytest.raises(_Captured) as e:
+        convert.load_hf_model(str(tmp_path))
+    assert e.value.kwargs["local_files_only"] is True
+    assert "cache_dir" not in e.value.kwargs
+
+
+def test_in_memory_model_unaffected(monkeypatch):
+    """The in-memory path never touches from_pretrained (used by tests and
+    the numerics oracle)."""
+    monkeypatch.delenv("DLI_ALLOW_DOWNLOAD", raising=False)
+    import torch
+    torch.manual_seed(0)
+    hf = transformers.GPT2LMHeadModel(transformers.GPT2Config(
+        vocab_size=97, n_positions=32, n_embd=16, n_layer=2, n_head=2)).eval()
+    cfg, params = convert.load_hf_model(hf)
+    assert cfg.vocab_size == 97
+    assert params["embed"]["tokens"].shape == (97, 16)
