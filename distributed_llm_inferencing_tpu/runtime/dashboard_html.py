@@ -144,9 +144,14 @@ NODES = f"""<!doctype html><html><head><title>Nodes</title>{_STYLE}
 <th></th></tr></thead><tbody id="nodes"></tbody></table>
 <h2 style="margin-top:24px">Placement Plans</h2>
 <table><thead><tr><th>ID</th><th>Model</th><th>Mesh</th><th>Devices</th>
-<th>HBM/device</th><th>Max seq</th><th>Node</th><th>Loaded</th></tr></thead>
-<tbody id="plans"><tr><td colspan="8" class="muted">no plans</td></tr>
+<th>HBM/device</th><th>Max seq</th><th>Node</th><th>Loaded</th><th></th>
+</tr></thead>
+<tbody id="plans"><tr><td colspan="9" class="muted">no plans</td></tr>
 </tbody></table>
+<div class="row" style="margin-top:8px">
+  <label>Checkpoint path for deploys (empty = random-init demo)</label>
+  <input id="deploy-ckpt" placeholder="/path/to/native/checkpoint">
+  <span id="deploy-msg" class="muted"></span></div>
 <h2 style="margin-top:24px">Add Node</h2>
 <div class="grid2"><form id="add">
   <div class="row"><label>Name</label><input name="name" required></div>
@@ -154,6 +159,18 @@ NODES = f"""<!doctype html><html><head><title>Nodes</title>{_STYLE}
        placeholder="127.0.0.1"></div>
   <div class="row"><label>Port</label><input name="port" value="8100"></div>
   <button>Add Node</button> <span id="add-msg" class="muted"></span>
+</form>
+<form id="mkplan">
+  <h3 style="margin:0 0 8px">Create Placement Plan</h3>
+  <div class="row"><label>Model</label><input name="model" value="gpt2"></div>
+  <div class="row"><label>Mesh (tp pp dp sp ep)</label>
+    <div style="display:flex;gap:8px">
+      <input name="tp" value="1"><input name="pp" value="1">
+      <input name="dp" value="1"><input name="sp" value="1">
+      <input name="ep" value="1"></div></div>
+  <div class="row"><label>Max seq</label>
+    <input name="max_seq" value="2048"></div>
+  <button>Create Plan</button> <span id="mkplan-msg" class="muted"></span>
 </form></div>
 <script>{_ESC}
 function gib(b) {{ return b >= 2**30 ? (b/2**30).toFixed(1)+' GiB'
@@ -172,8 +189,23 @@ async function refreshPlans() {{
             gib(plan.hbm_per_device_estimate) : ''}}</td>`+
     `<td>${{plan.max_seq ?? ''}}</td><td>${{p.node_id ?? '–'}}</td>`+
     `<td><span class="pill ${{p.is_loaded ? 'online' : 'pending'}}">`+
-    `${{p.is_loaded ? 'deployed' : 'planned'}}</span></td></tr>`;
-  }}).join('') || '<tr><td colspan="8" class="muted">no plans</td></tr>';
+    `${{p.is_loaded ? 'deployed' : 'planned'}}</span></td>`+
+    `<td>${{p.is_loaded ? '' :
+      `<button onclick="deployPlan(${{p.id}})">Deploy</button>`}}</td></tr>`;
+  }}).join('') || '<tr><td colspan="9" class="muted">no plans</td></tr>';
+}}
+async function deployPlan(id) {{
+  // ≙ the mutation surface the reference kept in Django admin
+  // (admin.py:9-13 was the only way to mark a shard loaded); here the
+  // deploy actually pushes the plan to a worker via /load_shard
+  const ckpt = document.getElementById('deploy-ckpt').value.trim();
+  const body = ckpt ? {{checkpoint_path: ckpt}} : {{allow_random_init: true}};
+  const res = await fetch('/api/plans/deploy/'+id,
+    {{method:'POST', body:JSON.stringify(body)}});
+  const j = await res.json();
+  document.getElementById('deploy-msg').textContent =
+    j.status === 'success' ? ('plan '+id+' deployed') : j.message;
+  refreshPlans();
 }}
 async function refresh() {{
   refreshPlans();
@@ -211,6 +243,21 @@ document.getElementById('add').addEventListener('submit', async e => {{
   document.getElementById('add-msg').textContent =
     j.status === 'success' ? 'added' : j.message;
   refresh();
+}});
+document.getElementById('mkplan').addEventListener('submit', async e => {{
+  e.preventDefault();
+  const f = new FormData(e.target);
+  const mesh = {{}};
+  for (const ax of ['tp','pp','dp','sp','ep'])
+    mesh[ax] = parseInt(f.get(ax)) || 1;
+  const body = {{model_name: f.get('model'), mesh: mesh,
+                max_seq: parseInt(f.get('max_seq')) || 2048}};
+  const res = await fetch('/api/plans/create',
+    {{method:'POST', body:JSON.stringify(body)}});
+  const j = await res.json();
+  document.getElementById('mkplan-msg').textContent =
+    j.status === 'success' ? ('plan '+j.plan_id+' created') : j.message;
+  refreshPlans();
 }});
 refresh(); setInterval(refresh, 10000);  // 10s, like node_management.html:221-229
 </script></main></body></html>"""

@@ -178,6 +178,37 @@ def test_master_plan_api(master):
     assert len(plans["plans"]) == 1
 
 
+def test_plan_create_and_deploy_ui_flow(worker, master):
+    """The nodes page's plan mutation surface end-to-end: the same
+    create → deploy POSTs the dashboard form/button issue (the reference
+    kept this mutation surface in Django admin only, admin.py:4-19, and
+    never actually called /load_shard, SURVEY.md §3.2)."""
+    _, wport = worker
+    m, mport = master
+    requests.post(_url(mport, "/api/nodes/add"), json={
+        "name": "wplan", "host": "127.0.0.1", "port": wport})
+    r = requests.post(_url(mport, "/api/plans/create"), json={
+        "model_name": "tiny-gpt2", "mesh": {"tp": 1}, "max_seq": 64}).json()
+    assert r["status"] == "success", r
+    pid = r["plan_id"]
+    d = requests.post(_url(mport, f"/api/plans/deploy/{pid}"), json={
+        "allow_random_init": True, "dtype": "float32"}).json()
+    assert d["status"] == "success", d
+    plans = requests.get(_url(mport, "/api/plans")).json()["plans"]
+    mine = [p for p in plans if p["id"] == pid]
+    assert mine and mine[0]["is_loaded"] and mine[0]["node_id"] is not None
+    # the worker really holds the model now
+    h = requests.get(_url(wport, "/health")).json()
+    assert any(mdl["name"] == "tiny-gpt2" for mdl in h["loaded_models"])
+    requests.post(_url(wport, "/unload_model"),
+                  json={"model_name": "tiny-gpt2"})
+    # the page ships the mutation form + deploy wiring
+    page = requests.get(_url(mport, "/nodes")).text
+    assert "Create Placement Plan" in page
+    assert "deployPlan" in page and "/api/plans/deploy/" in page
+    assert "/api/plans/create" in page
+
+
 def test_user_error_does_not_strike_node(worker, master):
     """An unknown model name must fail the request immediately without
     deactivating the (healthy) node."""
