@@ -64,6 +64,12 @@ class ModelConfig:
     # Weight-only quantization (ops/quant.py): None | "int8". Halves the
     # HBM weight traffic of decode and doubles fit-per-chip.
     quant: Optional[str] = None
+    # KV-cache quantization: None | "int8" (per-token-per-head symmetric
+    # scales, ops/kvcache.py quant_kv). Halves cache traffic/footprint —
+    # the long-context decode lever on top of weight int8. Attention
+    # dequantizes at read; XLA fuses the int8->bf16 convert+scale into the
+    # attention matmuls so the HBM read stays int8.
+    kv_quant: Optional[str] = None
 
     # Attention kernel backend: auto | xla | pallas | pallas_interpret
     # (trace-time static; see ops/attention.py resolve_backend)

@@ -250,20 +250,41 @@ def _block_body(x, lp, cfg: ModelConfig, q_positions, attend_write):
 
 
 def _block(x, lp, cache_k, cache_v, *, cfg: ModelConfig, q_positions,
-           write_starts, new_lengths, is_prefill, backend, mesh=None):
+           write_starts, new_lengths, is_prefill, backend, mesh=None,
+           cache_ks=None, cache_vs=None):
     """One transformer block over the dense cache.
 
     x: [B,s,D]; cache_k/v: [B,S,Hkv,hd] (this layer's slice);
     write_starts: [B] int32 slot where this token block begins, per sequence.
-    Returns (x_out, new_cache_k, new_cache_v).
+    Returns (x_out, new_cache_k, new_cache_v[, new_k_scale, new_v_scale]).
 
     Two attention regimes (ops/attention.py): prefill attends the fresh
     K/V block directly — O(s^2) instead of O(s * max_seq) over the mostly
-    empty cache — while decode attends the cache.
+    empty cache — while decode attends the cache (dequantized at read when
+    ``cache_ks``/``cache_vs`` scales are present, ops/kvcache.py).
     """
+    quantized = cache_ks is not None
+
     def attend_write(q, k, v):
-        ck = write_block(cache_k, k, write_starts)
-        cv = write_block(cache_v, v, write_starts)
+        if quantized:
+            from distributed_llm_inferencing_tpu.ops.kvcache import (
+                dequant_kv, quant_kv)
+            k8, ks_new = quant_kv(k)
+            v8, vs_new = quant_kv(v)
+            ck = write_block(cache_k, k8, write_starts)
+            cv = write_block(cache_v, v8, write_starts)
+            cks = write_block(cache_ks, ks_new, write_starts)
+            cvs = write_block(cache_vs, vs_new, write_starts)
+            cache_out = (ck, cv, cks, cvs)
+            # decode attends the dequantized view; the convert+scale fuses
+            # into the attention matmul (reads stay int8 in HBM)
+            ck_at = dequant_kv(ck, cks, x.dtype)
+            cv_at = dequant_kv(cv, cvs, x.dtype)
+        else:
+            ck = write_block(cache_k, k, write_starts)
+            cv = write_block(cache_v, v, write_starts)
+            cache_out = (ck, cv)
+            ck_at, cv_at = ck, cv
         if is_prefill and mesh is not None and mesh.shape.get("sp", 1) > 1:
             # sequence-parallel long-context path: ring attention over sp
             # (parallel/ring.py) — K/V chunks rotate via ppermute, no device
@@ -282,16 +303,21 @@ def _block(x, lp, cache_k, cache_v, *, cfg: ModelConfig, q_positions,
             # the dense-under-GSPMD fallback
             from distributed_llm_inferencing_tpu.parallel.ring import (
                 ring_attend_decode)
-            attn = ring_attend_decode(q, ck, cv, new_lengths, mesh=mesh,
+            attn = ring_attend_decode(q, ck_at, cv_at, new_lengths,
+                                      mesh=mesh,
                                       sliding_window=cfg.sliding_window)
         else:
-            attn = attend_decode(q, ck, cv, new_lengths,
+            # quantized caches pin the xla formulation: the dequant fuses
+            # into its matmul, while a pallas kernel input would
+            # materialize the bf16 copy and forfeit the int8 read
+            attn = attend_decode(q, ck_at, cv_at, new_lengths,
                                  sliding_window=cfg.sliding_window,
-                                 backend=backend)
-        return attn, (ck, cv)
+                                 backend="xla" if quantized else backend,
+                                 q_positions=q_positions)
+        return attn, cache_out
 
-    x, (ck, cv) = _block_body(x, lp, cfg, q_positions, attend_write)
-    return x, ck, cv
+    x, cache_out = _block_body(x, lp, cfg, q_positions, attend_write)
+    return (x,) + cache_out
 
 
 def forward(
@@ -324,19 +350,24 @@ def forward(
     # pallas kernels are single-program (no GSPMD partitioning rule).
     backend = resolve_backend(cfg.attn_backend, jax.device_count())
 
+    # one body serves both cache layouts: scale planes ride the scan xs
+    # only when the cache is quantized
     def body(x, layer_in):
-        lp, ck, cv = layer_in
-        x, ck, cv = _block(
+        lp, ck, cv, *scales = layer_in
+        out = _block(
             x, lp, ck, cv, cfg=cfg, q_positions=q_positions,
             write_starts=write_starts, new_lengths=new_lengths,
-            is_prefill=is_prefill, backend=backend, mesh=mesh)
-        return x, (ck, cv)
+            is_prefill=is_prefill, backend=backend, mesh=mesh,
+            cache_ks=scales[0] if scales else None,
+            cache_vs=scales[1] if scales else None)
+        return out[0], tuple(out[1:])
 
-    x, (new_k, new_v) = jax.lax.scan(
-        body, x, (params["layers"], cache.k, cache.v))
-
+    xs = (params["layers"], cache.k, cache.v) + (
+        (cache.k_scale, cache.v_scale) if cache.quantized else ())
+    x, cache_out = jax.lax.scan(body, x, xs)
     logits = unembed(params, cfg, x)
-    return logits, KVCache(k=new_k, v=new_v, lengths=new_lengths)
+    planes = dict(zip(("k", "v", "k_scale", "v_scale"), cache_out))
+    return logits, KVCache(lengths=new_lengths, **planes)
 
 
 def prefill(params, cfg: ModelConfig, tokens, lengths, cache: KVCache,
@@ -396,11 +427,27 @@ def paged_decode_step(params, cfg: ModelConfig, tokens, paged,
     backend = resolve_backend(cfg.attn_backend, jax.device_count())
     q_pos = context_lens[:, None]                       # [R, 1]
     x = embed(params, cfg, tokens[:, None], q_pos)      # [R, 1, D]
+    quantized = paged.quantized
 
     def body(x, layer_in):
-        lp, ck, cv = layer_in                           # ck: [NB, bs, Hkv, hd]
+        lp, ck, cv, *scales = layer_in                  # ck: [NB, bs, Hkv, hd]
 
         def attend_write(q, k, v):
+            if quantized:
+                from distributed_llm_inferencing_tpu.ops.kvcache import (
+                    quant_kv)
+                cks, cvs = scales
+                k8, ks = quant_kv(k[:, 0])
+                v8, vs = quant_kv(v[:, 0])
+                nk = write_token(ck, k8, block_tables, context_lens)
+                nv = write_token(cv, v8, block_tables, context_lens)
+                nks = write_token(cks, ks, block_tables, context_lens)
+                nvs = write_token(cvs, vs, block_tables, context_lens)
+                attn = paged_attend_decode(
+                    q, nk, nv, block_tables, context_lens + 1,
+                    sliding_window=cfg.sliding_window, backend=backend,
+                    k_scale_layer=nks, v_scale_layer=nvs)
+                return attn, (nk, nv, nks, nvs)
             nk = write_token(ck, k[:, 0], block_tables, context_lens)
             nv = write_token(cv, v[:, 0], block_tables, context_lens)
             attn = paged_attend_decode(
@@ -410,10 +457,11 @@ def paged_decode_step(params, cfg: ModelConfig, tokens, paged,
 
         return _block_body(x, lp, cfg, q_pos, attend_write)
 
-    x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], paged.k,
-                                               paged.v))
+    xs = (params["layers"], paged.k, paged.v) + (
+        (paged.k_scale, paged.v_scale) if quantized else ())
+    x, cache_out = jax.lax.scan(body, x, xs)
     logits = unembed(params, cfg, x)[:, 0]              # [R, V]
-    return logits, PagedKVCache(k=new_k, v=new_v)
+    return logits, PagedKVCache(*cache_out)
 
 
 # Cap for materializing the whole chunk's pool gather [L, R, P, Hkv, hd]
@@ -481,7 +529,8 @@ def paged_decode_chunk(params, cfg: ModelConfig, k: int, tokens, paged,
     L = cfg.num_layers
     bs = paged.block_size
     mb = block_tables.shape[1]
-    dt = paged.k.dtype
+    dt = jnp.dtype(cfg.dtype)             # compute dtype (pool may be int8)
+    quantized = paged.quantized
     cl0 = context_lens                    # pool horizon, fixed this chunk
     pool_pos = jnp.broadcast_to(jnp.arange(mb * bs, dtype=jnp.int32),
                                 (r, mb * bs))
@@ -492,14 +541,21 @@ def paged_decode_chunk(params, cfg: ModelConfig, k: int, tokens, paged,
     # Pool K/V is loop-invariant: gather it ONCE for the whole chunk when
     # the materialization is modest; at long contexts fall back to a
     # per-step per-layer gather (transient, one layer at a time).
-    gathered_bytes = 2 * side0.dtype.itemsize * L * r * mb * bs \
+    gathered_bytes = 2 * dt.itemsize * L * r * mb * bs \
         * cfg.num_kv_heads * cfg.head_dim
     pre = gathered_bytes <= _PREGATHER_MAX_BYTES
     if pre:
-        pool_k = paged.k[:, block_tables].reshape(
-            L, r, mb * bs, cfg.num_kv_heads, cfg.head_dim)
-        pool_v = paged.v[:, block_tables].reshape(
-            L, r, mb * bs, cfg.num_kv_heads, cfg.head_dim)
+        shape = (L, r, mb * bs, cfg.num_kv_heads, cfg.head_dim)
+        pool_k = paged.k[:, block_tables].reshape(shape)
+        pool_v = paged.v[:, block_tables].reshape(shape)
+        if quantized:
+            from distributed_llm_inferencing_tpu.ops.kvcache import dequant_kv
+            pool_k = dequant_kv(
+                pool_k, paged.k_scale[:, block_tables].reshape(shape[:-1]),
+                dt)
+            pool_v = dequant_kv(
+                pool_v, paged.v_scale[:, block_tables].reshape(shape[:-1]),
+                dt)
     else:
         pool_k, pool_v = paged.k, paged.v   # gathered per layer in-loop
 
@@ -515,6 +571,14 @@ def paged_decode_chunk(params, cfg: ModelConfig, k: int, tokens, paged,
         def layer(x, layer_in):
             if pre:
                 lp, sk, sv, kp, vp = layer_in
+            elif quantized:
+                from distributed_llm_inferencing_tpu.ops.kvcache import (
+                    dequant_kv)
+                lp, sk, sv, ck, cv, cks, cvs = layer_in
+                kp = dequant_kv(gather_seq(ck, block_tables),
+                                gather_seq(cks, block_tables), dt)
+                vp = dequant_kv(gather_seq(cv, block_tables),
+                                gather_seq(cvs, block_tables), dt)
             else:
                 lp, sk, sv, ck, cv = layer_in
                 kp, vp = gather_seq(ck, block_tables), gather_seq(
@@ -538,8 +602,10 @@ def paged_decode_chunk(params, cfg: ModelConfig, k: int, tokens, paged,
             x, (sk2, sv2) = _block_body(x, lp, cfg, q_pos, attend_write)
             return x, (sk2, sv2)
 
-        x2, (side_k, side_v) = jax.lax.scan(
-            layer, x, (params["layers"], side_k, side_v, pool_k, pool_v))
+        xs = (params["layers"], side_k, side_v, pool_k, pool_v)
+        if quantized and not pre:
+            xs = xs + (paged.k_scale, paged.v_scale)
+        x2, (side_k, side_v) = jax.lax.scan(layer, x, xs)
         logits = unembed(params, cfg, x2)[:, 0]
         nxt = sample_batch(logits, seeds, steps0 + t, temps, tks, tps, ds)
         is_eos = alive & (eos_ids >= 0) & (nxt == eos_ids)
@@ -559,6 +625,17 @@ def paged_decode_chunk(params, cfg: ModelConfig, k: int, tokens, paged,
                               jnp.swapaxes(pos // bs, 0, 1), axis=1)
     blk = jnp.where(wrote, jnp.swapaxes(blk, 0, 1), dummy_block)   # [K, R]
     off = pos % bs
+    if quantized:
+        from distributed_llm_inferencing_tpu.ops.kvcache import quant_kv
+        k8, ks = quant_kv(side_k)
+        v8, vs = quant_kv(side_v)
+        return toks, emits, PagedKVCache(
+            k=paged.k.at[:, blk, off].set(jnp.swapaxes(k8, 1, 2)),
+            v=paged.v.at[:, blk, off].set(jnp.swapaxes(v8, 1, 2)),
+            k_scale=paged.k_scale.at[:, blk, off].set(
+                jnp.swapaxes(ks, 1, 2)),
+            v_scale=paged.v_scale.at[:, blk, off].set(
+                jnp.swapaxes(vs, 1, 2)))
     new_k = paged.k.at[:, blk, off].set(jnp.swapaxes(side_k, 1, 2))
     new_v = paged.v.at[:, blk, off].set(jnp.swapaxes(side_v, 1, 2))
     return toks, emits, PagedKVCache(k=new_k, v=new_v)
@@ -625,11 +702,29 @@ def paged_prefill_tail(params, cfg: ModelConfig, tokens, tail_len,
         jnp.arange(t, dtype=jnp.int32), (b, t))
     tail_valid = jnp.arange(t, dtype=jnp.int32)[None, :] < tail_len[:, None]
     x = embed(params, cfg, tokens, q_pos)
+    quantized = paged.quantized
 
     def body(x, layer_in):
-        lp, ck, cv = layer_in
+        lp, ck, cv, *scales = layer_in
 
         def attend_write(q, k, v):
+            if quantized:
+                # store int8 + scales; the tail attends its own fresh bf16
+                # K/V plus the dequantized cached prefix
+                from distributed_llm_inferencing_tpu.ops.kvcache import (
+                    quant_kv)
+                cks, cvs = scales
+                k8, ks = quant_kv(k)
+                v8, vs = quant_kv(v)
+                nk = write_block_run(ck, k8, tail_blocks)
+                nv = write_block_run(cv, v8, tail_blocks)
+                nks = write_block_run(cks, ks, tail_blocks)
+                nvs = write_block_run(cvs, vs, tail_blocks)
+                attn = paged_attend_prefix(
+                    q, k, v, nk, nv, prefix_blocks, prefix_len, q_pos,
+                    tail_valid, sliding_window=cfg.sliding_window,
+                    k_scale_layer=nks, v_scale_layer=nvs)
+                return attn, (nk, nv, nks, nvs)
             nk = write_block_run(ck, k, tail_blocks)
             nv = write_block_run(cv, v, tail_blocks)
             attn = paged_attend_prefix(
@@ -639,12 +734,14 @@ def paged_prefill_tail(params, cfg: ModelConfig, tokens, tail_len,
 
         return _block_body(x, lp, cfg, q_pos, attend_write)
 
-    x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], paged.k,
-                                               paged.v))
+    xs = (params["layers"], paged.k, paged.v) + (
+        (paged.k_scale, paged.v_scale) if quantized else ())
+    x, cache_out = jax.lax.scan(body, x, xs)
+    new_paged = PagedKVCache(*cache_out)
     # project only the last real position through the vocab head ([D,V] over
     # one row per sequence, not T padded rows)
     last_x = jnp.take_along_axis(
         x, jnp.maximum(tail_len - 1, 0)[:, None, None].astype(jnp.int32),
         axis=1)                                         # [B, 1, D]
     last = unembed(params, cfg, last_x)[:, 0]           # [B, V]
-    return last, PagedKVCache(k=new_k, v=new_v)
+    return last, new_paged

@@ -122,10 +122,18 @@ def attend_prefill(q, k, v, *, sliding_window: Optional[int] = None,
 
 def attend_decode(q, cache_k, cache_v, lengths, *,
                   sliding_window: Optional[int] = None,
-                  backend: str = "xla"):
-    """Single-token cached attention. ``lengths`` counts filled slots
-    including the token just written; the query is at ``lengths - 1``."""
-    if backend.startswith("pallas"):
+                  backend: str = "xla", q_positions=None):
+    """Cached attention for decode-regime queries.
+
+    Single-token (Sq == 1): ``lengths`` counts filled slots including the
+    token just written; the query sits at ``lengths - 1``. Multi-token
+    (speculative verification, ops/speculative.py): pass ``q_positions``
+    [B, Sq] so each query is causally masked at its own position — the
+    pallas flash-decode kernel is single-query, so multi-token always
+    takes the xla formulation.
+    """
+    multi = q.shape[1] > 1
+    if backend.startswith("pallas") and not multi:
         from distributed_llm_inferencing_tpu.ops.pallas import flash_decode
         return flash_decode(
             q, cache_k, cache_v, lengths, sliding_window=sliding_window,
@@ -133,6 +141,7 @@ def attend_decode(q, cache_k, cache_v, lengths, *,
     B, S = cache_k.shape[0], cache_k.shape[1]
     kv_pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
     kv_valid = kv_pos < lengths[:, None]
-    q_pos = (lengths - 1)[:, None]
+    q_pos = (q_positions if q_positions is not None
+             else (lengths - 1)[:, None])
     return attend(q, cache_k, cache_v, q_pos, kv_pos, kv_valid,
                   sliding_window=sliding_window)

@@ -38,8 +38,12 @@ from distributed_llm_inferencing_tpu.ops.attention import attend
 
 
 class PagedKVCache(NamedTuple):
-    k: jax.Array   # [L, NB, bs, Hkv, hd]
+    k: jax.Array   # [L, NB, bs, Hkv, hd] (model dtype, or int8)
     v: jax.Array   # [L, NB, bs, Hkv, hd]
+    # per-token-per-head scales, present iff cfg.kv_quant == "int8"
+    # (ops/kvcache.py quant_kv scheme): [L, NB, bs, Hkv] f32
+    k_scale: Optional[jax.Array] = None
+    v_scale: Optional[jax.Array] = None
 
     @property
     def num_blocks(self) -> int:
@@ -49,12 +53,23 @@ class PagedKVCache(NamedTuple):
     def block_size(self) -> int:
         return self.k.shape[2]
 
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
+
 
 def init_paged_cache(cfg: ModelConfig, num_blocks: int, block_size: int,
                      dtype=None) -> PagedKVCache:
     dtype = dtype or jnp.dtype(cfg.dtype)
     shape = (cfg.num_layers, num_blocks, block_size, cfg.num_kv_heads,
              cfg.head_dim)
+    if cfg.kv_quant == "int8":
+        return PagedKVCache(
+            k=jnp.zeros(shape, jnp.int8), v=jnp.zeros(shape, jnp.int8),
+            k_scale=jnp.zeros(shape[:-1], jnp.float32),
+            v_scale=jnp.zeros(shape[:-1], jnp.float32))
+    if cfg.kv_quant is not None:
+        raise ValueError(f"unknown kv_quant mode {cfg.kv_quant!r}")
     return PagedKVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
 
 
@@ -80,7 +95,7 @@ def write_block_run(cache_layer, new_blocks, block_ids):
     in one op; duplicate ids may only occur on the reserved dummy block
     (padding rows), where last-write-wins garbage is by design.
     """
-    if new_blocks.ndim == 3:
+    if block_ids.ndim == 1:   # legacy unbatched call: [T, ...] + [T//bs]
         new_blocks, block_ids = new_blocks[None], block_ids[None]
     bs = cache_layer.shape[1]
     b, t = new_blocks.shape[:2]
@@ -99,7 +114,8 @@ def gather_seq(cache_layer, block_tables):
 def paged_attend_decode(q, cache_k_layer, cache_v_layer, block_tables,
                         context_lens,
                         sliding_window: Optional[int] = None,
-                        backend: str = "xla"):
+                        backend: str = "xla",
+                        k_scale_layer=None, v_scale_layer=None):
     """Single-token attention over the paged cache.
 
     q: [R, 1, H, hd]; context_lens: [R] — filled slots INCLUDING the token
@@ -113,8 +129,12 @@ def paged_attend_decode(q, cache_k_layer, cache_v_layer, block_tables,
     gather is a dense contiguous read XLA streams at full HBM bandwidth,
     while the kernel's per-slot block walk is grid-serialized. Revisit
     when contexts are long enough that gathering MB*bs dominates.
+
+    int8 caches (``k_scale_layer``/``v_scale_layer`` present) always take
+    the gather formulation — the dequant fuses into the gather/matmul;
+    the pallas kernel has no int8 rule.
     """
-    if backend.startswith("pallas"):
+    if backend.startswith("pallas") and k_scale_layer is None:
         from distributed_llm_inferencing_tpu.ops.pallas.paged_attention import (
             paged_flash_decode)
         return paged_flash_decode(
@@ -125,6 +145,10 @@ def paged_attend_decode(q, cache_k_layer, cache_v_layer, block_tables,
     bs = cache_k_layer.shape[1]
     k = gather_seq(cache_k_layer, block_tables)
     v = gather_seq(cache_v_layer, block_tables)
+    if k_scale_layer is not None:
+        from distributed_llm_inferencing_tpu.ops.kvcache import dequant_kv
+        k = dequant_kv(k, gather_seq(k_scale_layer, block_tables), q.dtype)
+        v = dequant_kv(v, gather_seq(v_scale_layer, block_tables), q.dtype)
     kv_pos = jnp.broadcast_to(jnp.arange(mb * bs, dtype=jnp.int32),
                               (r, mb * bs))
     kv_valid = kv_pos < context_lens[:, None]
@@ -135,7 +159,8 @@ def paged_attend_decode(q, cache_k_layer, cache_v_layer, block_tables,
 
 def paged_attend_prefix(q, k_new, v_new, cache_k_layer, cache_v_layer,
                         prefix_blocks, prefix_len, q_positions, tail_valid,
-                        sliding_window: Optional[int] = None):
+                        sliding_window: Optional[int] = None,
+                        k_scale_layer=None, v_scale_layer=None):
     """Tail-prefill attention: fresh tail K/V plus a cached prefix.
 
     This is what makes prefix-cache hits save *compute*, not just memory:
@@ -153,6 +178,12 @@ def paged_attend_prefix(q, k_new, v_new, cache_k_layer, cache_v_layer,
     pb = prefix_blocks.shape[1]
     kp = gather_seq(cache_k_layer, prefix_blocks)   # [B, PB*bs, Hkv, hd]
     vp = gather_seq(cache_v_layer, prefix_blocks)
+    if k_scale_layer is not None:   # int8 pool: dequantize the prefix
+        from distributed_llm_inferencing_tpu.ops.kvcache import dequant_kv
+        kp = dequant_kv(kp, gather_seq(k_scale_layer, prefix_blocks),
+                        q.dtype)
+        vp = dequant_kv(vp, gather_seq(v_scale_layer, prefix_blocks),
+                        q.dtype)
     p = pb * bs
     prefix_pos = jnp.broadcast_to(jnp.arange(p, dtype=jnp.int32), (b, p))
     prefix_valid = prefix_pos < prefix_len[:, None]

@@ -54,6 +54,22 @@ def _mask_top_p(logits, p: float):
     return jnp.where(logits < thresh, -jnp.inf, logits)
 
 
+def warp_logits(logits, params: SamplingParams):
+    """Apply the HF warper pipeline (temperature -> top_k -> top_p) and
+    return the masked logits [-inf outside the sampling support]. The
+    distribution ``softmax(warp_logits(l, p))`` is exactly what ``sample``
+    draws from — factored out so speculative verification
+    (ops/speculative.py) can accept/reject against the same distribution.
+    """
+    logits = logits.astype(jnp.float32)
+    if not params.do_sample:
+        return logits
+    t = max(params.temperature, 1e-6)
+    logits = logits / t
+    logits = _mask_top_k(logits, params.top_k)
+    return _mask_top_p(logits, params.top_p)
+
+
 def sample(logits, key, params: SamplingParams,
            ban_tokens: Optional[jax.Array] = None):
     """Sample next tokens. logits: [..., V] float; returns [...] int32.

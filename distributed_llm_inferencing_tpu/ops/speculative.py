@@ -1,0 +1,126 @@
+"""Speculative decoding: n-gram self-draft proposal + one-pass verification.
+
+Decode is HBM-bound: each autoregressive step streams the full weight set
+for ONE token of progress. Speculative decoding converts spare MXU compute
+into tokens — score gamma cheap draft tokens in a single forward pass
+(prefill-style, s = gamma + 1) and keep the prefix the model agrees with.
+With a delta draft (our proposals are deterministic) the standard
+leave-one-out rejection rule preserves the target sampling distribution
+EXACTLY; greedy verification is exact trivially.
+
+The draft source is *prompt lookup* (self-drafting): the continuation of
+the most recent earlier occurrence of the current n-gram in the token
+history. Free to compute host-side (the host already holds every emitted
+token), surprisingly strong on repetitive serving workloads
+(summarization, code edits, RAG quoting the context), and requiring no
+second model — the right first speculation tier for a serving stack.
+No reference counterpart at any level (its loop was HF ``generate()``,
+reference worker/app.py:297-305).
+
+Verification runs entirely on device (ops/sampling.py warp_logits gives
+the same warped distribution ``sample`` draws from); the host syncs once
+per verify step and receives up to gamma+1 tokens.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from distributed_llm_inferencing_tpu.models import transformer
+from distributed_llm_inferencing_tpu.models.config import ModelConfig
+from distributed_llm_inferencing_tpu.ops.sampling import (
+    SamplingParams, warp_logits)
+
+
+def propose_ngram(history: Sequence[int], gamma: int,
+                  n: int = 2) -> Optional[List[int]]:
+    """Prompt-lookup draft: continuation of the most recent earlier
+    occurrence of the trailing ``n``-gram of ``history``. Returns gamma
+    tokens (right-padded by repeating the last continuation token), or
+    None when the n-gram never occurred before (caller decides whether to
+    verify a dummy draft or plain-decode)."""
+    h = list(history)
+    if len(h) < n + 1:
+        return None
+    key = h[-n:]
+    for i in range(len(h) - n - 1, -1, -1):
+        if h[i:i + n] == key:
+            cont = h[i + n:i + n + gamma]
+            if not cont:
+                continue
+            return cont + [cont[-1]] * (gamma - len(cont))
+    return None
+
+
+def verify_step(params, cfg: ModelConfig, cache, cur, drafts, key,
+                sp: SamplingParams):
+    """Score ``[cur, drafts...]`` in one forward pass and accept the
+    longest draft prefix the target distribution keeps.
+
+    cur: [B] current token (not yet in cache); drafts: [B, G].
+    Returns (tokens [B, G+1], n_emit [B], cache, key): row b emits
+    ``tokens[b, :n_emit[b]]`` (between 1 and G+1 tokens).
+
+    Acceptance, per row:
+    - greedy: accept draft i while it equals the raw argmax; the emitted
+      stop token is the argmax itself, so output ≡ plain greedy decode.
+    - sampling: delta-draft leave-one-out rejection — accept draft i with
+      probability p_i(d_i) under the warped target distribution; on the
+      first rejection, sample from p_i with d_i masked out (renormalized).
+      This preserves the target distribution exactly (the residual
+      max(0, p - delta_d) / (1 - p(d)) is p with d removed).
+    All-accepted rows draw a bonus token from the last position.
+
+    Cache semantics: K/V for cur and ALL drafts are written at positions
+    [L0, L0+G]; lengths advance only by the accepted count, so rejected
+    positions hold garbage that later steps overwrite in order (the cache
+    invariant slot == position is preserved).
+    """
+    b, g = drafts.shape
+    toks_in = jnp.concatenate([cur[:, None], drafts], axis=1)   # [B, G+1]
+    l0 = cache.lengths
+    q_pos = l0[:, None] + jnp.arange(g + 1, dtype=jnp.int32)[None, :]
+    logits, cache = transformer.forward(
+        params, cfg, toks_in, cache, write_starts=l0, q_positions=q_pos,
+        new_lengths=l0 + g + 1, is_prefill=False)
+    # (causality masks each query to its own prefix, so the provisional
+    # over-long lengths above never leak future K/V into a score)
+
+    key, k_acc, k_stop = jax.random.split(key, 3)
+    if sp.do_sample:
+        probs = jax.nn.softmax(warp_logits(logits, sp), axis=-1)
+        p_draft = jnp.take_along_axis(
+            probs[:, :-1], drafts[..., None], axis=-1)[..., 0]   # [B, G]
+        acc = jax.random.uniform(k_acc, (b, g)) < p_draft
+    else:
+        targets = jnp.argmax(logits, axis=-1)                    # [B, G+1]
+        acc = drafts == targets[:, :-1]
+    prefix = jnp.cumprod(acc.astype(jnp.int32), axis=1)          # [B, G]
+    n_acc = prefix.sum(axis=1)                                   # [B] 0..G
+
+    # stop token: position n_acc's distribution, minus the rejected draft
+    stop_logits = jnp.take_along_axis(
+        warp_logits(logits, sp), n_acc[:, None, None], axis=1)[:, 0]
+    rejected = jnp.take_along_axis(   # draft at the stop position (G-clamped)
+        drafts, jnp.minimum(n_acc, g - 1)[:, None], axis=1)[:, 0]
+    was_rejection = n_acc < g
+    mask_rej = (jnp.arange(stop_logits.shape[-1])[None, :]
+                == rejected[:, None]) & was_rejection[:, None]
+    stop_logits = jnp.where(mask_rej, -jnp.inf, stop_logits)
+    if sp.do_sample:
+        stop_tok = jax.random.categorical(k_stop, stop_logits, axis=-1)
+    else:
+        stop_tok = jnp.argmax(stop_logits, axis=-1)
+    stop_tok = stop_tok.astype(jnp.int32)
+
+    # emitted = accepted drafts then the stop token
+    idx = jnp.arange(g + 1, dtype=jnp.int32)[None, :]
+    draft_pad = jnp.concatenate(
+        [drafts, jnp.zeros((b, 1), drafts.dtype)], axis=1)
+    tokens = jnp.where(idx == n_acc[:, None], stop_tok[:, None], draft_pad)
+    n_emit = n_acc + 1
+    cache = cache._replace(lengths=l0 + n_emit)
+    return tokens, n_emit, cache, key

@@ -106,9 +106,12 @@ def cache_specs(cfg: ModelConfig, spec: MeshSpec):
     sequence over sp (ring attention shards the S axis)."""
     kv_tp = kv_head_axis(cfg.num_kv_heads, spec.tp)
     L = "pp" if spec.pp > 1 else None  # stage-local cache slices
-    kv = P(L, "dp", "sp" if spec.sp > 1 else None, kv_tp, None)
+    sp = "sp" if spec.sp > 1 else None
+    kv = P(L, "dp", sp, kv_tp, None)
     from distributed_llm_inferencing_tpu.ops.kvcache import KVCache
-    return KVCache(k=kv, v=kv, lengths=P("dp"))
+    scale = P(L, "dp", sp, kv_tp) if cfg.kv_quant else None
+    return KVCache(k=kv, v=kv, lengths=P("dp"), k_scale=scale,
+                   v_scale=scale)
 
 
 def paged_cache_specs(cfg: ModelConfig, spec: MeshSpec):
@@ -120,7 +123,8 @@ def paged_cache_specs(cfg: ModelConfig, spec: MeshSpec):
     kv_tp = kv_head_axis(cfg.num_kv_heads, spec.tp)
     kv = P(None, None, None, kv_tp, None)
     from distributed_llm_inferencing_tpu.ops.paged_kvcache import PagedKVCache
-    return PagedKVCache(k=kv, v=kv)
+    scale = P(None, None, None, kv_tp) if cfg.kv_quant else None
+    return PagedKVCache(k=kv, v=kv, k_scale=scale, v_scale=scale)
 
 
 def logits_spec():

@@ -185,12 +185,24 @@ class InferenceEngine:
         seed: int = 0,
         eos_token_id: Optional[int] = None,
         stream_cb: Optional[Callable[[int, List[int]], None]] = None,
+        speculative: Optional[str] = None,   # "ngram" (ops/speculative.py)
+        spec_gamma: int = 4,
     ) -> GenerateResult:
         """Generate continuations for a batch of token-id prompts.
 
         stream_cb(step, tokens_this_step) fires after every decode step —
         the streaming surface the server layer exposes as SSE.
+
+        ``speculative="ngram"`` turns on prompt-lookup speculative decoding
+        (single sequence only): each dispatched program verifies
+        ``spec_gamma`` self-drafted tokens, emitting 1..gamma+1 tokens per
+        step — output distribution identical to plain decode (exact for
+        greedy; leave-one-out rejection for sampling).
         """
+        if speculative is not None:
+            return self._generate_speculative(
+                prompts, max_new_tokens, sampling, seed, eos_token_id,
+                stream_cb, speculative, spec_gamma)
         cfg = self.cfg
         sp = sampling or SamplingParams()
         n_real = len(prompts)
@@ -309,6 +321,106 @@ class InferenceEngine:
         return GenerateResult(
             tokens=out, prefill_ms=(t1 - t0) * 1e3,
             decode_ms=(t2 - t1) * 1e3, steps=steps)
+
+    # ---- speculative decoding (ops/speculative.py) --------------------
+
+    def _verify_jitted(self, sp: SamplingParams, g: int):
+        fn = self._decode_fns.get(("spec", sp, g))
+        if fn is None:
+            cfg = self.cfg
+            from distributed_llm_inferencing_tpu.ops import speculative
+
+            def raw(params, cache, cur, drafts, key):
+                return speculative.verify_step(params, cfg, cache, cur,
+                                               drafts, key, sp)
+
+            fn = jax.jit(raw, donate_argnums=(1,))
+            if len(self._decode_fns) >= 24:
+                self._decode_fns.pop(next(iter(self._decode_fns)))
+            self._decode_fns[("spec", sp, g)] = fn
+        return fn
+
+    def _generate_speculative(self, prompts, max_new_tokens, sampling, seed,
+                              eos_token_id, stream_cb, mode, gamma):
+        """Prompt-lookup speculative loop: one verify program per step,
+        1..gamma+1 tokens per host sync. Single-sequence (speculation is a
+        latency lever for individual streams; batched throughput comes
+        from the continuous batcher)."""
+        from distributed_llm_inferencing_tpu.ops import speculative
+        if mode != "ngram":
+            raise ValueError(f"unknown speculative mode {mode!r}")
+        if len(prompts) != 1:
+            raise ValueError("speculative decoding serves one sequence")
+        if any(getattr(self.mesh_spec, ax) > 1 for ax in ("sp", "pp", "dp")):
+            raise ValueError("speculative decoding supports tp/ep meshes")
+        cfg = self.cfg
+        sp = sampling or SamplingParams()
+        gamma = max(1, int(gamma))
+        prompt = list(map(int, prompts[0]))
+        if not prompt:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            return GenerateResult(tokens=[[]], prefill_ms=0.0, decode_ms=0.0,
+                                  steps=0)
+        if len(prompt) + max_new_tokens + gamma + 1 > self.max_seq:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens})"
+                f" + gamma ({gamma}) exceeds engine max_seq {self.max_seq}")
+
+        s0 = min(_bucket(len(prompt)), self.max_seq)
+        tokens = np.zeros((1, s0), np.int32)
+        tokens[0, :len(prompt)] = prompt
+        with self.mesh:
+            cache = init_cache(cfg, 1, self.max_seq)
+            cache = jax.device_put(cache, self._cache_shardings)
+            if s0 not in self._prefill_fns:
+                self._prefill_fns[s0] = self._build_prefill(s0)
+            t0 = time.perf_counter()
+            last_logits, cache = self._prefill_fns[s0](
+                self.params, jnp.asarray(tokens),
+                jnp.asarray([len(prompt)], jnp.int32), cache)
+            key = jax.random.PRNGKey(seed)
+            key, sub = jax.random.split(key)
+            cur = int(sample(last_logits, sub, sp)[0])
+            t1 = time.perf_counter()
+
+            hit_eos = eos_token_id is not None and cur == eos_token_id
+            out: List[int] = [] if hit_eos else [cur]
+            if stream_cb and not hit_eos:
+                stream_cb(0, [cur])   # same contract as the plain path
+            history = prompt + out
+            steps = 1
+            verify = self._verify_jitted(sp, gamma)
+            while len(out) < max_new_tokens and not hit_eos:
+                drafts = speculative.propose_ngram(history, gamma)
+                if drafts is None:
+                    # no n-gram hit: verify a dummy draft — still emits
+                    # >= 1 correct token for one dispatch
+                    drafts = [history[-1]] * gamma
+                toks_dev, n_emit, cache, key = verify(
+                    self.params, cache, jnp.asarray([out[-1]], jnp.int32),
+                    jnp.asarray([drafts], jnp.int32), key)
+                steps += 1
+                n = int(n_emit[0])
+                emitted = [int(t) for t in np.asarray(toks_dev)[0, :n]]
+                # keep (and stream) only what the result will contain:
+                # nothing past max_new_tokens, nothing at/after eos
+                kept = []
+                for t in emitted:
+                    if eos_token_id is not None and t == eos_token_id:
+                        hit_eos = True
+                        break
+                    kept.append(t)
+                    if len(out) + len(kept) >= max_new_tokens:
+                        break
+                out.extend(kept)
+                history.extend(kept)
+                if stream_cb and kept:
+                    stream_cb(steps, kept)
+            t2 = time.perf_counter()
+
+        return GenerateResult(tokens=[out], prefill_ms=(t1 - t0) * 1e3,
+                              decode_ms=(t2 - t1) * 1e3, steps=steps)
 
     # ---- introspection ----------------------------------------------
 

@@ -188,6 +188,10 @@ class WorkerAgent:
             source = "random-init"
         if body.get("dtype"):
             cfg = cfg.replace(dtype=body["dtype"])
+        if body.get("kv_quantize"):
+            # int8 KV cache (ops/kvcache.py): halves cache traffic and
+            # footprint for long contexts, on top of weight int8
+            cfg = cfg.replace(kv_quant=body["kv_quantize"])
         if body.get("quantize"):
             cfg = cfg.replace(quant=body["quantize"])
             if params is not None:
@@ -293,6 +297,17 @@ class WorkerAgent:
             max_new = int(body["max_new_tokens"])
         else:
             max_new = max(1, int(body.get("max_length", 100)) - len(prompt))
+        spec = body.get("speculative")
+        if spec is not None:
+            if spec != "ngram":
+                raise ValueError(f"unknown speculative mode {spec!r} "
+                                 "(supported: 'ngram')")
+            if not 1 <= int(body.get("spec_gamma", 4)) <= 16:
+                raise ValueError("spec_gamma must be in [1, 16]")
+            if m.batcher is not None:
+                raise ValueError(
+                    "speculative decoding is engine-mode only; this model "
+                    "serves via the continuous batcher")
         return m, prompt, sp, max_new
 
     def inference(self, body):
@@ -334,11 +349,21 @@ class WorkerAgent:
                 "ttft_ms": req.ttft_ms,
                 "scheduler": m.batcher.stats(),
             }
-        with self.metrics.time("inference"), m.lock:
-            res = m.engine.generate(
-                [prompt], max_new_tokens=max_new, sampling=sp,
-                seed=int(body.get("seed", time.time_ns() % (1 << 31))),
-                eos_token_id=m.tokenizer.eos_token_id)
+        try:
+            with self.metrics.time("inference"), m.lock:
+                res = m.engine.generate(
+                    [prompt], max_new_tokens=max_new, sampling=sp,
+                    seed=int(body.get("seed", time.time_ns() % (1 << 31))),
+                    eos_token_id=m.tokenizer.eos_token_id,
+                    # prompt-lookup speculative decoding
+                    # (ops/speculative.py): per-request opt-in,
+                    # output-distribution-preserving
+                    speculative=body.get("speculative"),
+                    spec_gamma=int(body.get("spec_gamma", 4)))
+        except ValueError as e:   # request-shape errors (e.g. context
+            # window exceeded incl. the speculative gamma margin) are the
+            # caller's fault, not a server fault
+            return 400, {"status": "error", "message": str(e)}
         text = m.tokenizer.decode(res.tokens[0])
         self.metrics.inc("requests_completed")
         self.metrics.inc("tokens_generated", len(res.tokens[0]))

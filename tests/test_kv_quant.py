@@ -1,0 +1,162 @@
+"""int8 KV cache (cfg.kv_quant): storage halves, outputs stay close.
+
+Per-token-per-head symmetric int8 (ops/kvcache.py quant_kv) bounds the
+per-element quantization error at ~0.4% of the head's max |value|, so
+logits drift but distributions stay close — the standard serving trade.
+Tests pin: (a) relaxed-tolerance logits equivalence vs the bf16/f32 cache
+on dense and paged paths, (b) end-to-end generation through engine and
+batcher, (c) the memory halving that is the feature's point.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_llm_inferencing_tpu.models import transformer
+from distributed_llm_inferencing_tpu.models.params import init_params
+from distributed_llm_inferencing_tpu.models.registry import get_config
+from distributed_llm_inferencing_tpu.ops.kvcache import (
+    dequant_kv, init_cache, quant_kv)
+from distributed_llm_inferencing_tpu.ops.paged_kvcache import init_paged_cache
+from distributed_llm_inferencing_tpu.ops.sampling import SamplingParams
+
+CFG = get_config("tiny-llama").replace(dtype="float32", attn_backend="xla")
+QCFG = CFG.replace(kv_quant="int8")
+PARAMS = init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
+RNG = np.random.default_rng(0)
+
+
+def test_quant_roundtrip_error_bound():
+    x = jnp.asarray(RNG.normal(size=(4, 7, 2, 16)), jnp.float32)
+    q, s = quant_kv(x)
+    back = dequant_kv(q, s, jnp.float32)
+    # symmetric int8: error <= scale/2 = max|x| per head / 254
+    err = np.abs(np.asarray(back - x))
+    bound = np.asarray(jnp.max(jnp.abs(x), axis=-1) / 127.0)[..., None]
+    assert (err <= bound * 0.5 + 1e-7).all()
+
+
+def test_cache_memory_halves():
+    full = init_cache(CFG, 2, 64, dtype=jnp.float32)
+    q = init_cache(QCFG, 2, 64)
+    fb = sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(full))
+    qb = sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(q))
+    assert q.k.dtype == jnp.int8 and q.quantized
+    # f32 baseline: int8 + one f32 scale per hd-vector -> (1 + 4/hd)/4
+    expected = (1 + 4 / CFG.head_dim) / 4
+    assert qb < expected * fb * 1.05
+    # at serving head dims (>=64) that is ~0.26x f32 / ~0.52x bf16
+    assert CFG.head_dim < 64 or qb < 0.27 * fb
+
+
+def test_dense_prefill_decode_close_to_full_precision():
+    B, S = 2, 24
+    toks = jnp.asarray(RNG.integers(0, CFG.vocab_size, (B, S)), jnp.int32)
+    lens = jnp.asarray([S, S - 5], jnp.int32)
+
+    logits_f, cache_f = transformer.prefill(
+        PARAMS, CFG, toks, lens, init_cache(CFG, B, 48, dtype=jnp.float32))
+    logits_q, cache_q = transformer.prefill(
+        PARAMS, QCFG, toks, lens, init_cache(QCFG, B, 48))
+    # prefill attends fresh K/V only -> logits should match tightly
+    np.testing.assert_allclose(np.asarray(logits_q), np.asarray(logits_f),
+                               atol=1e-4, rtol=1e-4)
+
+    nxt = jnp.argmax(logits_f[:, -1], -1).astype(jnp.int32)[:, None]
+    d_f, _ = transformer.decode_step(PARAMS, CFG, nxt, cache_f)
+    d_q, _ = transformer.decode_step(PARAMS, QCFG, nxt, cache_q)
+    # decode reads the quantized cache -> relaxed tolerance
+    f, q = np.asarray(d_f[:, 0]), np.asarray(d_q[:, 0])
+    assert np.abs(q - f).max() < 0.15 * np.abs(f).max()
+    # distributions nearly identical
+    pf = jax.nn.softmax(jnp.asarray(f), axis=-1)
+    pq = jax.nn.softmax(jnp.asarray(q), axis=-1)
+    assert float(jnp.abs(pf - pq).sum(-1).max()) < 0.1
+
+
+def test_engine_generates_with_kv_int8():
+    from distributed_llm_inferencing_tpu.runtime.engine import InferenceEngine
+    prompt = RNG.integers(0, CFG.vocab_size, 11).tolist()
+    full = InferenceEngine(CFG, PARAMS, max_seq=64).generate(
+        [prompt], max_new_tokens=12, sampling=SamplingParams.greedy())
+    q = InferenceEngine(QCFG, PARAMS, max_seq=64).generate(
+        [prompt], max_new_tokens=12, sampling=SamplingParams.greedy())
+    assert len(q.tokens[0]) == 12
+    # greedy trajectories usually agree on a tiny model; require a shared
+    # prefix so gross corruption can't pass
+    shared = sum(1 for a, b in zip(full.tokens[0], q.tokens[0]) if a == b)
+    assert shared >= 6, (full.tokens[0], q.tokens[0])
+
+
+def test_batcher_paged_kv_int8_end_to_end():
+    from distributed_llm_inferencing_tpu.runtime.batcher import (
+        ContinuousBatcher)
+    b = ContinuousBatcher(QCFG, PARAMS, num_blocks=64, block_size=8,
+                          slots=2, max_seq=64)
+    assert b.paged.quantized and b.paged.k.dtype == jnp.int8
+    sys_prompt = RNG.integers(0, CFG.vocab_size, 16).tolist()
+    prompts = [sys_prompt + RNG.integers(0, CFG.vocab_size, 3).tolist(),
+               sys_prompt + RNG.integers(0, CFG.vocab_size, 5).tolist()]
+    reqs = [b.submit(p, max_new_tokens=10, sampling=SamplingParams.greedy())
+            for p in prompts]
+    for _ in range(60):
+        b.step()
+        if all(r.done.is_set() for r in reqs):
+            break
+    for r in reqs:
+        assert r.error is None and len(r.wait()) == 10
+    # prefix reuse works over the quantized pool too
+    assert b.pool.stats()["prefix_hits"] >= 1
+    # quantized-vs-full trajectories stay mostly aligned (greedy, tiny model)
+    fb = ContinuousBatcher(CFG, PARAMS, num_blocks=64, block_size=8,
+                           slots=2, max_seq=64)
+    fr = fb.submit(prompts[0], max_new_tokens=10,
+                   sampling=SamplingParams.greedy())
+    for _ in range(60):
+        fb.step()
+        if fr.done.is_set():
+            break
+    shared = sum(1 for a, c in zip(fr.wait(), reqs[0].wait()) if a == c)
+    assert shared >= 5, (fr.tokens, reqs[0].tokens)
+
+
+def test_paged_decode_step_kv_int8_matches_dense():
+    """Stepwise paged decode over an int8 pool vs the int8 DENSE cache:
+    the same quantization scheme on both sides should land on the same
+    greedy tokens for a short trajectory."""
+    paged = init_paged_cache(QCFG, 16, 8)
+    prompt = RNG.integers(0, CFG.vocab_size, 9).tolist()
+    # paged admission via prefill tail (no prefix)
+    toks = np.zeros((1, 16), np.int32)
+    toks[0, :9] = prompt
+    last, paged = jax.jit(
+        transformer.paged_prefill_tail, static_argnums=(1,))(
+        PARAMS, QCFG, jnp.asarray(toks), jnp.asarray([9], jnp.int32),
+        jnp.asarray([1, 2], jnp.int32), jnp.zeros((1, 1), jnp.int32),
+        jnp.asarray([0], jnp.int32), paged)
+    bt = np.zeros((1, 4), np.int32)
+    bt[0, :2] = [1, 2]
+    cur = int(jnp.argmax(last[0]))
+    out_paged = [cur]
+    cl = 9
+    for _ in range(5):
+        logits, paged = jax.jit(
+            transformer.paged_decode_step, static_argnums=(1,))(
+            PARAMS, QCFG, jnp.asarray([cur], jnp.int32), paged,
+            jnp.asarray(bt), jnp.asarray([cl], jnp.int32))
+        cur = int(jnp.argmax(logits[0]))
+        out_paged.append(cur)
+        cl += 1
+
+    cache = init_cache(QCFG, 1, 32)
+    logits, cache = transformer.prefill(
+        PARAMS, QCFG, jnp.asarray([prompt], jnp.int32),
+        jnp.asarray([9], jnp.int32), cache)
+    cur = int(jnp.argmax(logits[0, 8]))
+    out_dense = [cur]
+    for _ in range(5):
+        logits, cache = transformer.decode_step(
+            PARAMS, QCFG, jnp.asarray([[cur]], jnp.int32), cache)
+        cur = int(jnp.argmax(logits[0, 0]))
+        out_dense.append(cur)
+    assert out_paged == out_dense

@@ -1,0 +1,129 @@
+"""Speculative decoding (ops/speculative.py + engine integration).
+
+The contract is output EQUIVALENCE: greedy speculative decode must be
+bit-identical to plain greedy decode (acceptance keeps exactly the tokens
+argmax would have produced), and sampling mode must preserve the target
+distribution (delta-draft leave-one-out rejection). Speed is asserted
+only structurally — fewer dispatched steps than emitted tokens on a
+draft-friendly (repetitive) input.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_llm_inferencing_tpu.models.params import init_params
+from distributed_llm_inferencing_tpu.models.registry import get_config
+from distributed_llm_inferencing_tpu.ops.sampling import SamplingParams
+from distributed_llm_inferencing_tpu.ops.speculative import propose_ngram
+from distributed_llm_inferencing_tpu.runtime.engine import InferenceEngine
+
+CFG = get_config("tiny-llama").replace(dtype="float32", attn_backend="xla")
+PARAMS = init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
+RNG = np.random.default_rng(0)
+
+
+def test_propose_ngram():
+    hist = [1, 2, 3, 4, 9, 9, 1, 2]
+    # trailing bigram (1,2) occurred at 0 -> continuation 3, 4, 9...
+    assert propose_ngram(hist, 3) == [3, 4, 9]
+    # continuation shorter than gamma -> padded with its last token
+    assert propose_ngram([5, 6, 7, 5, 6], 4) == [7, 5, 6, 6]
+    assert propose_ngram([1, 2, 3], 4) is None          # no earlier hit
+    assert propose_ngram([1, 2], 4) is None             # too short
+
+
+def _engine():
+    return InferenceEngine(CFG, PARAMS, max_seq=128)
+
+
+def test_greedy_speculative_matches_plain_repetitive():
+    """Repetitive prompt = high draft acceptance; output must still be
+    bit-identical to plain greedy decode."""
+    pattern = RNG.integers(0, CFG.vocab_size, 5).tolist()
+    prompt = (pattern * 4)[:18]
+    eng = _engine()
+    plain = eng.generate([prompt], max_new_tokens=24,
+                         sampling=SamplingParams.greedy())
+    spec = eng.generate([prompt], max_new_tokens=24,
+                        sampling=SamplingParams.greedy(),
+                        speculative="ngram", spec_gamma=4)
+    assert spec.tokens[0] == plain.tokens[0]
+
+
+def test_greedy_speculative_matches_plain_random():
+    """Random prompt = few/no draft hits; correctness must not depend on
+    acceptance rate."""
+    prompt = RNG.integers(0, CFG.vocab_size, 13).tolist()
+    eng = _engine()
+    plain = eng.generate([prompt], max_new_tokens=16,
+                         sampling=SamplingParams.greedy())
+    spec = eng.generate([prompt], max_new_tokens=16,
+                        sampling=SamplingParams.greedy(),
+                        speculative="ngram", spec_gamma=3)
+    assert spec.tokens[0] == plain.tokens[0]
+
+
+def test_speculative_fewer_steps_on_acceptance():
+    """Tiny random-init models repeat themselves under greedy decode, so
+    the n-gram draft should land accepts — fewer verify dispatches than
+    tokens. (Structural speed proxy; wall-clock is hardware-dependent.)"""
+    pattern = RNG.integers(0, CFG.vocab_size, 4).tolist()
+    prompt = (pattern * 5)[:19]
+    eng = _engine()
+    spec = eng.generate([prompt], max_new_tokens=30,
+                        sampling=SamplingParams.greedy(),
+                        speculative="ngram", spec_gamma=4)
+    assert len(spec.tokens[0]) == 30
+    assert spec.steps < 30, spec.steps
+
+
+def test_speculative_eos_and_seeding():
+    prompt = RNG.integers(0, CFG.vocab_size, 9).tolist()
+    eng = _engine()
+    full = eng.generate([prompt], max_new_tokens=12,
+                        sampling=SamplingParams.greedy(),
+                        speculative="ngram").tokens[0]
+    eos = full[5]
+    want = full[:5] if eos not in full[:5] else None
+    got = eng.generate([prompt], max_new_tokens=12,
+                       sampling=SamplingParams.greedy(),
+                       speculative="ngram", eos_token_id=eos).tokens[0]
+    if want is not None:
+        assert got == want
+    assert eos not in got
+    # sampling mode: deterministic given the seed
+    sp = SamplingParams(temperature=0.9, top_k=40, top_p=0.9)
+    a = eng.generate([prompt], max_new_tokens=15, sampling=sp, seed=7,
+                     speculative="ngram").tokens[0]
+    b = eng.generate([prompt], max_new_tokens=15, sampling=sp, seed=7,
+                     speculative="ngram").tokens[0]
+    assert a == b and len(a) == 15
+
+
+def test_speculative_sampling_distribution_preserved():
+    """Delta-draft rejection must keep the target distribution: with a
+    sharply peaked next-token distribution and an adversarial draft, the
+    emitted first token's empirical frequencies must match plain decode's
+    across seeds."""
+    prompt = (RNG.integers(0, CFG.vocab_size, 4).tolist() * 5)[:18]
+    eng = _engine()
+    sp = SamplingParams(temperature=1.2, top_k=8, top_p=0.95)
+    plain_counts: dict = {}
+    spec_counts: dict = {}
+    n = 120
+    for seed in range(n):
+        p = eng.generate([prompt], max_new_tokens=2, sampling=sp,
+                         seed=seed).tokens[0]
+        s = eng.generate([prompt], max_new_tokens=2, sampling=sp, seed=seed,
+                         speculative="ngram", spec_gamma=2).tokens[0]
+        # token 0 comes from the same prefill+sample path in both modes —
+        # compare token 1, the first speculative-verified position
+        plain_counts[p[1]] = plain_counts.get(p[1], 0) + 1
+        spec_counts[s[1]] = spec_counts.get(s[1], 0) + 1
+    support = set(plain_counts) | set(spec_counts)
+    tv = sum(abs(plain_counts.get(t, 0) - spec_counts.get(t, 0))
+             for t in support) / (2 * n)
+    # total-variation distance between the two empirical distributions;
+    # ~sqrt(k/n) noise floor — generous bound catches real skew
+    assert tv < 0.25, (tv, plain_counts, spec_counts)
