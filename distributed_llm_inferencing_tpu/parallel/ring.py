@@ -22,8 +22,12 @@ lives). Decode with sp > 1 runs ``ring_attend_decode`` — the
 flash-decoding formulation: with a single query token there is nothing to
 pipeline around a ring, so each device reduces its own cache shard to an
 online-softmax partial (m, l, o) and ONE pmax+psum combine over sp merges
-them. Per step that moves O(B·H·hd) bytes over ICI instead of the
-gather-the-world pattern GSPMD picks for the dense formulation.
+them — O(B·H·hd) bytes over ICI per step. Measured caveat
+(benchmarks/ring_decode_bench.py): at the scales a virtual CPU mesh can
+host, GSPMD's partitioner finds an equivalent combine-of-partials plan
+for the dense formulation too (collective-traffic parity, bit-identical
+output) — the explicit path's value is *guaranteeing* that communication
+shape where GSPMD's heuristic choice is scale- and layout-dependent.
 
 Masking travels with the data: each K/V block carries its absolute
 positions and a validity bitmap, so causality, ragged batch lengths and

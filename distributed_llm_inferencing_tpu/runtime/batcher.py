@@ -128,10 +128,13 @@ class ContinuousBatcher:
     # Decode-chunk sizes (tokens per dispatched program), tried in order.
     # Each step picks the largest chunk some active slot can fill; per-slot
     # budget/eos masks handle slots that finish mid-chunk. Mirrors the
-    # engine's DECODE_CHUNKS trade (runtime/engine.py): bigger chunks
-    # amortize dispatch RTT, at the cost of chunk-granularity admission/
-    # cancellation latency.
-    DECODE_CHUNKS = (64, 32, 16, 8, 4, 2, 1)
+    # engine's DECODE_CHUNKS trade (one shared schedule — a tuning there
+    # is a tuning here): bigger chunks amortize dispatch RTT, at the cost
+    # of chunk-granularity admission/cancellation latency.
+    from distributed_llm_inferencing_tpu.runtime.engine import (
+        InferenceEngine as _Eng)
+    DECODE_CHUNKS = _Eng.DECODE_CHUNKS
+    del _Eng
     # A dispatch round trip costs ~10-15 decode steps of compute on a
     # tunnel-attached chip, so rounding the chunk UP past the largest
     # remaining budget (budget masks make overshoot steps dead compute)

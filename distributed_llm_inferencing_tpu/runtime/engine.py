@@ -134,7 +134,16 @@ class InferenceEngine:
     # per sampling config and the host syncs once per chunk, not per token
     # (the per-token dispatch+transfer pattern is what made the reference's
     # serving loop unshippable on an accelerator behind a network hop).
-    DECODE_CHUNKS = (32, 8, 1)
+    # Powers of two keep the greedy cover tight: 63 remaining = 6 chunks,
+    # which is what bounds per-chunk syncs on the streaming/eos path (the
+    # non-streaming path queues every chunk and syncs once regardless).
+    # The continuous batcher reuses this schedule (runtime/batcher.py).
+    DECODE_CHUNKS = (64, 32, 16, 8, 4, 2, 1)
+    # The incremental (streaming / eos-early-exit) path syncs and emits
+    # only at chunk boundaries, and a chunk that straddles eos is wasted
+    # compute — cap its chunk size so burst latency and eos overshoot
+    # stay bounded while the fire-and-forget path uses the full 64.
+    STREAM_CHUNK_MAX = 32
 
     def _decode_jitted(self, sp: SamplingParams, T: int):
         # per-instance cache (an lru_cache on the method would pin the
@@ -170,7 +179,9 @@ class InferenceEngine:
                 return toks, cur, cache, key   # toks: [T, B]
 
             fn = jax.jit(raw, donate_argnums=(2,))
-            if len(self._decode_fns) >= 24:
+            # cap scaled to the chunk schedule: ~8 sampling configs' worth
+            # of compiled programs before FIFO eviction
+            if len(self._decode_fns) >= 8 * len(self.DECODE_CHUNKS):
                 self._decode_fns.pop(next(iter(self._decode_fns)))
             self._decode_fns[(sp, T)] = fn
         return fn
@@ -293,7 +304,8 @@ class InferenceEngine:
                     stream_cb(0, [int(cur[i]) for i in range(n_real)])
 
                 while remaining > 0 and not all(done):
-                    T = next(c for c in self.DECODE_CHUNKS if c <= remaining)
+                    T = next(c for c in self.DECODE_CHUNKS
+                             if c <= min(remaining, self.STREAM_CHUNK_MAX))
                     decode = self._decode_jitted(sp, T)
                     toks_dev, cur, cache, key = decode(self.params, cur, cache, key)
                     toks = np.asarray(toks_dev)    # [T, B] — one sync per chunk
@@ -335,7 +347,7 @@ class InferenceEngine:
                                                drafts, key, sp)
 
             fn = jax.jit(raw, donate_argnums=(1,))
-            if len(self._decode_fns) >= 24:
+            if len(self._decode_fns) >= 8 * len(self.DECODE_CHUNKS):
                 self._decode_fns.pop(next(iter(self._decode_fns)))
             self._decode_fns[("spec", sp, g)] = fn
         return fn

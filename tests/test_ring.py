@@ -171,3 +171,24 @@ def test_sp_tp_decode_trajectory_matches_dense():
     got = sp_eng.generate([prompt], max_new_tokens=12, sampling=g)
     ref = ref_eng.generate([prompt], max_new_tokens=12, sampling=g)
     assert got.tokens == ref.tokens
+
+
+def test_ring_decode_bench_harness_runs():
+    """The perf-evidence harness (benchmarks/ring_decode_bench.py) stays
+    runnable and its two formulations stay numerically aligned."""
+    import json
+    import os
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "benchmarks",
+                                      "ring_decode_bench.py"), "256", "2"],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    line = json.loads(r.stdout.strip().splitlines()[-1])
+    assert line["seq_len"] == 256 and line["sp"] == 2
+    assert line["max_abs_diff"] < 1e-4
+    assert line["ring_collective_bytes"] > 0
