@@ -106,7 +106,8 @@ def main(seq_len: int = 32768, sp: int = 8):
                 total += n * dt_bytes.get(dt, 4)
             # tuple-shaped collectives: count their tuple elements too
             for m in re.finditer(
-                    r"=\s+\(([^)]+)\)\s+(?:all-gather|all-reduce)\(", txt):
+                    r"=\s+\(([^)]+)\)\s+(?:all-gather|all-reduce|"
+                    r"collective-permute|reduce-scatter|all-to-all)\(", txt):
                 for el in m.group(1).split(", "):
                     em = re.match(r"(\w+)\[([\d,]*)\]", el.strip())
                     if em:

@@ -97,6 +97,12 @@ def main(argv=None):
     g.add_argument("--mesh", default="")
     g.add_argument("--allow_random_init", action="store_true")
     g.add_argument("--greedy", action="store_true")
+    g.add_argument("--speculative", choices=["ngram"], default=None,
+                   help="prompt-lookup speculative decoding "
+                        "(ops/speculative.py; distribution-preserving)")
+    g.add_argument("--spec_gamma", type=int, default=4)
+    g.add_argument("--quantize", choices=["int8"], default=None)
+    g.add_argument("--kv_quantize", choices=["int8"], default=None)
 
     args = ap.parse_args(argv)
 
@@ -234,6 +240,10 @@ def _generate(args):
         cfg, params = get_config(args.model_name), None
     else:
         sys.exit("need --checkpoint_path or --allow_random_init")
+    if args.quantize:
+        cfg = cfg.replace(quant=args.quantize)
+    if args.kv_quantize:
+        cfg = cfg.replace(kv_quant=args.kv_quantize)
     mesh = MeshSpec.from_dict(
         dict(kv.split("=") for kv in args.mesh.split(",") if kv))
     eng = InferenceEngine(cfg, params, mesh_spec=mesh)
@@ -241,7 +251,9 @@ def _generate(args):
     sp = SamplingParams.greedy() if args.greedy else SamplingParams()
     res = eng.generate([tok.encode(args.prompt)],
                        max_new_tokens=args.max_new_tokens, sampling=sp,
-                       eos_token_id=tok.eos_token_id)
+                       eos_token_id=tok.eos_token_id,
+                       speculative=args.speculative,
+                       spec_gamma=args.spec_gamma)
     print(tok.decode(res.tokens[0]))
     print(f"[prefill {res.prefill_ms:.0f}ms, "
           f"decode {res.decode_tokens_per_s:.1f} tok/s]", file=sys.stderr)

@@ -268,7 +268,7 @@ class ContinuousBatcher:
             "tokens_out": self._tokens_out,
             "block_size": self.block_size,
             "blocks_free": self.pool.free_count(),
-            "chunk_sizes": sorted(self._decode_fns),
+            "chunk_sizes": sorted({k for (k, _, _) in self._decode_fns}),
             "pool": self.pool.stats(),
         }
 

@@ -238,6 +238,15 @@ def test_elastic_recovery_after_follower_restart(slice2_nodist):
     lst = requests.get(url + "/lockstep/status", timeout=30).json()
     assert not lst["degraded"]
 
+    # operator escape hatch: recover is a no-op when healthy unless forced
+    r = requests.post(url + "/lockstep/recover", json={}, timeout=60).json()
+    assert "nothing to recover" in r["message"]
+    r = requests.post(url + "/lockstep/recover", json={"force": True},
+                      timeout=300).json()
+    assert r["status"] == "success" and r["epoch"] > fst["epoch"], r
+    got2 = requests.post(url + "/inference", json=body, timeout=300).json()
+    assert got2["tokens"] == want["tokens"]
+
 
 def test_batched_serving_on_multihost(slice2):
     """Round-2: batched serving spans the slice — the tp=2 mesh covers
