@@ -427,8 +427,11 @@ class InferenceEngine:
                         break
                 out.extend(kept)
                 history.extend(kept)
-                if stream_cb and kept:
-                    stream_cb(steps, kept)
+                if stream_cb:
+                    # same contract as the plain path: one call per token,
+                    # payload = that step's tokens per sequence ([t] here)
+                    for j, t in enumerate(kept):
+                        stream_cb(len(out) - len(kept) + j, [t])
             t2 = time.perf_counter()
 
         return GenerateResult(tokens=[out], prefill_ms=(t1 - t0) * 1e3,

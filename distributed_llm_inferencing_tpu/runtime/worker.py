@@ -298,22 +298,34 @@ class WorkerAgent:
         else:
             max_new = max(1, int(body.get("max_length", 100)) - len(prompt))
         spec = body.get("speculative")
+        try:
+            gamma = int(body.get("spec_gamma", 4))
+        except (TypeError, ValueError):
+            raise ValueError("spec_gamma must be an integer")
         if spec is not None:
             if spec != "ngram":
                 raise ValueError(f"unknown speculative mode {spec!r} "
                                  "(supported: 'ngram')")
-            if not 1 <= int(body.get("spec_gamma", 4)) <= 16:
+            if not 1 <= gamma <= 16:
                 raise ValueError("spec_gamma must be in [1, 16]")
             if m.batcher is not None:
                 raise ValueError(
                     "speculative decoding is engine-mode only; this model "
                     "serves via the continuous batcher")
-        return m, prompt, sp, max_new
+        # single source of generate() kwargs: every serving path (blocking,
+        # SSE, lockstep co-execution) passes these verbatim, so they can
+        # never silently disagree about a request's decode configuration
+        gen_kw = {
+            "seed": int(body.get("seed", time.time_ns() % (1 << 31))),
+            "speculative": spec,
+            "spec_gamma": gamma,
+        }
+        return m, prompt, sp, max_new, gen_kw
 
     def inference(self, body):
         t0 = time.time()
         try:
-            m, prompt, sp, max_new = self._prep_inference(body)
+            m, prompt, sp, max_new, gen_kw = self._prep_inference(body)
         except (KeyError, ValueError) as e:
             return 400, {"status": "error", "message": str(e)}
         if m.batcher is not None:
@@ -353,13 +365,7 @@ class WorkerAgent:
             with self.metrics.time("inference"), m.lock:
                 res = m.engine.generate(
                     [prompt], max_new_tokens=max_new, sampling=sp,
-                    seed=int(body.get("seed", time.time_ns() % (1 << 31))),
-                    eos_token_id=m.tokenizer.eos_token_id,
-                    # prompt-lookup speculative decoding
-                    # (ops/speculative.py): per-request opt-in,
-                    # output-distribution-preserving
-                    speculative=body.get("speculative"),
-                    spec_gamma=int(body.get("spec_gamma", 4)))
+                    eos_token_id=m.tokenizer.eos_token_id, **gen_kw)
         except ValueError as e:   # request-shape errors (e.g. context
             # window exceeded incl. the speculative gamma margin) are the
             # caller's fault, not a server fault
@@ -390,7 +396,7 @@ class WorkerAgent:
 
         def run():
             try:
-                m, prompt, sp, max_new = self._prep_inference(body)
+                m, prompt, sp, max_new, gen_kw = self._prep_inference(body)
                 if m.batcher is not None:
                     raise ValueError(
                         "engine_stream_events is for engine-mode models")
@@ -404,10 +410,8 @@ class WorkerAgent:
                 with m.lock:
                     res = m.engine.generate(
                         [prompt], max_new_tokens=max_new, sampling=sp,
-                        seed=int(body.get("seed",
-                                          time.time_ns() % (1 << 31))),
                         eos_token_id=m.tokenizer.eos_token_id,
-                        stream_cb=cb)
+                        stream_cb=cb, **gen_kw)
                 q.put({"event": "done",
                        "result": m.tokenizer.decode(res.tokens[0]),
                        "tokens_per_s": res.decode_tokens_per_s})
@@ -433,7 +437,7 @@ class WorkerAgent:
             # validate up front so bad requests get a proper 400, matching
             # /inference; execution still re-preps inside the stream thread
             # (the lockstep leader relies on in-slot prep)
-            m, _, _, _ = self._prep_inference(body)
+            m, _, _, _, _ = self._prep_inference(body)
         except (KeyError, ValueError) as e:
             return 400, {"status": "error", "message": str(e)}
         if m.batcher is None:
@@ -456,7 +460,7 @@ class WorkerAgent:
                     step[0] += 1
 
                 try:
-                    _, prompt, sp, max_new = self._prep_inference(body)
+                    _, prompt, sp, max_new, _gk = self._prep_inference(body)
                     req = m.batcher.submit(
                         prompt, max_new_tokens=max_new, sampling=sp,
                         eos_token_id=m.tokenizer.eos_token_id, stream_cb=cb,

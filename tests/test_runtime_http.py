@@ -390,3 +390,29 @@ def test_dashboard_pages_surface_serving_internals(master):
     assert "Placement Plans" in nodes and "/api/plans" in nodes
     inf = requests.get(_url(mport, "/inference")).text
     assert "Run Inference" in inf
+
+
+def test_worker_streaming_speculative(worker):
+    """SSE streaming with speculative decoding on: every token arrives as
+    its own event (chunk-verified tokens are re-serialized per token) and
+    the stream matches the non-streaming result."""
+    _, wport = worker
+    requests.post(_url(wport, "/load_model"), json={
+        "model_name": "tiny-gpt2", "allow_random_init": True,
+        "dtype": "float32", "max_seq": 128})
+    body = {"model_name": "tiny-gpt2", "prompt_tokens": [7, 3] * 6,
+            "max_new_tokens": 18, "sampling": {"do_sample": False},
+            "speculative": "ngram", "spec_gamma": 4}
+    import json as _json
+    with requests.post(_url(wport, "/inference_stream"), json=body,
+                       stream=True, timeout=300) as r:
+        assert r.status_code == 200
+        events = [_json.loads(l[6:]) for l in r.iter_lines()
+                  if l.startswith(b"data: ")]
+    toks = [e["token"] for e in events if e["event"] == "token"]
+    assert events[-1]["event"] == "done"
+    plain = requests.post(_url(wport, "/inference"), json=body,
+                          timeout=300).json()
+    assert toks == plain["tokens"] and len(toks) == 18
+    requests.post(_url(wport, "/unload_model"),
+                  json={"model_name": "tiny-gpt2"})
