@@ -23,6 +23,9 @@ Extra keys (best-effort; omitted rather than fatal when they fail):
   gpt2_xl_int8_tokens_per_s    — 1.5B model, int8 weight-only, batch 1
   llama_3_8b_int8_tokens_per_s — the north-star model (BASELINE.md config
                                  2), int8 weight-only, batch 1, one chip
+  llama_3_8b_int4_tokens_per_s — same model, nibble-packed int4 via the
+                                 pallas fused-unpack kernel
+                                 (ops/pallas/quant_matmul.py)
   llama_3_8b_int8_batched_tokens_per_s — 8 concurrent streams
   batched_* — 8 concurrent gpt2 requests through the continuous batcher
               (runtime/batcher.py), with TTFT/latency percentiles
@@ -336,6 +339,22 @@ def run_all(platform, degraded):
                   file=sys.stderr)
         except Exception as e:
             print(f"llama-3-8b batched bench skipped: {e!r}", file=sys.stderr)
+        _reclaim()
+        try:
+            if _over_budget("llama-3-8b int4"):
+                raise RuntimeError("budget")
+            # int4 nibble-packed weights through the pallas fused-unpack
+            # kernel (ops/pallas/quant_matmul.py): halves the 8B weight
+            # stream again — the decode roofline doubles
+            l4, l4b = bench_engine("llama-3-8b", quant="int4",
+                                   new_tokens=32, repeats=2)
+            result["llama_3_8b_int4_tokens_per_s"] = round(l4, 2)
+            if bw:
+                result["llama_3_8b_int4_hbm_bw_util"] = round(
+                    l4b * l4 / bw, 3)
+            print(f"llama-3-8b int4: {l4:.2f} tok/s", file=sys.stderr)
+        except Exception as e:
+            print(f"llama-3-8b int4 bench skipped: {e!r}", file=sys.stderr)
         _reclaim()
         try:
             # BASELINE.md config 3: Mistral-7B (sliding-window attn),

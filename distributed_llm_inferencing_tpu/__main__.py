@@ -13,6 +13,8 @@ import json
 import os
 import sys
 
+from distributed_llm_inferencing_tpu.ops.quant import MODES as quant_modes
+
 
 def main(argv=None):
     ap = argparse.ArgumentParser(
@@ -86,8 +88,8 @@ def main(argv=None):
     c.add_argument("--allow_random_init", action="store_true")
     c.add_argument("--out", required=True)
     c.add_argument("--dtype")
-    c.add_argument("--quantize", choices=["int8"],
-                   help="store int8 weight-only quantized weights")
+    c.add_argument("--quantize", choices=list(quant_modes),
+                   help="store weight-only quantized weights (ops/quant.py)")
 
     g = sub.add_parser("generate", help="one-shot local generation")
     g.add_argument("--model_name", default="gpt2")
@@ -101,7 +103,7 @@ def main(argv=None):
                    help="prompt-lookup speculative decoding "
                         "(ops/speculative.py; distribution-preserving)")
     g.add_argument("--spec_gamma", type=int, default=4)
-    g.add_argument("--quantize", choices=["int8"], default=None)
+    g.add_argument("--quantize", choices=list(quant_modes), default=None)
     g.add_argument("--kv_quantize", choices=["int8"], default=None)
 
     args = ap.parse_args(argv)

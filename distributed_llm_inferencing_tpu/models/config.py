@@ -61,8 +61,10 @@ class ModelConfig:
 
     # Numerics
     dtype: str = "bfloat16"  # activation/weight dtype on device
-    # Weight-only quantization (ops/quant.py): None | "int8". Halves the
-    # HBM weight traffic of decode and doubles fit-per-chip.
+    # Weight-only quantization (ops/quant.py): None | "int8" | "int4".
+    # int8 halves the HBM weight traffic of decode and doubles
+    # fit-per-chip at negligible accuracy cost; int4 (nibble-packed)
+    # halves it again — the throughput mode, measurably lossier.
     quant: Optional[str] = None
     # KV-cache quantization: None | "int8" (per-token-per-head symmetric
     # scales, ops/kvcache.py quant_kv). Halves cache traffic/footprint —

@@ -23,13 +23,22 @@ def init_params(cfg: ModelConfig, key, dtype=None):
         return (jax.random.normal(next(keys), shape, jnp.float32) * scale).astype(dtype)
 
     def w_q(shape, scale=0.02):
-        # cfg.quant="int8": emit the linear weight ALREADY quantized —
-        # random int8 levels with the per-output-channel scale a real
-        # quantized checkpoint would carry (ops/quant.py schema). Peak
-        # memory is the int8 model itself; init-bf16-then-quantize would
-        # transiently need 2x, which for the 8B flagship exceeds one
-        # chip's HBM. Values are random either way — identical layout,
-        # dtypes and compute to a converted int8 checkpoint.
+        # cfg.quant="int8"/"int4": emit the linear weight ALREADY
+        # quantized — random quant levels with the per-output-channel
+        # scale a real quantized checkpoint would carry (ops/quant.py
+        # schema). Peak memory is the quantized model itself;
+        # init-bf16-then-quantize would transiently need 2-4x, which for
+        # the 8B flagship exceeds one chip's HBM. Values are random
+        # either way — identical layout, dtypes and compute to a
+        # converted quantized checkpoint.
+        if cfg.quant == "int4":
+            assert shape[-2] % 2 == 0, (
+                f"int4 packing needs even din, got {shape[-2]}")
+            packed = jax.random.randint(
+                next(keys), shape[:-2] + (shape[-2] // 2, shape[-1]),
+                0, 256, jnp.int32).astype(jnp.uint8)
+            return {"p4": packed, "scale": jnp.full(
+                shape[:-2] + shape[-1:], scale / 7.0, jnp.float32)}
         q = jax.random.randint(next(keys), shape, -127, 128, jnp.int8)
         return {"q": q, "scale": jnp.full(shape[:-2] + shape[-1:],
                                           scale / 127.0, jnp.float32)}
@@ -46,16 +55,16 @@ def init_params(cfg: ModelConfig, key, dtype=None):
             p["bias"] = zeros((L, D))
         return p
 
-    quant8 = cfg.quant == "int8"
+    quantized = cfg.quant in ("int8", "int4")
 
     def lin(din, dout, bias):
-        p = w_q((L, din, dout)) if quant8 else {"w": w((L, din, dout))}
+        p = w_q((L, din, dout)) if quantized else {"w": w((L, din, dout))}
         if bias:
             p["b"] = zeros((L, dout))
         return p
 
     def ew(shape):
-        return w_q(shape) if quant8 else {"w": w(shape)}
+        return w_q(shape) if quantized else {"w": w(shape)}
 
     layers = {
         "attn_norm": norm_p(),

@@ -51,11 +51,29 @@ from distributed_llm_inferencing_tpu.ops.norms import norm
 from distributed_llm_inferencing_tpu.ops.rope import apply_rope
 
 
+def _qw(p, dt):
+    """Quantized weight as compute-dtype levels (scale still pending).
+    int8 reads stay int8 in HBM (XLA fuses the convert into the dot);
+    int4 via this path materializes the unpack — only the pallas kernel
+    keeps the read 4-bit (ops/pallas/quant_matmul.py), so this is the
+    fallback for shapes/platforms the kernel doesn't cover."""
+    if "p4" in p:
+        from distributed_llm_inferencing_tpu.ops.quant import unpack_int4
+        return unpack_int4(p["p4"]).astype(dt)
+    return p["q"].astype(dt)
+
+
 def _linear(x, p):
+    if "p4" in p:   # int4 weight-only: pallas fused-unpack kernel on the
+        # decode path, XLA unpack elsewhere (ops/pallas/quant_matmul.py)
+        from distributed_llm_inferencing_tpu.ops.pallas.quant_matmul import (
+            q4_linear)
+        return q4_linear(x, p)
     if "q" in p:   # int8 weight-only (ops/quant.py): per-out-channel scale
         # commutes with the contraction, so it applies to the [.., dout]
-        # output — the MXU reads int8 weights, no dequantized temporary
-        y = jnp.einsum("...d,df->...f", x, p["q"].astype(x.dtype))
+        # output — the MXU reads the quantized levels, no dequantized
+        # temporary
+        y = jnp.einsum("...d,df->...f", x, _qw(p, x.dtype))
         y = y * p["scale"].astype(x.dtype)
     else:
         y = jnp.einsum("...d,df->...f", x, p["w"])
@@ -81,9 +99,9 @@ def _mlp(x, lp, cfg: ModelConfig):
 
 
 def _ew(operand, p, eq):
-    """Expert einsum with optional int8 weights (scale on output)."""
-    if "q" in p:
-        y = jnp.einsum(eq, operand, p["q"].astype(operand.dtype))
+    """Expert einsum with optional int8/int4 weights (scale on output)."""
+    if "q" in p or "p4" in p:
+        y = jnp.einsum(eq, operand, _qw(p, operand.dtype))
         return y * p["scale"].astype(operand.dtype)
     return jnp.einsum(eq, operand, p["w"])
 

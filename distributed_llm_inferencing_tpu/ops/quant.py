@@ -1,14 +1,18 @@
-"""Weight-only int8 quantization (per-output-channel symmetric).
+"""Weight-only int8 / int4 quantization (per-output-channel symmetric).
 
 Decode is HBM-bandwidth-bound: every step streams the full weight set
 through the MXU. Storing matmul weights as int8 halves that traffic vs
-bf16 — and doubles the model size that fits one chip. Activations stay
-bf16; accuracy cost of per-channel weight-only int8 is negligible for
-serving (the standard vLLM/TGI weight-only trade).
+bf16 — and doubles the model size that fits one chip. int4 halves it
+again (the 8B flagship drops to ~4.3 GB of weights). Activations stay
+bf16; per-channel weight-only int8 is accuracy-negligible for serving
+(the standard vLLM/TGI weight-only trade); int4 round-to-nearest is the
+throughput mode — measurably lossier per layer, so int8 stays the
+accuracy-conservative default.
 
 Scheme: for a weight ``w [..., din, dout]``, ``scale[..., dout] =
-max|w|/127`` over din, ``q = round(w / scale)``. Because the scale is
-per *output* channel it commutes with the contraction:
+max|w|/levels`` over din (levels = 127 or 7), ``q = round(w / scale)``.
+Because the scale is per *output* channel it commutes with the
+contraction:
 
     y = x @ (q * scale) == (x @ q) * scale
 
@@ -16,10 +20,23 @@ so the kernel runs ``x_bf16 @ q->bf16`` (int8 reads, MXU-native
 convert) and applies one cheap [dout] multiply on the output — no
 weight-sized dequantized temporary ever exists.
 
+int4 storage: this JAX build cannot carry ``jnp.int4`` arrays across a
+jit boundary, so nibbles are packed two-per-byte along din in a uint8
+array, split-half biased (pack_int4 below). The decode-speed win comes
+from the pallas kernel in ops/pallas/quant_matmul.py — XLA itself
+cannot fuse any unpack formulation into a dot-operand read (every
+variant measured on the v5e materializes the bf16 weights first and
+lands 2-5x SLOWER than int8), so the XLA unpack here is only the
+portability/prefill fallback. Group-wise scales (the AWQ/GPTQ accuracy
+trick) were measured too but turn the flat GEMV into a batched one that
+XLA schedules ~2x slower at decode batch sizes, so per-channel it is.
+
 A quantized leaf is ``{"q": int8[..., din, dout], "scale":
+f32[..., dout]}`` or ``{"p4": uint8[..., din//2, dout], "scale":
 f32[..., dout]}`` (+"b" unchanged); models/transformer.py's ``_linear``
-and ``_moe`` dispatch on the presence of "q". No reference counterpart
-at any level (SURVEY.md §2.5 — its compute was vendored torch/CUDA).
+and ``_moe`` dispatch on the presence of "q"/"p4". No reference
+counterpart at any level (SURVEY.md §2.5 — its compute was vendored
+torch/CUDA).
 """
 
 from __future__ import annotations
@@ -29,6 +46,8 @@ import jax.numpy as jnp
 
 # leaves quantized under params["layers"] / params root
 _LINEAR_LEAVES = ("q", "k", "v", "o", "up", "gate", "down")
+
+MODES = ("int8", "int4")
 
 
 def quantize_weight(w) -> dict:
@@ -40,28 +59,60 @@ def quantize_weight(w) -> dict:
     return {"q": q.astype(jnp.int8), "scale": scale}
 
 
+def pack_int4(q) -> jax.Array:
+    """int8 nibbles [..., din, dout] (values in [-8,7]) -> uint8
+    [..., din//2, dout], split-half biased: byte row i holds din row i
+    (+8, low nibble) and din row i + din//2 (+8, high nibble). Split-half
+    (not pairwise-interleaved) so unpacking is a concat — and the pallas
+    kernel (ops/pallas/quant_matmul.py) needs no unpack reorder at all:
+    each nibble plane dots against its own half of x."""
+    din = q.shape[-2]
+    assert din % 2 == 0, f"int4 packing needs even din, got {din}"
+    u = (q + 8).astype(jnp.uint8)                      # biased nibble 0..15
+    lo, hi = u[..., : din // 2, :], u[..., din // 2:, :]
+    return lo | (hi << 4)
+
+
+def unpack_int4(p4) -> jax.Array:
+    """uint8 [..., din//2, dout] -> sign-extended int8 [..., din, dout]."""
+    lo = (p4 & 0xF).astype(jnp.int8) - 8
+    hi = ((p4 >> 4) & 0xF).astype(jnp.int8) - 8
+    return jnp.concatenate([lo, hi], axis=-2)
+
+
+def quantize_weight_int4(w) -> dict:
+    """w [..., din, dout] -> {"p4": packed uint8, "scale": f32 [..., dout]}."""
+    w32 = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(w32), axis=-2)              # [..., dout]
+    scale = jnp.maximum(amax, 1e-8) / 7.0
+    q = jnp.clip(jnp.round(w32 / scale[..., None, :]), -7, 7).astype(jnp.int8)
+    return {"p4": pack_int4(q), "scale": scale}
+
+
 def is_quantized(p: dict) -> bool:
-    return isinstance(p, dict) and "q" in p
+    return isinstance(p, dict) and ("q" in p or "p4" in p)
 
 
-def _quant_linear(p: dict, donate: bool) -> dict:
+def _quant_linear(p: dict, donate: bool, mode: str = "int8") -> dict:
     if is_quantized(p) or "w" not in p:
         return p
+    quantize = quantize_weight if mode == "int8" else quantize_weight_int4
     if donate:
-        # free each float leaf as soon as its int8 twin exists: peak extra
-        # memory is one stacked weight, not a whole second model
+        # free each float leaf as soon as its quantized twin exists: peak
+        # extra memory is one stacked weight, not a whole second model
         w = p.pop("w")
-        q = quantize_weight(w)
+        q = quantize(w)
         del w
         p.update(q)
         return p
     out = dict(p)
     w = out.pop("w")
-    out.update(quantize_weight(w))
+    out.update(quantize(w))
     return out
 
 
-def quantize_params(params, cfg, donate: bool = False) -> dict:
+def quantize_params(params, cfg, donate: bool = False,
+                    mode: str = "int8") -> dict:
     """Quantize the big matmul weights of a transformer param pytree.
 
     Covered: per-layer q/k/v/o, MLP up/gate/down, MoE expert weights, and
@@ -80,14 +131,15 @@ def quantize_params(params, cfg, donate: bool = False) -> dict:
     layers = params["layers"]
     for name in _LINEAR_LEAVES:
         if name in layers:
-            layers[name] = _quant_linear(layers[name], donate)
+            layers[name] = _quant_linear(layers[name], donate, mode)
     if "experts" in layers:
         if not donate:
             layers["experts"] = dict(layers["experts"])
         for k in layers["experts"]:
-            layers["experts"][k] = _quant_linear(layers["experts"][k], donate)
+            layers["experts"][k] = _quant_linear(
+                layers["experts"][k], donate, mode)
     if "lm_head" in params:
-        params["lm_head"] = _quant_linear(params["lm_head"], donate)
+        params["lm_head"] = _quant_linear(params["lm_head"], donate, mode)
     return params
 
 
@@ -95,11 +147,14 @@ def maybe_quantize(params, cfg, donate: bool = False):
     """Apply cfg.quant to a (possibly already quantized) param tree."""
     if cfg.quant is None:
         return params
-    if cfg.quant != "int8":
-        raise ValueError(f"unknown quant mode {cfg.quant!r}")
-    return quantize_params(params, cfg, donate=donate)
+    if cfg.quant not in MODES:
+        raise ValueError(f"unknown quant mode {cfg.quant!r}; known: {MODES}")
+    return quantize_params(params, cfg, donate=donate, mode=cfg.quant)
 
 
 def dequantize_weight(p: dict):
     """Materialize the float weight (tests / conversion tooling)."""
+    if "p4" in p:
+        return unpack_int4(p["p4"]).astype(jnp.float32) \
+            * p["scale"][..., None, :]
     return p["q"].astype(jnp.float32) * p["scale"][..., None, :]

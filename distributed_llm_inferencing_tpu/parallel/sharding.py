@@ -48,11 +48,19 @@ def param_specs(cfg: ModelConfig, spec: MeshSpec,
         return p
 
     def lin(spec: P) -> Dict[str, Any]:
-        """Leaf specs for a linear weight; int8 quant (ops/quant.py) adds
-        a per-out-channel scale sharded like the weight's last axis."""
+        """Leaf specs for a linear weight; int8/int4 quant (ops/quant.py)
+        adds a per-out-channel scale sharded like the weight's last axis.
+        The packed-int4 leaf reuses the int8 spec (same rank, din axis
+        just halved). NB split-half packing means a din-sharded packed
+        leaf does NOT unpack to a contiguous din range per shard — that
+        is fine under GSPMD, which executes the unpack (concat of the
+        nibble planes, ops/quant.py unpack_int4) with whatever resharding
+        the einsum needs; the pallas kernel never runs inside GSPMD
+        programs (ops/pallas/quant_matmul.py supported())."""
         if not cfg.quant:
             return {"w": spec}
-        return {"q": spec, "scale": P(*(spec[:-2] + spec[-1:]))}
+        key = "p4" if cfg.quant == "int4" else "q"
+        return {key: spec, "scale": P(*(spec[:-2] + spec[-1:]))}
 
     layers: Dict[str, Any] = {
         "attn_norm": norm_p(),
