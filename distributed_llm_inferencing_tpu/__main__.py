@@ -13,7 +13,10 @@ import json
 import os
 import sys
 
-from distributed_llm_inferencing_tpu.ops.quant import MODES as quant_modes
+# Mirrors ops/quant.py MODES — kept literal so jax-free subcommands
+# (master, admin, --help) never import jax just to build the parser;
+# tests/test_quant.py asserts the two stay in sync.
+quant_modes = ("int8", "int4")
 
 
 def main(argv=None):
@@ -90,6 +93,9 @@ def main(argv=None):
     c.add_argument("--dtype")
     c.add_argument("--quantize", choices=list(quant_modes),
                    help="store weight-only quantized weights (ops/quant.py)")
+    c.add_argument("--embed_quantize", choices=["int8"], default=None,
+                   help="per-row int8 token-embedding table "
+                        "(halves the tied-head read and table footprint)")
 
     g = sub.add_parser("generate", help="one-shot local generation")
     g.add_argument("--model_name", default="gpt2")
@@ -104,6 +110,7 @@ def main(argv=None):
                         "(ops/speculative.py; distribution-preserving)")
     g.add_argument("--spec_gamma", type=int, default=4)
     g.add_argument("--quantize", choices=list(quant_modes), default=None)
+    g.add_argument("--embed_quantize", choices=["int8"], default=None)
     g.add_argument("--kv_quantize", choices=["int8"], default=None)
 
     args = ap.parse_args(argv)
@@ -163,7 +170,7 @@ def main(argv=None):
         if args.checkpoint_path:
             cfg = checkpoint.convert_hf_to_native(
                 args.checkpoint_path, args.out, dtype=args.dtype,
-                quantize=args.quantize)
+                quantize=args.quantize, embed_quantize=args.embed_quantize)
         elif args.allow_random_init and args.model_name:
             import jax
             from distributed_llm_inferencing_tpu.models.params import init_params
@@ -173,6 +180,8 @@ def main(argv=None):
                 cfg = cfg.replace(dtype=args.dtype)
             if args.quantize:
                 cfg = cfg.replace(quant=args.quantize)
+            if args.embed_quantize:
+                cfg = cfg.replace(embed_quant=args.embed_quantize)
             checkpoint.save_checkpoint(
                 args.out, cfg, init_params(cfg, jax.random.PRNGKey(0)))
         else:
@@ -244,6 +253,8 @@ def _generate(args):
         sys.exit("need --checkpoint_path or --allow_random_init")
     if args.quantize:
         cfg = cfg.replace(quant=args.quantize)
+    if args.embed_quantize:
+        cfg = cfg.replace(embed_quant=args.embed_quantize)
     if args.kv_quantize:
         cfg = cfg.replace(kv_quant=args.kv_quantize)
     mesh = MeshSpec.from_dict(

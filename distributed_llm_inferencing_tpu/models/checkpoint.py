@@ -96,7 +96,8 @@ def load_checkpoint(path: str, *, mesh=None, mesh_spec=None,
 
 def convert_hf_to_native(hf_path: str, out_path: str,
                          dtype: Optional[str] = None,
-                         quantize: Optional[str] = None) -> ModelConfig:
+                         quantize: Optional[str] = None,
+                         embed_quantize: Optional[str] = None) -> ModelConfig:
     """One-shot HF → native conversion (the ``convert`` CLI verb).
 
     After this, serving never touches torch/transformers for weights again
@@ -116,6 +117,11 @@ def convert_hf_to_native(hf_path: str, out_path: str,
         from distributed_llm_inferencing_tpu.ops.quant import maybe_quantize
         cfg = cfg.replace(quant=quantize)
         params = maybe_quantize(params, cfg)
+    if embed_quantize:
+        from distributed_llm_inferencing_tpu.ops.quant import (
+            maybe_quantize_embed)
+        cfg = cfg.replace(embed_quant=embed_quantize)
+        params = maybe_quantize_embed(params, cfg)
     save_checkpoint(out_path, cfg, params)
     # carry the tokenizer along so the native dir is self-contained (the
     # worker falls back to byte-level tokenization without one)

@@ -66,6 +66,12 @@ class ModelConfig:
     # fit-per-chip at negligible accuracy cost; int4 (nibble-packed)
     # halves it again — the throughput mode, measurably lossier.
     quant: Optional[str] = None
+    # Token-embedding-table quantization: None | "int8" (per-row scales,
+    # ops/quant.py quantize_embed). The tied-head lever: gpt2-family
+    # unembed streams the whole [V, D] table per decode step, and
+    # llama's table is ~1 GB bf16 of footprint. Opt-in separately from
+    # ``quant`` because embeddings are the most accuracy-sensitive table.
+    embed_quant: Optional[str] = None
     # KV-cache quantization: None | "int8" (per-token-per-head symmetric
     # scales, ops/kvcache.py quant_kv). Halves cache traffic/footprint —
     # the long-context decode lever on top of weight int8. Attention

@@ -89,8 +89,19 @@ def init_params(cfg: ModelConfig, key, dtype=None):
         layers["down"] = lin(I, D, cfg.mlp_bias)
 
     E = cfg.embed_proj_dim or D
+
+    def embed_table():
+        if cfg.embed_quant == "int8":
+            # direct-to-int8 table (ops/quant.py quantize_embed schema):
+            # same reasoning as w_q — never materialize the float table
+            q = jax.random.randint(next(keys), (cfg.vocab_size, E),
+                                   -127, 128, jnp.int8)
+            return {"q8": q, "rscale": jnp.full((cfg.vocab_size,),
+                                                0.02 / 127.0, jnp.float32)}
+        return w((cfg.vocab_size, E))
+
     params = {
-        "embed": {"tokens": w((cfg.vocab_size, E))},
+        "embed": {"tokens": embed_table()},
         "layers": layers,
     }
     if not cfg.post_norm:   # post-LN models (opt-350m) have no final norm
@@ -109,6 +120,10 @@ def init_params(cfg: ModelConfig, key, dtype=None):
         # float linear (and validates the quant mode)
         from distributed_llm_inferencing_tpu.ops.quant import maybe_quantize
         params = maybe_quantize(params, cfg)
+    if cfg.embed_quant:
+        from distributed_llm_inferencing_tpu.ops.quant import (
+            maybe_quantize_embed)
+        params = maybe_quantize_embed(params, cfg)   # validates the mode
     return params
 
 

@@ -195,7 +195,15 @@ def _moe(x, lp, cfg: ModelConfig):
 def embed(params, cfg: ModelConfig, tokens, q_positions):
     """Token (+ learned position) embedding. Shared by the scanned forward
     below and the pipelined executor (parallel/pipeline.py)."""
-    x = jnp.take(params["embed"]["tokens"], tokens, axis=0)
+    table = params["embed"]["tokens"]
+    if isinstance(table, dict):   # int8 per-row table (cfg.embed_quant):
+        # gather whole rows then one scalar multiply per row — the HBM
+        # read is s rows of int8, not the float table
+        x = jnp.take(table["q8"], tokens, axis=0).astype(jnp.dtype(cfg.dtype))
+        x = x * jnp.take(table["rscale"], tokens,
+                         axis=0)[..., None].astype(x.dtype)
+    else:
+        x = jnp.take(table, tokens, axis=0)
     x = x.astype(jnp.dtype(cfg.dtype))
     if "project_in" in params["embed"]:   # opt-350m: embed dim < hidden dim
         x = _linear(x, params["embed"]["project_in"])
@@ -222,8 +230,15 @@ def unembed(params, cfg: ModelConfig, x):
     if "project_out" in params["embed"]:
         x = _linear(x, params["embed"]["project_out"])
     if cfg.tie_word_embeddings:
-        logits = jnp.einsum("bsd,vd->bsv", x,
-                            params["embed"]["tokens"].astype(x.dtype))
+        table = params["embed"]["tokens"]
+        if isinstance(table, dict):   # int8 table (cfg.embed_quant): the
+            # per-row scale is a per-output(vocab)-channel scale here, so
+            # it commutes out of the dot — the tied-head read stays int8
+            logits = jnp.einsum("bsd,vd->bsv", x,
+                                table["q8"].astype(x.dtype))
+            logits = logits * table["rscale"].astype(x.dtype)
+        else:
+            logits = jnp.einsum("bsd,vd->bsv", x, table.astype(x.dtype))
     else:
         logits = _linear(x, params["lm_head"])
     return logits.astype(jnp.float32)

@@ -158,3 +158,51 @@ def dequantize_weight(p: dict):
         return unpack_int4(p["p4"]).astype(jnp.float32) \
             * p["scale"][..., None, :]
     return p["q"].astype(jnp.float32) * p["scale"][..., None, :]
+
+
+# ----------------------------------------------------------------------
+# Embedding-table quantization (cfg.embed_quant)
+# ----------------------------------------------------------------------
+#
+# The tied-head models (gpt2 family; reference default, inference.html:22)
+# pay the single largest per-token read OUTSIDE the layer stack at the
+# unembed: [V, D] bf16 streams every decode step (gpt2-xl: 161 MB/token —
+# comparable to several transformer layers). Per-ROW symmetric int8 works
+# for BOTH uses of the table:
+#   - unembed contracts d: row scale == per-output(vocab)-channel scale,
+#     which commutes out of the dot exactly like the linear case above;
+#   - the embedding gather takes whole rows: dequant is one scalar
+#     multiply per gathered row.
+# Kept separate from cfg.quant because embeddings are the most
+# sensitivity-prone table and the win is model-family dependent (untied
+# heads already quantize via lm_head) — opt-in via cfg.embed_quant.
+
+
+def quantize_embed(emb) -> dict:
+    """emb [V, D] -> {"q8": int8 [V, D], "rscale": f32 [V]} (per-row)."""
+    w32 = emb.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(w32), axis=-1)              # [V]
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w32 / scale[..., None]), -127, 127)
+    return {"q8": q.astype(jnp.int8), "rscale": scale}
+
+
+def dequantize_embed(p: dict):
+    return p["q8"].astype(jnp.float32) * p["rscale"][..., None]
+
+
+def maybe_quantize_embed(params, cfg, donate: bool = False) -> dict:
+    """Apply cfg.embed_quant to the token-embedding table. Idempotent."""
+    if cfg.embed_quant is None:
+        return params
+    if cfg.embed_quant != "int8":
+        raise ValueError(
+            f"unknown embed_quant mode {cfg.embed_quant!r}; known: ('int8',)")
+    tokens = params["embed"]["tokens"]
+    if isinstance(tokens, dict):                       # already quantized
+        return params
+    if not donate:
+        params = dict(params)
+        params["embed"] = dict(params["embed"])
+    params["embed"]["tokens"] = quantize_embed(tokens)
+    return params

@@ -92,7 +92,10 @@ def param_specs(cfg: ModelConfig, spec: MeshSpec,
             layers["down"]["b"] = P(L, None)
 
     specs = {
-        "embed": {"tokens": P("tp", None)},
+        # int8 embed table (cfg.embed_quant): vocab-sharded like the
+        # float table, per-row scales follow the vocab axis
+        "embed": {"tokens": {"q8": P("tp", None), "rscale": P("tp")}
+                  if cfg.embed_quant else P("tp", None)},
         "layers": layers,
     }
     if not cfg.post_norm:

@@ -92,8 +92,9 @@ class InferenceEngine:
         if params is None:
             params = init_params(cfg, jax.random.PRNGKey(seed))
         else:
-            from distributed_llm_inferencing_tpu.ops.quant import maybe_quantize
-            params = maybe_quantize(params, cfg)
+            from distributed_llm_inferencing_tpu.ops.quant import (
+                maybe_quantize, maybe_quantize_embed)
+            params = maybe_quantize_embed(maybe_quantize(params, cfg), cfg)
         with self.mesh:
             self.params = shd.shard_params(params, self.mesh, cfg, self.mesh_spec)
 

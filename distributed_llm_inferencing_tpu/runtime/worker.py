@@ -202,6 +202,14 @@ class WorkerAgent:
                 from distributed_llm_inferencing_tpu.ops.quant import (
                     maybe_quantize)
                 params = maybe_quantize(params, cfg, donate=True)
+        if body.get("embed_quantize"):
+            # per-row int8 token-embedding table (ops/quant.py): the
+            # tied-head read and the table footprint both halve
+            cfg = cfg.replace(embed_quant=body["embed_quantize"])
+            if params is not None:
+                from distributed_llm_inferencing_tpu.ops.quant import (
+                    maybe_quantize_embed)
+                params = maybe_quantize_embed(params, cfg, donate=True)
         from distributed_llm_inferencing_tpu.utils.tokenizer import has_tokenizer
         tok_dir = body.get("tokenizer_path") or next(
             (d for d in (ckpt, native) if has_tokenizer(d)), None)
