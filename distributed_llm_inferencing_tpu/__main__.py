@@ -260,7 +260,10 @@ def _generate(args):
     mesh = MeshSpec.from_dict(
         dict(kv.split("=") for kv in args.mesh.split(",") if kv))
     eng = InferenceEngine(cfg, params, mesh_spec=mesh)
-    tok = load_tokenizer(args.checkpoint_path, cfg.vocab_size)
+    from distributed_llm_inferencing_tpu.utils.tokenizer import has_tokenizer
+    tok = load_tokenizer(
+        args.checkpoint_path if has_tokenizer(args.checkpoint_path) else None,
+        cfg.vocab_size)   # weights-only dirs fall back to byte-level
     sp = SamplingParams.greedy() if args.greedy else SamplingParams()
     res = eng.generate([tok.encode(args.prompt)],
                        max_new_tokens=args.max_new_tokens, sampling=sp,

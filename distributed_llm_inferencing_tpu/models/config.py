@@ -38,9 +38,20 @@ class ModelConfig:
     position_embedding: str = "learned"  # learned | rope
     rope_theta: float = 10000.0
     attn_bias: bool = True
+    # Qwen2-style asymmetric attention bias: q/k/v carry bias, the output
+    # projection does not. None => o follows attn_bias.
+    o_bias: Optional[bool] = None
     mlp_bias: bool = True
     tie_word_embeddings: bool = True
     sliding_window: Optional[int] = None  # Mistral-style local attention
+    # Gemma-style sqrt(hidden_size) embedding normalizer, applied to the
+    # embedding OUTPUT only (the tied head reads the raw table).
+    embed_scale: Optional[float] = None
+    # Gemma's RMSNorm convention is (1 + w) * x̂. Conversion absorbs the
+    # +1 into the stored scale (models/convert.py) so the runtime norm
+    # stays plain; this flag only drives that conversion step (and
+    # random-init's ones() is already the absorbed identity).
+    norm_offset: bool = False
     # OPT-350m specifics (reference's second arch family, shard_model.py:46):
     # token embeds live in a smaller space with linear project_in/out...
     embed_proj_dim: Optional[int] = None
@@ -100,6 +111,10 @@ class ModelConfig:
     @property
     def is_moe(self) -> bool:
         return self.num_experts > 0
+
+    @property
+    def o_bias_effective(self) -> bool:
+        return self.attn_bias if self.o_bias is None else self.o_bias
 
     def replace(self, **kw) -> "ModelConfig":
         return dataclasses.replace(self, **kw)

@@ -32,6 +32,25 @@ def _np(t):
     return np.asarray(t, dtype=np.float32)
 
 
+def _qwen2_window(hf_config):
+    """Qwen2 windows only layers >= max_window_layers (HF
+    configuration_qwen2.py) — a per-layer mix our global
+    cfg.sliding_window cannot represent, so accept only the two shapes
+    that map exactly and refuse the rest loudly (silently windowing the
+    full-attention layers would corrupt long-prompt logits)."""
+    if not getattr(hf_config, "use_sliding_window", False):
+        return None
+    mwl = getattr(hf_config, "max_window_layers", 0) or 0
+    if mwl > 0 and mwl < hf_config.num_hidden_layers:
+        raise NotImplementedError(
+            f"qwen2 with use_sliding_window and 0 < max_window_layers="
+            f"{mwl} < num_layers={hf_config.num_hidden_layers}: mixed "
+            "full/windowed layers are not supported")
+    if mwl >= hf_config.num_hidden_layers:
+        return None                       # every layer is full-attention
+    return hf_config.sliding_window       # every layer is windowed
+
+
 def config_from_hf(hf_config) -> ModelConfig:
     mt = hf_config.model_type
     if mt == "gpt2":
@@ -64,7 +83,10 @@ def config_from_hf(hf_config) -> ModelConfig:
             norm_type="layernorm", activation="relu", gated_mlp=False,
             position_embedding="learned", attn_bias=True, mlp_bias=True,
             tie_word_embeddings=True)
-    if mt in ("llama", "mistral", "mixtral"):
+    if mt in ("llama", "mistral", "mixtral", "qwen2", "gemma"):
+        # All share the llama layer layout (model.layers.N.self_attn.*,
+        # mlp gate/up/down, input/post_attention layernorms), so one
+        # conversion family covers them; the deltas are config switches.
         num_experts = getattr(hf_config, "num_local_experts", 0) if mt == "mixtral" else 0
         return ModelConfig(
             name=getattr(hf_config, "name_or_path", mt) or mt,
@@ -79,14 +101,28 @@ def config_from_hf(hf_config) -> ModelConfig:
             or hf_config.hidden_size // hf_config.num_attention_heads,
             max_position_embeddings=hf_config.max_position_embeddings,
             norm_type="rmsnorm", norm_eps=hf_config.rms_norm_eps,
-            activation="silu", gated_mlp=True, position_embedding="rope",
+            # gemma: gelu_pytorch_tanh == our default tanh-gelu
+            activation="gelu" if mt == "gemma" else "silu",
+            gated_mlp=True, position_embedding="rope",
             rope_theta=getattr(hf_config, "rope_theta", 10000.0),
-            attn_bias=getattr(hf_config, "attention_bias", False),
+            # qwen2: bias on q/k/v only (baked into the HF module, not a
+            # config attr), o_proj bias-free
+            attn_bias=(True if mt == "qwen2"
+                       else getattr(hf_config, "attention_bias", False)),
+            o_bias=False if mt == "qwen2" else None,
             mlp_bias=getattr(hf_config, "mlp_bias", False),
-            tie_word_embeddings=getattr(hf_config, "tie_word_embeddings", False),
-            sliding_window=getattr(hf_config, "sliding_window", None),
+            tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
+                                        mt == "gemma"),
+            # qwen2 carries sliding_window=4096 in its config but only
+            # APPLIES it when use_sliding_window is set (HF default off)
+            sliding_window=_qwen2_window(hf_config) if mt == "qwen2"
+            else getattr(hf_config, "sliding_window", None),
             num_experts=num_experts,
-            num_experts_per_tok=getattr(hf_config, "num_experts_per_tok", 2))
+            num_experts_per_tok=getattr(hf_config, "num_experts_per_tok", 2),
+            # gemma: sqrt(D) embedding normalizer + (1+w) norm convention
+            embed_scale=(hf_config.hidden_size ** 0.5 if mt == "gemma"
+                         else None),
+            norm_offset=mt == "gemma")
     raise NotImplementedError(f"unsupported HF model_type {mt!r}")
 
 
@@ -174,6 +210,11 @@ def convert_state_dict(cfg: ModelConfig, sd, dtype=None):
             params["embed"]["project_out"] = {
                 "w": get("model.decoder.project_out.weight").T}
     elif fam == "llama":
+        # gemma stores rmsnorm weights in the (1 + w) convention; absorb
+        # the offset here so the runtime norm stays plain (config.py
+        # norm_offset)
+        off = 1.0 if cfg.norm_offset else 0.0
+
         def layer(i):
             p = f"model.layers.{i}."
             def lin(n):
@@ -182,12 +223,12 @@ def convert_state_dict(cfg: ModelConfig, sd, dtype=None):
                     out["b"] = get(p + n + ".bias")
                 return out
             lp = {
-                "attn_norm": {"scale": get(p + "input_layernorm.weight")},
+                "attn_norm": {"scale": get(p + "input_layernorm.weight") + off},
                 "q": lin("self_attn.q_proj"),
                 "k": lin("self_attn.k_proj"),
                 "v": lin("self_attn.v_proj"),
                 "o": lin("self_attn.o_proj"),
-                "mlp_norm": {"scale": get(p + "post_attention_layernorm.weight")},
+                "mlp_norm": {"scale": get(p + "post_attention_layernorm.weight") + off},
             }
             if cfg.is_moe:
                 lp["router"] = {"w": get(p + "block_sparse_moe.gate.weight").T}
@@ -205,7 +246,7 @@ def convert_state_dict(cfg: ModelConfig, sd, dtype=None):
         params = {
             "embed": {"tokens": get("model.embed_tokens.weight")},
             "layers": _stack([layer(i) for i in range(cfg.num_layers)]),
-            "final_norm": {"scale": get("model.norm.weight")},
+            "final_norm": {"scale": get("model.norm.weight") + off},
         }
         if not cfg.tie_word_embeddings:
             params["lm_head"] = {"w": get("lm_head.weight").T}

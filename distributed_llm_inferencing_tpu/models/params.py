@@ -71,7 +71,7 @@ def init_params(cfg: ModelConfig, key, dtype=None):
         "q": lin(D, cfg.q_dim, cfg.attn_bias),
         "k": lin(D, cfg.kv_dim, cfg.attn_bias),
         "v": lin(D, cfg.kv_dim, cfg.attn_bias),
-        "o": lin(cfg.q_dim, D, cfg.attn_bias),
+        "o": lin(cfg.q_dim, D, cfg.o_bias_effective),
         "mlp_norm": norm_p(),
     }
     if cfg.is_moe:

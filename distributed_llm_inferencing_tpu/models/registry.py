@@ -98,6 +98,28 @@ register(_llama("mixtral-8x7b", 4096, 14336, 32, 32, 8, vocab=32000,
                 ctx=32768, theta=1000000.0).replace(
                     name="mixtral-8x7b", num_experts=8, num_experts_per_tok=2))
 
+# --- Qwen2: llama layout + bias on q/k/v only (models/convert.py) ---
+register(_llama("qwen2-7b", 3584, 18944, 28, 28, 4, vocab=152064,
+                ctx=32768, theta=1000000.0).replace(
+                    name="qwen2-7b", attn_bias=True, o_bias=False))
+register(_llama("qwen2-0.5b", 896, 4864, 24, 14, 2, vocab=151936,
+                ctx=32768, theta=1000000.0).replace(
+                    name="qwen2-0.5b", attn_bias=True, o_bias=False,
+                    tie_word_embeddings=True))
+
+# --- Gemma: llama layout + tanh-gelu, sqrt(D) embed normalizer, wide
+# head_dim (256 > hidden/heads), tied 256k-vocab head ---
+register(_llama("gemma-7b", 3072, 24576, 28, 16, 16, vocab=256000,
+                ctx=8192, theta=10000.0).replace(
+                    name="gemma-7b", head_dim=256, activation="gelu",
+                    tie_word_embeddings=True, embed_scale=3072 ** 0.5,
+                    norm_eps=1e-6, norm_offset=True))
+register(_llama("gemma-2b", 2048, 16384, 18, 8, 1, vocab=256000,
+                ctx=8192, theta=10000.0).replace(
+                    name="gemma-2b", head_dim=256, activation="gelu",
+                    tie_word_embeddings=True, embed_scale=2048 ** 0.5,
+                    norm_eps=1e-6, norm_offset=True))
+
 # --- Tiny configs for tests/dryrun (not real checkpoints) ---
 register(ModelConfig(
     name="tiny-gpt2", family="gpt2", vocab_size=256, hidden_size=64,

@@ -205,6 +205,9 @@ def embed(params, cfg: ModelConfig, tokens, q_positions):
     else:
         x = jnp.take(table, tokens, axis=0)
     x = x.astype(jnp.dtype(cfg.dtype))
+    if cfg.embed_scale is not None:   # gemma: sqrt(D) normalizer on the
+        # embedding output only — the tied head reads the raw table
+        x = x * jnp.asarray(cfg.embed_scale, x.dtype)
     if "project_in" in params["embed"]:   # opt-350m: embed dim < hidden dim
         x = _linear(x, params["embed"]["project_in"])
     if cfg.position_embedding == "learned":

@@ -74,6 +74,7 @@ def param_specs(cfg: ModelConfig, spec: MeshSpec,
         layers["q"]["b"] = P(L, "tp")
         layers["k"]["b"] = P(L, kv_tp)
         layers["v"]["b"] = P(L, kv_tp)
+    if cfg.o_bias_effective:
         layers["o"]["b"] = P(L, None)
     if cfg.is_moe:
         layers["router"] = {"w": P(L, None, None)}

@@ -186,3 +186,103 @@ def test_ragged_prefill_matches_unpadded():
     sole_b = _logits_ours(cfg, params, b)
     np.testing.assert_allclose(np.asarray(logits)[0, :9], sole_a[0], atol=1e-4, rtol=1e-4)
     np.testing.assert_allclose(np.asarray(logits)[1, :5], sole_b[0], atol=1e-4, rtol=1e-4)
+
+
+def test_qwen2_matches_hf():
+    """Qwen2: llama layout + bias on q/k/v only (o_proj bias-free)."""
+    import transformers
+    torch_cfg = transformers.Qwen2Config(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rope_theta=10000.0,
+        tie_word_embeddings=False, use_sliding_window=False)
+    import torch
+    torch.manual_seed(8)
+    model = transformers.Qwen2ForCausalLM(torch_cfg).eval()
+    cfg, _ = convert.load_hf_model(model, dtype=jnp.float32)
+    assert cfg.attn_bias and cfg.o_bias is False
+    assert cfg.sliding_window is None   # declared but not applied by HF
+    rng = np.random.default_rng(8)
+    tokens = rng.integers(0, 128, size=(2, 10), dtype=np.int64)
+    _check_model(model, tokens)
+
+
+def test_gemma_matches_hf():
+    """Gemma: (1+w) rmsnorm (absorbed at conversion), sqrt(D) embedding
+    normalizer, tanh-gelu gated MLP, head_dim > hidden/heads, tied head."""
+    import transformers
+    torch_cfg = transformers.GemmaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=1,
+        head_dim=16, max_position_embeddings=64, rope_theta=10000.0,
+        hidden_activation="gelu_pytorch_tanh")
+    import torch
+    torch.manual_seed(9)
+    model = transformers.GemmaForCausalLM(torch_cfg).eval()
+    cfg, _ = convert.load_hf_model(model, dtype=jnp.float32)
+    assert cfg.tie_word_embeddings and cfg.norm_offset
+    assert cfg.head_dim == 16 and cfg.embed_scale == 32 ** 0.5
+    rng = np.random.default_rng(9)
+    tokens = rng.integers(0, 128, size=(2, 10), dtype=np.int64)
+    _check_model(model, tokens)
+
+
+def test_gemma_decode_matches_hf_generate():
+    """Greedy decode parity for the gemma deltas (embed scale must apply
+    on the decode path too, and the MQA cache must round-trip)."""
+    import torch
+    import transformers
+    torch_cfg = transformers.GemmaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=1,
+        head_dim=16, max_position_embeddings=64,
+        hidden_activation="gelu_pytorch_tanh")
+    torch.manual_seed(10)
+    model = transformers.GemmaForCausalLM(torch_cfg).eval()
+    cfg, params = convert.load_hf_model(model, dtype=jnp.float32)
+    cfg = cfg.replace(dtype="float32")
+
+    rng = np.random.default_rng(10)
+    prompt = rng.integers(4, 128, size=(1, 6), dtype=np.int64)
+    with torch.no_grad():
+        want = model.generate(
+            torch.tensor(prompt), max_new_tokens=8, do_sample=False,
+            pad_token_id=0)[0, 6:].tolist()
+
+    cache = init_cache(cfg, 1, 32, dtype=jnp.float32)
+    logits, cache = transformer.prefill(
+        params, cfg, jnp.asarray(prompt.astype(np.int32)),
+        jnp.asarray([6], jnp.int32), cache)
+    cur = int(np.argmax(np.asarray(logits)[0, 5]))
+    got = [cur]
+    for _ in range(7):
+        logits, cache = transformer.decode_step(
+            params, cfg, jnp.asarray([[cur]], jnp.int32), cache)
+        cur = int(np.argmax(np.asarray(logits)[0, 0]))
+        got.append(cur)
+    assert got == want
+
+
+def test_qwen2_mixed_window_rejected():
+    """Qwen2's layer-indexed sliding window (full attention below
+    max_window_layers) is not representable by the global
+    cfg.sliding_window — conversion must refuse, not silently window
+    every layer."""
+    import transformers
+    torch_cfg = transformers.Qwen2Config(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        use_sliding_window=True, sliding_window=8, max_window_layers=2)
+    with pytest.raises(NotImplementedError, match="max_window_layers"):
+        convert.config_from_hf(torch_cfg)
+    # ...but the two exactly-representable shapes convert
+    all_win = transformers.Qwen2Config(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        use_sliding_window=True, sliding_window=8, max_window_layers=0)
+    assert convert.config_from_hf(all_win).sliding_window == 8
+    none_win = transformers.Qwen2Config(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        use_sliding_window=True, sliding_window=8, max_window_layers=4)
+    assert convert.config_from_hf(none_win).sliding_window is None
