@@ -244,7 +244,13 @@ def _generate(args):
     from distributed_llm_inferencing_tpu.runtime.engine import InferenceEngine
     from distributed_llm_inferencing_tpu.utils.tokenizer import load_tokenizer
 
-    if args.checkpoint_path:
+    if args.checkpoint_path and os.path.isdir(
+            os.path.join(args.checkpoint_path, "params")):
+        # native (Orbax) checkpoint dir, as produced by `convert` — no
+        # torch/transformers on this path
+        from distributed_llm_inferencing_tpu.models import checkpoint
+        cfg, params = checkpoint.load_checkpoint(args.checkpoint_path)
+    elif args.checkpoint_path:
         from distributed_llm_inferencing_tpu.models.convert import load_hf_model
         cfg, params = load_hf_model(args.checkpoint_path)
     elif args.allow_random_init:

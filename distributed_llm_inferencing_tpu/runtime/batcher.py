@@ -84,6 +84,12 @@ class BatchRequest:
     _blocks: List[int] = dataclasses.field(default_factory=list)
     _preemptions: int = 0
     _cancelled: bool = False
+    # chunked-prefill progress: high-water of cached+chunk across partial
+    # passes, and how many passes failed to advance it (radix eviction
+    # between chunks can undo progress — bounded, or two pool-sized
+    # prompts could re-prefill each other's evictions forever)
+    _chunk_high: int = 0
+    _chunk_stalls: int = 0
 
     def wait(self, timeout: Optional[float] = None) -> List[int]:
         if not self.done.wait(timeout):
@@ -145,7 +151,8 @@ class ContinuousBatcher:
                  num_blocks: int = 512, block_size: int = 16,
                  slots: int = 8, max_seq: Optional[int] = None,
                  seed: int = 0, force_python_pool: bool = False,
-                 mesh_spec: Optional[MeshSpec] = None):
+                 mesh_spec: Optional[MeshSpec] = None,
+                 prefill_chunk: Optional[int] = 32):
         self.mesh_spec = mesh_spec or MeshSpec()
         for ax in ("dp", "pp", "sp"):
             if getattr(self.mesh_spec, ax) > 1:
@@ -161,6 +168,20 @@ class ContinuousBatcher:
         self.max_seq = min(max_seq or cfg.max_position_embeddings,
                            cfg.max_position_embeddings)
         self.max_blocks = -(-self.max_seq // block_size)
+        # Chunked prefill (vLLM-style): prompts whose un-cached tail
+        # exceeds this many blocks admit one chunk per step — KV lands in
+        # the radix cache, the request requeues, and the next wave's
+        # prefix match resumes exactly where the chunk ended. Bounds how
+        # long one huge prompt can stall co-running decode. None/0
+        # disables; snapped to a tail bucket so chunk programs hit the
+        # same compile cache as ordinary admissions.
+        if prefill_chunk:
+            self.prefill_chunk = next(
+                (m for m in TAIL_BUCKETS_X_BS if m >= prefill_chunk),
+                TAIL_BUCKETS_X_BS[-1])
+        else:
+            self.prefill_chunk = None
+        self._chunked_admissions = 0
         if params is None:
             params = init_params(cfg, jax.random.PRNGKey(seed))
         else:
@@ -270,6 +291,8 @@ class ContinuousBatcher:
             "block_size": self.block_size,
             "blocks_free": self.pool.free_count(),
             "chunk_sizes": sorted({k for (k, _, _) in self._decode_fns}),
+            "chunked_admissions": self._chunked_admissions,
+            "prefill_chunk": self.prefill_chunk,
             "pool": self.pool.stats(),
         }
 
@@ -433,8 +456,17 @@ class ContinuousBatcher:
         # token's logits (a fully-cached prompt would have nothing to run).
         prefix_blocks, cached = self.pool.match_prefix(prompt[:n - 1])
         tail_alloc = []
+        partial = False
         try:
             tail_len = n - cached
+            cap = (self.prefill_chunk * bs) if self.prefill_chunk else None
+            if cap is not None and tail_len > cap:
+                # chunked prefill: run only the next `cap` tokens (block
+                # aligned — `cached` is whole blocks and cap is too), so
+                # >= 1 token always remains for the sampling admission
+                partial = True
+                tail_len = cap
+                n = cached + cap
             t = self._bucket_tail(tail_len)      # may raise ValueError
             tail_alloc = self.pool.alloc(t // bs)
             if tail_alloc is None:
@@ -448,19 +480,34 @@ class ContinuousBatcher:
             self.pool.release(tail_alloc or [])
             raise
         return {"t": t, "pb": pb, "n": n, "cached": cached,
-                "tail_len": tail_len, "prompt": prompt,
+                "tail_len": tail_len, "prompt": prompt, "partial": partial,
                 "prefix_blocks": prefix_blocks, "tail_alloc": tail_alloc}
 
     def _admit_wave(self):
         """Admit queued requests into free slots as bucketed waves: one
-        batched program per (tail, prefix) bucket group."""
+        batched program per (tail, prefix) bucket group.
+
+        Chunked-prefill (partial) members need no slot — their chunk only
+        writes KV into the radix cache — so a long prompt keeps making
+        admission progress even when every decode slot is busy. One
+        partial per wave: it requeues to the front, and pulling the queue
+        past a front request that is mid-prefill would break FIFO order.
+        """
         wave: List[dict] = []
         taken: set = set()
         while True:
             free = [i for i, a in enumerate(self.active)
                     if a is None and i not in taken]
             if not free:
-                break
+                # no decode slot — only worth popping if the head could
+                # chunk-admit (needs no slot); cheap length pre-filter,
+                # the authoritative partial decision is _prep_admit's
+                cap = (self.prefill_chunk or 0) * self.block_size
+                with self._lock:
+                    head = self.queue[0] if self.queue else None
+                if (head is None or cap == 0
+                        or len(head.prompt) + len(head.tokens) - 1 <= cap):
+                    break
             with self._lock:
                 req = self.queue.popleft() if self.queue else None
             if req is None:
@@ -507,6 +554,18 @@ class ContinuousBatcher:
                         self.queue.appendleft(req)
                 break
             prep["req"] = req
+            if prep["partial"]:
+                prep["slot"] = None
+                wave.append(prep)
+                break
+            if not free:
+                # a full admission does need a slot; put the request back
+                # and run whatever the wave already holds
+                self.pool.release(prep["prefix_blocks"])
+                self.pool.release(prep["tail_alloc"])
+                with self._lock:
+                    self.queue.appendleft(req)
+                break
             prep["slot"] = free[0]
             taken.add(free[0])
             wave.append(prep)
@@ -538,7 +597,8 @@ class ContinuousBatcher:
         ds = np.zeros((b,), bool)
         for j, m in enumerate(members):
             req = m["req"]
-            toks[j, :m["tail_len"]] = m["prompt"][m["cached"]:]
+            toks[j, :m["tail_len"]] = \
+                m["prompt"][m["cached"]:m["cached"] + m["tail_len"]]
             tail_len[j] = m["tail_len"]
             tail_blocks[j, :] = m["tail_alloc"]
             pfb[j, :len(m["prefix_blocks"])] = m["prefix_blocks"]
@@ -569,7 +629,14 @@ class ContinuousBatcher:
     def _post_admit(self, m: dict, first: int):
         """Register one admitted wave member: release padding blocks, enter
         the prompt's full blocks into the radix cache, bind the slot, and
-        emit the fused-sampled first token."""
+        emit the fused-sampled first token.
+
+        Chunked-prefill members (m["partial"]) stop after the radix
+        registration: their KV now lives in the prefix cache, so the
+        request requeues (front) and the next wave's match_prefix resumes
+        one chunk further — no slot is bound and the chunk program's
+        sampled token is discarded (it isn't the prompt's last position).
+        """
         req, slot = m["req"], m["slot"]
         bs = self.block_size
         n, cached, tail_len = m["n"], m["cached"], m["tail_len"]
@@ -583,6 +650,33 @@ class ContinuousBatcher:
         if n_full > skip:
             self.pool.insert_prefix(m["prompt"][:n_full * bs],
                                     tail_real[:n_full - skip], skip)
+
+        if m.get("partial"):
+            # drop our references — the radix keeps the chunk's blocks
+            # alive (refcount-0 leaves evict only under pool pressure,
+            # in which case the re-match simply re-prefills that chunk)
+            self.pool.release(prefix_blocks)
+            self.pool.release(tail_real)
+            self._chunked_admissions += 1
+            if n > req._chunk_high:
+                req._chunk_high = n
+                req._chunk_stalls = 0
+            else:
+                # eviction between passes undid progress; bounded, or two
+                # pool-sized prompts could re-prefill each other forever
+                req._chunk_stalls += 1
+                if req._chunk_stalls > 4:
+                    req.error = ("KV block pool exhausted "
+                                 "(chunked prefill made no progress)")
+                    req.done.set()
+                    return
+            if not req._cancelled:
+                with self._lock:
+                    self.queue.appendleft(req)
+            else:
+                req.error = req.error or "cancelled"
+                req.done.set()
+            return
 
         req._blocks = prefix_blocks + tail_real
         self.block_tables[slot, :] = self._dummy

@@ -226,6 +226,10 @@ class WorkerAgent:
                 block_size=int(body.get("kv_block_size", 16)),
                 slots=int(body.get("slots", 8)),
                 max_seq=body.get("max_seq"),
+                # chunked prefill cap (blocks); 0/null disables
+                prefill_chunk=(int(body["prefill_chunk"])
+                               if body.get("prefill_chunk") is not None
+                               else None) if "prefill_chunk" in body else 32,
                 mesh_spec=mesh)
             batcher.start()
             lm = LoadedModel(None, tok, source, batcher=batcher)

@@ -133,3 +133,24 @@ def test_cli_convert_and_worker_load(tmp_path):
         assert len(resp.json()["tokens"]) == 4
     finally:
         agent.service.shutdown()
+
+
+def test_generate_cli_loads_native_checkpoint(tmp_path, capsys):
+    """`generate --checkpoint_path <native dir>` auto-detects the Orbax
+    layout (params/ subdir) and serves it without torch — same surface
+    the worker uses, now from the CLI."""
+    import jax
+    from distributed_llm_inferencing_tpu import __main__ as cli
+    from distributed_llm_inferencing_tpu.models import checkpoint
+    from distributed_llm_inferencing_tpu.models.params import init_params
+    from distributed_llm_inferencing_tpu.models.registry import get_config
+
+    cfg = get_config("tiny-llama").replace(dtype="float32")
+    checkpoint.save_checkpoint(
+        str(tmp_path / "native"), cfg,
+        init_params(cfg, jax.random.PRNGKey(0)))
+    cli.main(["--platform", "cpu", "generate",
+              "--checkpoint_path", str(tmp_path / "native"),
+              "--prompt", "ab", "--max_new_tokens", "4", "--greedy"])
+    out = capsys.readouterr().out
+    assert len(out.strip()) > 0
