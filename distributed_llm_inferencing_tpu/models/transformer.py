@@ -707,6 +707,242 @@ def _paged_decode_chunk_stepwise(params, cfg: ModelConfig, k: int, tokens,
     return toks, emits, paged
 
 
+def paged_speculative_chunk(params, cfg: ModelConfig, k: int, gamma: int,
+                            tokens, history, paged, block_tables,
+                            context_lens, seeds, steps0, temps, tks, tps,
+                            ds, budget, eos_ids, dummy_block: int):
+    """K speculative iterations on device for R serving slots: draft
+    gamma tokens per slot by on-device prompt lookup
+    (ops/speculative.py propose_ngram_device), score [cur, drafts] in one
+    forward block, and keep the prefix the target distribution agrees
+    with — up to gamma+1 tokens per slot per iteration, still one host
+    sync per chunk.
+
+    The engine's speculative path (ops/speculative.py verify_step) hands
+    drafting to the host between steps; behind a dispatch round trip that
+    forfeits the entire speedup, so here the token history rides in a
+    device buffer and drafting is a compare/gather inside the scan.
+
+    Acceptance: greedy rows (``~ds``) accept drafts matching the raw
+    argmax — output is bit-identical to plain greedy decode, only
+    faster. Sampling rows emit exactly ONE token per iteration, drawn by
+    the same ``sample_batch`` stream as the plain chunk (bit-identical
+    trajectories, no speculation speedup) — exact per-row
+    data-parameterized rejection sampling is future work, and silently
+    approximating a user's sampling distribution is not acceptable.
+
+    Cache bookkeeping (the subtle part): every iteration writes K/V for
+    all gamma+1 scored tokens into a side buffer at a STATIC offset
+    ``t*(gamma+1)`` (dynamic_update_slice — no scatters in the loop),
+    with each entry's absolute position recorded in ``side_pos``.
+    Rejected entries' positions get re-written by later iterations, so
+    validity cannot be position-derived: an ``accepted`` mask carry
+    marks entries committed at their own iteration (entry i of the
+    block is committed iff i <= n_acc — entry 0 is ``cur``, whose
+    position was already owed to the cache). Attention at iteration t
+    sees pool(< cl0) + accepted side entries + the current block
+    (causally masked); the single post-scan pool scatter writes exactly
+    the accepted entries, everything else landing in ``dummy_block``.
+
+    tokens: [R] current token per slot (emitted, not yet cached);
+    history: [R, H] all known tokens per slot (prompt + emitted; row r
+    valid to context_lens[r] + 1). Block tables must cover
+    ``context_lens + k*(gamma+1)`` growth.
+
+    Returns (toks [K, R, gamma+1], keeps [K, R], eos_seen [K, R],
+    new paged): iteration t of slot r emitted ``toks[t, r, :keeps[t,r]]``;
+    ``eos_seen`` is cumulative per row, so the host can distinguish an
+    eos death from simply running out of iterations (1 token/iteration
+    when every draft misses covers less than the chunk's token budget).
+    """
+    from distributed_llm_inferencing_tpu.ops.attention import attend
+    from distributed_llm_inferencing_tpu.ops.paged_kvcache import (
+        PagedKVCache)
+    from distributed_llm_inferencing_tpu.ops.sampling import sample_batch
+    from distributed_llm_inferencing_tpu.ops.speculative import (
+        propose_ngram_device)
+
+    r = tokens.shape[0]
+    L = cfg.num_layers
+    bs = paged.block_size
+    mb = block_tables.shape[1]
+    g1 = gamma + 1
+    E = k * g1                       # side-buffer entries per slot
+    dt = jnp.dtype(cfg.dtype)
+    quantized = paged.quantized
+    cl0 = context_lens
+    H = history.shape[1]
+
+    pool_pos = jnp.broadcast_to(jnp.arange(mb * bs, dtype=jnp.int32),
+                                (r, mb * bs))
+    pool_valid = pool_pos < cl0[:, None]
+    side0 = jnp.zeros((L, r, E, cfg.num_kv_heads, cfg.head_dim), dt)
+    entry_step = jnp.arange(E, dtype=jnp.int32) // g1               # [E]
+
+    gathered_bytes = 2 * dt.itemsize * L * r * mb * bs \
+        * cfg.num_kv_heads * cfg.head_dim
+    pre = gathered_bytes <= _PREGATHER_MAX_BYTES
+    if pre:
+        shape = (L, r, mb * bs, cfg.num_kv_heads, cfg.head_dim)
+        pool_k = paged.k[:, block_tables].reshape(shape)
+        pool_v = paged.v[:, block_tables].reshape(shape)
+        if quantized:
+            from distributed_llm_inferencing_tpu.ops.kvcache import dequant_kv
+            pool_k = dequant_kv(
+                pool_k, paged.k_scale[:, block_tables].reshape(shape[:-1]),
+                dt)
+            pool_v = dequant_kv(
+                pool_v, paged.v_scale[:, block_tables].reshape(shape[:-1]),
+                dt)
+    else:
+        from distributed_llm_inferencing_tpu.ops.paged_kvcache import (
+            gather_seq)
+        pool_k, pool_v = paged.k, paged.v   # gathered per layer in-loop
+
+    def body(carry, t):
+        (cur, hist, hist_len, side_k, side_v, side_pos, acc_mask, cl,
+         emitted, alive, eos_seen) = carry
+        qp0 = jnp.where(alive, cl, 0)
+        qp = qp0[:, None] + jnp.arange(g1, dtype=jnp.int32)[None, :]
+        drafts, _ = propose_ngram_device(hist, hist_len, gamma)
+        toks_in = jnp.concatenate([cur[:, None], drafts], axis=1)  # [R, g1]
+        x = embed(params, cfg, toks_in, qp)
+
+        side_pos = jax.lax.dynamic_update_slice(side_pos, qp, (0, t * g1))
+        is_cur_block = jnp.broadcast_to(entry_step == t, (r, E))
+        side_valid = acc_mask | is_cur_block
+
+        def layer(x, layer_in):
+            if pre:
+                lp, sk, sv, kp, vp = layer_in
+            elif quantized:
+                from distributed_llm_inferencing_tpu.ops.kvcache import (
+                    dequant_kv)
+                lp, sk, sv, ck, cv, cks, cvs = layer_in
+                kp = dequant_kv(gather_seq(ck, block_tables),
+                                gather_seq(cks, block_tables), dt)
+                vp = dequant_kv(gather_seq(cv, block_tables),
+                                gather_seq(cvs, block_tables), dt)
+            else:
+                lp, sk, sv, ck, cv = layer_in
+                kp, vp = gather_seq(ck, block_tables), gather_seq(
+                    cv, block_tables)
+
+            def attend_write(q, kh, vh):
+                sk2 = jax.lax.dynamic_update_slice(sk, kh.astype(dt),
+                                                   (0, t * g1, 0, 0))
+                sv2 = jax.lax.dynamic_update_slice(sv, vh.astype(dt),
+                                                   (0, t * g1, 0, 0))
+                attn = attend(
+                    q,
+                    jnp.concatenate([kp, sk2], axis=1),
+                    jnp.concatenate([vp, sv2], axis=1),
+                    qp,
+                    jnp.concatenate([pool_pos, side_pos], axis=1),
+                    jnp.concatenate([pool_valid, side_valid], axis=1),
+                    sliding_window=cfg.sliding_window)
+                return attn, (sk2, sv2)
+
+            x, (sk2, sv2) = _block_body(x, lp, cfg, qp, attend_write)
+            return x, (sk2, sv2)
+
+        xs = (params["layers"], side_k, side_v, pool_k, pool_v)
+        if quantized and not pre:
+            xs = xs + (paged.k_scale, paged.v_scale)
+        x2, (side_k, side_v) = jax.lax.scan(layer, x, xs)
+        logits = unembed(params, cfg, x2)                 # [R, g1, V] f32
+
+        # greedy acceptance (exact); sampling rows emit 1 token via the
+        # same per-row stream as the plain chunk
+        targets = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [R, g1]
+        acc = (drafts == targets[:, :-1]) & ~ds[:, None]
+        prefix = jnp.cumprod(acc.astype(jnp.int32), axis=1)
+        n_acc = prefix.sum(axis=1)                                # [R]
+        greedy_stop = jnp.take_along_axis(
+            targets, n_acc[:, None], axis=1)[:, 0]
+        sampled = sample_batch(logits[:, 0], seeds, steps0 + emitted,
+                               temps, tks, tps, ds)
+        stop = jnp.where(ds, sampled, greedy_stop).astype(jnp.int32)
+
+        idx = jnp.arange(g1, dtype=jnp.int32)[None, :]
+        draft_pad = jnp.concatenate(
+            [drafts, jnp.zeros((r, 1), jnp.int32)], axis=1)
+        toks_out = jnp.where(idx == n_acc[:, None], stop[:, None],
+                             draft_pad)                           # [R, g1]
+        n_emit = n_acc + 1
+
+        # eos / budget clamping
+        emit_sl = idx < n_emit[:, None]
+        is_eos = (toks_out == eos_ids[:, None]) & (eos_ids >= 0)[:, None] \
+            & emit_sl
+        eos_pos = jnp.min(jnp.where(is_eos, idx, g1), axis=1)     # [R]
+        rem = budget - emitted
+        n_keep = jnp.minimum(jnp.minimum(n_emit, eos_pos), rem)
+        n_keep = jnp.where(alive, n_keep, 0)
+        # an eos "happened" only if plain decode would have reached it
+        # inside this chunk's budget — when the budget clamp cut the run
+        # first, the slot must survive and re-derive the tail next chunk
+        hit_eos = (eos_pos < n_emit) & (eos_pos < rem)
+
+        # commit: entry i of this block is cache-valid iff i < n_keep
+        # (entry 0 = cur at position cl; kept emitted tokens cover
+        # positions cl+1..cl+n_keep-1 whose KV is entries 1..n_keep-1;
+        # the LAST kept token becomes next cur, its KV unwritten) — and
+        # for fully-kept rows entry n_acc's draft was accepted too, so
+        # commit i <= min(n_acc, n_keep-1)... conservatively i < n_keep
+        # plus entry 0 for alive rows.
+        commit = (idx < n_keep[:, None]) | ((idx == 0) & alive[:, None])
+        acc_mask = jax.lax.dynamic_update_slice(
+            acc_mask, commit, (0, t * g1))
+
+        # history append: kept tokens at h[cl+1 .. cl+n_keep]
+        rows = jnp.broadcast_to(jnp.arange(r)[:, None], (r, g1))
+        cols = jnp.where(emit_sl & (idx < n_keep[:, None]),
+                         cl[:, None] + 1 + idx, H)   # H -> dropped
+        hist = hist.at[rows, cols].set(toks_out, mode="drop")
+        hist_len = hist_len + n_keep
+
+        new_cl = cl + n_keep
+        emitted2 = emitted + n_keep
+        eos_seen2 = eos_seen | (hit_eos & alive)
+        new_alive = alive & ~hit_eos & (emitted2 < budget)
+        new_cur = jnp.where(
+            n_keep > 0,
+            jnp.take_along_axis(
+                toks_out, jnp.maximum(n_keep - 1, 0)[:, None], axis=1)[:, 0],
+            cur)
+        return ((new_cur, hist, hist_len, side_k, side_v, side_pos,
+                 acc_mask, new_cl, emitted2, new_alive, eos_seen2),
+                (toks_out, n_keep, eos_seen2))
+
+    hist_len0 = cl0 + 1
+    carry0 = (tokens, history, hist_len0, side0, side0,
+              jnp.zeros((r, E), jnp.int32), jnp.zeros((r, E), bool),
+              cl0, jnp.zeros((r,), jnp.int32), budget > 0,
+              jnp.zeros((r,), bool))
+    (_, _, _, side_k, side_v, side_pos, acc_mask, _, _, _, _), \
+        (toks, keeps, eos_seen) = jax.lax.scan(
+            body, carry0, jnp.arange(k, dtype=jnp.int32))
+
+    # single pool scatter of the accepted side entries
+    blk = jnp.take_along_axis(block_tables, side_pos // bs, axis=1)  # [R, E]
+    blk = jnp.where(acc_mask, blk, dummy_block)
+    off = side_pos % bs
+    if quantized:
+        from distributed_llm_inferencing_tpu.ops.kvcache import quant_kv
+        k8, ks = quant_kv(side_k)
+        v8, vs = quant_kv(side_v)
+        paged = PagedKVCache(
+            k=paged.k.at[:, blk, off].set(k8),
+            v=paged.v.at[:, blk, off].set(v8),
+            k_scale=paged.k_scale.at[:, blk, off].set(ks),
+            v_scale=paged.v_scale.at[:, blk, off].set(vs))
+    else:
+        paged = PagedKVCache(k=paged.k.at[:, blk, off].set(side_k),
+                             v=paged.v.at[:, blk, off].set(side_v))
+    return toks, keeps, eos_seen, paged
+
+
 def paged_prefill_tail(params, cfg: ModelConfig, tokens, tail_len,
                        tail_blocks, prefix_blocks, prefix_len, paged):
     """Prefill a WAVE of prompt tails into paged blocks, each attending its

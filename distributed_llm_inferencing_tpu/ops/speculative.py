@@ -55,6 +55,49 @@ def propose_ngram(history: Sequence[int], gamma: int,
     return None
 
 
+def propose_ngram_device(history, lengths, gamma: int, n: int = 2):
+    """Vectorized on-device prompt-lookup drafting for R slots.
+
+    The host version (propose_ngram) forces a host sync per verify step —
+    ruinous behind a dispatch round trip. This one is a compare/gather
+    over a device-resident token history, so the whole
+    draft->verify->accept loop can run inside one chunked program
+    (models/transformer.py paged_speculative_chunk).
+
+    history: [R, H] int32 (row r valid to lengths[r]); lengths: [R]
+    (number of known tokens incl. the current one). Returns
+    (drafts [R, gamma] int32, has_draft [R] bool) with semantics
+    matching propose_ngram for n == 2: the continuation of the most
+    recent earlier occurrence of the trailing bigram, right-padded by
+    the last continuation token (== the last history token, since the
+    continuation runs to the end of the history).
+    """
+    assert n == 2, "device drafting implements the serving default n=2"
+    r, h = history.shape
+    idx = jnp.arange(h, dtype=jnp.int32)[None, :]                  # [1, H]
+    last = jnp.take_along_axis(history, (lengths - 1)[:, None], axis=1)
+    prev = jnp.take_along_axis(
+        history, jnp.maximum(lengths - 2, 0)[:, None], axis=1)
+    nxt = jnp.concatenate(                                          # h[i+1]
+        [history[:, 1:], jnp.zeros((r, 1), history.dtype)], axis=1)
+    # candidate start i: h[i] == prev, h[i+1] == last; i + 2 < length
+    # covers both "continuation non-empty" and "not the trailing bigram
+    # itself" (identical constraints for n=2)
+    m = ((history == prev) & (nxt == last)
+         & (idx + 2 < lengths[:, None]) & (lengths[:, None] >= 3))
+    has = jnp.any(m, axis=1)
+    pos = jnp.max(jnp.where(m, idx, -1), axis=1)                    # [R]
+    # continuation tokens h[pos+2 .. pos+1+gamma], clamped to the last
+    # known token (identical to the host version's repeat-last padding)
+    g_idx = pos[:, None] + 2 + jnp.arange(gamma, dtype=jnp.int32)[None, :]
+    g_idx = jnp.minimum(g_idx, lengths[:, None] - 1)
+    drafts = jnp.take_along_axis(history, jnp.maximum(g_idx, 0), axis=1)
+    # no-draft rows fall back to repeating the current token (uniform
+    # program shape; a bad draft just gets rejected at verification)
+    drafts = jnp.where(has[:, None], drafts, last)
+    return drafts.astype(jnp.int32), has
+
+
 def verify_step(params, cfg: ModelConfig, cache, cur, drafts, key,
                 sp: SamplingParams):
     """Score ``[cur, drafts...]`` in one forward pass and accept the

@@ -152,7 +152,8 @@ class ContinuousBatcher:
                  slots: int = 8, max_seq: Optional[int] = None,
                  seed: int = 0, force_python_pool: bool = False,
                  mesh_spec: Optional[MeshSpec] = None,
-                 prefill_chunk: Optional[int] = 32):
+                 prefill_chunk: Optional[int] = 32,
+                 speculative: Optional[str] = None, spec_gamma: int = 4):
         self.mesh_spec = mesh_spec or MeshSpec()
         for ax in ("dp", "pp", "sp"):
             if getattr(self.mesh_spec, ax) > 1:
@@ -182,6 +183,22 @@ class ContinuousBatcher:
         else:
             self.prefill_chunk = None
         self._chunked_admissions = 0
+        # Speculative decoding (models/transformer.py
+        # paged_speculative_chunk): on-device prompt-lookup drafts, up to
+        # spec_gamma+1 tokens per slot per iteration. Greedy requests get
+        # the speedup with bit-identical output; sampling requests run
+        # one exact token per iteration (no speedup, no distribution
+        # drift).
+        if speculative not in (None, "ngram"):
+            raise ValueError(f"unknown speculative mode {speculative!r}")
+        self.speculative = speculative
+        self.spec_gamma = int(spec_gamma)
+        self._spec_accepted = 0
+        # device-drafting token history, maintained incrementally (a
+        # per-step rebuild would be O(slots * max_seq) host work on the
+        # hot path): row i holds slot i's prompt + emitted tokens
+        self._hist = (np.zeros((slots, self.max_seq + 1), np.int32)
+                      if speculative else None)
         if params is None:
             params = init_params(cfg, jax.random.PRNGKey(seed))
         else:
@@ -290,9 +307,12 @@ class ContinuousBatcher:
             "tokens_out": self._tokens_out,
             "block_size": self.block_size,
             "blocks_free": self.pool.free_count(),
-            "chunk_sizes": sorted({k for (k, _, _) in self._decode_fns}),
+            "chunk_sizes": sorted({key[0] for key in self._decode_fns
+                                   if not isinstance(key[0], str)}),
             "chunked_admissions": self._chunked_admissions,
             "prefill_chunk": self.prefill_chunk,
+            "speculative": self.speculative,
+            "spec_accepted_tokens": self._spec_accepted,
             "pool": self.pool.stats(),
         }
 
@@ -349,6 +369,30 @@ class ContinuousBatcher:
             self._decode_fns[(k, r, mb)] = fn
         return fn
 
+    def _spec_jit(self, k: int, g: int, r: int, mb: int, hh: int):
+        """K speculative verify iterations
+        (transformer.paged_speculative_chunk): up to (g+1)K tokens per
+        slot per host sync."""
+        key = ("spec", k, g, r, mb, hh)
+        fn = self._decode_fns.get(key)
+        if fn is None:
+            cfg, dummy = self.cfg, self._dummy
+
+            def chunk(p, ints, floats, paged):
+                bt = ints[:r * mb].reshape(r, mb)
+                hist = ints[r * mb:r * (mb + hh)].reshape(r, hh)
+                (tokens, cl, seeds, steps0, tks, budget, eos_ids,
+                 ds) = ints[r * (mb + hh):].reshape(8, r)
+                temps, tps = floats
+                return transformer.paged_speculative_chunk(
+                    p, cfg, k, g, tokens, hist, paged, bt, cl, seeds,
+                    steps0, temps, tks, tps, ds.astype(bool), budget,
+                    eos_ids, dummy)
+
+            fn = jax.jit(chunk, donate_argnums=(3,))
+            self._decode_fns[key] = fn
+        return fn
+
     # ---- program launch (shared by the scheduler and lockstep replay) --
 
     def _run_admit(self, a: dict) -> np.ndarray:
@@ -393,6 +437,25 @@ class ContinuousBatcher:
             # ONE host sync per K-token chunk for all slots
             return jax.device_get((toks, emits))
 
+    def _run_spec_decode(self, a: dict):
+        """Launch one speculative chunk's program. Returns (toks
+        [K, R, g+1], keeps [K, R]) as host arrays."""
+        bt = np.asarray(a["bt"], np.int32)
+        hist = np.asarray(a["hist"], np.int32)
+        r, mb = bt.shape
+        ints = np.concatenate([bt.reshape(-1), hist.reshape(-1)] + [
+            np.asarray(a[key], np.int32) for key in
+            ("tokens", "cl", "seeds", "steps", "tks", "budget", "eos", "ds")])
+        floats = np.stack([np.asarray(a["temps"], np.float32),
+                           np.asarray(a["tps"], np.float32)])
+        fn = self._spec_jit(int(a["k"]), int(a["gamma"]), r, mb,
+                            hist.shape[1])
+        with self.mesh:
+            toks, keeps, eos_seen, self.paged = fn(
+                self.params, jnp.asarray(ints), jnp.asarray(floats),
+                self.paged)
+            return jax.device_get((toks, keeps, eos_seen))
+
     def replay(self, kind: str, args: dict):
         """Re-execute a program the lockstep leader broadcast. SPMD
         correctness requires every host to launch identical programs in
@@ -402,6 +465,8 @@ class ContinuousBatcher:
             self._run_admit(args)
         elif kind == "decode":
             self._run_decode(args)
+        elif kind == "spec_decode":
+            self._run_spec_decode(args)
         else:
             raise ValueError(f"unknown batcher program kind {kind!r}")
 
@@ -685,9 +750,15 @@ class ContinuousBatcher:
         self.context_lens[slot] = n
         self.active[slot] = req
         self._admit_order.append(slot)
+        if self._hist is not None:
+            known = m["prompt"][: self.max_seq + 1]
+            self._hist[slot, : len(known)] = known
         if req.first_token_at is None:
             req.first_token_at = time.time()
         self._emit(req, first)
+        if self._hist is not None and req.tokens:
+            # the fused-sampled first token extends the history
+            self._hist[slot, min(n, self.max_seq)] = req.tokens[-1]
         if req.done.is_set() or len(req.tokens) >= req.max_new_tokens:
             self._finish_slot(slot)
 
@@ -840,6 +911,8 @@ class ContinuousBatcher:
             "tks": tks.tolist(), "tps": tps.tolist(), "ds": ds.tolist(),
             "budget": budget.tolist(), "eos": eos.tolist(),
         }
+        if self.speculative:
+            return self._step_speculative(active, decode_args)
         if self.program_hook is not None:
             toks, emits = self.program_hook(
                 "decode", decode_args, lambda: self._run_decode(decode_args))
@@ -858,6 +931,46 @@ class ContinuousBatcher:
             self.context_lens[i] += cnt
             hit_eos = cnt < int(budget[i])   # stopped before its budget
             if hit_eos or len(req.tokens) >= req.max_new_tokens:
+                self._finish_slot(i)
+        return len([a for a in self.active if a is not None])
+
+    def _step_speculative(self, active, decode_args: dict) -> int:
+        """Dispatch a speculative chunk instead of a plain decode chunk:
+        ceil(k / (gamma+1)) verify iterations cover the same token budget
+        when drafts miss, and up to (gamma+1)x fewer dispatches when they
+        hit. Block growth was already ensured for k tokens — accepted
+        cache writes never exceed the budget, and rejected scratch
+        entries scatter to the dummy block."""
+        g1 = self.spec_gamma + 1
+        k_it = -(-int(decode_args["k"]) // g1)
+        args = dict(decode_args, k=k_it, gamma=self.spec_gamma)
+        if self.program_hook is not None:
+            # the lockstep mirror ships JSON; serialize only on this path
+            args["hist"] = self._hist.tolist()
+            toks, keeps, eos_seen = self.program_hook(
+                "spec_decode", args, lambda: self._run_spec_decode(args))
+        else:
+            args["hist"] = self._hist
+            toks, keeps, eos_seen = self._run_spec_decode(args)
+        self._step_count += 1
+
+        for i in active:
+            req = self.active[i]
+            pos = int(self.context_lens[i]) + 1   # first new history slot
+            cnt = int(keeps[:, i].sum())
+            for t in range(keeps.shape[0]):
+                for tok in toks[t, i, : int(keeps[t, i])]:
+                    self._emit(req, int(tok))
+                    if pos <= self.max_seq:
+                        self._hist[i, pos] = int(tok)
+                    pos += 1
+            # speedup accounting: tokens beyond one-per-iteration
+            self._spec_accepted += cnt - int((keeps[:, i] > 0).sum())
+            self.context_lens[i] += cnt
+            # a slot may legitimately emit fewer than its budget when
+            # every draft missed (1 token/iteration) — only the device's
+            # cumulative eos flag or an exhausted budget finishes it
+            if bool(eos_seen[-1, i]) or len(req.tokens) >= req.max_new_tokens:
                 self._finish_slot(i)
         return len([a for a in self.active if a is not None])
 

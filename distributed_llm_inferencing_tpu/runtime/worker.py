@@ -230,6 +230,11 @@ class WorkerAgent:
                 prefill_chunk=(int(body["prefill_chunk"])
                                if body.get("prefill_chunk") is not None
                                else None) if "prefill_chunk" in body else 32,
+                # on-device prompt-lookup speculative decoding
+                # (transformer.paged_speculative_chunk): greedy requests
+                # get up to spec_gamma+1 tokens/iteration bit-identically
+                speculative=body.get("speculative"),
+                spec_gamma=int(body.get("spec_gamma", 4)),
                 mesh_spec=mesh)
             batcher.start()
             lm = LoadedModel(None, tok, source, batcher=batcher)

@@ -127,3 +127,261 @@ def test_speculative_sampling_distribution_preserved():
     # total-variation distance between the two empirical distributions;
     # ~sqrt(k/n) noise floor — generous bound catches real skew
     assert tv < 0.25, (tv, plain_counts, spec_counts)
+
+
+# ---------------- on-device drafting ----------------
+
+def test_propose_ngram_device_matches_host():
+    """Differential: the vectorized device proposer must agree with the
+    host propose_ngram on random histories (where the host finds a
+    draft), and report has_draft=False exactly when the host returns
+    None."""
+    import jax.numpy as jnp
+    from distributed_llm_inferencing_tpu.ops.speculative import (
+        propose_ngram, propose_ngram_device)
+    rng = np.random.default_rng(0)
+    H, R, G = 48, 16, 4
+    hist = np.zeros((R, H), np.int32)
+    lens = np.zeros((R,), np.int32)
+    rows = []
+    for r in range(R):
+        n = int(rng.integers(3, H))
+        # small vocab => plenty of repeated bigrams
+        row = rng.integers(0, 5, n).tolist()
+        rows.append(row)
+        hist[r, :n] = row
+        lens[r] = n
+    drafts, has = propose_ngram_device(
+        jnp.asarray(hist), jnp.asarray(lens), G)
+    drafts, has = np.asarray(drafts), np.asarray(has)
+    for r in range(R):
+        want = propose_ngram(rows[r], G)
+        assert has[r] == (want is not None), (r, rows[r])
+        if want is not None:
+            assert drafts[r].tolist() == want, (r, rows[r],
+                                                drafts[r].tolist(), want)
+
+
+def test_propose_ngram_device_short_histories():
+    import jax.numpy as jnp
+    from distributed_llm_inferencing_tpu.ops.speculative import (
+        propose_ngram_device)
+    hist = jnp.asarray([[7, 0, 0, 0], [7, 7, 0, 0]], jnp.int32)
+    drafts, has = propose_ngram_device(hist, jnp.asarray([1, 2]), 3)
+    assert not bool(has[0]) and not bool(has[1])
+    # fallback drafts repeat the current token
+    assert np.asarray(drafts).tolist() == [[7, 7, 7], [7, 7, 7]]
+
+
+def _paged_setup(prompts, cfg, num_blocks=64, bs=8, mb=8):
+    """Prefill prompts into a fresh paged cache via the admission path;
+    returns (paged, block_tables, context_lens, tokens=last prompt tok)."""
+    import jax.numpy as jnp
+    from distributed_llm_inferencing_tpu.models import transformer
+    from distributed_llm_inferencing_tpu.models.params import init_params
+    from distributed_llm_inferencing_tpu.ops.paged_kvcache import (
+        init_paged_cache)
+    import jax
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    paged = init_paged_cache(cfg, num_blocks, bs)
+    r = len(prompts)
+    t = max(len(p) for p in prompts)
+    t = -(-t // bs) * bs
+    toks = np.zeros((r, t), np.int32)
+    tail_len = np.zeros((r,), np.int32)
+    tail_blocks = np.zeros((r, t // bs), np.int32)
+    nb = 1   # block 0 = dummy
+    tables = np.zeros((r, mb), np.int32)
+    for i, p in enumerate(prompts):
+        toks[i, :len(p) - 1] = p[:-1]
+        tail_len[i] = len(p) - 1
+        nblk = t // bs
+        tail_blocks[i] = np.arange(nb, nb + nblk)
+        tables[i, :nblk] = tail_blocks[i]
+        # growth blocks for decode
+        tables[i, nblk:] = np.arange(nb + nblk, nb + mb)
+        nb += mb
+    _, paged = transformer.paged_prefill_tail(
+        params, cfg, jnp.asarray(toks), jnp.asarray(tail_len),
+        jnp.asarray(tail_blocks), jnp.zeros((r, 1), jnp.int32),
+        jnp.zeros((r,), jnp.int32), paged)
+    cur = np.asarray([p[-1] for p in prompts], np.int32)
+    cl = np.asarray([len(p) - 1 for p in prompts], np.int32)
+    return params, paged, jnp.asarray(tables), jnp.asarray(cl), \
+        jnp.asarray(cur)
+
+
+def test_paged_speculative_chunk_matches_plain_chunk():
+    """Greedy rows: bit-identical tokens to the plain decode chunk (the
+    acceptance rule only skips ahead); a sampling row: bit-identical too
+    (spec emits one sample/iter from the same per-row stream). Exercised
+    with a repetitive prompt so drafts actually accept."""
+    import jax.numpy as jnp
+    from distributed_llm_inferencing_tpu.models import transformer
+    cfg = get_config("tiny-llama").replace(dtype="float32",
+                                           attn_backend="xla")
+    rng = np.random.default_rng(0)
+    base = rng.integers(0, 256, 6).tolist()
+    prompts = [(base * 4)[:20],                      # repetitive: drafts hit
+               rng.integers(0, 256, 9).tolist(),     # arbitrary
+               (base * 3)[:14]]                      # repetitive + sampled
+    params, paged0, tables, cl0, cur0 = _paged_setup(prompts, cfg)
+
+    n_new = 12
+    seeds = jnp.asarray([11, 22, 33], jnp.int32)
+    steps0 = jnp.zeros((3,), jnp.int32)
+    temps = jnp.asarray([1.0, 1.0, 0.8], jnp.float32)
+    tks = jnp.asarray([0, 0, 40], jnp.int32)
+    tps = jnp.asarray([1.0, 1.0, 0.9], jnp.float32)
+    ds = jnp.asarray([False, False, True])
+    budget = jnp.full((3,), n_new, jnp.int32)
+    eos = jnp.full((3,), -1, jnp.int32)
+
+    ptoks, pemits, _ = transformer.paged_decode_chunk(
+        params, cfg, n_new, cur0, paged0, tables, cl0, seeds, steps0,
+        temps, tks, tps, ds, budget, eos, dummy_block=0)
+    plain = [[int(ptoks[t, r]) for t in range(n_new) if bool(pemits[t, r])]
+             for r in range(3)]
+
+    stoks, keeps, alive, _ = transformer.paged_speculative_chunk(
+        params, cfg, 12, 3, cur0, _hist(prompts, 64), paged0, tables,
+        cl0, seeds, steps0, temps, tks, tps, ds, budget, eos,
+        dummy_block=0)
+    spec = [[], [], []]
+    for t in range(12):
+        for r in range(3):
+            spec[r].extend(int(x) for x in
+                           np.asarray(stoks[t, r, :int(keeps[t, r])]))
+    assert spec == plain, (spec, plain)
+
+
+def _hist(prompts, h):
+    import jax.numpy as jnp
+    r = len(prompts)
+    out = np.zeros((r, h), np.int32)
+    for i, p in enumerate(prompts):
+        out[i, :len(p)] = p
+    return jnp.asarray(out)
+
+
+def test_paged_speculative_chunk_eos_and_budget():
+    """Per-slot eos inside an accepted run truncates at it; budgets are
+    exact (never exceeded even when a full gamma+1 run would)."""
+    import jax.numpy as jnp
+    from distributed_llm_inferencing_tpu.models import transformer
+    cfg = get_config("tiny-llama").replace(dtype="float32",
+                                           attn_backend="xla")
+    rng = np.random.default_rng(1)
+    base = rng.integers(0, 256, 5).tolist()
+    prompts = [(base * 5)[:22], (base * 5)[:22]]
+    params, paged0, tables, cl0, cur0 = _paged_setup(prompts, cfg)
+
+    seeds = jnp.zeros((2,), jnp.int32)
+    steps0 = jnp.zeros((2,), jnp.int32)
+    ones = jnp.ones((2,), jnp.float32)
+    ds = jnp.zeros((2,), bool)
+    # row 0: tiny budget; row 1: eos = its first plain-decode token
+    ptoks, pemits, _ = transformer.paged_decode_chunk(
+        params, cfg, 4, cur0, paged0, tables, cl0, seeds, steps0, ones,
+        jnp.zeros((2,), jnp.int32), ones, ds, jnp.full((2,), 4, jnp.int32),
+        jnp.full((2,), -1, jnp.int32), dummy_block=0)
+    first_tok = int(ptoks[1, 1]) if bool(pemits[1, 1]) else int(ptoks[0, 1])
+
+    budget = jnp.asarray([3, 10], jnp.int32)
+    eos = jnp.asarray([-1, first_tok], jnp.int32)
+    stoks, keeps, eos_seen, _ = transformer.paged_speculative_chunk(
+        params, cfg, 8, 3, cur0, _hist(prompts, 64), paged0, tables,
+        cl0, seeds, steps0, ones, jnp.zeros((2,), jnp.int32), ones, ds,
+        budget, eos, dummy_block=0)
+    out = [[], []]
+    for t in range(8):
+        for r in range(2):
+            out[r].extend(int(x) for x in
+                          np.asarray(stoks[t, r, :int(keeps[t, r])]))
+    assert len(out[0]) == 3                     # budget exact
+    assert first_tok not in out[1]              # eos never emitted
+    eos_seen = np.asarray(eos_seen)
+    assert not eos_seen[-1, 0]                  # budget death, not eos
+    assert eos_seen[-1, 1]                      # eos reported to the host
+
+
+def test_batcher_speculative_matches_plain():
+    """Batched speculative serving: greedy AND sampled requests produce
+    bit-identical outputs to the plain batcher (greedy via exact
+    acceptance; sampled via the shared per-row stream), and at least one
+    draft token was accepted on the repetitive prompt."""
+    from distributed_llm_inferencing_tpu.ops.sampling import SamplingParams
+    from distributed_llm_inferencing_tpu.runtime.batcher import (
+        ContinuousBatcher)
+    cfg = get_config("tiny-llama").replace(dtype="float32",
+                                           attn_backend="xla")
+    rng = np.random.default_rng(0)
+    base = rng.integers(0, 256, 6).tolist()
+    rep = (base * 4)[:20]
+    arb = rng.integers(0, 256, 9).tolist()
+
+    def run(spec):
+        b = ContinuousBatcher(
+            cfg, num_blocks=96, block_size=8, slots=3, max_seq=128, seed=0,
+            speculative="ngram" if spec else None, spec_gamma=3)
+        reqs = [
+            b.submit(rep, max_new_tokens=14, sampling=SamplingParams.greedy(),
+                     seed=1),
+            b.submit(arb, max_new_tokens=10, sampling=SamplingParams.greedy(),
+                     seed=2),
+            b.submit(rep, max_new_tokens=12,
+                     sampling=SamplingParams(temperature=0.8, top_k=40),
+                     seed=3),
+        ]
+        for _ in range(120):
+            b.step()
+            if all(r.done.is_set() for r in reqs):
+                break
+        return [r.wait() for r in reqs], b.stats()
+
+    plain, _ = run(False)
+    spec, st = run(True)
+    assert spec == plain, (spec, plain)
+    assert st["spec_accepted_tokens"] >= 1, st
+
+
+def test_batcher_speculative_eos_and_stream():
+    """eos cuts a speculative run mid-chunk; streamed tokens match kept
+    tokens in order."""
+    from distributed_llm_inferencing_tpu.ops.sampling import SamplingParams
+    from distributed_llm_inferencing_tpu.runtime.batcher import (
+        ContinuousBatcher)
+    cfg = get_config("tiny-llama").replace(dtype="float32",
+                                           attn_backend="xla")
+    rng = np.random.default_rng(1)
+    base = rng.integers(0, 256, 5).tolist()
+    prompt = (base * 4)[:18]
+
+    plain = ContinuousBatcher(cfg, num_blocks=64, block_size=8, slots=2,
+                              max_seq=128, seed=0)
+    r0 = plain.submit(prompt, max_new_tokens=10,
+                      sampling=SamplingParams.greedy())
+    for _ in range(40):
+        plain.step()
+        if r0.done.is_set():
+            break
+    full = r0.wait()
+    eos = full[4]
+    want = full[:4] if eos not in full[:4] else None
+
+    b = ContinuousBatcher(cfg, num_blocks=64, block_size=8, slots=2,
+                          max_seq=128, seed=0, speculative="ngram",
+                          spec_gamma=3)
+    seen = []
+    r = b.submit(prompt, max_new_tokens=10,
+                 sampling=SamplingParams.greedy(), eos_token_id=eos,
+                 stream_cb=seen.append)
+    for _ in range(40):
+        b.step()
+        if r.done.is_set():
+            break
+    got = r.wait()
+    if want is not None:
+        assert got == want, (got, want)
+    assert seen == got
+    assert eos not in got
