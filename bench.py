@@ -32,6 +32,9 @@ Extra keys (best-effort; omitted rather than fatal when they fail):
   llama_3_8b_int8_batched_tokens_per_s — 8 concurrent streams
   batched_* — 8 concurrent gpt2 requests through the continuous batcher
               (runtime/batcher.py), with TTFT/latency percentiles
+  batched_greedy_rep[_spec]_tokens_per_s — greedy x8 on a repetitive
+              workload, plain vs on-device-drafted speculative decoding
+              (transformer.paged_speculative_chunk): the acceptance story
   *_hbm_bw_util — bytes-per-token (= weight bytes at batch 1) x tok/s
                   against the chip's spec HBM bandwidth: how close the
                   decode loop runs to its bandwidth roofline
@@ -162,7 +165,8 @@ def _pct(sorted_vals, p):
 
 def bench_batched(model=MODEL, quant=None, n_requests=8,
                   new_tokens=NEW_TOKENS, dtype=None, repeats=2,
-                  prompt_len=PROMPT_LEN, kv_quant=None):
+                  prompt_len=PROMPT_LEN, kv_quant=None,
+                  speculative=None, repetitive=False):
     """Aggregate throughput + TTFT/latency percentiles: n concurrent
     requests through the continuous batcher (the serving path the
     reference fully serialized, reference worker/Dockerfile:47).
@@ -173,6 +177,7 @@ def bench_batched(model=MODEL, quant=None, n_requests=8,
     launches are already compiled."""
     import numpy as np
     from distributed_llm_inferencing_tpu.models.registry import get_config
+    from distributed_llm_inferencing_tpu.ops.sampling import SamplingParams
     from distributed_llm_inferencing_tpu.runtime.batcher import (
         ContinuousBatcher)
 
@@ -186,15 +191,25 @@ def bench_batched(model=MODEL, quant=None, n_requests=8,
     max_seq = prompt_len + new_tokens + 16
     blocks = max(256, n_requests * (-(-max_seq // 16)) + 32)
     b = ContinuousBatcher(cfg, num_blocks=blocks, block_size=16,
-                          slots=n_requests, max_seq=max_seq, seed=0)
+                          slots=n_requests, max_seq=max_seq, seed=0,
+                          speculative=speculative)
     rng = np.random.default_rng(0)
-    sp = _sampling()
+    # the speculative comparison measures greedy on BOTH arms (greedy is
+    # the accelerated mode, and the baseline must match it); repetitive
+    # prompts are the workload class prompt-lookup drafting targets
+    sp = (SamplingParams.greedy() if (speculative or repetitive)
+          else _sampling())
+
+    def mk_prompt():
+        if repetitive:
+            base = rng.integers(0, cfg.vocab_size, 4).tolist()
+            return (base * (prompt_len // 4 + 1))[:prompt_len]
+        return rng.integers(0, cfg.vocab_size, prompt_len).tolist()
 
     def run(seed_base):
         # fresh prompts every run: same buckets/shapes (compiled programs
         # reused), no radix hits from a previous run's inserts
-        prompts = [rng.integers(0, cfg.vocab_size, prompt_len).tolist()
-                   for _ in range(n_requests)]
+        prompts = [mk_prompt() for _ in range(n_requests)]
         reqs = [b.submit(p, max_new_tokens=new_tokens, sampling=sp,
                          seed=seed_base + i) for i, p in enumerate(prompts)]
         t0 = time.perf_counter()
@@ -287,6 +302,21 @@ def run_all(platform, degraded):
                       file=sys.stderr)
             except Exception as e:
                 print(f"batched x{n} bench skipped: {e!r}", file=sys.stderr)
+    if platform != "cpu" and not _over_budget("batched speculative"):
+        # on-device-drafted speculation, greedy x8 on a repetitive
+        # workload vs the same workload plain — the acceptance-rate story
+        for tag, spec in (("", None), ("_spec", "ngram")):
+            _reclaim()
+            try:
+                tput, pstats = bench_batched(repeats=1, speculative=spec,
+                                             repetitive=True)
+                result[f"batched_greedy_rep{tag}_tokens_per_s"] = round(
+                    tput, 2)
+                print(f"batched greedy repetitive{tag}: {tput:.2f} tok/s",
+                      file=sys.stderr)
+            except Exception as e:
+                print(f"batched spec{tag} bench skipped: {e!r}",
+                      file=sys.stderr)
     if platform != "cpu" and not _over_budget("long-ctx kv8"):   # int8 KV cache: the long-context serving lever
         for tag, kvq in (("", None), ("_kv8", "int8")):
             _reclaim()
