@@ -33,16 +33,31 @@ class ModelConfig:
     # Architecture switches
     norm_type: str = "layernorm"  # layernorm | rmsnorm
     norm_eps: float = 1e-5
-    activation: str = "gelu"  # gelu | silu
+    activation: str = "gelu"  # gelu (tanh approx) | gelu_exact | silu | relu
     gated_mlp: bool = False  # llama-style SwiGLU (gate+up) vs plain fc
     position_embedding: str = "learned"  # learned | rope
     rope_theta: float = 10000.0
+    # Partial rotary (GPT-NeoX rotary_pct / Phi partial_rotary_factor):
+    # only the first rope_pct * head_dim dims rotate, the rest pass
+    # through position-free.
+    rope_pct: float = 1.0
     attn_bias: bool = True
     # Qwen2-style asymmetric attention bias: q/k/v carry bias, the output
     # projection does not. None => o follows attn_bias.
     o_bias: Optional[bool] = None
     mlp_bias: bool = True
+    # Phi-style bias on the untied lm_head projection.
+    lm_head_bias: bool = False
     tie_word_embeddings: bool = True
+    # GPT-NeoX / Phi / Falcon block topology: attention and MLP both read
+    # (norms of) the SAME block input and share one residual add —
+    # x + attn(norm1(x)) + mlp(norm2(x)) — instead of the sequential
+    # two-residual layout.
+    parallel_residual: bool = False
+    # Phi / Falcon-7B: ONE layernorm feeds both attention and MLP (layer
+    # params then carry no mlp_norm). Only meaningful with
+    # parallel_residual.
+    shared_attn_mlp_norm: bool = False
     sliding_window: Optional[int] = None  # Mistral-style local attention
     # Gemma-style sqrt(hidden_size) embedding normalizer, applied to the
     # embedding OUTPUT only (the tied head reads the raw table).
@@ -99,6 +114,11 @@ class ModelConfig:
             f"num_heads={self.num_heads} must be divisible by "
             f"num_kv_heads={self.num_kv_heads}"
         )
+        assert not (self.parallel_residual and self.post_norm), (
+            "parallel_residual and post_norm are mutually exclusive")
+        assert not (self.shared_attn_mlp_norm
+                    and not self.parallel_residual), (
+            "shared_attn_mlp_norm requires parallel_residual")
 
     @property
     def q_dim(self) -> int:

@@ -17,7 +17,7 @@ from distributed_llm_inferencing_tpu.models.config import ModelConfig
 def init_params(cfg: ModelConfig, key, dtype=None):
     dtype = dtype or jnp.dtype(cfg.dtype)
     L, D, I = cfg.num_layers, cfg.hidden_size, cfg.intermediate_size
-    keys = iter(jax.random.split(key, 32))
+    keys = iter(jax.random.split(key, 64))
 
     def w(shape, scale=0.02):
         return (jax.random.normal(next(keys), shape, jnp.float32) * scale).astype(dtype)
@@ -34,9 +34,14 @@ def init_params(cfg: ModelConfig, key, dtype=None):
         if cfg.quant == "int4":
             assert shape[-2] % 2 == 0, (
                 f"int4 packing needs even din, got {shape[-2]}")
-            packed = jax.random.randint(
-                next(keys), shape[:-2] + (shape[-2] // 2, shape[-1]),
-                0, 256, jnp.int32).astype(jnp.uint8)
+            # draw per-nibble biased levels in [1,15] (values [-7,7]) —
+            # quantize_weight_int4 clips to that range, so level -8
+            # (biased 0) never appears in a converted checkpoint and must
+            # not appear here either
+            half = shape[:-2] + (shape[-2] // 2, shape[-1])
+            lo = jax.random.randint(next(keys), half, 1, 16, jnp.int32)
+            hi = jax.random.randint(next(keys), half, 1, 16, jnp.int32)
+            packed = (lo | (hi << 4)).astype(jnp.uint8)
             return {"p4": packed, "scale": jnp.full(
                 shape[:-2] + shape[-1:], scale / 7.0, jnp.float32)}
         q = jax.random.randint(next(keys), shape, -127, 128, jnp.int8)
@@ -72,8 +77,9 @@ def init_params(cfg: ModelConfig, key, dtype=None):
         "k": lin(D, cfg.kv_dim, cfg.attn_bias),
         "v": lin(D, cfg.kv_dim, cfg.attn_bias),
         "o": lin(cfg.q_dim, D, cfg.o_bias_effective),
-        "mlp_norm": norm_p(),
     }
+    if not cfg.shared_attn_mlp_norm:   # phi/falcon-7b: one norm per block
+        layers["mlp_norm"] = norm_p()
     if cfg.is_moe:
         E = cfg.num_experts
         layers["router"] = {"w": w((L, D, E))}   # kept float (ops/quant.py)
@@ -115,6 +121,8 @@ def init_params(cfg: ModelConfig, key, dtype=None):
         params["embed"]["positions"] = w((cfg.max_position_embeddings, D))
     if not cfg.tie_word_embeddings:
         params["lm_head"] = ew((D, cfg.vocab_size))
+        if cfg.lm_head_bias:   # phi
+            params["lm_head"]["b"] = zeros((cfg.vocab_size,))
     if cfg.quant:
         # no-op for the leaves w_q already emitted; covers any remaining
         # float linear (and validates the quant mode)

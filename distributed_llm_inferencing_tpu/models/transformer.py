@@ -87,6 +87,8 @@ def _act(x, kind: str):
         return jax.nn.silu(x)
     if kind == "relu":
         return jax.nn.relu(x)
+    if kind == "gelu_exact":   # HF "gelu" (erf form): gpt-neox, falcon
+        return jax.nn.gelu(x, approximate=False)
     return jax.nn.gelu(x, approximate=True)  # gpt2 uses gelu_new
 
 
@@ -257,7 +259,11 @@ def _block_body(x, lp, cfg: ModelConfig, q_positions, attend_write):
     cache update + attention formulation.
 
     cfg.post_norm flips pre-LN (norm -> sublayer -> residual) to the
-    post-LN order opt-350m uses (sublayer -> residual -> norm).
+    post-LN order opt-350m uses (sublayer -> residual -> norm);
+    cfg.parallel_residual is the GPT-NeoX/Phi/Falcon topology — attention
+    and MLP both read (norms of) the same block input and share one
+    residual add, with cfg.shared_attn_mlp_norm collapsing the two norms
+    into one (Phi / Falcon-7B).
     """
     B, s, _ = x.shape
     h = x if cfg.post_norm else norm(x, lp["attn_norm"], cfg.norm_type,
@@ -267,11 +273,18 @@ def _block_body(x, lp, cfg: ModelConfig, q_positions, attend_write):
     v = _linear(h, lp["v"]).reshape(B, s, cfg.num_kv_heads, cfg.head_dim)
 
     if cfg.position_embedding == "rope":
-        q = apply_rope(q, q_positions, cfg.rope_theta)
-        k = apply_rope(k, q_positions, cfg.rope_theta)
+        q = apply_rope(q, q_positions, cfg.rope_theta, cfg.rope_pct)
+        k = apply_rope(k, q_positions, cfg.rope_theta, cfg.rope_pct)
 
     attn, cache_out = attend_write(q, k, v)
     attn = _linear(attn.reshape(B, s, cfg.num_heads * cfg.head_dim), lp["o"])
+
+    if cfg.parallel_residual:
+        h2 = h if cfg.shared_attn_mlp_norm else norm(
+            x, lp["mlp_norm"], cfg.norm_type, cfg.norm_eps)
+        mlp_out = _moe(h2, lp, cfg) if cfg.is_moe else _mlp(h2, lp, cfg)
+        return x + attn + mlp_out, cache_out
+
     x = x + attn
     if cfg.post_norm:
         x = norm(x, lp["attn_norm"], cfg.norm_type, cfg.norm_eps)
@@ -723,13 +736,15 @@ def paged_speculative_chunk(params, cfg: ModelConfig, k: int, gamma: int,
     forfeits the entire speedup, so here the token history rides in a
     device buffer and drafting is a compare/gather inside the scan.
 
-    Acceptance: greedy rows (``~ds``) accept drafts matching the raw
-    argmax — output is bit-identical to plain greedy decode, only
-    faster. Sampling rows emit exactly ONE token per iteration, drawn by
-    the same ``sample_batch`` stream as the plain chunk (bit-identical
-    trajectories, no speculation speedup) — exact per-row
-    data-parameterized rejection sampling is future work, and silently
-    approximating a user's sampling distribution is not acceptable.
+    Acceptance (ops/speculative.py accept_rejection_batch): greedy rows
+    (``~ds``) accept drafts matching the raw argmax — output is
+    bit-identical to plain greedy decode, only faster. Sampling rows run
+    exact per-row data-parameterized leave-one-out rejection against the
+    warped distribution ``sample_batch`` draws from — the emitted
+    distribution is preserved exactly while accepted drafts compress
+    iterations, so serving-default do_sample requests speed up too.
+    (Rows whose top_k exceeds sampling.PREFIX_K — no realistic serving
+    config — fall back to one bit-identical sample per iteration.)
 
     Cache bookkeeping (the subtle part): every iteration writes K/V for
     all gamma+1 scored tokens into a side buffer at a STATIC offset
@@ -758,9 +773,8 @@ def paged_speculative_chunk(params, cfg: ModelConfig, k: int, gamma: int,
     from distributed_llm_inferencing_tpu.ops.attention import attend
     from distributed_llm_inferencing_tpu.ops.paged_kvcache import (
         PagedKVCache)
-    from distributed_llm_inferencing_tpu.ops.sampling import sample_batch
     from distributed_llm_inferencing_tpu.ops.speculative import (
-        propose_ngram_device)
+        accept_rejection_batch, propose_ngram_device)
 
     r = tokens.shape[0]
     L = cfg.num_layers
@@ -852,24 +866,14 @@ def paged_speculative_chunk(params, cfg: ModelConfig, k: int, gamma: int,
         x2, (side_k, side_v) = jax.lax.scan(layer, x, xs)
         logits = unembed(params, cfg, x2)                 # [R, g1, V] f32
 
-        # greedy acceptance (exact); sampling rows emit 1 token via the
-        # same per-row stream as the plain chunk
-        targets = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [R, g1]
-        acc = (drafts == targets[:, :-1]) & ~ds[:, None]
-        prefix = jnp.cumprod(acc.astype(jnp.int32), axis=1)
-        n_acc = prefix.sum(axis=1)                                # [R]
-        greedy_stop = jnp.take_along_axis(
-            targets, n_acc[:, None], axis=1)[:, 0]
-        sampled = sample_batch(logits[:, 0], seeds, steps0 + emitted,
-                               temps, tks, tps, ds)
-        stop = jnp.where(ds, sampled, greedy_stop).astype(jnp.int32)
-
+        # per-row acceptance (ops/speculative.py): greedy rows accept
+        # argmax-matching drafts (bit-identical to plain greedy decode);
+        # sampled rows run exact leave-one-out rejection against the same
+        # warped distribution sample_batch draws from — real speedups for
+        # do_sample requests with the target distribution preserved
+        toks_out, n_emit = accept_rejection_batch(
+            logits, drafts, seeds, steps0 + emitted, temps, tks, tps, ds)
         idx = jnp.arange(g1, dtype=jnp.int32)[None, :]
-        draft_pad = jnp.concatenate(
-            [drafts, jnp.zeros((r, 1), jnp.int32)], axis=1)
-        toks_out = jnp.where(idx == n_acc[:, None], stop[:, None],
-                             draft_pad)                           # [R, g1]
-        n_emit = n_acc + 1
 
         # eos / budget clamping
         emit_sl = idx < n_emit[:, None]

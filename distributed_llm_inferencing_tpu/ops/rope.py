@@ -15,13 +15,21 @@ def rope_freqs(head_dim: int, theta: float):
     return 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
 
 
-def apply_rope(x, positions, theta: float):
+def apply_rope(x, positions, theta: float, pct: float = 1.0):
     """Apply RoPE.
 
     x: [B, S, H, hd]; positions: [B, S] int32 absolute positions.
+    ``pct`` < 1 is partial rotary (GPT-NeoX rotary_pct / Phi
+    partial_rotary_factor): only the first ``int(hd * pct)`` dims rotate,
+    the rest pass through position-free — matching HF's per-model
+    rotary_ndims slicing so converted checkpoints stay bit-compatible.
     Returns same shape/dtype as x.
     """
     hd = x.shape[-1]
+    rot = int(hd * pct)
+    if rot < hd:
+        rotated = apply_rope(x[..., :rot], positions, theta)
+        return jnp.concatenate([rotated, x[..., rot:]], axis=-1)
     inv_freq = rope_freqs(hd, theta)  # [hd/2]
     # angles: [B, S, hd/2]
     angles = positions.astype(jnp.float32)[..., None] * inv_freq

@@ -114,6 +114,29 @@ def sample(logits, key, params: SamplingParams,
 PREFIX_K = 128
 
 
+def nucleus_mask_sorted(sorted_vals, width, top_ps):
+    """Mask sorted-descending logits to top-k ∩ top-p (HF warper order:
+    the token crossing the p threshold is kept).
+
+    sorted_vals: [..., KS] descending; width: [..., 1] int (top-k cut,
+    already clamped to KS); top_ps: [..., 1] f32. Returns (masked
+    [..., KS] with -inf outside the sampling support, thresh [..., 1] =
+    smallest kept logit). ``softmax(masked)`` is exactly the distribution
+    ``sample_batch`` draws from for covered rows, which is what lets
+    speculative verification (ops/speculative.py accept_rejection_batch)
+    accept/reject against the same distribution the plain path samples.
+    """
+    ks = sorted_vals.shape[-1]
+    m = jnp.where(jnp.arange(ks)[(None,) * (sorted_vals.ndim - 1)] < width,
+                  sorted_vals, -jnp.inf)
+    probs = jax.nn.softmax(m, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = (cum - probs) < top_ps
+    num_keep = jnp.maximum(jnp.sum(keep, axis=-1, keepdims=True), 1)
+    thresh = jnp.take_along_axis(m, num_keep - 1, axis=-1)
+    return jnp.where(m < thresh, -jnp.inf, m), thresh
+
+
 def sample_batch(logits, seeds, steps, temps, top_ks, top_ps, do_sample):
     """Per-row-parameterized sampling for the continuous batcher.
 
@@ -151,16 +174,7 @@ def sample_batch(logits, seeds, steps, temps, top_ks, top_ps, do_sample):
     vals, idx = jax.lax.top_k(scaled, ks)               # [R, KS] descending
 
     def _nucleus_mask(sorted_vals, width):
-        """Mask sorted-descending logits to top-k ∩ top-p (HF warper
-        order: the token crossing the p threshold is kept)."""
-        m = jnp.where(jnp.arange(sorted_vals.shape[-1])[None, :] < width,
-                      sorted_vals, -jnp.inf)
-        probs = jax.nn.softmax(m, axis=-1)
-        cum = jnp.cumsum(probs, axis=-1)
-        keep = (cum - probs) < top_ps[:, None]
-        num_keep = jnp.maximum(jnp.sum(keep, axis=-1, keepdims=True), 1)
-        thresh = jnp.take_along_axis(m, num_keep - 1, axis=-1)
-        return jnp.where(m < thresh, -jnp.inf, m), thresh
+        return nucleus_mask_sorted(sorted_vals, width, top_ps[:, None])
 
     def prefix_draw():
         m, _ = _nucleus_mask(vals, jnp.minimum(k, ks)[:, None])

@@ -32,7 +32,7 @@ import jax.numpy as jnp
 from distributed_llm_inferencing_tpu.models import transformer
 from distributed_llm_inferencing_tpu.models.config import ModelConfig
 from distributed_llm_inferencing_tpu.ops.sampling import (
-    SamplingParams, warp_logits)
+    PREFIX_K, SamplingParams, nucleus_mask_sorted, sample_batch, warp_logits)
 
 
 def propose_ngram(history: Sequence[int], gamma: int,
@@ -96,6 +96,101 @@ def propose_ngram_device(history, lengths, gamma: int, n: int = 2):
     # program shape; a bad draft just gets rejected at verification)
     drafts = jnp.where(has[:, None], drafts, last)
     return drafts.astype(jnp.int32), has
+
+
+def accept_rejection_batch(logits, drafts, seeds, steps, temps, top_ks,
+                           top_ps, ds):
+    """Per-row data-parameterized draft acceptance for the BATCHED
+    speculative path (models/transformer.py paged_speculative_chunk):
+    one compiled program serves any mix of greedy / sampled requests,
+    with sampling parameters as data, and sampled rows get real
+    accepted-draft speedups via the same delta-draft leave-one-out
+    rejection rule ``verify_step`` applies with static params.
+
+    logits: [R, G+1, V] f32 — position i scores the token after accepting
+    i drafts; drafts: [R, G] int32; seeds/steps: [R] int32 — ``steps`` is
+    the row's emitted-token count, so its PRNG stream stays a pure
+    function of (prompt, seed) and a rerun reproduces the trajectory.
+    temps/top_ps: [R] f32; top_ks: [R] int32 (0 disables); ds: [R] bool.
+
+    Acceptance, per row:
+    - greedy (``~ds``): accept draft i while it equals the raw argmax;
+      the stop token is the argmax itself — output ≡ plain greedy decode.
+    - sampled, covered (0 < k <= PREFIX_K — every realistic serving
+      config): the target distribution is ``softmax(nucleus_mask_sorted(
+      top_k(scaled)))``, exactly what sample_batch's prefix tier draws
+      from. Accept draft i with probability p_i(d_i); on first rejection
+      draw the stop token from p_i with d_i masked out (renormalized).
+      The residual max(0, p - delta_d) / (1 - p(d)) is p with d removed,
+      so the emitted distribution is exactly p.
+    - sampled, uncovered (k == 0 or k > PREFIX_K): no acceptance
+      (n_acc = 0); the stop token is ``sample_batch``'s draw from the
+      full-vocab tier — bit-identical to the plain chunk for these rows.
+
+    Returns (toks_out [R, G+1], n_emit [R]): row r emits
+    ``toks_out[r, :n_emit[r]]`` (1..G+1 tokens), before any budget/eos
+    clamping the caller applies.
+    """
+    r, g = drafts.shape
+    v = logits.shape[-1]
+    ks = min(PREFIX_K, v)
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None, None]   # [R,G1,V]
+    k = jnp.where(top_ks <= 0, v, jnp.clip(top_ks, 1, v))       # [R]
+    covered = k <= ks
+
+    # warped target distribution over the top-KS prefix, per position
+    vals, idx = jax.lax.top_k(scaled, ks)                       # [R,G1,KS]
+    width = jnp.minimum(k, ks)[:, None, None]
+    m, thresh = nucleus_mask_sorted(vals, width, top_ps[:, None, None])
+    z = jax.nn.logsumexp(m, axis=-1)                            # [R,G1]
+
+    # p_i(d_i): the draft token's mass under position i's warped dist
+    d_val = jnp.take_along_axis(scaled[:, :-1], drafts[..., None],
+                                axis=-1)[..., 0]                # [R,G]
+    in_support = d_val >= thresh[:, :-1, 0]
+    p_draft = jnp.where(in_support, jnp.exp(d_val - z[:, :-1]), 0.0)
+
+    # per-row PRNG: fold the emitted-count stream position, then a spec
+    # tag per use — reproducible, independent of chunk-mates
+    def _keys(s, t):
+        base = jax.random.fold_in(jax.random.PRNGKey(s), t)
+        return (jax.random.fold_in(base, 0x5acc),
+                jax.random.fold_in(base, 0x570b))
+    k_acc, k_stop = jax.vmap(_keys)(seeds, steps)
+    u = jax.vmap(lambda kk: jax.random.uniform(kk, (g,)))(k_acc)
+
+    targets = jnp.argmax(logits, axis=-1).astype(jnp.int32)     # [R,G1]
+    acc_greedy = drafts == targets[:, :-1]
+    acc_sample = covered[:, None] & (u < p_draft)
+    acc = jnp.where(ds[:, None], acc_sample, acc_greedy)
+    prefix = jnp.cumprod(acc.astype(jnp.int32), axis=1)
+    n_acc = prefix.sum(axis=1)                                  # [R] 0..G
+
+    # stop token at position n_acc, per mechanism
+    stop_greedy = jnp.take_along_axis(targets, n_acc[:, None],
+                                      axis=1)[:, 0]
+    m_stop = jnp.take_along_axis(
+        m, n_acc[:, None, None], axis=1)[:, 0]                  # [R,KS]
+    idx_stop = jnp.take_along_axis(
+        idx, n_acc[:, None, None], axis=1)[:, 0]                # [R,KS]
+    rejected = jnp.take_along_axis(
+        drafts, jnp.minimum(n_acc, g - 1)[:, None], axis=1)[:, 0]
+    was_rejection = n_acc < g
+    m_res = jnp.where((idx_stop == rejected[:, None])
+                      & was_rejection[:, None], -jnp.inf, m_stop)
+    j = jax.vmap(lambda kk, l: jax.random.categorical(kk, l))(k_stop, m_res)
+    stop_cov = jnp.take_along_axis(idx_stop, j[:, None], axis=1)[:, 0]
+    # uncovered sampled rows: identical draw to the plain chunk's
+    stop_unc = sample_batch(logits[:, 0], seeds, steps, temps, top_ks,
+                            top_ps, ds)
+    stop = jnp.where(ds, jnp.where(covered, stop_cov, stop_unc),
+                     stop_greedy).astype(jnp.int32)
+
+    pos = jnp.arange(g + 1, dtype=jnp.int32)[None, :]
+    draft_pad = jnp.concatenate(
+        [drafts, jnp.zeros((r, 1), jnp.int32)], axis=1)
+    toks_out = jnp.where(pos == n_acc[:, None], stop[:, None], draft_pad)
+    return toks_out, n_acc + 1
 
 
 def verify_step(params, cfg: ModelConfig, cache, cur, drafts, key,

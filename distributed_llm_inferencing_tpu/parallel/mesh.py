@@ -78,6 +78,18 @@ def validate_spec(spec: MeshSpec, cfg) -> None:
     """Shape-divisibility checks so failures happen at plan time, not inside
     a compiled program (the reference deferred every such error to runtime
     HTTP 500s, worker/app.py:133-137)."""
+    import os
+    if (getattr(cfg, "quant", None) == "int4" and spec.num_devices > 1
+            and os.environ.get("DLI_INT4_PALLAS") == "always"):
+        # the pallas int4 kernel has no GSPMD partitioning rule; the
+        # "always" override exists for single-device programs on hosts
+        # that merely SEE several chips — tracing it into a real
+        # multi-device mesh would silently corrupt results
+        raise ValueError(
+            f"DLI_INT4_PALLAS=always with a {spec.num_devices}-device "
+            "mesh: the pallas int4 kernel cannot be partitioned; unset "
+            "the override (auto already falls back to the XLA unpack on "
+            "multi-device meshes)")
     if cfg.num_heads % spec.tp:
         raise ValueError(f"tp={spec.tp} must divide num_heads={cfg.num_heads}")
     if spec.tp <= cfg.num_kv_heads and cfg.num_kv_heads % spec.tp:

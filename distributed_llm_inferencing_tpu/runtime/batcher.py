@@ -90,6 +90,10 @@ class BatchRequest:
     # prompts could re-prefill each other's evictions forever)
     _chunk_high: int = 0
     _chunk_stalls: int = 0
+    # set when a no-free-slot pop found the request non-partial (its long
+    # prompt is mostly radix-cached): skip re-popping it — and the
+    # match_prefix + alloc churn that costs — until a slot frees
+    _noslot_bounce: bool = False
 
     def wait(self, timeout: Optional[float] = None) -> List[int]:
         if not self.done.wait(timeout):
@@ -119,13 +123,16 @@ class BatchRequest:
 class ContinuousBatcher:
     """Slot-based continuous batching scheduler.
 
-    One jitted program per step; the model may be mesh-sharded (tensor /
-    expert parallel) — params and the paged cache carry NamedShardings and
-    GSPMD partitions the step's matmuls/attention over ICI. Batch-dim
-    parallelism (dp), pipeline stages (pp), and sequence sharding (sp) are
-    rejected: the slot scheduler owns the batch dimension, and its
-    chunk-boundary host round trip is incompatible with stage/sequence
-    pipelining.
+    One jitted program per step; the model may be mesh-sharded. Tensor /
+    expert parallelism (tp/ep) ride GSPMD — params and the paged cache
+    carry NamedShardings and XLA partitions the step's matmuls/attention
+    over ICI. Pipeline parallelism (pp > 1) swaps the decode-chunk and
+    admission programs for GPipe-scheduled shard_map versions
+    (parallel/paged_pipeline.py) with slots as the microbatch dimension
+    and the paged pool's layer axis sharded per stage — the serving path
+    for models too big for one slice's tp×ep. Batch-dim parallelism (dp)
+    and sequence sharding (sp) are rejected: the slot scheduler owns the
+    batch dimension, and decode chunks never span one sequence.
 
     Drive it either with an owned background thread (``start()``/``stop()``)
     or synchronously via ``step()`` (tests, custom loops).
@@ -155,11 +162,24 @@ class ContinuousBatcher:
                  prefill_chunk: Optional[int] = 32,
                  speculative: Optional[str] = None, spec_gamma: int = 4):
         self.mesh_spec = mesh_spec or MeshSpec()
-        for ax in ("dp", "pp", "sp"):
+        for ax in ("dp", "sp"):
             if getattr(self.mesh_spec, ax) > 1:
                 raise ValueError(
-                    f"batched serving shards tensors only (tp/ep); "
-                    f"{ax}={getattr(self.mesh_spec, ax)} unsupported")
+                    f"batched serving shards tensors (tp/ep) and pipeline "
+                    f"stages (pp); {ax}={getattr(self.mesh_spec, ax)} "
+                    "unsupported (the slot scheduler owns the batch dim)")
+        if self.mesh_spec.pp > 1:
+            # pipeline-parallel serving (parallel/paged_pipeline.py):
+            # slots microbatch over pp inside one GPipe-scheduled program
+            if speculative:
+                raise ValueError(
+                    "speculative decoding does not span pipeline stages "
+                    "yet; drop speculative or pp")
+            if cfg.kv_quant:
+                raise ValueError(
+                    "int8 KV cache + pipeline-parallel batching is not "
+                    "supported yet; drop kv_quant or pp")
+            slots = -(-slots // self.mesh_spec.pp) * self.mesh_spec.pp
         self.cfg = cfg = cfg.replace(
             attn_backend=_backend(cfg, self.mesh_spec.num_devices))
         validate_spec(self.mesh_spec, cfg)
@@ -199,6 +219,12 @@ class ContinuousBatcher:
         # hot path): row i holds slot i's prompt + emitted tokens
         self._hist = (np.zeros((slots, self.max_seq + 1), np.int32)
                       if speculative else None)
+        # lockstep-mirror watermark: how many leading entries of each hist
+        # row the followers hold (spec dispatches broadcast only the
+        # per-slot delta past it — the appends themselves are derived from
+        # the replayed program's outputs on both sides)
+        self._hist_synced = (np.zeros((slots,), np.int64)
+                             if speculative else None)
         if params is None:
             params = init_params(cfg, jax.random.PRNGKey(seed))
         else:
@@ -331,6 +357,7 @@ class ContinuousBatcher:
         if fn is None:
             cfg = self.cfg
             nb = t // self.block_size
+            pp, mesh, dummy = self.mesh_spec.pp, self.mesh, self._dummy
 
             def admit(p, ints, floats, paged):
                 toks = ints[:b * t].reshape(b, t)
@@ -339,8 +366,15 @@ class ContinuousBatcher:
                 tl, pfl, seeds, steps, tks, ds = (
                     ints[b * (t + nb + pb):].reshape(6, b))
                 temps, tps = floats
-                last, paged = transformer.paged_prefill_tail(
-                    p, cfg, toks, tl, tb, pfb, pfl, paged)
+                if pp > 1:
+                    from distributed_llm_inferencing_tpu.parallel import (
+                        paged_pipeline)
+                    last, paged = paged_pipeline.paged_prefill_tail_pp(
+                        p, cfg, toks, tl, tb, pfb, pfl, paged, dummy,
+                        mesh=mesh)
+                else:
+                    last, paged = transformer.paged_prefill_tail(
+                        p, cfg, toks, tl, tb, pfb, pfl, paged)
                 first = sample_batch(last, seeds, steps, temps, tks, tps,
                                      ds.astype(bool))
                 return first, paged
@@ -355,12 +389,20 @@ class ContinuousBatcher:
         fn = self._decode_fns.get((k, r, mb))
         if fn is None:
             cfg, dummy = self.cfg, self._dummy
+            pp, mesh = self.mesh_spec.pp, self.mesh
 
             def chunk(p, ints, floats, paged):
                 bt = ints[:r * mb].reshape(r, mb)
                 (tokens, cl, seeds, steps0, tks, budget, eos_ids,
                  ds) = ints[r * mb:].reshape(8, r)
                 temps, tps = floats
+                if pp > 1:
+                    from distributed_llm_inferencing_tpu.parallel import (
+                        paged_pipeline)
+                    return paged_pipeline.paged_decode_chunk_pp(
+                        p, cfg, k, tokens, paged, bt, cl, seeds, steps0,
+                        temps, tks, tps, ds.astype(bool), budget, eos_ids,
+                        dummy, mesh=mesh)
                 return transformer.paged_decode_chunk(
                     p, cfg, k, tokens, paged, bt, cl, seeds, steps0, temps,
                     tks, tps, ds.astype(bool), budget, eos_ids, dummy)
@@ -437,11 +479,52 @@ class ContinuousBatcher:
             # ONE host sync per K-token chunk for all slots
             return jax.device_get((toks, emits))
 
+    def _hist_deltas(self) -> list:
+        """JSON-safe per-slot history deltas for the lockstep broadcast:
+        ``[slot, offset, tokens]`` for every active row the followers are
+        behind on. Non-empty only right after a slot (re)admission — every
+        other append is derived from replayed program outputs on both
+        sides — so the broadcast is O(new prompt), not O(slots * max_seq)
+        per chunk. Advances the watermark."""
+        out = []
+        for r in range(self.slots):
+            if self.active[r] is None:
+                continue
+            k = min(int(self.context_lens[r]) + 1, self.max_seq + 1)
+            s = int(self._hist_synced[r])
+            if k > s:
+                out.append([r, s, self._hist[r, s:k].tolist()])
+                self._hist_synced[r] = k
+        return out
+
+    def _apply_spec_hist(self, toks, keeps, cl):
+        """Mirror a speculative chunk's kept tokens into the drafting
+        history. Pure function of the program's (inputs, outputs), so the
+        leader and every replaying follower evolve identical rows without
+        the history ever riding the broadcast."""
+        for r in range(keeps.shape[1]):
+            pos = int(cl[r]) + 1
+            kept = 0
+            for t in range(keeps.shape[0]):
+                for tok in toks[t, r, : int(keeps[t, r])]:
+                    if pos <= self.max_seq:
+                        self._hist[r, pos] = int(tok)
+                    pos += 1
+                    kept += 1
+            if self._hist_synced is not None and kept:
+                self._hist_synced[r] = min(self._hist_synced[r] + kept,
+                                           self.max_seq + 1)
+
     def _run_spec_decode(self, a: dict):
         """Launch one speculative chunk's program. Returns (toks
         [K, R, g+1], keeps [K, R]) as host arrays."""
         bt = np.asarray(a["bt"], np.int32)
-        hist = np.asarray(a["hist"], np.int32)
+        if "hist" in a:
+            hist = np.asarray(a["hist"], np.int32)
+        else:   # lockstep replay: apply the leader's deltas to our copy
+            for r, off, row in a.get("hist_delta") or []:
+                self._hist[r, off:off + len(row)] = row
+            hist = self._hist
         r, mb = bt.shape
         ints = np.concatenate([bt.reshape(-1), hist.reshape(-1)] + [
             np.asarray(a[key], np.int32) for key in
@@ -466,7 +549,12 @@ class ContinuousBatcher:
         elif kind == "decode":
             self._run_decode(args)
         elif kind == "spec_decode":
-            self._run_spec_decode(args)
+            toks, keeps, _ = self._run_spec_decode(args)
+            if "hist" not in args:
+                # mirror the leader's host-side history appends from the
+                # program's own outputs (see _apply_spec_hist)
+                self._apply_spec_hist(toks, keeps,
+                                      np.asarray(args["cl"], np.int32))
         else:
             raise ValueError(f"unknown batcher program kind {kind!r}")
 
@@ -570,13 +658,14 @@ class ContinuousBatcher:
                 cap = (self.prefill_chunk or 0) * self.block_size
                 with self._lock:
                     head = self.queue[0] if self.queue else None
-                if (head is None or cap == 0
+                if (head is None or cap == 0 or head._noslot_bounce
                         or len(head.prompt) + len(head.tokens) - 1 <= cap):
                     break
             with self._lock:
                 req = self.queue.popleft() if self.queue else None
             if req is None:
                 break
+            req._noslot_bounce = False   # re-marked below if it bounces again
             if req._cancelled:
                 req.error = req.error or "cancelled"
                 req.done.set()
@@ -625,7 +714,10 @@ class ContinuousBatcher:
                 break
             if not free:
                 # a full admission does need a slot; put the request back
-                # and run whatever the wave already holds
+                # and run whatever the wave already holds. Mark it so the
+                # no-slot pre-filter above stops re-popping (and
+                # re-prepping) it every step until a slot frees.
+                req._noslot_bounce = True
                 self.pool.release(prep["prefix_blocks"])
                 self.pool.release(prep["tail_alloc"])
                 with self._lock:
@@ -649,6 +741,8 @@ class ContinuousBatcher:
         size (padding rows write only the reserved dummy block)."""
         bs = self.block_size
         b = self._bucket_wave(len(members))
+        if self.mesh_spec.pp > 1:   # wave rows microbatch over pp stages
+            b = -(-b // self.mesh_spec.pp) * self.mesh_spec.pp
         toks = np.zeros((b, t), np.int32)
         tail_len = np.ones((b,), np.int32)
         tail_blocks = np.full((b, t // bs), self._dummy, np.int32)
@@ -753,6 +847,7 @@ class ContinuousBatcher:
         if self._hist is not None:
             known = m["prompt"][: self.max_seq + 1]
             self._hist[slot, : len(known)] = known
+            self._hist_synced[slot] = 0   # row rewritten: full re-sync
         if req.first_token_at is None:
             req.first_token_at = time.time()
         self._emit(req, first)
@@ -945,25 +1040,28 @@ class ContinuousBatcher:
         k_it = -(-int(decode_args["k"]) // g1)
         args = dict(decode_args, k=k_it, gamma=self.spec_gamma)
         if self.program_hook is not None:
-            # the lockstep mirror ships JSON; serialize only on this path
-            args["hist"] = self._hist.tolist()
+            # the lockstep mirror ships JSON: broadcast only per-slot
+            # history deltas (non-empty just after admissions); followers
+            # derive every other append from the replayed program's
+            # outputs, so the broadcast is O(new tokens), never
+            # O(slots * max_seq) per chunk
+            args["hist_delta"] = self._hist_deltas()
+            local = dict(args, hist=self._hist)
             toks, keeps, eos_seen = self.program_hook(
-                "spec_decode", args, lambda: self._run_spec_decode(args))
+                "spec_decode", args, lambda: self._run_spec_decode(local))
         else:
             args["hist"] = self._hist
             toks, keeps, eos_seen = self._run_spec_decode(args)
         self._step_count += 1
+        self._apply_spec_hist(toks, keeps,
+                              np.asarray(decode_args["cl"], np.int32))
 
         for i in active:
             req = self.active[i]
-            pos = int(self.context_lens[i]) + 1   # first new history slot
             cnt = int(keeps[:, i].sum())
             for t in range(keeps.shape[0]):
                 for tok in toks[t, i, : int(keeps[t, i])]:
                     self._emit(req, int(tok))
-                    if pos <= self.max_seq:
-                        self._hist[i, pos] = int(tok)
-                    pos += 1
             # speedup accounting: tokens beyond one-per-iteration
             self._spec_accepted += cnt - int((keeps[:, i] > 0).sum())
             self.context_lens[i] += cnt

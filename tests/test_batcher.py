@@ -351,7 +351,10 @@ def test_ep_sharded_batcher_moe():
 
 
 def test_batcher_rejects_non_tensor_axes():
-    for spec in (MeshSpec(dp=2), MeshSpec(pp=2), MeshSpec(sp=2)):
+    # dp/sp stay rejected (the slot scheduler owns the batch dim; decode
+    # chunks never span one sequence); pp>1 is now a supported serving
+    # mode (tests/test_paged_pipeline.py)
+    for spec in (MeshSpec(dp=2), MeshSpec(sp=2)):
         with pytest.raises(ValueError, match="tp/ep"):
             ContinuousBatcher(CFG, PARAMS, num_blocks=16, block_size=8,
                               slots=2, max_seq=64, mesh_spec=spec)

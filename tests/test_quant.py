@@ -369,6 +369,60 @@ def test_embed_quant_sharded_and_stacked_with_int4():
     assert r2.tokens[0][0] == r1.tokens[0][0]
 
 
+def test_int4_pallas_always_refused_on_multidevice_mesh(monkeypatch):
+    """DLI_INT4_PALLAS=always exists for single-device programs on hosts
+    that merely SEE several chips; tracing the unpartitionable kernel
+    into a real multi-device mesh would corrupt results — construction
+    must refuse (ADVICE round-3)."""
+    from distributed_llm_inferencing_tpu.parallel.mesh import MeshSpec
+    monkeypatch.setenv("DLI_INT4_PALLAS", "always")
+    cfg = get_config("tiny-llama").replace(dtype="float32", quant="int4")
+    with pytest.raises(ValueError, match="DLI_INT4_PALLAS"):
+        InferenceEngine(cfg, init_params(cfg, jax.random.PRNGKey(0)),
+                        mesh_spec=MeshSpec(tp=2), max_seq=64)
+    # single-device stays allowed
+    InferenceEngine(cfg, init_params(cfg, jax.random.PRNGKey(0)),
+                    max_seq=64)
+
+
+def test_embed_quant_untied_int4_full_stack():
+    """The llama-family full quant story (bench llama_3_8b_int4_eq8):
+    int4 matmuls INCLUDING the untied lm_head + int8 embedding table.
+    Greedy decode must match the same stack with a dequantized table at
+    relaxed tolerance, and the engine must serve it tp-sharded."""
+    from distributed_llm_inferencing_tpu.parallel.mesh import MeshSpec
+    cfg = get_config("tiny-llama").replace(
+        dtype="float32", attn_backend="xla", quant="int4",
+        embed_quant="int8")
+    params = init_params(cfg, jax.random.PRNGKey(3))
+    assert "p4" in params["lm_head"]            # untied head is int4
+    assert "q8" in params["embed"]["tokens"]    # table is int8
+    prompt = np.random.default_rng(2).integers(0, 256, 9).tolist()
+    eng = InferenceEngine(cfg, params, max_seq=64)
+    r1 = eng.generate([prompt], max_new_tokens=8,
+                      sampling=SamplingParams.greedy())
+    assert len(r1.tokens[0]) == 8
+
+    # same stack, table dequantized to float: first greedy tokens agree
+    # (rounding-loss tolerance: compare the first token only, the rest
+    # can legitimately diverge after an argmax flip)
+    from distributed_llm_inferencing_tpu.ops.quant import dequantize_embed
+    ref = {k: v for k, v in params.items()}
+    ref["embed"] = dict(params["embed"])
+    ref["embed"]["tokens"] = dequantize_embed(
+        params["embed"]["tokens"]).astype(jnp.float32)
+    eng_ref = InferenceEngine(cfg.replace(embed_quant=None), ref, max_seq=64)
+    r2 = eng_ref.generate([prompt], max_new_tokens=8,
+                          sampling=SamplingParams.greedy())
+    assert r1.tokens[0][0] == r2.tokens[0][0]
+
+    eng_tp = InferenceEngine(cfg, params, mesh_spec=MeshSpec(tp=2),
+                             max_seq=64)
+    r3 = eng_tp.generate([prompt], max_new_tokens=8,
+                         sampling=SamplingParams.greedy())
+    assert r3.tokens[0][0] == r1.tokens[0][0]
+
+
 def test_embed_quant_checkpoint_roundtrip(tmp_path):
     from distributed_llm_inferencing_tpu.models import checkpoint
     cfg = get_config("tiny-gpt2").replace(dtype="float32",

@@ -213,8 +213,9 @@ def _paged_setup(prompts, cfg, num_blocks=64, bs=8, mb=8):
 
 def test_paged_speculative_chunk_matches_plain_chunk():
     """Greedy rows: bit-identical tokens to the plain decode chunk (the
-    acceptance rule only skips ahead); a sampling row: bit-identical too
-    (spec emits one sample/iter from the same per-row stream). Exercised
+    acceptance rule only skips ahead). The sampling row runs exact
+    rejection sampling — trajectory diverges from plain by design, but
+    must be budget-exact and deterministic given its seed. Exercised
     with a repetitive prompt so drafts actually accept."""
     import jax.numpy as jnp
     from distributed_llm_inferencing_tpu.models import transformer
@@ -243,16 +244,23 @@ def test_paged_speculative_chunk_matches_plain_chunk():
     plain = [[int(ptoks[t, r]) for t in range(n_new) if bool(pemits[t, r])]
              for r in range(3)]
 
-    stoks, keeps, alive, _ = transformer.paged_speculative_chunk(
-        params, cfg, 12, 3, cur0, _hist(prompts, 64), paged0, tables,
-        cl0, seeds, steps0, temps, tks, tps, ds, budget, eos,
-        dummy_block=0)
-    spec = [[], [], []]
-    for t in range(12):
-        for r in range(3):
-            spec[r].extend(int(x) for x in
-                           np.asarray(stoks[t, r, :int(keeps[t, r])]))
-    assert spec == plain, (spec, plain)
+    def run_spec():
+        stoks, keeps, _, _ = transformer.paged_speculative_chunk(
+            params, cfg, 12, 3, cur0, _hist(prompts, 64), paged0, tables,
+            cl0, seeds, steps0, temps, tks, tps, ds, budget, eos,
+            dummy_block=0)
+        out = [[], [], []]
+        for t in range(12):
+            for r in range(3):
+                out[r].extend(int(x) for x in
+                              np.asarray(stoks[t, r, :int(keeps[t, r])]))
+        return out
+
+    spec = run_spec()
+    assert spec[0] == plain[0], (spec[0], plain[0])   # greedy: bit-identical
+    assert spec[1] == plain[1], (spec[1], plain[1])
+    assert len(spec[2]) == n_new                      # sampled: budget exact
+    assert run_spec()[2] == spec[2]                   # and seed-deterministic
 
 
 def _hist(prompts, h):
@@ -306,10 +314,10 @@ def test_paged_speculative_chunk_eos_and_budget():
 
 
 def test_batcher_speculative_matches_plain():
-    """Batched speculative serving: greedy AND sampled requests produce
-    bit-identical outputs to the plain batcher (greedy via exact
-    acceptance; sampled via the shared per-row stream), and at least one
-    draft token was accepted on the repetitive prompt."""
+    """Batched speculative serving: greedy requests produce bit-identical
+    outputs to the plain batcher (exact acceptance); the sampled request
+    runs exact rejection sampling — right length, deterministic given its
+    seed — and draft tokens were accepted on the repetitive prompts."""
     from distributed_llm_inferencing_tpu.ops.sampling import SamplingParams
     from distributed_llm_inferencing_tpu.runtime.batcher import (
         ContinuousBatcher)
@@ -341,8 +349,176 @@ def test_batcher_speculative_matches_plain():
 
     plain, _ = run(False)
     spec, st = run(True)
-    assert spec == plain, (spec, plain)
+    assert spec[0] == plain[0], (spec[0], plain[0])
+    assert spec[1] == plain[1], (spec[1], plain[1])
+    assert len(spec[2]) == 12
+    spec2, _ = run(True)
+    assert spec2[2] == spec[2]          # sampled: seed-deterministic
     assert st["spec_accepted_tokens"] >= 1, st
+
+
+def test_batcher_speculative_sampled_accepts_drafts():
+    """do_sample requests must get real accepted-draft speedups (VERDICT
+    round-3 ask #3): a lone sampled request on a highly repetitive prompt
+    accepts at least one draft token."""
+    from distributed_llm_inferencing_tpu.ops.sampling import SamplingParams
+    from distributed_llm_inferencing_tpu.runtime.batcher import (
+        ContinuousBatcher)
+    cfg = get_config("tiny-llama").replace(dtype="float32",
+                                           attn_backend="xla")
+    rng = np.random.default_rng(3)
+    base = rng.integers(0, 256, 4).tolist()
+    prompt = (base * 6)[:22]
+    b = ContinuousBatcher(cfg, num_blocks=64, block_size=8, slots=2,
+                          max_seq=128, seed=0, speculative="ngram",
+                          spec_gamma=3)
+    # low temperature peaks the target distribution, so in-pattern drafts
+    # carry high acceptance probability (the tiny random-init model's
+    # sampled trajectories wander; near-greedy keeps them on-pattern)
+    r = b.submit(prompt, max_new_tokens=48,
+                 sampling=SamplingParams(temperature=0.05, top_k=20), seed=5)
+    for _ in range(120):
+        b.step()
+        if r.done.is_set():
+            break
+    assert len(r.wait()) == 48
+    assert b.stats()["spec_accepted_tokens"] >= 1, b.stats()
+
+
+def test_batcher_speculative_lockstep_hist_delta():
+    """The lockstep broadcast must NOT carry the full drafting history:
+    spec_decode args ship per-slot deltas (non-empty only right after an
+    admission), and a follower replaying the JSON'd programs reconstructs
+    the leader's history rows and cache evolution exactly."""
+    import json
+    from distributed_llm_inferencing_tpu.ops.sampling import SamplingParams
+    from distributed_llm_inferencing_tpu.runtime.batcher import (
+        ContinuousBatcher)
+    cfg = get_config("tiny-llama").replace(dtype="float32",
+                                           attn_backend="xla")
+    rng = np.random.default_rng(2)
+    base = rng.integers(0, 256, 5).tolist()
+    prompts = [(base * 5)[:20], rng.integers(0, 256, 7).tolist()]
+
+    mk = lambda: ContinuousBatcher(  # noqa: E731
+        cfg, num_blocks=64, block_size=8, slots=2, max_seq=96, seed=0,
+        speculative="ngram", spec_gamma=3)
+    leader, follower = mk(), mk()
+    spec_payloads = []
+
+    def hook(kind, args, run):
+        wire = json.loads(json.dumps(args))   # prove JSON-safety
+        if kind == "spec_decode":
+            assert "hist" not in wire, "full history must not broadcast"
+            spec_payloads.append(wire)
+        follower.replay(kind, wire)
+        return run()
+
+    leader.program_hook = hook
+    reqs = [leader.submit(p, max_new_tokens=12,
+                          sampling=SamplingParams.greedy(), seed=9 + i)
+            for i, p in enumerate(prompts)]
+    for _ in range(60):
+        leader.step()
+        if all(r.done.is_set() for r in reqs):
+            break
+    outs = [r.wait() for r in reqs]
+    assert all(len(o) == 12 for o in outs)
+
+    assert spec_payloads, "speculative chunks must have been dispatched"
+    # delta amortization: only the first chunk after admission syncs rows
+    assert spec_payloads[0]["hist_delta"], spec_payloads[0]
+    for p in spec_payloads[1:]:
+        assert p["hist_delta"] == [], p["hist_delta"]
+    # follower reconstructed the leader's history exactly
+    np.testing.assert_array_equal(follower._hist, leader._hist)
+
+
+def test_accept_rejection_batch_matches_analytic_probability():
+    """The acceptance math itself, against closed form: with a fixed
+    peaked distribution and the draft equal to the favored token, the
+    expected accepted count is p + p^2 + ... + p^G for
+    p = exp(l)/(exp(l) + (k-1)) under temp-1 top-k warping. Empirical
+    mean over seeds must land on it; and rejected-position residuals must
+    never re-emit the rejected draft."""
+    import jax
+    from distributed_llm_inferencing_tpu.ops.speculative import (
+        accept_rejection_batch)
+    G, V, L = 3, 64, 5.0
+    logits = np.zeros((1, G + 1, V), np.float32)
+    logits[..., 7] = L
+    drafts = np.full((1, G), 7, np.int32)
+    args = dict(temps=jnp.asarray([1.0], jnp.float32),
+                top_ks=jnp.asarray([20], jnp.int32),
+                top_ps=jnp.asarray([0.95], jnp.float32),
+                ds=jnp.asarray([True]))
+    fn = jax.jit(lambda s: accept_rejection_batch(
+        jnp.asarray(logits), jnp.asarray(drafts), s,
+        jnp.zeros((1,), jnp.int32), **args))
+    n_accs, toks = [], []
+    for s in range(400):
+        t, n_emit = fn(jnp.asarray([s], jnp.int32))
+        n_accs.append(int(n_emit[0]) - 1)
+        toks.append(np.asarray(t[0]))
+    p = np.exp(L) / (np.exp(L) + 19)   # top-20 keeps 19 competitors
+    want = sum(p ** i for i in range(1, G + 1))        # ~2.37
+    got = np.mean(n_accs)
+    assert abs(got - want) < 0.12, (got, want)
+    # rejection residuals exclude the rejected draft
+    for n_acc, t in zip(n_accs, toks):
+        if n_acc < G:
+            assert t[n_acc] != 7, (n_acc, t)
+
+
+def test_batcher_speculative_sampling_distribution_preserved():
+    """Exact rejection sampling at the batcher level: across many seeds,
+    the speculative-verified tokens' empirical distribution must match
+    the plain batcher's. The distributions are conditional mixtures over
+    the admission token, so the pass bound is CALIBRATED against the
+    plain-vs-plain sampling noise floor at the same sample size instead
+    of a fixed constant."""
+    from distributed_llm_inferencing_tpu.ops.sampling import SamplingParams
+    from distributed_llm_inferencing_tpu.runtime.batcher import (
+        ContinuousBatcher)
+    cfg = get_config("tiny-llama").replace(dtype="float32",
+                                           attn_backend="xla")
+    rng = np.random.default_rng(0)
+    prompt = (rng.integers(0, 256, 4).tolist() * 5)[:18]
+    sp = SamplingParams(temperature=1.2, top_k=8, top_p=0.95)
+    n = 120
+
+    def collect(spec, seed0):
+        b = ContinuousBatcher(cfg, num_blocks=256, block_size=8, slots=8,
+                              max_seq=64, seed=0,
+                              speculative="ngram" if spec else None,
+                              spec_gamma=2)
+        reqs = [b.submit(prompt, max_new_tokens=3, sampling=sp,
+                         seed=seed0 + s) for s in range(n)]
+        for _ in range(600):
+            b.step()
+            if all(r.done.is_set() for r in reqs):
+                break
+        counts: dict = {}
+        for r in reqs:
+            toks = r.wait()
+            # token 0 is the admission sample (same path in both modes);
+            # positions 1 and 2 are speculative-verified
+            for pos in (1, 2):
+                key = (pos, toks[pos])
+                counts[key] = counts.get(key, 0) + 1
+        return counts
+
+    def tv(a, b):
+        support = set(a) | set(b)
+        return sum(abs(a.get(t, 0) - b.get(t, 0))
+                   for t in support) / (2 * 2 * n)
+
+    plain_a = collect(False, 0)
+    plain_b = collect(False, 5000)     # same dist, fresh seeds: noise floor
+    spec_a = collect(True, 0)
+    tv_null = tv(plain_a, plain_b)
+    tv_spec = tv(spec_a, plain_a)
+    assert tv_spec < 1.5 * tv_null + 0.08, (tv_spec, tv_null)
 
 
 def test_batcher_speculative_eos_and_stream():
