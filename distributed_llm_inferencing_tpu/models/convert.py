@@ -51,6 +51,22 @@ def _qwen2_window(hf_config):
     return hf_config.sliding_window       # every layer is windowed
 
 
+# HF hidden_act -> our activation kinds (models/transformer.py _act).
+# "gelu" is the erf form; gelu_new/gelu_pytorch_tanh are the tanh approx.
+_HF_ACT = {"gelu": "gelu_exact", "gelu_new": "gelu",
+           "gelu_pytorch_tanh": "gelu", "silu": "silu", "relu": "relu"}
+
+
+def _act_from_hf(name: str) -> str:
+    if name not in _HF_ACT:
+        raise NotImplementedError(f"unsupported hidden_act {name!r}")
+    return _HF_ACT[name]
+
+
+SUPPORTED_MODEL_TYPES = ("gpt2", "opt", "llama", "mistral", "mixtral",
+                         "qwen2", "gemma", "gpt_neox", "phi", "falcon")
+
+
 def config_from_hf(hf_config) -> ModelConfig:
     mt = hf_config.model_type
     if mt == "gpt2":
@@ -123,7 +139,102 @@ def config_from_hf(hf_config) -> ModelConfig:
             embed_scale=(hf_config.hidden_size ** 0.5 if mt == "gemma"
                          else None),
             norm_offset=mt == "gemma")
-    raise NotImplementedError(f"unsupported HF model_type {mt!r}")
+    if mt == "gpt_neox":
+        # GPT-NeoX / Pythia: parallel-residual blocks (two norms), fused
+        # per-head-interleaved QKV, partial rotary (rotary_pct), exact
+        # (erf) gelu, untied embed_out head.
+        return ModelConfig(
+            name=getattr(hf_config, "name_or_path", mt) or mt,
+            family="gpt-neox", vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            intermediate_size=hf_config.intermediate_size,
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            num_kv_heads=hf_config.num_attention_heads,
+            head_dim=hf_config.hidden_size // hf_config.num_attention_heads,
+            max_position_embeddings=hf_config.max_position_embeddings,
+            norm_type="layernorm", norm_eps=hf_config.layer_norm_eps,
+            activation=_act_from_hf(hf_config.hidden_act),
+            gated_mlp=False, position_embedding="rope",
+            rope_theta=getattr(hf_config, "rotary_emb_base", None)
+            or getattr(hf_config, "rope_theta", 10000.0),
+            rope_pct=getattr(hf_config, "rotary_pct", 1.0),
+            attn_bias=getattr(hf_config, "attention_bias", True),
+            mlp_bias=True,
+            tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
+                                        False),
+            parallel_residual=getattr(hf_config, "use_parallel_residual",
+                                      True))
+    if mt == "phi":
+        # Phi-1/1.5/2: parallel residual with a SINGLE shared layernorm,
+        # partial rotary, biases everywhere incl. the untied lm_head.
+        if getattr(hf_config, "qk_layernorm", False):
+            raise NotImplementedError("phi with qk_layernorm")
+        heads = hf_config.num_attention_heads
+        return ModelConfig(
+            name=getattr(hf_config, "name_or_path", mt) or mt,
+            family="phi", vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            intermediate_size=hf_config.intermediate_size,
+            num_layers=hf_config.num_hidden_layers, num_heads=heads,
+            num_kv_heads=getattr(hf_config, "num_key_value_heads", None)
+            or heads,
+            head_dim=hf_config.hidden_size // heads,
+            max_position_embeddings=hf_config.max_position_embeddings,
+            norm_type="layernorm", norm_eps=hf_config.layer_norm_eps,
+            activation=_act_from_hf(hf_config.hidden_act),
+            gated_mlp=False, position_embedding="rope",
+            rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+            rope_pct=getattr(hf_config, "partial_rotary_factor", 0.5),
+            attn_bias=True, mlp_bias=True, lm_head_bias=True,
+            tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
+                                        False),
+            parallel_residual=True, shared_attn_mlp_norm=True)
+    if mt == "falcon":
+        # Falcon: parallel-residual blocks, fused grouped/MQA QKV, exact
+        # gelu, no biases. Two shapes map: the 7B layout (multi_query,
+        # single shared norm) and the new decoder architecture
+        # (grouped-KV, ln_attn + ln_mlp). Alibi models are positional-
+        # encoding-incompatible and refused.
+        if getattr(hf_config, "alibi", False):
+            raise NotImplementedError("falcon with alibi positions")
+        if not getattr(hf_config, "parallel_attn", True):
+            raise NotImplementedError("falcon without parallel_attn")
+        new_arch = getattr(hf_config, "new_decoder_architecture", False)
+        if new_arch and getattr(hf_config, "num_ln_in_parallel_attn",
+                                None) == 1:
+            raise NotImplementedError("falcon new-arch with a single "
+                                      "parallel layernorm")
+        heads = hf_config.num_attention_heads
+        if new_arch:
+            kv = getattr(hf_config, "num_kv_heads", None) or heads
+        else:
+            kv = 1 if getattr(hf_config, "multi_query", True) else heads
+        bias = getattr(hf_config, "bias", False)
+        return ModelConfig(
+            name=getattr(hf_config, "name_or_path", mt) or mt,
+            family="falcon", vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            intermediate_size=getattr(hf_config, "ffn_hidden_size", None)
+            or 4 * hf_config.hidden_size,
+            num_layers=hf_config.num_hidden_layers, num_heads=heads,
+            num_kv_heads=kv,
+            head_dim=hf_config.hidden_size // heads,
+            max_position_embeddings=getattr(
+                hf_config, "max_position_embeddings", 2048),
+            norm_type="layernorm",
+            norm_eps=getattr(hf_config, "layer_norm_epsilon", 1e-5),
+            activation=_act_from_hf(getattr(hf_config, "activation",
+                                            "gelu")),
+            gated_mlp=False, position_embedding="rope",
+            rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+            attn_bias=bias, mlp_bias=bias,
+            tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
+                                        True),
+            parallel_residual=True, shared_attn_mlp_norm=not new_arch)
+    raise NotImplementedError(
+        f"unsupported HF model_type {mt!r}; supported: "
+        f"{', '.join(SUPPORTED_MODEL_TYPES)}")
 
 
 def _stack(dicts):
@@ -247,6 +358,125 @@ def convert_state_dict(cfg: ModelConfig, sd, dtype=None):
             "embed": {"tokens": get("model.embed_tokens.weight")},
             "layers": _stack([layer(i) for i in range(cfg.num_layers)]),
             "final_norm": {"scale": get("model.norm.weight") + off},
+        }
+        if not cfg.tie_word_embeddings:
+            params["lm_head"] = {"w": get("lm_head.weight").T}
+    elif fam == "gpt-neox":
+        H, hd = cfg.num_heads, cfg.head_dim
+
+        def layer(i):
+            p = f"gpt_neox.layers.{i}."
+            # fused QKV, per-head interleaved: out-row h*3*hd + j*hd + d
+            # holds head h, kind j (q,k,v), dim d (HF GPTNeoXAttention
+            # views [.., heads, 3*head_size] then splits the last axis)
+            qkv_w = get(p + "attention.query_key_value.weight")  # [3Hhd, D]
+            qkv_b = get(p + "attention.query_key_value.bias")
+            w3 = qkv_w.reshape(H, 3, hd, D)
+            b3 = qkv_b.reshape(H, 3, hd)
+
+            def proj(j):
+                return {"w": w3[:, j].reshape(H * hd, D).T,
+                        "b": b3[:, j].reshape(H * hd)}
+            return {
+                "attn_norm": {"scale": get(p + "input_layernorm.weight"),
+                              "bias": get(p + "input_layernorm.bias")},
+                "q": proj(0), "k": proj(1), "v": proj(2),
+                "o": {"w": get(p + "attention.dense.weight").T,
+                      "b": get(p + "attention.dense.bias")},
+                "mlp_norm": {
+                    "scale": get(p + "post_attention_layernorm.weight"),
+                    "bias": get(p + "post_attention_layernorm.bias")},
+                "up": {"w": get(p + "mlp.dense_h_to_4h.weight").T,
+                       "b": get(p + "mlp.dense_h_to_4h.bias")},
+                "down": {"w": get(p + "mlp.dense_4h_to_h.weight").T,
+                         "b": get(p + "mlp.dense_4h_to_h.bias")},
+            }
+        params = {
+            "embed": {"tokens": get("gpt_neox.embed_in.weight")},
+            "layers": _stack([layer(i) for i in range(cfg.num_layers)]),
+            "final_norm": {
+                "scale": get("gpt_neox.final_layer_norm.weight"),
+                "bias": get("gpt_neox.final_layer_norm.bias")},
+        }
+        if not cfg.tie_word_embeddings:
+            params["lm_head"] = {"w": get("embed_out.weight").T}
+    elif fam == "phi":
+        def layer(i):
+            p = f"model.layers.{i}."
+
+            def lin(n):
+                return {"w": get(p + n + ".weight").T,
+                        "b": get(p + n + ".bias")}
+            # single shared layernorm (cfg.shared_attn_mlp_norm): no
+            # mlp_norm leaf
+            return {
+                "attn_norm": {"scale": get(p + "input_layernorm.weight"),
+                              "bias": get(p + "input_layernorm.bias")},
+                "q": lin("self_attn.q_proj"),
+                "k": lin("self_attn.k_proj"),
+                "v": lin("self_attn.v_proj"),
+                "o": lin("self_attn.dense"),
+                "up": lin("mlp.fc1"),
+                "down": lin("mlp.fc2"),
+            }
+        params = {
+            "embed": {"tokens": get("model.embed_tokens.weight")},
+            "layers": _stack([layer(i) for i in range(cfg.num_layers)]),
+            "final_norm": {"scale": get("model.final_layernorm.weight"),
+                           "bias": get("model.final_layernorm.bias")},
+        }
+        if not cfg.tie_word_embeddings:
+            params["lm_head"] = {"w": get("lm_head.weight").T,
+                                 "b": get("lm_head.bias")}
+    elif fam == "falcon":
+        H, hd, KV = cfg.num_heads, cfg.head_dim, cfg.num_kv_heads
+        g = H // KV
+        two_norms = not cfg.shared_attn_mlp_norm   # new decoder arch
+
+        def layer(i):
+            p = f"transformer.h.{i}."
+            # fused QKV, grouped per kv head: [KV, g + 2, hd] out rows —
+            # g query heads then k then v per group (HF Falcon
+            # _split_heads; the 7B MQA layout is the KV == 1 case)
+            qkv_w = get(p + "self_attention.query_key_value.weight")
+            wg = qkv_w.reshape(KV, g + 2, hd, D)
+            bg = (get(p + "self_attention.query_key_value.bias"
+                      ).reshape(KV, g + 2, hd) if cfg.attn_bias else None)
+
+            def proj(sel, rows):
+                out = {"w": wg[:, sel].reshape(rows * hd, D).T}
+                if bg is not None:
+                    out["b"] = bg[:, sel].reshape(rows * hd)
+                return out
+
+            def lin(n, bias):
+                out = {"w": get(p + n + ".weight").T}
+                if bias:
+                    out["b"] = get(p + n + ".bias")
+                return out
+            lp = {
+                "q": proj(slice(0, g), H),
+                "k": proj(slice(g, g + 1), KV),
+                "v": proj(slice(g + 1, g + 2), KV),
+                "o": lin("self_attention.dense", cfg.o_bias_effective),
+                "up": lin("mlp.dense_h_to_4h", cfg.mlp_bias),
+                "down": lin("mlp.dense_4h_to_h", cfg.mlp_bias),
+            }
+            if two_norms:
+                lp["attn_norm"] = {"scale": get(p + "ln_attn.weight"),
+                                   "bias": get(p + "ln_attn.bias")}
+                lp["mlp_norm"] = {"scale": get(p + "ln_mlp.weight"),
+                                  "bias": get(p + "ln_mlp.bias")}
+            else:
+                lp["attn_norm"] = {
+                    "scale": get(p + "input_layernorm.weight"),
+                    "bias": get(p + "input_layernorm.bias")}
+            return lp
+        params = {
+            "embed": {"tokens": get("transformer.word_embeddings.weight")},
+            "layers": _stack([layer(i) for i in range(cfg.num_layers)]),
+            "final_norm": {"scale": get("transformer.ln_f.weight"),
+                           "bias": get("transformer.ln_f.bias")},
         }
         if not cfg.tie_word_embeddings:
             params["lm_head"] = {"w": get("lm_head.weight").T}

@@ -120,6 +120,53 @@ register(_llama("gemma-2b", 2048, 16384, 18, 8, 1, vocab=256000,
                     tie_word_embeddings=True, embed_scale=2048 ** 0.5,
                     norm_eps=1e-6, norm_offset=True))
 
+# --- MoE proxy (BASELINE.md config 4's measurable stand-in): Mixtral
+# itself cannot fit one v5e chip even int4, so this 8-expert ~2.6B-total
+# (~0.8B active) llama-layout MoE makes the dense-vs-capacity dispatch
+# trade measurable on the real chip (bench.py moe_* keys). ---
+register(_llama("moe-proxy-8e", 1536, 4096, 16, 12, 4, vocab=32000,
+                ctx=4096, theta=10000.0).replace(
+                    name="moe-proxy-8e", num_experts=8,
+                    num_experts_per_tok=2))
+
+# --- GPT-NeoX / Pythia: parallel residual, partial rotary, exact gelu ---
+register(ModelConfig(
+    name="pythia-6.9b", family="gpt-neox", vocab_size=50432,
+    hidden_size=4096, intermediate_size=16384, num_layers=32, num_heads=32,
+    num_kv_heads=32, head_dim=128, max_position_embeddings=2048,
+    norm_type="layernorm", activation="gelu_exact", gated_mlp=False,
+    position_embedding="rope", rope_theta=10000.0, rope_pct=0.25,
+    attn_bias=True, mlp_bias=True, tie_word_embeddings=False,
+    parallel_residual=True))
+register(ModelConfig(
+    name="pythia-1.4b", family="gpt-neox", vocab_size=50304,
+    hidden_size=2048, intermediate_size=8192, num_layers=24, num_heads=16,
+    num_kv_heads=16, head_dim=128, max_position_embeddings=2048,
+    norm_type="layernorm", activation="gelu_exact", gated_mlp=False,
+    position_embedding="rope", rope_theta=10000.0, rope_pct=0.25,
+    attn_bias=True, mlp_bias=True, tie_word_embeddings=False,
+    parallel_residual=True))
+
+# --- Phi-2: parallel residual + single shared norm, biased lm_head ---
+register(ModelConfig(
+    name="phi-2", family="phi", vocab_size=51200, hidden_size=2560,
+    intermediate_size=10240, num_layers=32, num_heads=32, num_kv_heads=32,
+    head_dim=80, max_position_embeddings=2048, norm_type="layernorm",
+    activation="gelu", gated_mlp=False, position_embedding="rope",
+    rope_theta=10000.0, rope_pct=0.4, attn_bias=True, mlp_bias=True,
+    lm_head_bias=True, tie_word_embeddings=False, parallel_residual=True,
+    shared_attn_mlp_norm=True))
+
+# --- Falcon-7B: MQA fused QKV, parallel residual + shared norm ---
+register(ModelConfig(
+    name="falcon-7b", family="falcon", vocab_size=65024, hidden_size=4544,
+    intermediate_size=18176, num_layers=32, num_heads=71, num_kv_heads=1,
+    head_dim=64, max_position_embeddings=2048, norm_type="layernorm",
+    activation="gelu_exact", gated_mlp=False, position_embedding="rope",
+    rope_theta=10000.0, attn_bias=False, mlp_bias=False,
+    tie_word_embeddings=True, parallel_residual=True,
+    shared_attn_mlp_norm=True))
+
 # --- Tiny configs for tests/dryrun (not real checkpoints) ---
 register(ModelConfig(
     name="tiny-gpt2", family="gpt2", vocab_size=256, hidden_size=64,

@@ -68,8 +68,9 @@ def param_specs(cfg: ModelConfig, spec: MeshSpec,
         "k": lin(P(L, None, kv_tp)),
         "v": lin(P(L, None, kv_tp)),
         "o": lin(P(L, "tp", None)),
-        "mlp_norm": norm_p(),
     }
+    if not cfg.shared_attn_mlp_norm:   # phi/falcon-7b: one norm per block
+        layers["mlp_norm"] = norm_p()
     if cfg.attn_bias:
         layers["q"]["b"] = P(L, "tp")
         layers["k"]["b"] = P(L, kv_tp)
@@ -110,6 +111,8 @@ def param_specs(cfg: ModelConfig, spec: MeshSpec,
         specs["embed"]["positions"] = P(None, None)
     if not cfg.tie_word_embeddings:
         specs["lm_head"] = lin(P(None, "tp"))
+        if cfg.lm_head_bias:   # phi
+            specs["lm_head"]["b"] = P("tp")
     return specs
 
 
@@ -127,15 +130,18 @@ def cache_specs(cfg: ModelConfig, spec: MeshSpec):
 
 
 def paged_cache_specs(cfg: ModelConfig, spec: MeshSpec):
-    """PagedKVCache sharding: [L, NB, bs, Hkv, hd] — kv heads over tp.
+    """PagedKVCache sharding: [L, NB, bs, Hkv, hd] — kv heads over tp,
+    layers over pp (pipeline stages own their layer slice of the pool,
+    parallel/paged_pipeline.py).
 
     The block axes (NB, bs) stay replicated: which blocks a slot owns is
     host-side scheduler state (runtime/batcher.py), identical on every
     device, so only the head dimension is worth splitting."""
     kv_tp = kv_head_axis(cfg.num_kv_heads, spec.tp)
-    kv = P(None, None, None, kv_tp, None)
+    L = "pp" if spec.pp > 1 else None
+    kv = P(L, None, None, kv_tp, None)
     from distributed_llm_inferencing_tpu.ops.paged_kvcache import PagedKVCache
-    scale = P(None, None, None, kv_tp) if cfg.kv_quant else None
+    scale = P(L, None, None, kv_tp) if cfg.kv_quant else None
     return PagedKVCache(k=kv, v=kv, k_scale=scale, v_scale=scale)
 
 

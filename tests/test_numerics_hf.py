@@ -263,6 +263,159 @@ def test_gemma_decode_matches_hf_generate():
     assert got == want
 
 
+def test_gpt_neox_matches_hf():
+    """GPT-NeoX/Pythia: parallel-residual blocks, per-head-interleaved
+    fused QKV, partial rotary (rotary_pct), exact (erf) gelu, untied
+    embed_out head."""
+    import transformers
+    torch_cfg = transformers.GPTNeoXConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=3, num_attention_heads=4,
+        max_position_embeddings=64, rotary_pct=0.25,
+        use_parallel_residual=True, tie_word_embeddings=False)
+    import torch
+    torch.manual_seed(11)
+    model = transformers.GPTNeoXForCausalLM(torch_cfg).eval()
+    cfg, _ = convert.load_hf_model(model, dtype=jnp.float32)
+    assert cfg.parallel_residual and cfg.rope_pct == 0.25
+    assert cfg.activation == "gelu_exact"
+    rng = np.random.default_rng(11)
+    tokens = rng.integers(0, 128, size=(2, 10), dtype=np.int64)
+    _check_model(model, tokens)
+
+
+def test_gpt_neox_sequential_residual_matches_hf():
+    """use_parallel_residual=False NeoX variants run the sequential
+    two-residual block — the conversion must carry the flag through."""
+    import transformers
+    torch_cfg = transformers.GPTNeoXConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=64, rotary_pct=0.5,
+        use_parallel_residual=False, tie_word_embeddings=False)
+    import torch
+    torch.manual_seed(12)
+    model = transformers.GPTNeoXForCausalLM(torch_cfg).eval()
+    cfg, _ = convert.load_hf_model(model, dtype=jnp.float32)
+    assert not cfg.parallel_residual
+    rng = np.random.default_rng(12)
+    tokens = rng.integers(0, 128, size=(1, 9), dtype=np.int64)
+    _check_model(model, tokens)
+
+
+def test_phi_matches_hf():
+    """Phi: parallel residual with ONE shared layernorm per block,
+    partial rotary, biases everywhere including the untied lm_head."""
+    import transformers
+    torch_cfg = transformers.PhiConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=4,
+        max_position_embeddings=64, partial_rotary_factor=0.5,
+        tie_word_embeddings=False)
+    import torch
+    torch.manual_seed(13)
+    model = transformers.PhiForCausalLM(torch_cfg).eval()
+    cfg, params = convert.load_hf_model(model, dtype=jnp.float32)
+    assert cfg.parallel_residual and cfg.shared_attn_mlp_norm
+    assert cfg.lm_head_bias and "b" in params["lm_head"]
+    assert "mlp_norm" not in params["layers"]
+    rng = np.random.default_rng(13)
+    tokens = rng.integers(0, 128, size=(2, 10), dtype=np.int64)
+    _check_model(model, tokens)
+
+
+def test_falcon_mqa_matches_hf():
+    """Falcon-7B layout: multi-query fused QKV (H query heads + 1 k +
+    1 v), parallel residual, single shared norm, no biases, tied head."""
+    import transformers
+    torch_cfg = transformers.FalconConfig(
+        vocab_size=128, hidden_size=32, num_hidden_layers=3,
+        num_attention_heads=4, multi_query=True,
+        new_decoder_architecture=False, parallel_attn=True, bias=False,
+        alibi=False, max_position_embeddings=64)
+    import torch
+    torch.manual_seed(14)
+    model = transformers.FalconForCausalLM(torch_cfg).eval()
+    cfg, _ = convert.load_hf_model(model, dtype=jnp.float32)
+    assert cfg.num_kv_heads == 1 and cfg.parallel_residual
+    assert cfg.shared_attn_mlp_norm
+    rng = np.random.default_rng(14)
+    tokens = rng.integers(0, 128, size=(2, 10), dtype=np.int64)
+    _check_model(model, tokens)
+
+
+def test_falcon_new_arch_matches_hf():
+    """Falcon new decoder architecture (40B/180B layout): grouped-KV
+    fused QKV with ln_attn + ln_mlp parallel norms."""
+    import transformers
+    torch_cfg = transformers.FalconConfig(
+        vocab_size=128, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, num_kv_heads=2,
+        new_decoder_architecture=True, parallel_attn=True, bias=False,
+        alibi=False, max_position_embeddings=64)
+    import torch
+    torch.manual_seed(15)
+    model = transformers.FalconForCausalLM(torch_cfg).eval()
+    cfg, params = convert.load_hf_model(model, dtype=jnp.float32)
+    assert cfg.num_kv_heads == 2 and not cfg.shared_attn_mlp_norm
+    assert "mlp_norm" in params["layers"]
+    rng = np.random.default_rng(15)
+    tokens = rng.integers(0, 128, size=(1, 8), dtype=np.int64)
+    _check_model(model, tokens)
+
+
+def test_falcon_alibi_rejected():
+    """Alibi positional encoding has no RoPE mapping — conversion must
+    refuse, and the error must name the supported families."""
+    import transformers
+    torch_cfg = transformers.FalconConfig(
+        vocab_size=128, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, alibi=True)
+    with pytest.raises(NotImplementedError, match="alibi"):
+        convert.config_from_hf(torch_cfg)
+
+    class FakeCfg:
+        model_type = "mamba"
+    with pytest.raises(NotImplementedError, match="gpt_neox"):
+        convert.config_from_hf(FakeCfg())
+
+
+def test_phi_decode_matches_hf_generate():
+    """Greedy decode parity for the phi deltas (shared-norm parallel
+    block + partial rotary on the decode path, biased head)."""
+    import torch
+    import transformers
+    torch_cfg = transformers.PhiConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=64, partial_rotary_factor=0.5,
+        tie_word_embeddings=False)
+    torch.manual_seed(16)
+    model = transformers.PhiForCausalLM(torch_cfg).eval()
+    cfg, params = convert.load_hf_model(model, dtype=jnp.float32)
+    cfg = cfg.replace(dtype="float32")
+
+    rng = np.random.default_rng(16)
+    prompt = rng.integers(4, 128, size=(1, 6), dtype=np.int64)
+    with torch.no_grad():
+        want = model.generate(
+            torch.tensor(prompt), max_new_tokens=8, do_sample=False,
+            pad_token_id=0)[0, 6:].tolist()
+
+    cache = init_cache(cfg, 1, 32, dtype=jnp.float32)
+    logits, cache = transformer.prefill(
+        params, cfg, jnp.asarray(prompt.astype(np.int32)),
+        jnp.asarray([6], jnp.int32), cache)
+    cur = int(np.argmax(np.asarray(logits)[0, 5]))
+    got = [cur]
+    for _ in range(7):
+        logits, cache = transformer.decode_step(
+            params, cfg, jnp.asarray([[cur]], jnp.int32), cache)
+        cur = int(np.argmax(np.asarray(logits)[0, 0]))
+        got.append(cur)
+    assert got == want
+
+
 def test_qwen2_mixed_window_rejected():
     """Qwen2's layer-indexed sliding window (full attention below
     max_window_layers) is not representable by the global
