@@ -149,13 +149,13 @@ class WorkerAgent:
         mesh = MeshSpec.from_dict(body.get("mesh", {}))
         t0 = time.time()
         if body.get("serving") == "batched" and any(
-                getattr(mesh, ax) > 1 for ax in ("dp", "pp", "sp")):
+                getattr(mesh, ax) > 1 for ax in ("dp", "sp")):
             # validate BEFORE any (possibly huge) checkpoint restore; the
-            # batcher shards tensors (tp/ep) but owns the batch dimension
-            # itself (runtime/batcher.py)
+            # batcher shards tensors (tp/ep) and pipeline stages (pp) but
+            # owns the batch dimension itself (runtime/batcher.py)
             return 400, {"status": "error",
-                         "message": "batched serving supports tp/ep mesh "
-                                    "axes only; drop dp/pp/sp or use "
+                         "message": "batched serving supports tp/ep/pp "
+                                    "mesh axes; drop dp/sp or use "
                                     "default mode"}
         if native:
             # converted-once artifact (models/checkpoint.py): no torch on

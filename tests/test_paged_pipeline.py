@@ -1,0 +1,174 @@
+"""Pipeline-parallel continuous batching (parallel/paged_pipeline.py).
+
+The contract: a batcher on a pp>1 mesh serves requests with outputs
+identical to the single-stage batcher — admission waves, decode chunks,
+prefix reuse and per-request PRNG streams all preserved — while the
+layer stack (params AND paged pool) lives sharded across stages. Run on
+the 8-virtual-CPU-device mesh (conftest.py), the same harness the dryrun
+uses (SURVEY.md §4).
+"""
+
+import numpy as np
+
+from distributed_llm_inferencing_tpu.models.registry import get_config
+from distributed_llm_inferencing_tpu.ops.sampling import SamplingParams
+from distributed_llm_inferencing_tpu.parallel.mesh import MeshSpec
+from distributed_llm_inferencing_tpu.runtime.batcher import ContinuousBatcher
+
+CFG = get_config("tiny-llama").replace(dtype="float32", attn_backend="xla")
+RNG = np.random.default_rng(0)
+
+
+def _run(b, reqs, steps=200):
+    for _ in range(steps):
+        b.step()
+        if all(r.done.is_set() for r in reqs):
+            break
+    return [r.wait() for r in reqs]
+
+
+def _submit_mixed(b):
+    base = RNG.integers(0, 256, 6).tolist()
+    prompts = [(base * 4)[:20],
+               RNG.integers(0, 256, 9).tolist(),
+               RNG.integers(0, 256, 13).tolist()]
+    return [
+        b.submit(prompts[0], max_new_tokens=14,
+                 sampling=SamplingParams.greedy(), seed=1),
+        b.submit(prompts[1], max_new_tokens=10,
+                 sampling=SamplingParams(temperature=0.8, top_k=40), seed=2),
+        b.submit(prompts[2], max_new_tokens=12,
+                 sampling=SamplingParams.greedy(), seed=3),
+    ]
+
+
+def test_pp_batcher_matches_dense():
+    """pp=2 batcher ≡ single-stage batcher: same tokens for greedy AND
+    sampled requests (per-slot PRNG streams are data, so the pipelined
+    program must reproduce them bit-for-bit)."""
+    global RNG
+    RNG = np.random.default_rng(0)
+    dense = ContinuousBatcher(CFG, num_blocks=96, block_size=8, slots=4,
+                              max_seq=64, seed=0)
+    want = _run(dense, _submit_mixed(dense))
+
+    RNG = np.random.default_rng(0)
+    pp = ContinuousBatcher(CFG, num_blocks=96, block_size=8, slots=4,
+                           max_seq=64, seed=0, mesh_spec=MeshSpec(pp=2))
+    got = _run(pp, _submit_mixed(pp))
+    assert got == want, (got, want)
+
+
+def test_pp_batcher_eos_budget_and_inflight_admission():
+    """Per-slot eos stops a pp-scheduled slot mid-chunk; freed slots
+    admit queued requests mid-flight exactly like the dense batcher."""
+    global RNG
+    RNG = np.random.default_rng(7)
+    prompts = [RNG.integers(0, 256, n).tolist() for n in (8, 11, 9, 7, 12)]
+
+    def run(mesh_spec):
+        b = ContinuousBatcher(CFG, num_blocks=96, block_size=8, slots=2,
+                              max_seq=64, seed=0, mesh_spec=mesh_spec)
+        # more requests than slots: forces queueing + in-flight admission
+        reqs = [b.submit(p, max_new_tokens=6 + i,
+                         sampling=SamplingParams.greedy(), seed=10 + i)
+                for i, p in enumerate(prompts)]
+        return _run(b, reqs)
+
+    want = run(None)
+    got = run(MeshSpec(pp=2))
+    assert got == want, (got, want)
+
+    # eos: derive it from a full run, then check truncation matches
+    b = ContinuousBatcher(CFG, num_blocks=96, block_size=8, slots=2,
+                          max_seq=64, seed=0, mesh_spec=MeshSpec(pp=2))
+    r_full = b.submit(prompts[0], max_new_tokens=10,
+                      sampling=SamplingParams.greedy(), seed=10)
+    full = _run(b, [r_full])[0]
+    eos = full[4]
+    b2 = ContinuousBatcher(CFG, num_blocks=96, block_size=8, slots=2,
+                           max_seq=64, seed=0, mesh_spec=MeshSpec(pp=2))
+    r_eos = b2.submit(prompts[0], max_new_tokens=10,
+                      sampling=SamplingParams.greedy(), seed=10,
+                      eos_token_id=eos)
+    got_eos = _run(b2, [r_eos])[0]
+    if eos not in full[:4]:
+        assert got_eos == full[:4], (got_eos, full)
+    assert eos not in got_eos
+
+
+def test_pp_batcher_prefix_reuse():
+    """Radix prefix hits survive the pp pool layout: a second request
+    sharing a long prompt prefix admits with a cached prefix (fewer
+    fresh blocks) and still matches the dense batcher's tokens."""
+    global RNG
+    RNG = np.random.default_rng(3)
+    head = RNG.integers(0, 256, 24).tolist()
+    p1 = head + RNG.integers(0, 256, 4).tolist()
+    p2 = head + RNG.integers(0, 256, 5).tolist()
+
+    def run(mesh_spec):
+        b = ContinuousBatcher(CFG, num_blocks=96, block_size=8, slots=2,
+                              max_seq=64, seed=0, mesh_spec=mesh_spec)
+        r1 = b.submit(p1, max_new_tokens=6,
+                      sampling=SamplingParams.greedy(), seed=1)
+        out1 = _run(b, [r1])[0]
+        hits0 = b.pool.stats()["prefix_hits"]
+        r2 = b.submit(p2, max_new_tokens=6,
+                      sampling=SamplingParams.greedy(), seed=2)
+        out2 = _run(b, [r2])[0]
+        hit = b.pool.stats()["prefix_hits"] > hits0
+        return out1, out2, hit
+
+    w1, w2, whit = run(None)
+    g1, g2, ghit = run(MeshSpec(pp=2))
+    assert (g1, g2) == (w1, w2)
+    assert ghit == whit
+
+
+def test_pp_batcher_lockstep_replay_evolves_identical_cache():
+    """The lockstep contract extends to the pp program kinds: a follower
+    replaying the leader's broadcast admit/decode args (JSON round-trip)
+    evolves a bit-identical pp-sharded paged pool."""
+    import json
+    import jax
+
+    mk = lambda: ContinuousBatcher(  # noqa: E731
+        CFG, num_blocks=64, block_size=8, slots=2, max_seq=64, seed=0,
+        mesh_spec=MeshSpec(pp=2))
+    leader, follower = mk(), mk()
+
+    def hook(kind, args, run):
+        follower.replay(kind, json.loads(json.dumps(args)))
+        return run()
+
+    leader.program_hook = hook
+    global RNG
+    RNG = np.random.default_rng(5)
+    prompts = [RNG.integers(0, 256, 9).tolist(),
+               RNG.integers(0, 256, 12).tolist()]
+    reqs = [leader.submit(p, max_new_tokens=8,
+                          sampling=SamplingParams.greedy(), seed=20 + i)
+            for i, p in enumerate(prompts)]
+    outs = _run(leader, reqs)
+    assert all(len(o) == 8 for o in outs)
+    np.testing.assert_array_equal(np.asarray(jax.device_get(leader.paged.k)),
+                                  np.asarray(jax.device_get(follower.paged.k)))
+    np.testing.assert_array_equal(np.asarray(jax.device_get(leader.paged.v)),
+                                  np.asarray(jax.device_get(follower.paged.v)))
+
+
+def test_pp_batcher_rejects_unsupported_combos():
+    import pytest
+    with pytest.raises(ValueError, match="speculative"):
+        ContinuousBatcher(CFG, num_blocks=32, block_size=8, slots=2,
+                          max_seq=64, mesh_spec=MeshSpec(pp=2),
+                          speculative="ngram")
+    with pytest.raises(ValueError, match="kv_quant|int8 KV"):
+        ContinuousBatcher(CFG.replace(kv_quant="int8"), num_blocks=32,
+                          block_size=8, slots=2, max_seq=64,
+                          mesh_spec=MeshSpec(pp=2))
+    # slots round UP to a pp multiple
+    b = ContinuousBatcher(CFG, num_blocks=32, block_size=8, slots=3,
+                          max_seq=64, mesh_spec=MeshSpec(pp=2))
+    assert b.slots == 4
