@@ -6,8 +6,10 @@ Prints ONE JSON line (always, rc=0 even if the TPU is down):
 - ours: distributed_llm_inferencing_tpu engine (jitted prefill+decode, bf16)
   on the default JAX backend (the real TPU chip under the driver). If the
   TPU backend is unavailable or hangs (probed hang-proof via
-  utils/platform.ensure_backend), the whole bench re-runs on CPU and the
-  line carries {"platform": "cpu", "degraded": true}.
+  utils/platform.ensure_backend), the bench re-probes for a bounded
+  window (DLI_BENCH_PROBE_WINDOW_S — tunnel wedges clear when the remote
+  recovers), then degrades: the whole bench re-runs on CPU and the line
+  carries {"platform": "cpu", "degraded": true}.
 - baseline: the reference's serving stack — HF transformers ``generate()``
   on torch CPU (the reference's worker hot loop, worker/app.py:297-305) —
   measured fresh in the same process, same model config, same sampling
@@ -19,25 +21,28 @@ Prints ONE JSON line (always, rc=0 even if the TPU is down):
   each actually runs on); it is not a like-for-like chip comparison. The
   line carries ``baseline_stack`` so the number can't be misread.
 
-Extra keys (best-effort; omitted rather than fatal when they fail):
-  gpt2_xl_int8_tokens_per_s    — 1.5B model, int8 weight-only, batch 1
-  gpt2_xl_int4_eq8_tokens_per_s — same model, int4 matmuls (pallas
-                                 fused-unpack kernel) + int8 embedding
-                                 table (the tied-head lever)
-  llama_3_8b_int8_tokens_per_s — the north-star model (BASELINE.md config
-                                 2), int8 weight-only, batch 1, one chip
-  llama_3_8b_int4_tokens_per_s — same model, nibble-packed int4 via the
-                                 pallas fused-unpack kernel
-                                 (ops/pallas/quant_matmul.py)
-  llama_3_8b_int8_batched_tokens_per_s — 8 concurrent streams
+Extra keys run in PRIORITY order (contract-critical first, long-tail
+extras last) so a mid-run failure or the time budget can never cost the
+headline numbers:
   batched_* — 8 concurrent gpt2 requests through the continuous batcher
-              (runtime/batcher.py), with TTFT/latency percentiles
+              (runtime/batcher.py)
+  llama_3_8b_int8|int4|int4_eq8_tokens_per_s — the north-star model
+              (BASELINE.md config 2): int8, nibble-packed int4 via the
+              pallas fused-unpack kernel (ops/pallas/quant_matmul.py),
+              and int4 + int8-quantized embed/unembed tables
   batched_greedy_rep[_spec]_tokens_per_s — greedy x8 on a repetitive
               workload, plain vs on-device-drafted speculative decoding
-              (transformer.paged_speculative_chunk): the acceptance story
+  batched_stag_x32_* — 32 requests with Poisson arrivals over ~1s:
+              honest TTFT/latency percentiles under staggered load
+              (single-wave percentiles are degenerate — p50 == p95)
+  prefill_chunk_stall_ms[_off] — max inter-token stall of an active
+              decode stream while a long prompt admits, chunked prefill
+              on vs off (the feature's entire point)
+  moe_* — fits-on-one-chip MoE proxy (registry moe-proxy-8e): decode
+              tok/s plus dense- vs capacity-dispatch prefill tok/s
+              (BASELINE.md config 4's measurable stand-in)
   *_hbm_bw_util — bytes-per-token (= weight bytes at batch 1) x tok/s
-                  against the chip's spec HBM bandwidth: how close the
-                  decode loop runs to its bandwidth roofline
+              against the chip's spec HBM bandwidth
 """
 
 import json
@@ -166,7 +171,7 @@ def _pct(sorted_vals, p):
 def bench_batched(model=MODEL, quant=None, n_requests=8,
                   new_tokens=NEW_TOKENS, dtype=None, repeats=2,
                   prompt_len=PROMPT_LEN, kv_quant=None,
-                  speculative=None, repetitive=False):
+                  speculative=None, repetitive=False, stagger_s=None):
     """Aggregate throughput + TTFT/latency percentiles: n concurrent
     requests through the continuous batcher (the serving path the
     reference fully serialized, reference worker/Dockerfile:47).
@@ -174,7 +179,13 @@ def bench_batched(model=MODEL, quant=None, n_requests=8,
     Drives ``step()`` synchronously (no scheduler thread) so the timed
     region is pure serving work, and warms with an identically-shaped
     workload first so the exact wave/chunk programs the timed run
-    launches are already compiled."""
+    launches are already compiled.
+
+    ``stagger_s``: spread submissions as Poisson arrivals over roughly
+    this many seconds instead of one burst — admission then happens
+    across many waves, so TTFT/latency percentiles reflect load instead
+    of a single wave's degenerate p50 == p95.
+    """
     import numpy as np
     from distributed_llm_inferencing_tpu.models.registry import get_config
     from distributed_llm_inferencing_tpu.ops.sampling import SamplingParams
@@ -189,9 +200,10 @@ def bench_batched(model=MODEL, quant=None, n_requests=8,
     if kv_quant:
         cfg = cfg.replace(kv_quant=kv_quant)
     max_seq = prompt_len + new_tokens + 16
+    slots = min(n_requests, 32)
     blocks = max(256, n_requests * (-(-max_seq // 16)) + 32)
     b = ContinuousBatcher(cfg, num_blocks=blocks, block_size=16,
-                          slots=n_requests, max_seq=max_seq, seed=0,
+                          slots=slots, max_seq=max_seq, seed=0,
                           speculative=speculative)
     rng = np.random.default_rng(0)
     # the speculative comparison measures greedy on BOTH arms (greedy is
@@ -210,14 +222,29 @@ def bench_batched(model=MODEL, quant=None, n_requests=8,
         # fresh prompts every run: same buckets/shapes (compiled programs
         # reused), no radix hits from a previous run's inserts
         prompts = [mk_prompt() for _ in range(n_requests)]
-        reqs = [b.submit(p, max_new_tokens=new_tokens, sampling=sp,
-                         seed=seed_base + i) for i, p in enumerate(prompts)]
+        offs = None
+        if stagger_s:
+            gaps = np.random.default_rng(seed_base).exponential(
+                stagger_s / n_requests, n_requests)
+            offs = np.cumsum(gaps)
+        reqs = []
+        nxt = 0
         t0 = time.perf_counter()
-        guard = 0
-        while not all(r.done.is_set() for r in reqs):
-            b.step()
-            guard += 1
-            assert guard < 10_000, "batched bench did not converge"
+        deadline = t0 + 600
+        while True:
+            now = time.perf_counter() - t0
+            while nxt < n_requests and (offs is None or offs[nxt] <= now):
+                reqs.append(b.submit(prompts[nxt],
+                                     max_new_tokens=new_tokens, sampling=sp,
+                                     seed=seed_base + nxt))
+                nxt += 1
+            busy = b.step()
+            if not busy and nxt < n_requests:
+                time.sleep(0.001)   # idle until the next Poisson arrival
+            assert time.perf_counter() < deadline, \
+                "batched bench did not converge"
+            if nxt >= n_requests and all(r.done.is_set() for r in reqs):
+                break
         dt = time.perf_counter() - t0
         for r in reqs:
             if r.error:
@@ -239,6 +266,85 @@ def bench_batched(model=MODEL, quant=None, n_requests=8,
                 "latency_ms_p95": round(_pct(lats, 95), 1),
             }
     return best, stats
+
+
+def bench_prefill_chunk_stall(model=MODEL, dtype=None, chunk=32,
+                              long_len=1536):
+    """How long one huge prompt stalls co-running decode — the number
+    chunked prefill exists to bound. An active request streams tokens
+    while a ``long_len``-token prompt admits; returns the active
+    stream's max inter-token gap (ms). Compare chunk=32 vs chunk=None."""
+    import numpy as np
+    from distributed_llm_inferencing_tpu.models.registry import get_config
+    from distributed_llm_inferencing_tpu.ops.sampling import SamplingParams
+    from distributed_llm_inferencing_tpu.runtime.batcher import (
+        ContinuousBatcher)
+
+    cfg = get_config(model)
+    if dtype:
+        cfg = cfg.replace(dtype=dtype)
+    bs = 16
+    max_seq = long_len + 96
+    blocks = 2 * (-(-max_seq // bs)) + 32
+    rng = np.random.default_rng(0)
+    sp = SamplingParams.greedy()
+
+    b = ContinuousBatcher(cfg, num_blocks=blocks, block_size=bs,
+                          slots=2, max_seq=max_seq, seed=0,
+                          prefill_chunk=chunk)
+    # small decode chunks: the stream callback fires per chunk, so the
+    # measured max-gap must be admission stall, not chunk duration
+    b.DECODE_CHUNKS = (8, 4, 2, 1)
+
+    def run(seed_base):
+        # fresh prompts each run: no radix hits, so every run drives the
+        # same (already compiled after run 1) admission/chunk programs
+        stamps = []
+        a = b.submit(rng.integers(0, cfg.vocab_size, 16).tolist(),
+                     max_new_tokens=64, sampling=sp, seed=seed_base,
+                     stream_cb=lambda tok: stamps.append(
+                         time.perf_counter()))
+        # let the short stream start, then the long prompt arrives
+        while len(a.tokens) < 4:
+            b.step()
+        long = b.submit(rng.integers(0, cfg.vocab_size, long_len).tolist(),
+                        max_new_tokens=2, sampling=sp, seed=seed_base + 1)
+        guard = 0
+        while not (a.done.is_set() and long.done.is_set()):
+            b.step()
+            guard += 1
+            assert guard < 10_000
+        for r in (a, long):
+            if r.error:
+                raise RuntimeError(r.error)
+        gaps = [(t1 - t0) * 1e3 for t0, t1 in zip(stamps, stamps[1:])]
+        return max(gaps)
+
+    run(1)   # warmup: compiles the admission + chunk programs
+    return min(run(100), run(200))
+
+
+def bench_moe_prefill(dispatch: str, prompt_len=512, dtype=None):
+    """MoE prefill throughput (tok/s through prefill) for one dispatch
+    strategy on the fits-on-one-chip proxy (registry moe-proxy-8e)."""
+    import numpy as np
+    from distributed_llm_inferencing_tpu.models.registry import get_config
+    from distributed_llm_inferencing_tpu.runtime.engine import InferenceEngine
+
+    cfg = get_config("moe-proxy-8e").replace(
+        quant="int8", moe_dispatch=dispatch)
+    if dtype:
+        cfg = cfg.replace(dtype=dtype)
+    eng = InferenceEngine(cfg, max_seq=prompt_len + 24, seed=0)
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, cfg.vocab_size, prompt_len).tolist()
+    sp = _sampling()
+    eng.generate([prompt], max_new_tokens=2, sampling=sp)   # warmup
+    best = 0.0
+    for _ in range(2):
+        res = eng.generate([prompt], max_new_tokens=2, sampling=sp)
+        best = max(best, prompt_len / (res.prefill_ms / 1e3))
+    return best
 
 
 def _reclaim():
@@ -278,11 +384,19 @@ def run_all(platform, degraded):
     # number reflects the machine, not the emulation
     dtype = "float32" if platform == "cpu" else None
     bw = None if platform == "cpu" else _chip_bw()
+    on_tpu = platform != "cpu"
+
+    def util(key, tok_s, pbytes):
+        if bw:
+            result[key] = round(pbytes * tok_s / bw, 3)
+
+    # ---- priority 1: the contract headline -------------------------------
     ours, pbytes = bench_engine(dtype=dtype)
     result["value"] = round(ours, 2)
-    if bw:
-        result["gpt2_hbm_bw_util"] = round(pbytes * ours / bw, 3)
+    util("gpt2_hbm_bw_util", ours, pbytes)
     print(f"ours: {ours:.2f} tok/s [{platform}]", file=sys.stderr)
+
+    # ---- priority 2: batched x8 (the >=3x-engine bar) --------------------
     try:
         tput, pstats = bench_batched(dtype=dtype)
         result["batched_throughput_tokens_per_s"] = round(tput, 2)
@@ -290,21 +404,31 @@ def run_all(platform, degraded):
         print(f"batched x8: {tput:.2f} tok/s {pstats}", file=sys.stderr)
     except Exception as e:  # extras never break the contract line
         print(f"batched bench skipped: {e!r}", file=sys.stderr)
-    if platform != "cpu" and not _over_budget("batched x16/x32"):   # wider slot counts: the throughput scaling story
-        for n in (16, 32):
+
+    # ---- priority 3: the north-star model, int8 then int4 ----------------
+    # (llama-3-8b, BASELINE.md config 2 — int4 is the pallas kernel's
+    # make-or-break model-level number, so it runs BEFORE any long tail)
+    if on_tpu:
+        for key, kw in (
+                ("llama_3_8b_int8", dict(quant="int8")),
+                ("llama_3_8b_int4", dict(quant="int4")),
+                ("llama_3_8b_int4_eq8", dict(quant="int4",
+                                             embed_quant="int8")),
+        ):
             _reclaim()
+            if _over_budget(key):
+                break
             try:
-                tput, pstats = bench_batched(n_requests=n, repeats=1)
-                result[f"batched_x{n}_tokens_per_s"] = round(tput, 2)
-                result[f"batched_x{n}_latency_ms_p50"] = pstats[
-                    "latency_ms_p50"]
-                print(f"batched x{n}: {tput:.2f} tok/s {pstats}",
-                      file=sys.stderr)
+                ll, llb = bench_engine("llama-3-8b", new_tokens=32,
+                                       repeats=2, **kw)
+                result[f"{key}_tokens_per_s"] = round(ll, 2)
+                util(f"{key}_hbm_bw_util", ll, llb)
+                print(f"{key}: {ll:.2f} tok/s", file=sys.stderr)
             except Exception as e:
-                print(f"batched x{n} bench skipped: {e!r}", file=sys.stderr)
-    if platform != "cpu" and not _over_budget("batched speculative"):
-        # on-device-drafted speculation, greedy x8 on a repetitive
-        # workload vs the same workload plain — the acceptance-rate story
+                print(f"{key} skipped: {e!r}", file=sys.stderr)
+
+    # ---- priority 4: batched speculative pair ----------------------------
+    if on_tpu and not _over_budget("batched speculative"):
         for tag, spec in (("", None), ("_spec", "ngram")):
             _reclaim()
             try:
@@ -317,7 +441,9 @@ def run_all(platform, degraded):
             except Exception as e:
                 print(f"batched spec{tag} bench skipped: {e!r}",
                       file=sys.stderr)
-    if platform != "cpu" and not _over_budget("long-ctx kv8"):   # int8 KV cache: the long-context serving lever
+
+    # ---- priority 5: long-context kv8 pair -------------------------------
+    if on_tpu and not _over_budget("long-ctx kv8"):
         for tag, kvq in (("", None), ("_kv8", "int8")):
             _reclaim()
             try:
@@ -328,33 +454,89 @@ def run_all(platform, degraded):
                       file=sys.stderr)
             except Exception as e:
                 print(f"batched long-ctx{tag} skipped: {e!r}", file=sys.stderr)
-    if platform != "cpu" and not _over_budget("big-model extras"):  # big random-init models are pointless on host cpu
+
+    # ---- priority 6: staggered-arrival percentiles (p50 != p95) ----------
+    if on_tpu and not _over_budget("staggered x32"):
+        _reclaim()
+        try:
+            tput, pstats = bench_batched(n_requests=32, repeats=2,
+                                         stagger_s=1.0)
+            result["batched_stag_x32_tokens_per_s"] = round(tput, 2)
+            result.update(
+                {f"batched_stag_x32_{k}": v for k, v in pstats.items()})
+            print(f"batched staggered x32: {tput:.2f} tok/s {pstats}",
+                  file=sys.stderr)
+        except Exception as e:
+            print(f"staggered x32 skipped: {e!r}", file=sys.stderr)
+
+    # ---- priority 7: chunked-prefill stall A/B ---------------------------
+    if on_tpu and not _over_budget("prefill-chunk A/B"):
+        _reclaim()
+        try:
+            on = bench_prefill_chunk_stall(chunk=32)
+            off = bench_prefill_chunk_stall(chunk=None)
+            result["prefill_chunk_stall_ms"] = round(on, 1)
+            result["prefill_chunk_stall_ms_off"] = round(off, 1)
+            print(f"prefill-chunk stall: on={on:.1f} ms off={off:.1f} ms",
+                  file=sys.stderr)
+        except Exception as e:
+            print(f"prefill-chunk A/B skipped: {e!r}", file=sys.stderr)
+
+    # ---- priority 8: MoE proxy (BASELINE.md config 4 stand-in) -----------
+    if on_tpu and not _over_budget("moe proxy"):
+        _reclaim()
+        try:
+            md, mdb = bench_engine("moe-proxy-8e", quant="int8",
+                                   new_tokens=32, repeats=2)
+            result["moe_decode_tokens_per_s"] = round(md, 2)
+            util("moe_decode_hbm_bw_util", md, mdb)
+            print(f"moe decode: {md:.2f} tok/s", file=sys.stderr)
+            _reclaim()
+            for disp in ("dense", "capacity"):
+                pf = bench_moe_prefill(disp)
+                result[f"moe_prefill_{disp}_tokens_per_s"] = round(pf, 2)
+                print(f"moe prefill {disp}: {pf:.2f} tok/s", file=sys.stderr)
+                _reclaim()
+        except Exception as e:
+            print(f"moe proxy skipped: {e!r}", file=sys.stderr)
+
+    # ---- long tail: scaling + other model families -----------------------
+    if on_tpu and not _over_budget("batched x16/x32"):
+        for n in (16, 32):
+            _reclaim()
+            try:
+                tput, pstats = bench_batched(n_requests=n, repeats=1)
+                result[f"batched_x{n}_tokens_per_s"] = round(tput, 2)
+                result[f"batched_x{n}_latency_ms_p50"] = pstats[
+                    "latency_ms_p50"]
+                print(f"batched x{n}: {tput:.2f} tok/s {pstats}",
+                      file=sys.stderr)
+            except Exception as e:
+                print(f"batched x{n} bench skipped: {e!r}", file=sys.stderr)
+    if on_tpu and not _over_budget("big-model extras"):
         _reclaim()
         try:
             xl, xlb = bench_engine("gpt2-xl", quant="int8", new_tokens=32,
                                    repeats=2)
             result["gpt2_xl_int8_tokens_per_s"] = round(xl, 2)
-            if bw:
-                result["gpt2_xl_int8_hbm_bw_util"] = round(xlb * xl / bw, 3)
+            util("gpt2_xl_int8_hbm_bw_util", xl, xlb)
             print(f"gpt2-xl int8: {xl:.2f} tok/s", file=sys.stderr)
         except Exception as e:
             print(f"gpt2-xl bench skipped: {e!r}", file=sys.stderr)
         _reclaim()
         try:
-            if _over_budget("llama-3-8b"):
+            if _over_budget("gpt2-xl int4+eq8"):
                 raise RuntimeError("budget")
-            # the north-star model (BASELINE.md config 2): 8B int8 ≈ 8.5 GB
-            # weights — fits one v5e chip; random-init direct-to-int8
-            # (models/params.py) so no bf16 tree ever materializes
-            ll, llb = bench_engine("llama-3-8b", quant="int8",
-                                   new_tokens=32, repeats=2)
-            result["llama_3_8b_int8_tokens_per_s"] = round(ll, 2)
-            if bw:
-                result["llama_3_8b_int8_hbm_bw_util"] = round(
-                    llb * ll / bw, 3)
-            print(f"llama-3-8b int8: {ll:.2f} tok/s", file=sys.stderr)
+            # tied-head family full quant story: int4 matmuls (pallas
+            # kernel) + int8 embedding table (the 161 MB/token unembed)
+            xq, xqb = bench_engine("gpt2-xl", quant="int4",
+                                   embed_quant="int8", new_tokens=32,
+                                   repeats=2)
+            result["gpt2_xl_int4_eq8_tokens_per_s"] = round(xq, 2)
+            util("gpt2_xl_int4_eq8_hbm_bw_util", xq, xqb)
+            print(f"gpt2-xl int4+eq8: {xq:.2f} tok/s", file=sys.stderr)
         except Exception as e:
-            print(f"llama-3-8b bench skipped: {e!r}", file=sys.stderr)
+            print(f"gpt2-xl int4+eq8 bench skipped: {e!r}", file=sys.stderr)
         _reclaim()
         try:
             if _over_budget("llama-3-8b batched"):
@@ -377,51 +559,13 @@ def run_all(platform, degraded):
             print(f"llama-3-8b batched bench skipped: {e!r}", file=sys.stderr)
         _reclaim()
         try:
-            if _over_budget("gpt2-xl int4+eq8"):
-                raise RuntimeError("budget")
-            # full quant story for the tied-head family: int4 matmuls
-            # (pallas kernel) + int8 embedding table — at xl scale the
-            # tied unembed (161 MB bf16/token) dominates once the layer
-            # weights shrink, so quantizing the table is what unlocks
-            # the int4 win here
-            xq, xqb = bench_engine("gpt2-xl", quant="int4",
-                                   embed_quant="int8", new_tokens=32,
-                                   repeats=2)
-            result["gpt2_xl_int4_eq8_tokens_per_s"] = round(xq, 2)
-            if bw:
-                result["gpt2_xl_int4_eq8_hbm_bw_util"] = round(
-                    xqb * xq / bw, 3)
-            print(f"gpt2-xl int4+eq8: {xq:.2f} tok/s", file=sys.stderr)
-        except Exception as e:
-            print(f"gpt2-xl int4+eq8 bench skipped: {e!r}", file=sys.stderr)
-        _reclaim()
-        try:
-            if _over_budget("llama-3-8b int4"):
-                raise RuntimeError("budget")
-            # int4 nibble-packed weights through the pallas fused-unpack
-            # kernel (ops/pallas/quant_matmul.py): halves the 8B weight
-            # stream again — the decode roofline doubles
-            l4, l4b = bench_engine("llama-3-8b", quant="int4",
-                                   new_tokens=32, repeats=2)
-            result["llama_3_8b_int4_tokens_per_s"] = round(l4, 2)
-            if bw:
-                result["llama_3_8b_int4_hbm_bw_util"] = round(
-                    l4b * l4 / bw, 3)
-            print(f"llama-3-8b int4: {l4:.2f} tok/s", file=sys.stderr)
-        except Exception as e:
-            print(f"llama-3-8b int4 bench skipped: {e!r}", file=sys.stderr)
-        _reclaim()
-        try:
-            # BASELINE.md config 3: Mistral-7B (sliding-window attn),
-            # int8 on one chip
+            # BASELINE.md config 3: Mistral-7B (sliding-window attn)
             if _over_budget("mistral-7b"):
                 raise RuntimeError("budget")
             ms, msb = bench_engine("mistral-7b", quant="int8",
                                    new_tokens=32, repeats=2)
             result["mistral_7b_int8_tokens_per_s"] = round(ms, 2)
-            if bw:
-                result["mistral_7b_int8_hbm_bw_util"] = round(
-                    msb * ms / bw, 3)
+            util("mistral_7b_int8_hbm_bw_util", ms, msb)
             print(f"mistral-7b int8: {ms:.2f} tok/s", file=sys.stderr)
         except Exception as e:
             print(f"mistral-7b bench skipped: {e!r}", file=sys.stderr)
@@ -451,6 +595,23 @@ def main():
         ensure_backend("cpu")
     else:
         info = ensure_backend()
+        # A wedged tunnel (e.g. a prior process killed mid-compile) clears
+        # when the remote recovers — re-probe inside a bounded window
+        # before conceding a degraded CPU run. The probe is subprocess-
+        # isolated and hang-proof, so the worst case is the window itself
+        # (a machine with no TPU at all pays it too — keep the default
+        # modest, and set the window to 0 to skip re-probing entirely).
+        window = float(os.environ.get("DLI_BENCH_PROBE_WINDOW_S", 300))
+        deadline = _T0 + window
+        while info["degraded"] and time.time() < deadline:
+            wait = min(60.0, max(1.0, deadline - time.time()))
+            print(f"TPU probe degraded; re-probing in {wait:.0f}s "
+                  f"(window {window:.0f}s)", file=sys.stderr)
+            time.sleep(wait)
+            info = ensure_backend(attempts=1)
+        # probing time must not eat the extras budget: restart the clock
+        global _T0
+        _T0 = time.time()
     try:
         result = run_all(info["platform"], info["degraded"])
     except Exception as e:
