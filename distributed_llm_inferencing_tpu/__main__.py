@@ -44,6 +44,12 @@ def main(argv=None):
     w.add_argument("--followers",
                    help="leader only: comma-separated follower host:port "
                         "worker addresses (processes 1..N-1)")
+    w.add_argument("--latejoin", action="store_true",
+                   help="restarted host: record the distributed identity "
+                        "(--num_processes/--process_id) WITHOUT joining — "
+                        "the old coordinator died with the slice; the "
+                        "leader's elastic recovery orders a fresh join "
+                        "via /lockstep/reinit_dist")
     w.add_argument("--platform",
                    help="force the jax platform (tpu|cpu) before device "
                         "init — e.g. cpu for transport testing")
@@ -135,11 +141,19 @@ def main(argv=None):
 
     if args.cmd == "worker":
         from distributed_llm_inferencing_tpu.runtime.worker import WorkerAgent
-        if args.coordinator:
+        if args.coordinator or args.latejoin:
             from distributed_llm_inferencing_tpu.runtime.multihost import (
-                LockstepFollower, LockstepLeader, init_multihost)
-            pid, n = init_multihost(args.coordinator, args.num_processes,
-                                    args.process_id)
+                LockstepFollower, LockstepLeader, configure_multihost,
+                init_multihost)
+            if args.latejoin:
+                if args.num_processes is None or args.process_id is None:
+                    sys.exit("--latejoin needs --num_processes and "
+                             "--process_id")
+                configure_multihost(args.num_processes, args.process_id)
+                pid, n = args.process_id, args.num_processes
+            else:
+                pid, n = init_multihost(args.coordinator,
+                                        args.num_processes, args.process_id)
             agent = WorkerAgent()
             if pid == 0:
                 followers = [f for f in (args.followers or "").split(",") if f]

@@ -129,6 +129,25 @@ def _replace_route(service: httpd.JsonHTTPService, method: str,
 MIRRORED_OPS = ("load_model", "load_shard", "unload_model", "inference")
 
 
+def _fresh_coordinator() -> str:
+    """A new coordinator address on the original coordinator's host (the
+    leader) — fresh port, so the dying job's service can never collide.
+    A restarted LEADER has no prior address to derive from (127.0.0.1
+    would be unreachable for remote followers) — the operator must pass
+    one explicitly."""
+    import socket
+    if not _DIST_STATE["coordinator"]:
+        raise RuntimeError(
+            "restarted leader has no prior coordinator address; pass "
+            '{"coordinator": "host:port"} to /lockstep/recover')
+    host = _DIST_STATE["coordinator"].rsplit(":", 1)[0]
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return f"{host}:{port}"
+
+
 RECOVERY_POLL_S = 2.0   # degraded-leader probe cadence for follower return
 
 
@@ -161,6 +180,7 @@ class LockstepLeader:
         self._seq = 0
         self._epoch = 0
         self._degraded: Optional[str] = None
+        self._recovering = False
         self._loaded: Dict[str, dict] = {}   # model -> last load body
         self._recovery_thread: Optional[threading.Thread] = None
         self._handlers: Dict[str, Callable] = {}
@@ -313,6 +333,15 @@ class LockstepLeader:
         """Epoch-bumped slice recovery: reset every follower's lockstep
         state, restart sequence numbering, replay model loads.
 
+        On a slice with a jax.distributed job (init_multihost), recovery
+        additionally RE-FORMS the distributed runtime: every model is
+        dropped (its arrays belong to the dying job), every host rejoins
+        a fresh coordinator (``/lockstep/reinit_dist`` on followers, then
+        the leader's own blocking join — which doubles as the barrier
+        that every host made it), and the replayed loads re-shard params
+        onto the new job's devices. ``{"coordinator": "host:port"}``
+        overrides the fresh coordinator address.
+
         ``{"force": true}`` runs the protocol even when the leader does
         not consider the slice degraded (operator escape hatch for states
         the leader cannot see). Epochs are adopted from the followers
@@ -320,10 +349,21 @@ class LockstepLeader:
         followers that lived through earlier epochs.
         """
         with self._mirror_lock:
+            if self._recovering:
+                return {"status": "success",
+                        "message": "recovery already in progress"}
             if not self._degraded and not body.get("force"):
                 return {"status": "success",
                         "message": "slice not degraded; nothing to recover "
                                    "(pass {\"force\": true} to override)"}
+            self._recovering = True
+        try:
+            return self._recover_inner(body)
+        finally:
+            self._recovering = False
+
+    def _recover_inner(self, body):
+        with self._mirror_lock:
             for f in self.followers:   # adopt the highest epoch out there
                 try:
                     st = http.get(f"{f}/lockstep/status",
@@ -338,14 +378,49 @@ class LockstepLeader:
                               headers=self._headers(),
                               timeout=FORWARD_TIMEOUT)
                 r.raise_for_status()
+            reloads = list(self._loaded.items())
+            self._loaded = {}
+            # mirrored ops keep failing fast while the (lockless) rejoin
+            # below runs — holding the lock across a 120s blocking join
+            # would hang /lockstep/status and turn fast 503s into client
+            # timeouts
+            self._degraded = self._degraded or "recovery in progress"
+        try:
+            if _DIST_STATE["num_processes"] > 0:
+                # drop stale-job models BEFORE tearing down backends (the
+                # followers' reset already dropped theirs)
+                for name, _ in reloads:
+                    try:
+                        self.agent.unload_model({"model_name": name})
+                    except Exception as e:
+                        log.warning("pre-rejoin unload of %s: %s", name, e)
+                new_coord = body.get("coordinator") or _fresh_coordinator()
+                log.info("re-forming jax.distributed at %s", new_coord)
+                for f in self.followers:
+                    r = http.post(f"{f}/lockstep/reinit_dist",
+                                  json={"coordinator": new_coord},
+                                  headers=self._headers(),
+                                  timeout=FORWARD_TIMEOUT)
+                    r.raise_for_status()
+                # blocking join: returns only once every follower joined
+                reinit_multihost(new_coord)
+        except Exception as e:
+            with self._mirror_lock:
+                # restore the replay state — a retried recovery must not
+                # "succeed" with the model loads silently dropped
+                merged = dict(reloads)
+                merged.update(self._loaded)
+                self._loaded = merged
+                self._degraded = f"distributed rejoin failed: {e}"
+            self._start_recovery()
+            raise
+        with self._mirror_lock:
             self._seq = 0
             # fresh executor: its _next restarts at 0 alongside the seq
             # counter (the old one would treat replayed seq 0 as stale)
             self.exec.stop()
             self.exec = LockstepExecutor()
             self._degraded = None
-            reloads = list(self._loaded.items())
-            self._loaded = {}
         # Rebuild every model on every host through the normal mirrored
         # path: the leader drops its own copy first so leader and follower
         # reconstruct identical fresh state (engines are deterministic from
@@ -364,6 +439,10 @@ class LockstepLeader:
                 errors.append(f"{name}: {e}")
         if errors:
             with self._mirror_lock:
+                # keep un-replayed loads for the retry (successful ones
+                # re-registered themselves through the mirrored handler)
+                for name, entry in reloads:
+                    self._loaded.setdefault(name, entry)
                 self._degraded = f"recovery replay failed: {errors[0]}"
             self._start_recovery()
             raise RuntimeError(self._degraded)
@@ -453,9 +532,12 @@ class LockstepFollower:
             "batcher_program": self._batcher_program,
             "noop": lambda body: {"status": "noop"},
         }
+        self._dist_error: Optional[str] = None
+        self._dist_thread: Optional[threading.Thread] = None
         s = agent.service
         s.add("POST", "/lockstep", self.lockstep)
         s.add("POST", "/lockstep/reset", self.reset)
+        s.add("POST", "/lockstep/reinit_dist", self.reinit_dist)
         s.add("GET", "/lockstep/status", self.status)
         for op in MIRRORED_OPS + ("inference_stream",):
             _replace_route(s, "POST", f"/{op}", self._rejected(op))
@@ -463,7 +545,41 @@ class LockstepFollower:
     def status(self, body):
         return {"status": "ok", "role": "follower", "epoch": self._epoch,
                 "next_seq": self.exec._next, "last_recv": self._last_recv,
-                "loaded": sorted(self.agent.models)}
+                "loaded": sorted(self.agent.models),
+                "dist": {**dist_status(), "error": self._dist_error}}
+
+    def reinit_dist(self, body):
+        """Leader-ordered distributed rejoin: join the fresh coordinator
+        in a background thread (jax.distributed.initialize blocks until
+        EVERY host connects — the leader joins last, so responding first
+        is what lets the barrier complete). An in-flight join refuses a
+        second order: two concurrent reinit_multihost calls would race on
+        jax's global distributed state — the leader's recovery retries
+        after the stale join times out."""
+        coord = (body or {}).get("coordinator")
+        if not coord:
+            return 400, {"status": "error", "message": "coordinator required"}
+        if _DIST_STATE["num_processes"] <= 0:
+            return 409, {"status": "error",
+                         "message": "host has no distributed identity"}
+        if self._dist_thread is not None and self._dist_thread.is_alive():
+            return 409, {"status": "error",
+                         "message": "distributed rejoin already in flight"}
+
+        def join():
+            try:
+                reinit_multihost(coord)
+                self._dist_error = None
+                log.info("rejoined jax.distributed at %s", coord)
+            except Exception as e:
+                self._dist_error = f"rejoin failed: {e}"
+                log.error("distributed rejoin failed: %s", e)
+
+        self._dist_error = "joining"
+        self._dist_thread = threading.Thread(target=join, daemon=True,
+                                             name="dist-rejoin")
+        self._dist_thread.start()
+        return {"status": "joining", "coordinator": coord}
 
     def reset(self, body):
         """Leader-ordered epoch reset: wipe lockstep ordering state and all
@@ -548,10 +664,93 @@ class LockstepFollower:
         return {"status": "queued", "seq": seq}
 
 
+# This host's distributed identity — what a fresh jax.distributed job
+# needs to re-form after a host restart (reinit_multihost). coordinator
+# is None when configured-but-not-joined (a restarted host whose old
+# coordinator epoch is gone).
+_DIST_STATE = {"coordinator": None, "num_processes": 0, "process_id": -1}
+
+
 def init_multihost(coordinator: str, num_processes: int, process_id: int):
-    """Join the slice's jax.distributed job (before any jax device use)."""
+    """Join the slice's jax.distributed job (before any jax device use).
+
+    Recoverability is enabled so a surviving host OUTLIVES a peer's death
+    (jaxlib's default coordination client terminates the whole process
+    when any task dies — which would turn one lost host into a lost
+    slice, making elastic recovery impossible by construction)."""
     import jax
+    jax.config.update("jax_enable_recoverability", True)
     jax.distributed.initialize(coordinator_address=coordinator,
                                num_processes=num_processes,
                                process_id=process_id)
+    _DIST_STATE.update(coordinator=coordinator, num_processes=num_processes,
+                       process_id=process_id)
+    return jax.process_index(), jax.process_count()
+
+
+def configure_multihost(num_processes: int, process_id: int):
+    """Record this host's distributed identity WITHOUT joining a job — a
+    restarted host whose old coordinator is gone starts this way and
+    waits for the leader's recovery to order a fresh join
+    (``/lockstep/reinit_dist`` -> reinit_multihost)."""
+    _DIST_STATE.update(coordinator=None, num_processes=num_processes,
+                       process_id=process_id)
+
+
+def dist_status() -> dict:
+    return {"configured": _DIST_STATE["num_processes"] > 0,
+            "joined": _DIST_STATE["coordinator"] is not None,
+            "process_id": _DIST_STATE["process_id"],
+            "num_processes": _DIST_STATE["num_processes"]}
+
+
+# Orphaned distributed runtimes from before a rejoin. Deliberately kept
+# alive: a graceful shutdown of the old job cannot complete (its shutdown
+# barrier waits for the very peer whose death triggered recovery), and
+# letting the client/service destruct fires a ShutdownTask RPC whose
+# failure path is process-FATAL in jaxlib (client.h). Leaked threads are
+# the price of surviving; real deployments recycle hosts eventually.
+_GRAVEYARD: list = []
+
+
+def reinit_multihost(coordinator: str, timeout_s: float = 120.0):
+    """Abandon this process's jax.distributed runtime (if any) and join a
+    FRESH job at ``coordinator`` — the real-slice elastic-recovery step
+    the control-plane epoch reset alone cannot provide.
+
+    The old job is never shut down gracefully (see _GRAVEYARD) — its
+    client/service objects are detached and kept referenced, then
+    backends are cleared: live arrays from the old job (sharded params,
+    caches) die with it, which is why recovery unloads every model
+    BEFORE the rejoin and replays the loads after.
+    """
+    import gc
+
+    import jax
+    from jax._src import distributed as jdist
+    from jax.extend import backend as jex_backend
+
+    if _DIST_STATE["num_processes"] <= 0:
+        raise RuntimeError("host has no distributed identity "
+                           "(init_multihost/configure_multihost not called)")
+    gs = jdist.global_state
+    if gs.client is not None or gs.service is not None:
+        log.warning("abandoning the previous jax.distributed job "
+                    "(graceful shutdown cannot complete with a dead peer)")
+        _GRAVEYARD.append((gs.client, gs.service,
+                           gs.preemption_sync_manager))
+        gs.client = None
+        gs.service = None
+        gs.preemption_sync_manager = None
+        gs.process_id = 0
+    gc.collect()
+    jax.clear_caches()
+    jex_backend.clear_backends()
+    jax.config.update("jax_enable_recoverability", True)
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=_DIST_STATE["num_processes"],
+        process_id=_DIST_STATE["process_id"],
+        initialization_timeout=int(timeout_s))
+    _DIST_STATE["coordinator"] = coordinator
     return jax.process_index(), jax.process_count()
