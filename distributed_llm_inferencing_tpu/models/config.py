@@ -35,12 +35,23 @@ class ModelConfig:
     norm_eps: float = 1e-5
     activation: str = "gelu"  # gelu (tanh approx) | gelu_exact | silu | relu
     gated_mlp: bool = False  # llama-style SwiGLU (gate+up) vs plain fc
-    position_embedding: str = "learned"  # learned | rope
+    # learned | rope | alibi (BLOOM/Falcon-RW: linear attention bias,
+    # position-free K/V — the cache layout matches the RoPE families')
+    position_embedding: str = "learned"
+    # Multiplier on the ALiBi slopes: BLOOM adds the bias to the SCALED
+    # scores (1.0); Falcon-RW scales (scores + bias) together, i.e. the
+    # bias carries an extra 1/sqrt(head_dim).
+    alibi_scale: float = 1.0
     rope_theta: float = 10000.0
     # Partial rotary (GPT-NeoX rotary_pct / Phi partial_rotary_factor):
     # only the first rope_pct * head_dim dims rotate, the rest pass
     # through position-free.
     rope_pct: float = 1.0
+    # GPT-J rotate_every_two convention: frequency i rotates dims
+    # (2i, 2i+1) instead of HF-llama's (i, i + rot/2) halves.
+    rope_interleaved: bool = False
+    # BLOOM: layernorm applied to the embedding output.
+    embed_norm: bool = False
     attn_bias: bool = True
     # Qwen2-style asymmetric attention bias: q/k/v carry bias, the output
     # projection does not. None => o follows attn_bias.

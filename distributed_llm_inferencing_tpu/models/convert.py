@@ -64,7 +64,8 @@ def _act_from_hf(name: str) -> str:
 
 
 SUPPORTED_MODEL_TYPES = ("gpt2", "opt", "llama", "mistral", "mixtral",
-                         "qwen2", "gemma", "gpt_neox", "phi", "falcon")
+                         "qwen2", "gemma", "gpt_neox", "phi", "falcon",
+                         "bloom", "gptj")
 
 
 def config_from_hf(hf_config) -> ModelConfig:
@@ -191,16 +192,14 @@ def config_from_hf(hf_config) -> ModelConfig:
                                         False),
             parallel_residual=True, shared_attn_mlp_norm=True)
     if mt == "falcon":
-        # Falcon: parallel-residual blocks, fused grouped/MQA QKV, exact
-        # gelu, no biases. Two shapes map: the 7B layout (multi_query,
-        # single shared norm) and the new decoder architecture
-        # (grouped-KV, ln_attn + ln_mlp). Alibi models are positional-
-        # encoding-incompatible and refused.
-        if getattr(hf_config, "alibi", False):
-            raise NotImplementedError("falcon with alibi positions")
-        if not getattr(hf_config, "parallel_attn", True):
-            raise NotImplementedError("falcon without parallel_attn")
+        # Falcon: fused grouped/MQA QKV, exact gelu, no biases. Three
+        # shapes map: the 7B layout (multi_query, parallel residual,
+        # single shared norm), the new decoder architecture (grouped-KV,
+        # ln_attn + ln_mlp parallel norms), and the RW layout (per-head
+        # fused QKV, sequential residual, ALiBi positions).
         new_arch = getattr(hf_config, "new_decoder_architecture", False)
+        parallel = getattr(hf_config, "parallel_attn", True)
+        alibi = getattr(hf_config, "alibi", False)
         if new_arch and getattr(hf_config, "num_ln_in_parallel_attn",
                                 None) == 1:
             raise NotImplementedError("falcon new-arch with a single "
@@ -226,12 +225,65 @@ def config_from_hf(hf_config) -> ModelConfig:
             norm_eps=getattr(hf_config, "layer_norm_epsilon", 1e-5),
             activation=_act_from_hf(getattr(hf_config, "activation",
                                             "gelu")),
-            gated_mlp=False, position_embedding="rope",
+            gated_mlp=False,
+            position_embedding="alibi" if alibi else "rope",
+            # falcon scales (scores + alibi) by 1/sqrt(hd) together
+            alibi_scale=(hf_config.hidden_size // heads) ** -0.5
+            if alibi else 1.0,
             rope_theta=getattr(hf_config, "rope_theta", 10000.0),
             attn_bias=bias, mlp_bias=bias,
             tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
                                         True),
-            parallel_residual=True, shared_attn_mlp_norm=not new_arch)
+            parallel_residual=parallel,
+            shared_attn_mlp_norm=parallel and not new_arch)
+    if mt == "bloom":
+        # BLOOM: ALiBi positions, layernormed embedding output, per-head
+        # interleaved fused QKV, tanh-gelu, tied 250k-vocab head.
+        heads = hf_config.n_head
+        return ModelConfig(
+            name=getattr(hf_config, "name_or_path", mt) or mt,
+            family="bloom", vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            intermediate_size=4 * hf_config.hidden_size,
+            num_layers=hf_config.n_layer, num_heads=heads,
+            num_kv_heads=heads,
+            head_dim=hf_config.hidden_size // heads,
+            max_position_embeddings=getattr(hf_config, "seq_length", None)
+            or 2048,
+            norm_type="layernorm",
+            norm_eps=hf_config.layer_norm_epsilon,
+            activation="gelu", gated_mlp=False,
+            position_embedding="alibi", embed_norm=True,
+            attn_bias=True, mlp_bias=True,
+            tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
+                                        True))
+    if mt == "gptj":
+        # GPT-J: parallel residual with ONE shared layernorm, partial
+        # INTERLEAVED rotary (rotate_every_two over rotary_dim dims),
+        # bias-free attention, biased MLP and untied biased lm_head.
+        heads = hf_config.n_head
+        hd = hf_config.n_embd // heads
+        return ModelConfig(
+            name=getattr(hf_config, "name_or_path", mt) or mt,
+            family="gptj", vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.n_embd,
+            intermediate_size=getattr(hf_config, "n_inner", None)
+            or 4 * hf_config.n_embd,
+            num_layers=hf_config.n_layer, num_heads=heads,
+            num_kv_heads=heads, head_dim=hd,
+            max_position_embeddings=hf_config.n_positions,
+            norm_type="layernorm",
+            norm_eps=hf_config.layer_norm_epsilon,
+            activation=_act_from_hf(hf_config.activation_function),
+            gated_mlp=False, position_embedding="rope",
+            rope_theta=10000.0,
+            rope_pct=(getattr(hf_config, "rotary_dim", None) or hd) / hd,
+            rope_interleaved=True,
+            attn_bias=False, o_bias=False, mlp_bias=True,
+            lm_head_bias=True,
+            tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
+                                        False),
+            parallel_residual=True, shared_attn_mlp_norm=True)
     raise NotImplementedError(
         f"unsupported HF model_type {mt!r}; supported: "
         f"{', '.join(SUPPORTED_MODEL_TYPES)}")
@@ -463,10 +515,17 @@ def convert_state_dict(cfg: ModelConfig, sd, dtype=None):
                 "down": lin("mlp.dense_4h_to_h", cfg.mlp_bias),
             }
             if two_norms:
-                lp["attn_norm"] = {"scale": get(p + "ln_attn.weight"),
-                                   "bias": get(p + "ln_attn.bias")}
-                lp["mlp_norm"] = {"scale": get(p + "ln_mlp.weight"),
-                                  "bias": get(p + "ln_mlp.bias")}
+                # new decoder arch names them ln_attn/ln_mlp; the RW
+                # sequential layout reuses the llama-style pair
+                if p + "ln_attn.weight" in sd:
+                    attn_n, mlp_n = "ln_attn", "ln_mlp"
+                else:
+                    attn_n, mlp_n = ("input_layernorm",
+                                     "post_attention_layernorm")
+                lp["attn_norm"] = {"scale": get(p + attn_n + ".weight"),
+                                   "bias": get(p + attn_n + ".bias")}
+                lp["mlp_norm"] = {"scale": get(p + mlp_n + ".weight"),
+                                  "bias": get(p + mlp_n + ".bias")}
             else:
                 lp["attn_norm"] = {
                     "scale": get(p + "input_layernorm.weight"),
@@ -480,6 +539,80 @@ def convert_state_dict(cfg: ModelConfig, sd, dtype=None):
         }
         if not cfg.tie_word_embeddings:
             params["lm_head"] = {"w": get("lm_head.weight").T}
+    elif fam == "bloom":
+        H, hd = cfg.num_heads, cfg.head_dim
+
+        def layer(i):
+            p = f"transformer.h.{i}."
+            # fused QKV, per-head interleaved [H, 3, hd] (HF
+            # BloomAttention._reshape)
+            w3 = get(p + "self_attention.query_key_value.weight"
+                     ).reshape(H, 3, hd, D)
+            b3 = get(p + "self_attention.query_key_value.bias"
+                     ).reshape(H, 3, hd)
+
+            def proj(j):
+                return {"w": w3[:, j].reshape(H * hd, D).T,
+                        "b": b3[:, j].reshape(H * hd)}
+
+            def lin(n):
+                return {"w": get(p + n + ".weight").T,
+                        "b": get(p + n + ".bias")}
+            return {
+                "attn_norm": {"scale": get(p + "input_layernorm.weight"),
+                              "bias": get(p + "input_layernorm.bias")},
+                "q": proj(0), "k": proj(1), "v": proj(2),
+                "o": lin("self_attention.dense"),
+                "mlp_norm": {
+                    "scale": get(p + "post_attention_layernorm.weight"),
+                    "bias": get(p + "post_attention_layernorm.bias")},
+                "up": lin("mlp.dense_h_to_4h"),
+                "down": lin("mlp.dense_4h_to_h"),
+            }
+        params = {
+            "embed": {
+                "tokens": get("transformer.word_embeddings.weight"),
+                "norm": {
+                    "scale": get(
+                        "transformer.word_embeddings_layernorm.weight"),
+                    "bias": get(
+                        "transformer.word_embeddings_layernorm.bias")},
+            },
+            "layers": _stack([layer(i) for i in range(cfg.num_layers)]),
+            "final_norm": {"scale": get("transformer.ln_f.weight"),
+                           "bias": get("transformer.ln_f.bias")},
+        }
+        if not cfg.tie_word_embeddings:
+            params["lm_head"] = {"w": get("lm_head.weight").T}
+    elif fam == "gptj":
+        def layer(i):
+            p = f"transformer.h.{i}."
+
+            def lin(n, bias):
+                out = {"w": get(p + n + ".weight").T}
+                if bias:
+                    out["b"] = get(p + n + ".bias")
+                return out
+            # single shared ln_1 (cfg.shared_attn_mlp_norm): no mlp_norm
+            return {
+                "attn_norm": {"scale": get(p + "ln_1.weight"),
+                              "bias": get(p + "ln_1.bias")},
+                "q": lin("attn.q_proj", False),
+                "k": lin("attn.k_proj", False),
+                "v": lin("attn.v_proj", False),
+                "o": lin("attn.out_proj", False),
+                "up": lin("mlp.fc_in", True),
+                "down": lin("mlp.fc_out", True),
+            }
+        params = {
+            "embed": {"tokens": get("transformer.wte.weight")},
+            "layers": _stack([layer(i) for i in range(cfg.num_layers)]),
+            "final_norm": {"scale": get("transformer.ln_f.weight"),
+                           "bias": get("transformer.ln_f.bias")},
+        }
+        if not cfg.tie_word_embeddings:
+            params["lm_head"] = {"w": get("lm_head.weight").T,
+                                 "b": get("lm_head.bias")}
     else:
         raise NotImplementedError(fam)
 

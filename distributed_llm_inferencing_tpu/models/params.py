@@ -110,6 +110,8 @@ def init_params(cfg: ModelConfig, key, dtype=None):
         "embed": {"tokens": embed_table()},
         "layers": layers,
     }
+    if cfg.embed_norm:   # bloom: layernorm on the embedding output
+        params["embed"]["norm"] = {"scale": ones((E,)), "bias": zeros((E,))}
     if not cfg.post_norm:   # post-LN models (opt-350m) have no final norm
         params["final_norm"] = (
             {"scale": ones((D,)), "bias": zeros((D,))}

@@ -167,6 +167,35 @@ register(ModelConfig(
     tie_word_embeddings=True, parallel_residual=True,
     shared_attn_mlp_norm=True))
 
+# --- BLOOM: ALiBi positions, layernormed embedding, tied 250k head ---
+register(ModelConfig(
+    name="bloom-7b1", family="bloom", vocab_size=250880, hidden_size=4096,
+    intermediate_size=16384, num_layers=30, num_heads=32, num_kv_heads=32,
+    head_dim=128, max_position_embeddings=2048, norm_type="layernorm",
+    activation="gelu", gated_mlp=False, position_embedding="alibi",
+    embed_norm=True, attn_bias=True, mlp_bias=True,
+    tie_word_embeddings=True))
+
+# --- Falcon-RW-1B: ALiBi + sequential residual (the RW layout) ---
+register(ModelConfig(
+    name="falcon-rw-1b", family="falcon", vocab_size=50304,
+    hidden_size=2048, intermediate_size=8192, num_layers=24, num_heads=32,
+    num_kv_heads=32, head_dim=64, max_position_embeddings=2048,
+    norm_type="layernorm", activation="gelu_exact", gated_mlp=False,
+    position_embedding="alibi", alibi_scale=64 ** -0.5,
+    attn_bias=True, mlp_bias=True, tie_word_embeddings=True))
+
+# --- GPT-J-6B: interleaved partial rotary, shared-norm parallel block ---
+register(ModelConfig(
+    name="gpt-j-6b", family="gptj", vocab_size=50400, hidden_size=4096,
+    intermediate_size=16384, num_layers=28, num_heads=16, num_kv_heads=16,
+    head_dim=256, max_position_embeddings=2048, norm_type="layernorm",
+    activation="gelu", gated_mlp=False, position_embedding="rope",
+    rope_theta=10000.0, rope_pct=0.25, rope_interleaved=True,
+    attn_bias=False, o_bias=False, mlp_bias=True, lm_head_bias=True,
+    tie_word_embeddings=False, parallel_residual=True,
+    shared_attn_mlp_norm=True))
+
 # --- Tiny configs for tests/dryrun (not real checkpoints) ---
 register(ModelConfig(
     name="tiny-gpt2", family="gpt2", vocab_size=256, hidden_size=64,

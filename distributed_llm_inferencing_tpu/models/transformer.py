@@ -194,6 +194,17 @@ def _moe(x, lp, cfg: ModelConfig):
     return _moe_dense(x, lp, cfg)
 
 
+def _alibi(cfg: ModelConfig):
+    """[H] ALiBi slopes when the config uses them, else None — threaded
+    into every attention formulation (trace-time constant).
+    cfg.alibi_scale folds in Falcon-RW's extra 1/sqrt(head_dim) (it
+    scales scores + bias together where BLOOM scales scores only)."""
+    if cfg.position_embedding != "alibi":
+        return None
+    from distributed_llm_inferencing_tpu.ops.attention import alibi_slopes
+    return alibi_slopes(cfg.num_heads) * cfg.alibi_scale
+
+
 def embed(params, cfg: ModelConfig, tokens, q_positions):
     """Token (+ learned position) embedding. Shared by the scanned forward
     below and the pipelined executor (parallel/pipeline.py)."""
@@ -220,6 +231,8 @@ def embed(params, cfg: ModelConfig, tokens, q_positions):
                        jnp.clip(q_positions, 0, cfg.max_position_embeddings - 1),
                        axis=0)
         x = x + pos.astype(x.dtype)
+    if cfg.embed_norm:   # bloom: layernorm on the embedding output
+        x = norm(x, params["embed"]["norm"], cfg.norm_type, cfg.norm_eps)
     return x
 
 
@@ -273,8 +286,10 @@ def _block_body(x, lp, cfg: ModelConfig, q_positions, attend_write):
     v = _linear(h, lp["v"]).reshape(B, s, cfg.num_kv_heads, cfg.head_dim)
 
     if cfg.position_embedding == "rope":
-        q = apply_rope(q, q_positions, cfg.rope_theta, cfg.rope_pct)
-        k = apply_rope(k, q_positions, cfg.rope_theta, cfg.rope_pct)
+        q = apply_rope(q, q_positions, cfg.rope_theta, cfg.rope_pct,
+                       cfg.rope_interleaved)
+        k = apply_rope(k, q_positions, cfg.rope_theta, cfg.rope_pct,
+                       cfg.rope_interleaved)
 
     attn, cache_out = attend_write(q, k, v)
     attn = _linear(attn.reshape(B, s, cfg.num_heads * cfg.head_dim), lp["o"])
@@ -345,7 +360,7 @@ def _block(x, lp, cache_k, cache_v, *, cfg: ModelConfig, q_positions,
                 sliding_window=cfg.sliding_window)
         elif is_prefill:
             attn = attend_prefill(q, k, v, sliding_window=cfg.sliding_window,
-                                  backend=backend)
+                                  backend=backend, alibi=_alibi(cfg))
         elif mesh is not None and mesh.shape.get("sp", 1) > 1:
             # sp-sharded cache decode: flash-decoding partials per shard +
             # one combine (parallel/ring.py ring_attend_decode) — replaces
@@ -362,7 +377,7 @@ def _block(x, lp, cache_k, cache_v, *, cfg: ModelConfig, q_positions,
             attn = attend_decode(q, ck_at, cv_at, new_lengths,
                                  sliding_window=cfg.sliding_window,
                                  backend="xla" if quantized else backend,
-                                 q_positions=q_positions)
+                                 q_positions=q_positions, alibi=_alibi(cfg))
         return attn, cache_out
 
     x, cache_out = _block_body(x, lp, cfg, q_positions, attend_write)
@@ -495,13 +510,15 @@ def paged_decode_step(params, cfg: ModelConfig, tokens, paged,
                 attn = paged_attend_decode(
                     q, nk, nv, block_tables, context_lens + 1,
                     sliding_window=cfg.sliding_window, backend=backend,
-                    k_scale_layer=nks, v_scale_layer=nvs)
+                    k_scale_layer=nks, v_scale_layer=nvs,
+                    alibi=_alibi(cfg))
                 return attn, (nk, nv, nks, nvs)
             nk = write_token(ck, k[:, 0], block_tables, context_lens)
             nv = write_token(cv, v[:, 0], block_tables, context_lens)
             attn = paged_attend_decode(
                 q, nk, nv, block_tables, context_lens + 1,
-                sliding_window=cfg.sliding_window, backend=backend)
+                sliding_window=cfg.sliding_window, backend=backend,
+                alibi=_alibi(cfg))
             return attn, (nk, nv)
 
         return _block_body(x, lp, cfg, q_pos, attend_write)
@@ -645,7 +662,7 @@ def paged_decode_chunk(params, cfg: ModelConfig, k: int, tokens, paged,
                     q_pos,
                     jnp.concatenate([pool_pos, side_pos], axis=1),
                     jnp.concatenate([pool_valid, side_valid], axis=1),
-                    sliding_window=cfg.sliding_window)
+                    sliding_window=cfg.sliding_window, alibi=_alibi(cfg))
                 return attn, (sk2, sv2)
 
             x, (sk2, sv2) = _block_body(x, lp, cfg, q_pos, attend_write)
@@ -854,7 +871,7 @@ def paged_speculative_chunk(params, cfg: ModelConfig, k: int, gamma: int,
                     qp,
                     jnp.concatenate([pool_pos, side_pos], axis=1),
                     jnp.concatenate([pool_valid, side_valid], axis=1),
-                    sliding_window=cfg.sliding_window)
+                    sliding_window=cfg.sliding_window, alibi=_alibi(cfg))
                 return attn, (sk2, sv2)
 
             x, (sk2, sv2) = _block_body(x, lp, cfg, qp, attend_write)
@@ -999,13 +1016,14 @@ def paged_prefill_tail(params, cfg: ModelConfig, tokens, tail_len,
                 attn = paged_attend_prefix(
                     q, k, v, nk, nv, prefix_blocks, prefix_len, q_pos,
                     tail_valid, sliding_window=cfg.sliding_window,
-                    k_scale_layer=nks, v_scale_layer=nvs)
+                    k_scale_layer=nks, v_scale_layer=nvs,
+                    alibi=_alibi(cfg))
                 return attn, (nk, nv, nks, nvs)
             nk = write_block_run(ck, k, tail_blocks)
             nv = write_block_run(cv, v, tail_blocks)
             attn = paged_attend_prefix(
                 q, k, v, nk, nv, prefix_blocks, prefix_len, q_pos, tail_valid,
-                sliding_window=cfg.sliding_window)
+                sliding_window=cfg.sliding_window, alibi=_alibi(cfg))
             return attn, (nk, nv)
 
         return _block_body(x, lp, cfg, q_pos, attend_write)

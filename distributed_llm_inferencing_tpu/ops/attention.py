@@ -29,6 +29,22 @@ import jax.numpy as jnp
 NEG_INF = -1e30  # large-but-finite: keeps softmax well-defined on all-masked rows
 
 
+def alibi_slopes(num_heads: int):
+    """Per-query-head ALiBi slopes, HF convention (BLOOM/Falcon
+    build_alibi_tensor): geometric sequence from the nearest power of
+    two, odd-index extras interpolated for non-power-of-two head counts.
+    Returns [H] f32; the bias applied is ``slope * (kv_pos - q_pos)``
+    (non-positive at attended positions)."""
+    import math
+    cp2 = 2 ** math.floor(math.log2(num_heads))
+    base = 2.0 ** (-(2.0 ** -(math.log2(cp2) - 3)))
+    slopes = [base ** (i + 1) for i in range(cp2)]
+    if cp2 != num_heads:
+        extra = 2.0 ** (-(2.0 ** -(math.log2(2 * cp2) - 3)))
+        slopes += [extra ** (2 * i + 1) for i in range(num_heads - cp2)]
+    return jnp.asarray(slopes, jnp.float32)
+
+
 def repeat_kv(x, n_rep: int):
     """[B,S,Hkv,hd] -> [B,S,Hkv*n_rep,hd] by repeating each kv head."""
     if n_rep == 1:
@@ -46,12 +62,17 @@ def attend(
     kv_positions,        # [B, Skv] absolute position of each kv slot
     kv_valid,            # [B, Skv] bool — slot holds a real token
     sliding_window: Optional[int] = None,
+    alibi=None,          # [H] f32 slopes — bias slope*(kv_pos - q_pos)
 ):
     """Causal attention over a (possibly cached, possibly padded) KV set.
 
     Masking rule: query at position p may attend kv at position t iff
     t <= p, the slot is valid, and (no window or p - t < window).
     Works for prefill (Sq == Skv) and single-token decode (Sq == 1) alike.
+    ``alibi`` adds the linear position bias (BLOOM/Falcon-RW) to the
+    scaled scores — position-free K/V make the cache layout identical to
+    the RoPE families', so every paged/chunked serving path reuses this
+    one formulation.
     """
     B, Sq, H, hd = q.shape
     Hkv = k.shape[2]
@@ -62,6 +83,10 @@ def attend(
     # [B, H, Sq, Skv]
     logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                         k.astype(jnp.float32)) * scale
+    if alibi is not None:
+        rel = (kv_positions[:, None, :]
+               - q_positions[:, :, None]).astype(jnp.float32)  # [B,Sq,Skv]
+        logits = logits + alibi[None, :, None, None] * rel[:, None, :, :]
 
     causal = kv_positions[:, None, :] <= q_positions[:, :, None]  # [B,Sq,Skv]
     mask = causal & kv_valid[:, None, :]
@@ -102,14 +127,16 @@ def resolve_backend(requested: str = "auto", n_devices: int = 1,
 
 
 def attend_prefill(q, k, v, *, sliding_window: Optional[int] = None,
-                   backend: str = "xla"):
+                   backend: str = "xla", alibi=None):
     """Causal self-attention over the fresh (uncached) K/V block.
 
     Prefill never needs the cache or a validity mask: causality restricts
     every real query row to real slots at or before it, and rows past a
-    sequence's length are garbage the engine never reads.
+    sequence's length are garbage the engine never reads. ALiBi models
+    always take the xla formulation (the flash kernels carry no bias
+    term).
     """
-    if backend.startswith("pallas"):
+    if backend.startswith("pallas") and alibi is None:
         from distributed_llm_inferencing_tpu.ops.pallas import flash_attention
         return flash_attention(
             q, k, v, sliding_window=sliding_window,
@@ -117,12 +144,12 @@ def attend_prefill(q, k, v, *, sliding_window: Optional[int] = None,
     B, S, _, _ = q.shape
     pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
     return attend(q, k, v, pos, pos, jnp.ones((B, S), bool),
-                  sliding_window=sliding_window)
+                  sliding_window=sliding_window, alibi=alibi)
 
 
 def attend_decode(q, cache_k, cache_v, lengths, *,
                   sliding_window: Optional[int] = None,
-                  backend: str = "xla", q_positions=None):
+                  backend: str = "xla", q_positions=None, alibi=None):
     """Cached attention for decode-regime queries.
 
     Single-token (Sq == 1): ``lengths`` counts filled slots including the
@@ -130,9 +157,9 @@ def attend_decode(q, cache_k, cache_v, lengths, *,
     (speculative verification, ops/speculative.py): pass ``q_positions``
     [B, Sq] so each query is causally masked at its own position — the
     pallas flash-decode kernel is single-query, so multi-token always
-    takes the xla formulation.
+    takes the xla formulation. ALiBi models always take xla.
     """
-    multi = q.shape[1] > 1
+    multi = q.shape[1] > 1 or alibi is not None
     if backend.startswith("pallas") and not multi:
         from distributed_llm_inferencing_tpu.ops.pallas import flash_decode
         return flash_decode(
@@ -144,4 +171,4 @@ def attend_decode(q, cache_k, cache_v, lengths, *,
     q_pos = (q_positions if q_positions is not None
              else (lengths - 1)[:, None])
     return attend(q, cache_k, cache_v, q_pos, kv_pos, kv_valid,
-                  sliding_window=sliding_window)
+                  sliding_window=sliding_window, alibi=alibi)

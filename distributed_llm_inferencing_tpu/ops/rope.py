@@ -15,20 +15,25 @@ def rope_freqs(head_dim: int, theta: float):
     return 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
 
 
-def apply_rope(x, positions, theta: float, pct: float = 1.0):
+def apply_rope(x, positions, theta: float, pct: float = 1.0,
+               interleaved: bool = False):
     """Apply RoPE.
 
     x: [B, S, H, hd]; positions: [B, S] int32 absolute positions.
     ``pct`` < 1 is partial rotary (GPT-NeoX rotary_pct / Phi
-    partial_rotary_factor): only the first ``int(hd * pct)`` dims rotate,
-    the rest pass through position-free — matching HF's per-model
-    rotary_ndims slicing so converted checkpoints stay bit-compatible.
+    partial_rotary_factor / GPT-J rotary_dim): only the first
+    ``int(hd * pct)`` dims rotate, the rest pass through position-free —
+    matching HF's per-model rotary_ndims slicing so converted
+    checkpoints stay bit-compatible. ``interleaved`` switches pairing to
+    GPT-J's rotate_every_two convention: frequency i rotates dims
+    (2i, 2i+1) instead of the half-split (i, i + rot/2).
     Returns same shape/dtype as x.
     """
     hd = x.shape[-1]
     rot = int(hd * pct)
     if rot < hd:
-        rotated = apply_rope(x[..., :rot], positions, theta)
+        rotated = apply_rope(x[..., :rot], positions, theta,
+                             interleaved=interleaved)
         return jnp.concatenate([rotated, x[..., rot:]], axis=-1)
     inv_freq = rope_freqs(hd, theta)  # [hd/2]
     # angles: [B, S, hd/2]
@@ -36,6 +41,13 @@ def apply_rope(x, positions, theta: float, pct: float = 1.0):
     cos = jnp.cos(angles)[:, :, None, :]  # [B,S,1,hd/2]
     sin = jnp.sin(angles)[:, :, None, :]
     xf = x.astype(jnp.float32)
-    x1, x2 = xf[..., : hd // 2], xf[..., hd // 2:]
-    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    if interleaved:
+        x1, x2 = xf[..., 0::2], xf[..., 1::2]
+        ra = x1 * cos - x2 * sin
+        rb = x2 * cos + x1 * sin
+        out = jnp.stack([ra, rb], axis=-1).reshape(xf.shape)
+    else:
+        x1, x2 = xf[..., : hd // 2], xf[..., hd // 2:]
+        out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+                              axis=-1)
     return out.astype(x.dtype)

@@ -103,6 +103,10 @@ def validate_spec(spec: MeshSpec, cfg) -> None:
             f"tp={spec.tp} must divide intermediate_size={cfg.intermediate_size}")
     if cfg.num_layers % spec.pp:
         raise ValueError(f"pp={spec.pp} must divide num_layers={cfg.num_layers}")
+    if spec.sp > 1 and getattr(cfg, "position_embedding", None) == "alibi":
+        raise ValueError(
+            "sp>1 with alibi positions: the ring-attention path carries "
+            "no linear position bias yet")
     if spec.sp > 1 and spec.pp > 1:
         raise ValueError(
             "sp and pp cannot both exceed 1 yet: the pipelined executor "

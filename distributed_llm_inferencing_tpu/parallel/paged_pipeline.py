@@ -169,7 +169,8 @@ def paged_decode_chunk_pp(params, cfg: ModelConfig, k: int, tokens, paged,
                         q_pos,
                         jnp.concatenate([pool_pos, side_pos], axis=1),
                         jnp.concatenate([pool_valid, side_valid], axis=1),
-                        sliding_window=cfg.sliding_window)
+                        sliding_window=cfg.sliding_window,
+                        alibi=tf._alibi(cfg))
                     return attn, (sk2, sv2)
 
                 xc, (sk2, sv2) = tf._block_body(xc, lp, cfg, q_pos,
@@ -325,7 +326,8 @@ def paged_prefill_tail_pp(params, cfg: ModelConfig, tokens, tail_len,
                     nv = write_block_run(cv, vh, tb_eff)
                     attn = paged_attend_prefix(
                         q, kh, vh, nk, nv, pb_m, pl_m, qp, tv,
-                        sliding_window=cfg.sliding_window)
+                        sliding_window=cfg.sliding_window,
+                        alibi=tf._alibi(cfg))
                     return attn, (nk, nv)
 
                 xc, (nk, nv) = tf._block_body(xc, lp, cfg, qp, attend_write)

@@ -107,6 +107,8 @@ def param_specs(cfg: ModelConfig, spec: MeshSpec,
     if cfg.embed_proj_dim:   # opt-350m embed projections: small, replicated
         specs["embed"]["project_in"] = {"w": P(None, None)}
         specs["embed"]["project_out"] = {"w": P(None, None)}
+    if cfg.embed_norm:       # bloom embedding layernorm: tiny, replicated
+        specs["embed"]["norm"] = {"scale": P(None), "bias": P(None)}
     if cfg.position_embedding == "learned":
         specs["embed"]["positions"] = P(None, None)
     if not cfg.tie_word_embeddings:

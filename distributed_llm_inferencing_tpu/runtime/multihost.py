@@ -181,6 +181,7 @@ class LockstepLeader:
         self._epoch = 0
         self._degraded: Optional[str] = None
         self._recovering = False
+        self._recover_coordinator: Optional[str] = None
         self._loaded: Dict[str, dict] = {}   # model -> last load body
         self._recovery_thread: Optional[threading.Thread] = None
         self._handlers: Dict[str, Callable] = {}
@@ -349,9 +350,18 @@ class LockstepLeader:
         followers that lived through earlier epochs.
         """
         with self._mirror_lock:
+            if body.get("coordinator"):
+                # adopt the operator-supplied coordinator even when an
+                # automatic attempt is mid-flight — a restarted leader's
+                # auto-recovery NEEDS it (it has no prior address), and
+                # dropping it with a 200 would strand the slice
+                self._recover_coordinator = body["coordinator"]
             if self._recovering:
                 return {"status": "success",
-                        "message": "recovery already in progress"}
+                        "message": "recovery already in progress"
+                                   + ("; coordinator adopted for the next "
+                                      "attempt" if body.get("coordinator")
+                                      else "")}
             if not self._degraded and not body.get("force"):
                 return {"status": "success",
                         "message": "slice not degraded; nothing to recover "
@@ -394,7 +404,10 @@ class LockstepLeader:
                         self.agent.unload_model({"model_name": name})
                     except Exception as e:
                         log.warning("pre-rejoin unload of %s: %s", name, e)
-                new_coord = body.get("coordinator") or _fresh_coordinator()
+                new_coord = (body.get("coordinator")
+                             or self._recover_coordinator
+                             or _fresh_coordinator())
+                self._recover_coordinator = None
                 log.info("re-forming jax.distributed at %s", new_coord)
                 for f in self.followers:
                     r = http.post(f"{f}/lockstep/reinit_dist",
@@ -743,6 +756,9 @@ def reinit_multihost(coordinator: str, timeout_s: float = 120.0):
         gs.service = None
         gs.preemption_sync_manager = None
         gs.process_id = 0
+        # joined=false until the fresh initialize below succeeds — a
+        # failed rejoin must not report the abandoned job as live
+        _DIST_STATE["coordinator"] = None
     gc.collect()
     jax.clear_caches()
     jex_backend.clear_backends()

@@ -364,16 +364,8 @@ def test_falcon_new_arch_matches_hf():
     _check_model(model, tokens)
 
 
-def test_falcon_alibi_rejected():
-    """Alibi positional encoding has no RoPE mapping — conversion must
-    refuse, and the error must name the supported families."""
-    import transformers
-    torch_cfg = transformers.FalconConfig(
-        vocab_size=128, hidden_size=32, num_hidden_layers=2,
-        num_attention_heads=4, alibi=True)
-    with pytest.raises(NotImplementedError, match="alibi"):
-        convert.config_from_hf(torch_cfg)
-
+def test_unsupported_model_type_names_supported_families():
+    """The unsupported-architecture error must enumerate what converts."""
     class FakeCfg:
         model_type = "mamba"
     with pytest.raises(NotImplementedError, match="gpt_neox"):
@@ -414,6 +406,146 @@ def test_phi_decode_matches_hf_generate():
         cur = int(np.argmax(np.asarray(logits)[0, 0]))
         got.append(cur)
     assert got == want
+
+
+def test_bloom_matches_hf():
+    """BLOOM: ALiBi position bias, layernormed embedding output, per-head
+    interleaved fused QKV, tied head."""
+    import transformers
+    torch_cfg = transformers.BloomConfig(
+        vocab_size=128, hidden_size=32, n_layer=3, n_head=4,
+        layer_norm_epsilon=1e-5)
+    import torch
+    torch.manual_seed(17)
+    model = transformers.BloomForCausalLM(torch_cfg).eval()
+    cfg, params = convert.load_hf_model(model, dtype=jnp.float32)
+    assert cfg.position_embedding == "alibi" and cfg.embed_norm
+    assert "norm" in params["embed"]
+    rng = np.random.default_rng(17)
+    tokens = rng.integers(0, 128, size=(2, 10), dtype=np.int64)
+    _check_model(model, tokens)
+
+
+def test_bloom_nonpow2_heads_matches_hf():
+    """ALiBi slope interpolation for non-power-of-two head counts must
+    match HF's build_alibi_tensor exactly."""
+    import transformers
+    torch_cfg = transformers.BloomConfig(
+        vocab_size=128, hidden_size=36, n_layer=2, n_head=6)
+    import torch
+    torch.manual_seed(18)
+    model = transformers.BloomForCausalLM(torch_cfg).eval()
+    rng = np.random.default_rng(18)
+    tokens = rng.integers(0, 128, size=(1, 9), dtype=np.int64)
+    _check_model(model, tokens)
+
+
+def test_bloom_decode_matches_hf_generate():
+    """Greedy decode parity for ALiBi: the bias must track the query's
+    absolute position on the cached decode path too."""
+    import torch
+    import transformers
+    torch_cfg = transformers.BloomConfig(
+        vocab_size=128, hidden_size=32, n_layer=2, n_head=4)
+    torch.manual_seed(19)
+    model = transformers.BloomForCausalLM(torch_cfg).eval()
+    cfg, params = convert.load_hf_model(model, dtype=jnp.float32)
+    cfg = cfg.replace(dtype="float32")
+
+    rng = np.random.default_rng(19)
+    prompt = rng.integers(4, 128, size=(1, 6), dtype=np.int64)
+    with torch.no_grad():
+        want = model.generate(
+            torch.tensor(prompt), max_new_tokens=8, do_sample=False,
+            pad_token_id=0)[0, 6:].tolist()
+
+    cache = init_cache(cfg, 1, 32, dtype=jnp.float32)
+    logits, cache = transformer.prefill(
+        params, cfg, jnp.asarray(prompt.astype(np.int32)),
+        jnp.asarray([6], jnp.int32), cache)
+    cur = int(np.argmax(np.asarray(logits)[0, 5]))
+    got = [cur]
+    for _ in range(7):
+        logits, cache = transformer.decode_step(
+            params, cfg, jnp.asarray([[cur]], jnp.int32), cache)
+        cur = int(np.argmax(np.asarray(logits)[0, 0]))
+        got.append(cur)
+    assert got == want
+
+
+def test_gptj_matches_hf():
+    """GPT-J: interleaved (rotate_every_two) partial rotary, parallel
+    residual with one shared norm, biased MLP + untied biased head."""
+    import transformers
+    torch_cfg = transformers.GPTJConfig(
+        vocab_size=128, n_embd=32, n_layer=3, n_head=4, rotary_dim=4,
+        n_positions=64, tie_word_embeddings=False)
+    import torch
+    torch.manual_seed(20)
+    model = transformers.GPTJForCausalLM(torch_cfg).eval()
+    cfg, params = convert.load_hf_model(model, dtype=jnp.float32)
+    assert cfg.rope_interleaved and cfg.rope_pct == 0.5  # 4 of 8 dims
+    assert cfg.parallel_residual and cfg.shared_attn_mlp_norm
+    assert "b" in params["lm_head"]
+    rng = np.random.default_rng(20)
+    tokens = rng.integers(0, 128, size=(2, 10), dtype=np.int64)
+    _check_model(model, tokens)
+
+
+def test_falcon_rw_alibi_matches_hf():
+    """The Falcon-RW layout: per-head fused QKV, SEQUENTIAL residual
+    (parallel_attn=False), ALiBi positions."""
+    import transformers
+    torch_cfg = transformers.FalconConfig(
+        vocab_size=128, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, multi_query=False,
+        new_decoder_architecture=False, parallel_attn=False, bias=True,
+        alibi=True, max_position_embeddings=64)
+    import torch
+    torch.manual_seed(22)
+    model = transformers.FalconForCausalLM(torch_cfg).eval()
+    cfg, params = convert.load_hf_model(model, dtype=jnp.float32)
+    assert cfg.position_embedding == "alibi"
+    assert not cfg.parallel_residual and "mlp_norm" in params["layers"]
+    rng = np.random.default_rng(22)
+    tokens = rng.integers(0, 128, size=(1, 9), dtype=np.int64)
+    _check_model(model, tokens)
+
+
+def test_alibi_paged_serving_matches_engine():
+    """ALiBi through the SERVING path: the continuous batcher's paged
+    prefill + chunked decode must reproduce the engine's greedy tokens
+    (the bias rides q/kv positions, so block-table indirection must not
+    disturb it)."""
+    import torch
+    import transformers
+    from distributed_llm_inferencing_tpu.ops.sampling import SamplingParams
+    from distributed_llm_inferencing_tpu.runtime.batcher import (
+        ContinuousBatcher)
+    from distributed_llm_inferencing_tpu.runtime.engine import (
+        InferenceEngine)
+    torch_cfg = transformers.BloomConfig(
+        vocab_size=128, hidden_size=32, n_layer=2, n_head=4)
+    torch.manual_seed(23)
+    model = transformers.BloomForCausalLM(torch_cfg).eval()
+    cfg, params = convert.load_hf_model(model, dtype=jnp.float32)
+    cfg = cfg.replace(dtype="float32", attn_backend="xla")
+    rng = np.random.default_rng(23)
+    prompt = rng.integers(0, 128, size=11).tolist()
+
+    eng = InferenceEngine(cfg, params, max_seq=64)
+    want = eng.generate([prompt], max_new_tokens=10,
+                        sampling=SamplingParams.greedy()).tokens[0]
+
+    b = ContinuousBatcher(cfg, params, num_blocks=32, block_size=8,
+                          slots=2, max_seq=64, seed=0)
+    r = b.submit(prompt, max_new_tokens=10,
+                 sampling=SamplingParams.greedy())
+    for _ in range(40):
+        b.step()
+        if r.done.is_set():
+            break
+    assert r.wait() == want, (r.tokens, want)
 
 
 def test_qwen2_mixed_window_rejected():
