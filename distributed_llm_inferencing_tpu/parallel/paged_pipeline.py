@@ -74,12 +74,15 @@ def paged_decode_chunk_pp(params, cfg: ModelConfig, k: int, tokens, paged,
     over ``pp``. Same contract as transformer.paged_decode_chunk:
     returns (toks [K, R] int32, emits [K, R] bool, new paged).
 
-    Requires R % pp == 0 (the batcher rounds its slot count up) and an
-    unquantized pool (int8 KV + pp is future work, rejected at batcher
-    construction).
+    Requires R % pp == 0 (the batcher rounds its slot count up). An int8
+    pool (cfg.kv_quant) works like the dense chunk's: the per-layer
+    gather dequantizes at read, the bf16 side buffer quantizes in the
+    single post-loop scatter.
     """
     from distributed_llm_inferencing_tpu.models import transformer as tf
     from distributed_llm_inferencing_tpu.ops.attention import attend
+    from distributed_llm_inferencing_tpu.ops.kvcache import (
+        dequant_kv, quant_kv)
     from distributed_llm_inferencing_tpu.ops.paged_kvcache import (
         PagedKVCache, gather_seq)
     from distributed_llm_inferencing_tpu.ops.sampling import sample_batch
@@ -93,9 +96,7 @@ def paged_decode_chunk_pp(params, cfg: ModelConfig, k: int, tokens, paged,
     bs = paged.block_size
     mb = block_tables.shape[1]
     dt = jnp.dtype(cfg.dtype)
-    if paged.quantized:
-        raise NotImplementedError("int8 KV cache + pipeline-parallel "
-                                  "batching is not supported yet")
+    quantized = paged.quantized
     cl0 = context_lens
     n_ticks = k * pp + pp - 1
 
@@ -103,8 +104,9 @@ def paged_decode_chunk_pp(params, cfg: ModelConfig, k: int, tokens, paged,
     layer_spec, other_spec = _specs(p_layers, p_other)
     perm = [(i, (i + 1) % pp) for i in range(pp)]
 
-    def body(p_layers, p_other, pool_k, pool_v, tokens, cl0_, bt, seeds,
-             steps0, temps, tks, tps, ds, budget, eos_ids):
+    def body(p_layers, p_other, pool_k, pool_v, pool_ks, pool_vs, tokens,
+             cl0_, bt, seeds, steps0, temps, tks, tps, ds, budget,
+             eos_ids):
         pd = dict(p_other)
         pd["layers"] = p_layers
         stage = jax.lax.axis_index("pp")
@@ -151,9 +153,16 @@ def paged_decode_chunk_pp(params, cfg: ModelConfig, k: int, tokens, paged,
                 jnp.arange(k, dtype=jnp.int32)[None, :] <= d, (mbsz, k))
 
             def layer(xc, layer_in):
-                lp, sk, sv, ck, cv = layer_in
-                kp = gather_seq(ck, bt_m)
-                vp = gather_seq(cv, bt_m)
+                if quantized:
+                    lp, sk, sv, ck, cv, cks, cvs = layer_in
+                    kp = dequant_kv(gather_seq(ck, bt_m),
+                                    gather_seq(cks, bt_m), dt)
+                    vp = dequant_kv(gather_seq(cv, bt_m),
+                                    gather_seq(cvs, bt_m), dt)
+                else:
+                    lp, sk, sv, ck, cv = layer_in
+                    kp = gather_seq(ck, bt_m)
+                    vp = gather_seq(cv, bt_m)
                 sk_m = jax.lax.dynamic_slice_in_dim(sk, m * mbsz, mbsz, 0)
                 sv_m = jax.lax.dynamic_slice_in_dim(sv, m * mbsz, mbsz, 0)
 
@@ -181,8 +190,10 @@ def paged_decode_chunk_pp(params, cfg: ModelConfig, k: int, tokens, paged,
                     sv, jnp.where(valid, sv2, sv_m), m * mbsz, 0)
                 return xc, (sk, sv)
 
-            x2, (side_k, side_v) = jax.lax.scan(
-                layer, x_in, (p_layers, side_k, side_v, pool_k, pool_v))
+            xs = (p_layers, side_k, side_v, pool_k, pool_v)
+            if quantized:
+                xs = xs + (pool_ks, pool_vs)
+            x2, (side_k, side_v) = jax.lax.scan(layer, x_in, xs)
 
             # last stage: sample, record, advance the microbatch's state
             logits = tf.unembed(pd, cfg, x2)[:, 0]              # [mb, V]
@@ -232,20 +243,39 @@ def paged_decode_chunk_pp(params, cfg: ModelConfig, k: int, tokens, paged,
         blk = jnp.take_along_axis(bt, jnp.swapaxes(pos // bs, 0, 1), axis=1)
         blk = jnp.where(wrote, jnp.swapaxes(blk, 0, 1), dummy_block)
         off = pos % bs
+        if quantized:
+            k8, ks = quant_kv(side_k)
+            v8, vs = quant_kv(side_v)
+            return (toks, emits,
+                    pool_k.at[:, blk, off].set(jnp.swapaxes(k8, 1, 2)),
+                    pool_v.at[:, blk, off].set(jnp.swapaxes(v8, 1, 2)),
+                    pool_ks.at[:, blk, off].set(jnp.swapaxes(ks, 1, 2)),
+                    pool_vs.at[:, blk, off].set(jnp.swapaxes(vs, 1, 2)))
         new_k = pool_k.at[:, blk, off].set(jnp.swapaxes(side_k, 1, 2))
         new_v = pool_v.at[:, blk, off].set(jnp.swapaxes(side_v, 1, 2))
-        return toks, emits, new_k, new_v
+        return toks, emits, new_k, new_v, pool_ks, pool_vs
 
     cache_spec = P("pp")
-    toks, emits, new_k, new_v = jax.shard_map(
+    # the scale planes ride as zero-size dummies when unquantized so one
+    # body signature serves both layouts (shard_map specs stay static)
+    dummy = jnp.zeros((L, 0), jnp.float32)
+    pool_ks = paged.k_scale if quantized else dummy
+    pool_vs = paged.v_scale if quantized else dummy
+    toks, emits, new_k, new_v, new_ks, new_vs = jax.shard_map(
         body, mesh=mesh, axis_names={"pp"},
         in_specs=(layer_spec, other_spec, cache_spec, cache_spec,
+                  cache_spec, cache_spec,
                   P(), P(), P(), P(), P(), P(), P(), P(), P(), P(), P()),
-        out_specs=(P(), P(), cache_spec, cache_spec),
+        out_specs=(P(), P(), cache_spec, cache_spec, cache_spec,
+                   cache_spec),
         check_vma=False,
-    )(p_layers, p_other, paged.k, paged.v, tokens, context_lens,
-      block_tables, seeds, steps0, temps, tks, tps, ds, budget, eos_ids)
+    )(p_layers, p_other, paged.k, paged.v, pool_ks, pool_vs, tokens,
+      context_lens, block_tables, seeds, steps0, temps, tks, tps, ds,
+      budget, eos_ids)
     from distributed_llm_inferencing_tpu.ops.paged_kvcache import PagedKVCache
+    if quantized:
+        return toks, emits, PagedKVCache(k=new_k, v=new_v, k_scale=new_ks,
+                                         v_scale=new_vs)
     return toks, emits, PagedKVCache(k=new_k, v=new_v)
 
 
@@ -258,9 +288,11 @@ def paged_prefill_tail_pp(params, cfg: ModelConfig, tokens, tail_len,
     pp (B % pp == 0 — the batcher pads its wave buckets); each microbatch
     makes one pass through the stages (2*pp - 1 ticks). ``dummy_block``
     absorbs the fill/drain ticks' garbage writes (the dense version gets
-    this for free from the host's all-dummy padding rows).
+    this for free from the host's all-dummy padding rows). int8 pools
+    store quantized tail K/V + scales exactly like the dense version.
     """
     from distributed_llm_inferencing_tpu.models import transformer as tf
+    from distributed_llm_inferencing_tpu.ops.kvcache import quant_kv
     from distributed_llm_inferencing_tpu.ops.paged_kvcache import (
         PagedKVCache, paged_attend_prefix, write_block_run)
 
@@ -272,9 +304,7 @@ def paged_prefill_tail_pp(params, cfg: ModelConfig, tokens, tail_len,
         tail_blocks = tail_blocks[None]
     mbsz = b // pp
     dt = jnp.dtype(cfg.dtype)
-    if paged.quantized:
-        raise NotImplementedError("int8 KV cache + pipeline-parallel "
-                                  "batching is not supported yet")
+    quantized = paged.quantized
     n_ticks = 2 * pp - 1
 
     p_layers, p_other = _split_params(params)
@@ -286,8 +316,9 @@ def paged_prefill_tail_pp(params, cfg: ModelConfig, tokens, tail_len,
     tail_valid_all = (jnp.arange(t, dtype=jnp.int32)[None, :]
                       < tail_len[:, None])
 
-    def body(p_layers, p_other, pool_k, pool_v, tokens, tail_len, tail_bs,
-             prefix_bs, prefix_len, q_pos_all, tail_valid_all):
+    def body(p_layers, p_other, pool_k, pool_v, pool_ks, pool_vs, tokens,
+             tail_len, tail_bs, prefix_bs, prefix_len, q_pos_all,
+             tail_valid_all):
         pd = dict(p_other)
         pd["layers"] = p_layers
         stage = jax.lax.axis_index("pp")
@@ -298,10 +329,10 @@ def paged_prefill_tail_pp(params, cfg: ModelConfig, tokens, tail_len,
 
         x0 = jnp.zeros((mbsz, t, cfg.hidden_size), dt)
         out0 = jnp.zeros((b, cfg.vocab_size), jnp.float32)
-        carry0 = (x0, pool_k, pool_v, out0)
+        carry0 = (x0, pool_k, pool_v, pool_ks, pool_vs, out0)
 
         def tick(tt, carry):
-            x, pool_k, pool_v, out = carry
+            x, pool_k, pool_v, pool_ks, pool_vs, out = carry
             j = tt - stage
             valid = (j >= 0) & (j < pp)
             m = jnp.where(valid, j, 0)
@@ -316,11 +347,28 @@ def paged_prefill_tail_pp(params, cfg: ModelConfig, tokens, tail_len,
             x_in = jnp.where(stage == 0, x_emb, x)
 
             def layer(xc, layer_in):
-                lp, ck, cv = layer_in
+                def attend_write_quant(q, kh, vh):
+                    lp, ck, cv, cks, cvs = layer_in
+                    tb_eff = jnp.where(valid, tb_m, dummy_block)
+                    k8, ks = quant_kv(kh)
+                    v8, vs = quant_kv(vh)
+                    nk = write_block_run(ck, k8, tb_eff)
+                    nv = write_block_run(cv, v8, tb_eff)
+                    nks = write_block_run(cks, ks, tb_eff)
+                    nvs = write_block_run(cvs, vs, tb_eff)
+                    # the tail attends its own fresh bf16 K/V plus the
+                    # dequantized cached prefix
+                    attn = paged_attend_prefix(
+                        q, kh, vh, nk, nv, pb_m, pl_m, qp, tv,
+                        sliding_window=cfg.sliding_window,
+                        k_scale_layer=nks, v_scale_layer=nvs,
+                        alibi=tf._alibi(cfg))
+                    return attn, (nk, nv, nks, nvs)
 
                 def attend_write(q, kh, vh):
                     # write this microbatch's tail K/V; invalid ticks
                     # write only the dummy block (padding-row semantics)
+                    lp, ck, cv = layer_in
                     tb_eff = jnp.where(valid, tb_m, dummy_block)
                     nk = write_block_run(ck, kh, tb_eff)
                     nv = write_block_run(cv, vh, tb_eff)
@@ -330,11 +378,19 @@ def paged_prefill_tail_pp(params, cfg: ModelConfig, tokens, tail_len,
                         alibi=tf._alibi(cfg))
                     return attn, (nk, nv)
 
-                xc, (nk, nv) = tf._block_body(xc, lp, cfg, qp, attend_write)
-                return xc, (nk, nv)
+                lp = layer_in[0]
+                xc, caches = tf._block_body(
+                    xc, lp, cfg, qp,
+                    attend_write_quant if quantized else attend_write)
+                return xc, caches
 
-            x2, (pool_k, pool_v) = jax.lax.scan(
-                layer, x_in, (p_layers, pool_k, pool_v))
+            if quantized:
+                x2, (pool_k, pool_v, pool_ks, pool_vs) = jax.lax.scan(
+                    layer, x_in,
+                    (p_layers, pool_k, pool_v, pool_ks, pool_vs))
+            else:
+                x2, (pool_k, pool_v) = jax.lax.scan(
+                    layer, x_in, (p_layers, pool_k, pool_v))
 
             # last stage: project the last real position of each row
             tl_m = mrows(tail_len, m)
@@ -348,18 +404,27 @@ def paged_prefill_tail_pp(params, cfg: ModelConfig, tokens, tail_len,
             out = jax.lax.dynamic_update_slice(out, new, (m * mbsz, 0))
 
             x2 = jax.lax.ppermute(x2, "pp", perm)
-            return (x2, pool_k, pool_v, out)
+            return (x2, pool_k, pool_v, pool_ks, pool_vs, out)
 
-        _, pool_k, pool_v, out = jax.lax.fori_loop(0, n_ticks, tick, carry0)
-        return jax.lax.psum(out, "pp"), pool_k, pool_v
+        _, pool_k, pool_v, pool_ks, pool_vs, out = jax.lax.fori_loop(
+            0, n_ticks, tick, carry0)
+        return jax.lax.psum(out, "pp"), pool_k, pool_v, pool_ks, pool_vs
 
     cache_spec = P("pp")
-    last, new_k, new_v = jax.shard_map(
+    dummy = jnp.zeros((cfg.num_layers, 0), jnp.float32)
+    pool_ks = paged.k_scale if quantized else dummy
+    pool_vs = paged.v_scale if quantized else dummy
+    last, new_k, new_v, new_ks, new_vs = jax.shard_map(
         body, mesh=mesh, axis_names={"pp"},
         in_specs=(layer_spec, other_spec, cache_spec, cache_spec,
+                  cache_spec, cache_spec,
                   P(), P(), P(), P(), P(), P(), P()),
-        out_specs=(P(), cache_spec, cache_spec),
+        out_specs=(P(), cache_spec, cache_spec, cache_spec, cache_spec),
         check_vma=False,
-    )(p_layers, p_other, paged.k, paged.v, tokens, tail_len, tail_blocks,
-      prefix_blocks, prefix_len, q_pos_all, tail_valid_all)
+    )(p_layers, p_other, paged.k, paged.v, pool_ks, pool_vs, tokens,
+      tail_len, tail_blocks, prefix_blocks, prefix_len, q_pos_all,
+      tail_valid_all)
+    if quantized:
+        return last, PagedKVCache(k=new_k, v=new_v, k_scale=new_ks,
+                                  v_scale=new_vs)
     return last, PagedKVCache(k=new_k, v=new_v)

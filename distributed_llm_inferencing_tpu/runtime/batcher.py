@@ -175,10 +175,6 @@ class ContinuousBatcher:
                 raise ValueError(
                     "speculative decoding does not span pipeline stages "
                     "yet; drop speculative or pp")
-            if cfg.kv_quant:
-                raise ValueError(
-                    "int8 KV cache + pipeline-parallel batching is not "
-                    "supported yet; drop kv_quant or pp")
             slots = -(-slots // self.mesh_spec.pp) * self.mesh_spec.pp
         self.cfg = cfg = cfg.replace(
             attn_backend=_backend(cfg, self.mesh_spec.num_devices))

@@ -350,11 +350,15 @@ class LockstepLeader:
         followers that lived through earlier epochs.
         """
         with self._mirror_lock:
-            if body.get("coordinator"):
+            if body.get("coordinator") and (self._recovering
+                                            or self._degraded
+                                            or body.get("force")):
                 # adopt the operator-supplied coordinator even when an
                 # automatic attempt is mid-flight — a restarted leader's
                 # auto-recovery NEEDS it (it has no prior address), and
-                # dropping it with a 200 would strand the slice
+                # dropping it with a 200 would strand the slice. On a
+                # healthy slice (no force) nothing is adopted: a stashed
+                # address would go stale before any future recovery.
                 self._recover_coordinator = body["coordinator"]
             if self._recovering:
                 return {"status": "success",
@@ -404,10 +408,15 @@ class LockstepLeader:
                         self.agent.unload_model({"model_name": name})
                     except Exception as e:
                         log.warning("pre-rejoin unload of %s: %s", name, e)
-                new_coord = (body.get("coordinator")
-                             or self._recover_coordinator
-                             or _fresh_coordinator())
-                self._recover_coordinator = None
+                if body.get("coordinator"):
+                    new_coord = body["coordinator"]
+                else:
+                    with self._mirror_lock:   # consume exactly the value
+                        # this attempt uses; a concurrently adopted one
+                        # must survive for the next attempt
+                        new_coord = self._recover_coordinator
+                        self._recover_coordinator = None
+                    new_coord = new_coord or _fresh_coordinator()
                 log.info("re-forming jax.distributed at %s", new_coord)
                 for f in self.followers:
                     r = http.post(f"{f}/lockstep/reinit_dist",

@@ -158,16 +158,35 @@ def test_pp_batcher_lockstep_replay_evolves_identical_cache():
                                   np.asarray(jax.device_get(follower.paged.v)))
 
 
+def test_pp_batcher_kv8_matches_dense_kv8():
+    """int8 KV cache composes with pipeline parallelism: the pp batcher
+    over a quantized pool reproduces the single-stage kv8 batcher's
+    tokens exactly (same quantize-at-write / dequantize-at-read points,
+    so the rounding is identical)."""
+    kcfg = CFG.replace(kv_quant="int8")
+    global RNG
+    RNG = np.random.default_rng(11)
+    prompts = [RNG.integers(0, 256, n).tolist() for n in (9, 14)]
+
+    def run(mesh_spec):
+        b = ContinuousBatcher(kcfg, num_blocks=96, block_size=8, slots=2,
+                              max_seq=64, seed=0, mesh_spec=mesh_spec)
+        reqs = [b.submit(p, max_new_tokens=8,
+                         sampling=SamplingParams.greedy(), seed=30 + i)
+                for i, p in enumerate(prompts)]
+        return _run(b, reqs)
+
+    want = run(None)
+    got = run(MeshSpec(pp=2))
+    assert got == want, (got, want)
+
+
 def test_pp_batcher_rejects_unsupported_combos():
     import pytest
     with pytest.raises(ValueError, match="speculative"):
         ContinuousBatcher(CFG, num_blocks=32, block_size=8, slots=2,
                           max_seq=64, mesh_spec=MeshSpec(pp=2),
                           speculative="ngram")
-    with pytest.raises(ValueError, match="kv_quant|int8 KV"):
-        ContinuousBatcher(CFG.replace(kv_quant="int8"), num_blocks=32,
-                          block_size=8, slots=2, max_seq=64,
-                          mesh_spec=MeshSpec(pp=2))
     # slots round UP to a pp multiple
     b = ContinuousBatcher(CFG, num_blocks=32, block_size=8, slots=3,
                           max_seq=64, mesh_spec=MeshSpec(pp=2))
