@@ -589,6 +589,7 @@ def run_all(platform, degraded):
 
 
 def main():
+    global _T0
     from distributed_llm_inferencing_tpu.utils.platform import ensure_backend
     if os.environ.get(_FALLBACK_ENV):
         info = {"platform": "cpu", "degraded": True}
@@ -610,7 +611,6 @@ def main():
             time.sleep(wait)
             info = ensure_backend(attempts=1)
         # probing time must not eat the extras budget: restart the clock
-        global _T0
         _T0 = time.time()
     try:
         result = run_all(info["platform"], info["degraded"])

@@ -410,6 +410,12 @@ class LockstepLeader:
                         log.warning("pre-rejoin unload of %s: %s", name, e)
                 if body.get("coordinator"):
                     new_coord = body["coordinator"]
+                    with self._mirror_lock:
+                        # this attempt consumes its own adoption; only a
+                        # DIFFERENT concurrently adopted address survives
+                        # for the next attempt
+                        if self._recover_coordinator == new_coord:
+                            self._recover_coordinator = None
                 else:
                     with self._mirror_lock:   # consume exactly the value
                         # this attempt uses; a concurrently adopted one
