@@ -65,7 +65,7 @@ def _act_from_hf(name: str) -> str:
 
 SUPPORTED_MODEL_TYPES = ("gpt2", "opt", "llama", "mistral", "mixtral",
                          "qwen2", "gemma", "gpt_neox", "phi", "falcon",
-                         "bloom", "gptj")
+                         "bloom", "gptj", "mpt")
 
 
 def config_from_hf(hf_config) -> ModelConfig:
@@ -284,6 +284,46 @@ def config_from_hf(hf_config) -> ModelConfig:
             tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
                                         False),
             parallel_residual=True, shared_attn_mlp_norm=True)
+    if mt == "mpt":
+        # MPT: ALiBi (BLOOM-convention slopes for power-of-two heads),
+        # straight-concat fused QKV (optionally grouped KV), bias-free
+        # layout by default, exact gelu, tied head.
+        ac = hf_config.attn_config
+
+        def acget(key, default=None):
+            return (ac.get(key, default) if isinstance(ac, dict)
+                    else getattr(ac, key, default))
+        if not acget("alibi", True):
+            raise NotImplementedError("mpt without alibi positions")
+        if acget("clip_qkv") or acget("qk_ln", False):
+            raise NotImplementedError("mpt with clip_qkv/qk_ln")
+        if acget("softmax_scale") is not None:
+            raise NotImplementedError(
+                "mpt with a custom attn softmax_scale (the runtime always "
+                "uses 1/sqrt(head_dim))")
+        if acget("alibi_bias_max", 8) != 8:
+            raise NotImplementedError("mpt with alibi_bias_max != 8")
+        heads = hf_config.n_heads
+        if heads & (heads - 1):
+            raise NotImplementedError(
+                "mpt with non-power-of-two heads: its alibi slope "
+                "interpolation differs from the BLOOM convention")
+        D = hf_config.d_model
+        bias = not getattr(hf_config, "no_bias", True)
+        return ModelConfig(
+            name=getattr(hf_config, "name_or_path", mt) or mt,
+            family="mpt", vocab_size=hf_config.vocab_size,
+            hidden_size=D,
+            intermediate_size=int(hf_config.expansion_ratio * D),
+            num_layers=hf_config.n_layers, num_heads=heads,
+            num_kv_heads=acget("kv_n_heads", None) or heads,
+            head_dim=D // heads,
+            max_position_embeddings=hf_config.max_seq_len,
+            norm_type="layernorm", norm_eps=1e-5,
+            activation="gelu_exact", gated_mlp=False,
+            position_embedding="alibi",
+            attn_bias=bias, mlp_bias=bias,
+            tie_word_embeddings=True)
     raise NotImplementedError(
         f"unsupported HF model_type {mt!r}; supported: "
         f"{', '.join(SUPPORTED_MODEL_TYPES)}")
@@ -613,6 +653,52 @@ def convert_state_dict(cfg: ModelConfig, sd, dtype=None):
         if not cfg.tie_word_embeddings:
             params["lm_head"] = {"w": get("lm_head.weight").T,
                                  "b": get("lm_head.bias")}
+    elif fam == "mpt":
+        qd, kvd = cfg.q_dim, cfg.kv_dim
+
+        def layer(i):
+            p = f"transformer.blocks.{i}."
+
+            def norm_leaf(n):
+                # no_bias MPT norms carry weight only; a zero bias is the
+                # exact equivalent of HF's bias=None layer_norm
+                return {"scale": get(p + n + ".weight"),
+                        "bias": get(p + n + ".bias")
+                        if p + n + ".bias" in sd
+                        else np.zeros((D,), np.float32)}
+
+            def lin(n):
+                out = {"w": get(p + n + ".weight").T}
+                if p + n + ".bias" in sd:
+                    out["b"] = get(p + n + ".bias")
+                return out
+            # straight-concat fused QKV: rows [q | k | v]
+            wqkv = get(p + "attn.Wqkv.weight")          # [qd+2*kvd, D]
+            lp = {
+                "attn_norm": norm_leaf("norm_1"),
+                "q": {"w": wqkv[:qd].T},
+                "k": {"w": wqkv[qd:qd + kvd].T},
+                "v": {"w": wqkv[qd + kvd:].T},
+                "o": lin("attn.out_proj"),
+                "mlp_norm": norm_leaf("norm_2"),
+                "up": lin("ffn.up_proj"),
+                "down": lin("ffn.down_proj"),
+            }
+            if p + "attn.Wqkv.bias" in sd:
+                bqkv = get(p + "attn.Wqkv.bias")
+                lp["q"]["b"] = bqkv[:qd]
+                lp["k"]["b"] = bqkv[qd:qd + kvd]
+                lp["v"]["b"] = bqkv[qd + kvd:]
+            return lp
+        params = {
+            "embed": {"tokens": get("transformer.wte.weight")},
+            "layers": _stack([layer(i) for i in range(cfg.num_layers)]),
+            "final_norm": {
+                "scale": get("transformer.norm_f.weight"),
+                "bias": get("transformer.norm_f.bias")
+                if "transformer.norm_f.bias" in sd
+                else np.zeros((D,), np.float32)},
+        }
     else:
         raise NotImplementedError(fam)
 

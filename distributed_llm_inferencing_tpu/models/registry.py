@@ -185,6 +185,14 @@ register(ModelConfig(
     position_embedding="alibi", alibi_scale=64 ** -0.5,
     attn_bias=True, mlp_bias=True, tie_word_embeddings=True))
 
+# --- MPT-7B: ALiBi, bias-free straight-concat fused QKV, tied head ---
+register(ModelConfig(
+    name="mpt-7b", family="mpt", vocab_size=50432, hidden_size=4096,
+    intermediate_size=16384, num_layers=32, num_heads=32, num_kv_heads=32,
+    head_dim=128, max_position_embeddings=2048, norm_type="layernorm",
+    activation="gelu_exact", gated_mlp=False, position_embedding="alibi",
+    attn_bias=False, mlp_bias=False, tie_word_embeddings=True))
+
 # --- GPT-J-6B: interleaved partial rotary, shared-norm parallel block ---
 register(ModelConfig(
     name="gpt-j-6b", family="gptj", vocab_size=50400, hidden_size=4096,

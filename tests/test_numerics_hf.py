@@ -364,6 +364,36 @@ def test_falcon_new_arch_matches_hf():
     _check_model(model, tokens)
 
 
+def test_mpt_matches_hf():
+    """MPT: ALiBi, straight-concat bias-free fused QKV, zero-bias
+    layernorms, exact gelu, tied head."""
+    import transformers
+    torch_cfg = transformers.MptConfig(
+        vocab_size=128, d_model=32, n_heads=4, n_layers=3, max_seq_len=64)
+    import torch
+    torch.manual_seed(24)
+    model = transformers.MptForCausalLM(torch_cfg).eval()
+    cfg, params = convert.load_hf_model(model, dtype=jnp.float32)
+    assert cfg.position_embedding == "alibi" and not cfg.attn_bias
+    assert cfg.tie_word_embeddings
+    rng = np.random.default_rng(24)
+    tokens = rng.integers(0, 128, size=(2, 10), dtype=np.int64)
+    _check_model(model, tokens)
+
+
+def test_mpt_unsupported_attn_options_rejected():
+    import transformers
+    torch_cfg = transformers.MptConfig(
+        vocab_size=128, d_model=32, n_heads=4, n_layers=2,
+        attn_config=dict(qk_ln=True))
+    with pytest.raises(NotImplementedError, match="qk_ln"):
+        convert.config_from_hf(torch_cfg)
+    torch_cfg = transformers.MptConfig(
+        vocab_size=128, d_model=36, n_heads=6, n_layers=2)
+    with pytest.raises(NotImplementedError, match="power-of-two"):
+        convert.config_from_hf(torch_cfg)
+
+
 def test_unsupported_model_type_names_supported_families():
     """The unsupported-architecture error must enumerate what converts."""
     class FakeCfg:
