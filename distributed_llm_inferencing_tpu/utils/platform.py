@@ -46,16 +46,30 @@ def force_platform(platform: str) -> None:
 def probe_default_backend(timeout: float = 75.0) -> Optional[str]:
     """Try default-backend init in a subprocess; return its platform name,
     or None if init failed OR hung past ``timeout`` seconds."""
+    return probe_default_backend_ex(timeout)[0]
+
+
+def probe_default_backend_ex(timeout: float = 75.0):
+    """Like probe_default_backend, but also return WHY a probe failed:
+    ``(platform_or_None, error_or_None)``. The error string is what a
+    degraded bench artifact records so an outage is provable, not just
+    asserted (a timeout reads ``"probe timeout after Ns"``; a crashed
+    init carries the tail of its stderr)."""
     env = dict(os.environ)
     env.pop("DLI_PLATFORM", None)  # probe the true default
     try:
         r = subprocess.run(
             [sys.executable, "-c", _PROBE_SRC],
             capture_output=True, text=True, timeout=timeout, env=env)
-    except (subprocess.TimeoutExpired, OSError):
-        return None
+    except subprocess.TimeoutExpired:
+        return None, f"probe timeout after {timeout:.0f}s (backend init hang)"
+    except OSError as e:
+        return None, f"probe spawn failed: {e!r}"
     out = r.stdout.strip()
-    return out if r.returncode == 0 and out else None
+    if r.returncode == 0 and out:
+        return out, None
+    tail = (r.stderr or "").strip().splitlines()[-3:]
+    return None, (f"probe rc={r.returncode}: " + " | ".join(tail))[:500]
 
 
 def ensure_backend(requested: Optional[str] = None,
@@ -71,13 +85,16 @@ def ensure_backend(requested: Optional[str] = None,
     requested = requested or os.environ.get("DLI_PLATFORM") or None
     if requested:
         force_platform(requested)
-        return {"platform": requested, "degraded": False}
-    last = None
+        return {"platform": requested, "degraded": False,
+                "probe_attempts": 0, "probe_last_error": None}
+    last = err = None
     for i in range(attempts):
         if i:
             time.sleep(backoff_s * i)
-        last = probe_default_backend(probe_timeout)
+        last, err = probe_default_backend_ex(probe_timeout)
         if last:
-            return {"platform": last, "degraded": False}
+            return {"platform": last, "degraded": False,
+                    "probe_attempts": i + 1, "probe_last_error": None}
     force_platform("cpu")
-    return {"platform": "cpu", "degraded": True}
+    return {"platform": "cpu", "degraded": True,
+            "probe_attempts": attempts, "probe_last_error": err}

@@ -15,32 +15,39 @@ from distributed_llm_inferencing_tpu.utils import platform as plat
 def test_explicit_request_is_not_degraded(monkeypatch):
     monkeypatch.delenv("DLI_PLATFORM", raising=False)
     info = plat.ensure_backend("cpu")
-    assert info == {"platform": "cpu", "degraded": False}
+    assert (info["platform"], info["degraded"]) == ("cpu", False)
+    assert info["probe_last_error"] is None
 
 
 def test_env_request_wins(monkeypatch):
     monkeypatch.setenv("DLI_PLATFORM", "cpu")
     info = plat.ensure_backend()
-    assert info == {"platform": "cpu", "degraded": False}
+    assert (info["platform"], info["degraded"]) == ("cpu", False)
 
 
 def test_probe_failure_degrades_to_cpu(monkeypatch):
     monkeypatch.delenv("DLI_PLATFORM", raising=False)
-    monkeypatch.setattr(plat, "probe_default_backend", lambda timeout: None)
+    monkeypatch.setattr(plat, "probe_default_backend_ex",
+                        lambda timeout: (None, "boom"))
     info = plat.ensure_backend(attempts=2, backoff_s=0.0)
-    assert info == {"platform": "cpu", "degraded": True}
+    assert (info["platform"], info["degraded"]) == ("cpu", True)
+    # a degraded result must carry the WHY for the bench artifact
+    assert info["probe_attempts"] == 2
+    assert info["probe_last_error"] == "boom"
 
 
 def test_probe_success_is_used(monkeypatch):
     monkeypatch.delenv("DLI_PLATFORM", raising=False)
-    monkeypatch.setattr(plat, "probe_default_backend", lambda timeout: "tpu")
+    monkeypatch.setattr(plat, "probe_default_backend_ex",
+                        lambda timeout: ("tpu", None))
     info = plat.ensure_backend()
-    assert info == {"platform": "tpu", "degraded": False}
+    assert (info["platform"], info["degraded"]) == ("tpu", False)
+    assert info["probe_attempts"] == 1
 
 
 def test_probe_timeout_kills_hung_init(monkeypatch):
     # a probe command that hangs forever must return None at the timeout,
-    # not hang the caller
+    # not hang the caller — and report the hang as the probe error
     real_run = subprocess.run
 
     def hang_run(cmd, **kw):
@@ -49,3 +56,5 @@ def test_probe_timeout_kills_hung_init(monkeypatch):
 
     monkeypatch.setattr(plat.subprocess, "run", hang_run)
     assert plat.probe_default_backend(timeout=1.0) is None
+    p, err = plat.probe_default_backend_ex(timeout=1.0)
+    assert p is None and "timeout" in err
