@@ -120,6 +120,15 @@ class ModelConfig:
     # (trace-time static; see ops/attention.py resolve_backend)
     attn_backend: str = "auto"
 
+    # Pinned by the engine at init (like attn_backend's resolution): True
+    # when the enclosing GSPMD program shards linear weights over tp, so
+    # row-parallel (din-sharded: o/down) int4 leaves keep the XLA unpack
+    # instead of the pallas kernel, whose partitioning rule shards only
+    # the output axis (ops/pallas/quant_matmul.py supported()). Local-
+    # view (shard_map) callers keep False: their weights arrive pre-
+    # sliced and the kernel is a plain local matmul.
+    tp_row_sharded: bool = False
+
     def __post_init__(self):
         assert self.num_heads % self.num_kv_heads == 0, (
             f"num_heads={self.num_heads} must be divisible by "

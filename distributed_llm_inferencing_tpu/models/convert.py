@@ -65,7 +65,7 @@ def _act_from_hf(name: str) -> str:
 
 SUPPORTED_MODEL_TYPES = ("gpt2", "opt", "llama", "mistral", "mixtral",
                          "qwen2", "gemma", "gpt_neox", "phi", "falcon",
-                         "bloom", "gptj", "mpt")
+                         "bloom", "gptj", "mpt", "gpt_bigcode", "stablelm")
 
 
 def config_from_hf(hf_config) -> ModelConfig:
@@ -324,6 +324,61 @@ def config_from_hf(hf_config) -> ModelConfig:
             position_embedding="alibi",
             attn_bias=bias, mlp_bias=bias,
             tie_word_embeddings=True)
+    if mt == "gpt_bigcode":
+        # StarCoder / SantaCoder: GPT-2 block layout but nn.Linear (not
+        # Conv1D) weights, multi-query attention (1 kv head) by default,
+        # tanh-gelu, learned positions, tied head.
+        heads = hf_config.n_head
+        return ModelConfig(
+            name=getattr(hf_config, "name_or_path", mt) or mt,
+            family="gpt_bigcode", vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.n_embd,
+            intermediate_size=getattr(hf_config, "n_inner", None)
+            or 4 * hf_config.n_embd,
+            num_layers=hf_config.n_layer, num_heads=heads,
+            num_kv_heads=1 if getattr(hf_config, "multi_query", True)
+            else heads,
+            head_dim=hf_config.n_embd // heads,
+            max_position_embeddings=hf_config.n_positions,
+            norm_type="layernorm",
+            norm_eps=hf_config.layer_norm_epsilon,
+            activation=_act_from_hf(getattr(hf_config,
+                                            "activation_function",
+                                            "gelu_pytorch_tanh")),
+            gated_mlp=False, position_embedding="learned",
+            attn_bias=True, mlp_bias=True,
+            tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
+                                        True))
+    if mt == "stablelm":
+        # StableLM / StableLM-2: llama layer layout with LAYERNORMS
+        # (biased) instead of rmsnorm, partial rotary, optional qkv-only
+        # bias, untied head.
+        if getattr(hf_config, "use_parallel_residual", False):
+            raise NotImplementedError("stablelm with use_parallel_residual")
+        if getattr(hf_config, "qk_layernorm", False):
+            raise NotImplementedError("stablelm with qk_layernorm")
+        heads = hf_config.num_attention_heads
+        return ModelConfig(
+            name=getattr(hf_config, "name_or_path", mt) or mt,
+            family="stablelm", vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            intermediate_size=hf_config.intermediate_size,
+            num_layers=hf_config.num_hidden_layers, num_heads=heads,
+            num_kv_heads=getattr(hf_config, "num_key_value_heads", None)
+            or heads,
+            head_dim=hf_config.hidden_size // heads,
+            max_position_embeddings=hf_config.max_position_embeddings,
+            norm_type="layernorm",
+            norm_eps=getattr(hf_config, "layer_norm_eps", 1e-5),
+            activation=_act_from_hf(getattr(hf_config, "hidden_act",
+                                            "silu")),
+            gated_mlp=True, position_embedding="rope",
+            rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+            rope_pct=getattr(hf_config, "partial_rotary_factor", 0.25),
+            attn_bias=getattr(hf_config, "use_qkv_bias", False),
+            o_bias=False, mlp_bias=False,
+            tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
+                                        False))
     raise NotImplementedError(
         f"unsupported HF model_type {mt!r}; supported: "
         f"{', '.join(SUPPORTED_MODEL_TYPES)}")
@@ -699,6 +754,84 @@ def convert_state_dict(cfg: ModelConfig, sd, dtype=None):
                 if "transformer.norm_f.bias" in sd
                 else np.zeros((D,), np.float32)},
         }
+    elif fam == "gpt_bigcode":
+        # StarCoder: gpt2 block layout, nn.Linear (out-major) weights.
+        # Fused c_attn rows: MQA stores [q (D) | k (hd) | v (hd)]
+        # straight; the MHA variant is PER-HEAD interleaved
+        # [q_h | k_h | v_h] per head (HF GPTBigCodeAttention views
+        # [heads, 3*head_dim] before splitting).
+        H, hd = cfg.num_heads, cfg.head_dim
+        mqa = cfg.num_kv_heads == 1
+
+        def layer(i):
+            p = f"transformer.h.{i}."
+            ca_w = get(p + "attn.c_attn.weight")
+            ca_b = get(p + "attn.c_attn.bias")
+            if mqa:
+                qw, kw, vw = (ca_w[:D], ca_w[D:D + hd], ca_w[D + hd:])
+                qb, kb, vb = (ca_b[:D], ca_b[D:D + hd], ca_b[D + hd:])
+            else:
+                w3 = ca_w.reshape(H, 3, hd, D)
+                b3 = ca_b.reshape(H, 3, hd)
+                qw, kw, vw = (w3[:, j].reshape(H * hd, D)
+                              for j in range(3))
+                qb, kb, vb = (b3[:, j].reshape(H * hd) for j in range(3))
+
+            def lin(n):
+                return {"w": get(p + n + ".weight").T,
+                        "b": get(p + n + ".bias")}
+            return {
+                "attn_norm": {"scale": get(p + "ln_1.weight"),
+                              "bias": get(p + "ln_1.bias")},
+                "q": {"w": qw.T, "b": qb},
+                "k": {"w": kw.T, "b": kb},
+                "v": {"w": vw.T, "b": vb},
+                "o": lin("attn.c_proj"),
+                "mlp_norm": {"scale": get(p + "ln_2.weight"),
+                             "bias": get(p + "ln_2.bias")},
+                "up": lin("mlp.c_fc"),
+                "down": lin("mlp.c_proj"),
+            }
+        params = {
+            "embed": {"tokens": get("transformer.wte.weight"),
+                      "positions": get("transformer.wpe.weight")},
+            "layers": _stack([layer(i) for i in range(cfg.num_layers)]),
+            "final_norm": {"scale": get("transformer.ln_f.weight"),
+                           "bias": get("transformer.ln_f.bias")},
+        }
+        if not cfg.tie_word_embeddings:
+            params["lm_head"] = {"w": get("lm_head.weight").T}
+    elif fam == "stablelm":
+        def layer(i):
+            p = f"model.layers.{i}."
+
+            def lin(n):
+                out = {"w": get(p + n + ".weight").T}
+                if p + n + ".bias" in sd:   # use_qkv_bias variants
+                    out["b"] = get(p + n + ".bias")
+                return out
+            return {
+                "attn_norm": {"scale": get(p + "input_layernorm.weight"),
+                              "bias": get(p + "input_layernorm.bias")},
+                "q": lin("self_attn.q_proj"),
+                "k": lin("self_attn.k_proj"),
+                "v": lin("self_attn.v_proj"),
+                "o": lin("self_attn.o_proj"),
+                "mlp_norm": {
+                    "scale": get(p + "post_attention_layernorm.weight"),
+                    "bias": get(p + "post_attention_layernorm.bias")},
+                "gate": lin("mlp.gate_proj"),
+                "up": lin("mlp.up_proj"),
+                "down": lin("mlp.down_proj"),
+            }
+        params = {
+            "embed": {"tokens": get("model.embed_tokens.weight")},
+            "layers": _stack([layer(i) for i in range(cfg.num_layers)]),
+            "final_norm": {"scale": get("model.norm.weight"),
+                           "bias": get("model.norm.bias")},
+        }
+        if not cfg.tie_word_embeddings:
+            params["lm_head"] = {"w": get("lm_head.weight").T}
     else:
         raise NotImplementedError(fam)
 

@@ -63,12 +63,37 @@ def _qw(p, dt):
     return p["q"].astype(dt)
 
 
-def _linear(x, p):
+def _linear(x, p, row_sharded: bool = False):
+    if "qT" in p or "wT" in p:
+        # CPU-native transposed layouts (ops/cpu_gemv.py): the engine
+        # repacks leaves to [dout, din] on the unrolled CPU path so
+        # decode streams the stored bytes (f32 / bf16 / int8) through
+        # the FFI GEMV — XLA-CPU's dot leaves ~20% of measured GEMV
+        # bandwidth unused and its int8 lowering materializes the f32
+        # dequant first
+        from distributed_llm_inferencing_tpu.ops import cpu_gemv
+        lead = x.shape[:-1]
+        x2 = x.reshape(-1, x.shape[-1])
+        if "qT" in p:
+            if x2.shape[0] <= cpu_gemv.MAX_FAST_M:
+                y = cpu_gemv.qgemv_i8(x2, p["qT"], p["scale"])
+            else:   # prefill-shaped: compute-bound, XLA's GEMM wins
+                y = (x2.astype(jnp.float32)
+                     @ p["qT"].astype(jnp.float32).T) * p["scale"]
+        else:
+            if x2.shape[0] <= cpu_gemv.MAX_FAST_M:
+                y = cpu_gemv.gemv_w(x2, p["wT"])
+            else:
+                y = x2.astype(jnp.float32) @ p["wT"].astype(jnp.float32).T
+        y = y.reshape(*lead, y.shape[-1])
+        if "b" in p:
+            y = y + p["b"]
+        return y.astype(x.dtype)
     if "p4" in p:   # int4 weight-only: pallas fused-unpack kernel on the
         # decode path, XLA unpack elsewhere (ops/pallas/quant_matmul.py)
         from distributed_llm_inferencing_tpu.ops.pallas.quant_matmul import (
             q4_linear)
-        return q4_linear(x, p)
+        return q4_linear(x, p, row_sharded=row_sharded)
     if "q" in p:   # int8 weight-only (ops/quant.py): per-out-channel scale
         # commutes with the contraction, so it applies to the [.., dout]
         # output — the MXU reads the quantized levels, no dequantized
@@ -97,7 +122,7 @@ def _mlp(x, lp, cfg: ModelConfig):
         h = _act(_linear(x, lp["gate"]), cfg.activation) * _linear(x, lp["up"])
     else:
         h = _act(_linear(x, lp["up"]), cfg.activation)
-    return _linear(h, lp["down"])
+    return _linear(h, lp["down"], row_sharded=cfg.tp_row_sharded)
 
 
 def _ew(operand, p, eq):
@@ -249,9 +274,21 @@ def unembed(params, cfg: ModelConfig, x):
         x = _linear(x, params["embed"]["project_out"])
     if cfg.tie_word_embeddings:
         table = params["embed"]["tokens"]
-        if isinstance(table, dict):   # int8 table (cfg.embed_quant): the
-            # per-row scale is a per-output(vocab)-channel scale here, so
-            # it commutes out of the dot — the tied-head read stays int8
+        # The tied head is the single largest per-token read; on a
+        # single-visible-device CPU process with decode-shaped rows the
+        # FFI kernel streams the stored bytes directly (the [V, D] table
+        # IS its transposed layout) — int8 rows with the per-row scale
+        # (a per-output-channel scale here, it commutes out of the dot),
+        # or raw f32/bf16 rows.
+        from distributed_llm_inferencing_tpu.ops import cpu_gemv
+        b, s, d = x.shape
+        if cpu_gemv.usable_for_rows(b * s):
+            x2 = x.reshape(b * s, d)
+            logits = (cpu_gemv.qgemv_i8(x2, table["q8"], table["rscale"])
+                      if isinstance(table, dict)
+                      else cpu_gemv.gemv_w(x2, table))
+            return logits.reshape(b, s, -1).astype(jnp.float32)
+        if isinstance(table, dict):   # int8 table (cfg.embed_quant)
             logits = jnp.einsum("bsd,vd->bsv", x,
                                 table["q8"].astype(x.dtype))
             logits = logits * table["rscale"].astype(x.dtype)
@@ -292,7 +329,8 @@ def _block_body(x, lp, cfg: ModelConfig, q_positions, attend_write):
                        cfg.rope_interleaved)
 
     attn, cache_out = attend_write(q, k, v)
-    attn = _linear(attn.reshape(B, s, cfg.num_heads * cfg.head_dim), lp["o"])
+    attn = _linear(attn.reshape(B, s, cfg.num_heads * cfg.head_dim), lp["o"],
+                   row_sharded=cfg.tp_row_sharded)
 
     if cfg.parallel_residual:
         h2 = h if cfg.shared_attn_mlp_norm else norm(
@@ -357,7 +395,7 @@ def _block(x, lp, cache_k, cache_v, *, cfg: ModelConfig, q_positions,
                 ring_attend_prefill)
             attn = ring_attend_prefill(
                 q, k, v, q_positions, new_lengths, mesh=mesh,
-                sliding_window=cfg.sliding_window)
+                sliding_window=cfg.sliding_window, alibi=_alibi(cfg))
         elif is_prefill:
             attn = attend_prefill(q, k, v, sliding_window=cfg.sliding_window,
                                   backend=backend, alibi=_alibi(cfg))
@@ -369,7 +407,8 @@ def _block(x, lp, cache_k, cache_v, *, cfg: ModelConfig, q_positions,
                 ring_attend_decode)
             attn = ring_attend_decode(q, ck_at, cv_at, new_lengths,
                                       mesh=mesh,
-                                      sliding_window=cfg.sliding_window)
+                                      sliding_window=cfg.sliding_window,
+                                      alibi=_alibi(cfg))
         else:
             # quantized caches pin the xla formulation: the dequant fuses
             # into its matmul, while a pallas kernel input would
@@ -426,9 +465,26 @@ def forward(
             cache_vs=scales[1] if scales else None)
         return out[0], tuple(out[1:])
 
-    xs = (params["layers"], cache.k, cache.v) + (
+    layers = params["layers"]
+    cache_xs = (cache.k, cache.v) + (
         (cache.k_scale, cache.v_scale) if cache.quantized else ())
-    x, cache_out = jax.lax.scan(body, x, xs)
+    if isinstance(layers, (list, tuple)):
+        # Unrolled layer loop over per-layer weight trees that are SEPARATE
+        # device buffers (engine._maybe_unroll_layers). XLA-CPU lowers an
+        # M<=2 dot whose weight operand is a scan/static slice of a stacked
+        # [L, ...] array to a naive kLoop fusion (~7x slower than the dot
+        # kernel: 290 vs 39 ms/step for gpt2 f32) — real per-buffer weights
+        # get the dot kernel and let batch-1 decode run without the dummy
+        # second row. Cache planes stay stacked; their static slices only
+        # feed small attention ops where the fusion penalty is noise.
+        outs = []
+        for i, lp in enumerate(layers):
+            x, out = body(x, (lp,) + tuple(p[i] for p in cache_xs))
+            outs.append(out)
+        cache_out = tuple(
+            jnp.stack([o[j] for o in outs]) for j in range(len(outs[0])))
+    else:
+        x, cache_out = jax.lax.scan(body, x, (layers,) + cache_xs)
     logits = unembed(params, cfg, x)
     planes = dict(zip(("k", "v", "k_scale", "v_scale"), cache_out))
     return logits, KVCache(lengths=new_lengths, **planes)

@@ -132,14 +132,13 @@ def attend_prefill(q, k, v, *, sliding_window: Optional[int] = None,
 
     Prefill never needs the cache or a validity mask: causality restricts
     every real query row to real slots at or before it, and rows past a
-    sequence's length are garbage the engine never reads. ALiBi models
-    always take the xla formulation (the flash kernels carry no bias
-    term).
+    sequence's length are garbage the engine never reads. ALiBi rides the
+    flash kernel as an in-tile additive bias (one SMEM slope per head).
     """
-    if backend.startswith("pallas") and alibi is None:
+    if backend.startswith("pallas"):
         from distributed_llm_inferencing_tpu.ops.pallas import flash_attention
         return flash_attention(
-            q, k, v, sliding_window=sliding_window,
+            q, k, v, sliding_window=sliding_window, alibi=alibi,
             interpret=(backend == "pallas_interpret"))
     B, S, _, _ = q.shape
     pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
@@ -157,14 +156,14 @@ def attend_decode(q, cache_k, cache_v, lengths, *,
     (speculative verification, ops/speculative.py): pass ``q_positions``
     [B, Sq] so each query is causally masked at its own position — the
     pallas flash-decode kernel is single-query, so multi-token always
-    takes the xla formulation. ALiBi models always take xla.
+    takes the xla formulation. ALiBi rides the flash kernel (in-tile
+    bias from SMEM slopes).
     """
-    multi = q.shape[1] > 1 or alibi is not None
-    if backend.startswith("pallas") and not multi:
+    if backend.startswith("pallas") and q.shape[1] == 1:
         from distributed_llm_inferencing_tpu.ops.pallas import flash_decode
         return flash_decode(
             q, cache_k, cache_v, lengths, sliding_window=sliding_window,
-            interpret=(backend == "pallas_interpret"))
+            alibi=alibi, interpret=(backend == "pallas_interpret"))
     B, S = cache_k.shape[0], cache_k.shape[1]
     kv_pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
     kv_valid = kv_pos < lengths[:, None]

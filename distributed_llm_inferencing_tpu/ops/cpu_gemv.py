@@ -1,0 +1,147 @@
+"""int8 weight-only matmul for XLA-CPU via an FFI custom call.
+
+XLA-CPU cannot read int8 weights inside a dot: its lowering materializes
+the dequantized f32 array first, so an int8-quantized model streams
+f32-sized bytes per decode step and the quantization buys nothing on the
+degraded/fallback platform. This wraps ``native/src/qgemv.cc`` — a C++
+kernel that streams the weights int8 and dequantizes in registers — as a
+jit-compatible ``jax.ffi`` call, the CPU sibling of the Pallas int4
+fused-unpack kernel (ops/pallas/quant_matmul.py) on the TPU side.
+
+Built on first use with g++ (same pattern as native/__init__.py's block
+pool); if the toolchain or ``jax.ffi`` is unavailable, ``available()``
+is False and callers keep the portable XLA path. The reference has no
+counterpart at any level — its CPU path is stock HF torch generate
+(reference worker/app.py:297-305).
+
+Weight layout: the kernel wants the TRANSPOSED quantized weight
+``[dout, din]`` (contiguous along the contraction axis). The engine
+repacks int8 leaves into this layout when it adopts the CPU-unrolled
+path (runtime/engine.py _maybe_unroll_layers); the per-row int8
+embedding table (ops/quant.py quantize_embed) is already ``[V, D]`` and
+needs no repack for the tied unembed.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import tempfile
+import threading
+
+log = logging.getLogger("dli.cpu_gemv")
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(os.path.dirname(_HERE), "native", "src", "qgemv.cc")
+_LIB = os.path.join(os.path.dirname(_HERE), "native", "libdli_qgemv.so")
+_TARGET = "dli_qgemv_i8"
+
+_lock = threading.Lock()
+_state = {"ready": False, "failed": False}
+
+# the kernel keeps per-row accumulators for up to this many activation
+# rows while a weight row is hot in L1; larger M is compute-bound and
+# belongs on the XLA dequant matmul (see MAX_FAST_M use in callers)
+MAX_FAST_M = 4
+
+
+def _build():
+    import jax.ffi
+    if (os.path.exists(_LIB)
+            and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC)):
+        return _LIB
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=os.path.dirname(_LIB))
+    os.close(fd)
+    obj = tmp + ".o"
+    try:
+        # fast-math applies at COMPILE only (the dot reassociates/
+        # vectorizes); linking without it keeps crtfastmath.o out of the
+        # .so — that startup object would flip FTZ/DAZ in MXCSR for the
+        # whole process the moment the library loads
+        subprocess.run(
+            ["g++", "-O3", "-march=native", "-ffast-math", "-std=c++17",
+             "-c", "-fPIC", f"-I{jax.ffi.include_dir()}", _SRC, "-o", obj],
+            check=True, capture_output=True, timeout=180)
+        subprocess.run(
+            ["g++", "-shared", obj, "-o", tmp],
+            check=True, capture_output=True, timeout=60)
+        os.rename(tmp, _LIB)  # atomic: concurrent procs never half-load
+    finally:
+        for p in (tmp, obj):
+            if os.path.exists(p):
+                os.unlink(p)
+    return _LIB
+
+
+def _ensure():
+    if _state["ready"] or _state["failed"]:
+        return _state["ready"]
+    with _lock:
+        if _state["ready"] or _state["failed"]:
+            return _state["ready"]
+        try:
+            import jax
+            import jax.ffi
+            lib = ctypes.CDLL(_build())
+            jax.ffi.register_ffi_target(
+                _TARGET, jax.ffi.pycapsule(lib.QGemvI8), platform="cpu")
+            jax.ffi.register_ffi_target(
+                "dli_gemv_f32", jax.ffi.pycapsule(lib.GemvF32),
+                platform="cpu")
+            jax.ffi.register_ffi_target(
+                "dli_gemv_bf16", jax.ffi.pycapsule(lib.GemvBf16),
+                platform="cpu")
+            _state["ready"] = True
+        except Exception as e:  # missing g++ / headers / old jax: fall back
+            log.warning("cpu int8 gemv unavailable (%s); int8 matmuls use "
+                        "the XLA dequant path on cpu", e)
+            _state["failed"] = True
+    return _state["ready"]
+
+
+def available() -> bool:
+    """True once the kernel is built+registered (attempts on first call)."""
+    return _ensure()
+
+
+def usable_for_rows(rows: int) -> bool:
+    """One gate for trace-time call sites that are NOT behind an
+    engine-repacked leaf (the tied unembed): decode-shaped row counts,
+    single-visible-device CPU process, kernel built. Keeping it here
+    stops the condition from drifting between branches."""
+    import jax
+    return (rows <= MAX_FAST_M
+            and jax.default_backend() == "cpu"
+            and jax.device_count() == 1
+            and available())
+
+
+def qgemv_i8(x, wt, scale):
+    """y[M,N] = (x[M,K] @ dequant(wt[N,K]).T) * scale[N], f32 out.
+
+    Jit-compatible (lowers to the registered custom call). Callers gate on
+    ``available()`` and keep M small (<= MAX_FAST_M) — large M is
+    compute-bound and faster on the XLA dequant matmul.
+    """
+    import jax.ffi
+    import jax.numpy as jnp
+    m, _ = x.shape
+    n = wt.shape[0]
+    call = jax.ffi.ffi_call(
+        _TARGET, jax.ShapeDtypeStruct((m, n), jnp.float32))
+    return call(x.astype(jnp.float32), wt, scale.astype(jnp.float32))
+
+
+def gemv_w(x, wt):
+    """y[M,N] = x[M,K] @ wt[N,K].T for f32 or bf16-stored weights, f32
+    out (f32 accumulate either way). Same caveats as qgemv_i8."""
+    import jax.ffi
+    import jax.numpy as jnp
+    m, _ = x.shape
+    n = wt.shape[0]
+    target = "dli_gemv_bf16" if wt.dtype == jnp.bfloat16 else "dli_gemv_f32"
+    call = jax.ffi.ffi_call(
+        target, jax.ShapeDtypeStruct((m, n), jnp.float32))
+    return call(x.astype(jnp.float32), wt)

@@ -48,9 +48,12 @@ def _pick_block(n: int, target: int) -> int:
 # Prefill: causal self-attention over the fresh (uncached) K/V block
 # ----------------------------------------------------------------------
 
-def _prefill_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
-                    *, block_q: int, block_kv: int, scale: float,
-                    sliding_window: Optional[int]):
+def _prefill_kernel(*refs, block_q: int, block_kv: int, scale: float,
+                    sliding_window: Optional[int], alibi: bool):
+    if alibi:
+        sl_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr = refs
     i, j = pl.program_id(2), pl.program_id(3)
     nkv = pl.num_programs(3)
 
@@ -76,6 +79,12 @@ def _prefill_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
             jnp.int32, (block_q, block_kv), 0)
         kv_pos = kv_start + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_kv), 1)
+        if alibi:
+            # linear position bias on the VPU, after scale (matching the
+            # xla formulation ops/attention.py attend): this head's slope
+            # arrives as an SMEM scalar, rel = kv - q is never positive
+            # at attended positions
+            s += sl_ref[0, 0] * (kv_pos - q_pos).astype(jnp.float32)
         mask = kv_pos <= q_pos
         if sliding_window is not None:
             mask &= (q_pos - kv_pos) < sliding_window
@@ -109,6 +118,7 @@ def flash_attention(
     v,                    # [B, Sq, Hkv, hd]
     *,
     sliding_window: Optional[int] = None,
+    alibi=None,           # [H] f32 slopes (ops/attention.py alibi_slopes)
     block_q: int = 256,
     block_kv: int = 512,
     interpret: bool = False,
@@ -119,6 +129,9 @@ def flash_attention(
     slot 0). Rows past a sequence's real length compute garbage that the
     caller never reads (logits are gathered at length-1) — exactly the
     semantics of ops/attention.py's reference path in prefill mode.
+    ``alibi`` adds the BLOOM/Falcon-RW/MPT linear bias inside the tile
+    loop (one SMEM scalar per head), so the ALiBi families run the same
+    kernel as the rotary ones.
     """
     B, Sq, H, hd = q.shape
     Hkv = k.shape[2]
@@ -134,18 +147,25 @@ def flash_attention(
     grid = (B, H, Sq // bq, Sq // bkv)
     kernel = functools.partial(
         _prefill_kernel, block_q=bq, block_kv=bkv, scale=scale,
-        sliding_window=sliding_window)
+        sliding_window=sliding_window, alibi=alibi is not None)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, bq, hd), lambda b, h, i, j: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, bkv, hd),
+                     lambda b, h, i, j, g=group: (b, h // g, j, 0)),
+        pl.BlockSpec((1, 1, bkv, hd),
+                     lambda b, h, i, j, g=group: (b, h // g, j, 0)),
+    ]
+    args = (qt, kt, vt)
+    if alibi is not None:
+        in_specs = [pl.BlockSpec((1, 1), lambda b, h, i, j: (h, 0),
+                                 memory_space=pltpu.SMEM)] + in_specs
+        args = (alibi.astype(jnp.float32).reshape(H, 1),) + args
 
     out = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, hd), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bkv, hd),
-                         lambda b, h, i, j, g=group: (b, h // g, j, 0)),
-            pl.BlockSpec((1, 1, bkv, hd),
-                         lambda b, h, i, j, g=group: (b, h // g, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, bq, hd),
                                lambda b, h, i, j: (b, h, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B, H, Sq, hd), q.dtype),
@@ -155,7 +175,7 @@ def flash_attention(
             pltpu.VMEM((bq, hd), jnp.float32),    # output accumulator
         ],
         interpret=interpret,
-    )(qt, kt, vt)
+    )(*args)
     return jnp.transpose(out, (0, 2, 1, 3))
 
 
@@ -163,9 +183,13 @@ def flash_attention(
 # Decode: one query token per sequence against the cached K/V
 # ----------------------------------------------------------------------
 
-def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
-                   acc_scr, *, block_kv: int, scale: float,
-                   sliding_window: Optional[int]):
+def _decode_kernel(*refs, block_kv: int, scale: float,
+                   sliding_window: Optional[int], alibi: bool):
+    if alibi:
+        sl_ref, len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, \
+            acc_scr = refs
+    else:
+        len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr = refs
     j = pl.program_id(2)
     nkv = pl.num_programs(2)
 
@@ -191,6 +215,12 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
         G = q.shape[0]
         kv_pos = kv_start + jax.lax.broadcasted_iota(
             jnp.int32, (G, block_kv), 1)
+        if alibi:
+            # per-group-head slopes from SMEM (G scalar reads, G static;
+            # G == 1 for the MHA ALiBi families BLOOM/Falcon-RW/MPT);
+            # query position == length - 1, so rel = kv - (length-1)
+            sl = jnp.stack([sl_ref[0, g] for g in range(G)])[:, None]
+            s += sl * (kv_pos - (length - 1)).astype(jnp.float32)
         mask = kv_pos < length          # causal: q position == length - 1
         if sliding_window is not None:
             mask &= ((length - 1) - kv_pos) < sliding_window
@@ -224,6 +254,7 @@ def flash_decode(
     lengths,              # [B] int32 — cache fill AFTER this token's write
     *,
     sliding_window: Optional[int] = None,
+    alibi=None,           # [H] f32 slopes (ops/attention.py alibi_slopes)
     block_kv: int = 512,
     interpret: bool = False,
 ):
@@ -231,7 +262,9 @@ def flash_decode(
 
     The query sits at position ``lengths - 1``; valid kv slots are
     ``[0, lengths)`` (slot index == absolute position, the engine's cache
-    invariant — models/transformer.py ``forward`` docstring).
+    invariant — models/transformer.py ``forward`` docstring). ``alibi``
+    adds the linear position bias inside the tile loop (SMEM slopes), so
+    ALiBi families run this kernel too.
     """
     B, one, H, hd = q.shape
     assert one == 1, "flash_decode takes exactly one query token"
@@ -248,18 +281,25 @@ def flash_decode(
     grid = (B, Hkv, S // bkv)
     kernel = functools.partial(
         _decode_kernel, block_kv=bkv, scale=scale,
-        sliding_window=sliding_window)
+        sliding_window=sliding_window, alibi=alibi is not None)
+
+    in_specs = [
+        pl.BlockSpec((1, 1), lambda b, h, j: (b, 0),
+                     memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, 1, G, hd), lambda b, h, j: (b, h, 0, 0)),
+        pl.BlockSpec((1, 1, bkv, hd), lambda b, h, j: (b, h, j, 0)),
+        pl.BlockSpec((1, 1, bkv, hd), lambda b, h, j: (b, h, j, 0)),
+    ]
+    args = (len2d, qt, kt, vt)
+    if alibi is not None:
+        in_specs = [pl.BlockSpec((1, G), lambda b, h, j: (h, 0),
+                                 memory_space=pltpu.SMEM)] + in_specs
+        args = (alibi.astype(jnp.float32).reshape(Hkv, G),) + args
 
     out = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1), lambda b, h, j: (b, 0),
-                         memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 1, G, hd), lambda b, h, j: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, bkv, hd), lambda b, h, j: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, bkv, hd), lambda b, h, j: (b, h, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, G, hd), lambda b, h, j: (b, h, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((B, Hkv, G, hd), q.dtype),
         scratch_shapes=[
@@ -268,5 +308,5 @@ def flash_decode(
             pltpu.VMEM((G, hd), jnp.float32),
         ],
         interpret=interpret,
-    )(len2d, qt, kt, vt)
+    )(*args)
     return out.reshape(B, H, hd)[:, None]

@@ -78,36 +78,126 @@ def _pick_tile(din: int) -> int:
     return tile
 
 
-def _device_ok() -> bool:
-    """The kernel has no GSPMD partitioning rule, so it must not appear
-    in multi-device programs. Trace-time code cannot see whether the
-    enclosing jit targets one device or a mesh, so the default gate is
-    the conservative process-global device count — which also disables
-    the kernel for single-chip (tp=1) models on hosts that merely SEE
-    more chips. ``DLI_INT4_PALLAS=always`` overrides for that case (the
-    operator asserts int4 models run single-device); ``never`` forces
-    the XLA fallback everywhere (debugging)."""
-    mode = os.environ.get("DLI_INT4_PALLAS", "auto")
-    if mode == "always":
-        return True
-    if mode == "never":
-        return False
-    return jax.device_count() == 1
+def _mode() -> str:
+    return os.environ.get("DLI_INT4_PALLAS", "auto")
 
 
-def supported(rows: int, din: int, dout: int) -> bool:
+def supported(rows: int, din: int, dout: int,
+              row_sharded: bool = False) -> bool:
     """Trace-time gate for the pallas path. Falls back to the XLA unpack
     (ops/quant.py) when the shape or platform doesn't fit: prefill-sized
-    row counts, odd dims, multi-device GSPMD programs (the kernel has no
-    partitioning rule — see _device_ok), or a non-TPU backend."""
+    row counts, odd dims, a non-TPU backend, or a ROW-parallel
+    (contraction-axis-sharded) weight in a multi-device program.
+
+    The kernel carries a GSPMD/shardy partitioning rule (see
+    ``_q4_matmul_p``) that shards the OUTPUT channel axis, so
+    column-parallel leaves (q/k/v/up/gate, untied lm_head — the
+    megatron layout in parallel/sharding.py) run the kernel per-shard on
+    tp meshes. A din-sharded (row-parallel: o/down) leaf would force the
+    partitioner to all-gather the weight to satisfy the rule — worse
+    than the XLA unpack — and the split-half packing means its shards
+    don't unpack to contiguous din ranges anyway, so those leaves keep
+    the XLA path when tp > 1 (models/transformer.py threads the hint).
+
+    ``DLI_INT4_PALLAS``: ``never`` forces the XLA fallback everywhere;
+    ``interpret`` runs the kernel in pallas interpret mode on any
+    backend (CPU-mesh dryruns/tests of the partitioned path); ``auto``
+    (default) uses the kernel on TPU. (The historical ``always``
+    override predates the partitioning rule and now means ``auto``.)
+    """
+    mode = _mode()
+    if mode == "never":
+        return False
     return (
         rows <= MAX_PALLAS_ROWS
         and din % 2 == 0
         and din // 2 >= 32            # int8 sublane tile
         and dout >= 128               # lane width
-        and jax.default_backend() == "tpu"
-        and _device_ok()
+        and not row_sharded
+        and (jax.default_backend() == "tpu" or mode == "interpret")
     )
+
+
+def _q4_pallas(x, p4, scale, interpret: bool):
+    """The raw pallas call: x [b, din] (b pre-padded to the sublane
+    tile), p4 [din//2, dout], scale [dout]."""
+    b, din = x.shape
+    dout = p4.shape[-1]
+    tile_o = _pick_tile(din)
+    kernel = _biased_kernel if x.dtype == jnp.bfloat16 else _signed_kernel
+    return pl.pallas_call(
+        kernel,
+        grid=(pl.cdiv(dout, tile_o),),
+        in_specs=[
+            pl.BlockSpec((b, din), lambda o: (0, 0)),
+            pl.BlockSpec((din // 2, tile_o), lambda o: (0, o)),
+            pl.BlockSpec((1, tile_o), lambda o: (0, o)),
+        ],
+        out_specs=pl.BlockSpec((b, tile_o), lambda o: (0, o)),
+        out_shape=jax.ShapeDtypeStruct((b, dout), x.dtype),
+        interpret=interpret,
+    )(x, p4, scale.reshape(1, dout).astype(jnp.float32))
+
+
+# ---- GSPMD/shardy partitioning -----------------------------------------
+#
+# Factors: m = rows, k = din, h = din//2 (the packed axis), n = dout.
+# k and h must be replicated (one kernel instance needs the full
+# contraction); m and n may shard freely — n over tp is the column-
+# parallel case the kernel exists for (llama-8B tp / 70B pp+tp regimes).
+# The partition callback re-lowers the SAME pallas call on the local
+# shard: the grid is a ceil-div over the local dout and Mosaic pads the
+# final block, so any per-shard dout >= 128 works.
+
+from jax.experimental.custom_partitioning import (  # noqa: E402
+    custom_partitioning)
+from jax.sharding import NamedSharding  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+
+def _spec_of(shape_with_sharding):
+    sh = getattr(shape_with_sharding, "sharding", None)
+    spec = getattr(sh, "spec", None)
+    return tuple(spec) if spec is not None else ()
+
+
+def _pad_spec(spec, rank):
+    spec = tuple(spec)[:rank]
+    return spec + (None,) * (rank - len(spec))
+
+
+def _q4_infer(interpret, mesh, arg_shapes, result_shape):
+    m = _pad_spec(_spec_of(arg_shapes[0]), 2)[0]
+    n = _pad_spec(_spec_of(arg_shapes[1]), 2)[1]
+    return NamedSharding(mesh, P(m, n))
+
+
+def _q4_partition(interpret, mesh, arg_shapes, result_shape):
+    m = _pad_spec(_spec_of(arg_shapes[0]), 2)[0]
+    n = _pad_spec(_spec_of(arg_shapes[1]), 2)[1]
+    arg_shardings = (
+        NamedSharding(mesh, P(m, None)),     # x: contraction replicated
+        NamedSharding(mesh, P(None, n)),     # p4: dout sharded
+        NamedSharding(mesh, P(n)),           # scale follows dout
+    )
+    out_sharding = NamedSharding(mesh, P(m, n))
+
+    def lower(x, p4, scale):
+        return _q4_pallas(x, p4, scale, interpret)
+
+    return mesh, lower, out_sharding, arg_shardings
+
+
+@functools.partial(custom_partitioning, static_argnums=(3,))
+def _q4_matmul_p(x, p4, scale, interpret):
+    return _q4_pallas(x, p4, scale, interpret)
+
+
+_q4_matmul_p.def_partition(
+    partition=_q4_partition,
+    infer_sharding_from_operands=_q4_infer,
+    sharding_rule="m k, h n, n -> m n",
+    need_replication_factors=("k", "h"))
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -116,33 +206,25 @@ def q4_matmul(x, p4, scale, interpret: bool = False):
 
     ``p4`` uses the split-half biased packing of ops/quant.py pack_int4.
     Rows are padded to the sublane tile; callers gate with supported().
+    Safe inside multi-device GSPMD programs: the partitioning rule above
+    shards the output-channel axis and replicates the contraction.
     """
     b, din = x.shape
-    dout = p4.shape[-1]
-    tile_o = _pick_tile(din)
     pad = (-b) % 8
     if pad:
         x = jnp.pad(x, ((0, pad), (0, 0)))
-    kernel = _biased_kernel if x.dtype == jnp.bfloat16 else _signed_kernel
-    out = pl.pallas_call(
-        kernel,
-        grid=(pl.cdiv(dout, tile_o),),
-        in_specs=[
-            pl.BlockSpec((b + pad, din), lambda o: (0, 0)),
-            pl.BlockSpec((din // 2, tile_o), lambda o: (0, o)),
-            pl.BlockSpec((1, tile_o), lambda o: (0, o)),
-        ],
-        out_specs=pl.BlockSpec((b + pad, tile_o), lambda o: (0, o)),
-        out_shape=jax.ShapeDtypeStruct((b + pad, dout), x.dtype),
-        interpret=interpret,
-    )(x, p4, scale.reshape(1, dout).astype(jnp.float32))
+    out = _q4_matmul_p(x, p4, scale.astype(jnp.float32), interpret)
     return out[:b] if pad else out
 
 
-def q4_linear(x, p):
+def q4_linear(x, p, row_sharded: bool = False):
     """Quantized linear over an int4 leaf ``{"p4", "scale"[, "b"]}`` with
     arbitrary leading dims on x. Dispatches to the pallas kernel for
-    decode-shaped calls on a single TPU, else to the XLA unpack path."""
+    decode-shaped calls on TPU (column-parallel or replicated leaves;
+    see supported()), else to the XLA unpack path. ``row_sharded``: the
+    caller's mesh shards this leaf's din axis (tp>1 o/down projections),
+    which the kernel's partitioning rule cannot serve without an
+    all-gather — keep those on XLA."""
     from distributed_llm_inferencing_tpu.ops.quant import unpack_int4
 
     din = x.shape[-1]
@@ -151,8 +233,9 @@ def q4_linear(x, p):
     rows = 1
     for s in lead:
         rows *= s
-    if p["p4"].ndim == 2 and supported(rows, din, dout):
-        y = q4_matmul(x.reshape(rows, din), p["p4"], p["scale"])
+    if p["p4"].ndim == 2 and supported(rows, din, dout, row_sharded):
+        y = q4_matmul(x.reshape(rows, din), p["p4"], p["scale"],
+                      interpret=_mode() == "interpret")
         y = y.reshape(*lead, dout)
     else:
         y = jnp.einsum("...d,df->...f", x, unpack_int4(p["p4"]).astype(x.dtype))

@@ -144,11 +144,17 @@ def accept_rejection_batch(logits, drafts, seeds, steps, temps, top_ks,
     m, thresh = nucleus_mask_sorted(vals, width, top_ps[:, None, None])
     z = jax.nn.logsumexp(m, axis=-1)                            # [R,G1]
 
-    # p_i(d_i): the draft token's mass under position i's warped dist
-    d_val = jnp.take_along_axis(scaled[:, :-1], drafts[..., None],
-                                axis=-1)[..., 0]                # [R,G]
-    in_support = d_val >= thresh[:, :-1, 0]
-    p_draft = jnp.where(in_support, jnp.exp(d_val - z[:, :-1]), 0.0)
+    # p_i(d_i): the draft token's mass under position i's warped dist.
+    # Support membership comes from the kept top-k prefix ITSELF, not a
+    # value-vs-threshold compare: a draft whose logit exactly ties the
+    # threshold but lost the top-k index tiebreak is out-of-support, and
+    # the threshold compare would wrongly admit it (while the rejection
+    # residual could not then exclude it) — ADVICE r4.
+    kept = m > -jnp.inf                                         # [R,G1,KS]
+    match = (idx[:, :-1] == drafts[..., None]) & kept[:, :-1]   # [R,G,KS]
+    p_draft = jnp.sum(
+        jnp.where(match, jnp.exp(m[:, :-1] - z[:, :-1, None]), 0.0),
+        axis=-1)                                                # [R,G]
 
     # per-row PRNG: fold the emitted-count stream position, then a spec
     # tag per use — reproducible, independent of chunk-mates

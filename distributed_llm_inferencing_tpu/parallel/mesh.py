@@ -78,18 +78,10 @@ def validate_spec(spec: MeshSpec, cfg) -> None:
     """Shape-divisibility checks so failures happen at plan time, not inside
     a compiled program (the reference deferred every such error to runtime
     HTTP 500s, worker/app.py:133-137)."""
-    import os
-    if (getattr(cfg, "quant", None) == "int4" and spec.num_devices > 1
-            and os.environ.get("DLI_INT4_PALLAS") == "always"):
-        # the pallas int4 kernel has no GSPMD partitioning rule; the
-        # "always" override exists for single-device programs on hosts
-        # that merely SEE several chips — tracing it into a real
-        # multi-device mesh would silently corrupt results
-        raise ValueError(
-            f"DLI_INT4_PALLAS=always with a {spec.num_devices}-device "
-            "mesh: the pallas int4 kernel cannot be partitioned; unset "
-            "the override (auto already falls back to the XLA unpack on "
-            "multi-device meshes)")
+    # (int4 + multi-device needs no refusal since the pallas kernel
+    # carries a GSPMD/shardy partitioning rule — column-parallel leaves
+    # run it per-shard, row-parallel leaves fall back to the XLA unpack;
+    # ops/pallas/quant_matmul.py supported())
     if cfg.num_heads % spec.tp:
         raise ValueError(f"tp={spec.tp} must divide num_heads={cfg.num_heads}")
     if spec.tp <= cfg.num_kv_heads and cfg.num_kv_heads % spec.tp:
@@ -103,10 +95,8 @@ def validate_spec(spec: MeshSpec, cfg) -> None:
             f"tp={spec.tp} must divide intermediate_size={cfg.intermediate_size}")
     if cfg.num_layers % spec.pp:
         raise ValueError(f"pp={spec.pp} must divide num_layers={cfg.num_layers}")
-    if spec.sp > 1 and getattr(cfg, "position_embedding", None) == "alibi":
-        raise ValueError(
-            "sp>1 with alibi positions: the ring-attention path carries "
-            "no linear position bias yet")
+    # (sp + alibi needs no refusal: the ring bodies carry the linear
+    # position bias — slopes shard over tp with the heads, parallel/ring.py)
     if spec.sp > 1 and spec.pp > 1:
         raise ValueError(
             "sp and pp cannot both exceed 1 yet: the pipelined executor "

@@ -279,6 +279,323 @@ def paged_decode_chunk_pp(params, cfg: ModelConfig, k: int, tokens, paged,
     return toks, emits, PagedKVCache(k=new_k, v=new_v)
 
 
+def paged_speculative_chunk_pp(params, cfg: ModelConfig, k: int, gamma: int,
+                               tokens, history, paged, block_tables,
+                               context_lens, seeds, steps0, temps, tks, tps,
+                               ds, budget, eos_ids, dummy_block: int,
+                               *, mesh: Mesh):
+    """K speculative iterations with the layer stack pipelined over
+    ``pp``. Same contract as transformer.paged_speculative_chunk:
+    returns (toks [K, R, gamma+1], keeps [K, R], eos_seen [K, R],
+    new paged).
+
+    This is the round-3/4 gap closed one level up: speculation pays most
+    exactly where decode is slowest — the pp-sharded big models — and
+    was previously refused at batcher construction. The GPipe schedule
+    is paged_decode_chunk_pp's (microbatch (t-stage) mod pp at iteration
+    (t-stage) div pp; activations AND per-microbatch decode state ride
+    ``ppermute``); the speculative machinery is the single-stage
+    chunk's, with two pipeline-specific twists:
+
+    - The draft/acceptance STATE rides the ring alongside the
+      activations: the token history (drafting source), the per-entry
+      side positions and committed-entry mask (attention validity), and
+      the emitted/eos bookkeeping. Stage 0 drafts (the history arrives
+      with the microbatch), every stage attends pool + committed side
+      entries + the current block, the last stage runs the exact
+      leave-one-out rejection (ops/speculative.py accept_rejection_batch)
+      and updates the riding state before it wraps to stage 0.
+    - The post-loop pool scatter needs every microbatch's FINAL
+      side_pos/acc_mask on every stage, but each final state ends the
+      loop held by exactly one stage (states keep circulating unchanged
+      once their k iterations are done, so after the last tick the pp
+      in-flight states are the pp microbatches' finals). Each state
+      carries its microbatch id; one psum of id-scattered buffers
+      reassembles the full [R, E] masks everywhere, then each stage
+      scatters its local side K/V slice exactly like the single-stage
+      version.
+    """
+    from distributed_llm_inferencing_tpu.models import transformer as tf
+    from distributed_llm_inferencing_tpu.ops.attention import attend
+    from distributed_llm_inferencing_tpu.ops.kvcache import (
+        dequant_kv, quant_kv)
+    from distributed_llm_inferencing_tpu.ops.paged_kvcache import (
+        PagedKVCache, gather_seq)
+    from distributed_llm_inferencing_tpu.ops.speculative import (
+        accept_rejection_batch, propose_ngram_device)
+
+    pp = mesh.shape["pp"]
+    r = tokens.shape[0]
+    if r % pp:
+        raise ValueError(f"slots {r} must divide over pp={pp}")
+    mbsz = r // pp
+    L = cfg.num_layers
+    bs = paged.block_size
+    mb = block_tables.shape[1]
+    g1 = gamma + 1
+    E = k * g1
+    dt = jnp.dtype(cfg.dtype)
+    quantized = paged.quantized
+    cl0 = context_lens
+    H = history.shape[1]
+    n_ticks = k * pp + pp - 1
+    entry_step = jnp.arange(E, dtype=jnp.int32) // g1              # [E]
+
+    p_layers, p_other = _split_params(params)
+    layer_spec, other_spec = _specs(p_layers, p_other)
+    perm = [(i, (i + 1) % pp) for i in range(pp)]
+
+    def body(p_layers, p_other, pool_k, pool_v, pool_ks, pool_vs, tokens,
+             history, cl0_, bt, seeds, steps0, temps, tks, tps, ds, budget,
+             eos_ids):
+        pd = dict(p_other)
+        pd["layers"] = p_layers
+        stage = jax.lax.axis_index("pp")
+        is_last = stage == pp - 1
+        L_loc = pool_k.shape[0]
+        assert L_loc == L // pp
+
+        def mrows(a, m):
+            return jax.lax.dynamic_slice_in_dim(a, m * mbsz, mbsz, 0)
+
+        side0 = jnp.zeros((L_loc, r, E, cfg.num_kv_heads, cfg.head_dim), dt)
+        # ring state: one microbatch's speculation context
+        state0 = dict(
+            x=jnp.zeros((mbsz, g1, cfg.hidden_size), dt),
+            cur=jnp.zeros((mbsz,), jnp.int32),
+            drafts=jnp.zeros((mbsz, gamma), jnp.int32),
+            hist=jnp.zeros((mbsz, H), jnp.int32),
+            hist_len=jnp.zeros((mbsz,), jnp.int32),
+            cl=jnp.zeros((mbsz,), jnp.int32),
+            alive=jnp.zeros((mbsz,), bool),
+            emitted=jnp.zeros((mbsz,), jnp.int32),
+            eos_seen=jnp.zeros((mbsz,), bool),
+            side_pos=jnp.zeros((mbsz, E), jnp.int32),
+            acc=jnp.zeros((mbsz, E), bool),
+            m_id=jnp.asarray(-1, jnp.int32),
+        )
+        toks0 = jnp.zeros((k, r, g1), jnp.int32)
+        flags0 = jnp.zeros((k, r), jnp.int32)
+        carry0 = (state0, side0, side0, toks0, flags0, flags0)
+
+        def tick(t, carry):
+            st, side_k, side_v, toks_buf, keeps_buf, eos_buf = carry
+            j = t - stage
+            valid = (j >= 0) & (j < k * pp)
+            m = jnp.where(valid, j % pp, 0)
+            d = jnp.where(valid, j // pp, 0)
+
+            # stage 0 injects microbatch t at tick t (fill phase)
+            fresh = (stage == 0) & (t < pp)
+
+            def inj(old, new):
+                return jnp.where(fresh, new, old)
+
+            cur = inj(st["cur"], mrows(tokens, m))
+            hist = jnp.where(fresh, mrows(history, m), st["hist"])
+            hist_len = inj(st["hist_len"], mrows(cl0_, m) + 1)
+            cl = inj(st["cl"], mrows(cl0_, m))
+            alive = jnp.where(fresh, mrows(budget, m) > 0, st["alive"])
+            emitted = inj(st["emitted"], jnp.zeros((mbsz,), jnp.int32))
+            eos_seen = jnp.where(fresh, jnp.zeros((mbsz,), bool),
+                                 st["eos_seen"])
+            side_pos_m = jnp.where(fresh, jnp.zeros((mbsz, E), jnp.int32),
+                                   st["side_pos"])
+            acc_m = jnp.where(fresh, jnp.zeros((mbsz, E), bool), st["acc"])
+            m_id = jnp.where(fresh, t, st["m_id"])
+
+            qp0 = jnp.where(alive, cl, 0)
+            qp = qp0[:, None] + jnp.arange(g1, dtype=jnp.int32)[None, :]
+
+            # stage 0 drafts from the riding history; later stages keep
+            # the drafts that rode in with the activations
+            drafts_new, _ = propose_ngram_device(hist, hist_len, gamma)
+            drafts = jnp.where(stage == 0, drafts_new, st["drafts"])
+            toks_in = jnp.concatenate([cur[:, None], drafts], axis=1)
+            x_emb = tf.embed(pd, cfg, toks_in, qp)
+            x_in = jnp.where(stage == 0, x_emb, st["x"])
+
+            upd = jax.lax.dynamic_update_slice(side_pos_m, qp, (0, d * g1))
+            side_pos_m = jnp.where(valid, upd, side_pos_m)
+            is_cur_block = jnp.broadcast_to(entry_step == d, (mbsz, E))
+            side_valid = acc_m | is_cur_block
+
+            bt_m = mrows(bt, m)
+            cl0_m = mrows(cl0_, m)
+            pool_pos = jnp.broadcast_to(
+                jnp.arange(mb * bs, dtype=jnp.int32), (mbsz, mb * bs))
+            pool_valid = pool_pos < cl0_m[:, None]
+
+            def layer(xc, layer_in):
+                if quantized:
+                    lp, sk, sv, ck, cv, cks, cvs = layer_in
+                    kp = dequant_kv(gather_seq(ck, bt_m),
+                                    gather_seq(cks, bt_m), dt)
+                    vp = dequant_kv(gather_seq(cv, bt_m),
+                                    gather_seq(cvs, bt_m), dt)
+                else:
+                    lp, sk, sv, ck, cv = layer_in
+                    kp = gather_seq(ck, bt_m)
+                    vp = gather_seq(cv, bt_m)
+                sk_m = jax.lax.dynamic_slice_in_dim(sk, m * mbsz, mbsz, 0)
+                sv_m = jax.lax.dynamic_slice_in_dim(sv, m * mbsz, mbsz, 0)
+
+                def attend_write(q, kh, vh):
+                    sk2 = jax.lax.dynamic_update_slice(
+                        sk_m, kh.astype(dt), (0, d * g1, 0, 0))
+                    sv2 = jax.lax.dynamic_update_slice(
+                        sv_m, vh.astype(dt), (0, d * g1, 0, 0))
+                    attn = attend(
+                        q,
+                        jnp.concatenate([kp, sk2], axis=1),
+                        jnp.concatenate([vp, sv2], axis=1),
+                        qp,
+                        jnp.concatenate([pool_pos, side_pos_m], axis=1),
+                        jnp.concatenate([pool_valid, side_valid], axis=1),
+                        sliding_window=cfg.sliding_window,
+                        alibi=tf._alibi(cfg))
+                    return attn, (sk2, sv2)
+
+                xc, (sk2, sv2) = tf._block_body(xc, lp, cfg, qp,
+                                                attend_write)
+                sk = jax.lax.dynamic_update_slice_in_dim(
+                    sk, jnp.where(valid, sk2, sk_m), m * mbsz, 0)
+                sv = jax.lax.dynamic_update_slice_in_dim(
+                    sv, jnp.where(valid, sv2, sv_m), m * mbsz, 0)
+                return xc, (sk, sv)
+
+            xs = (p_layers, side_k, side_v, pool_k, pool_v)
+            if quantized:
+                xs = xs + (pool_ks, pool_vs)
+            x2, (side_k, side_v) = jax.lax.scan(layer, x_in, xs)
+
+            # last stage: exact acceptance + state advance (the same
+            # bookkeeping as the single-stage chunk, per-microbatch)
+            logits = tf.unembed(pd, cfg, x2)                  # [mb, g1, V]
+            toks_out, n_emit = accept_rejection_batch(
+                logits, drafts, mrows(seeds, m), mrows(steps0, m) + emitted,
+                mrows(temps, m), mrows(tks, m), mrows(tps, m), mrows(ds, m))
+            idx = jnp.arange(g1, dtype=jnp.int32)[None, :]
+            eos_m = mrows(eos_ids, m)
+            emit_sl = idx < n_emit[:, None]
+            is_eos = (toks_out == eos_m[:, None]) & (eos_m >= 0)[:, None] \
+                & emit_sl
+            eos_pos = jnp.min(jnp.where(is_eos, idx, g1), axis=1)
+            rem = mrows(budget, m) - emitted
+            n_keep = jnp.minimum(jnp.minimum(n_emit, eos_pos), rem)
+            n_keep = jnp.where(alive, n_keep, 0)
+            hit_eos = (eos_pos < n_emit) & (eos_pos < rem)
+
+            commit = (idx < n_keep[:, None]) | ((idx == 0) & alive[:, None])
+            acc_upd = jax.lax.dynamic_update_slice(acc_m, commit,
+                                                   (0, d * g1))
+            rows = jnp.broadcast_to(jnp.arange(mbsz)[:, None], (mbsz, g1))
+            cols = jnp.where(emit_sl & (idx < n_keep[:, None]),
+                             cl[:, None] + 1 + idx, H)
+            hist_upd = hist.at[rows, cols].set(toks_out, mode="drop")
+            new_cur = jnp.where(
+                n_keep > 0,
+                jnp.take_along_axis(
+                    toks_out, jnp.maximum(n_keep - 1, 0)[:, None],
+                    axis=1)[:, 0],
+                cur)
+
+            do_upd = valid & is_last
+            acc_m = jnp.where(do_upd, acc_upd, acc_m)
+            hist = jnp.where(do_upd, hist_upd, hist)
+            hist_len = jnp.where(do_upd, hist_len + n_keep, hist_len)
+            cl = jnp.where(do_upd, cl + n_keep, cl)
+            emitted = jnp.where(do_upd, emitted + n_keep, emitted)
+            eos_seen = jnp.where(do_upd, eos_seen | (hit_eos & alive),
+                                 eos_seen)
+            alive = jnp.where(do_upd,
+                              alive & ~hit_eos
+                              & (emitted < mrows(budget, m)), alive)
+            cur = jnp.where(do_upd, new_cur, cur)
+
+            def record(buf, vals):
+                start = (d,) + (m * mbsz,) + (0,) * (buf.ndim - 2)
+                sizes = (1, mbsz) + buf.shape[2:]
+                old = jax.lax.dynamic_slice(buf, start, sizes)
+                new = jnp.where(do_upd, vals.astype(buf.dtype), old[0])
+                return jax.lax.dynamic_update_slice(buf, new[None], start)
+
+            toks_buf = record(toks_buf, toks_out)
+            keeps_buf = record(keeps_buf, n_keep)
+            eos_buf = record(eos_buf, eos_seen)
+
+            st2 = dict(
+                x=jax.lax.ppermute(x2, "pp", perm),
+                cur=jax.lax.ppermute(cur, "pp", perm),
+                drafts=jax.lax.ppermute(drafts, "pp", perm),
+                hist=jax.lax.ppermute(hist, "pp", perm),
+                hist_len=jax.lax.ppermute(hist_len, "pp", perm),
+                cl=jax.lax.ppermute(cl, "pp", perm),
+                alive=jax.lax.ppermute(alive, "pp", perm),
+                emitted=jax.lax.ppermute(emitted, "pp", perm),
+                eos_seen=jax.lax.ppermute(eos_seen, "pp", perm),
+                side_pos=jax.lax.ppermute(side_pos_m, "pp", perm),
+                acc=jax.lax.ppermute(acc_m, "pp", perm),
+                m_id=jax.lax.ppermute(m_id, "pp", perm),
+            )
+            return (st2, side_k, side_v, toks_buf, keeps_buf, eos_buf)
+
+        st, side_k, side_v, toks_buf, keeps_buf, eos_buf = jax.lax.fori_loop(
+            0, n_ticks, tick, carry0)
+
+        # reassemble the final [R, E] commit masks from the circulating
+        # states (each stage ends holding exactly one microbatch's final)
+        row0 = st["m_id"] * mbsz
+        acc_all = jax.lax.psum(
+            jax.lax.dynamic_update_slice(
+                jnp.zeros((r, E), jnp.int32), st["acc"].astype(jnp.int32),
+                (row0, 0)), "pp") > 0
+        pos_all = jax.lax.psum(
+            jax.lax.dynamic_update_slice(
+                jnp.zeros((r, E), jnp.int32), st["side_pos"], (row0, 0)),
+            "pp")
+
+        toks = jax.lax.psum(toks_buf, "pp")
+        keeps = jax.lax.psum(keeps_buf, "pp")
+        eos_seen = jax.lax.psum(eos_buf, "pp") > 0
+
+        blk = jnp.take_along_axis(bt, pos_all // bs, axis=1)       # [R, E]
+        blk = jnp.where(acc_all, blk, dummy_block)
+        off = pos_all % bs
+        if quantized:
+            k8, ks = quant_kv(side_k)
+            v8, vs = quant_kv(side_v)
+            return (toks, keeps, eos_seen,
+                    pool_k.at[:, blk, off].set(k8),
+                    pool_v.at[:, blk, off].set(v8),
+                    pool_ks.at[:, blk, off].set(ks),
+                    pool_vs.at[:, blk, off].set(vs))
+        return (toks, keeps, eos_seen,
+                pool_k.at[:, blk, off].set(side_k),
+                pool_v.at[:, blk, off].set(side_v), pool_ks, pool_vs)
+
+    cache_spec = P("pp")
+    dummy = jnp.zeros((L, 0), jnp.float32)
+    pool_ks = paged.k_scale if quantized else dummy
+    pool_vs = paged.v_scale if quantized else dummy
+    toks, keeps, eos_seen, new_k, new_v, new_ks, new_vs = jax.shard_map(
+        body, mesh=mesh, axis_names={"pp"},
+        in_specs=(layer_spec, other_spec, cache_spec, cache_spec,
+                  cache_spec, cache_spec,
+                  P(), P(), P(), P(), P(), P(), P(), P(), P(), P(), P(),
+                  P()),
+        out_specs=(P(), P(), P(), cache_spec, cache_spec, cache_spec,
+                   cache_spec),
+        check_vma=False,
+    )(p_layers, p_other, paged.k, paged.v, pool_ks, pool_vs, tokens,
+      history, context_lens, block_tables, seeds, steps0, temps, tks, tps,
+      ds, budget, eos_ids)
+    if quantized:
+        return toks, keeps, eos_seen, PagedKVCache(
+            k=new_k, v=new_v, k_scale=new_ks, v_scale=new_vs)
+    return toks, keeps, eos_seen, PagedKVCache(k=new_k, v=new_v)
+
+
 def paged_prefill_tail_pp(params, cfg: ModelConfig, tokens, tail_len,
                           tail_blocks, prefix_blocks, prefix_len, paged,
                           dummy_block: int, *, mesh: Mesh):

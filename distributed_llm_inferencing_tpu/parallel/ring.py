@@ -48,12 +48,19 @@ from jax.sharding import Mesh, PartitionSpec as P
 from distributed_llm_inferencing_tpu.ops.attention import NEG_INF, repeat_kv
 
 
-def _masked_scores(q, k, q_pos, kv_pos, kv_valid, sliding_window):
-    """[B,H,Sq,Skv] f32 masked scores for one (Q chunk, KV chunk) pair."""
+def _masked_scores(q, k, q_pos, kv_pos, kv_valid, sliding_window,
+                   alibi=None):
+    """[B,H,Sq,Skv] f32 masked scores for one (Q chunk, KV chunk) pair.
+    ``alibi``: LOCAL head-shard slopes [H_loc] — positions travel with
+    the chunks, so the linear bias is the same arithmetic as the dense
+    path (ops/attention.py attend) on ring-local blocks."""
     hd = q.shape[-1]
     scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
     s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
+    if alibi is not None:
+        rel = (kv_pos[:, None, :] - q_pos[:, :, None]).astype(jnp.float32)
+        s = s + alibi[None, :, None, None] * rel[:, None, :, :]
     mask = (kv_pos[:, None, :] <= q_pos[:, :, None]) & kv_valid[:, None, :]
     if sliding_window is not None:
         mask = mask & ((q_pos[:, :, None] - kv_pos[:, None, :])
@@ -61,8 +68,8 @@ def _masked_scores(q, k, q_pos, kv_pos, kv_valid, sliding_window):
     return jnp.where(mask[:, None, :, :], s, NEG_INF)
 
 
-def _ring_body(q, k, v, q_pos, kv_pos, kv_valid, *, axis: str,
-               sliding_window: Optional[int]):
+def _ring_body(q, k, v, q_pos, kv_pos, kv_valid, alibi=None, *,
+               axis: str, sliding_window: Optional[int]):
     """Per-device ring loop. Shapes are LOCAL chunks:
     q [B,Sq,H,hd], k/v [B,Sk,Hkv,hd], q_pos [B,Sq], kv_pos [B,Sk],
     kv_valid [B,Sk]. Returns [B,Sq,H,hd] in q.dtype.
@@ -80,7 +87,8 @@ def _ring_body(q, k, v, q_pos, kv_pos, kv_valid, *, axis: str,
         k, v, kv_pos, kv_valid, m, l, o = carry
         kf = repeat_kv(k, n_rep)
         vf = repeat_kv(v, n_rep)
-        s = _masked_scores(q, kf, q_pos, kv_pos, kv_valid, sliding_window)
+        s = _masked_scores(q, kf, q_pos, kv_pos, kv_valid,
+                           sliding_window, alibi)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))          # [B,H,Sq]
         alpha = jnp.exp(m - m_new)
         # explicit zero for masked entries: on a fully-masked row
@@ -103,8 +111,8 @@ def _ring_body(q, k, v, q_pos, kv_pos, kv_valid, *, axis: str,
     return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
 
 
-def _decode_body(q, k, v, kv_pos, kv_valid, lengths, *, axis: str,
-                 sliding_window: Optional[int]):
+def _decode_body(q, k, v, kv_pos, kv_valid, lengths, alibi=None, *,
+                 axis: str, sliding_window: Optional[int]):
     """Per-device partial attention over the LOCAL cache shard + combine.
 
     q [B,1,H,hd] (replicated over sp), k/v [B,Sk,Hkv,hd] (the local S/sp
@@ -115,7 +123,8 @@ def _decode_body(q, k, v, kv_pos, kv_valid, lengths, *, axis: str,
     q_pos = (lengths - 1)[:, None]                                  # [B,1]
 
     kf = repeat_kv(k, n_rep)
-    s = _masked_scores(q, kf, q_pos, kv_pos, kv_valid, sliding_window)
+    s = _masked_scores(q, kf, q_pos, kv_pos, kv_valid, sliding_window,
+                       alibi)
     m_loc = jnp.max(s, axis=-1)                                     # [B,H,1]
     p = jnp.where(s > NEG_INF * 0.5, jnp.exp(s - m_loc[..., None]), 0.0)
     l_loc = jnp.sum(p, axis=-1)                                     # [B,H,1]
@@ -139,6 +148,7 @@ def ring_attend_decode(
     *,
     mesh: Mesh,
     sliding_window: Optional[int] = None,
+    alibi=None,   # [H] f32 slopes, sharded over tp with the heads
 ):
     """Single-token attention over the sp-sharded dense cache.
 
@@ -167,12 +177,17 @@ def ring_attend_decode(
     q_spec = P("dp", None, "tp", None)
     kv_spec = P("dp", "sp", kv_tp, None)
     pos_spec = P("dp", "sp")
+    in_specs = (q_spec, kv_spec, kv_spec, pos_spec, pos_spec, P("dp"))
+    args = (q, cache_k, cache_v, kv_pos, kv_valid, lengths)
+    if alibi is not None:   # slopes shard with the query heads
+        in_specs = in_specs + (P("tp"),)
+        args = args + (alibi,)
     return jax.shard_map(
         body, mesh=mesh,
-        in_specs=(q_spec, kv_spec, kv_spec, pos_spec, pos_spec, P("dp")),
+        in_specs=in_specs,
         out_specs=q_spec,
         check_vma=False,
-    )(q, cache_k, cache_v, kv_pos, kv_valid, lengths)
+    )(*args)
 
 
 def ring_attend_prefill(
@@ -184,6 +199,7 @@ def ring_attend_prefill(
     *,
     mesh: Mesh,
     sliding_window: Optional[int] = None,
+    alibi=None,   # [H] f32 slopes, sharded over tp with the heads
 ):
     """Sequence-parallel causal prefill attention via shard_map over sp.
 
@@ -213,9 +229,14 @@ def ring_attend_prefill(
     q_spec = P("dp", "sp", "tp", None)
     kv_spec = P("dp", "sp", kv_tp, None)
     pos_spec = P("dp", "sp")
+    in_specs = (q_spec, kv_spec, kv_spec, pos_spec, pos_spec, pos_spec)
+    args = (q, k, v, q_positions, q_positions, kv_valid)
+    if alibi is not None:   # slopes shard with the query heads
+        in_specs = in_specs + (P("tp"),)
+        args = args + (alibi,)
     return jax.shard_map(
         body, mesh=mesh,
-        in_specs=(q_spec, kv_spec, kv_spec, pos_spec, pos_spec, pos_spec),
+        in_specs=in_specs,
         out_specs=q_spec,
         check_vma=False,
-    )(q, k, v, q_positions, q_positions, kv_valid)
+    )(*args)

@@ -171,13 +171,15 @@ class ContinuousBatcher:
         if self.mesh_spec.pp > 1:
             # pipeline-parallel serving (parallel/paged_pipeline.py):
             # slots microbatch over pp inside one GPipe-scheduled program
-            if speculative:
-                raise ValueError(
-                    "speculative decoding does not span pipeline stages "
-                    "yet; drop speculative or pp")
+            # (speculative chunks included — the draft/acceptance state
+            # rides the ppermute ring, paged_speculative_chunk_pp)
             slots = -(-slots // self.mesh_spec.pp) * self.mesh_spec.pp
         self.cfg = cfg = cfg.replace(
-            attn_backend=_backend(cfg, self.mesh_spec.num_devices))
+            attn_backend=_backend(cfg, self.mesh_spec.num_devices),
+            # int4 pallas routing hint (models/config.py): this GSPMD
+            # program din-shards o/down over tp, and the kernel's
+            # partition rule would all-gather those shards every step
+            tp_row_sharded=self.mesh_spec.tp > 1)
         validate_spec(self.mesh_spec, cfg)
         self.mesh = create_mesh(self.mesh_spec)
         self.block_size = block_size
@@ -415,6 +417,7 @@ class ContinuousBatcher:
         fn = self._decode_fns.get(key)
         if fn is None:
             cfg, dummy = self.cfg, self._dummy
+            pp, mesh = self.mesh_spec.pp, self.mesh
 
             def chunk(p, ints, floats, paged):
                 bt = ints[:r * mb].reshape(r, mb)
@@ -422,6 +425,13 @@ class ContinuousBatcher:
                 (tokens, cl, seeds, steps0, tks, budget, eos_ids,
                  ds) = ints[r * (mb + hh):].reshape(8, r)
                 temps, tps = floats
+                if pp > 1:
+                    from distributed_llm_inferencing_tpu.parallel import (
+                        paged_pipeline)
+                    return paged_pipeline.paged_speculative_chunk_pp(
+                        p, cfg, k, g, tokens, hist, paged, bt, cl, seeds,
+                        steps0, temps, tks, tps, ds.astype(bool), budget,
+                        eos_ids, dummy, mesh=mesh)
                 return transformer.paged_speculative_chunk(
                     p, cfg, k, g, tokens, hist, paged, bt, cl, seeds,
                     steps0, temps, tks, tps, ds.astype(bool), budget,
@@ -513,7 +523,9 @@ class ContinuousBatcher:
 
     def _run_spec_decode(self, a: dict):
         """Launch one speculative chunk's program. Returns (toks
-        [K, R, g+1], keeps [K, R]) as host arrays."""
+        [K, R, g+1], keeps [K, R], eos_seen [K, R]) as host arrays —
+        ``eos_seen`` is cumulative per row, distinguishing an eos death
+        from merely running out of chunk iterations."""
         bt = np.asarray(a["bt"], np.int32)
         if "hist" in a:
             hist = np.asarray(a["hist"], np.int32)

@@ -134,13 +134,36 @@ def _fresh_coordinator() -> str:
     leader) — fresh port, so the dying job's service can never collide.
     A restarted LEADER has no prior address to derive from (127.0.0.1
     would be unreachable for remote followers) — the operator must pass
-    one explicitly."""
+    one explicitly.
+
+    Assumption (logged, not silently relied on): the free-port probe
+    binds on THIS machine while the address reuses the old coordinator's
+    host — correct when the leader hosts the coordinator (the deployment
+    layout init_multihost sets up). If the coordinator lived elsewhere,
+    or another process grabs the probed port before jax.distributed
+    binds it (TOCTOU), the rejoin fails with a bind/connect error — in
+    both cases pass an explicit {"coordinator": "host:port"} to
+    /lockstep/recover instead of relying on this derivation."""
     import socket
     if not _DIST_STATE["coordinator"]:
         raise RuntimeError(
             "restarted leader has no prior coordinator address; pass "
             '{"coordinator": "host:port"} to /lockstep/recover')
     host = _DIST_STATE["coordinator"].rsplit(":", 1)[0]
+    local = {"127.0.0.1", "localhost", socket.gethostname(),
+             socket.getfqdn()}
+    try:
+        local.add(socket.gethostbyname(socket.gethostname()))
+    except OSError:
+        pass
+    if host not in local:
+        log.warning(
+            "deriving a fresh coordinator on %r, but this process is %r "
+            "— the free-port probe runs locally, so if %r is a different "
+            "machine the port may be taken there; pass an explicit "
+            '{"coordinator": "host:port"} to /lockstep/recover if the '
+            "rejoin fails to bind/connect", host, socket.gethostname(),
+            host)
     s = socket.socket()
     s.bind(("", 0))
     port = s.getsockname()[1]

@@ -95,3 +95,38 @@ def test_auto_picks_dense_for_decode_and_capacity_for_prefill():
         _prefill_logits(BASE.replace(moe_dispatch="capacity",
                                      moe_capacity_factor=NO_DROP),
                         PARAMS, big))
+
+
+def test_capacity_overflow_actually_drops_tokens():
+    """Token-level drop semantics (not just finiteness): rig the router
+    so EVERY token picks expert 0 with k=1 and size capacity C=2; the
+    first C tokens (priority = token order) must match the dense path's
+    expert output, and every later token must come out exactly zero —
+    its single expert choice was shed."""
+    cfg = BASE.replace(moe_dispatch="capacity", num_experts_per_tok=1,
+                       moe_capacity_factor=1.0)
+    E, D, I = cfg.num_experts, cfg.hidden_size, cfg.intermediate_size
+    rng = np.random.default_rng(2)
+    router = np.zeros((D, E), np.float32)
+    router[:, 0] = 1.0   # expert 0 dominates for any positive-sum token
+    lp = {
+        "router": {"w": jnp.asarray(router)},
+        "experts": {
+            "gate": {"w": jnp.asarray(rng.standard_normal((E, D, I)) * 0.1,
+                                      jnp.float32)},
+            "up": {"w": jnp.asarray(rng.standard_normal((E, D, I)) * 0.1,
+                                    jnp.float32)},
+            "down": {"w": jnp.asarray(rng.standard_normal((E, I, D)) * 0.1,
+                                      jnp.float32)},
+        },
+    }
+    N = 8
+    x = jnp.abs(jnp.asarray(rng.standard_normal((N, D)), jnp.float32)) + 0.1
+    # C = factor * k * N / E = 1 * 1 * 8 / 4 = 2
+    out_cap = np.asarray(transformer._moe_capacity(x, lp, cfg))
+    out_dense = np.asarray(transformer._moe_dense(x, lp, cfg))
+    np.testing.assert_allclose(out_cap[:2], out_dense[:2],
+                               atol=1e-5, rtol=1e-5)
+    kept_norm = np.abs(out_dense[2:]).max()
+    assert kept_norm > 1e-3   # the dense path would have produced signal
+    np.testing.assert_array_equal(out_cap[2:], np.zeros((N - 2, D)))

@@ -601,3 +601,64 @@ def test_qwen2_mixed_window_rejected():
         num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
         use_sliding_window=True, sliding_window=8, max_window_layers=4)
     assert convert.config_from_hf(none_win).sliding_window is None
+
+
+def test_gpt_bigcode_mqa_matches_hf():
+    """StarCoder layout: MQA (1 kv head) + learned positions + fused
+    nn.Linear c_attn — paths the other 14 families don't combine."""
+    import torch
+    import transformers
+    torch_cfg = transformers.GPTBigCodeConfig(
+        vocab_size=128, n_positions=64, n_embd=32, n_layer=3, n_head=4,
+        multi_query=True, activation_function="gelu_pytorch_tanh")
+    torch.manual_seed(11)
+    model = transformers.GPTBigCodeForCausalLM(torch_cfg).eval()
+    rng = np.random.default_rng(11)
+    tokens = rng.integers(0, 128, size=(2, 12), dtype=np.int64)
+    _check_model(model, tokens)
+
+
+def test_gpt_bigcode_mha_matches_hf():
+    import torch
+    import transformers
+    torch_cfg = transformers.GPTBigCodeConfig(
+        vocab_size=96, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+        multi_query=False)
+    torch.manual_seed(12)
+    model = transformers.GPTBigCodeForCausalLM(torch_cfg).eval()
+    rng = np.random.default_rng(12)
+    tokens = rng.integers(0, 96, size=(1, 9), dtype=np.int64)
+    _check_model(model, tokens)
+
+
+def test_stablelm_matches_hf():
+    """StableLM: llama layout with biased layernorms + partial rotary +
+    qkv-only bias."""
+    import torch
+    import transformers
+    torch_cfg = transformers.StableLmConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, partial_rotary_factor=0.5,
+        use_qkv_bias=True, tie_word_embeddings=False)
+    torch.manual_seed(13)
+    model = transformers.StableLmForCausalLM(torch_cfg).eval()
+    rng = np.random.default_rng(13)
+    tokens = rng.integers(0, 128, size=(2, 10), dtype=np.int64)
+    _check_model(model, tokens)
+
+
+def test_stablelm_unsupported_options_rejected():
+    import transformers
+    import pytest as _pytest
+    cfg = transformers.StableLmConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=1, num_attention_heads=4,
+        use_parallel_residual=True)
+    with _pytest.raises(NotImplementedError, match="parallel_residual"):
+        convert.config_from_hf(cfg)
+    cfg2 = transformers.StableLmConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=1, num_attention_heads=4, qk_layernorm=True)
+    with _pytest.raises(NotImplementedError, match="qk_layernorm"):
+        convert.config_from_hf(cfg2)
