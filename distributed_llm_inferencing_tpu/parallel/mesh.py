@@ -97,10 +97,9 @@ def validate_spec(spec: MeshSpec, cfg) -> None:
         raise ValueError(f"pp={spec.pp} must divide num_layers={cfg.num_layers}")
     # (sp + alibi needs no refusal: the ring bodies carry the linear
     # position bias — slopes shard over tp with the heads, parallel/ring.py)
-    if spec.sp > 1 and spec.pp > 1:
-        raise ValueError(
-            "sp and pp cannot both exceed 1 yet: the pipelined executor "
-            "(parallel/pipeline.py) does not route through ring attention")
+    # (sp + pp needs no refusal: the pipelined executor routes per-stage
+    # attention through the ring path — parallel/pipeline.py _stage_body,
+    # nested shard_map on the abstract context mesh)
     if spec.sp > 1 and spec.tp > cfg.num_kv_heads:
         # (tp <= num_kv_heads non-divisibility is already rejected above;
         # the rule itself lives in sharding.kv_head_axis)

@@ -31,10 +31,15 @@ from distributed_llm_inferencing_tpu.ops.kvcache import KVCache
 
 
 def _stage_body(x, layers_p, ck, cv, q_positions, write_starts, new_lengths,
-                *, cfg: ModelConfig, is_prefill: bool, backend: str):
+                *, cfg: ModelConfig, is_prefill: bool, backend: str,
+                sp_mesh=None):
     """Run this stage's local layers over one microbatch.
 
     x [mb,s,D]; layers_p leaves [L_loc,...]; ck/cv [L_loc,mb,S,Hkv,hd].
+    ``sp_mesh``: set when the mesh carries sp > 1 — per-stage attention
+    then routes through the ring path (parallel/ring.py), whose nested
+    shard_map binds the sp axis via the abstract context mesh (sp stays
+    an AUTO axis of this pp-manual region).
     """
     from distributed_llm_inferencing_tpu.models.transformer import _block
 
@@ -42,7 +47,8 @@ def _stage_body(x, layers_p, ck, cv, q_positions, write_starts, new_lengths,
         lp, k, v = layer_in
         x, k, v = _block(x, lp, k, v, cfg=cfg, q_positions=q_positions,
                          write_starts=write_starts, new_lengths=new_lengths,
-                         is_prefill=is_prefill, backend=backend, mesh=None)
+                         is_prefill=is_prefill, backend=backend,
+                         mesh=sp_mesh)
         return x, (k, v)
 
     x, (ck, cv) = jax.lax.scan(body, x, (layers_p, ck, cv))
@@ -83,7 +89,8 @@ def pipelined_apply(
 
     body = functools.partial(_pipeline_shardmap_body, cfg=cfg,
                              is_prefill=is_prefill, backend=backend,
-                             n_micro=n_micro, mb=mb)
+                             n_micro=n_micro, mb=mb,
+                             sp_mesh=mesh if mesh.shape["sp"] > 1 else None)
     layer_spec = jax.tree.map(lambda _: P("pp"), params["layers"])
     cache_spec = P("pp")
     out = jax.shard_map(
@@ -137,7 +144,7 @@ def pick_n_micro(batch: int, pp: int, requested=None) -> int:
 
 def _pipeline_shardmap_body(x, layers_p, ck, cv, q_positions, write_starts,
                             new_lengths, *, cfg, is_prefill, backend,
-                            n_micro, mb):
+                            n_micro, mb, sp_mesh=None):
     """Manual-over-pp region: GPipe schedule with ppermute handoff.
 
     Local views: x [B,s,D] (replicated over pp), layers_p leaves
@@ -174,7 +181,8 @@ def _pipeline_shardmap_body(x, layers_p, ck, cv, q_positions, write_starts,
 
         new_state, ck_new, cv_new = _stage_body(
             state, layers_p, ck_m, cv_m, qp, ws, nl,
-            cfg=cfg, is_prefill=is_prefill, backend=backend)
+            cfg=cfg, is_prefill=is_prefill, backend=backend,
+            sp_mesh=sp_mesh)
 
         # merge cache/output only when this tick did real work
         ck = jax.lax.dynamic_update_slice_in_dim(

@@ -48,6 +48,18 @@ from jax.sharding import Mesh, PartitionSpec as P
 from distributed_llm_inferencing_tpu.ops.attention import NEG_INF, repeat_kv
 
 
+def _resolve_mesh(mesh):
+    """The mesh the ring's shard_map must be built on. Inside an
+    enclosing manual region (the pp pipeline executor, parallel/
+    pipeline.py), a nested shard_map must use the ABSTRACT context mesh
+    — building on the concrete mesh raises a context-mismatch — while
+    from plain jit/GSPMD the concrete mesh is the right one."""
+    am = jax.sharding.get_abstract_mesh()
+    if am is not None and getattr(am, "_any_axis_manual", False):
+        return am
+    return mesh
+
+
 def _masked_scores(q, k, q_pos, kv_pos, kv_valid, sliding_window,
                    alibi=None):
     """[B,H,Sq,Skv] f32 masked scores for one (Q chunk, KV chunk) pair.
@@ -183,7 +195,7 @@ def ring_attend_decode(
         in_specs = in_specs + (P("tp"),)
         args = args + (alibi,)
     return jax.shard_map(
-        body, mesh=mesh,
+        body, mesh=_resolve_mesh(mesh),
         in_specs=in_specs,
         out_specs=q_spec,
         check_vma=False,
@@ -235,7 +247,7 @@ def ring_attend_prefill(
         in_specs = in_specs + (P("tp"),)
         args = args + (alibi,)
     return jax.shard_map(
-        body, mesh=mesh,
+        body, mesh=_resolve_mesh(mesh),
         in_specs=in_specs,
         out_specs=q_spec,
         check_vma=False,
