@@ -58,8 +58,9 @@ def _qw(p, dt):
     keeps the read 4-bit (ops/pallas/quant_matmul.py), so this is the
     fallback for shapes/platforms the kernel doesn't cover."""
     if "p4" in p:
-        from distributed_llm_inferencing_tpu.ops.quant import unpack_int4
-        return unpack_int4(p["p4"]).astype(dt)
+        from distributed_llm_inferencing_tpu.ops.quant import (
+            pack_chunks, unpack_int4)
+        return unpack_int4(p["p4"], pack_chunks(p)).astype(dt)
     return p["q"].astype(dt)
 
 

@@ -200,6 +200,95 @@ _q4_matmul_p.def_partition(
     need_replication_factors=("k", "h"))
 
 
+# ---- row-parallel (din-sharded) variant --------------------------------
+#
+# For the megatron row-parallel leaves (o/down under tp) the weight's
+# CONTRACTION axis is sharded. With the leaf repacked chunk-locally
+# (ops/quant.py repack_int4_rows, chunk count == the axis size), each
+# shard's p4 slice is a self-contained split-half packing of its own din
+# rows, so the local lowering is the SAME pallas kernel on the local
+# shard followed by one psum over the sharding axis — the full megatron
+# row-parallel pattern with int4 reads.
+
+
+def _axis_of(spec, dim):
+    if spec is None or len(spec) <= dim:
+        return None
+    ax = spec[dim]
+    if isinstance(ax, (tuple, list)):
+        return ax[0] if ax else None
+    return ax
+
+
+def _q4_row_infer(interpret, chunks, mesh, arg_shapes, result_shape):
+    m = _pad_spec(_spec_of(arg_shapes[0]), 2)[0]
+    return NamedSharding(mesh, P(m, None))
+
+
+def _q4_row_partition(interpret, chunks, mesh, arg_shapes, result_shape):
+    kx = _axis_of(_pad_spec(_spec_of(arg_shapes[0]), 2), 1)
+    kw = _axis_of(_pad_spec(_spec_of(arg_shapes[1]), 2), 0)
+    axis = kw or kx
+    m = _axis_of(_pad_spec(_spec_of(arg_shapes[0]), 2), 0)
+    arg_shardings = (
+        NamedSharding(mesh, P(m, axis)),     # x: contraction sharded
+        NamedSharding(mesh, P(axis, None)),  # p4: din chunks sharded
+        NamedSharding(mesh, P(None)),        # scale replicated
+    )
+    out_sharding = NamedSharding(mesh, P(m, None))
+
+    def lower(x, p4, scale):
+        if axis is None:
+            # nothing actually sharded the contraction: the local p4 is
+            # the GLOBAL chunked layout, which the kernel's split-half
+            # assumption does not match — use the chunk-aware unpack
+            from distributed_llm_inferencing_tpu.ops.quant import (
+                unpack_int4)
+            w = unpack_int4(p4, chunks).astype(jnp.float32)
+            return ((x.astype(jnp.float32) @ w)
+                    * scale[None, :]).astype(x.dtype)
+        # the per-shard chunk is a self-contained split-half pack, so
+        # the plain kernel runs locally; one psum combines the partials
+        return jax.lax.psum(_q4_pallas(x, p4, scale, interpret), axis)
+
+    return mesh, lower, out_sharding, arg_shardings
+
+
+@functools.partial(custom_partitioning, static_argnums=(3, 4))
+def _q4_matmul_row_p(x, p4, scale, interpret, chunks):
+    # unpartitioned body (single device / fully replicated): honor the
+    # CHUNKED layout via the XLA unpack — the kernel's split-half
+    # assumption only matches a chunked leaf per-shard, never globally.
+    # Result dtype must match the partitioned lowering's (x.dtype).
+    from distributed_llm_inferencing_tpu.ops.quant import unpack_int4
+    w = unpack_int4(p4, chunks).astype(jnp.float32)
+    return ((x.astype(jnp.float32) @ w) * scale[None, :]).astype(x.dtype)
+
+
+_q4_matmul_row_p.def_partition(
+    partition=_q4_row_partition,
+    infer_sharding_from_operands=_q4_row_infer,
+    sharding_rule="m k, h n, n -> m n",
+    reduction_factors=("k", "h"))
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "chunks"))
+def q4_matmul_row(x, p4, scale, interpret: bool = False, chunks: int = 1):
+    """Row-parallel twin of q4_matmul for CHUNK-LOCALLY packed leaves
+    (ops/quant.py repack_int4_rows): x [b, din] with din (and p4's rows)
+    sharded over one mesh axis; each shard runs the kernel on its
+    self-contained chunk and one psum combines the partials. ``chunks``
+    must equal the sharding axis size (the shard-time repack guarantees
+    it, parallel/sharding.py)."""
+    b, din = x.shape
+    pad = (-b) % 8
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+    out = _q4_matmul_row_p(x, p4, scale.astype(jnp.float32), interpret,
+                           chunks)
+    return out[:b] if pad else out
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def q4_matmul(x, p4, scale, interpret: bool = False):
     """x [b, din] @ unpack(p4 [din//2, dout]) * scale [dout] -> [b, dout].
@@ -219,13 +308,17 @@ def q4_matmul(x, p4, scale, interpret: bool = False):
 
 def q4_linear(x, p, row_sharded: bool = False):
     """Quantized linear over an int4 leaf ``{"p4", "scale"[, "b"]}`` with
-    arbitrary leading dims on x. Dispatches to the pallas kernel for
-    decode-shaped calls on TPU (column-parallel or replicated leaves;
-    see supported()), else to the XLA unpack path. ``row_sharded``: the
-    caller's mesh shards this leaf's din axis (tp>1 o/down projections),
-    which the kernel's partitioning rule cannot serve without an
-    all-gather — keep those on XLA."""
-    from distributed_llm_inferencing_tpu.ops.quant import unpack_int4
+    arbitrary leading dims on x. Dispatch:
+
+    - chunk-local leaf (``chunked`` marker, shard-time repack of
+      row-parallel o/down under tp — parallel/sharding.py): the
+      row-parallel partitioned kernel (local pallas + one psum);
+    - plain leaf, decode-shaped on TPU: the column-partitioned kernel;
+    - otherwise the XLA unpack. ``row_sharded`` marks a din-sharded leaf
+      that was NOT repacked (e.g. loaded pre-round-5 checkpoints): the
+      output-axis rule would all-gather the weight, so keep XLA."""
+    from distributed_llm_inferencing_tpu.ops.quant import (
+        pack_chunks, unpack_int4)
 
     din = x.shape[-1]
     dout = p["p4"].shape[-1]
@@ -233,12 +326,20 @@ def q4_linear(x, p, row_sharded: bool = False):
     rows = 1
     for s in lead:
         rows *= s
-    if p["p4"].ndim == 2 and supported(rows, din, dout, row_sharded):
+    chunks = pack_chunks(p)
+    if (chunks > 1 and p["p4"].ndim == 2
+            and supported(rows, din // chunks, dout)):
+        y = q4_matmul_row(x.reshape(rows, din), p["p4"], p["scale"],
+                          interpret=_mode() == "interpret", chunks=chunks)
+        y = y.reshape(*lead, dout)
+    elif (chunks == 1 and p["p4"].ndim == 2
+            and supported(rows, din, dout, row_sharded)):
         y = q4_matmul(x.reshape(rows, din), p["p4"], p["scale"],
                       interpret=_mode() == "interpret")
         y = y.reshape(*lead, dout)
     else:
-        y = jnp.einsum("...d,df->...f", x, unpack_int4(p["p4"]).astype(x.dtype))
+        y = jnp.einsum("...d,df->...f", x,
+                       unpack_int4(p["p4"], chunks).astype(x.dtype))
         y = y * p["scale"].astype(x.dtype)
     if "b" in p:
         y = y + p["b"]

@@ -73,11 +73,78 @@ def pack_int4(q) -> jax.Array:
     return lo | (hi << 4)
 
 
-def unpack_int4(p4) -> jax.Array:
-    """uint8 [..., din//2, dout] -> sign-extended int8 [..., din, dout]."""
+def unpack_int4(p4, chunks: int = 1) -> jax.Array:
+    """uint8 [..., din//2, dout] -> sign-extended int8 [..., din, dout].
+
+    ``chunks > 1``: the leaf uses CHUNK-LOCAL split-half packing
+    (repack_int4_rows) — each of ``chunks`` equal row groups is its own
+    split-half pack, so a din-sharded leaf unpacks shard-locally."""
     lo = (p4 & 0xF).astype(jnp.int8) - 8
     hi = ((p4 >> 4) & 0xF).astype(jnp.int8) - 8
-    return jnp.concatenate([lo, hi], axis=-2)
+    if chunks == 1:
+        return jnp.concatenate([lo, hi], axis=-2)
+    *lead, half, dout = p4.shape
+    per = half // chunks
+    lo = lo.reshape(*lead, chunks, per, dout)
+    hi = hi.reshape(*lead, chunks, per, dout)
+    return jnp.concatenate([lo, hi], axis=-2).reshape(
+        *lead, 2 * half, dout)
+
+
+def pack_chunks(p4) -> int:
+    """Chunk count of an int4 leaf (1 = the global split-half layout).
+    The marker's SECOND-TO-LAST dim carries the count — its leading dims
+    mirror p4's stacked layer axes so the layer scan / unrolled loop
+    slices it alongside the weight."""
+    return p4["chunked"].shape[-2] if "chunked" in p4 else 1
+
+
+def repack_int4_rows(p: dict, chunks: int) -> dict:
+    """Re-pack a split-half int4 leaf so each of ``chunks`` equal din
+    row-groups is a SELF-CONTAINED split-half packing of its own rows.
+
+    A din-sharded (row-parallel: o/down under tp) leaf in the GLOBAL
+    layout is useless per-shard — packed row i pairs din rows i and
+    i + din/2, which land on different shards. After this repack, shard
+    c's slice is exactly the packing of din rows [c*din/C, (c+1)*din/C),
+    so the pallas kernel runs shard-local (ops/pallas/quant_matmul.py
+    row-parallel rule). The zero-size ``chunked`` leaf carries C in its
+    static shape; consumers (unpack_int4, dequantize_weight, the kernel
+    dispatch) read it at trace time. Values are bit-identical — only
+    byte placement changes."""
+    if "chunked" in p:
+        if p["chunked"].shape[-2] != chunks:
+            raise ValueError(
+                f"leaf already chunked x{p['chunked'].shape[-2]}, "
+                f"asked for x{chunks}")
+        return p
+    p4 = p["p4"]
+    *lead, half, dout = p4.shape
+    din = 2 * half
+    if din % (2 * chunks):
+        raise ValueError(f"din={din} not divisible into {chunks} "
+                         "split-half chunks")
+    per = din // chunks
+    # Pure NIBBLE GATHER on the packed bytes — never unpacks (a 70B-class
+    # o/down stack would otherwise materialize a 4x int8 transient at
+    # load). Target byte (chunk c, local row j) pairs din rows
+    # rA = c*per + j and rB = rA + per/2; source nibble of din row r is
+    # the low half of byte row r (r < din/2) or the high half of byte
+    # row r - din/2.
+    c = jnp.arange(half, dtype=jnp.int32) // (per // 2)
+    j = jnp.arange(half, dtype=jnp.int32) % (per // 2)
+    r_a = c * per + j
+    r_b = r_a + per // 2
+
+    def nib(r):
+        lo_sel = r < half
+        rows = jnp.take(p4, jnp.where(lo_sel, r, r - half), axis=-2)
+        return jnp.where(lo_sel[:, None], rows & 0xF, (rows >> 4) & 0xF)
+
+    out = dict(p)
+    out["p4"] = nib(r_a) | (nib(r_b) << 4)
+    out["chunked"] = jnp.zeros((*lead, chunks, 0), jnp.int8)
+    return out
 
 
 def quantize_weight_int4(w) -> dict:
@@ -155,7 +222,7 @@ def maybe_quantize(params, cfg, donate: bool = False):
 def dequantize_weight(p: dict):
     """Materialize the float weight (tests / conversion tooling)."""
     if "p4" in p:
-        return unpack_int4(p["p4"]).astype(jnp.float32) \
+        return unpack_int4(p["p4"], pack_chunks(p)).astype(jnp.float32) \
             * p["scale"][..., None, :]
     return p["q"].astype(jnp.float32) * p["scale"][..., None, :]
 

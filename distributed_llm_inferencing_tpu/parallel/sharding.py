@@ -47,20 +47,22 @@ def param_specs(cfg: ModelConfig, spec: MeshSpec,
             p["bias"] = P(L, None)
         return p
 
-    def lin(spec: P) -> Dict[str, Any]:
+    def lin(spec_: P) -> Dict[str, Any]:
         """Leaf specs for a linear weight; int8/int4 quant (ops/quant.py)
         adds a per-out-channel scale sharded like the weight's last axis.
         The packed-int4 leaf reuses the int8 spec (same rank, din axis
-        just halved). NB split-half packing means a din-sharded packed
-        leaf does NOT unpack to a contiguous din range per shard — that
-        is fine under GSPMD, which executes the unpack (concat of the
-        nibble planes, ops/quant.py unpack_int4) with whatever resharding
-        the einsum needs; the pallas kernel never runs inside GSPMD
-        programs (ops/pallas/quant_matmul.py supported())."""
+        just halved). Row-parallel (din-sharded) int4 leaves get the
+        shard-time chunk-local repack (shard_params below) so each
+        shard's slice is a self-contained split-half pack — the zero-
+        size ``chunked`` marker it adds replicates."""
+        # NB the shard-time chunk-local repack's ``chunked`` marker spec
+        # is added by shard_params itself, AFTER the repack — keeping it
+        # out of param_specs means every other consumer (checkpoint
+        # restore trees, plans) sees the mesh-agnostic leaf schema.
         if not cfg.quant:
-            return {"w": spec}
+            return {"w": spec_}
         key = "p4" if cfg.quant == "int4" else "q"
-        return {key: spec, "scale": P(*(spec[:-2] + spec[-1:]))}
+        return {key: spec_, "scale": P(*(spec_[:-2] + spec_[-1:]))}
 
     layers: Dict[str, Any] = {
         "attn_norm": norm_p(),
@@ -162,6 +164,38 @@ def named(mesh: Mesh, spec_tree):
 
 
 def shard_params(params, mesh: Mesh, cfg: ModelConfig, spec: MeshSpec):
-    """Place a param pytree onto the mesh per param_specs."""
-    shardings = named(mesh, param_specs(cfg, spec))
+    """Place a param pytree onto the mesh per param_specs.
+
+    int4 + tp>1: row-parallel (din-sharded) packed leaves are first
+    repacked chunk-locally (ops/quant.py repack_int4_rows) so each tp
+    shard holds a self-contained split-half pack and the pallas kernel's
+    row-parallel rule can run shard-local (ops/pallas/quant_matmul.py
+    q4_matmul_row). Leaves whose din doesn't divide into 2*tp chunks
+    keep the global layout (and the XLA unpack path)."""
+    specs = param_specs(cfg, spec)
+    if getattr(cfg, "quant", None) == "int4" and spec.tp > 1:
+        from distributed_llm_inferencing_tpu.ops.quant import (
+            repack_int4_rows)
+        params = dict(params)
+        params["layers"] = dict(params["layers"])
+        specs["layers"] = dict(specs["layers"])
+        for name in ("o", "down"):
+            leaf = params["layers"].get(name)
+            if not (isinstance(leaf, dict) and "p4" in leaf):
+                continue
+            try:
+                leaf = repack_int4_rows(leaf, spec.tp)
+            except ValueError:
+                if "chunked" in leaf:
+                    # chunked for a DIFFERENT tp: sharding it would be
+                    # silently wrong — the caller must reload/repack
+                    raise
+                # non-divisible din: keep global layout + XLA path
+            params["layers"][name] = leaf
+            if "chunked" in leaf:
+                ls = dict(specs["layers"][name])
+                # marker mirrors p4's stacked layer axis for the scan
+                ls["chunked"] = P(*(ls["p4"][:-2] + (None, None)))
+                specs["layers"][name] = ls
+    shardings = named(mesh, specs)
     return jax.device_put(params, shardings)
