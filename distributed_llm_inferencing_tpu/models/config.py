@@ -11,7 +11,7 @@ weight conversion.
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
+from typing import Optional, Tuple
 
 
 @dataclasses.dataclass(frozen=True)
@@ -70,6 +70,16 @@ class ModelConfig:
     # parallel_residual.
     shared_attn_mlp_norm: bool = False
     sliding_window: Optional[int] = None  # Mistral-style local attention
+    # Per-LAYER attention windows (GPT-Neo alternating global/local-256):
+    # a full per-layer tuple, entries None => global. Mutually exclusive
+    # with the uniform ``sliding_window``. Threaded through the runtime
+    # as an int32 leaf ``attn_window`` ([L], -1 == global) in the layer
+    # param tree (models/params.py, convert.py), so every scan / unroll /
+    # pipeline-stage / sharding path carries it without special cases;
+    # attention reads it as a traced scalar (ops/attention.py). Forces
+    # the XLA attention formulation — the pallas flash kernels take
+    # static windows only (models/transformer.py).
+    attn_windows: Optional[Tuple[Optional[int], ...]] = None
     # Gemma-style sqrt(hidden_size) embedding normalizer, applied to the
     # embedding OUTPUT only (the tied head reads the raw table).
     embed_scale: Optional[float] = None
@@ -134,6 +144,15 @@ class ModelConfig:
             f"num_heads={self.num_heads} must be divisible by "
             f"num_kv_heads={self.num_kv_heads}"
         )
+        if self.attn_windows is not None:
+            # normalize (checkpoint config.json roundtrips tuple -> list)
+            object.__setattr__(self, "attn_windows",
+                               tuple(self.attn_windows))
+            assert len(self.attn_windows) == self.num_layers, (
+                f"attn_windows has {len(self.attn_windows)} entries for "
+                f"{self.num_layers} layers")
+            assert self.sliding_window is None, (
+                "attn_windows and sliding_window are mutually exclusive")
         assert not (self.parallel_residual and self.post_norm), (
             "parallel_residual and post_norm are mutually exclusive")
         assert not (self.shared_attn_mlp_norm

@@ -65,7 +65,9 @@ def _act_from_hf(name: str) -> str:
 
 SUPPORTED_MODEL_TYPES = ("gpt2", "opt", "llama", "mistral", "mixtral",
                          "qwen2", "gemma", "gpt_neox", "phi", "falcon",
-                         "bloom", "gptj", "mpt", "gpt_bigcode", "stablelm")
+                         "bloom", "gptj", "mpt", "gpt_bigcode", "stablelm",
+                         "codegen", "starcoder2", "olmo", "phi3",
+                         "gpt_neo")
 
 
 def config_from_hf(hf_config) -> ModelConfig:
@@ -379,6 +381,162 @@ def config_from_hf(hf_config) -> ModelConfig:
             o_bias=False, mlp_bias=False,
             tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
                                         False))
+    if mt == "codegen":
+        # CodeGen (Salesforce): GPT-J topology — parallel residual with a
+        # single shared ln_1, partial INTERLEAVED rotary over rotary_dim,
+        # bias-free attention, biased MLP + untied biased lm_head. Only
+        # the fused-QKV weight layout differs (mp_num blocks, q|v|k
+        # order — see convert_state_dict).
+        heads = hf_config.n_head
+        hd = hf_config.n_embd // heads
+        if heads % 4:
+            raise NotImplementedError(
+                "codegen with n_head not divisible by mp_num=4 (HF "
+                "CodeGenAttention hard-codes 4 TP blocks in the fused "
+                "QKV layout)")
+        return ModelConfig(
+            name=getattr(hf_config, "name_or_path", mt) or mt,
+            family="codegen", vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.n_embd,
+            intermediate_size=getattr(hf_config, "n_inner", None)
+            or 4 * hf_config.n_embd,
+            num_layers=hf_config.n_layer, num_heads=heads,
+            num_kv_heads=heads, head_dim=hd,
+            max_position_embeddings=hf_config.n_positions,
+            norm_type="layernorm",
+            norm_eps=hf_config.layer_norm_epsilon,
+            activation=_act_from_hf(hf_config.activation_function),
+            gated_mlp=False, position_embedding="rope",
+            rope_theta=10000.0,
+            rope_pct=(getattr(hf_config, "rotary_dim", None) or hd) / hd,
+            rope_interleaved=True,
+            attn_bias=False, o_bias=False, mlp_bias=True,
+            lm_head_bias=True,
+            tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
+                                        False),
+            parallel_residual=True, shared_attn_mlp_norm=True)
+    if mt == "starcoder2":
+        # StarCoder2: llama layer layout/names but biased LAYERNORMS, a
+        # plain (non-gated) tanh-gelu MLP named c_fc/c_proj, biased
+        # linears (use_bias), full rotary, optional sliding window.
+        heads = hf_config.num_attention_heads
+        bias = getattr(hf_config, "use_bias", True)
+        return ModelConfig(
+            name=getattr(hf_config, "name_or_path", mt) or mt,
+            family="starcoder2", vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            intermediate_size=hf_config.intermediate_size,
+            num_layers=hf_config.num_hidden_layers, num_heads=heads,
+            num_kv_heads=getattr(hf_config, "num_key_value_heads", None)
+            or heads,
+            head_dim=hf_config.hidden_size // heads,
+            max_position_embeddings=hf_config.max_position_embeddings,
+            norm_type="layernorm",
+            norm_eps=getattr(hf_config, "norm_epsilon", 1e-5),
+            activation=_act_from_hf(getattr(hf_config, "hidden_act",
+                                            "gelu_pytorch_tanh")),
+            gated_mlp=False, position_embedding="rope",
+            rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+            attn_bias=bias, mlp_bias=bias,
+            sliding_window=getattr(hf_config, "sliding_window", None),
+            tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
+                                        True))
+    if mt == "olmo":
+        # OLMo: llama layout with NON-PARAMETRIC layernorms (no scale or
+        # bias — converted as unit-scale/zero-bias leaves so the runtime
+        # norm stays uniform), SwiGLU, bias-free linears, full rotary.
+        if getattr(hf_config, "clip_qkv", None):
+            raise NotImplementedError(
+                "olmo with clip_qkv (the runtime applies no QKV "
+                "activation clamp)")
+        heads = hf_config.num_attention_heads
+        return ModelConfig(
+            name=getattr(hf_config, "name_or_path", mt) or mt,
+            family="olmo", vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            intermediate_size=hf_config.intermediate_size,
+            num_layers=hf_config.num_hidden_layers, num_heads=heads,
+            num_kv_heads=getattr(hf_config, "num_key_value_heads", None)
+            or heads,
+            head_dim=hf_config.hidden_size // heads,
+            max_position_embeddings=hf_config.max_position_embeddings,
+            # HF OlmoLayerNorm: F.layer_norm with no affine, eps 1e-5
+            norm_type="layernorm", norm_eps=1e-5,
+            activation=_act_from_hf(getattr(hf_config, "hidden_act",
+                                            "silu")),
+            gated_mlp=True, position_embedding="rope",
+            rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+            attn_bias=getattr(hf_config, "attention_bias", False),
+            mlp_bias=False,
+            tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
+                                        False))
+    if mt == "phi3":
+        # Phi-3: llama semantics (rmsnorm, SwiGLU, full rotary, GQA,
+        # bias-free, untied head) with FUSED qkv_proj ([q|k|v] rows) and
+        # gate_up_proj ([gate|up] rows) — split in convert_state_dict.
+        if getattr(hf_config, "rope_scaling", None):
+            raise NotImplementedError(
+                "phi3 with rope_scaling (longrope) — only the base-rope "
+                "variants convert")
+        heads = hf_config.num_attention_heads
+        return ModelConfig(
+            name=getattr(hf_config, "name_or_path", mt) or mt,
+            family="phi3", vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            intermediate_size=hf_config.intermediate_size,
+            num_layers=hf_config.num_hidden_layers, num_heads=heads,
+            num_kv_heads=getattr(hf_config, "num_key_value_heads", None)
+            or heads,
+            head_dim=hf_config.hidden_size // heads,
+            max_position_embeddings=hf_config.max_position_embeddings,
+            norm_type="rmsnorm", norm_eps=hf_config.rms_norm_eps,
+            activation=_act_from_hf(getattr(hf_config, "hidden_act",
+                                            "silu")),
+            gated_mlp=True, position_embedding="rope",
+            rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+            attn_bias=False, mlp_bias=False,
+            sliding_window=getattr(hf_config, "sliding_window", None),
+            tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
+                                        False))
+    if mt == "gpt_neo":
+        # GPT-Neo: gpt2 topology (learned positions, sequential pre-LN,
+        # plain gelu MLP) with two quirks: attention scores are UNSCALED
+        # (no 1/sqrt(hd) — folded into the q weights at conversion, the
+        # same absorb-at-conversion idiom as gemma's norm offset), and
+        # layers alternate global / local-window attention
+        # (attention_types) — the per-layer window rides the param tree
+        # (config.py attn_windows).
+        kinds = list(hf_config.attention_layers)
+        if not all(t in ("global", "local") for t in kinds):
+            raise NotImplementedError(
+                f"gpt_neo attention_types {sorted(set(kinds))!r} — only "
+                "global/local convert")
+        win = int(getattr(hf_config, "window_size", 256))
+        wins = tuple(None if t == "global" else win for t in kinds)
+        uniform = len(set(wins)) == 1   # all-global OR all-local: the
+        # static uniform path keeps the pallas flash kernels eligible
+        heads = hf_config.num_heads
+        return ModelConfig(
+            name=getattr(hf_config, "name_or_path", mt) or mt,
+            family="gpt_neo", vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            intermediate_size=getattr(hf_config, "intermediate_size", None)
+            or 4 * hf_config.hidden_size,
+            num_layers=hf_config.num_layers, num_heads=heads,
+            num_kv_heads=heads,
+            head_dim=hf_config.hidden_size // heads,
+            max_position_embeddings=hf_config.max_position_embeddings,
+            norm_type="layernorm",
+            norm_eps=hf_config.layer_norm_epsilon,
+            activation=_act_from_hf(getattr(hf_config,
+                                            "activation_function",
+                                            "gelu_new")),
+            gated_mlp=False, position_embedding="learned",
+            attn_bias=False, o_bias=True, mlp_bias=True,
+            sliding_window=wins[0] if uniform else None,
+            attn_windows=None if uniform else wins,
+            tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
+                                        True))
     raise NotImplementedError(
         f"unsupported HF model_type {mt!r}; supported: "
         f"{', '.join(SUPPORTED_MODEL_TYPES)}")
@@ -832,6 +990,175 @@ def convert_state_dict(cfg: ModelConfig, sd, dtype=None):
         }
         if not cfg.tie_word_embeddings:
             params["lm_head"] = {"w": get("lm_head.weight").T}
+    elif fam == "codegen":
+        # Fused QKV in mp_num=4 TP blocks; within each block the order is
+        # q | v | k (HF CodeGenAttention splits query, value, key), and
+        # block m holds global heads [m*H/4, (m+1)*H/4) — so kind j's
+        # rows, concatenated across blocks, are already in global head
+        # order.
+        mp = 4
+        local = 3 * D // mp  # block width: q+v+k for H/4 heads
+
+        def layer(i):
+            p = f"transformer.h.{i}."
+
+            def lin(n, bias):
+                out = {"w": get(p + n + ".weight").T}
+                if bias:
+                    out["b"] = get(p + n + ".bias")
+                return out
+            wb = get(p + "attn.qkv_proj.weight").reshape(mp, local, D)
+
+            def proj(j):  # j: 0=q, 1=v, 2=k
+                third = local // 3
+                return {"w": wb[:, j * third:(j + 1) * third]
+                        .reshape(D, D).T}
+            return {
+                "attn_norm": {"scale": get(p + "ln_1.weight"),
+                              "bias": get(p + "ln_1.bias")},
+                "q": proj(0), "v": proj(1), "k": proj(2),
+                "o": lin("attn.out_proj", False),
+                "up": lin("mlp.fc_in", True),
+                "down": lin("mlp.fc_out", True),
+            }
+        params = {
+            "embed": {"tokens": get("transformer.wte.weight")},
+            "layers": _stack([layer(i) for i in range(cfg.num_layers)]),
+            "final_norm": {"scale": get("transformer.ln_f.weight"),
+                           "bias": get("transformer.ln_f.bias")},
+        }
+        if not cfg.tie_word_embeddings:
+            params["lm_head"] = {"w": get("lm_head.weight").T,
+                                 "b": get("lm_head.bias")}
+    elif fam == "starcoder2":
+        def layer(i):
+            p = f"model.layers.{i}."
+
+            def lin(n, bias):
+                out = {"w": get(p + n + ".weight").T}
+                if bias:
+                    out["b"] = get(p + n + ".bias")
+                return out
+            return {
+                "attn_norm": {"scale": get(p + "input_layernorm.weight"),
+                              "bias": get(p + "input_layernorm.bias")},
+                "q": lin("self_attn.q_proj", cfg.attn_bias),
+                "k": lin("self_attn.k_proj", cfg.attn_bias),
+                "v": lin("self_attn.v_proj", cfg.attn_bias),
+                "o": lin("self_attn.o_proj", cfg.o_bias_effective),
+                "mlp_norm": {
+                    "scale": get(p + "post_attention_layernorm.weight"),
+                    "bias": get(p + "post_attention_layernorm.bias")},
+                "up": lin("mlp.c_fc", cfg.mlp_bias),
+                "down": lin("mlp.c_proj", cfg.mlp_bias),
+            }
+        params = {
+            "embed": {"tokens": get("model.embed_tokens.weight")},
+            "layers": _stack([layer(i) for i in range(cfg.num_layers)]),
+            "final_norm": {"scale": get("model.norm.weight"),
+                           "bias": get("model.norm.bias")},
+        }
+        if not cfg.tie_word_embeddings:
+            params["lm_head"] = {"w": get("lm_head.weight").T}
+    elif fam == "olmo":
+        # Non-parametric norms: HF OlmoLayerNorm has no weights at all —
+        # unit scale / zero bias is its exact parametric equivalent.
+        unit_norm = {"scale": np.ones((D,), np.float32),
+                     "bias": np.zeros((D,), np.float32)}
+
+        def layer(i):
+            p = f"model.layers.{i}."
+
+            def lin(n):
+                out = {"w": get(p + n + ".weight").T}
+                if p + n + ".bias" in sd:
+                    out["b"] = get(p + n + ".bias")
+                return out
+            return {
+                "attn_norm": dict(unit_norm),
+                "q": lin("self_attn.q_proj"),
+                "k": lin("self_attn.k_proj"),
+                "v": lin("self_attn.v_proj"),
+                "o": lin("self_attn.o_proj"),
+                "mlp_norm": dict(unit_norm),
+                "gate": lin("mlp.gate_proj"),
+                "up": lin("mlp.up_proj"),
+                "down": lin("mlp.down_proj"),
+            }
+        params = {
+            "embed": {"tokens": get("model.embed_tokens.weight")},
+            "layers": _stack([layer(i) for i in range(cfg.num_layers)]),
+            "final_norm": dict(unit_norm),
+        }
+        if not cfg.tie_word_embeddings:
+            params["lm_head"] = {"w": get("lm_head.weight").T}
+    elif fam == "phi3":
+        qd = cfg.num_heads * cfg.head_dim
+        kvd = cfg.num_kv_heads * cfg.head_dim
+        I = cfg.intermediate_size
+
+        def layer(i):
+            p = f"model.layers.{i}."
+            wqkv = get(p + "self_attn.qkv_proj.weight")     # [q|k|v, D]
+            wgu = get(p + "mlp.gate_up_proj.weight")        # [gate|up, D]
+            return {
+                "attn_norm": {"scale": get(p + "input_layernorm.weight")},
+                "q": {"w": wqkv[:qd].T},
+                "k": {"w": wqkv[qd:qd + kvd].T},
+                "v": {"w": wqkv[qd + kvd:].T},
+                "o": {"w": get(p + "self_attn.o_proj.weight").T},
+                "mlp_norm": {
+                    "scale": get(p + "post_attention_layernorm.weight")},
+                "gate": {"w": wgu[:I].T},
+                "up": {"w": wgu[I:].T},
+                "down": {"w": get(p + "mlp.down_proj.weight").T},
+            }
+        params = {
+            "embed": {"tokens": get("model.embed_tokens.weight")},
+            "layers": _stack([layer(i) for i in range(cfg.num_layers)]),
+            "final_norm": {"scale": get("model.norm.weight")},
+        }
+        if not cfg.tie_word_embeddings:
+            params["lm_head"] = {"w": get("lm_head.weight").T}
+    elif fam == "gpt_neo":
+        # HF GPTNeo computes UNSCALED attention scores; our attend always
+        # multiplies by 1/sqrt(hd), so scale q by sqrt(hd) here — exact
+        # (the scalar commutes with the projection).
+        qs = float(cfg.head_dim) ** 0.5
+
+        def layer(i):
+            p = f"transformer.h.{i}."
+
+            def lin(n, bias):
+                out = {"w": get(p + n + ".weight").T}
+                if bias:
+                    out["b"] = get(p + n + ".bias")
+                return out
+            lp = {
+                "attn_norm": {"scale": get(p + "ln_1.weight"),
+                              "bias": get(p + "ln_1.bias")},
+                "q": {"w": get(p + "attn.attention.q_proj.weight").T * qs},
+                "k": lin("attn.attention.k_proj", False),
+                "v": lin("attn.attention.v_proj", False),
+                "o": lin("attn.attention.out_proj", True),
+                "mlp_norm": {"scale": get(p + "ln_2.weight"),
+                             "bias": get(p + "ln_2.bias")},
+                "up": lin("mlp.c_fc", True),
+                "down": lin("mlp.c_proj", True),
+            }
+            if cfg.attn_windows is not None:
+                w = cfg.attn_windows[i]
+                lp["attn_window"] = np.int32(-1 if w is None else w)
+            return lp
+        params = {
+            "embed": {"tokens": get("transformer.wte.weight"),
+                      "positions": get("transformer.wpe.weight")},
+            "layers": _stack([layer(i) for i in range(cfg.num_layers)]),
+            "final_norm": {"scale": get("transformer.ln_f.weight"),
+                           "bias": get("transformer.ln_f.bias")},
+        }
+        if not cfg.tie_word_embeddings:
+            params["lm_head"] = {"w": get("lm_head.weight").T}
     else:
         raise NotImplementedError(fam)
 
@@ -840,7 +1167,9 @@ def convert_state_dict(cfg: ModelConfig, sd, dtype=None):
 
 def _to_jax(tree, dtype):
     if isinstance(tree, dict):
-        return {k: _to_jax(v, dtype) for k, v in tree.items()}
+        return {k: (jnp.asarray(v, jnp.int32) if k == "attn_window"
+                    else _to_jax(v, dtype))
+                for k, v in tree.items()}
     return jnp.asarray(tree, dtype)
 
 

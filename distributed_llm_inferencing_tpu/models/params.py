@@ -78,6 +78,11 @@ def init_params(cfg: ModelConfig, key, dtype=None):
         "v": lin(D, cfg.kv_dim, cfg.attn_bias),
         "o": lin(cfg.q_dim, D, cfg.o_bias_effective),
     }
+    if cfg.attn_windows is not None:
+        # per-layer window leaf ([L] int32, -1 == global) — rides the
+        # layer scan/unroll/pipeline machinery (transformer._layer_window)
+        layers["attn_window"] = jnp.asarray(
+            [-1 if w is None else w for w in cfg.attn_windows], jnp.int32)
     if not cfg.shared_attn_mlp_norm:   # phi/falcon-7b: one norm per block
         layers["mlp_norm"] = norm_p()
     if cfg.is_moe:

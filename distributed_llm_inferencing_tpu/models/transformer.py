@@ -231,6 +231,31 @@ def _alibi(cfg: ModelConfig):
     return alibi_slopes(cfg.num_heads) * cfg.alibi_scale
 
 
+def _cfg_backend(cfg: ModelConfig, n_devices: int, op: str = "dense"):
+    """resolve_backend, then force the XLA formulation for per-layer
+    windows: the pallas flash/paged kernels take static windows only,
+    while the traced ``attn_window`` scalar flows through the XLA masks
+    unchanged (ops/attention.py attend)."""
+    b = resolve_backend(cfg.attn_backend, n_devices, op=op)
+    if cfg.attn_windows is not None and b.startswith("pallas"):
+        return "xla"
+    return b
+
+
+def _layer_window(cfg: ModelConfig, lp):
+    """Effective attention window for one layer.
+
+    Per-layer windows (cfg.attn_windows, GPT-Neo's alternating
+    global/local) ride the layer param tree as an int32 ``attn_window``
+    leaf ([L] stacked; -1 == global) — under scan/unroll/pipeline ``lp``
+    holds this layer's scalar slice, so every serving path threads it
+    with no extra plumbing. Uniform-window families fall through to the
+    static cfg.sliding_window."""
+    if isinstance(lp, dict) and "attn_window" in lp:
+        return lp["attn_window"]
+    return cfg.sliding_window
+
+
 def embed(params, cfg: ModelConfig, tokens, q_positions):
     """Token (+ learned position) embedding. Shared by the scanned forward
     below and the pipelined executor (parallel/pipeline.py)."""
@@ -396,9 +421,9 @@ def _block(x, lp, cache_k, cache_v, *, cfg: ModelConfig, q_positions,
                 ring_attend_prefill)
             attn = ring_attend_prefill(
                 q, k, v, q_positions, new_lengths, mesh=mesh,
-                sliding_window=cfg.sliding_window, alibi=_alibi(cfg))
+                sliding_window=_layer_window(cfg, lp), alibi=_alibi(cfg))
         elif is_prefill:
-            attn = attend_prefill(q, k, v, sliding_window=cfg.sliding_window,
+            attn = attend_prefill(q, k, v, sliding_window=_layer_window(cfg, lp),
                                   backend=backend, alibi=_alibi(cfg))
         elif mesh is not None and mesh.shape.get("sp", 1) > 1:
             # sp-sharded cache decode: flash-decoding partials per shard +
@@ -408,14 +433,14 @@ def _block(x, lp, cache_k, cache_v, *, cfg: ModelConfig, q_positions,
                 ring_attend_decode)
             attn = ring_attend_decode(q, ck_at, cv_at, new_lengths,
                                       mesh=mesh,
-                                      sliding_window=cfg.sliding_window,
+                                      sliding_window=_layer_window(cfg, lp),
                                       alibi=_alibi(cfg))
         else:
             # quantized caches pin the xla formulation: the dequant fuses
             # into its matmul, while a pallas kernel input would
             # materialize the bf16 copy and forfeit the int8 read
             attn = attend_decode(q, ck_at, cv_at, new_lengths,
-                                 sliding_window=cfg.sliding_window,
+                                 sliding_window=_layer_window(cfg, lp),
                                  backend="xla" if quantized else backend,
                                  q_positions=q_positions, alibi=_alibi(cfg))
         return attn, cache_out
@@ -452,7 +477,7 @@ def forward(
     # backend for its own programs; direct callers (tests, dryrun) get
     # pallas only when the whole process sees a single device, since the
     # pallas kernels are single-program (no GSPMD partitioning rule).
-    backend = resolve_backend(cfg.attn_backend, jax.device_count())
+    backend = _cfg_backend(cfg, jax.device_count())
 
     # one body serves both cache layouts: scale planes ride the scan xs
     # only when the cache is quantized
@@ -545,7 +570,7 @@ def paged_decode_step(params, cfg: ModelConfig, tokens, paged,
     from distributed_llm_inferencing_tpu.ops.paged_kvcache import (
         PagedKVCache, paged_attend_decode, write_token)
     r = tokens.shape[0]
-    backend = resolve_backend(cfg.attn_backend, jax.device_count())
+    backend = _cfg_backend(cfg, jax.device_count())
     q_pos = context_lens[:, None]                       # [R, 1]
     x = embed(params, cfg, tokens[:, None], q_pos)      # [R, 1, D]
     quantized = paged.quantized
@@ -566,7 +591,7 @@ def paged_decode_step(params, cfg: ModelConfig, tokens, paged,
                 nvs = write_token(cvs, vs, block_tables, context_lens)
                 attn = paged_attend_decode(
                     q, nk, nv, block_tables, context_lens + 1,
-                    sliding_window=cfg.sliding_window, backend=backend,
+                    sliding_window=_layer_window(cfg, lp), backend=backend,
                     k_scale_layer=nks, v_scale_layer=nvs,
                     alibi=_alibi(cfg))
                 return attn, (nk, nv, nks, nvs)
@@ -574,7 +599,7 @@ def paged_decode_step(params, cfg: ModelConfig, tokens, paged,
             nv = write_token(cv, v[:, 0], block_tables, context_lens)
             attn = paged_attend_decode(
                 q, nk, nv, block_tables, context_lens + 1,
-                sliding_window=cfg.sliding_window, backend=backend,
+                sliding_window=_layer_window(cfg, lp), backend=backend,
                 alibi=_alibi(cfg))
             return attn, (nk, nv)
 
@@ -638,8 +663,8 @@ def paged_decode_chunk(params, cfg: ModelConfig, k: int, tokens, paged,
         PagedKVCache, gather_seq)
     from distributed_llm_inferencing_tpu.ops.sampling import sample_batch
 
-    if resolve_backend(cfg.attn_backend, jax.device_count(),
-                       op="paged").startswith("pallas"):
+    if _cfg_backend(cfg, jax.device_count(),
+                    op="paged").startswith("pallas"):
         # explicit pallas request (A/B and debug escape hatch): the
         # side-buffer formulation below bypasses the paged kernel, so run
         # the stepwise write+attend loop that dispatches to it instead
@@ -719,7 +744,7 @@ def paged_decode_chunk(params, cfg: ModelConfig, k: int, tokens, paged,
                     q_pos,
                     jnp.concatenate([pool_pos, side_pos], axis=1),
                     jnp.concatenate([pool_valid, side_valid], axis=1),
-                    sliding_window=cfg.sliding_window, alibi=_alibi(cfg))
+                    sliding_window=_layer_window(cfg, lp), alibi=_alibi(cfg))
                 return attn, (sk2, sv2)
 
             x, (sk2, sv2) = _block_body(x, lp, cfg, q_pos, attend_write)
@@ -928,7 +953,7 @@ def paged_speculative_chunk(params, cfg: ModelConfig, k: int, gamma: int,
                     qp,
                     jnp.concatenate([pool_pos, side_pos], axis=1),
                     jnp.concatenate([pool_valid, side_valid], axis=1),
-                    sliding_window=cfg.sliding_window, alibi=_alibi(cfg))
+                    sliding_window=_layer_window(cfg, lp), alibi=_alibi(cfg))
                 return attn, (sk2, sv2)
 
             x, (sk2, sv2) = _block_body(x, lp, cfg, qp, attend_write)
@@ -1072,7 +1097,7 @@ def paged_prefill_tail(params, cfg: ModelConfig, tokens, tail_len,
                 nvs = write_block_run(cvs, vs, tail_blocks)
                 attn = paged_attend_prefix(
                     q, k, v, nk, nv, prefix_blocks, prefix_len, q_pos,
-                    tail_valid, sliding_window=cfg.sliding_window,
+                    tail_valid, sliding_window=_layer_window(cfg, lp),
                     k_scale_layer=nks, v_scale_layer=nvs,
                     alibi=_alibi(cfg))
                 return attn, (nk, nv, nks, nvs)
@@ -1080,7 +1105,7 @@ def paged_prefill_tail(params, cfg: ModelConfig, tokens, tail_len,
             nv = write_block_run(cv, v, tail_blocks)
             attn = paged_attend_prefix(
                 q, k, v, nk, nv, prefix_blocks, prefix_len, q_pos, tail_valid,
-                sliding_window=cfg.sliding_window, alibi=_alibi(cfg))
+                sliding_window=_layer_window(cfg, lp), alibi=_alibi(cfg))
             return attn, (nk, nv)
 
         return _block_body(x, lp, cfg, q_pos, attend_write)

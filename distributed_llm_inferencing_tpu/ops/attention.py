@@ -54,6 +54,20 @@ def repeat_kv(x, n_rep: int):
     return x.reshape(b, s, h * n_rep, d)
 
 
+def window_mask(q_pos, kv_pos, sliding_window):
+    """Window admissibility for broadcast-aligned position arrays.
+
+    ``sliding_window`` is either a static python int (uniform window) or
+    a traced scalar — the per-layer ``attn_window`` leaf
+    (models/transformer.py _layer_window), where a NEGATIVE value
+    disables the window for that layer (GPT-Neo's global layers). One
+    helper so the dense and ring formulations can't drift."""
+    in_window = (q_pos - kv_pos) < sliding_window
+    if not isinstance(sliding_window, int):
+        in_window = in_window | (sliding_window < 0)
+    return in_window
+
+
 def attend(
     q,                   # [B, Sq, H, hd]
     k,                   # [B, Skv, Hkv, hd]
@@ -91,8 +105,8 @@ def attend(
     causal = kv_positions[:, None, :] <= q_positions[:, :, None]  # [B,Sq,Skv]
     mask = causal & kv_valid[:, None, :]
     if sliding_window is not None:
-        in_window = (q_positions[:, :, None] - kv_positions[:, None, :]) < sliding_window
-        mask = mask & in_window
+        mask = mask & window_mask(q_positions[:, :, None],
+                                  kv_positions[:, None, :], sliding_window)
     logits = jnp.where(mask[:, None, :, :], logits, NEG_INF)
 
     probs = jnp.exp(logits - jnp.max(logits, axis=-1, keepdims=True))

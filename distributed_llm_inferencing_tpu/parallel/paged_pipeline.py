@@ -178,7 +178,7 @@ def paged_decode_chunk_pp(params, cfg: ModelConfig, k: int, tokens, paged,
                         q_pos,
                         jnp.concatenate([pool_pos, side_pos], axis=1),
                         jnp.concatenate([pool_valid, side_valid], axis=1),
-                        sliding_window=cfg.sliding_window,
+                        sliding_window=tf._layer_window(cfg, lp),
                         alibi=tf._alibi(cfg))
                     return attn, (sk2, sv2)
 
@@ -452,7 +452,7 @@ def paged_speculative_chunk_pp(params, cfg: ModelConfig, k: int, gamma: int,
                         qp,
                         jnp.concatenate([pool_pos, side_pos_m], axis=1),
                         jnp.concatenate([pool_valid, side_valid], axis=1),
-                        sliding_window=cfg.sliding_window,
+                        sliding_window=tf._layer_window(cfg, lp),
                         alibi=tf._alibi(cfg))
                     return attn, (sk2, sv2)
 
@@ -677,7 +677,7 @@ def paged_prefill_tail_pp(params, cfg: ModelConfig, tokens, tail_len,
                     # dequantized cached prefix
                     attn = paged_attend_prefix(
                         q, kh, vh, nk, nv, pb_m, pl_m, qp, tv,
-                        sliding_window=cfg.sliding_window,
+                        sliding_window=tf._layer_window(cfg, lp),
                         k_scale_layer=nks, v_scale_layer=nvs,
                         alibi=tf._alibi(cfg))
                     return attn, (nk, nv, nks, nvs)
@@ -691,7 +691,7 @@ def paged_prefill_tail_pp(params, cfg: ModelConfig, tokens, tail_len,
                     nv = write_block_run(cv, vh, tb_eff)
                     attn = paged_attend_prefix(
                         q, kh, vh, nk, nv, pb_m, pl_m, qp, tv,
-                        sliding_window=cfg.sliding_window,
+                        sliding_window=tf._layer_window(cfg, lp),
                         alibi=tf._alibi(cfg))
                     return attn, (nk, nv)
 

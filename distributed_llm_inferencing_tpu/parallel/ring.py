@@ -75,8 +75,9 @@ def _masked_scores(q, k, q_pos, kv_pos, kv_valid, sliding_window,
         s = s + alibi[None, :, None, None] * rel[:, None, :, :]
     mask = (kv_pos[:, None, :] <= q_pos[:, :, None]) & kv_valid[:, None, :]
     if sliding_window is not None:
-        mask = mask & ((q_pos[:, :, None] - kv_pos[:, None, :])
-                       < sliding_window)
+        from distributed_llm_inferencing_tpu.ops.attention import window_mask
+        mask = mask & window_mask(q_pos[:, :, None], kv_pos[:, None, :],
+                                  sliding_window)
     return jnp.where(mask[:, None, :, :], s, NEG_INF)
 
 

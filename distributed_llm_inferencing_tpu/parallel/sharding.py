@@ -71,6 +71,10 @@ def param_specs(cfg: ModelConfig, spec: MeshSpec,
         "v": lin(P(L, None, kv_tp)),
         "o": lin(P(L, "tp", None)),
     }
+    if cfg.attn_windows is not None:
+        # [L] int32 per-layer window leaf: pp shards the layer axis like
+        # every other stacked leaf, so each stage carries its own slice
+        layers["attn_window"] = P(L)
     if not cfg.shared_attn_mlp_norm:   # phi/falcon-7b: one norm per block
         layers["mlp_norm"] = norm_p()
     if cfg.attn_bias:

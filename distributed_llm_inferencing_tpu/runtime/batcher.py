@@ -1108,5 +1108,6 @@ class ContinuousBatcher:
 
 
 def _backend(cfg: ModelConfig, num_devices: int = 1) -> str:
-    from distributed_llm_inferencing_tpu.ops.attention import resolve_backend
-    return resolve_backend(cfg.attn_backend, num_devices, op="paged")
+    from distributed_llm_inferencing_tpu.models.transformer import (
+        _cfg_backend)
+    return _cfg_backend(cfg, num_devices, op="paged")

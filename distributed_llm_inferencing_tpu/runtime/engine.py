@@ -77,10 +77,10 @@ class InferenceEngine:
         # Pin the attention backend now that the program's device span is
         # known (pallas kernels are single-program; GSPMD partitions the
         # xla formulation on multi-device meshes).
-        from distributed_llm_inferencing_tpu.ops.attention import resolve_backend
+        from distributed_llm_inferencing_tpu.models.transformer import (
+            _cfg_backend)
         self.cfg = cfg = cfg.replace(
-            attn_backend=resolve_backend(cfg.attn_backend,
-                                         self.mesh_spec.num_devices),
+            attn_backend=_cfg_backend(cfg, self.mesh_spec.num_devices),
             # int4 pallas routing: row-parallel leaves stay on XLA when
             # this GSPMD program shards them over tp (config.py field doc)
             tp_row_sharded=self.mesh_spec.tp > 1)

@@ -154,3 +154,18 @@ def test_generate_cli_loads_native_checkpoint(tmp_path, capsys):
               "--prompt", "ab", "--max_new_tokens", "4", "--greedy"])
     out = capsys.readouterr().out
     assert len(out.strip()) > 0
+
+
+def test_roundtrip_per_layer_windows(tmp_path):
+    """attn_windows survives config.json (tuple -> list -> tuple) and the
+    int32 ``attn_window`` leaf restores with its dtype intact."""
+    cfg = get_config("tiny-llama").replace(
+        dtype="float32", sliding_window=None,
+        attn_windows=(None, 3, None, 3))
+    params = init_params(cfg, jax.random.PRNGKey(1), dtype=jnp.float32)
+    checkpoint.save_checkpoint(str(tmp_path / "ck"), cfg, params)
+    cfg2, params2 = checkpoint.load_checkpoint(str(tmp_path / "ck"))
+    assert cfg2 == cfg
+    assert cfg2.attn_windows == (None, 3, None, 3)
+    assert params2["layers"]["attn_window"].dtype == jnp.int32
+    tree_equal(params, params2)

@@ -662,3 +662,203 @@ def test_stablelm_unsupported_options_rejected():
         num_hidden_layers=1, num_attention_heads=4, qk_layernorm=True)
     with _pytest.raises(NotImplementedError, match="qk_layernorm"):
         convert.config_from_hf(cfg2)
+
+
+def test_codegen_matches_hf():
+    """CodeGen: GPT-J topology via a DIFFERENT fused-QKV layout (mp_num=4
+    TP blocks, q|v|k order within each block) + partial interleaved
+    rotary."""
+    import torch
+    import transformers
+    torch_cfg = transformers.CodeGenConfig(
+        vocab_size=128, n_positions=64, n_ctx=64, n_embd=32, n_layer=3,
+        n_head=4, rotary_dim=4, activation_function="gelu_new",
+        tie_word_embeddings=False)
+    torch.manual_seed(14)
+    model = transformers.CodeGenForCausalLM(torch_cfg).eval()
+    rng = np.random.default_rng(14)
+    tokens = rng.integers(0, 128, size=(2, 11), dtype=np.int64)
+    _check_model(model, tokens)
+
+
+def test_codegen_head_divisibility_rejected():
+    import transformers
+    import pytest as _pytest
+    cfg = transformers.CodeGenConfig(
+        vocab_size=64, n_positions=64, n_embd=30, n_layer=1, n_head=6,
+        rotary_dim=4)
+    with _pytest.raises(NotImplementedError, match="mp_num"):
+        convert.config_from_hf(cfg)
+
+
+def test_starcoder2_matches_hf():
+    """StarCoder2: llama layer names with biased layernorms, biased
+    linears and a plain (non-gated) tanh-gelu c_fc/c_proj MLP."""
+    import torch
+    import transformers
+    torch_cfg = transformers.Starcoder2Config(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, use_bias=True, sliding_window=None,
+        tie_word_embeddings=True)
+    torch.manual_seed(15)
+    model = transformers.Starcoder2ForCausalLM(torch_cfg).eval()
+    rng = np.random.default_rng(15)
+    tokens = rng.integers(0, 128, size=(2, 10), dtype=np.int64)
+    _check_model(model, tokens)
+
+
+def test_olmo_matches_hf():
+    """OLMo: llama layout with NON-PARAMETRIC layernorms (converted to
+    unit-scale/zero-bias leaves)."""
+    import torch
+    import transformers
+    torch_cfg = transformers.OlmoConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, clip_qkv=None,
+        tie_word_embeddings=False)
+    torch.manual_seed(16)
+    model = transformers.OlmoForCausalLM(torch_cfg).eval()
+    rng = np.random.default_rng(16)
+    tokens = rng.integers(0, 128, size=(2, 10), dtype=np.int64)
+    _check_model(model, tokens)
+
+
+def test_olmo_clip_qkv_rejected():
+    import transformers
+    import pytest as _pytest
+    cfg = transformers.OlmoConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=1, num_attention_heads=4, clip_qkv=8.0)
+    with _pytest.raises(NotImplementedError, match="clip_qkv"):
+        convert.config_from_hf(cfg)
+
+
+def test_phi3_matches_hf():
+    """Phi-3: llama semantics with fused qkv_proj and gate_up_proj rows
+    split at conversion."""
+    import torch
+    import transformers
+    torch_cfg = transformers.Phi3Config(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, sliding_window=None,
+        pad_token_id=0, tie_word_embeddings=False)
+    torch.manual_seed(17)
+    model = transformers.Phi3ForCausalLM(torch_cfg).eval()
+    rng = np.random.default_rng(17)
+    tokens = rng.integers(0, 128, size=(2, 10), dtype=np.int64)
+    _check_model(model, tokens)
+
+
+def test_phi3_rope_scaling_rejected():
+    import transformers
+    import pytest as _pytest
+    cfg = transformers.Phi3Config(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=1, num_attention_heads=4,
+        max_position_embeddings=128, original_max_position_embeddings=64,
+        rope_scaling={"type": "longrope",
+                      "short_factor": [1.0] * 4, "long_factor": [2.0] * 4})
+    with _pytest.raises(NotImplementedError, match="rope_scaling"):
+        convert.config_from_hf(cfg)
+
+
+def test_gpt_neo_matches_hf():
+    """GPT-Neo: UNSCALED attention (sqrt(hd) folded into q at conversion)
+    + alternating global/local-window layers via the per-layer traced
+    ``attn_window`` leaf. window_size=8 < seq so the local mask binds."""
+    import torch
+    import transformers
+    torch_cfg = transformers.GPTNeoConfig(
+        vocab_size=128, max_position_embeddings=64, hidden_size=32,
+        num_layers=4, attention_types=[[["global", "local"], 2]],
+        num_heads=4, window_size=8)
+    torch.manual_seed(18)
+    model = transformers.GPTNeoForCausalLM(torch_cfg).eval()
+    rng = np.random.default_rng(18)
+    tokens = rng.integers(0, 128, size=(2, 14), dtype=np.int64)
+    _check_model(model, tokens)
+
+
+def test_gpt_neo_all_global_matches_hf():
+    """All-global GPT-Neo converts WITHOUT attn_windows (uniform path)."""
+    import torch
+    import transformers
+    torch_cfg = transformers.GPTNeoConfig(
+        vocab_size=96, max_position_embeddings=64, hidden_size=32,
+        num_layers=2, attention_types=[[["global"], 2]], num_heads=4)
+    cfg = convert.config_from_hf(torch_cfg)
+    assert cfg.attn_windows is None
+    torch.manual_seed(19)
+    model = transformers.GPTNeoForCausalLM(torch_cfg).eval()
+    rng = np.random.default_rng(19)
+    tokens = rng.integers(0, 96, size=(1, 9), dtype=np.int64)
+    _check_model(model, tokens)
+
+
+def test_gpt_neo_decode_matches_hf_generate():
+    """Greedy decode through the engine (cached attend_decode with the
+    traced per-layer window) vs HF generate."""
+    import torch
+    import transformers
+    from distributed_llm_inferencing_tpu.ops.sampling import SamplingParams
+    from distributed_llm_inferencing_tpu.runtime.engine import (
+        InferenceEngine)
+    torch_cfg = transformers.GPTNeoConfig(
+        vocab_size=128, max_position_embeddings=64, hidden_size=32,
+        num_layers=4, attention_types=[[["global", "local"], 2]],
+        num_heads=4, window_size=8)
+    torch.manual_seed(20)
+    model = transformers.GPTNeoForCausalLM(torch_cfg).eval()
+    cfg, params = convert.load_hf_model(model, dtype=jnp.float32)
+    cfg = cfg.replace(dtype="float32")
+    rng = np.random.default_rng(20)
+    prompt = rng.integers(0, 128, size=12).tolist()
+    eng = InferenceEngine(cfg, params, max_seq=40)
+    ours = eng.generate([prompt], max_new_tokens=16,
+                        sampling=SamplingParams.greedy()).tokens[0]
+    with torch.no_grad():
+        ref = model.generate(torch.tensor([prompt]), max_new_tokens=16,
+                             do_sample=False)
+    assert ours == ref[0, len(prompt):].tolist()
+
+
+def test_gpt_neo_paged_serving_matches_engine():
+    """Per-layer windows through the SERVING path: paged prefill +
+    chunked decode reproduce the engine's greedy tokens (the window mask
+    rides q/kv positions, so block-table indirection must not disturb
+    it — and decode must keep attending far-back pool blocks on GLOBAL
+    layers while masking them on local ones)."""
+    import torch
+    import transformers
+    from distributed_llm_inferencing_tpu.ops.sampling import SamplingParams
+    from distributed_llm_inferencing_tpu.runtime.batcher import (
+        ContinuousBatcher)
+    from distributed_llm_inferencing_tpu.runtime.engine import (
+        InferenceEngine)
+    torch_cfg = transformers.GPTNeoConfig(
+        vocab_size=128, max_position_embeddings=64, hidden_size=32,
+        num_layers=4, attention_types=[[["global", "local"], 2]],
+        num_heads=4, window_size=8)
+    torch.manual_seed(21)
+    model = transformers.GPTNeoForCausalLM(torch_cfg).eval()
+    cfg, params = convert.load_hf_model(model, dtype=jnp.float32)
+    cfg = cfg.replace(dtype="float32", attn_backend="xla")
+    rng = np.random.default_rng(21)
+    prompt = rng.integers(0, 128, size=11).tolist()
+
+    eng = InferenceEngine(cfg, params, max_seq=64)
+    want = eng.generate([prompt], max_new_tokens=12,
+                        sampling=SamplingParams.greedy()).tokens[0]
+
+    b = ContinuousBatcher(cfg, params, num_blocks=32, block_size=8,
+                          slots=2, max_seq=64, seed=0)
+    r = b.submit(prompt, max_new_tokens=12,
+                 sampling=SamplingParams.greedy())
+    for _ in range(40):
+        b.step()
+        if r.done.is_set():
+            break
+    assert r.wait() == want, (r.tokens, want)

@@ -112,3 +112,48 @@ def test_moe_pipelined():
                                                     lengths, cache)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                atol=2e-4, rtol=2e-4)
+
+
+def test_pipelined_per_layer_windows_match_single_device():
+    """Per-layer attention windows (gpt-neo topology) through pp: the [L]
+    ``attn_window`` leaf shards over the pp axis like every stacked leaf,
+    so each stage masks with its OWN layers' windows."""
+    cfg = get_config("tiny-llama").replace(
+        dtype="float32", sliding_window=None,
+        attn_windows=(None, 3, None, 3))
+    assert cfg.num_layers == 4, "tiny-llama layer count changed"
+    spec = MeshSpec(pp=2)
+    validate_spec(spec, cfg)
+    params = init_params(cfg, jax.random.PRNGKey(2), dtype=jnp.float32)
+    B, S = 2, 8
+    tokens = jnp.asarray(
+        np.random.default_rng(2).integers(0, cfg.vocab_size, (B, S)),
+        jnp.int32)
+    lengths = jnp.asarray([S, S - 3], jnp.int32)
+
+    cache = init_cache(cfg, B, S, dtype=jnp.float32)
+    ref, _ = transformer.prefill(params, cfg, tokens, lengths, cache)
+    # sanity: the window must actually bind (global-only result differs)
+    cfg_g = cfg.replace(attn_windows=None)
+    params_g = dict(params, layers={
+        k: v for k, v in params["layers"].items() if k != "attn_window"})
+    glob, _ = transformer.prefill(params_g, cfg_g, tokens, lengths,
+                                  init_cache(cfg_g, B, S,
+                                             dtype=jnp.float32))
+    assert not np.allclose(np.asarray(ref)[0, :8], np.asarray(glob)[0, :8],
+                           atol=1e-5)
+
+    mesh = create_mesh(spec)
+    with mesh:
+        pparams = shd.shard_params(params, mesh, cfg, spec)
+        pcache = jax.device_put(init_cache(cfg, B, S, dtype=jnp.float32),
+                                shd.named(mesh, shd.cache_specs(cfg, spec)))
+        got, _ = jax.jit(lambda p, t, l, c: pipeline.pipelined_prefill(
+            p, cfg, t, l, c, mesh=mesh, n_micro=2)
+        )(pparams, tokens, lengths, pcache)
+
+    pos = np.arange(S)[None, :]
+    valid = (pos < np.asarray(lengths)[:, None])[..., None]
+    np.testing.assert_allclose(np.where(valid, np.asarray(got), 0),
+                               np.where(valid, np.asarray(ref), 0),
+                               atol=2e-4, rtol=2e-4)
