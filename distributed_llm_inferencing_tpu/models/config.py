@@ -80,6 +80,25 @@ class ModelConfig:
     # the XLA attention formulation — the pallas flash kernels take
     # static windows only (models/transformer.py).
     attn_windows: Optional[Tuple[Optional[int], ...]] = None
+    # Gemma-2 logit softcapping: scores/logits squashed to
+    # cap * tanh(x / cap). ``attn_softcap`` applies to attention scores
+    # (pre-mask; forces the XLA attention formulation — the flash
+    # kernels' online softmax has no tanh hook); ``logit_softcap`` to
+    # the final vocab logits.
+    attn_softcap: Optional[float] = None
+    logit_softcap: Optional[float] = None
+    # Cohere: tied-head logits multiplied by a constant scale.
+    logit_scale: Optional[float] = None
+    # Gemma-2 block topology: sandwich norms — attention/MLP outputs are
+    # normed BEFORE their residual add (attn_post_norm/mlp_post_norm
+    # leaves), in addition to the usual pre-norms.
+    post_block_norms: bool = False
+    # Gemma-2 query_pre_attn_scalar: HF scales scores by qpas**-0.5
+    # instead of head_dim**-0.5. Conversion absorbs the ratio
+    # sqrt(head_dim / qpas) into the q weights (models/convert.py) so
+    # the runtime score scale stays uniform; like norm_offset, this
+    # field only drives that conversion step.
+    query_pre_attn_scalar: Optional[float] = None
     # Gemma-style sqrt(hidden_size) embedding normalizer, applied to the
     # embedding OUTPUT only (the tied head reads the raw table).
     embed_scale: Optional[float] = None
@@ -153,6 +172,10 @@ class ModelConfig:
                 f"{self.num_layers} layers")
             assert self.sliding_window is None, (
                 "attn_windows and sliding_window are mutually exclusive")
+        assert not (self.post_block_norms
+                    and (self.parallel_residual or self.post_norm)), (
+            "post_block_norms (sandwich) excludes parallel_residual and "
+            "post_norm topologies")
         assert not (self.parallel_residual and self.post_norm), (
             "parallel_residual and post_norm are mutually exclusive")
         assert not (self.shared_attn_mlp_norm

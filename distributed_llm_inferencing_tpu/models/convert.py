@@ -67,7 +67,7 @@ SUPPORTED_MODEL_TYPES = ("gpt2", "opt", "llama", "mistral", "mixtral",
                          "qwen2", "gemma", "gpt_neox", "phi", "falcon",
                          "bloom", "gptj", "mpt", "gpt_bigcode", "stablelm",
                          "codegen", "starcoder2", "olmo", "phi3",
-                         "gpt_neo")
+                         "gpt_neo", "gemma2", "cohere")
 
 
 def config_from_hf(hf_config) -> ModelConfig:
@@ -537,6 +537,88 @@ def config_from_hf(hf_config) -> ModelConfig:
             attn_windows=None if uniform else wins,
             tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
                                         True))
+    if mt == "gemma2":
+        # Gemma-2: gemma's rmsnorm/(1+w)/embed-scale conventions plus
+        # FOUR norms per block (sandwich, post_block_norms), attention +
+        # final logit softcapping, query_pre_attn_scalar replacing the
+        # 1/sqrt(hd) score scale (the ratio folds into q at conversion),
+        # and alternating sliding/full layers (attn_windows).
+        heads = hf_config.num_attention_heads
+        kinds = list(getattr(hf_config, "layer_types", None)
+                     or ["sliding_attention" if i % 2 == 0
+                         else "full_attention"
+                         for i in range(hf_config.num_hidden_layers)])
+        if not all(t in ("sliding_attention", "full_attention")
+                   for t in kinds):
+            raise NotImplementedError(
+                f"gemma2 layer_types {sorted(set(kinds))!r}")
+        win = getattr(hf_config, "sliding_window", None)
+        wins = tuple(win if t == "sliding_attention" else None
+                     for t in kinds)
+        uniform2 = win is None or len(set(wins)) == 1
+        return ModelConfig(
+            name=getattr(hf_config, "name_or_path", mt) or mt,
+            family="gemma2", vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            intermediate_size=hf_config.intermediate_size,
+            num_layers=hf_config.num_hidden_layers, num_heads=heads,
+            num_kv_heads=getattr(hf_config, "num_key_value_heads", None)
+            or heads,
+            head_dim=getattr(hf_config, "head_dim", None)
+            or hf_config.hidden_size // heads,
+            max_position_embeddings=hf_config.max_position_embeddings,
+            norm_type="rmsnorm", norm_eps=hf_config.rms_norm_eps,
+            activation=_act_from_hf(getattr(hf_config, "hidden_activation",
+                                            "gelu_pytorch_tanh")),
+            gated_mlp=True, position_embedding="rope",
+            rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+            attn_bias=getattr(hf_config, "attention_bias", False),
+            mlp_bias=False,
+            sliding_window=(wins[0] if uniform2 else None),
+            attn_windows=None if uniform2 else wins,
+            attn_softcap=getattr(hf_config, "attn_logit_softcapping",
+                                 None),
+            logit_softcap=getattr(hf_config, "final_logit_softcapping",
+                                  None),
+            post_block_norms=True,
+            query_pre_attn_scalar=float(
+                getattr(hf_config, "query_pre_attn_scalar", None)
+                or (getattr(hf_config, "head_dim", None)
+                    or hf_config.hidden_size // heads)),
+            embed_scale=hf_config.hidden_size ** 0.5,
+            norm_offset=True,
+            tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
+                                        True))
+    if mt == "cohere":
+        # Cohere (Command-R): parallel residual with ONE shared bias-free
+        # layernorm, INTERLEAVED full rotary, tied head with a constant
+        # logit scale.
+        if getattr(hf_config, "use_qk_norm", False):
+            raise NotImplementedError("cohere with use_qk_norm")
+        heads = hf_config.num_attention_heads
+        return ModelConfig(
+            name=getattr(hf_config, "name_or_path", mt) or mt,
+            family="cohere", vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            intermediate_size=hf_config.intermediate_size,
+            num_layers=hf_config.num_hidden_layers, num_heads=heads,
+            num_kv_heads=getattr(hf_config, "num_key_value_heads", None)
+            or heads,
+            head_dim=hf_config.hidden_size // heads,
+            max_position_embeddings=hf_config.max_position_embeddings,
+            norm_type="layernorm",
+            norm_eps=getattr(hf_config, "layer_norm_eps", 1e-5),
+            activation=_act_from_hf(getattr(hf_config, "hidden_act",
+                                            "silu")),
+            gated_mlp=True, position_embedding="rope",
+            rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+            rope_interleaved=True,
+            attn_bias=getattr(hf_config, "attention_bias", False),
+            mlp_bias=False,
+            logit_scale=getattr(hf_config, "logit_scale", None),
+            tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
+                                        True),
+            parallel_residual=True, shared_attn_mlp_norm=True)
     raise NotImplementedError(
         f"unsupported HF model_type {mt!r}; supported: "
         f"{', '.join(SUPPORTED_MODEL_TYPES)}")
@@ -1156,6 +1238,81 @@ def convert_state_dict(cfg: ModelConfig, sd, dtype=None):
             "layers": _stack([layer(i) for i in range(cfg.num_layers)]),
             "final_norm": {"scale": get("transformer.ln_f.weight"),
                            "bias": get("transformer.ln_f.bias")},
+        }
+        if not cfg.tie_word_embeddings:
+            params["lm_head"] = {"w": get("lm_head.weight").T}
+    elif fam == "gemma2":
+        # (1 + w) rmsnorm convention absorbed on ALL five norm kinds;
+        # query_pre_attn_scalar**-0.5 replaces attend's 1/sqrt(hd) score
+        # scale, so fold the ratio sqrt(hd / qpas) into q here — exact,
+        # the scalar commutes with the projection (q_proj is bias-free).
+        hd = cfg.head_dim
+        qs = (hd / (cfg.query_pre_attn_scalar or hd)) ** 0.5
+
+        def layer(i):
+            p = f"model.layers.{i}."
+
+            def nrm(n):
+                return {"scale": get(p + n + ".weight") + 1.0}
+
+            def lin(n, scale=1.0):
+                out = {"w": get(p + n + ".weight").T * scale}
+                if p + n + ".bias" in sd:   # attention_bias variants —
+                    # the q fold scales bias with weight (commutes)
+                    out["b"] = get(p + n + ".bias") * scale
+                return out
+            return {
+                "attn_norm": nrm("input_layernorm"),
+                "q": lin("self_attn.q_proj", qs),
+                "k": lin("self_attn.k_proj"),
+                "v": lin("self_attn.v_proj"),
+                "o": lin("self_attn.o_proj"),
+                "attn_post_norm": nrm("post_attention_layernorm"),
+                "mlp_norm": nrm("pre_feedforward_layernorm"),
+                "gate": lin("mlp.gate_proj"),
+                "up": lin("mlp.up_proj"),
+                "down": lin("mlp.down_proj"),
+                "mlp_post_norm": nrm("post_feedforward_layernorm"),
+            }
+        params = {
+            "embed": {"tokens": get("model.embed_tokens.weight")},
+            "layers": _stack([layer(i) for i in range(cfg.num_layers)]),
+            "final_norm": {"scale": get("model.norm.weight") + 1.0},
+        }
+        if not cfg.tie_word_embeddings:
+            params["lm_head"] = {"w": get("lm_head.weight").T}
+        if cfg.attn_windows is not None:
+            params["layers"]["attn_window"] = np.asarray(
+                [-1 if w is None else w for w in cfg.attn_windows],
+                np.int32)
+    elif fam == "cohere":
+        # CohereLayerNorm has no bias — zero bias is its exact parametric
+        # equivalent under our layer_norm.
+        zb = np.zeros((D,), np.float32)
+
+        def layer(i):
+            p = f"model.layers.{i}."
+
+            def lin(n):
+                out = {"w": get(p + n + ".weight").T}
+                if p + n + ".bias" in sd:   # attention_bias variants
+                    out["b"] = get(p + n + ".bias")
+                return out
+            return {
+                "attn_norm": {"scale": get(p + "input_layernorm.weight"),
+                              "bias": zb},
+                "q": lin("self_attn.q_proj"),
+                "k": lin("self_attn.k_proj"),
+                "v": lin("self_attn.v_proj"),
+                "o": lin("self_attn.o_proj"),
+                "gate": lin("mlp.gate_proj"),
+                "up": lin("mlp.up_proj"),
+                "down": lin("mlp.down_proj"),
+            }
+        params = {
+            "embed": {"tokens": get("model.embed_tokens.weight")},
+            "layers": _stack([layer(i) for i in range(cfg.num_layers)]),
+            "final_norm": {"scale": get("model.norm.weight"), "bias": zb},
         }
         if not cfg.tie_word_embeddings:
             params["lm_head"] = {"w": get("lm_head.weight").T}

@@ -78,6 +78,9 @@ def init_params(cfg: ModelConfig, key, dtype=None):
         "v": lin(D, cfg.kv_dim, cfg.attn_bias),
         "o": lin(cfg.q_dim, D, cfg.o_bias_effective),
     }
+    if cfg.post_block_norms:   # gemma2 sandwich norms
+        layers["attn_post_norm"] = norm_p()
+        layers["mlp_post_norm"] = norm_p()
     if cfg.attn_windows is not None:
         # per-layer window leaf ([L] int32, -1 == global) — rides the
         # layer scan/unroll/pipeline machinery (transformer._layer_window)

@@ -233,11 +233,13 @@ def _alibi(cfg: ModelConfig):
 
 def _cfg_backend(cfg: ModelConfig, n_devices: int, op: str = "dense"):
     """resolve_backend, then force the XLA formulation for per-layer
-    windows: the pallas flash/paged kernels take static windows only,
+    windows (the pallas flash/paged kernels take static windows only,
     while the traced ``attn_window`` scalar flows through the XLA masks
-    unchanged (ops/attention.py attend)."""
+    unchanged) and for attention softcapping (the kernels' online
+    softmax has no tanh hook)."""
     b = resolve_backend(cfg.attn_backend, n_devices, op=op)
-    if cfg.attn_windows is not None and b.startswith("pallas"):
+    if b.startswith("pallas") and (cfg.attn_windows is not None
+                                   or cfg.attn_softcap is not None):
         return "xla"
     return b
 
@@ -313,7 +315,8 @@ def unembed(params, cfg: ModelConfig, x):
             logits = (cpu_gemv.qgemv_i8(x2, table["q8"], table["rscale"])
                       if isinstance(table, dict)
                       else cpu_gemv.gemv_w(x2, table))
-            return logits.reshape(b, s, -1).astype(jnp.float32)
+            return _head_post(logits.reshape(b, s, -1), cfg
+                              ).astype(jnp.float32)
         if isinstance(table, dict):   # int8 table (cfg.embed_quant)
             logits = jnp.einsum("bsd,vd->bsv", x,
                                 table["q8"].astype(x.dtype))
@@ -322,7 +325,18 @@ def unembed(params, cfg: ModelConfig, x):
             logits = jnp.einsum("bsd,vd->bsv", x, table.astype(x.dtype))
     else:
         logits = _linear(x, params["lm_head"])
-    return logits.astype(jnp.float32)
+    return _head_post(logits, cfg).astype(jnp.float32)
+
+
+def _head_post(logits, cfg: ModelConfig):
+    """Head post-processing: Cohere's constant logit scale and Gemma-2's
+    final softcap, applied wherever logits leave the model (incl. the
+    CPU FFI fast path, which returns early)."""
+    if cfg.logit_scale is not None:
+        logits = logits * cfg.logit_scale
+    if cfg.logit_softcap is not None:
+        logits = jnp.tanh(logits / cfg.logit_softcap) * cfg.logit_softcap
+    return logits
 
 
 def _block_body(x, lp, cfg: ModelConfig, q_positions, attend_write):
@@ -357,6 +371,8 @@ def _block_body(x, lp, cfg: ModelConfig, q_positions, attend_write):
     attn, cache_out = attend_write(q, k, v)
     attn = _linear(attn.reshape(B, s, cfg.num_heads * cfg.head_dim), lp["o"],
                    row_sharded=cfg.tp_row_sharded)
+    if cfg.post_block_norms:   # gemma2 sandwich: norm BEFORE the residual
+        attn = norm(attn, lp["attn_post_norm"], cfg.norm_type, cfg.norm_eps)
 
     if cfg.parallel_residual:
         h2 = h if cfg.shared_attn_mlp_norm else norm(
@@ -371,6 +387,9 @@ def _block_body(x, lp, cfg: ModelConfig, q_positions, attend_write):
     h = x if cfg.post_norm else norm(x, lp["mlp_norm"], cfg.norm_type,
                                      cfg.norm_eps)
     moe_out = _moe(h, lp, cfg) if cfg.is_moe else _mlp(h, lp, cfg)
+    if cfg.post_block_norms:
+        moe_out = norm(moe_out, lp["mlp_post_norm"], cfg.norm_type,
+                       cfg.norm_eps)
     x = x + moe_out
     if cfg.post_norm:
         x = norm(x, lp["mlp_norm"], cfg.norm_type, cfg.norm_eps)
@@ -421,10 +440,10 @@ def _block(x, lp, cache_k, cache_v, *, cfg: ModelConfig, q_positions,
                 ring_attend_prefill)
             attn = ring_attend_prefill(
                 q, k, v, q_positions, new_lengths, mesh=mesh,
-                sliding_window=_layer_window(cfg, lp), alibi=_alibi(cfg))
+                sliding_window=_layer_window(cfg, lp), alibi=_alibi(cfg), softcap=cfg.attn_softcap)
         elif is_prefill:
             attn = attend_prefill(q, k, v, sliding_window=_layer_window(cfg, lp),
-                                  backend=backend, alibi=_alibi(cfg))
+                                  backend=backend, alibi=_alibi(cfg), softcap=cfg.attn_softcap)
         elif mesh is not None and mesh.shape.get("sp", 1) > 1:
             # sp-sharded cache decode: flash-decoding partials per shard +
             # one combine (parallel/ring.py ring_attend_decode) — replaces
@@ -434,7 +453,7 @@ def _block(x, lp, cache_k, cache_v, *, cfg: ModelConfig, q_positions,
             attn = ring_attend_decode(q, ck_at, cv_at, new_lengths,
                                       mesh=mesh,
                                       sliding_window=_layer_window(cfg, lp),
-                                      alibi=_alibi(cfg))
+                                      alibi=_alibi(cfg), softcap=cfg.attn_softcap)
         else:
             # quantized caches pin the xla formulation: the dequant fuses
             # into its matmul, while a pallas kernel input would
@@ -442,7 +461,7 @@ def _block(x, lp, cache_k, cache_v, *, cfg: ModelConfig, q_positions,
             attn = attend_decode(q, ck_at, cv_at, new_lengths,
                                  sliding_window=_layer_window(cfg, lp),
                                  backend="xla" if quantized else backend,
-                                 q_positions=q_positions, alibi=_alibi(cfg))
+                                 q_positions=q_positions, alibi=_alibi(cfg), softcap=cfg.attn_softcap)
         return attn, cache_out
 
     x, cache_out = _block_body(x, lp, cfg, q_positions, attend_write)
@@ -593,14 +612,14 @@ def paged_decode_step(params, cfg: ModelConfig, tokens, paged,
                     q, nk, nv, block_tables, context_lens + 1,
                     sliding_window=_layer_window(cfg, lp), backend=backend,
                     k_scale_layer=nks, v_scale_layer=nvs,
-                    alibi=_alibi(cfg))
+                    alibi=_alibi(cfg), softcap=cfg.attn_softcap)
                 return attn, (nk, nv, nks, nvs)
             nk = write_token(ck, k[:, 0], block_tables, context_lens)
             nv = write_token(cv, v[:, 0], block_tables, context_lens)
             attn = paged_attend_decode(
                 q, nk, nv, block_tables, context_lens + 1,
                 sliding_window=_layer_window(cfg, lp), backend=backend,
-                alibi=_alibi(cfg))
+                alibi=_alibi(cfg), softcap=cfg.attn_softcap)
             return attn, (nk, nv)
 
         return _block_body(x, lp, cfg, q_pos, attend_write)
@@ -657,8 +676,7 @@ def paged_decode_chunk(params, cfg: ModelConfig, k: int, tokens, paged,
     far. Returns (toks [K, R] int32, emits [K, R] bool, new paged); the
     emitted tokens of slot r are ``toks[:emits[:, r].sum(), r]``.
     """
-    from distributed_llm_inferencing_tpu.ops.attention import (
-        attend, resolve_backend)
+    from distributed_llm_inferencing_tpu.ops.attention import attend
     from distributed_llm_inferencing_tpu.ops.paged_kvcache import (
         PagedKVCache, gather_seq)
     from distributed_llm_inferencing_tpu.ops.sampling import sample_batch
@@ -744,7 +762,7 @@ def paged_decode_chunk(params, cfg: ModelConfig, k: int, tokens, paged,
                     q_pos,
                     jnp.concatenate([pool_pos, side_pos], axis=1),
                     jnp.concatenate([pool_valid, side_valid], axis=1),
-                    sliding_window=_layer_window(cfg, lp), alibi=_alibi(cfg))
+                    sliding_window=_layer_window(cfg, lp), alibi=_alibi(cfg), softcap=cfg.attn_softcap)
                 return attn, (sk2, sv2)
 
             x, (sk2, sv2) = _block_body(x, lp, cfg, q_pos, attend_write)
@@ -953,7 +971,7 @@ def paged_speculative_chunk(params, cfg: ModelConfig, k: int, gamma: int,
                     qp,
                     jnp.concatenate([pool_pos, side_pos], axis=1),
                     jnp.concatenate([pool_valid, side_valid], axis=1),
-                    sliding_window=_layer_window(cfg, lp), alibi=_alibi(cfg))
+                    sliding_window=_layer_window(cfg, lp), alibi=_alibi(cfg), softcap=cfg.attn_softcap)
                 return attn, (sk2, sv2)
 
             x, (sk2, sv2) = _block_body(x, lp, cfg, qp, attend_write)
@@ -1099,13 +1117,13 @@ def paged_prefill_tail(params, cfg: ModelConfig, tokens, tail_len,
                     q, k, v, nk, nv, prefix_blocks, prefix_len, q_pos,
                     tail_valid, sliding_window=_layer_window(cfg, lp),
                     k_scale_layer=nks, v_scale_layer=nvs,
-                    alibi=_alibi(cfg))
+                    alibi=_alibi(cfg), softcap=cfg.attn_softcap)
                 return attn, (nk, nv, nks, nvs)
             nk = write_block_run(ck, k, tail_blocks)
             nv = write_block_run(cv, v, tail_blocks)
             attn = paged_attend_prefix(
                 q, k, v, nk, nv, prefix_blocks, prefix_len, q_pos, tail_valid,
-                sliding_window=_layer_window(cfg, lp), alibi=_alibi(cfg))
+                sliding_window=_layer_window(cfg, lp), alibi=_alibi(cfg), softcap=cfg.attn_softcap)
             return attn, (nk, nv)
 
         return _block_body(x, lp, cfg, q_pos, attend_write)

@@ -77,6 +77,7 @@ def attend(
     kv_valid,            # [B, Skv] bool — slot holds a real token
     sliding_window: Optional[int] = None,
     alibi=None,          # [H] f32 slopes — bias slope*(kv_pos - q_pos)
+    softcap: Optional[float] = None,   # gemma2: cap*tanh(scores/cap)
 ):
     """Causal attention over a (possibly cached, possibly padded) KV set.
 
@@ -97,6 +98,8 @@ def attend(
     # [B, H, Sq, Skv]
     logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                         k.astype(jnp.float32)) * scale
+    if softcap is not None:   # pre-mask score squash (HF gemma2 order)
+        logits = jnp.tanh(logits / softcap) * softcap
     if alibi is not None:
         rel = (kv_positions[:, None, :]
                - q_positions[:, :, None]).astype(jnp.float32)  # [B,Sq,Skv]
@@ -141,7 +144,8 @@ def resolve_backend(requested: str = "auto", n_devices: int = 1,
 
 
 def attend_prefill(q, k, v, *, sliding_window: Optional[int] = None,
-                   backend: str = "xla", alibi=None):
+                   backend: str = "xla", alibi=None,
+                   softcap: Optional[float] = None):
     """Causal self-attention over the fresh (uncached) K/V block.
 
     Prefill never needs the cache or a validity mask: causality restricts
@@ -157,12 +161,14 @@ def attend_prefill(q, k, v, *, sliding_window: Optional[int] = None,
     B, S, _, _ = q.shape
     pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
     return attend(q, k, v, pos, pos, jnp.ones((B, S), bool),
-                  sliding_window=sliding_window, alibi=alibi)
+                  sliding_window=sliding_window, alibi=alibi,
+                  softcap=softcap)
 
 
 def attend_decode(q, cache_k, cache_v, lengths, *,
                   sliding_window: Optional[int] = None,
-                  backend: str = "xla", q_positions=None, alibi=None):
+                  backend: str = "xla", q_positions=None, alibi=None,
+                  softcap: Optional[float] = None):
     """Cached attention for decode-regime queries.
 
     Single-token (Sq == 1): ``lengths`` counts filled slots including the
@@ -184,4 +190,5 @@ def attend_decode(q, cache_k, cache_v, lengths, *,
     q_pos = (q_positions if q_positions is not None
              else (lengths - 1)[:, None])
     return attend(q, cache_k, cache_v, q_pos, kv_pos, kv_valid,
-                  sliding_window=sliding_window, alibi=alibi)
+                  sliding_window=sliding_window, alibi=alibi,
+                  softcap=softcap)

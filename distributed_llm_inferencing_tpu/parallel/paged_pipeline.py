@@ -179,7 +179,7 @@ def paged_decode_chunk_pp(params, cfg: ModelConfig, k: int, tokens, paged,
                         jnp.concatenate([pool_pos, side_pos], axis=1),
                         jnp.concatenate([pool_valid, side_valid], axis=1),
                         sliding_window=tf._layer_window(cfg, lp),
-                        alibi=tf._alibi(cfg))
+                        alibi=tf._alibi(cfg), softcap=cfg.attn_softcap)
                     return attn, (sk2, sv2)
 
                 xc, (sk2, sv2) = tf._block_body(xc, lp, cfg, q_pos,
@@ -453,7 +453,7 @@ def paged_speculative_chunk_pp(params, cfg: ModelConfig, k: int, gamma: int,
                         jnp.concatenate([pool_pos, side_pos_m], axis=1),
                         jnp.concatenate([pool_valid, side_valid], axis=1),
                         sliding_window=tf._layer_window(cfg, lp),
-                        alibi=tf._alibi(cfg))
+                        alibi=tf._alibi(cfg), softcap=cfg.attn_softcap)
                     return attn, (sk2, sv2)
 
                 xc, (sk2, sv2) = tf._block_body(xc, lp, cfg, qp,
@@ -679,7 +679,7 @@ def paged_prefill_tail_pp(params, cfg: ModelConfig, tokens, tail_len,
                         q, kh, vh, nk, nv, pb_m, pl_m, qp, tv,
                         sliding_window=tf._layer_window(cfg, lp),
                         k_scale_layer=nks, v_scale_layer=nvs,
-                        alibi=tf._alibi(cfg))
+                        alibi=tf._alibi(cfg), softcap=cfg.attn_softcap)
                     return attn, (nk, nv, nks, nvs)
 
                 def attend_write(q, kh, vh):
@@ -692,7 +692,7 @@ def paged_prefill_tail_pp(params, cfg: ModelConfig, tokens, tail_len,
                     attn = paged_attend_prefix(
                         q, kh, vh, nk, nv, pb_m, pl_m, qp, tv,
                         sliding_window=tf._layer_window(cfg, lp),
-                        alibi=tf._alibi(cfg))
+                        alibi=tf._alibi(cfg), softcap=cfg.attn_softcap)
                     return attn, (nk, nv)
 
                 lp = layer_in[0]

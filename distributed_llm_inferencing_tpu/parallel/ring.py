@@ -61,7 +61,7 @@ def _resolve_mesh(mesh):
 
 
 def _masked_scores(q, k, q_pos, kv_pos, kv_valid, sliding_window,
-                   alibi=None):
+                   alibi=None, softcap=None):
     """[B,H,Sq,Skv] f32 masked scores for one (Q chunk, KV chunk) pair.
     ``alibi``: LOCAL head-shard slopes [H_loc] — positions travel with
     the chunks, so the linear bias is the same arithmetic as the dense
@@ -70,6 +70,8 @@ def _masked_scores(q, k, q_pos, kv_pos, kv_valid, sliding_window,
     scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
     s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
+    if softcap is not None:   # gemma2 score squash, pre-mask
+        s = jnp.tanh(s / softcap) * softcap
     if alibi is not None:
         rel = (kv_pos[:, None, :] - q_pos[:, :, None]).astype(jnp.float32)
         s = s + alibi[None, :, None, None] * rel[:, None, :, :]
@@ -82,7 +84,8 @@ def _masked_scores(q, k, q_pos, kv_pos, kv_valid, sliding_window,
 
 
 def _ring_body(q, k, v, q_pos, kv_pos, kv_valid, alibi=None, *,
-               axis: str, sliding_window: Optional[int]):
+               axis: str, sliding_window: Optional[int],
+               softcap: Optional[float] = None):
     """Per-device ring loop. Shapes are LOCAL chunks:
     q [B,Sq,H,hd], k/v [B,Sk,Hkv,hd], q_pos [B,Sq], kv_pos [B,Sk],
     kv_valid [B,Sk]. Returns [B,Sq,H,hd] in q.dtype.
@@ -101,7 +104,7 @@ def _ring_body(q, k, v, q_pos, kv_pos, kv_valid, alibi=None, *,
         kf = repeat_kv(k, n_rep)
         vf = repeat_kv(v, n_rep)
         s = _masked_scores(q, kf, q_pos, kv_pos, kv_valid,
-                           sliding_window, alibi)
+                           sliding_window, alibi, softcap)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))          # [B,H,Sq]
         alpha = jnp.exp(m - m_new)
         # explicit zero for masked entries: on a fully-masked row
@@ -125,7 +128,8 @@ def _ring_body(q, k, v, q_pos, kv_pos, kv_valid, alibi=None, *,
 
 
 def _decode_body(q, k, v, kv_pos, kv_valid, lengths, alibi=None, *,
-                 axis: str, sliding_window: Optional[int]):
+                 axis: str, sliding_window: Optional[int],
+                 softcap: Optional[float] = None):
     """Per-device partial attention over the LOCAL cache shard + combine.
 
     q [B,1,H,hd] (replicated over sp), k/v [B,Sk,Hkv,hd] (the local S/sp
@@ -137,7 +141,7 @@ def _decode_body(q, k, v, kv_pos, kv_valid, lengths, alibi=None, *,
 
     kf = repeat_kv(k, n_rep)
     s = _masked_scores(q, kf, q_pos, kv_pos, kv_valid, sliding_window,
-                       alibi)
+                       alibi, softcap)
     m_loc = jnp.max(s, axis=-1)                                     # [B,H,1]
     p = jnp.where(s > NEG_INF * 0.5, jnp.exp(s - m_loc[..., None]), 0.0)
     l_loc = jnp.sum(p, axis=-1)                                     # [B,H,1]
@@ -162,6 +166,7 @@ def ring_attend_decode(
     mesh: Mesh,
     sliding_window: Optional[int] = None,
     alibi=None,   # [H] f32 slopes, sharded over tp with the heads
+    softcap: Optional[float] = None,
 ):
     """Single-token attention over the sp-sharded dense cache.
 
@@ -186,7 +191,8 @@ def ring_attend_decode(
     kv_valid = kv_pos < lengths[:, None]
 
     body = functools.partial(_decode_body, axis="sp",
-                             sliding_window=sliding_window)
+                             sliding_window=sliding_window,
+                             softcap=softcap)
     q_spec = P("dp", None, "tp", None)
     kv_spec = P("dp", "sp", kv_tp, None)
     pos_spec = P("dp", "sp")
@@ -213,6 +219,7 @@ def ring_attend_prefill(
     mesh: Mesh,
     sliding_window: Optional[int] = None,
     alibi=None,   # [H] f32 slopes, sharded over tp with the heads
+    softcap: Optional[float] = None,
 ):
     """Sequence-parallel causal prefill attention via shard_map over sp.
 
@@ -238,7 +245,8 @@ def ring_attend_prefill(
     kv_valid = q_positions < lengths[:, None]   # [B, S]
 
     body = functools.partial(_ring_body, axis="sp",
-                             sliding_window=sliding_window)
+                             sliding_window=sliding_window,
+                             softcap=softcap)
     q_spec = P("dp", "sp", "tp", None)
     kv_spec = P("dp", "sp", kv_tp, None)
     pos_spec = P("dp", "sp")

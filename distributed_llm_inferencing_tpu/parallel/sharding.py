@@ -71,6 +71,9 @@ def param_specs(cfg: ModelConfig, spec: MeshSpec,
         "v": lin(P(L, None, kv_tp)),
         "o": lin(P(L, "tp", None)),
     }
+    if cfg.post_block_norms:   # gemma2 sandwich norms
+        layers["attn_post_norm"] = norm_p()
+        layers["mlp_post_norm"] = norm_p()
     if cfg.attn_windows is not None:
         # [L] int32 per-layer window leaf: pp shards the layer axis like
         # every other stacked leaf, so each stage carries its own slice

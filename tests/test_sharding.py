@@ -83,3 +83,23 @@ def test_plan_memory_math():
     assert p["num_devices"] == 4
     # every leaf has a spec entry
     assert "layers.q.w" in p["partition_specs"]
+
+
+def test_gemma2_topology_sharded_equals_unsharded():
+    """Sandwich norms + softcaps + per-layer windows through tp x pp
+    GSPMD: the attn_post_norm/mlp_post_norm leaves and the [L]
+    attn_window leaf shard per param_specs."""
+    cfg = get_config("tiny-llama").replace(
+        dtype="float32", sliding_window=None,
+        attn_windows=(None, 3, None, 3), attn_softcap=50.0,
+        logit_softcap=30.0, post_block_norms=True)
+    spec = MeshSpec(tp=2, pp=2)
+    validate_spec(spec, cfg)
+    params = init_params(cfg, jax.random.PRNGKey(3), dtype=jnp.float32)
+    tokens = jnp.asarray(
+        np.random.default_rng(3).integers(0, cfg.vocab_size, (2, 8)),
+        jnp.int32)
+    ref = _logits(cfg, params, tokens)
+    got = _logits(cfg, params, tokens, mesh=create_mesh(spec),
+                  mesh_spec=spec)
+    np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)
