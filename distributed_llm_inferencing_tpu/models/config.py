@@ -33,7 +33,9 @@ class ModelConfig:
     # Architecture switches
     norm_type: str = "layernorm"  # layernorm | rmsnorm
     norm_eps: float = 1e-5
-    activation: str = "gelu"  # gelu (tanh approx) | gelu_exact | silu | relu
+    # gelu (tanh approx) | gelu_exact | silu | relu | relu2 (squared
+    # ReLU, Nemotron)
+    activation: str = "gelu"
     gated_mlp: bool = False  # llama-style SwiGLU (gate+up) vs plain fc
     # learned | rope | alibi (BLOOM/Falcon-RW: linear attention bias,
     # position-free K/V — the cache layout matches the RoPE families')
@@ -107,6 +109,23 @@ class ModelConfig:
     # stays plain; this flag only drives that conversion step (and
     # random-init's ones() is already the absorbed identity).
     norm_offset: bool = False
+    # Q/K normalization applied to the projected q and k BEFORE RoPE:
+    # None | "rms_head" (RMSNorm over head_dim, per head — Qwen3 /
+    # Qwen3-MoE) | "rms_full" (RMSNorm over the full projection width —
+    # OLMo2) | "ln_head" (bias-free LayerNorm over head_dim — Cohere
+    # use_qk_norm). Adds q_norm/k_norm scale leaves to the layer tree.
+    qk_norm: Optional[str] = None
+    # OLMo2 block topology: NO pre-norms; the attn/mlp norm leaves apply
+    # to the sublayer OUTPUT before its residual add — x + norm(f(x)).
+    # (Distinct from post_norm, which norms after the add, and from
+    # post_block_norms, which sandwiches pre- AND post-norms.)
+    sublayer_postnorm_only: bool = False
+    # Granite residual_multiplier: sublayer outputs scaled by this before
+    # their residual add. (Granite's other multipliers map onto existing
+    # fields: embedding_multiplier -> embed_scale, attention_multiplier
+    # -> query_pre_attn_scalar absorption, 1/logits_scaling ->
+    # logit_scale.)
+    residual_scale: Optional[float] = None
     # OPT-350m specifics (reference's second arch family, shard_model.py:46):
     # token embeds live in a smaller space with linear project_in/out...
     embed_proj_dim: Optional[int] = None
@@ -178,6 +197,13 @@ class ModelConfig:
             "post_norm topologies")
         assert not (self.parallel_residual and self.post_norm), (
             "parallel_residual and post_norm are mutually exclusive")
+        assert not (self.sublayer_postnorm_only
+                    and (self.parallel_residual or self.post_norm
+                         or self.post_block_norms)), (
+            "sublayer_postnorm_only (olmo2) excludes parallel_residual, "
+            "post_norm and post_block_norms topologies")
+        assert self.qk_norm in (None, "rms_head", "rms_full", "ln_head"), (
+            f"unknown qk_norm {self.qk_norm!r}")
         assert not (self.shared_attn_mlp_norm
                     and not self.parallel_residual), (
             "shared_attn_mlp_norm requires parallel_residual")

@@ -54,7 +54,8 @@ def _qwen2_window(hf_config):
 # HF hidden_act -> our activation kinds (models/transformer.py _act).
 # "gelu" is the erf form; gelu_new/gelu_pytorch_tanh are the tanh approx.
 _HF_ACT = {"gelu": "gelu_exact", "gelu_new": "gelu",
-           "gelu_pytorch_tanh": "gelu", "silu": "silu", "relu": "relu"}
+           "gelu_pytorch_tanh": "gelu", "silu": "silu", "relu": "relu",
+           "relu2": "relu2"}
 
 
 def _act_from_hf(name: str) -> str:
@@ -67,7 +68,9 @@ SUPPORTED_MODEL_TYPES = ("gpt2", "opt", "llama", "mistral", "mixtral",
                          "qwen2", "gemma", "gpt_neox", "phi", "falcon",
                          "bloom", "gptj", "mpt", "gpt_bigcode", "stablelm",
                          "codegen", "starcoder2", "olmo", "phi3",
-                         "gpt_neo", "gemma2", "cohere")
+                         "gpt_neo", "gemma2", "cohere", "qwen3",
+                         "qwen3_moe", "granite", "olmo2", "glm", "glm4",
+                         "nemotron")
 
 
 def config_from_hf(hf_config) -> ModelConfig:
@@ -593,8 +596,6 @@ def config_from_hf(hf_config) -> ModelConfig:
         # Cohere (Command-R): parallel residual with ONE shared bias-free
         # layernorm, INTERLEAVED full rotary, tied head with a constant
         # logit scale.
-        if getattr(hf_config, "use_qk_norm", False):
-            raise NotImplementedError("cohere with use_qk_norm")
         heads = hf_config.num_attention_heads
         return ModelConfig(
             name=getattr(hf_config, "name_or_path", mt) or mt,
@@ -616,9 +617,184 @@ def config_from_hf(hf_config) -> ModelConfig:
             attn_bias=getattr(hf_config, "attention_bias", False),
             mlp_bias=False,
             logit_scale=getattr(hf_config, "logit_scale", None),
+            # Command-R+: bias-free per-head layernorm on q/k with
+            # distinct per-head scales
+            qk_norm=("ln_head" if getattr(hf_config, "use_qk_norm", False)
+                     else None),
             tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
                                         True),
             parallel_residual=True, shared_attn_mlp_norm=True)
+    if mt in ("qwen3", "qwen3_moe"):
+        # Qwen3 (+ MoE): llama layer layout plus per-head RMS q/k norms
+        # (ONE [head_dim] scale shared across heads) and an explicit
+        # head_dim decoupled from hidden_size/num_heads. The MoE variant
+        # is mixtral-shaped (softmax -> top-k -> renormalize matches our
+        # router only when norm_topk_prob is set).
+        kinds = list(getattr(hf_config, "layer_types", None) or [])
+        win = getattr(hf_config, "sliding_window", None)
+        wins = tuple(win if t == "sliding_attention" else None
+                     for t in kinds)
+        windowed = win is not None and any(w is not None for w in wins)
+        uniform = not windowed or len(set(wins)) == 1
+        num_experts = 0
+        if mt == "qwen3_moe":
+            num_experts = hf_config.num_experts
+            if not getattr(hf_config, "norm_topk_prob", True):
+                raise NotImplementedError(
+                    "qwen3_moe with norm_topk_prob=False")
+            if list(getattr(hf_config, "mlp_only_layers", []) or []):
+                raise NotImplementedError("qwen3_moe with mlp_only_layers")
+            if getattr(hf_config, "decoder_sparse_step", 1) != 1:
+                raise NotImplementedError(
+                    "qwen3_moe with decoder_sparse_step != 1")
+        return ModelConfig(
+            name=getattr(hf_config, "name_or_path", mt) or mt,
+            family="llama", vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            intermediate_size=(hf_config.moe_intermediate_size
+                               if num_experts
+                               else hf_config.intermediate_size),
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            num_kv_heads=getattr(hf_config, "num_key_value_heads", None)
+            or hf_config.num_attention_heads,
+            head_dim=getattr(hf_config, "head_dim", None)
+            or hf_config.hidden_size // hf_config.num_attention_heads,
+            max_position_embeddings=hf_config.max_position_embeddings,
+            norm_type="rmsnorm", norm_eps=hf_config.rms_norm_eps,
+            activation=_act_from_hf(hf_config.hidden_act),
+            gated_mlp=True, position_embedding="rope",
+            rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+            attn_bias=getattr(hf_config, "attention_bias", False),
+            mlp_bias=False, qk_norm="rms_head",
+            sliding_window=(wins[0] if windowed and uniform else None),
+            attn_windows=None if uniform else wins,
+            num_experts=num_experts,
+            num_experts_per_tok=getattr(hf_config, "num_experts_per_tok",
+                                        2),
+            tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
+                                        False))
+    if mt == "granite":
+        # Granite 3.x: llama layout with four scalar multipliers, all
+        # absorbed into existing mechanisms — embedding_multiplier ->
+        # embed_scale, attention_multiplier -> query_pre_attn_scalar
+        # (HF scales scores by am == qpas**-0.5, so qpas = am**-2; the
+        # ratio folds into the q weights at conversion),
+        # residual_multiplier -> residual_scale, and 1/logits_scaling ->
+        # logit_scale.
+        am = float(getattr(hf_config, "attention_multiplier", 1.0))
+        ls = float(getattr(hf_config, "logits_scaling", 1.0))
+        return ModelConfig(
+            name=getattr(hf_config, "name_or_path", mt) or mt,
+            family="llama", vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            intermediate_size=hf_config.intermediate_size,
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            num_kv_heads=getattr(hf_config, "num_key_value_heads", None)
+            or hf_config.num_attention_heads,
+            head_dim=hf_config.hidden_size
+            // hf_config.num_attention_heads,
+            max_position_embeddings=hf_config.max_position_embeddings,
+            norm_type="rmsnorm", norm_eps=hf_config.rms_norm_eps,
+            activation=_act_from_hf(hf_config.hidden_act),
+            gated_mlp=True, position_embedding="rope",
+            rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+            attn_bias=getattr(hf_config, "attention_bias", False),
+            mlp_bias=getattr(hf_config, "mlp_bias", False),
+            embed_scale=float(getattr(hf_config, "embedding_multiplier",
+                                      1.0)),
+            query_pre_attn_scalar=am ** -2,
+            residual_scale=float(getattr(hf_config,
+                                         "residual_multiplier", 1.0)),
+            logit_scale=1.0 / ls,
+            tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
+                                        False))
+    if mt == "olmo2":
+        # OLMo-2: llama dims, but norms move to the sublayer OUTPUTS
+        # (x + norm(f(x)), no pre-norms) and full-width RMS q/k norms on
+        # the projections.
+        return ModelConfig(
+            name=getattr(hf_config, "name_or_path", mt) or mt,
+            family="olmo2", vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            intermediate_size=hf_config.intermediate_size,
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            num_kv_heads=getattr(hf_config, "num_key_value_heads", None)
+            or hf_config.num_attention_heads,
+            head_dim=hf_config.hidden_size
+            // hf_config.num_attention_heads,
+            max_position_embeddings=hf_config.max_position_embeddings,
+            norm_type="rmsnorm", norm_eps=hf_config.rms_norm_eps,
+            activation=_act_from_hf(hf_config.hidden_act),
+            gated_mlp=True, position_embedding="rope",
+            rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+            attn_bias=getattr(hf_config, "attention_bias", False),
+            mlp_bias=False, qk_norm="rms_full",
+            sublayer_postnorm_only=True,
+            tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
+                                        False))
+    if mt in ("glm", "glm4"):
+        # GLM-4 lineage: llama dims with a fused gate_up MLP (split at
+        # conversion), INTERLEAVED rotary over the first
+        # partial_rotary_factor of head_dim (GPT-J pairing — HF glm's
+        # local rotate_half is the 0::2/1::2 stack), q/k/v bias without
+        # o bias, explicit head_dim. glm4 additionally sandwiches each
+        # sublayer with post norms (post_self_attn/post_mlp_layernorm ->
+        # post_block_norms).
+        return ModelConfig(
+            name=getattr(hf_config, "name_or_path", mt) or mt,
+            family="glm", vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            intermediate_size=hf_config.intermediate_size,
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            num_kv_heads=getattr(hf_config, "num_key_value_heads", None)
+            or hf_config.num_attention_heads,
+            head_dim=getattr(hf_config, "head_dim", None)
+            or hf_config.hidden_size // hf_config.num_attention_heads,
+            max_position_embeddings=hf_config.max_position_embeddings,
+            norm_type="rmsnorm", norm_eps=hf_config.rms_norm_eps,
+            activation=_act_from_hf(hf_config.hidden_act),
+            gated_mlp=True, position_embedding="rope",
+            rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+            rope_pct=float(getattr(hf_config, "partial_rotary_factor",
+                                   0.5)),
+            rope_interleaved=True,
+            attn_bias=bool(getattr(hf_config, "attention_bias", True)),
+            o_bias=False, mlp_bias=False,
+            post_block_norms=(mt == "glm4"),
+            tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
+                                        False))
+    if mt == "nemotron":
+        # Nemotron: ungated squared-ReLU MLP, LayerNorm1P ((1+w) scale,
+        # absorbed at conversion like gemma's rmsnorm offset), partial
+        # non-interleaved rotary, untied head, no biases.
+        return ModelConfig(
+            name=getattr(hf_config, "name_or_path", mt) or mt,
+            family="nemotron", vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            intermediate_size=hf_config.intermediate_size,
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            num_kv_heads=getattr(hf_config, "num_key_value_heads", None)
+            or hf_config.num_attention_heads,
+            head_dim=getattr(hf_config, "head_dim", None)
+            or hf_config.hidden_size // hf_config.num_attention_heads,
+            max_position_embeddings=hf_config.max_position_embeddings,
+            norm_type="layernorm",
+            norm_eps=getattr(hf_config, "norm_eps", 1e-5),
+            activation=_act_from_hf(hf_config.hidden_act),
+            gated_mlp=False, position_embedding="rope",
+            rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+            rope_pct=float(getattr(hf_config, "partial_rotary_factor",
+                                   0.5)),
+            attn_bias=bool(getattr(hf_config, "attention_bias", False)),
+            mlp_bias=bool(getattr(hf_config, "mlp_bias", False)),
+            norm_offset=True,
+            tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
+                                        False))
     raise NotImplementedError(
         f"unsupported HF model_type {mt!r}; supported: "
         f"{', '.join(SUPPORTED_MODEL_TYPES)}")
@@ -712,23 +888,43 @@ def convert_state_dict(cfg: ModelConfig, sd, dtype=None):
         # the offset here so the runtime norm stays plain (config.py
         # norm_offset)
         off = 1.0 if cfg.norm_offset else 0.0
+        # granite: attention_multiplier replaces the 1/sqrt(hd) score
+        # scale via query_pre_attn_scalar — fold the ratio into q (same
+        # absorption as the gemma2 branch)
+        qs = (cfg.head_dim / (cfg.query_pre_attn_scalar
+                              or cfg.head_dim)) ** 0.5
 
         def layer(i):
             p = f"model.layers.{i}."
-            def lin(n):
-                out = {"w": get(p + n + ".weight").T}
+            def lin(n, scale=1.0):
+                out = {"w": get(p + n + ".weight").T * scale}
                 if p + n + ".bias" in sd:  # attention_bias / mlp_bias variants
-                    out["b"] = get(p + n + ".bias")
+                    out["b"] = get(p + n + ".bias") * scale
                 return out
             lp = {
                 "attn_norm": {"scale": get(p + "input_layernorm.weight") + off},
-                "q": lin("self_attn.q_proj"),
+                # under qk_norm the q RMS-normalize erases any weight
+                # scale, so the qs fold moves to the q_norm scale below
+                "q": lin("self_attn.q_proj", 1.0 if cfg.qk_norm else qs),
                 "k": lin("self_attn.k_proj"),
                 "v": lin("self_attn.v_proj"),
                 "o": lin("self_attn.o_proj"),
                 "mlp_norm": {"scale": get(p + "post_attention_layernorm.weight") + off},
             }
-            if cfg.is_moe:
+            if cfg.qk_norm:   # qwen3: shared [head_dim] rms scales
+                lp["q_norm"] = {"scale": get(p + "self_attn.q_norm.weight")
+                                * qs}
+                lp["k_norm"] = {"scale": get(p + "self_attn.k_norm.weight")}
+            if cfg.is_moe and (p + "mlp.gate.weight") in sd:
+                # qwen3_moe naming: mlp.gate + mlp.experts.N.{gate,up,down}_proj
+                lp["router"] = {"w": get(p + "mlp.gate.weight").T}
+                ex = [f"mlp.experts.{e}." for e in range(cfg.num_experts)]
+                lp["experts"] = {
+                    "gate": {"w": np.stack([get(p + e + "gate_proj.weight").T for e in ex])},
+                    "up": {"w": np.stack([get(p + e + "up_proj.weight").T for e in ex])},
+                    "down": {"w": np.stack([get(p + e + "down_proj.weight").T for e in ex])},
+                }
+            elif cfg.is_moe:
                 lp["router"] = {"w": get(p + "block_sparse_moe.gate.weight").T}
                 ex = [f"block_sparse_moe.experts.{e}." for e in range(cfg.num_experts)]
                 lp["experts"] = {
@@ -1298,7 +1494,7 @@ def convert_state_dict(cfg: ModelConfig, sd, dtype=None):
                 if p + n + ".bias" in sd:   # attention_bias variants
                     out["b"] = get(p + n + ".bias")
                 return out
-            return {
+            lp = {
                 "attn_norm": {"scale": get(p + "input_layernorm.weight"),
                               "bias": zb},
                 "q": lin("self_attn.q_proj"),
@@ -1309,10 +1505,124 @@ def convert_state_dict(cfg: ModelConfig, sd, dtype=None):
                 "up": lin("mlp.up_proj"),
                 "down": lin("mlp.down_proj"),
             }
+            if cfg.qk_norm:   # use_qk_norm: [H, hd] per-head scales,
+                # stored flat (params.py layers["q_norm"])
+                lp["q_norm"] = {"scale": get(
+                    p + "self_attn.q_norm.weight").reshape(-1)}
+                lp["k_norm"] = {"scale": get(
+                    p + "self_attn.k_norm.weight").reshape(-1)}
+            return lp
         params = {
             "embed": {"tokens": get("model.embed_tokens.weight")},
             "layers": _stack([layer(i) for i in range(cfg.num_layers)]),
             "final_norm": {"scale": get("model.norm.weight"), "bias": zb},
+        }
+        if not cfg.tie_word_embeddings:
+            params["lm_head"] = {"w": get("lm_head.weight").T}
+    elif fam == "olmo2":
+        # llama tensor names for the projections, but the two block
+        # norms are the post-sublayer norms (sublayer_postnorm_only) and
+        # q/k carry full-projection-width rms norms.
+        def layer(i):
+            p = f"model.layers.{i}."
+
+            def lin(n):
+                out = {"w": get(p + n + ".weight").T}
+                if p + n + ".bias" in sd:
+                    out["b"] = get(p + n + ".bias")
+                return out
+            return {
+                "attn_norm": {
+                    "scale": get(p + "post_attention_layernorm.weight")},
+                "mlp_norm": {
+                    "scale": get(p + "post_feedforward_layernorm.weight")},
+                "q": lin("self_attn.q_proj"),
+                "k": lin("self_attn.k_proj"),
+                "v": lin("self_attn.v_proj"),
+                "o": lin("self_attn.o_proj"),
+                "q_norm": {"scale": get(p + "self_attn.q_norm.weight")},
+                "k_norm": {"scale": get(p + "self_attn.k_norm.weight")},
+                "gate": lin("mlp.gate_proj"),
+                "up": lin("mlp.up_proj"),
+                "down": lin("mlp.down_proj"),
+            }
+        params = {
+            "embed": {"tokens": get("model.embed_tokens.weight")},
+            "layers": _stack([layer(i) for i in range(cfg.num_layers)]),
+            "final_norm": {"scale": get("model.norm.weight")},
+        }
+        if not cfg.tie_word_embeddings:
+            params["lm_head"] = {"w": get("lm_head.weight").T}
+    elif fam == "glm":
+        # Fused gate_up like phi3 ([gate|up, D], split here); glm4's two
+        # extra per-block norms map onto the gemma2 sandwich leaves.
+        I = cfg.intermediate_size
+
+        def layer(i):
+            p = f"model.layers.{i}."
+
+            def lin(n):
+                out = {"w": get(p + n + ".weight").T}
+                if p + n + ".bias" in sd:   # q/k/v bias, o bias-free
+                    out["b"] = get(p + n + ".bias")
+                return out
+            wgu = get(p + "mlp.gate_up_proj.weight")        # [gate|up, D]
+            lp = {
+                "attn_norm": {"scale": get(p + "input_layernorm.weight")},
+                "q": lin("self_attn.q_proj"),
+                "k": lin("self_attn.k_proj"),
+                "v": lin("self_attn.v_proj"),
+                "o": lin("self_attn.o_proj"),
+                "mlp_norm": {
+                    "scale": get(p + "post_attention_layernorm.weight")},
+                "gate": {"w": wgu[:I].T},
+                "up": {"w": wgu[I:].T},
+                "down": {"w": get(p + "mlp.down_proj.weight").T},
+            }
+            if cfg.post_block_norms:   # glm4
+                lp["attn_post_norm"] = {
+                    "scale": get(p + "post_self_attn_layernorm.weight")}
+                lp["mlp_post_norm"] = {
+                    "scale": get(p + "post_mlp_layernorm.weight")}
+            return lp
+        params = {
+            "embed": {"tokens": get("model.embed_tokens.weight")},
+            "layers": _stack([layer(i) for i in range(cfg.num_layers)]),
+            "final_norm": {"scale": get("model.norm.weight")},
+        }
+        if not cfg.tie_word_embeddings:
+            params["lm_head"] = {"w": get("lm_head.weight").T}
+    elif fam == "nemotron":
+        # LayerNorm1P: (1 + w) * x̂ + b — absorb the +1 into the stored
+        # scale (norm_offset), biases kept as-is.
+        def layer(i):
+            p = f"model.layers.{i}."
+
+            def lin(n):
+                out = {"w": get(p + n + ".weight").T}
+                if p + n + ".bias" in sd:
+                    out["b"] = get(p + n + ".bias")
+                return out
+            return {
+                "attn_norm": {
+                    "scale": get(p + "input_layernorm.weight") + 1.0,
+                    "bias": get(p + "input_layernorm.bias")},
+                "q": lin("self_attn.q_proj"),
+                "k": lin("self_attn.k_proj"),
+                "v": lin("self_attn.v_proj"),
+                "o": lin("self_attn.o_proj"),
+                "mlp_norm": {
+                    "scale": get(p + "post_attention_layernorm.weight")
+                    + 1.0,
+                    "bias": get(p + "post_attention_layernorm.bias")},
+                "up": lin("mlp.up_proj"),
+                "down": lin("mlp.down_proj"),
+            }
+        params = {
+            "embed": {"tokens": get("model.embed_tokens.weight")},
+            "layers": _stack([layer(i) for i in range(cfg.num_layers)]),
+            "final_norm": {"scale": get("model.norm.weight") + 1.0,
+                           "bias": get("model.norm.bias")},
         }
         if not cfg.tie_word_embeddings:
             params["lm_head"] = {"w": get("lm_head.weight").T}

@@ -81,6 +81,16 @@ def init_params(cfg: ModelConfig, key, dtype=None):
     if cfg.post_block_norms:   # gemma2 sandwich norms
         layers["attn_post_norm"] = norm_p()
         layers["mlp_post_norm"] = norm_p()
+    if cfg.qk_norm:   # qwen3/olmo2/cohere q/k normalization (bias-free)
+        # rms_head: ONE [hd] scale shared by every head (qwen3);
+        # rms_full/ln_head: full projection width (olmo2 normalizes the
+        # flat projection; cohere's ln is per-head but carries DISTINCT
+        # per-head scales, stored flat [H*hd] here)
+        shared = cfg.qk_norm == "rms_head"
+        layers["q_norm"] = {"scale": ones(
+            (L, cfg.head_dim if shared else cfg.q_dim))}
+        layers["k_norm"] = {"scale": ones(
+            (L, cfg.head_dim if shared else cfg.kv_dim))}
     if cfg.attn_windows is not None:
         # per-layer window leaf ([L] int32, -1 == global) — rides the
         # layer scan/unroll/pipeline machinery (transformer._layer_window)

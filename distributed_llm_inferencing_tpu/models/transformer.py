@@ -47,7 +47,8 @@ from distributed_llm_inferencing_tpu.models.config import ModelConfig
 from distributed_llm_inferencing_tpu.ops.attention import (
     attend_decode, attend_prefill, resolve_backend)
 from distributed_llm_inferencing_tpu.ops.kvcache import KVCache, write_block
-from distributed_llm_inferencing_tpu.ops.norms import norm
+from distributed_llm_inferencing_tpu.ops.norms import (layer_norm, norm,
+                                                       rms_norm)
 from distributed_llm_inferencing_tpu.ops.rope import apply_rope
 
 
@@ -113,6 +114,8 @@ def _act(x, kind: str):
         return jax.nn.silu(x)
     if kind == "relu":
         return jax.nn.relu(x)
+    if kind == "relu2":   # squared ReLU (nemotron)
+        return jnp.square(jax.nn.relu(x))
     if kind == "gelu_exact":   # HF "gelu" (erf form): gpt-neox, falcon
         return jax.nn.gelu(x, approximate=False)
     return jax.nn.gelu(x, approximate=True)  # gpt2 uses gelu_new
@@ -339,6 +342,26 @@ def _head_post(logits, cfg: ModelConfig):
     return logits
 
 
+def _qk_normalize(t, p, cfg: ModelConfig):
+    """cfg.qk_norm on projected q or k [B,s,H,hd], pre-RoPE.
+
+    "rms_head"/"ln_head" normalize each head over head_dim (qwen3 /
+    cohere use_qk_norm); "rms_full" normalizes the flattened projection
+    width (olmo2 applies the norm to the [.., H*hd] projection output
+    before the head reshape)."""
+    kind = cfg.qk_norm
+    if kind == "rms_full":
+        B, s, H, hd = t.shape
+        return rms_norm(t.reshape(B, s, H * hd), p["scale"],
+                        cfg.norm_eps).reshape(B, s, H, hd)
+    if kind == "ln_head":   # cohere: bias-free layernorm per head, with
+        # DISTINCT per-head scales (stored flat [H*hd])
+        H, hd = t.shape[-2:]
+        return layer_norm(t, p["scale"].reshape(H, hd),
+                          jnp.zeros((), t.dtype), cfg.norm_eps)
+    return rms_norm(t, p["scale"], cfg.norm_eps)
+
+
 def _block_body(x, lp, cfg: ModelConfig, q_positions, attend_write):
     """One transformer block: norm → QKV (+RoPE) → attend → norm → MLP/MoE.
 
@@ -356,11 +379,15 @@ def _block_body(x, lp, cfg: ModelConfig, q_positions, attend_write):
     into one (Phi / Falcon-7B).
     """
     B, s, _ = x.shape
-    h = x if cfg.post_norm else norm(x, lp["attn_norm"], cfg.norm_type,
-                                     cfg.norm_eps)
+    h = x if (cfg.post_norm or cfg.sublayer_postnorm_only) else norm(
+        x, lp["attn_norm"], cfg.norm_type, cfg.norm_eps)
     q = _linear(h, lp["q"]).reshape(B, s, cfg.num_heads, cfg.head_dim)
     k = _linear(h, lp["k"]).reshape(B, s, cfg.num_kv_heads, cfg.head_dim)
     v = _linear(h, lp["v"]).reshape(B, s, cfg.num_kv_heads, cfg.head_dim)
+
+    if cfg.qk_norm:
+        q = _qk_normalize(q, lp["q_norm"], cfg)
+        k = _qk_normalize(k, lp["k_norm"], cfg)
 
     if cfg.position_embedding == "rope":
         q = apply_rope(q, q_positions, cfg.rope_theta, cfg.rope_pct,
@@ -373,23 +400,33 @@ def _block_body(x, lp, cfg: ModelConfig, q_positions, attend_write):
                    row_sharded=cfg.tp_row_sharded)
     if cfg.post_block_norms:   # gemma2 sandwich: norm BEFORE the residual
         attn = norm(attn, lp["attn_post_norm"], cfg.norm_type, cfg.norm_eps)
+    elif cfg.sublayer_postnorm_only:   # olmo2: x + norm(attn(x))
+        attn = norm(attn, lp["attn_norm"], cfg.norm_type, cfg.norm_eps)
+    if cfg.residual_scale is not None:   # granite residual_multiplier
+        attn = attn * cfg.residual_scale
 
     if cfg.parallel_residual:
         h2 = h if cfg.shared_attn_mlp_norm else norm(
             x, lp["mlp_norm"], cfg.norm_type, cfg.norm_eps)
         mlp_out = _moe(h2, lp, cfg) if cfg.is_moe else _mlp(h2, lp, cfg)
+        if cfg.residual_scale is not None:
+            mlp_out = mlp_out * cfg.residual_scale
         return x + attn + mlp_out, cache_out
 
     x = x + attn
     if cfg.post_norm:
         x = norm(x, lp["attn_norm"], cfg.norm_type, cfg.norm_eps)
 
-    h = x if cfg.post_norm else norm(x, lp["mlp_norm"], cfg.norm_type,
-                                     cfg.norm_eps)
+    h = x if (cfg.post_norm or cfg.sublayer_postnorm_only) else norm(
+        x, lp["mlp_norm"], cfg.norm_type, cfg.norm_eps)
     moe_out = _moe(h, lp, cfg) if cfg.is_moe else _mlp(h, lp, cfg)
     if cfg.post_block_norms:
         moe_out = norm(moe_out, lp["mlp_post_norm"], cfg.norm_type,
                        cfg.norm_eps)
+    elif cfg.sublayer_postnorm_only:
+        moe_out = norm(moe_out, lp["mlp_norm"], cfg.norm_type, cfg.norm_eps)
+    if cfg.residual_scale is not None:
+        moe_out = moe_out * cfg.residual_scale
     x = x + moe_out
     if cfg.post_norm:
         x = norm(x, lp["mlp_norm"], cfg.norm_type, cfg.norm_eps)

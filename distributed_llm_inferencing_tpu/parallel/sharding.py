@@ -74,6 +74,13 @@ def param_specs(cfg: ModelConfig, spec: MeshSpec,
     if cfg.post_block_norms:   # gemma2 sandwich norms
         layers["attn_post_norm"] = norm_p()
         layers["mlp_post_norm"] = norm_p()
+    if cfg.qk_norm:
+        # norm scales replicate (tiny); for the full-width kind the
+        # mean-square reduction spans every tp shard of q/k — GSPMD
+        # inserts the collective, and the shard_map (pp) local views
+        # carry whole heads so their local reduction is already global
+        layers["q_norm"] = {"scale": P(L, None)}
+        layers["k_norm"] = {"scale": P(L, None)}
     if cfg.attn_windows is not None:
         # [L] int32 per-layer window leaf: pp shards the layer axis like
         # every other stacked leaf, so each stage carries its own slice

@@ -944,11 +944,161 @@ def test_cohere_matches_hf():
     _check_model(model, tokens)
 
 
-def test_cohere_qk_norm_rejected():
+def test_cohere_qk_norm_matches_hf():
+    """Command-R+ use_qk_norm: bias-free per-head layernorm on q/k with
+    DISTINCT [H, hd] scales (qk_norm="ln_head")."""
+    import torch
     import transformers
-    import pytest as _pytest
-    cfg = transformers.CohereConfig(
-        vocab_size=64, hidden_size=32, intermediate_size=64,
-        num_hidden_layers=1, num_attention_heads=4, use_qk_norm=True)
-    with _pytest.raises(NotImplementedError, match="qk_norm"):
-        convert.config_from_hf(cfg)
+    torch_cfg = transformers.CohereConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, logit_scale=0.25, use_qk_norm=True,
+        pad_token_id=0, tie_word_embeddings=True)
+    torch.manual_seed(27)
+    model = transformers.CohereForCausalLM(torch_cfg).eval()
+    # random-init layernorm scales are all-ones — perturb them so the
+    # test distinguishes per-head scales from a shared one
+    with torch.no_grad():
+        for lyr in model.model.layers:
+            lyr.self_attn.q_norm.weight.mul_(
+                torch.rand_like(lyr.self_attn.q_norm.weight) + 0.5)
+            lyr.self_attn.k_norm.weight.mul_(
+                torch.rand_like(lyr.self_attn.k_norm.weight) + 0.5)
+    rng = np.random.default_rng(27)
+    tokens = rng.integers(0, 128, size=(2, 10), dtype=np.int64)
+    _check_model(model, tokens)
+
+
+def test_qwen3_matches_hf():
+    """Qwen3: llama layout + per-head RMS q/k norms (shared [head_dim]
+    scale) + head_dim decoupled from hidden//heads."""
+    import torch
+    import transformers
+    torch_cfg = transformers.Qwen3Config(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=64,
+        tie_word_embeddings=False)
+    torch.manual_seed(28)
+    model = transformers.Qwen3ForCausalLM(torch_cfg).eval()
+    with torch.no_grad():
+        for lyr in model.model.layers:
+            lyr.self_attn.q_norm.weight.mul_(
+                torch.rand_like(lyr.self_attn.q_norm.weight) + 0.5)
+            lyr.self_attn.k_norm.weight.mul_(
+                torch.rand_like(lyr.self_attn.k_norm.weight) + 0.5)
+    rng = np.random.default_rng(28)
+    tokens = rng.integers(0, 128, size=(2, 10), dtype=np.int64)
+    _check_model(model, tokens)
+
+
+def test_qwen3_moe_matches_hf():
+    """Qwen3-MoE: qwen3 attention + mixtral-convention router
+    (softmax -> top-k -> renormalize; norm_topk_prob=True)."""
+    import torch
+    import transformers
+    torch_cfg = transformers.Qwen3MoeConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        moe_intermediate_size=48, num_experts=4, num_experts_per_tok=2,
+        norm_topk_prob=True, num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, head_dim=16, max_position_embeddings=64,
+        mlp_only_layers=[], decoder_sparse_step=1,
+        tie_word_embeddings=False)
+    torch.manual_seed(29)
+    model = transformers.Qwen3MoeForCausalLM(torch_cfg).eval()
+    rng = np.random.default_rng(29)
+    tokens = rng.integers(0, 128, size=(2, 10), dtype=np.int64)
+    _check_model(model, tokens, atol=4e-3)
+
+
+def test_granite_matches_hf():
+    """Granite: llama layout + the four scalar multipliers (embedding,
+    attention, residual, logits_scaling) absorbed into existing fields."""
+    import torch
+    import transformers
+    torch_cfg = transformers.GraniteConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, embedding_multiplier=6.0,
+        attention_multiplier=0.31, residual_multiplier=0.22,
+        logits_scaling=4.0, tie_word_embeddings=False)
+    torch.manual_seed(30)
+    model = transformers.GraniteForCausalLM(torch_cfg).eval()
+    rng = np.random.default_rng(30)
+    tokens = rng.integers(0, 128, size=(2, 10), dtype=np.int64)
+    _check_model(model, tokens)
+
+
+def test_olmo2_matches_hf():
+    """OLMo-2: post-sublayer norms only (x + norm(f(x))) and full-width
+    RMS q/k norms on the projections."""
+    import torch
+    import transformers
+    torch_cfg = transformers.Olmo2Config(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, tie_word_embeddings=False)
+    torch.manual_seed(31)
+    model = transformers.Olmo2ForCausalLM(torch_cfg).eval()
+    with torch.no_grad():
+        for lyr in model.model.layers:
+            lyr.self_attn.q_norm.weight.mul_(
+                torch.rand_like(lyr.self_attn.q_norm.weight) + 0.5)
+            lyr.self_attn.k_norm.weight.mul_(
+                torch.rand_like(lyr.self_attn.k_norm.weight) + 0.5)
+    rng = np.random.default_rng(31)
+    tokens = rng.integers(0, 128, size=(2, 10), dtype=np.int64)
+    _check_model(model, tokens)
+
+
+def test_glm_matches_hf():
+    """GLM: interleaved PARTIAL rotary (gpt-j pairing over the first
+    half of head_dim), fused gate_up split, qkv bias without o bias."""
+    import torch
+    import transformers
+    torch_cfg = transformers.GlmConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, partial_rotary_factor=0.5, attention_bias=True,
+        max_position_embeddings=64, pad_token_id=0,
+        tie_word_embeddings=False)
+    torch.manual_seed(32)
+    model = transformers.GlmForCausalLM(torch_cfg).eval()
+    rng = np.random.default_rng(32)
+    tokens = rng.integers(0, 128, size=(2, 10), dtype=np.int64)
+    _check_model(model, tokens)
+
+
+def test_glm4_matches_hf():
+    """GLM-4: glm plus sandwich post norms (post_self_attn/post_mlp ->
+    post_block_norms)."""
+    import torch
+    import transformers
+    torch_cfg = transformers.Glm4Config(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, partial_rotary_factor=0.5, attention_bias=True,
+        max_position_embeddings=64, pad_token_id=0,
+        tie_word_embeddings=False)
+    torch.manual_seed(33)
+    model = transformers.Glm4ForCausalLM(torch_cfg).eval()
+    rng = np.random.default_rng(33)
+    tokens = rng.integers(0, 128, size=(2, 10), dtype=np.int64)
+    _check_model(model, tokens)
+
+
+def test_nemotron_matches_hf():
+    """Nemotron: LayerNorm1P ((1+w) absorbed), squared-ReLU ungated MLP,
+    partial non-interleaved rotary."""
+    import torch
+    import transformers
+    torch_cfg = transformers.NemotronConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        partial_rotary_factor=0.5, max_position_embeddings=64,
+        tie_word_embeddings=False)
+    torch.manual_seed(34)
+    model = transformers.NemotronForCausalLM(torch_cfg).eval()
+    rng = np.random.default_rng(34)
+    tokens = rng.integers(0, 128, size=(2, 10), dtype=np.int64)
+    _check_model(model, tokens)

@@ -75,6 +75,47 @@ def test_validate_spec_rejects_bad_shapes():
         validate_spec(MeshSpec(ep=2), cfg)  # dense model
 
 
+@pytest.mark.parametrize("qk", ["rms_head", "rms_full", "ln_head"])
+def test_qk_norm_sharded_equals_unsharded(qk):
+    """The q_norm/k_norm leaves through tp x pp GSPMD: per-head scales
+    replicate; the full-width RMS reduction spans every tp shard of q
+    (XLA inserts the collective)."""
+    cfg = get_config("tiny-llama").replace(dtype="float32", qk_norm=qk)
+    spec = MeshSpec(tp=2, pp=2)
+    validate_spec(spec, cfg)
+    params = init_params(cfg, jax.random.PRNGKey(4), dtype=jnp.float32)
+    # break the all-ones symmetry so the test can see a mis-sharded scale
+    params["layers"]["q_norm"]["scale"] = jnp.asarray(
+        np.random.default_rng(7).uniform(
+            0.5, 1.5, params["layers"]["q_norm"]["scale"].shape),
+        jnp.float32)
+    tokens = jnp.asarray(
+        np.random.default_rng(4).integers(0, cfg.vocab_size, (2, 8)),
+        jnp.int32)
+    ref = _logits(cfg, params, tokens)
+    got = _logits(cfg, params, tokens, mesh=create_mesh(spec),
+                  mesh_spec=spec)
+    np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_olmo2_topology_sharded_equals_unsharded():
+    """sublayer_postnorm_only + residual_scale through tp x pp GSPMD
+    (the olmo2/granite block mechanisms added in round 5)."""
+    cfg = get_config("tiny-llama").replace(
+        dtype="float32", qk_norm="rms_full", sublayer_postnorm_only=True,
+        residual_scale=0.7)
+    spec = MeshSpec(tp=2, pp=2)
+    validate_spec(spec, cfg)
+    params = init_params(cfg, jax.random.PRNGKey(5), dtype=jnp.float32)
+    tokens = jnp.asarray(
+        np.random.default_rng(5).integers(0, cfg.vocab_size, (2, 8)),
+        jnp.int32)
+    ref = _logits(cfg, params, tokens)
+    got = _logits(cfg, params, tokens, mesh=create_mesh(spec),
+                  mesh_spec=spec)
+    np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)
+
+
 def test_plan_memory_math():
     p = plan.make_plan("llama-3-8b", {"tp": 4}, max_seq=2048, batch=1)
     # 8B params in bf16 ~ 16GB total, ~4GB/device at tp=4
