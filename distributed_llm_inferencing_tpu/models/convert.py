@@ -1424,9 +1424,6 @@ def convert_state_dict(cfg: ModelConfig, sd, dtype=None):
                 "up": lin("mlp.c_fc", True),
                 "down": lin("mlp.c_proj", True),
             }
-            if cfg.attn_windows is not None:
-                w = cfg.attn_windows[i]
-                lp["attn_window"] = np.int32(-1 if w is None else w)
             return lp
         params = {
             "embed": {"tokens": get("transformer.wte.weight"),
@@ -1477,10 +1474,6 @@ def convert_state_dict(cfg: ModelConfig, sd, dtype=None):
         }
         if not cfg.tie_word_embeddings:
             params["lm_head"] = {"w": get("lm_head.weight").T}
-        if cfg.attn_windows is not None:
-            params["layers"]["attn_window"] = np.asarray(
-                [-1 if w is None else w for w in cfg.attn_windows],
-                np.int32)
     elif fam == "cohere":
         # CohereLayerNorm has no bias — zero bias is its exact parametric
         # equivalent under our layer_norm.
@@ -1628,6 +1621,16 @@ def convert_state_dict(cfg: ModelConfig, sd, dtype=None):
             params["lm_head"] = {"w": get("lm_head.weight").T}
     else:
         raise NotImplementedError(fam)
+
+    # Per-layer attention windows ride the param tree (transformer.
+    # _layer_window) — emitted HERE, once, for every family whose config
+    # carries them (gpt_neo's alternating global/local, gemma2, qwen3's
+    # mixed layer_types through the shared llama branch, ...); no family
+    # branch emits its own copy. sharding.param_specs expects the leaf
+    # whenever cfg.attn_windows is set.
+    if cfg.attn_windows is not None:
+        params["layers"]["attn_window"] = np.asarray(
+            [-1 if w is None else w for w in cfg.attn_windows], np.int32)
 
     return _to_jax(params, dtype)
 

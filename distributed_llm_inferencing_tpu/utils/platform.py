@@ -30,8 +30,18 @@ import sys
 import time
 from typing import Optional
 
+# The probe must do real COMPUTE, not just list devices: a half-wedged
+# remote chip (observed on the tunnel-attached v5e) answers the device
+# enumeration from cached topology while the first executable dispatch
+# blocks forever. jax.devices() alone therefore passes the probe and the
+# caller hangs on its first real step — exactly the hang the probe
+# exists to prevent. A tiny jit + block_until_ready exercises the whole
+# compile/execute/transfer path within the hard subprocess timeout.
 _PROBE_SRC = (
-    "import jax, sys\n"
+    "import jax, jax.numpy as jnp, sys\n"
+    "x = jnp.arange(16, dtype=jnp.float32)\n"
+    "v = jax.jit(lambda a: (a * 2.0).sum())(x)\n"
+    "assert float(v) == 240.0\n"
     "sys.stdout.write(jax.devices()[0].platform)\n"
     "sys.stdout.flush()\n"
 )

@@ -992,6 +992,31 @@ def test_qwen3_matches_hf():
     _check_model(model, tokens)
 
 
+def test_qwen3_mixed_sliding_windows_match_hf():
+    """Qwen3 with MIXED sliding/full layer_types (use_sliding_window +
+    max_window_layers < num_layers): the per-layer windows must ride the
+    param tree as the stacked attn_window leaf — the qwen3 config branch
+    reuses the llama state-dict path, which emits no per-layer leaves of
+    its own, so a missing generic emission silently ran every layer
+    global (seq > window here, so that bug shifts logits by ~0.17)."""
+    import torch
+    import transformers
+    torch_cfg = transformers.Qwen3Config(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=64, tie_word_embeddings=False,
+        use_sliding_window=True, sliding_window=4, max_window_layers=1)
+    assert len(set(torch_cfg.layer_types)) == 2  # genuinely mixed
+    torch.manual_seed(29)
+    model = transformers.Qwen3ForCausalLM(torch_cfg).eval()
+    cfg, params = convert.load_hf_model(model, dtype=jnp.float32)
+    assert cfg.attn_windows is not None and cfg.sliding_window is None
+    assert "attn_window" in params["layers"]
+    rng = np.random.default_rng(29)
+    tokens = rng.integers(0, 128, size=(2, 12), dtype=np.int64)  # 12 > 4
+    _check_model(model, tokens)
+
+
 def test_qwen3_moe_matches_hf():
     """Qwen3-MoE: qwen3 attention + mixtral-convention router
     (softmax -> top-k -> renormalize; norm_topk_prob=True)."""
