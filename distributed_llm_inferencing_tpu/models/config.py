@@ -133,9 +133,45 @@ class ModelConfig:
     # = False), with no final norm before the head.
     post_norm: bool = False
 
+    # DeepSeek-V3 multi-head latent attention (MLA, HF
+    # modeling_deepseek_v3.py DeepseekV3Attention): q and kv project
+    # through low-rank bottlenecks with an RMSNorm at each bottleneck
+    # (which is why MLA cannot be folded into plain q/k/v weights at
+    # conversion), per-head q/k dims split into a position-free "nope"
+    # part and a RoPE'd part whose k side is computed ONCE and shared
+    # across heads. kv_lora_rank non-None switches the block to MLA;
+    # head_dim must equal qk_nope_head_dim + qk_rope_head_dim, and
+    # num_kv_heads == num_heads (k/v are materialized per head — the
+    # correctness-first formulation; a latent-cache kernel can later cut
+    # the cache to kv_lora_rank + rope per token).
+    q_lora_rank: Optional[int] = None     # None => full-rank q projection
+    kv_lora_rank: Optional[int] = None
+    qk_nope_head_dim: int = 0
+    qk_rope_head_dim: int = 0
+    # MLA value head width; v is zero-padded to head_dim inside the block
+    # so every cache/attention path keeps a single head_dim, and the
+    # attention output is sliced back before the o projection. None =>
+    # head_dim (all non-MLA families).
+    v_head_dim: Optional[int] = None
+
     # Mixture-of-experts (Mixtral). num_experts == 0 => dense MLP.
     num_experts: int = 0
     num_experts_per_tok: int = 2
+    # Router convention: "softmax" (Mixtral/Qwen3-MoE: softmax -> top-k
+    # -> renormalize) | "deepseek_v3" (sigmoid scores; selection by
+    # scores + e_score_correction_bias under group-limited top-k —
+    # moe_n_group groups scored by their top-2 sum, top moe_topk_group
+    # groups kept; weights are the UNbiased scores, renormalized when
+    # moe_norm_topk, then scaled by moe_routed_scale).
+    moe_router: str = "softmax"
+    moe_n_group: int = 1
+    moe_topk_group: int = 1
+    moe_routed_scale: float = 1.0
+    moe_norm_topk: bool = True
+    # DeepSeek shared experts: a dense SwiGLU MLP of width
+    # moe_shared_experts * (per-expert intermediate), always active,
+    # added to the routed output (layer tree leaves shared_gate/up/down).
+    moe_shared_experts: int = 0
     # Dispatch strategy (models/transformer.py _moe): "dense" computes all
     # experts for every token (right trade at decode batch sizes);
     # "capacity" does GShard-style top-k einsum dispatch with a fixed
@@ -207,6 +243,40 @@ class ModelConfig:
         assert not (self.shared_attn_mlp_norm
                     and not self.parallel_residual), (
             "shared_attn_mlp_norm requires parallel_residual")
+        if self.kv_lora_rank is not None:
+            assert self.head_dim == (self.qk_nope_head_dim
+                                     + self.qk_rope_head_dim), (
+                "MLA: head_dim must equal qk_nope_head_dim + "
+                "qk_rope_head_dim")
+            assert self.num_kv_heads == self.num_heads, (
+                "MLA materializes k/v per head: num_kv_heads == num_heads")
+            assert self.position_embedding == "rope" and self.qk_norm is None
+        assert self.moe_router in ("softmax", "deepseek_v3"), (
+            f"unknown moe_router {self.moe_router!r}")
+        if self.moe_router == "deepseek_v3" and self.num_experts:
+            E, G = self.num_experts, self.moe_n_group
+            assert G >= 1 and E % G == 0, (
+                f"deepseek routing: num_experts={E} must divide into "
+                f"moe_n_group={G} groups")
+            assert E // G >= 2, (
+                f"deepseek routing scores each group by its top-2 sum: "
+                f"need >= 2 experts per group, got {E // G}")
+            assert 1 <= self.moe_topk_group <= G, (
+                f"moe_topk_group={self.moe_topk_group} must be in "
+                f"[1, moe_n_group={G}]")
+            assert self.moe_topk_group * (E // G) >= self.num_experts_per_tok, (
+                f"top-{self.num_experts_per_tok} routing needs at least "
+                f"that many eligible experts, but moe_topk_group="
+                f"{self.moe_topk_group} groups expose only "
+                f"{self.moe_topk_group * (E // G)}")
+
+    @property
+    def mla(self) -> bool:
+        return self.kv_lora_rank is not None
+
+    @property
+    def v_head_dim_effective(self) -> int:
+        return self.head_dim if self.v_head_dim is None else self.v_head_dim
 
     @property
     def q_dim(self) -> int:

@@ -70,7 +70,7 @@ SUPPORTED_MODEL_TYPES = ("gpt2", "opt", "llama", "mistral", "mixtral",
                          "codegen", "starcoder2", "olmo", "phi3",
                          "gpt_neo", "gemma2", "cohere", "qwen3",
                          "qwen3_moe", "granite", "olmo2", "glm", "glm4",
-                         "nemotron")
+                         "nemotron", "deepseek_v3")
 
 
 def config_from_hf(hf_config) -> ModelConfig:
@@ -674,6 +674,66 @@ def config_from_hf(hf_config) -> ModelConfig:
                                         2),
             tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
                                         False))
+    if mt == "deepseek_v3":
+        # DeepSeek-V3: llama residual topology with multi-head latent
+        # attention (low-rank q/kv bottlenecks with mid-stack RMSNorms,
+        # decoupled shared-rope head — config.py kv_lora_rank and
+        # transformer._mla_qkv) and sigmoid/group-limited MoE routing
+        # with always-active shared experts (transformer._moe_gates
+        # "deepseek_v3"). HF: modeling_deepseek_v3.py.
+        if getattr(hf_config, "rope_scaling", None):
+            raise NotImplementedError(
+                "deepseek_v3 with rope_scaling (yarn mscale folds into "
+                "the attention scale) is not supported")
+        L = hf_config.num_hidden_layers
+        fk = getattr(hf_config, "first_k_dense_replace", 0) or 0
+        if 0 < fk < L:
+            # the stacked-layer scan needs a uniform tree; a dense
+            # prefix + MoE tail is two different MLP shapes
+            raise NotImplementedError(
+                f"deepseek_v3 with mixed dense/MoE layers "
+                f"(0 < first_k_dense_replace={fk} < num_layers={L}); "
+                "all-dense (first_k_dense_replace >= num_layers) and "
+                "all-MoE (== 0) convert")
+        all_dense = fk >= L
+        E = 0 if all_dense else hf_config.n_routed_experts
+        nd = hf_config.qk_nope_head_dim
+        rd = hf_config.qk_rope_head_dim
+        return ModelConfig(
+            name=getattr(hf_config, "name_or_path", mt) or mt,
+            family="deepseek", vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            intermediate_size=(hf_config.intermediate_size if all_dense
+                               else hf_config.moe_intermediate_size),
+            num_layers=L, num_heads=hf_config.num_attention_heads,
+            num_kv_heads=hf_config.num_attention_heads,
+            head_dim=nd + rd,
+            max_position_embeddings=hf_config.max_position_embeddings,
+            norm_type="rmsnorm", norm_eps=hf_config.rms_norm_eps,
+            activation=_act_from_hf(hf_config.hidden_act),
+            gated_mlp=True, position_embedding="rope",
+            rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+            rope_interleaved=bool(getattr(hf_config, "rope_interleave",
+                                          True)),
+            attn_bias=bool(getattr(hf_config, "attention_bias", False)),
+            mlp_bias=False,
+            q_lora_rank=getattr(hf_config, "q_lora_rank", None),
+            kv_lora_rank=hf_config.kv_lora_rank,
+            qk_nope_head_dim=nd, qk_rope_head_dim=rd,
+            v_head_dim=hf_config.v_head_dim,
+            num_experts=E,
+            num_experts_per_tok=getattr(hf_config, "num_experts_per_tok",
+                                        8),
+            moe_router="deepseek_v3" if E else "softmax",
+            moe_n_group=getattr(hf_config, "n_group", 1) or 1,
+            moe_topk_group=getattr(hf_config, "topk_group", 1) or 1,
+            moe_routed_scale=float(getattr(hf_config,
+                                           "routed_scaling_factor", 1.0)),
+            moe_norm_topk=bool(getattr(hf_config, "norm_topk_prob", True)),
+            moe_shared_experts=(getattr(hf_config, "n_shared_experts", 0)
+                                or 0) if E else 0,
+            tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
+                                        False))
     if mt == "granite":
         # Granite 3.x: llama layout with four scalar multipliers, all
         # absorbed into existing mechanisms — embedding_multiplier ->
@@ -941,6 +1001,84 @@ def convert_state_dict(cfg: ModelConfig, sd, dtype=None):
             "embed": {"tokens": get("model.embed_tokens.weight")},
             "layers": _stack([layer(i) for i in range(cfg.num_layers)]),
             "final_norm": {"scale": get("model.norm.weight") + off},
+        }
+        if not cfg.tie_word_embeddings:
+            params["lm_head"] = {"w": get("lm_head.weight").T}
+    elif fam == "deepseek":
+        # MLA projections (HF modeling_deepseek_v3.py:327-446). Our
+        # runtime orders per-head q/k dims [rope | nope] (HF: [nope |
+        # rope]) so the rope slice is contiguous where apply_rope
+        # rotates — a score-invariant permutation applied here to the q
+        # projection columns (k is assembled in that order at runtime:
+        # kv_a's rope slice + kv_b's nope columns, transformer._mla_qkv).
+        H, hd = cfg.num_heads, cfg.head_dim
+        nd, rd = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim
+        vd = cfg.v_head_dim_effective
+
+        def q_permute(w):
+            """[din, H*hd] with per-head [nope|rope] -> [rope|nope]."""
+            w = w.reshape(-1, H, hd)
+            return np.concatenate([w[..., nd:], w[..., :nd]],
+                                  axis=-1).reshape(-1, H * hd)
+
+        def layer(i):
+            p = f"model.layers.{i}."
+
+            def lin(n):
+                out = {"w": get(p + n + ".weight").T}
+                if p + n + ".bias" in sd:   # attention_bias variants
+                    out["b"] = get(p + n + ".bias")
+                return out
+            kv_b = get(p + "self_attn.kv_b_proj.weight").T  # [r, H*(nd+vd)]
+            kv_b = kv_b.reshape(-1, H, nd + vd)
+            lp = {
+                "attn_norm": {"scale": get(p + "input_layernorm.weight")},
+                "kv_a": lin("self_attn.kv_a_proj_with_mqa"),
+                "kv_a_norm": {
+                    "scale": get(p + "self_attn.kv_a_layernorm.weight")},
+                "kv_b_k": {"w": kv_b[..., :nd].reshape(-1, H * nd)},
+                "kv_b_v": {"w": kv_b[..., nd:].reshape(-1, H * vd)},
+                "o": lin("self_attn.o_proj"),
+                "mlp_norm": {
+                    "scale": get(p + "post_attention_layernorm.weight")},
+            }
+            if cfg.q_lora_rank:
+                lp["q_a"] = lin("self_attn.q_a_proj")
+                lp["q_a_norm"] = {
+                    "scale": get(p + "self_attn.q_a_layernorm.weight")}
+                lp["q_b"] = {
+                    "w": q_permute(get(p + "self_attn.q_b_proj.weight").T)}
+            else:
+                lp["q"] = {
+                    "w": q_permute(get(p + "self_attn.q_proj.weight").T)}
+            if cfg.is_moe:
+                lp["router"] = {
+                    "w": get(p + "mlp.gate.weight").T,
+                    "bias": get(p + "mlp.gate.e_score_correction_bias"),
+                }
+                ex = [f"mlp.experts.{e}." for e in range(cfg.num_experts)]
+                lp["experts"] = {
+                    "gate": {"w": np.stack(
+                        [get(p + e + "gate_proj.weight").T for e in ex])},
+                    "up": {"w": np.stack(
+                        [get(p + e + "up_proj.weight").T for e in ex])},
+                    "down": {"w": np.stack(
+                        [get(p + e + "down_proj.weight").T for e in ex])},
+                }
+                if cfg.moe_shared_experts:
+                    s = "mlp.shared_experts."
+                    lp["shared_gate"] = lin(s + "gate_proj")
+                    lp["shared_up"] = lin(s + "up_proj")
+                    lp["shared_down"] = lin(s + "down_proj")
+            else:
+                lp["gate"] = lin("mlp.gate_proj")
+                lp["up"] = lin("mlp.up_proj")
+                lp["down"] = lin("mlp.down_proj")
+            return lp
+        params = {
+            "embed": {"tokens": get("model.embed_tokens.weight")},
+            "layers": _stack([layer(i) for i in range(cfg.num_layers)]),
+            "final_norm": {"scale": get("model.norm.weight")},
         }
         if not cfg.tie_word_embeddings:
             params["lm_head"] = {"w": get("lm_head.weight").T}

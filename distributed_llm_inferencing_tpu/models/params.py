@@ -71,13 +71,32 @@ def init_params(cfg: ModelConfig, key, dtype=None):
     def ew(shape):
         return w_q(shape) if quantized else {"w": w(shape)}
 
-    layers = {
-        "attn_norm": norm_p(),
-        "q": lin(D, cfg.q_dim, cfg.attn_bias),
-        "k": lin(D, cfg.kv_dim, cfg.attn_bias),
-        "v": lin(D, cfg.kv_dim, cfg.attn_bias),
-        "o": lin(cfg.q_dim, D, cfg.o_bias_effective),
-    }
+    if cfg.mla:   # deepseek-v3 latent attention (transformer._mla_qkv)
+        H, hd = cfg.num_heads, cfg.head_dim
+        r, rd = cfg.kv_lora_rank, cfg.qk_rope_head_dim
+        vd = cfg.v_head_dim_effective
+        layers = {
+            "attn_norm": norm_p(),
+            "kv_a": lin(D, r + rd, cfg.attn_bias),
+            "kv_a_norm": {"scale": ones((L, r))},
+            "kv_b_k": lin(r, H * (hd - rd), False),
+            "kv_b_v": lin(r, H * vd, False),
+            "o": lin(H * vd, D, cfg.o_bias_effective),
+        }
+        if cfg.q_lora_rank:
+            layers["q_a"] = lin(D, cfg.q_lora_rank, cfg.attn_bias)
+            layers["q_a_norm"] = {"scale": ones((L, cfg.q_lora_rank))}
+            layers["q_b"] = lin(cfg.q_lora_rank, cfg.q_dim, False)
+        else:
+            layers["q"] = lin(D, cfg.q_dim, False)
+    else:
+        layers = {
+            "attn_norm": norm_p(),
+            "q": lin(D, cfg.q_dim, cfg.attn_bias),
+            "k": lin(D, cfg.kv_dim, cfg.attn_bias),
+            "v": lin(D, cfg.kv_dim, cfg.attn_bias),
+            "o": lin(cfg.q_dim, D, cfg.o_bias_effective),
+        }
     if cfg.post_block_norms:   # gemma2 sandwich norms
         layers["attn_post_norm"] = norm_p()
         layers["mlp_post_norm"] = norm_p()
@@ -101,11 +120,18 @@ def init_params(cfg: ModelConfig, key, dtype=None):
     if cfg.is_moe:
         E = cfg.num_experts
         layers["router"] = {"w": w((L, D, E))}   # kept float (ops/quant.py)
+        if cfg.moe_router == "deepseek_v3":   # e_score_correction_bias
+            layers["router"]["bias"] = jnp.zeros((L, E), jnp.float32)
         layers["experts"] = {
             "gate": ew((L, E, D, I)),
             "up": ew((L, E, D, I)),
             "down": ew((L, E, I, D)),
         }
+        if cfg.moe_shared_experts:   # deepseek always-active shared MLP
+            SI = I * cfg.moe_shared_experts
+            layers["shared_gate"] = lin(D, SI, False)
+            layers["shared_up"] = lin(D, SI, False)
+            layers["shared_down"] = lin(SI, D, False)
     else:
         layers["up"] = lin(D, I, cfg.mlp_bias)
         if cfg.gated_mlp:

@@ -129,6 +129,22 @@ register(_llama("moe-proxy-8e", 1536, 4096, 16, 12, 4, vocab=32000,
                     name="moe-proxy-8e", num_experts=8,
                     num_experts_per_tok=2))
 
+# --- DeepSeek proxy: V3's mechanisms (MLA latent attention + sigmoid
+# group-limited routing + shared experts) at a scale one chip serves —
+# the real 671B is a multi-pod deployment. Dims follow V3's ratios
+# (kv_lora_rank ≈ D/14, rope head = nope/2, v = nope). ---
+register(ModelConfig(
+    name="deepseek-proxy", family="deepseek", vocab_size=32000,
+    hidden_size=1024, intermediate_size=512, num_layers=12, num_heads=16,
+    num_kv_heads=16, head_dim=96, qk_nope_head_dim=64,
+    qk_rope_head_dim=32, v_head_dim=64, q_lora_rank=384, kv_lora_rank=128,
+    max_position_embeddings=4096, norm_type="rmsnorm", activation="silu",
+    gated_mlp=True, position_embedding="rope", rope_theta=10000.0,
+    rope_interleaved=True, attn_bias=False, mlp_bias=False,
+    tie_word_embeddings=False, num_experts=8, num_experts_per_tok=2,
+    moe_router="deepseek_v3", moe_n_group=4, moe_topk_group=2,
+    moe_routed_scale=2.5, moe_shared_experts=1))
+
 # --- GPT-NeoX / Pythia: parallel residual, partial rotary, exact gelu ---
 register(ModelConfig(
     name="pythia-6.9b", family="gpt-neox", vocab_size=50432,
@@ -224,3 +240,14 @@ register(ModelConfig(
     activation="silu", gated_mlp=True, position_embedding="rope",
     attn_bias=False, mlp_bias=False, tie_word_embeddings=False,
     num_experts=4, num_experts_per_tok=2))
+register(ModelConfig(
+    name="tiny-deepseek", family="deepseek", vocab_size=256,
+    hidden_size=64, intermediate_size=32, num_layers=2, num_heads=8,
+    num_kv_heads=8, head_dim=24, qk_nope_head_dim=16, qk_rope_head_dim=8,
+    v_head_dim=16, q_lora_rank=32, kv_lora_rank=16,
+    max_position_embeddings=128, norm_type="rmsnorm", activation="silu",
+    gated_mlp=True, position_embedding="rope", rope_interleaved=True,
+    attn_bias=False, mlp_bias=False, tie_word_embeddings=False,
+    num_experts=4, num_experts_per_tok=2, moe_router="deepseek_v3",
+    moe_n_group=2, moe_topk_group=1, moe_routed_scale=2.5,
+    moe_shared_experts=1))

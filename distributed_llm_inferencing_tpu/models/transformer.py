@@ -138,12 +138,40 @@ def _ew(operand, p, eq):
 
 
 def _moe_gates(x, lp, cfg: ModelConfig):
-    """Router probs → renormalized top-k gates [..., E] (Mixtral
-    convention: softmax first, then top-k, then renormalize)."""
+    """Router probs → weighted top-k gates [..., E].
+
+    "softmax" (Mixtral convention): softmax first, then top-k, then
+    renormalize. "deepseek_v3" (HF modeling_deepseek_v3.py
+    DeepseekV3TopkRouter): sigmoid scores; SELECTION ranks scores +
+    e_score_correction_bias under group-limited top-k (groups scored by
+    their top-2 sum, only the top moe_topk_group groups are eligible);
+    WEIGHTS are the unbiased scores, renormalized when moe_norm_topk,
+    then scaled by moe_routed_scale. Divergence from HF, deliberate:
+    HF zero-fills ineligible groups (masked_fill 0.0), which can admit
+    an ineligible expert when every eligible biased score is negative —
+    we mask with -inf and keep selection inside the chosen groups."""
     router_logits = jnp.einsum("...d,de->...e", x.astype(jnp.float32),
                                lp["router"]["w"].astype(jnp.float32))
+    k = cfg.num_experts_per_tok
+    if cfg.moe_router == "deepseek_v3":
+        scores = jax.nn.sigmoid(router_logits)              # [...,E]
+        choice = scores + lp["router"]["bias"].astype(jnp.float32)
+        G = cfg.moe_n_group
+        gs = choice.reshape(*choice.shape[:-1], G, cfg.num_experts // G)
+        group_scores = jnp.sum(jax.lax.top_k(gs, 2)[0], axis=-1)  # [...,G]
+        gkth = jax.lax.top_k(group_scores,
+                             cfg.moe_topk_group)[0][..., -1:]
+        gmask = (group_scores >= gkth)[..., None]           # [...,G,1]
+        eligible = jnp.broadcast_to(gmask, gs.shape).reshape(choice.shape)
+        ranked = jnp.where(eligible, choice, -jnp.inf)
+        kth = jax.lax.top_k(ranked, k)[0][..., -1:]
+        sel = (ranked >= kth) & eligible
+        gate = jnp.where(sel, scores, 0.0)
+        if cfg.moe_norm_topk:
+            gate = gate / (jnp.sum(gate, axis=-1, keepdims=True) + 1e-20)
+        return gate * cfg.moe_routed_scale
     probs = jax.nn.softmax(router_logits, axis=-1)          # [...,E]
-    kth = jax.lax.top_k(probs, cfg.num_experts_per_tok)[0][..., -1:]
+    kth = jax.lax.top_k(probs, k)[0][..., -1:]
     gate = jnp.where(probs >= kth, probs, 0.0)
     return gate / jnp.sum(gate, axis=-1, keepdims=True)     # [...,E]
 
@@ -210,7 +238,10 @@ _MOE_AUTO_DENSE_MAX_TOKENS = 32
 
 
 def _moe(x, lp, cfg: ModelConfig):
-    """Mixtral-style sparse MoE — dispatch strategy per cfg.moe_dispatch."""
+    """Sparse MoE — dispatch strategy per cfg.moe_dispatch, plus the
+    always-active DeepSeek shared-experts MLP when the layer carries
+    shared_gate/up/down leaves (added OUTSIDE the routed dispatch, HF
+    DeepseekV3MoE.forward)."""
     mode = cfg.moe_dispatch
     if mode == "auto":
         n_tokens = 1
@@ -218,9 +249,14 @@ def _moe(x, lp, cfg: ModelConfig):
             n_tokens *= s
         mode = ("dense" if n_tokens <= _MOE_AUTO_DENSE_MAX_TOKENS
                 else "capacity")
-    if mode == "capacity":
-        return _moe_capacity(x, lp, cfg)
-    return _moe_dense(x, lp, cfg)
+    out = (_moe_capacity(x, lp, cfg) if mode == "capacity"
+           else _moe_dense(x, lp, cfg))
+    if cfg.moe_shared_experts:
+        h = _act(_linear(x, lp["shared_gate"]), cfg.activation) * _linear(
+            x, lp["shared_up"])
+        out = out + _linear(h, lp["shared_down"],
+                            row_sharded=cfg.tp_row_sharded)
+    return out
 
 
 def _alibi(cfg: ModelConfig):
@@ -242,7 +278,11 @@ def _cfg_backend(cfg: ModelConfig, n_devices: int, op: str = "dense"):
     softmax has no tanh hook)."""
     b = resolve_backend(cfg.attn_backend, n_devices, op=op)
     if b.startswith("pallas") and (cfg.attn_windows is not None
-                                   or cfg.attn_softcap is not None):
+                                   or cfg.attn_softcap is not None
+                                   or cfg.mla):
+        # mla: qk_head_dim (192) is off the kernels' 128-lane tiling and
+        # v rides zero-padded — keep the XLA formulation until a
+        # dedicated MLA kernel exists
         return "xla"
     return b
 
@@ -362,6 +402,54 @@ def _qk_normalize(t, p, cfg: ModelConfig):
     return rms_norm(t, p["scale"], cfg.norm_eps)
 
 
+def _mla_qkv(h, lp, cfg: ModelConfig, q_positions):
+    """DeepSeek-V3 multi-head latent attention projections (HF
+    modeling_deepseek_v3.py:327-446). q and kv pass through low-rank
+    bottlenecks with an RMSNorm at each bottleneck — the reason MLA
+    cannot be pre-expanded into plain q/k/v weights at conversion.
+
+    Layout choices, both score-invariant permutations of HF's:
+    - per-head q/k dims are ordered [rope | nope] (HF: [nope | rope]) so
+      the RoPE'd slice is contiguous at the front; conversion permutes
+      the projection columns to match (models/convert.py deepseek).
+    - rope uses the gptj-interleaved pairing when cfg.rope_interleaved
+      (HF's apply_rotary_pos_emb_interleave permutes pairs->halves then
+      half-rotates; same rotation pairs, different output layout —
+      identical q·k scores since q and k transform together).
+
+    k's rope part is computed ONCE from the hidden state (MQA-style) and
+    broadcast across heads; v is zero-padded from v_head_dim to head_dim
+    so every cache/attention path keeps one head_dim (the block slices
+    the attention output back before o). Returns q,k,v [B,s,H,head_dim].
+    """
+    B, s, _ = h.shape
+    H, hd = cfg.num_heads, cfg.head_dim
+    rd, vd = cfg.qk_rope_head_dim, cfg.v_head_dim_effective
+    r = cfg.kv_lora_rank
+    if cfg.q_lora_rank:
+        cq = norm(_linear(h, lp["q_a"]), lp["q_a_norm"], "rmsnorm",
+                  cfg.norm_eps)
+        q = _linear(cq, lp["q_b"]).reshape(B, s, H, hd)
+    else:
+        q = _linear(h, lp["q"]).reshape(B, s, H, hd)
+    q_rot = apply_rope(q[..., :rd], q_positions, cfg.rope_theta,
+                       interleaved=cfg.rope_interleaved)
+    q = jnp.concatenate([q_rot, q[..., rd:]], axis=-1)
+
+    ckv = _linear(h, lp["kv_a"])                         # [B,s,r+rd]
+    k_rot = apply_rope(ckv[..., r:][:, :, None, :], q_positions,
+                       cfg.rope_theta,
+                       interleaved=cfg.rope_interleaved)  # [B,s,1,rd]
+    c = norm(ckv[..., :r], lp["kv_a_norm"], "rmsnorm", cfg.norm_eps)
+    k_nope = _linear(c, lp["kv_b_k"]).reshape(B, s, H, hd - rd)
+    v = _linear(c, lp["kv_b_v"]).reshape(B, s, H, vd)
+    k = jnp.concatenate(
+        [jnp.broadcast_to(k_rot, (B, s, H, rd)), k_nope], axis=-1)
+    if vd < hd:
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, 0), (0, hd - vd)))
+    return q, k, v
+
+
 def _block_body(x, lp, cfg: ModelConfig, q_positions, attend_write):
     """One transformer block: norm → QKV (+RoPE) → attend → norm → MLP/MoE.
 
@@ -381,22 +469,28 @@ def _block_body(x, lp, cfg: ModelConfig, q_positions, attend_write):
     B, s, _ = x.shape
     h = x if (cfg.post_norm or cfg.sublayer_postnorm_only) else norm(
         x, lp["attn_norm"], cfg.norm_type, cfg.norm_eps)
-    q = _linear(h, lp["q"]).reshape(B, s, cfg.num_heads, cfg.head_dim)
-    k = _linear(h, lp["k"]).reshape(B, s, cfg.num_kv_heads, cfg.head_dim)
-    v = _linear(h, lp["v"]).reshape(B, s, cfg.num_kv_heads, cfg.head_dim)
+    if cfg.mla:
+        q, k, v = _mla_qkv(h, lp, cfg, q_positions)   # rope applied inside
+    else:
+        q = _linear(h, lp["q"]).reshape(B, s, cfg.num_heads, cfg.head_dim)
+        k = _linear(h, lp["k"]).reshape(B, s, cfg.num_kv_heads, cfg.head_dim)
+        v = _linear(h, lp["v"]).reshape(B, s, cfg.num_kv_heads, cfg.head_dim)
 
-    if cfg.qk_norm:
-        q = _qk_normalize(q, lp["q_norm"], cfg)
-        k = _qk_normalize(k, lp["k_norm"], cfg)
+        if cfg.qk_norm:
+            q = _qk_normalize(q, lp["q_norm"], cfg)
+            k = _qk_normalize(k, lp["k_norm"], cfg)
 
-    if cfg.position_embedding == "rope":
-        q = apply_rope(q, q_positions, cfg.rope_theta, cfg.rope_pct,
-                       cfg.rope_interleaved)
-        k = apply_rope(k, q_positions, cfg.rope_theta, cfg.rope_pct,
-                       cfg.rope_interleaved)
+        if cfg.position_embedding == "rope":
+            q = apply_rope(q, q_positions, cfg.rope_theta, cfg.rope_pct,
+                           cfg.rope_interleaved)
+            k = apply_rope(k, q_positions, cfg.rope_theta, cfg.rope_pct,
+                           cfg.rope_interleaved)
 
     attn, cache_out = attend_write(q, k, v)
-    attn = _linear(attn.reshape(B, s, cfg.num_heads * cfg.head_dim), lp["o"],
+    vd = cfg.v_head_dim_effective
+    if vd < cfg.head_dim:   # MLA: v rode the cache zero-padded
+        attn = attn[..., :vd]
+    attn = _linear(attn.reshape(B, s, cfg.num_heads * vd), lp["o"],
                    row_sharded=cfg.tp_row_sharded)
     if cfg.post_block_norms:   # gemma2 sandwich: norm BEFORE the residual
         attn = norm(attn, lp["attn_post_norm"], cfg.norm_type, cfg.norm_eps)

@@ -45,7 +45,12 @@ import jax
 import jax.numpy as jnp
 
 # leaves quantized under params["layers"] / params root
-_LINEAR_LEAVES = ("q", "k", "v", "o", "up", "gate", "down")
+_LINEAR_LEAVES = ("q", "k", "v", "o", "up", "gate", "down",
+                  # deepseek MLA bottlenecks + expansions and shared
+                  # experts (the q_a/kv_a latents are matmul weights like
+                  # any other; their mid-stack norms stay float)
+                  "q_a", "q_b", "kv_a", "kv_b_k", "kv_b_v",
+                  "shared_gate", "shared_up", "shared_down")
 
 MODES = ("int8", "int4")
 

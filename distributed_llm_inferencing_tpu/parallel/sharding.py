@@ -64,13 +64,39 @@ def param_specs(cfg: ModelConfig, spec: MeshSpec,
         key = "p4" if cfg.quant == "int4" else "q"
         return {key: spec_, "scale": P(*(spec_[:-2] + spec_[-1:]))}
 
-    layers: Dict[str, Any] = {
-        "attn_norm": norm_p(),
-        "q": lin(P(L, None, "tp")),
-        "k": lin(P(L, None, kv_tp)),
-        "v": lin(P(L, None, kv_tp)),
-        "o": lin(P(L, "tp", None)),
-    }
+    if cfg.mla:
+        # deepseek MLA (transformer._mla_qkv): the latent bottleneck
+        # projections are small and produce per-token latents every
+        # shard needs (the shared rope head and the normed c_kv feed
+        # every head) — replicate them; the per-head expansions kv_b_k /
+        # kv_b_v / q[_b] column-shard over tp like q/k/v, and o row-
+        # shards as usual.
+        layers: Dict[str, Any] = {
+            "attn_norm": norm_p(),
+            "kv_a": lin(P(L, None, None)),
+            "kv_a_norm": {"scale": P(L, None)},
+            "kv_b_k": lin(P(L, None, "tp")),
+            "kv_b_v": lin(P(L, None, "tp")),
+            "o": lin(P(L, "tp", None)),
+        }
+        if cfg.q_lora_rank:
+            layers["q_a"] = lin(P(L, None, None))
+            layers["q_a_norm"] = {"scale": P(L, None)}
+            layers["q_b"] = lin(P(L, None, "tp"))
+        else:
+            layers["q"] = lin(P(L, None, "tp"))
+        if cfg.attn_bias:
+            layers["kv_a"]["b"] = P(L, None)
+            if cfg.q_lora_rank:
+                layers["q_a"]["b"] = P(L, None)
+    else:
+        layers = {
+            "attn_norm": norm_p(),
+            "q": lin(P(L, None, "tp")),
+            "k": lin(P(L, None, kv_tp)),
+            "v": lin(P(L, None, kv_tp)),
+            "o": lin(P(L, "tp", None)),
+        }
     if cfg.post_block_norms:   # gemma2 sandwich norms
         layers["attn_post_norm"] = norm_p()
         layers["mlp_post_norm"] = norm_p()
@@ -87,7 +113,7 @@ def param_specs(cfg: ModelConfig, spec: MeshSpec,
         layers["attn_window"] = P(L)
     if not cfg.shared_attn_mlp_norm:   # phi/falcon-7b: one norm per block
         layers["mlp_norm"] = norm_p()
-    if cfg.attn_bias:
+    if cfg.attn_bias and not cfg.mla:   # mla biases set in its branch
         layers["q"]["b"] = P(L, "tp")
         layers["k"]["b"] = P(L, kv_tp)
         layers["v"]["b"] = P(L, kv_tp)
@@ -95,11 +121,17 @@ def param_specs(cfg: ModelConfig, spec: MeshSpec,
         layers["o"]["b"] = P(L, None)
     if cfg.is_moe:
         layers["router"] = {"w": P(L, None, None)}
+        if cfg.moe_router == "deepseek_v3":
+            layers["router"]["bias"] = P(L, None)
         layers["experts"] = {
             "gate": lin(P(L, "ep", None, "tp")),
             "up": lin(P(L, "ep", None, "tp")),
             "down": lin(P(L, "ep", "tp", None)),
         }
+        if cfg.moe_shared_experts:   # deepseek always-active shared MLP
+            layers["shared_gate"] = lin(P(L, None, "tp"))
+            layers["shared_up"] = lin(P(L, None, "tp"))
+            layers["shared_down"] = lin(P(L, "tp", None))
     else:
         layers["up"] = lin(P(L, None, "tp"))
         if cfg.gated_mlp:

@@ -26,14 +26,16 @@ def test_quantize_roundtrip_error():
     assert np.all(err <= np.asarray(q["scale"]) / 2 + 1e-7)
 
 
-@pytest.mark.parametrize("model", ["tiny-gpt2", "tiny-llama", "tiny-mixtral"])
+@pytest.mark.parametrize("model", ["tiny-gpt2", "tiny-llama",
+                                   "tiny-mixtral", "tiny-deepseek"])
 def test_quantized_logits_close(model):
     cfg = get_config(model).replace(dtype="float32", attn_backend="xla")
     params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
     qcfg = cfg.replace(quant="int8")
     qparams = maybe_quantize(params, qcfg)
-    # big matmul weights are int8 now
-    assert qparams["layers"]["q"]["q"].dtype == jnp.int8
+    # big matmul weights are int8 now (deepseek MLA: the q bottleneck)
+    ql = qparams["layers"]["q_a" if cfg.mla and cfg.q_lora_rank else "q"]
+    assert ql["q"].dtype == jnp.int8
     assert param_bytes(qparams) < 0.75 * param_bytes(params)
 
     toks = jnp.asarray(
