@@ -52,6 +52,18 @@ class ModelConfig:
     # GPT-J rotate_every_two convention: frequency i rotates dims
     # (2i, 2i+1) instead of HF-llama's (i, i + rot/2) halves.
     rope_interleaved: bool = False
+    # Context-extension override of the rope frequency ladder ([rot/2]
+    # floats, e.g. yarn's NTK-by-part interpolation) — computed ONCE at
+    # conversion (models/convert.py _yarn_inv_freq) and carried here so
+    # checkpoints roundtrip it through config.json. None => the plain
+    # theta ladder.
+    rope_inv_freq: Optional[Tuple[float, ...]] = None
+    # yarn attention_factor: multiplies cos/sin (ops/rope.apply_rope),
+    # i.e. scores scale by its square over the rotated dims. The
+    # separate mscale_all_dim score multiplier (uniform over ALL dims)
+    # is folded into the q weights at conversion via
+    # query_pre_attn_scalar instead.
+    rope_attn_factor: float = 1.0
     # BLOOM: layernorm applied to the embedding output.
     embed_norm: bool = False
     attn_bias: bool = True
@@ -172,6 +184,16 @@ class ModelConfig:
     # moe_shared_experts * (per-expert intermediate), always active,
     # added to the routed output (layer tree leaves shared_gate/up/down).
     moe_shared_experts: int = 0
+    # DeepSeek first_k_dense_replace: the first k layers run a plain
+    # dense MLP (width dense_intermediate_size) instead of the MoE. The
+    # param tree then carries a second stacked segment ``layers_dense``
+    # ([k, ...]) ahead of the MoE ``layers`` ([L-k, ...]) — the layer
+    # scans run the two segments back to back
+    # (models/transformer.py layer_segments). Attention/cache layout is
+    # identical across segments, so the KV cache stays one [L, ...]
+    # stack.
+    dense_prefix_layers: int = 0
+    dense_intermediate_size: Optional[int] = None
     # Dispatch strategy (models/transformer.py _moe): "dense" computes all
     # experts for every token (right trade at decode batch sizes);
     # "capacity" does GShard-style top-k einsum dispatch with a fixed
@@ -218,6 +240,10 @@ class ModelConfig:
             f"num_heads={self.num_heads} must be divisible by "
             f"num_kv_heads={self.num_kv_heads}"
         )
+        if self.rope_inv_freq is not None:
+            # normalize (checkpoint config.json roundtrips tuple -> list)
+            object.__setattr__(self, "rope_inv_freq",
+                               tuple(float(f) for f in self.rope_inv_freq))
         if self.attn_windows is not None:
             # normalize (checkpoint config.json roundtrips tuple -> list)
             object.__setattr__(self, "attn_windows",
@@ -253,6 +279,17 @@ class ModelConfig:
             assert self.position_embedding == "rope" and self.qk_norm is None
         assert self.moe_router in ("softmax", "deepseek_v3"), (
             f"unknown moe_router {self.moe_router!r}")
+        if self.dense_prefix_layers:
+            assert 0 < self.dense_prefix_layers < self.num_layers, (
+                f"dense_prefix_layers={self.dense_prefix_layers} must be "
+                f"in (0, num_layers={self.num_layers}); an all-dense "
+                "model is just num_experts=0")
+            assert self.num_experts > 0, (
+                "dense_prefix_layers describes a dense prefix AHEAD of "
+                "MoE layers; set num_experts")
+            assert self.dense_intermediate_size, (
+                "dense_prefix_layers needs dense_intermediate_size (the "
+                "prefix MLP width differs from the per-expert width)")
         if self.moe_router == "deepseek_v3" and self.num_experts:
             E, G = self.num_experts, self.moe_n_group
             assert G >= 1 and E % G == 0, (
@@ -273,6 +310,21 @@ class ModelConfig:
     @property
     def mla(self) -> bool:
         return self.kv_lora_rank is not None
+
+    def dense_segment_cfg(self, num_layers: Optional[int] = None
+                          ) -> "ModelConfig":
+        """The per-segment config of the dense-MLP prefix of a mixed
+        stack: MoE fields cleared, MLP width = dense_intermediate_size.
+        The ONE derivation shared by execution
+        (transformer.layer_segments), init (params.init_params) and
+        sharding (param_specs) — a field zeroed here is zeroed
+        everywhere."""
+        return self.replace(
+            num_experts=0, moe_shared_experts=0, moe_router="softmax",
+            dense_prefix_layers=0, dense_intermediate_size=None,
+            intermediate_size=self.dense_intermediate_size,
+            num_layers=(self.dense_prefix_layers if num_layers is None
+                        else num_layers))
 
     @property
     def v_head_dim_effective(self) -> int:

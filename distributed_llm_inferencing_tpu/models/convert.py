@@ -51,6 +51,96 @@ def _qwen2_window(hf_config):
     return hf_config.sliding_window       # every layer is windowed
 
 
+def _yarn_params(rs: dict, dim: int, base: float, max_pos: int):
+    """Yarn NTK-by-part rope scaling (HF modeling_rope_utils.py
+    _compute_yarn_parameters, arXiv:2309.00071): interpolated and
+    extrapolated frequency ladders blended by a per-dim linear ramp
+    between the beta_fast/beta_slow correction bounds. Returns
+    (inv_freq tuple [dim/2], attention_factor, mscale_all_dim_scale) —
+    the last is HF deepseek's separate uniform score multiplier
+    (modeling_deepseek_v3.py:372-377), squared there; we fold its square
+    into the q weights at conversion."""
+    import math
+    factor = float(rs["factor"])
+    beta_fast = float(rs.get("beta_fast") or 32)
+    beta_slow = float(rs.get("beta_slow") or 1)
+    orig = int(rs.get("original_max_position_embeddings") or max_pos)
+    mscale = rs.get("mscale")
+    mscale_all = rs.get("mscale_all_dim")
+
+    def get_mscale(scale, m=1.0):
+        return 1.0 if scale <= 1 else 0.1 * m * math.log(scale) + 1.0
+
+    attn_factor = rs.get("attention_factor")
+    if attn_factor is None:
+        if mscale and mscale_all:
+            attn_factor = get_mscale(factor, mscale) / get_mscale(
+                factor, mscale_all)
+        else:
+            attn_factor = get_mscale(factor)
+
+    def corr_dim(rot):
+        return (dim * math.log(orig / (rot * 2 * math.pi))
+                ) / (2 * math.log(base))
+    low, high = corr_dim(beta_fast), corr_dim(beta_slow)
+    if rs.get("truncate", True):   # HF floor/ceils unless truncate:false
+        low, high = math.floor(low), math.ceil(high)
+    low, high = max(low, 0), min(high, dim - 1)
+    ramp = np.clip((np.arange(dim // 2, dtype=np.float64) - low)
+                   / max(high - low, 1e-3), 0.0, 1.0)
+    pos_freqs = base ** (np.arange(0, dim, 2, dtype=np.float64) / dim)
+    inv_freq = (1.0 / (factor * pos_freqs)) * ramp \
+        + (1.0 / pos_freqs) * (1.0 - ramp)
+    score_scale = get_mscale(factor, float(mscale_all or 0.0)) \
+        if mscale_all else 1.0
+    return tuple(float(f) for f in inv_freq), float(attn_factor), \
+        float(score_scale)
+
+
+def _rope_scaling_params(hf_config, dim: int, what: str):
+    """Map an HF ``rope_scaling`` dict to (inv_freq tuple | None,
+    attention_factor, score_scale) for cfg.rope_inv_freq /
+    cfg.rope_attn_factor (ops/rope.apply_rope). Covers the schemes whose
+    effect is a static frequency-ladder rewrite — "yarn" (+ deepseek's
+    mscale), "llama3" (Llama 3.1+ NTK-by-part smoothing, HF
+    modeling_rope_utils._compute_llama3_parameters), "linear"
+    (position-interpolation: uniform /factor), "default" — and refuses
+    the rest loudly (silently ignoring rope_scaling would corrupt
+    long-context logits for every scaled checkpoint)."""
+    import math
+    rs = getattr(hf_config, "rope_scaling", None)
+    if not rs:
+        return None, 1.0, 1.0
+    kind = rs.get("rope_type", rs.get("type"))
+    base = float(getattr(hf_config, "rope_theta", 10000.0))
+    if kind == "yarn":
+        return _yarn_params(rs, dim, base,
+                            hf_config.max_position_embeddings)
+    pos_freqs = base ** (np.arange(0, dim, 2, dtype=np.float64) / dim)
+    inv_freq = 1.0 / pos_freqs
+    if kind in (None, "default"):
+        return None, 1.0, 1.0
+    if kind == "linear":
+        return tuple(float(f) for f in inv_freq / float(rs["factor"])), \
+            1.0, 1.0
+    if kind == "llama3":
+        factor = float(rs["factor"])
+        lo_f = float(rs["low_freq_factor"])
+        hi_f = float(rs["high_freq_factor"])
+        old = float(rs.get("original_max_position_embeddings")
+                    or hf_config.max_position_embeddings)
+        wavelen = 2 * math.pi / inv_freq
+        scaled = np.where(wavelen > old / lo_f, inv_freq / factor, inv_freq)
+        smooth = (old / wavelen - lo_f) / (hi_f - lo_f)
+        smoothed = (1 - smooth) * scaled / factor + smooth * scaled
+        medium = ~(wavelen < old / hi_f) & ~(wavelen > old / lo_f)
+        out = np.where(medium, smoothed, scaled)
+        return tuple(float(f) for f in out), 1.0, 1.0
+    raise NotImplementedError(
+        f"{what} rope_scaling type {kind!r} — yarn, llama3 and linear "
+        "convert")
+
+
 # HF hidden_act -> our activation kinds (models/transformer.py _act).
 # "gelu" is the erf form; gelu_new/gelu_pytorch_tanh are the tanh approx.
 _HF_ACT = {"gelu": "gelu_exact", "gelu_new": "gelu",
@@ -110,7 +200,11 @@ def config_from_hf(hf_config) -> ModelConfig:
         # mlp gate/up/down, input/post_attention layernorms), so one
         # conversion family covers them; the deltas are config switches.
         num_experts = getattr(hf_config, "num_local_experts", 0) if mt == "mixtral" else 0
+        inv_freq, attn_factor, _ = _rope_scaling_params(
+            hf_config, getattr(hf_config, "head_dim", None)
+            or hf_config.hidden_size // hf_config.num_attention_heads, mt)
         return ModelConfig(
+            rope_inv_freq=inv_freq, rope_attn_factor=attn_factor,
             name=getattr(hf_config, "name_or_path", mt) or mt,
             family="llama", vocab_size=hf_config.vocab_size,
             hidden_size=hf_config.hidden_size,
@@ -636,6 +730,9 @@ def config_from_hf(hf_config) -> ModelConfig:
                      for t in kinds)
         windowed = win is not None and any(w is not None for w in wins)
         uniform = not windowed or len(set(wins)) == 1
+        q3_inv_freq, q3_attn_factor, _ = _rope_scaling_params(
+            hf_config, getattr(hf_config, "head_dim", None)
+            or hf_config.hidden_size // hf_config.num_attention_heads, mt)
         num_experts = 0
         if mt == "qwen3_moe":
             num_experts = hf_config.num_experts
@@ -665,6 +762,7 @@ def config_from_hf(hf_config) -> ModelConfig:
             activation=_act_from_hf(hf_config.hidden_act),
             gated_mlp=True, position_embedding="rope",
             rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+            rope_inv_freq=q3_inv_freq, rope_attn_factor=q3_attn_factor,
             attn_bias=getattr(hf_config, "attention_bias", False),
             mlp_bias=False, qk_norm="rms_head",
             sliding_window=(wins[0] if windowed and uniform else None),
@@ -681,24 +779,29 @@ def config_from_hf(hf_config) -> ModelConfig:
         # transformer._mla_qkv) and sigmoid/group-limited MoE routing
         # with always-active shared experts (transformer._moe_gates
         # "deepseek_v3"). HF: modeling_deepseek_v3.py.
-        if getattr(hf_config, "rope_scaling", None):
-            raise NotImplementedError(
-                "deepseek_v3 with rope_scaling (yarn mscale folds into "
-                "the attention scale) is not supported")
-        L = hf_config.num_hidden_layers
-        fk = getattr(hf_config, "first_k_dense_replace", 0) or 0
-        if 0 < fk < L:
-            # the stacked-layer scan needs a uniform tree; a dense
-            # prefix + MoE tail is two different MLP shapes
-            raise NotImplementedError(
-                f"deepseek_v3 with mixed dense/MoE layers "
-                f"(0 < first_k_dense_replace={fk} < num_layers={L}); "
-                "all-dense (first_k_dense_replace >= num_layers) and "
-                "all-MoE (== 0) convert")
-        all_dense = fk >= L
-        E = 0 if all_dense else hf_config.n_routed_experts
         nd = hf_config.qk_nope_head_dim
         rd = hf_config.qk_rope_head_dim
+        # the rope ladder spans only the decoupled rope head (dim=rd —
+        # HF's DeepseekV3Config sets head_dim accordingly)
+        inv_freq, attn_factor, score_scale = _rope_scaling_params(
+            hf_config, rd, mt)
+        # yarn's mscale_all_dim multiplier scales SCORES uniformly by
+        # score_scale**2 (HF modeling_deepseek_v3.py:372-377); fold it
+        # into the q weights via the query_pre_attn_scalar absorption
+        # (conversion scales q by sqrt(hd/qpas) — pick qpas so that
+        # equals score_scale**2)
+        qpas = None
+        if score_scale != 1.0:
+            qpas = (nd + rd) / score_scale ** 4
+        L = hf_config.num_hidden_layers
+        fk = getattr(hf_config, "first_k_dense_replace", 0) or 0
+        # fk >= L: every layer dense (num_experts=0). 0 < fk < L: the
+        # shipped V3/V2 layout — a dense prefix segment ahead of the MoE
+        # tail (config.py dense_prefix_layers; the layer scans run the
+        # two stacked segments back to back, transformer.layer_segments)
+        all_dense = fk >= L
+        E = 0 if all_dense else hf_config.n_routed_experts
+        mixed = 0 < fk < L
         return ModelConfig(
             name=getattr(hf_config, "name_or_path", mt) or mt,
             family="deepseek", vocab_size=hf_config.vocab_size,
@@ -715,6 +818,8 @@ def config_from_hf(hf_config) -> ModelConfig:
             rope_theta=getattr(hf_config, "rope_theta", 10000.0),
             rope_interleaved=bool(getattr(hf_config, "rope_interleave",
                                           True)),
+            rope_inv_freq=inv_freq, rope_attn_factor=attn_factor,
+            query_pre_attn_scalar=qpas,
             attn_bias=bool(getattr(hf_config, "attention_bias", False)),
             mlp_bias=False,
             q_lora_rank=getattr(hf_config, "q_lora_rank", None),
@@ -732,6 +837,9 @@ def config_from_hf(hf_config) -> ModelConfig:
             moe_norm_topk=bool(getattr(hf_config, "norm_topk_prob", True)),
             moe_shared_experts=(getattr(hf_config, "n_shared_experts", 0)
                                 or 0) if E else 0,
+            dense_prefix_layers=fk if mixed else 0,
+            dense_intermediate_size=(hf_config.intermediate_size
+                                     if mixed else None),
             tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
                                         False))
     if mt == "granite":
@@ -1014,14 +1122,20 @@ def convert_state_dict(cfg: ModelConfig, sd, dtype=None):
         H, hd = cfg.num_heads, cfg.head_dim
         nd, rd = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim
         vd = cfg.v_head_dim_effective
+        # yarn mscale_all_dim: HF multiplies scores by score_scale**2
+        # uniformly; config_from_hf encoded score_scale**2 as the
+        # query_pre_attn_scalar absorption (qs == sqrt(hd/qpas)) — the
+        # scalar commutes with the projection AND the rope rotation, so
+        # scaling q here is exact
+        qs = (hd / (cfg.query_pre_attn_scalar or hd)) ** 0.5
 
         def q_permute(w):
             """[din, H*hd] with per-head [nope|rope] -> [rope|nope]."""
             w = w.reshape(-1, H, hd)
             return np.concatenate([w[..., nd:], w[..., :nd]],
-                                  axis=-1).reshape(-1, H * hd)
+                                  axis=-1).reshape(-1, H * hd) * qs
 
-        def layer(i):
+        def layer(i, moe):
             p = f"model.layers.{i}."
 
             def lin(n):
@@ -1051,7 +1165,7 @@ def convert_state_dict(cfg: ModelConfig, sd, dtype=None):
             else:
                 lp["q"] = {
                     "w": q_permute(get(p + "self_attn.q_proj.weight").T)}
-            if cfg.is_moe:
+            if moe:
                 lp["router"] = {
                     "w": get(p + "mlp.gate.weight").T,
                     "bias": get(p + "mlp.gate.e_score_correction_bias"),
@@ -1075,11 +1189,16 @@ def convert_state_dict(cfg: ModelConfig, sd, dtype=None):
                 lp["up"] = lin("mlp.up_proj")
                 lp["down"] = lin("mlp.down_proj")
             return lp
+        pref = cfg.dense_prefix_layers
         params = {
             "embed": {"tokens": get("model.embed_tokens.weight")},
-            "layers": _stack([layer(i) for i in range(cfg.num_layers)]),
+            "layers": _stack([layer(i, cfg.is_moe)
+                              for i in range(pref, cfg.num_layers)]),
             "final_norm": {"scale": get("model.norm.weight")},
         }
+        if pref:   # first_k_dense_replace: dense-MLP prefix segment
+            params["layers_dense"] = _stack(
+                [layer(i, False) for i in range(pref)])
         if not cfg.tie_word_embeddings:
             params["lm_head"] = {"w": get("lm_head.weight").T}
     elif fam == "gpt-neox":

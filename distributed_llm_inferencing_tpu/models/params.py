@@ -16,6 +16,18 @@ from distributed_llm_inferencing_tpu.models.config import ModelConfig
 
 def init_params(cfg: ModelConfig, key, dtype=None):
     dtype = dtype or jnp.dtype(cfg.dtype)
+    if cfg.dense_prefix_layers:
+        # deepseek first_k_dense_replace: build the MoE tail and the
+        # dense prefix as two independent stacked segments
+        # (transformer.layer_segments runs them back to back)
+        k1, k2 = jax.random.split(key)
+        kd = cfg.dense_prefix_layers
+        tail = init_params(
+            cfg.replace(dense_prefix_layers=0, dense_intermediate_size=None,
+                        num_layers=cfg.num_layers - kd), k1, dtype)
+        prefix = init_params(cfg.dense_segment_cfg(), k2, dtype)
+        tail["layers_dense"] = prefix["layers"]
+        return tail
     L, D, I = cfg.num_layers, cfg.hidden_size, cfg.intermediate_size
     keys = iter(jax.random.split(key, 64))
 

@@ -242,7 +242,7 @@ register(ModelConfig(
     num_experts=4, num_experts_per_tok=2))
 register(ModelConfig(
     name="tiny-deepseek", family="deepseek", vocab_size=256,
-    hidden_size=64, intermediate_size=32, num_layers=2, num_heads=8,
+    hidden_size=64, intermediate_size=32, num_layers=3, num_heads=8,
     num_kv_heads=8, head_dim=24, qk_nope_head_dim=16, qk_rope_head_dim=8,
     v_head_dim=16, q_lora_rank=32, kv_lora_rank=16,
     max_position_embeddings=128, norm_type="rmsnorm", activation="silu",
@@ -250,4 +250,7 @@ register(ModelConfig(
     attn_bias=False, mlp_bias=False, tie_word_embeddings=False,
     num_experts=4, num_experts_per_tok=2, moe_router="deepseek_v3",
     moe_n_group=2, moe_topk_group=1, moe_routed_scale=2.5,
-    moe_shared_experts=1))
+    moe_shared_experts=1,
+    # the shipped first_k_dense_replace layout: one dense-MLP layer
+    # ahead of the MoE tail (its own stacked segment, layers_dense)
+    dense_prefix_layers=1, dense_intermediate_size=48))

@@ -402,6 +402,58 @@ def _qk_normalize(t, p, cfg: ModelConfig):
     return rms_norm(t, p["scale"], cfg.norm_eps)
 
 
+def layer_segments(params, cfg: ModelConfig):
+    """Execution-ordered layer segments of a (possibly heterogeneous)
+    stack: ``[(layers_tree, segment_cfg, start, count)]``.
+
+    A homogeneous model is one segment. DeepSeek's
+    ``first_k_dense_replace`` layout (cfg.dense_prefix_layers) is two:
+    a dense-MLP prefix (param key ``layers_dense``) ahead of the MoE
+    tail (``layers``). Attention and cache layout are identical across
+    segments — only the MLP half of the block differs — so callers
+    slice their [L, ...]-stacked cache/pool planes by (start, count)
+    and run the same block body under each segment's cfg."""
+    if "layers_dense" not in params:
+        return [(params["layers"], cfg, 0, cfg.num_layers)]
+    k = cfg.dense_prefix_layers
+    return [(params["layers_dense"], cfg.dense_segment_cfg(), 0, k),
+            (params["layers"], cfg, k, cfg.num_layers - k)]
+
+
+def scan_layer_stack(make_body, x, params, cfg: ModelConfig, xs):
+    """Run the block stack over ``x``, segment-aware.
+
+    ``make_body(seg_cfg)`` returns a ``lax.scan`` body
+    ``(carry, (lp, *per_layer_xs)) -> (carry, per_layer_out)``;
+    ``xs`` is a tuple of [L, ...]-stacked per-layer arrays (cache or
+    pool planes). Each segment scans its own stacked tree (or, for the
+    engine's CPU-unrolled per-layer buffer lists, loops Python-side);
+    per-layer outputs are re-stacked and concatenated back to [L, ...]
+    order. Returns (carry, tuple_of_[L,...]_outputs)."""
+    seg_outs = []
+    for layers_seg, seg_cfg, start, n in layer_segments(params, cfg):
+        seg_xs = tuple(p[start:start + n] for p in xs)
+        body = make_body(seg_cfg)
+        if isinstance(layers_seg, (list, tuple)):
+            # unrolled per-layer weight buffers (engine._maybe_unroll_
+            # layers): real per-buffer weights get XLA-CPU's dot kernel
+            outs = []
+            for i, lp in enumerate(layers_seg):
+                x, out = body(x, (lp,) + tuple(p[i] for p in seg_xs))
+                outs.append(out)
+            seg_outs.append(tuple(
+                jnp.stack([o[j] for o in outs])
+                for j in range(len(outs[0]))))
+        else:
+            x, co = jax.lax.scan(body, x, (layers_seg,) + seg_xs)
+            seg_outs.append(co)
+    if len(seg_outs) == 1:
+        return x, seg_outs[0]
+    cat = tuple(jnp.concatenate([so[j] for so in seg_outs], axis=0)
+                for j in range(len(seg_outs[0])))
+    return x, cat
+
+
 def _mla_qkv(h, lp, cfg: ModelConfig, q_positions):
     """DeepSeek-V3 multi-head latent attention projections (HF
     modeling_deepseek_v3.py:327-446). q and kv pass through low-rank
@@ -433,13 +485,17 @@ def _mla_qkv(h, lp, cfg: ModelConfig, q_positions):
     else:
         q = _linear(h, lp["q"]).reshape(B, s, H, hd)
     q_rot = apply_rope(q[..., :rd], q_positions, cfg.rope_theta,
-                       interleaved=cfg.rope_interleaved)
+                       interleaved=cfg.rope_interleaved,
+                       inv_freq=cfg.rope_inv_freq,
+                       attn_factor=cfg.rope_attn_factor)
     q = jnp.concatenate([q_rot, q[..., rd:]], axis=-1)
 
     ckv = _linear(h, lp["kv_a"])                         # [B,s,r+rd]
     k_rot = apply_rope(ckv[..., r:][:, :, None, :], q_positions,
                        cfg.rope_theta,
-                       interleaved=cfg.rope_interleaved)  # [B,s,1,rd]
+                       interleaved=cfg.rope_interleaved,
+                       inv_freq=cfg.rope_inv_freq,
+                       attn_factor=cfg.rope_attn_factor)  # [B,s,1,rd]
     c = norm(ckv[..., :r], lp["kv_a_norm"], "rmsnorm", cfg.norm_eps)
     k_nope = _linear(c, lp["kv_b_k"]).reshape(B, s, H, hd - rd)
     v = _linear(c, lp["kv_b_v"]).reshape(B, s, H, vd)
@@ -482,9 +538,11 @@ def _block_body(x, lp, cfg: ModelConfig, q_positions, attend_write):
 
         if cfg.position_embedding == "rope":
             q = apply_rope(q, q_positions, cfg.rope_theta, cfg.rope_pct,
-                           cfg.rope_interleaved)
+                           cfg.rope_interleaved, inv_freq=cfg.rope_inv_freq,
+                           attn_factor=cfg.rope_attn_factor)
             k = apply_rope(k, q_positions, cfg.rope_theta, cfg.rope_pct,
-                           cfg.rope_interleaved)
+                           cfg.rope_interleaved, inv_freq=cfg.rope_inv_freq,
+                           attn_factor=cfg.rope_attn_factor)
 
     attn, cache_out = attend_write(q, k, v)
     vd = cfg.v_head_dim_effective
@@ -630,37 +688,23 @@ def forward(
     backend = _cfg_backend(cfg, jax.device_count())
 
     # one body serves both cache layouts: scale planes ride the scan xs
-    # only when the cache is quantized
-    def body(x, layer_in):
-        lp, ck, cv, *scales = layer_in
-        out = _block(
-            x, lp, ck, cv, cfg=cfg, q_positions=q_positions,
-            write_starts=write_starts, new_lengths=new_lengths,
-            is_prefill=is_prefill, backend=backend, mesh=mesh,
-            cache_ks=scales[0] if scales else None,
-            cache_vs=scales[1] if scales else None)
-        return out[0], tuple(out[1:])
+    # only when the cache is quantized. (The unrolled-list and
+    # dense-prefix segment dispatch live in scan_layer_stack.)
+    def make_body(seg_cfg):
+        def body(x, layer_in):
+            lp, ck, cv, *scales = layer_in
+            out = _block(
+                x, lp, ck, cv, cfg=seg_cfg, q_positions=q_positions,
+                write_starts=write_starts, new_lengths=new_lengths,
+                is_prefill=is_prefill, backend=backend, mesh=mesh,
+                cache_ks=scales[0] if scales else None,
+                cache_vs=scales[1] if scales else None)
+            return out[0], tuple(out[1:])
+        return body
 
-    layers = params["layers"]
     cache_xs = (cache.k, cache.v) + (
         (cache.k_scale, cache.v_scale) if cache.quantized else ())
-    if isinstance(layers, (list, tuple)):
-        # Unrolled layer loop over per-layer weight trees that are SEPARATE
-        # device buffers (engine._maybe_unroll_layers). XLA-CPU lowers an
-        # M<=2 dot whose weight operand is a scan/static slice of a stacked
-        # [L, ...] array to a naive kLoop fusion (~7x slower than the dot
-        # kernel: 290 vs 39 ms/step for gpt2 f32) — real per-buffer weights
-        # get the dot kernel and let batch-1 decode run without the dummy
-        # second row. Cache planes stay stacked; their static slices only
-        # feed small attention ops where the fusion penalty is noise.
-        outs = []
-        for i, lp in enumerate(layers):
-            x, out = body(x, (lp,) + tuple(p[i] for p in cache_xs))
-            outs.append(out)
-        cache_out = tuple(
-            jnp.stack([o[j] for o in outs]) for j in range(len(outs[0])))
-    else:
-        x, cache_out = jax.lax.scan(body, x, (layers,) + cache_xs)
+    x, cache_out = scan_layer_stack(make_body, x, params, cfg, cache_xs)
     logits = unembed(params, cfg, x)
     planes = dict(zip(("k", "v", "k_scale", "v_scale"), cache_out))
     return logits, KVCache(lengths=new_lengths, **planes)
@@ -725,39 +769,43 @@ def paged_decode_step(params, cfg: ModelConfig, tokens, paged,
     x = embed(params, cfg, tokens[:, None], q_pos)      # [R, 1, D]
     quantized = paged.quantized
 
-    def body(x, layer_in):
-        lp, ck, cv, *scales = layer_in                  # ck: [NB, bs, Hkv, hd]
+    def make_body(seg_cfg):
+        def body(x, layer_in):
+            lp, ck, cv, *scales = layer_in              # ck: [NB, bs, Hkv, hd]
 
-        def attend_write(q, k, v):
-            if quantized:
-                from distributed_llm_inferencing_tpu.ops.kvcache import (
-                    quant_kv)
-                cks, cvs = scales
-                k8, ks = quant_kv(k[:, 0])
-                v8, vs = quant_kv(v[:, 0])
-                nk = write_token(ck, k8, block_tables, context_lens)
-                nv = write_token(cv, v8, block_tables, context_lens)
-                nks = write_token(cks, ks, block_tables, context_lens)
-                nvs = write_token(cvs, vs, block_tables, context_lens)
+            def attend_write(q, k, v):
+                if quantized:
+                    from distributed_llm_inferencing_tpu.ops.kvcache import (
+                        quant_kv)
+                    cks, cvs = scales
+                    k8, ks = quant_kv(k[:, 0])
+                    v8, vs = quant_kv(v[:, 0])
+                    nk = write_token(ck, k8, block_tables, context_lens)
+                    nv = write_token(cv, v8, block_tables, context_lens)
+                    nks = write_token(cks, ks, block_tables, context_lens)
+                    nvs = write_token(cvs, vs, block_tables, context_lens)
+                    attn = paged_attend_decode(
+                        q, nk, nv, block_tables, context_lens + 1,
+                        sliding_window=_layer_window(seg_cfg, lp),
+                        backend=backend,
+                        k_scale_layer=nks, v_scale_layer=nvs,
+                        alibi=_alibi(seg_cfg), softcap=seg_cfg.attn_softcap)
+                    return attn, (nk, nv, nks, nvs)
+                nk = write_token(ck, k[:, 0], block_tables, context_lens)
+                nv = write_token(cv, v[:, 0], block_tables, context_lens)
                 attn = paged_attend_decode(
                     q, nk, nv, block_tables, context_lens + 1,
-                    sliding_window=_layer_window(cfg, lp), backend=backend,
-                    k_scale_layer=nks, v_scale_layer=nvs,
-                    alibi=_alibi(cfg), softcap=cfg.attn_softcap)
-                return attn, (nk, nv, nks, nvs)
-            nk = write_token(ck, k[:, 0], block_tables, context_lens)
-            nv = write_token(cv, v[:, 0], block_tables, context_lens)
-            attn = paged_attend_decode(
-                q, nk, nv, block_tables, context_lens + 1,
-                sliding_window=_layer_window(cfg, lp), backend=backend,
-                alibi=_alibi(cfg), softcap=cfg.attn_softcap)
-            return attn, (nk, nv)
+                    sliding_window=_layer_window(seg_cfg, lp),
+                    backend=backend,
+                    alibi=_alibi(seg_cfg), softcap=seg_cfg.attn_softcap)
+                return attn, (nk, nv)
 
-        return _block_body(x, lp, cfg, q_pos, attend_write)
+            return _block_body(x, lp, seg_cfg, q_pos, attend_write)
+        return body
 
-    xs = (params["layers"], paged.k, paged.v) + (
+    xs = (paged.k, paged.v) + (
         (paged.k_scale, paged.v_scale) if quantized else ())
-    x, cache_out = jax.lax.scan(body, x, xs)
+    x, cache_out = scan_layer_stack(make_body, x, params, cfg, xs)
     logits = unembed(params, cfg, x)[:, 0]              # [R, V]
     return logits, PagedKVCache(*cache_out)
 
@@ -865,44 +913,49 @@ def paged_decode_chunk(params, cfg: ModelConfig, k: int, tokens, paged,
         side_valid = jnp.broadcast_to(
             jnp.arange(k, dtype=jnp.int32)[None, :] <= t, (r, k))
 
-        def layer(x, layer_in):
-            if pre:
-                lp, sk, sv, kp, vp = layer_in
-            elif quantized:
-                from distributed_llm_inferencing_tpu.ops.kvcache import (
-                    dequant_kv)
-                lp, sk, sv, ck, cv, cks, cvs = layer_in
-                kp = dequant_kv(gather_seq(ck, block_tables),
-                                gather_seq(cks, block_tables), dt)
-                vp = dequant_kv(gather_seq(cv, block_tables),
-                                gather_seq(cvs, block_tables), dt)
-            else:
-                lp, sk, sv, ck, cv = layer_in
-                kp, vp = gather_seq(ck, block_tables), gather_seq(
-                    cv, block_tables)
+        def make_layer(seg_cfg):
+            def layer(x, layer_in):
+                if pre:
+                    lp, sk, sv, kp, vp = layer_in
+                elif quantized:
+                    from distributed_llm_inferencing_tpu.ops.kvcache import (
+                        dequant_kv)
+                    lp, sk, sv, ck, cv, cks, cvs = layer_in
+                    kp = dequant_kv(gather_seq(ck, block_tables),
+                                    gather_seq(cks, block_tables), dt)
+                    vp = dequant_kv(gather_seq(cv, block_tables),
+                                    gather_seq(cvs, block_tables), dt)
+                else:
+                    lp, sk, sv, ck, cv = layer_in
+                    kp, vp = gather_seq(ck, block_tables), gather_seq(
+                        cv, block_tables)
 
-            def attend_write(q, kh, vh):
-                sk2 = jax.lax.dynamic_update_slice(sk, kh.astype(dt),
-                                                   (0, t, 0, 0))
-                sv2 = jax.lax.dynamic_update_slice(sv, vh.astype(dt),
-                                                   (0, t, 0, 0))
-                attn = attend(
-                    q,
-                    jnp.concatenate([kp, sk2], axis=1),
-                    jnp.concatenate([vp, sv2], axis=1),
-                    q_pos,
-                    jnp.concatenate([pool_pos, side_pos], axis=1),
-                    jnp.concatenate([pool_valid, side_valid], axis=1),
-                    sliding_window=_layer_window(cfg, lp), alibi=_alibi(cfg), softcap=cfg.attn_softcap)
-                return attn, (sk2, sv2)
+                def attend_write(q, kh, vh):
+                    sk2 = jax.lax.dynamic_update_slice(sk, kh.astype(dt),
+                                                       (0, t, 0, 0))
+                    sv2 = jax.lax.dynamic_update_slice(sv, vh.astype(dt),
+                                                       (0, t, 0, 0))
+                    attn = attend(
+                        q,
+                        jnp.concatenate([kp, sk2], axis=1),
+                        jnp.concatenate([vp, sv2], axis=1),
+                        q_pos,
+                        jnp.concatenate([pool_pos, side_pos], axis=1),
+                        jnp.concatenate([pool_valid, side_valid], axis=1),
+                        sliding_window=_layer_window(seg_cfg, lp),
+                        alibi=_alibi(seg_cfg), softcap=seg_cfg.attn_softcap)
+                    return attn, (sk2, sv2)
 
-            x, (sk2, sv2) = _block_body(x, lp, cfg, q_pos, attend_write)
-            return x, (sk2, sv2)
+                x, (sk2, sv2) = _block_body(x, lp, seg_cfg, q_pos,
+                                            attend_write)
+                return x, (sk2, sv2)
+            return layer
 
-        xs = (params["layers"], side_k, side_v, pool_k, pool_v)
+        xs = (side_k, side_v, pool_k, pool_v)
         if quantized and not pre:
             xs = xs + (paged.k_scale, paged.v_scale)
-        x2, (side_k, side_v) = jax.lax.scan(layer, x, xs)
+        x2, (side_k, side_v) = scan_layer_stack(make_layer, x, params, cfg,
+                                                xs)
         logits = unembed(params, cfg, x2)[:, 0]
         nxt = sample_batch(logits, seeds, steps0 + t, temps, tks, tps, ds)
         is_eos = alive & (eos_ids >= 0) & (nxt == eos_ids)
@@ -1074,44 +1127,49 @@ def paged_speculative_chunk(params, cfg: ModelConfig, k: int, gamma: int,
         is_cur_block = jnp.broadcast_to(entry_step == t, (r, E))
         side_valid = acc_mask | is_cur_block
 
-        def layer(x, layer_in):
-            if pre:
-                lp, sk, sv, kp, vp = layer_in
-            elif quantized:
-                from distributed_llm_inferencing_tpu.ops.kvcache import (
-                    dequant_kv)
-                lp, sk, sv, ck, cv, cks, cvs = layer_in
-                kp = dequant_kv(gather_seq(ck, block_tables),
-                                gather_seq(cks, block_tables), dt)
-                vp = dequant_kv(gather_seq(cv, block_tables),
-                                gather_seq(cvs, block_tables), dt)
-            else:
-                lp, sk, sv, ck, cv = layer_in
-                kp, vp = gather_seq(ck, block_tables), gather_seq(
-                    cv, block_tables)
+        def make_layer(seg_cfg):
+            def layer(x, layer_in):
+                if pre:
+                    lp, sk, sv, kp, vp = layer_in
+                elif quantized:
+                    from distributed_llm_inferencing_tpu.ops.kvcache import (
+                        dequant_kv)
+                    lp, sk, sv, ck, cv, cks, cvs = layer_in
+                    kp = dequant_kv(gather_seq(ck, block_tables),
+                                    gather_seq(cks, block_tables), dt)
+                    vp = dequant_kv(gather_seq(cv, block_tables),
+                                    gather_seq(cvs, block_tables), dt)
+                else:
+                    lp, sk, sv, ck, cv = layer_in
+                    kp, vp = gather_seq(ck, block_tables), gather_seq(
+                        cv, block_tables)
 
-            def attend_write(q, kh, vh):
-                sk2 = jax.lax.dynamic_update_slice(sk, kh.astype(dt),
-                                                   (0, t * g1, 0, 0))
-                sv2 = jax.lax.dynamic_update_slice(sv, vh.astype(dt),
-                                                   (0, t * g1, 0, 0))
-                attn = attend(
-                    q,
-                    jnp.concatenate([kp, sk2], axis=1),
-                    jnp.concatenate([vp, sv2], axis=1),
-                    qp,
-                    jnp.concatenate([pool_pos, side_pos], axis=1),
-                    jnp.concatenate([pool_valid, side_valid], axis=1),
-                    sliding_window=_layer_window(cfg, lp), alibi=_alibi(cfg), softcap=cfg.attn_softcap)
-                return attn, (sk2, sv2)
+                def attend_write(q, kh, vh):
+                    sk2 = jax.lax.dynamic_update_slice(sk, kh.astype(dt),
+                                                       (0, t * g1, 0, 0))
+                    sv2 = jax.lax.dynamic_update_slice(sv, vh.astype(dt),
+                                                       (0, t * g1, 0, 0))
+                    attn = attend(
+                        q,
+                        jnp.concatenate([kp, sk2], axis=1),
+                        jnp.concatenate([vp, sv2], axis=1),
+                        qp,
+                        jnp.concatenate([pool_pos, side_pos], axis=1),
+                        jnp.concatenate([pool_valid, side_valid], axis=1),
+                        sliding_window=_layer_window(seg_cfg, lp),
+                        alibi=_alibi(seg_cfg), softcap=seg_cfg.attn_softcap)
+                    return attn, (sk2, sv2)
 
-            x, (sk2, sv2) = _block_body(x, lp, cfg, qp, attend_write)
-            return x, (sk2, sv2)
+                x, (sk2, sv2) = _block_body(x, lp, seg_cfg, qp,
+                                            attend_write)
+                return x, (sk2, sv2)
+            return layer
 
-        xs = (params["layers"], side_k, side_v, pool_k, pool_v)
+        xs = (side_k, side_v, pool_k, pool_v)
         if quantized and not pre:
             xs = xs + (paged.k_scale, paged.v_scale)
-        x2, (side_k, side_v) = jax.lax.scan(layer, x, xs)
+        x2, (side_k, side_v) = scan_layer_stack(make_layer, x, params, cfg,
+                                                xs)
         logits = unembed(params, cfg, x2)                 # [R, g1, V] f32
 
         # per-row acceptance (ops/speculative.py): greedy rows accept
@@ -1228,40 +1286,44 @@ def paged_prefill_tail(params, cfg: ModelConfig, tokens, tail_len,
     x = embed(params, cfg, tokens, q_pos)
     quantized = paged.quantized
 
-    def body(x, layer_in):
-        lp, ck, cv, *scales = layer_in
+    def make_body(seg_cfg):
+        def body(x, layer_in):
+            lp, ck, cv, *scales = layer_in
 
-        def attend_write(q, k, v):
-            if quantized:
-                # store int8 + scales; the tail attends its own fresh bf16
-                # K/V plus the dequantized cached prefix
-                from distributed_llm_inferencing_tpu.ops.kvcache import (
-                    quant_kv)
-                cks, cvs = scales
-                k8, ks = quant_kv(k)
-                v8, vs = quant_kv(v)
-                nk = write_block_run(ck, k8, tail_blocks)
-                nv = write_block_run(cv, v8, tail_blocks)
-                nks = write_block_run(cks, ks, tail_blocks)
-                nvs = write_block_run(cvs, vs, tail_blocks)
+            def attend_write(q, k, v):
+                if quantized:
+                    # store int8 + scales; the tail attends its own fresh
+                    # bf16 K/V plus the dequantized cached prefix
+                    from distributed_llm_inferencing_tpu.ops.kvcache import (
+                        quant_kv)
+                    cks, cvs = scales
+                    k8, ks = quant_kv(k)
+                    v8, vs = quant_kv(v)
+                    nk = write_block_run(ck, k8, tail_blocks)
+                    nv = write_block_run(cv, v8, tail_blocks)
+                    nks = write_block_run(cks, ks, tail_blocks)
+                    nvs = write_block_run(cvs, vs, tail_blocks)
+                    attn = paged_attend_prefix(
+                        q, k, v, nk, nv, prefix_blocks, prefix_len, q_pos,
+                        tail_valid,
+                        sliding_window=_layer_window(seg_cfg, lp),
+                        k_scale_layer=nks, v_scale_layer=nvs,
+                        alibi=_alibi(seg_cfg), softcap=seg_cfg.attn_softcap)
+                    return attn, (nk, nv, nks, nvs)
+                nk = write_block_run(ck, k, tail_blocks)
+                nv = write_block_run(cv, v, tail_blocks)
                 attn = paged_attend_prefix(
                     q, k, v, nk, nv, prefix_blocks, prefix_len, q_pos,
-                    tail_valid, sliding_window=_layer_window(cfg, lp),
-                    k_scale_layer=nks, v_scale_layer=nvs,
-                    alibi=_alibi(cfg), softcap=cfg.attn_softcap)
-                return attn, (nk, nv, nks, nvs)
-            nk = write_block_run(ck, k, tail_blocks)
-            nv = write_block_run(cv, v, tail_blocks)
-            attn = paged_attend_prefix(
-                q, k, v, nk, nv, prefix_blocks, prefix_len, q_pos, tail_valid,
-                sliding_window=_layer_window(cfg, lp), alibi=_alibi(cfg), softcap=cfg.attn_softcap)
-            return attn, (nk, nv)
+                    tail_valid, sliding_window=_layer_window(seg_cfg, lp),
+                    alibi=_alibi(seg_cfg), softcap=seg_cfg.attn_softcap)
+                return attn, (nk, nv)
 
-        return _block_body(x, lp, cfg, q_pos, attend_write)
+            return _block_body(x, lp, seg_cfg, q_pos, attend_write)
+        return body
 
-    xs = (params["layers"], paged.k, paged.v) + (
+    xs = (paged.k, paged.v) + (
         (paged.k_scale, paged.v_scale) if quantized else ())
-    x, cache_out = jax.lax.scan(body, x, xs)
+    x, cache_out = scan_layer_stack(make_body, x, params, cfg, xs)
     new_paged = PagedKVCache(*cache_out)
     # project only the last real position through the vocab head ([D,V] over
     # one row per sequence, not T padded rows)

@@ -199,17 +199,21 @@ def quantize_params(params, cfg, donate: bool = False,
     """
     if not donate:
         params = dict(params)
-        params["layers"] = dict(params["layers"])
-    layers = params["layers"]
-    for name in _LINEAR_LEAVES:
-        if name in layers:
-            layers[name] = _quant_linear(layers[name], donate, mode)
-    if "experts" in layers:
+    for seg in ("layers", "layers_dense"):
+        if seg not in params:
+            continue
         if not donate:
-            layers["experts"] = dict(layers["experts"])
-        for k in layers["experts"]:
-            layers["experts"][k] = _quant_linear(
-                layers["experts"][k], donate, mode)
+            params[seg] = dict(params[seg])
+        layers = params[seg]
+        for name in _LINEAR_LEAVES:
+            if name in layers:
+                layers[name] = _quant_linear(layers[name], donate, mode)
+        if "experts" in layers:
+            if not donate:
+                layers["experts"] = dict(layers["experts"])
+            for k in layers["experts"]:
+                layers["experts"][k] = _quant_linear(
+                    layers["experts"][k], donate, mode)
     if "lm_head" in params:
         params["lm_head"] = _quant_linear(params["lm_head"], donate, mode)
     return params

@@ -16,7 +16,8 @@ def rope_freqs(head_dim: int, theta: float):
 
 
 def apply_rope(x, positions, theta: float, pct: float = 1.0,
-               interleaved: bool = False):
+               interleaved: bool = False, inv_freq=None,
+               attn_factor: float = 1.0):
     """Apply RoPE.
 
     x: [B, S, H, hd]; positions: [B, S] int32 absolute positions.
@@ -27,19 +28,28 @@ def apply_rope(x, positions, theta: float, pct: float = 1.0,
     checkpoints stay bit-compatible. ``interleaved`` switches pairing to
     GPT-J's rotate_every_two convention: frequency i rotates dims
     (2i, 2i+1) instead of the half-split (i, i + rot/2).
+
+    ``inv_freq`` overrides the plain theta ladder with a precomputed
+    [rot/2] frequency ladder (context-extension schemes — yarn's
+    NTK-by-part interpolation; models/convert.py computes it once per
+    checkpoint, config.rope_inv_freq carries it). ``attn_factor``
+    scales cos AND sin (yarn attention_factor: each rotated side picks
+    up the factor, so scores scale by its square over the rotated dims).
     Returns same shape/dtype as x.
     """
     hd = x.shape[-1]
     rot = int(hd * pct)
     if rot < hd:
         rotated = apply_rope(x[..., :rot], positions, theta,
-                             interleaved=interleaved)
+                             interleaved=interleaved, inv_freq=inv_freq,
+                             attn_factor=attn_factor)
         return jnp.concatenate([rotated, x[..., rot:]], axis=-1)
-    inv_freq = rope_freqs(hd, theta)  # [hd/2]
+    inv_freq = (rope_freqs(hd, theta) if inv_freq is None
+                else jnp.asarray(inv_freq, jnp.float32))  # [hd/2]
     # angles: [B, S, hd/2]
     angles = positions.astype(jnp.float32)[..., None] * inv_freq
-    cos = jnp.cos(angles)[:, :, None, :]  # [B,S,1,hd/2]
-    sin = jnp.sin(angles)[:, :, None, :]
+    cos = jnp.cos(angles)[:, :, None, :] * attn_factor  # [B,S,1,hd/2]
+    sin = jnp.sin(angles)[:, :, None, :] * attn_factor
     xf = x.astype(jnp.float32)
     if interleaved:
         x1, x2 = xf[..., 0::2], xf[..., 1::2]

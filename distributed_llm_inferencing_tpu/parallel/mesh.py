@@ -93,8 +93,24 @@ def validate_spec(spec: MeshSpec, cfg) -> None:
     if cfg.intermediate_size % spec.tp:
         raise ValueError(
             f"tp={spec.tp} must divide intermediate_size={cfg.intermediate_size}")
+    if getattr(cfg, "dense_intermediate_size", None) and \
+            cfg.dense_intermediate_size % spec.tp:
+        # mixed stacks: cfg.intermediate_size is the per-expert width;
+        # the dense prefix has its own MLP width to divide
+        raise ValueError(
+            f"tp={spec.tp} must divide dense_intermediate_size="
+            f"{cfg.dense_intermediate_size} (the mixed stack's dense-"
+            "prefix MLP width)")
     if cfg.num_layers % spec.pp:
         raise ValueError(f"pp={spec.pp} must divide num_layers={cfg.num_layers}")
+    if spec.pp > 1 and getattr(cfg, "dense_prefix_layers", 0):
+        # the GPipe stage split assumes ONE uniformly-stacked layer tree
+        # to shard over pp; deepseek's dense-prefix + MoE-tail stack is
+        # two segments (transformer.layer_segments). tp/dp/sp/ep compose.
+        raise NotImplementedError(
+            "pipeline parallelism over a mixed dense/MoE stack "
+            "(dense_prefix_layers > 0) is not supported — use tp/ep for "
+            "this model, or convert an all-MoE/all-dense variant")
     # (sp + alibi needs no refusal: the ring bodies carry the linear
     # position bias — slopes shard over tp with the heads, parallel/ring.py)
     # (sp + pp needs no refusal: the pipelined executor routes per-stage

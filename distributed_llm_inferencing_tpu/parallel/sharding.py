@@ -38,6 +38,18 @@ def kv_head_axis(num_kv_heads: int, tp: int):
 def param_specs(cfg: ModelConfig, spec: MeshSpec,
                 shard_layers_over_pp: bool = True) -> Dict[str, Any]:
     """PartitionSpec pytree matching models/transformer.py's param schema."""
+    if cfg.dense_prefix_layers:
+        # deepseek mixed stack: the dense prefix carries the plain-MLP
+        # layer schema as its own stacked segment (pp would shard the
+        # two segments independently — refused upstream, mesh.validate)
+        tail = param_specs(
+            cfg.replace(dense_prefix_layers=0, dense_intermediate_size=None,
+                        num_layers=cfg.num_layers - cfg.dense_prefix_layers),
+            spec, shard_layers_over_pp)
+        prefix = param_specs(cfg.dense_segment_cfg(), spec,
+                             shard_layers_over_pp)
+        tail["layers_dense"] = prefix["layers"]
+        return tail
     kv_tp = kv_head_axis(cfg.num_kv_heads, spec.tp)
     L = "pp" if shard_layers_over_pp else None
 
@@ -223,25 +235,29 @@ def shard_params(params, mesh: Mesh, cfg: ModelConfig, spec: MeshSpec):
         from distributed_llm_inferencing_tpu.ops.quant import (
             repack_int4_rows)
         params = dict(params)
-        params["layers"] = dict(params["layers"])
-        specs["layers"] = dict(specs["layers"])
-        for name in ("o", "down"):
-            leaf = params["layers"].get(name)
-            if not (isinstance(leaf, dict) and "p4" in leaf):
+        for seg in ("layers", "layers_dense"):
+            if seg not in params:
                 continue
-            try:
-                leaf = repack_int4_rows(leaf, spec.tp)
-            except ValueError:
+            params[seg] = dict(params[seg])
+            specs[seg] = dict(specs[seg])
+            for name in ("o", "down", "shared_down"):
+                leaf = params[seg].get(name)
+                if not (isinstance(leaf, dict) and "p4" in leaf):
+                    continue
+                try:
+                    leaf = repack_int4_rows(leaf, spec.tp)
+                except ValueError:
+                    if "chunked" in leaf:
+                        # chunked for a DIFFERENT tp: sharding it would
+                        # be silently wrong — the caller must
+                        # reload/repack
+                        raise
+                    # non-divisible din: keep global layout + XLA path
+                params[seg][name] = leaf
                 if "chunked" in leaf:
-                    # chunked for a DIFFERENT tp: sharding it would be
-                    # silently wrong — the caller must reload/repack
-                    raise
-                # non-divisible din: keep global layout + XLA path
-            params["layers"][name] = leaf
-            if "chunked" in leaf:
-                ls = dict(specs["layers"][name])
-                # marker mirrors p4's stacked layer axis for the scan
-                ls["chunked"] = P(*(ls["p4"][:-2] + (None, None)))
-                specs["layers"][name] = ls
+                    ls = dict(specs[seg][name])
+                    # marker mirrors p4's stacked layer axis for the scan
+                    ls["chunked"] = P(*(ls["p4"][:-2] + (None, None)))
+                    specs[seg][name] = ls
     shardings = named(mesh, specs)
     return jax.device_put(params, shardings)

@@ -138,11 +138,16 @@ class InferenceEngine:
             return
         if self.cfg.num_layers > self.UNROLL_MAX_LAYERS and flag is None:
             return
-        stacked = self.params["layers"]
         self.params = dict(self.params)
-        self.params["layers"] = [
-            jax.tree.map(lambda a, i=i: a[i], stacked)
-            for i in range(self.cfg.num_layers)]
+        k = self.cfg.dense_prefix_layers
+        for key, n in (("layers_dense", k),
+                       ("layers", self.cfg.num_layers - k)):
+            if key not in self.params:
+                continue
+            stacked = self.params[key]
+            self.params[key] = [
+                jax.tree.map(lambda a, i=i: a[i], stacked)
+                for i in range(n)]
         self._layers_unrolled = True
         self._maybe_repack_cpu()
 
@@ -191,10 +196,11 @@ class InferenceEngine:
         # only the big matmul leaves (ops/quant.py's set): the router is
         # read raw by _moe_gates and norms carry no "w"
         from distributed_llm_inferencing_tpu.ops.quant import _LINEAR_LEAVES
-        for lp in self.params["layers"]:
-            for name in _LINEAR_LEAVES:
-                if name in lp:
-                    lp[name] = repack(lp[name])
+        for key in ("layers", "layers_dense"):
+            for lp in self.params.get(key, ()):
+                for name in _LINEAR_LEAVES:
+                    if name in lp:
+                        lp[name] = repack(lp[name])
         if "lm_head" in self.params:
             self.params["lm_head"] = repack(self.params["lm_head"])
         # the tied-head table is the single largest per-token read for

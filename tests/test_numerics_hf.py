@@ -1199,11 +1199,18 @@ def test_deepseek_v3_moe_matches_hf():
     _check_model(model, tokens)
 
 
-def test_deepseek_v3_mixed_dense_moe_refused():
+def test_deepseek_v3_mixed_stack_refuses_pp():
+    """The GPipe stage split assumes one uniformly-stacked layer tree;
+    a mixed stack under pp is refused at plan time with a named error
+    (parallel/mesh.validate_spec)."""
     import transformers
-    torch_cfg = _deepseek_cfg(first_k_dense_replace=1)
-    with pytest.raises(NotImplementedError, match="first_k_dense_replace"):
-        convert.config_from_hf(torch_cfg)
+    from distributed_llm_inferencing_tpu.parallel.mesh import (
+        MeshSpec, validate_spec)
+    cfg = convert.config_from_hf(_deepseek_cfg(
+        first_k_dense_replace=1, num_hidden_layers=4))
+    with pytest.raises(NotImplementedError, match="mixed dense/MoE"):
+        validate_spec(MeshSpec(pp=2), cfg)
+    validate_spec(MeshSpec(tp=2, ep=2), cfg)   # tp/ep compose fine
 
 
 def test_deepseek_v3_decode_and_batcher_match_hf_generate():
@@ -1245,3 +1252,163 @@ def test_deepseek_v3_decode_and_batcher_match_hf_generate():
     while b.step():
         pass
     assert r.error is None and r.tokens == want
+
+
+def test_deepseek_v3_yarn_rope_scaling_matches_hf():
+    """Yarn context extension: NTK-by-part interpolated rope ladder
+    (cfg.rope_inv_freq), the attention_factor on cos/sin, AND the
+    separate mscale_all_dim uniform score multiplier (folded into the q
+    weights via the query_pre_attn_scalar absorption). mscale !=
+    mscale_all_dim so both mechanisms are exercised; seq length runs
+    past original_max_position_embeddings so the extension bites."""
+    import torch
+    import transformers
+    torch_cfg = _deepseek_cfg(
+        first_k_dense_replace=3,
+        rope_scaling={"rope_type": "yarn", "factor": 4.0,
+                      "original_max_position_embeddings": 16,
+                      "beta_fast": 32, "beta_slow": 1,
+                      "mscale": 0.8, "mscale_all_dim": 1.2})
+    torch.manual_seed(44)
+    model = transformers.DeepseekV3ForCausalLM(torch_cfg).eval()
+    cfg, params = convert.load_hf_model(model, dtype=jnp.float32)
+    assert cfg.rope_inv_freq is not None and len(cfg.rope_inv_freq) == 4
+    assert cfg.rope_attn_factor != 1.0
+    assert cfg.query_pre_attn_scalar is not None
+    rng = np.random.default_rng(44)
+    tokens = rng.integers(0, 128, size=(1, 24), dtype=np.int64)
+    _check_model(model, tokens)
+
+
+def test_deepseek_v3_mixed_dense_moe_matches_hf():
+    """The SHIPPED DeepSeek layout: first_k_dense_replace dense-MLP
+    layers ahead of the MoE tail. The param tree carries the prefix as
+    its own stacked segment (layers_dense) and the layer scans run the
+    two segments back to back (transformer.layer_segments)."""
+    import torch
+    import transformers
+    torch_cfg = _deepseek_cfg(first_k_dense_replace=1)
+    torch.manual_seed(45)
+    model = transformers.DeepseekV3ForCausalLM(torch_cfg).eval()
+    cfg, params = convert.load_hf_model(model, dtype=jnp.float32)
+    assert cfg.dense_prefix_layers == 1 and cfg.num_experts == 8
+    assert cfg.dense_intermediate_size == 64
+    assert "layers_dense" in params
+    assert params["layers_dense"]["up"]["w"].shape == (1, 32, 64)
+    assert params["layers"]["experts"]["up"]["w"].shape == (2, 8, 32, 16)
+    rng = np.random.default_rng(45)
+    tokens = rng.integers(0, 128, size=(2, 10), dtype=np.int64)
+    _check_model(model, tokens)
+
+
+def test_deepseek_v3_mixed_decode_and_batcher_match_hf_generate():
+    """Mixed stack through the real serving paths: greedy decode via the
+    engine (dense cache + CPU layer-unroll eligibility) and via the
+    paged continuous batcher, both ≡ HF generate."""
+    import torch
+    import transformers
+    from distributed_llm_inferencing_tpu.ops.sampling import SamplingParams
+    from distributed_llm_inferencing_tpu.runtime.batcher import (
+        ContinuousBatcher)
+    from distributed_llm_inferencing_tpu.runtime.engine import (
+        InferenceEngine)
+
+    torch_cfg = _deepseek_cfg(first_k_dense_replace=1)
+    torch.manual_seed(46)
+    model = transformers.DeepseekV3ForCausalLM(torch_cfg).eval()
+    cfg, params = convert.load_hf_model(model, dtype=jnp.float32)
+    cfg = cfg.replace(dtype="float32")
+
+    rng = np.random.default_rng(46)
+    prompt = rng.integers(0, 128, 8).tolist()
+    with torch.no_grad():
+        want = model.generate(
+            torch.tensor([prompt]), max_new_tokens=10, do_sample=False,
+            pad_token_id=0)[0, 8:].tolist()
+
+    eng = InferenceEngine(cfg, max_seq=32, seed=0, params=params)
+    got = eng.generate([prompt], max_new_tokens=10,
+                       sampling=SamplingParams.greedy()).tokens[0]
+    assert got == want
+
+    b = ContinuousBatcher(cfg, num_blocks=16, block_size=8, slots=2,
+                          max_seq=32, seed=0, params=params)
+    r = b.submit(prompt, max_new_tokens=10,
+                 sampling=SamplingParams.greedy())
+    while b.step():
+        pass
+    assert r.error is None and r.tokens == want
+
+
+def test_llama31_rope_scaling_matches_hf():
+    """Llama 3.1+ ships rope_scaling rope_type="llama3" (NTK-by-part
+    smoothing); before cfg.rope_inv_freq existed this was silently
+    IGNORED, corrupting every position past the unscaled ladder's
+    wavelengths. Parity at sequence lengths where the smoothing bites."""
+    import torch
+    import transformers
+    torch_cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rope_theta=10000.0,
+        rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                      "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                      "original_max_position_embeddings": 16},
+        tie_word_embeddings=False, attention_bias=False)
+    torch.manual_seed(47)
+    model = transformers.LlamaForCausalLM(torch_cfg).eval()
+    cfg, params = convert.load_hf_model(model, dtype=jnp.float32)
+    assert cfg.rope_inv_freq is not None and len(cfg.rope_inv_freq) == 4
+    rng = np.random.default_rng(47)
+    tokens = rng.integers(0, 128, size=(1, 40), dtype=np.int64)
+    _check_model(model, tokens)
+
+
+def test_qwen2_linear_rope_scaling_matches_hf():
+    """Position-interpolation ("linear") scaling: uniform /factor."""
+    import torch
+    import transformers
+    torch_cfg = transformers.Qwen2Config(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128,
+        rope_scaling={"rope_type": "linear", "factor": 4.0},
+        tie_word_embeddings=False)
+    torch.manual_seed(48)
+    model = transformers.Qwen2ForCausalLM(torch_cfg).eval()
+    cfg, _ = convert.load_hf_model(model, dtype=jnp.float32)
+    assert cfg.rope_inv_freq is not None
+    rng = np.random.default_rng(48)
+    tokens = rng.integers(0, 128, size=(1, 24), dtype=np.int64)
+    _check_model(model, tokens)
+
+
+def test_unknown_rope_scaling_refused():
+    import transformers
+    torch_cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        rope_scaling={"rope_type": "dynamic", "factor": 2.0})
+    with pytest.raises(NotImplementedError, match="rope_scaling"):
+        convert.config_from_hf(torch_cfg)
+
+
+def test_deepseek_v3_mixed_stack_with_yarn_matches_hf():
+    """The shipped 671B combination: mixed dense-prefix/MoE-tail stack
+    WITH yarn (the q-weight mscale fold must land in BOTH segments'
+    q projections, and the scaled rope ladder rides every layer)."""
+    import torch
+    import transformers
+    torch_cfg = _deepseek_cfg(
+        first_k_dense_replace=1,
+        rope_scaling={"rope_type": "yarn", "factor": 4.0,
+                      "original_max_position_embeddings": 16,
+                      "mscale": 1.0, "mscale_all_dim": 1.0})
+    torch.manual_seed(49)
+    model = transformers.DeepseekV3ForCausalLM(torch_cfg).eval()
+    cfg, params = convert.load_hf_model(model, dtype=jnp.float32)
+    assert cfg.dense_prefix_layers == 1 and cfg.rope_inv_freq is not None
+    assert cfg.query_pre_attn_scalar is not None  # mscale fold active
+    rng = np.random.default_rng(49)
+    tokens = rng.integers(0, 128, size=(1, 24), dtype=np.int64)
+    _check_model(model, tokens)
