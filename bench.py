@@ -724,6 +724,20 @@ def run_all(platform, degraded, probe_info=None):
             print(f"moe proxy skipped: {e!r}", file=sys.stderr)
         _persist(result)
 
+    # ---- priority 4b: deepseek proxy (MLA latent attention + sigmoid
+    # group-routed MoE + shared experts + mixed dense-prefix stack) ------
+    if on_tpu and not _over_budget("deepseek proxy"):
+        _reclaim()
+        try:
+            dd, ddb = bench_engine("deepseek-proxy", quant="int8",
+                                   new_tokens=32, repeats=2)
+            result["deepseek_decode_tokens_per_s"] = round(dd, 2)
+            util("deepseek_decode_hbm_bw_util", dd, ddb)
+            print(f"deepseek decode: {dd:.2f} tok/s", file=sys.stderr)
+        except Exception as e:
+            print(f"deepseek proxy skipped: {e!r}", file=sys.stderr)
+        _persist(result)
+
     # ---- priority 5: batched speculative pair ----------------------------
     if on_tpu and not _over_budget("batched speculative"):
         for tag, spec in (("", None), ("_spec", "ngram")):

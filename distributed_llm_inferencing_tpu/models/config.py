@@ -165,6 +165,18 @@ class ModelConfig:
     # attention output is sliced back before the o projection. None =>
     # head_dim (all non-MLA families).
     v_head_dim: Optional[int] = None
+    # MLA's actual point: cache ONE shared latent row per token —
+    # [k_rot (qk_rope_head_dim, post-RoPE) | c (kv_lora_rank, normed)] —
+    # instead of materialized per-head K/V, and decode via the absorbed
+    # formulation (scores q_nope·(W_uk c) == (W_uk^T q_nope)·c; outputs
+    # W_uv (Σ w c)), i.e. MQA over the latent with per-head up/down
+    # projections folded around the attention (transformer.
+    # _mla_latent_attn). Cuts dense-cache bytes by
+    # 2·H·head_dim / (kv_lora_rank + qk_rope_head_dim) (~19x on the
+    # deepseek-proxy, ~85x on real V3 pre-tp). The engine auto-enables
+    # it on eligible meshes (no sp/pp, no kv_quant) — DLI_MLA_LATENT=0
+    # opts out; the paged batcher keeps the materialized layout.
+    mla_latent_cache: bool = False
 
     # Mixture-of-experts (Mixtral). num_experts == 0 => dense MLP.
     num_experts: int = 0
@@ -277,6 +289,11 @@ class ModelConfig:
             assert self.num_kv_heads == self.num_heads, (
                 "MLA materializes k/v per head: num_kv_heads == num_heads")
             assert self.position_embedding == "rope" and self.qk_norm is None
+        if self.mla_latent_cache:
+            assert self.mla, "mla_latent_cache requires an MLA config"
+            assert self.kv_quant is None, (
+                "mla_latent_cache and kv_quant are mutually exclusive "
+                "(the latent row is already the compressed representation)")
         assert self.moe_router in ("softmax", "deepseek_v3"), (
             f"unknown moe_router {self.moe_router!r}")
         if self.dense_prefix_layers:
@@ -329,6 +346,24 @@ class ModelConfig:
     @property
     def v_head_dim_effective(self) -> int:
         return self.head_dim if self.v_head_dim is None else self.v_head_dim
+
+    # Dense-cache plane shapes (ops/kvcache.init_cache, sharding.
+    # cache_specs): the latent layout stores ONE shared
+    # [k_rot | c] row per token in the k plane and nothing in the v
+    # plane (attention reads v as a slice of k — the c part).
+    @property
+    def cache_kv_heads(self) -> int:
+        return 1 if self.mla_latent_cache else self.num_kv_heads
+
+    @property
+    def cache_head_dim(self) -> int:
+        if self.mla_latent_cache:
+            return self.qk_rope_head_dim + self.kv_lora_rank
+        return self.head_dim
+
+    @property
+    def cache_v_head_dim(self) -> int:
+        return 0 if self.mla_latent_cache else self.head_dim
 
     @property
     def q_dim(self) -> int:

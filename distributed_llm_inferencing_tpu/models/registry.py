@@ -143,7 +143,8 @@ register(ModelConfig(
     rope_interleaved=True, attn_bias=False, mlp_bias=False,
     tie_word_embeddings=False, num_experts=8, num_experts_per_tok=2,
     moe_router="deepseek_v3", moe_n_group=4, moe_topk_group=2,
-    moe_routed_scale=2.5, moe_shared_experts=1))
+    moe_routed_scale=2.5, moe_shared_experts=1,
+    dense_prefix_layers=1, dense_intermediate_size=2048))
 
 # --- GPT-NeoX / Pythia: parallel residual, partial rotary, exact gelu ---
 register(ModelConfig(

@@ -65,6 +65,15 @@ def _qw(p, dt):
     return p["q"].astype(dt)
 
 
+def _wfull(p, dt):
+    """Materialized full-precision weight for leaves used OUTSIDE
+    _linear's contraction (MLA's absorbed einsums): float, int8 or int4
+    forms; scale applied."""
+    if "w" in p:
+        return p["w"].astype(dt)
+    return _qw(p, dt) * p["scale"].astype(dt)
+
+
 def _linear(x, p, row_sharded: bool = False):
     if "qT" in p or "wT" in p:
         # CPU-native transposed layouts (ops/cpu_gemv.py): the engine
@@ -478,6 +487,24 @@ def _mla_qkv(h, lp, cfg: ModelConfig, q_positions):
     H, hd = cfg.num_heads, cfg.head_dim
     rd, vd = cfg.qk_rope_head_dim, cfg.v_head_dim_effective
     r = cfg.kv_lora_rank
+    q = _mla_q(h, lp, cfg, q_positions)
+
+    k_rot, c = _mla_kv_latent(h, lp, cfg, q_positions)
+    k_nope = _linear(c, lp["kv_b_k"]).reshape(B, s, H, hd - rd)
+    v = _linear(c, lp["kv_b_v"]).reshape(B, s, H, vd)
+    k = jnp.concatenate(
+        [jnp.broadcast_to(k_rot, (B, s, H, rd)), k_nope], axis=-1)
+    if vd < hd:
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, 0), (0, hd - vd)))
+    return q, k, v
+
+
+def _mla_q(h, lp, cfg: ModelConfig, q_positions):
+    """MLA query projection, shared by the materialized and latent
+    formulations: [B,s,H,head_dim] with per-head dims [rope | nope],
+    RoPE applied to the rope slice."""
+    B, s, _ = h.shape
+    H, hd, rd = cfg.num_heads, cfg.head_dim, cfg.qk_rope_head_dim
     if cfg.q_lora_rank:
         cq = norm(_linear(h, lp["q_a"]), lp["q_a_norm"], "rmsnorm",
                   cfg.norm_eps)
@@ -488,8 +515,14 @@ def _mla_qkv(h, lp, cfg: ModelConfig, q_positions):
                        interleaved=cfg.rope_interleaved,
                        inv_freq=cfg.rope_inv_freq,
                        attn_factor=cfg.rope_attn_factor)
-    q = jnp.concatenate([q_rot, q[..., rd:]], axis=-1)
+    return jnp.concatenate([q_rot, q[..., rd:]], axis=-1)
 
+
+def _mla_kv_latent(h, lp, cfg: ModelConfig, q_positions):
+    """MLA kv bottleneck, shared by the materialized and latent
+    formulations: returns (k_rot [B,s,1,rd] post-RoPE, c [B,s,r]
+    normed)."""
+    r = cfg.kv_lora_rank
     ckv = _linear(h, lp["kv_a"])                         # [B,s,r+rd]
     k_rot = apply_rope(ckv[..., r:][:, :, None, :], q_positions,
                        cfg.rope_theta,
@@ -497,16 +530,66 @@ def _mla_qkv(h, lp, cfg: ModelConfig, q_positions):
                        inv_freq=cfg.rope_inv_freq,
                        attn_factor=cfg.rope_attn_factor)  # [B,s,1,rd]
     c = norm(ckv[..., :r], lp["kv_a_norm"], "rmsnorm", cfg.norm_eps)
-    k_nope = _linear(c, lp["kv_b_k"]).reshape(B, s, H, hd - rd)
-    v = _linear(c, lp["kv_b_v"]).reshape(B, s, H, vd)
-    k = jnp.concatenate(
-        [jnp.broadcast_to(k_rot, (B, s, H, rd)), k_nope], axis=-1)
-    if vd < hd:
-        v = jnp.pad(v, ((0, 0), (0, 0), (0, 0), (0, hd - vd)))
-    return q, k, v
+    return k_rot, c
 
 
-def _block_body(x, lp, cfg: ModelConfig, q_positions, attend_write):
+def _mla_latent_attn(h, lp, cfg: ModelConfig, q_positions, cache_k,
+                     cache_v, write_starts, new_lengths, is_prefill,
+                     backend):
+    """MLA attention over the LATENT cache (cfg.mla_latent_cache) for
+    the dense-cache serving path.
+
+    The cache's k plane holds one shared row per token —
+    [k_rot (rd, post-RoPE) | c (kv_lora_rank, normed)] — and the v plane
+    is zero-width. Prefill attends its fresh block with materialized
+    per-head K/V (the O(s^2) regime where compute, not cache traffic,
+    dominates) while writing only the latent row. Decode runs the
+    absorbed formulation: scores q_nope·(W_uk c) == (W_uk^T q_nope)·c
+    and outputs W_uv (Σ w c), i.e. MQA over the (rd + r)-wide latent
+    with the per-head up-projections folded into q and pulled out of
+    the weighted sum — exactly the materialized attention's numbers,
+    reassociated. Score scale stays the materialized head_dim's
+    (ops/attention.attend ``scale``).
+
+    Returns (attn [B,s,H,v_head_dim], (new_cache_k, cache_v)).
+    """
+    B, s, _ = h.shape
+    H, hd = cfg.num_heads, cfg.head_dim
+    rd, r = cfg.qk_rope_head_dim, cfg.kv_lora_rank
+    nd, vd = cfg.qk_nope_head_dim, cfg.v_head_dim_effective
+    q = _mla_q(h, lp, cfg, q_positions)                  # [B,s,H,hd]
+    k_rot, c = _mla_kv_latent(h, lp, cfg, q_positions)
+    latent = jnp.concatenate([k_rot, c[:, :, None, :]], axis=-1)
+    ck = write_block(cache_k, latent, write_starts)      # [B,S,1,rd+r]
+
+    wk = _wfull(lp["kv_b_k"], q.dtype).reshape(r, H, nd)
+    wv = _wfull(lp["kv_b_v"], q.dtype).reshape(r, H, vd)
+    if is_prefill:
+        # fresh-block attention with materialized per-head K/V — v
+        # zero-padded to head_dim for flash-kernel eligibility (same
+        # trade as the materialized path), sliced back after
+        k_nope = jnp.einsum("bsr,rhn->bshn", c, wk)
+        k = jnp.concatenate(
+            [jnp.broadcast_to(k_rot, (B, s, H, rd)), k_nope], axis=-1)
+        v = jnp.einsum("bsr,rhv->bshv", c, wv)
+        if vd < hd:
+            v = jnp.pad(v, ((0, 0), (0, 0), (0, 0), (0, hd - vd)))
+        attn = attend_prefill(q, k, v, backend=backend)[..., :vd]
+    else:
+        q_eff = jnp.concatenate(
+            [q[..., :rd],
+             jnp.einsum("bshn,rhn->bshr", q[..., rd:], wk)], axis=-1)
+        ctx = attend_decode(
+            q_eff, ck, ck[..., rd:], new_lengths, backend="xla",
+            q_positions=q_positions,   # multi-token speculative verify
+            # needs per-query causal masks, not the lengths-1 default
+            scale=1.0 / float(hd) ** 0.5)                # [B,s,H,r]
+        attn = jnp.einsum("bshr,rhv->bshv", ctx, wv)
+    return attn, (ck, cache_v)
+
+
+def _block_body(x, lp, cfg: ModelConfig, q_positions, attend_write,
+                mla_latent_attend=None):
     """One transformer block: norm → QKV (+RoPE) → attend → norm → MLP/MoE.
 
     The single definition of the block structure, shared by the dense path
@@ -525,6 +608,15 @@ def _block_body(x, lp, cfg: ModelConfig, q_positions, attend_write):
     B, s, _ = x.shape
     h = x if (cfg.post_norm or cfg.sublayer_postnorm_only) else norm(
         x, lp["attn_norm"], cfg.norm_type, cfg.norm_eps)
+    if mla_latent_attend is not None:
+        # dense-cache latent formulation (cfg.mla_latent_cache): the
+        # whole attention — projections, cache, absorbed decode — runs
+        # inside the callback; output arrives at v_head_dim already
+        attn, cache_out = mla_latent_attend(h, q_positions)
+        vd = cfg.v_head_dim_effective
+        attn = _linear(attn.reshape(B, s, cfg.num_heads * vd), lp["o"],
+                       row_sharded=cfg.tp_row_sharded)
+        return _block_tail(x, h, attn, cache_out, lp, cfg)
     if cfg.mla:
         q, k, v = _mla_qkv(h, lp, cfg, q_positions)   # rope applied inside
     else:
@@ -550,6 +642,12 @@ def _block_body(x, lp, cfg: ModelConfig, q_positions, attend_write):
         attn = attn[..., :vd]
     attn = _linear(attn.reshape(B, s, cfg.num_heads * vd), lp["o"],
                    row_sharded=cfg.tp_row_sharded)
+    return _block_tail(x, h, attn, cache_out, lp, cfg)
+
+
+def _block_tail(x, h, attn, cache_out, lp, cfg: ModelConfig):
+    """Post-attention half of the block: residual topology + MLP/MoE
+    (shared by the materialized and MLA-latent attention dispatches)."""
     if cfg.post_block_norms:   # gemma2 sandwich: norm BEFORE the residual
         attn = norm(attn, lp["attn_post_norm"], cfg.norm_type, cfg.norm_eps)
     elif cfg.sublayer_postnorm_only:   # olmo2: x + norm(attn(x))
@@ -600,6 +698,17 @@ def _block(x, lp, cache_k, cache_v, *, cfg: ModelConfig, q_positions,
     ``cache_ks``/``cache_vs`` scales are present, ops/kvcache.py).
     """
     quantized = cache_ks is not None
+    if cfg.mla_latent_cache:
+        # latent-layout cache: attention runs entirely inside the
+        # absorbed-formulation callback (engine enables this only on
+        # eligible meshes — no sp/pp, no kv_quant)
+        def mla_latent_attend(h, qp):
+            return _mla_latent_attn(
+                h, lp, cfg, qp, cache_k, cache_v, write_starts,
+                new_lengths, is_prefill, backend)
+        x, cache_out = _block_body(x, lp, cfg, q_positions, None,
+                                   mla_latent_attend=mla_latent_attend)
+        return (x,) + cache_out
 
     def attend_write(q, k, v):
         if quantized:

@@ -78,6 +78,11 @@ def attend(
     sliding_window: Optional[int] = None,
     alibi=None,          # [H] f32 slopes — bias slope*(kv_pos - q_pos)
     softcap: Optional[float] = None,   # gemma2: cap*tanh(scores/cap)
+    scale: Optional[float] = None,     # score scale; None => hd**-0.5.
+    # MLA's absorbed latent decode passes the ORIGINAL qk head dim's
+    # scale — its effective q/k carry the (rd + kv_lora_rank)-wide
+    # latent, but the scores are mathematically the materialized
+    # head_dim attention's (transformer._mla_latent_attn).
 ):
     """Causal attention over a (possibly cached, possibly padded) KV set.
 
@@ -94,7 +99,8 @@ def attend(
     k = repeat_kv(k, H // Hkv)
     v = repeat_kv(v, H // Hkv)
 
-    scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+    if scale is None:
+        scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
     # [B, H, Sq, Skv]
     logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                         k.astype(jnp.float32)) * scale
@@ -168,7 +174,8 @@ def attend_prefill(q, k, v, *, sliding_window: Optional[int] = None,
 def attend_decode(q, cache_k, cache_v, lengths, *,
                   sliding_window: Optional[int] = None,
                   backend: str = "xla", q_positions=None, alibi=None,
-                  softcap: Optional[float] = None):
+                  softcap: Optional[float] = None,
+                  scale: Optional[float] = None):
     """Cached attention for decode-regime queries.
 
     Single-token (Sq == 1): ``lengths`` counts filled slots including the
@@ -179,7 +186,7 @@ def attend_decode(q, cache_k, cache_v, lengths, *,
     takes the xla formulation. ALiBi rides the flash kernel (in-tile
     bias from SMEM slopes).
     """
-    if backend.startswith("pallas") and q.shape[1] == 1:
+    if backend.startswith("pallas") and q.shape[1] == 1 and scale is None:
         from distributed_llm_inferencing_tpu.ops.pallas import flash_decode
         return flash_decode(
             q, cache_k, cache_v, lengths, sliding_window=sliding_window,
@@ -191,4 +198,4 @@ def attend_decode(q, cache_k, cache_v, lengths, *,
              else (lengths - 1)[:, None])
     return attend(q, cache_k, cache_v, q_pos, kv_pos, kv_valid,
                   sliding_window=sliding_window, alibi=alibi,
-                  softcap=softcap)
+                  softcap=softcap, scale=scale)

@@ -77,10 +77,15 @@ def dequant_kv(q, scale, dtype):
 def init_cache(cfg: ModelConfig, batch: int, max_seq: int,
                dtype=None) -> KVCache:
     dtype = dtype or jnp.dtype(cfg.dtype)
-    shape = (cfg.num_layers, batch, max_seq, cfg.num_kv_heads, cfg.head_dim)
+    # mla_latent_cache: the k plane holds one shared [k_rot | c] latent
+    # row per token; the v plane is zero-width (attention reads v as the
+    # c slice of k — transformer._mla_latent_attn)
+    shape = (cfg.num_layers, batch, max_seq, cfg.cache_kv_heads,
+             cfg.cache_head_dim)
+    vshape = shape[:-1] + (cfg.cache_v_head_dim,)
     if cfg.kv_quant == "int8":
         return KVCache(
-            k=jnp.zeros(shape, jnp.int8), v=jnp.zeros(shape, jnp.int8),
+            k=jnp.zeros(shape, jnp.int8), v=jnp.zeros(vshape, jnp.int8),
             lengths=jnp.zeros((batch,), jnp.int32),
             k_scale=jnp.zeros(shape[:-1], jnp.float32),
             v_scale=jnp.zeros(shape[:-1], jnp.float32))
@@ -88,7 +93,7 @@ def init_cache(cfg: ModelConfig, batch: int, max_seq: int,
         raise ValueError(f"unknown kv_quant mode {cfg.kv_quant!r}")
     return KVCache(
         k=jnp.zeros(shape, dtype),
-        v=jnp.zeros(shape, dtype),
+        v=jnp.zeros(vshape, dtype),
         lengths=jnp.zeros((batch,), jnp.int32),
     )
 

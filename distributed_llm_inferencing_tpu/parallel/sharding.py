@@ -181,7 +181,7 @@ def param_specs(cfg: ModelConfig, spec: MeshSpec,
 def cache_specs(cfg: ModelConfig, spec: MeshSpec):
     """KVCache sharding: [L,B,S,Hkv,hd] — batch over dp, kv heads over tp,
     sequence over sp (ring attention shards the S axis)."""
-    kv_tp = kv_head_axis(cfg.num_kv_heads, spec.tp)
+    kv_tp = kv_head_axis(cfg.cache_kv_heads, spec.tp)
     L = "pp" if spec.pp > 1 else None  # stage-local cache slices
     sp = "sp" if spec.sp > 1 else None
     kv = P(L, "dp", sp, kv_tp, None)

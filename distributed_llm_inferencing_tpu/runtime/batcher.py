@@ -179,7 +179,11 @@ class ContinuousBatcher:
             # int4 pallas routing hint (models/config.py): this GSPMD
             # program din-shards o/down over tp, and the kernel's
             # partition rule would all-gather those shards every step
-            tp_row_sharded=self.mesh_spec.tp > 1)
+            tp_row_sharded=self.mesh_spec.tp > 1,
+            # the paged pool keeps the materialized per-head K/V layout;
+            # the latent formulation is the dense-cache engine's
+            # (config.py mla_latent_cache)
+            mla_latent_cache=False)
         validate_spec(self.mesh_spec, cfg)
         self.mesh = create_mesh(self.mesh_spec)
         self.block_size = block_size

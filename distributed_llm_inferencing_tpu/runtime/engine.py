@@ -83,7 +83,16 @@ class InferenceEngine:
             attn_backend=_cfg_backend(cfg, self.mesh_spec.num_devices),
             # int4 pallas routing: row-parallel leaves stay on XLA when
             # this GSPMD program shards them over tp (config.py field doc)
-            tp_row_sharded=self.mesh_spec.tp > 1)
+            tp_row_sharded=self.mesh_spec.tp > 1,
+            # MLA serves from the latent cache (the absorbed
+            # formulation, transformer._mla_latent_attn) whenever the
+            # mesh is eligible: cuts dense-cache bytes by
+            # 2*H*head_dim/(kv_lora_rank+rope) (~19x on deepseek-proxy).
+            # DLI_MLA_LATENT=0 opts out (A/B vs materialized).
+            mla_latent_cache=(
+                cfg.mla and cfg.kv_quant is None
+                and self.mesh_spec.sp == 1 and self.mesh_spec.pp == 1
+                and os.environ.get("DLI_MLA_LATENT") != "0"))
         self.max_seq = min(max_seq or cfg.max_position_embeddings,
                            cfg.max_position_embeddings)
         # sequence parallelism shards the cache S axis: keep it divisible
@@ -196,10 +205,14 @@ class InferenceEngine:
         # only the big matmul leaves (ops/quant.py's set): the router is
         # read raw by _moe_gates and norms carry no "w"
         from distributed_llm_inferencing_tpu.ops.quant import _LINEAR_LEAVES
+        # the latent path consumes kv_b_k/kv_b_v through absorbed
+        # einsums (_wfull), not _linear — keep their stored layout
+        skip = ({"kv_b_k", "kv_b_v"} if self.cfg.mla_latent_cache
+                else set())
         for key in ("layers", "layers_dense"):
             for lp in self.params.get(key, ()):
                 for name in _LINEAR_LEAVES:
-                    if name in lp:
+                    if name in lp and name not in skip:
                         lp[name] = repack(lp[name])
         if "lm_head" in self.params:
             self.params["lm_head"] = repack(self.params["lm_head"])
