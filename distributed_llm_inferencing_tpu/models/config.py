@@ -132,6 +132,17 @@ class ModelConfig:
     # (Distinct from post_norm, which norms after the add, and from
     # post_block_norms, which sandwiches pre- AND post-norms.)
     sublayer_postnorm_only: bool = False
+    # HunYuan-Dense: the q/k norms apply AFTER RoPE (Qwen3/Exaone norm
+    # then rotate; HunYuan rotates then norms). Only meaningful with
+    # qk_norm.
+    qk_norm_after_rope: bool = False
+    # Per-LAYER rope on/off (SmolLM3 no_rope_layers: every Nth layer is
+    # NoPE; Exaone4 hybrid: full-attention layers skip rope while
+    # sliding layers rotate). A full per-layer tuple of 1/0; None => all
+    # layers rotate. Rides the layer param tree as an int32 ``rope_on``
+    # leaf ([L]) like attn_windows, so every scan/unroll/pipeline path
+    # carries it; the block computes the rotation and selects per layer.
+    rope_layers: Optional[Tuple[int, ...]] = None
     # Granite residual_multiplier: sublayer outputs scaled by this before
     # their residual add. (Granite's other multipliers map onto existing
     # fields: embedding_multiplier -> embed_scale, attention_multiplier
@@ -265,6 +276,14 @@ class ModelConfig:
                 f"{self.num_layers} layers")
             assert self.sliding_window is None, (
                 "attn_windows and sliding_window are mutually exclusive")
+        if self.rope_layers is not None:
+            object.__setattr__(self, "rope_layers",
+                               tuple(int(v) for v in self.rope_layers))
+            assert len(self.rope_layers) == self.num_layers, (
+                f"rope_layers has {len(self.rope_layers)} entries for "
+                f"{self.num_layers} layers")
+            assert self.position_embedding == "rope", (
+                "rope_layers only makes sense with rope positions")
         assert not (self.post_block_norms
                     and (self.parallel_residual or self.post_norm)), (
             "post_block_norms (sandwich) excludes parallel_residual and "
@@ -294,6 +313,13 @@ class ModelConfig:
             assert self.kv_quant is None, (
                 "mla_latent_cache and kv_quant are mutually exclusive "
                 "(the latent row is already the compressed representation)")
+            assert (self.sliding_window is None
+                    and self.attn_windows is None
+                    and self.attn_softcap is None), (
+                "mla_latent_cache's absorbed attention does not thread "
+                "sliding windows or score softcapping (no MLA "
+                "architecture uses them); serve such a config with the "
+                "materialized layout (DLI_MLA_LATENT=0)")
         assert self.moe_router in ("softmax", "deepseek_v3"), (
             f"unknown moe_router {self.moe_router!r}")
         if self.dense_prefix_layers:

@@ -160,7 +160,8 @@ SUPPORTED_MODEL_TYPES = ("gpt2", "opt", "llama", "mistral", "mixtral",
                          "codegen", "starcoder2", "olmo", "phi3",
                          "gpt_neo", "gemma2", "cohere", "qwen3",
                          "qwen3_moe", "granite", "olmo2", "glm", "glm4",
-                         "nemotron", "deepseek_v3")
+                         "nemotron", "deepseek_v3", "ernie4_5", "smollm3",
+                         "hunyuan_v1_dense", "exaone4")
 
 
 def config_from_hf(hf_config) -> ModelConfig:
@@ -772,6 +773,127 @@ def config_from_hf(hf_config) -> ModelConfig:
                                         2),
             tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
                                         False))
+    if mt == "ernie4_5":
+        # ERNIE 4.5 (dense): llama layout with ONE use_bias switch on
+        # every linear (attention, o and MLP alike) and an explicit
+        # head_dim decoupled from hidden/heads.
+        b = bool(getattr(hf_config, "use_bias", False))
+        return ModelConfig(
+            name=getattr(hf_config, "name_or_path", mt) or mt,
+            family="llama", vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            intermediate_size=hf_config.intermediate_size,
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            num_kv_heads=getattr(hf_config, "num_key_value_heads", None)
+            or hf_config.num_attention_heads,
+            head_dim=getattr(hf_config, "head_dim", None)
+            or hf_config.hidden_size // hf_config.num_attention_heads,
+            max_position_embeddings=hf_config.max_position_embeddings,
+            norm_type="rmsnorm", norm_eps=hf_config.rms_norm_eps,
+            activation=_act_from_hf(hf_config.hidden_act),
+            gated_mlp=True, position_embedding="rope",
+            rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+            attn_bias=b, o_bias=b, mlp_bias=b,
+            tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
+                                        True))
+    if mt == "smollm3":
+        # SmolLM3: llama layout with per-layer NoPE (no_rope_layers: 1 =
+        # rotate, 0 = position-free — config.py rope_layers) and
+        # optional per-layer sliding windows via layer_types.
+        kinds = list(getattr(hf_config, "layer_types", None) or [])
+        win = getattr(hf_config, "sliding_window", None)
+        use_win = bool(getattr(hf_config, "use_sliding_window", win))
+        wins = tuple(win if (use_win and t == "sliding_attention")
+                     else None for t in kinds)
+        windowed = any(w is not None for w in wins)
+        uniform = not windowed or len(set(wins)) == 1
+        nope = tuple(int(v) for v in
+                     getattr(hf_config, "no_rope_layers", None) or [])
+        return ModelConfig(
+            name=getattr(hf_config, "name_or_path", mt) or mt,
+            family="llama", vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            intermediate_size=hf_config.intermediate_size,
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            num_kv_heads=getattr(hf_config, "num_key_value_heads", None)
+            or hf_config.num_attention_heads,
+            head_dim=getattr(hf_config, "head_dim", None)
+            or hf_config.hidden_size // hf_config.num_attention_heads,
+            max_position_embeddings=hf_config.max_position_embeddings,
+            norm_type="rmsnorm", norm_eps=hf_config.rms_norm_eps,
+            activation=_act_from_hf(hf_config.hidden_act),
+            gated_mlp=True, position_embedding="rope",
+            rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+            attn_bias=bool(getattr(hf_config, "attention_bias", False)),
+            mlp_bias=bool(getattr(hf_config, "mlp_bias", False)),
+            sliding_window=(wins[0] if windowed and uniform else None),
+            attn_windows=None if uniform else wins,
+            rope_layers=(nope if nope and not all(nope) else None),
+            tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
+                                        True))
+    if mt == "hunyuan_v1_dense":
+        # HunYuan-Dense: llama layout + shared [head_dim] q/k RMS norms
+        # applied AFTER RoPE (qk_norm_after_rope — qwen3/exaone norm
+        # before rotating).
+        return ModelConfig(
+            name=getattr(hf_config, "name_or_path", mt) or mt,
+            family="llama", vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            intermediate_size=hf_config.intermediate_size,
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            num_kv_heads=getattr(hf_config, "num_key_value_heads", None)
+            or hf_config.num_attention_heads,
+            head_dim=getattr(hf_config, "head_dim", None)
+            or hf_config.hidden_size // hf_config.num_attention_heads,
+            max_position_embeddings=hf_config.max_position_embeddings,
+            norm_type="rmsnorm", norm_eps=hf_config.rms_norm_eps,
+            activation=_act_from_hf(hf_config.hidden_act),
+            gated_mlp=True, position_embedding="rope",
+            rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+            attn_bias=bool(getattr(hf_config, "attention_bias", False)),
+            mlp_bias=False, qk_norm="rms_head", qk_norm_after_rope=True,
+            tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
+                                        False))
+    if mt == "exaone4":
+        # EXAONE 4.0: the olmo2 sublayer-postnorm topology (x +
+        # norm(f(x)), norms named post_attention/post_feedforward) with
+        # shared [head_dim] q/k RMS norms, hybrid attention — sliding
+        # layers rotate, full-attention layers are NoPE (rope_layers) —
+        # and per-layer windows from layer_types.
+        kinds = list(getattr(hf_config, "layer_types", None) or [])
+        win = getattr(hf_config, "sliding_window", None)
+        wins = tuple(win if t == "sliding_attention" else None
+                     for t in kinds)
+        windowed = win is not None and any(w is not None for w in wins)
+        uniform = not windowed or len(set(wins)) == 1
+        rope_on = (tuple(1 if t == "sliding_attention" else 0
+                         for t in kinds) if windowed else None)
+        return ModelConfig(
+            name=getattr(hf_config, "name_or_path", mt) or mt,
+            family="olmo2", vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            intermediate_size=hf_config.intermediate_size,
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            num_kv_heads=getattr(hf_config, "num_key_value_heads", None)
+            or hf_config.num_attention_heads,
+            head_dim=getattr(hf_config, "head_dim", None)
+            or hf_config.hidden_size // hf_config.num_attention_heads,
+            max_position_embeddings=hf_config.max_position_embeddings,
+            norm_type="rmsnorm", norm_eps=hf_config.rms_norm_eps,
+            activation=_act_from_hf(hf_config.hidden_act),
+            gated_mlp=True, position_embedding="rope",
+            rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+            attn_bias=False, mlp_bias=False, qk_norm="rms_head",
+            sublayer_postnorm_only=True,
+            sliding_window=(wins[0] if windowed and uniform else None),
+            attn_windows=None if uniform else wins,
+            rope_layers=rope_on,
+            tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
+                                        False))
     if mt == "deepseek_v3":
         # DeepSeek-V3: llama residual topology with multi-head latent
         # attention (low-rank q/kv bottlenecks with mid-stack RMSNorms,
@@ -1079,10 +1201,17 @@ def convert_state_dict(cfg: ModelConfig, sd, dtype=None):
                 "o": lin("self_attn.o_proj"),
                 "mlp_norm": {"scale": get(p + "post_attention_layernorm.weight") + off},
             }
-            if cfg.qk_norm:   # qwen3: shared [head_dim] rms scales
-                lp["q_norm"] = {"scale": get(p + "self_attn.q_norm.weight")
-                                * qs}
-                lp["k_norm"] = {"scale": get(p + "self_attn.k_norm.weight")}
+            if cfg.qk_norm:   # shared [head_dim] rms scales — qwen3
+                # names them q_norm/k_norm, hunyuan query_layernorm/
+                # key_layernorm
+                qn = ("self_attn.q_norm.weight"
+                      if p + "self_attn.q_norm.weight" in sd
+                      else "self_attn.query_layernorm.weight")
+                kn = ("self_attn.k_norm.weight"
+                      if p + "self_attn.k_norm.weight" in sd
+                      else "self_attn.key_layernorm.weight")
+                lp["q_norm"] = {"scale": get(p + qn) * qs}
+                lp["k_norm"] = {"scale": get(p + kn)}
             if cfg.is_moe and (p + "mlp.gate.weight") in sd:
                 # qwen3_moe naming: mlp.gate + mlp.experts.N.{gate,up,down}_proj
                 lp["router"] = {"w": get(p + "mlp.gate.weight").T}
@@ -1888,13 +2017,16 @@ def convert_state_dict(cfg: ModelConfig, sd, dtype=None):
     if cfg.attn_windows is not None:
         params["layers"]["attn_window"] = np.asarray(
             [-1 if w is None else w for w in cfg.attn_windows], np.int32)
+    if cfg.rope_layers is not None:   # per-layer NoPE (smollm3/exaone4)
+        params["layers"]["rope_on"] = np.asarray(cfg.rope_layers, np.int32)
 
     return _to_jax(params, dtype)
 
 
 def _to_jax(tree, dtype):
     if isinstance(tree, dict):
-        return {k: (jnp.asarray(v, jnp.int32) if k == "attn_window"
+        return {k: (jnp.asarray(v, jnp.int32)
+                    if k in ("attn_window", "rope_on")
                     else _to_jax(v, dtype))
                 for k, v in tree.items()}
     return jnp.asarray(tree, dtype)

@@ -127,6 +127,8 @@ def init_params(cfg: ModelConfig, key, dtype=None):
         # layer scan/unroll/pipeline machinery (transformer._layer_window)
         layers["attn_window"] = jnp.asarray(
             [-1 if w is None else w for w in cfg.attn_windows], jnp.int32)
+    if cfg.rope_layers is not None:   # per-layer NoPE (smollm3/exaone4)
+        layers["rope_on"] = jnp.asarray(cfg.rope_layers, jnp.int32)
     if not cfg.shared_attn_mlp_norm:   # phi/falcon-7b: one norm per block
         layers["mlp_norm"] = norm_p()
     if cfg.is_moe:

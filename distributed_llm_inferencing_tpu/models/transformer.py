@@ -624,17 +624,31 @@ def _block_body(x, lp, cfg: ModelConfig, q_positions, attend_write,
         k = _linear(h, lp["k"]).reshape(B, s, cfg.num_kv_heads, cfg.head_dim)
         v = _linear(h, lp["v"]).reshape(B, s, cfg.num_kv_heads, cfg.head_dim)
 
-        if cfg.qk_norm:
+        if cfg.qk_norm and not cfg.qk_norm_after_rope:
             q = _qk_normalize(q, lp["q_norm"], cfg)
             k = _qk_normalize(k, lp["k_norm"], cfg)
 
         if cfg.position_embedding == "rope":
-            q = apply_rope(q, q_positions, cfg.rope_theta, cfg.rope_pct,
-                           cfg.rope_interleaved, inv_freq=cfg.rope_inv_freq,
-                           attn_factor=cfg.rope_attn_factor)
-            k = apply_rope(k, q_positions, cfg.rope_theta, cfg.rope_pct,
-                           cfg.rope_interleaved, inv_freq=cfg.rope_inv_freq,
-                           attn_factor=cfg.rope_attn_factor)
+            q_r = apply_rope(q, q_positions, cfg.rope_theta, cfg.rope_pct,
+                             cfg.rope_interleaved,
+                             inv_freq=cfg.rope_inv_freq,
+                             attn_factor=cfg.rope_attn_factor)
+            k_r = apply_rope(k, q_positions, cfg.rope_theta, cfg.rope_pct,
+                             cfg.rope_interleaved,
+                             inv_freq=cfg.rope_inv_freq,
+                             attn_factor=cfg.rope_attn_factor)
+            if cfg.rope_layers is not None:
+                # per-layer NoPE (smollm3/exaone4): the int32 rope_on
+                # leaf rides the layer tree; compute-and-select keeps
+                # the scan body uniform
+                on = lp["rope_on"].astype(jnp.bool_)
+                q, k = jnp.where(on, q_r, q), jnp.where(on, k_r, k)
+            else:
+                q, k = q_r, k_r
+
+        if cfg.qk_norm and cfg.qk_norm_after_rope:   # hunyuan ordering
+            q = _qk_normalize(q, lp["q_norm"], cfg)
+            k = _qk_normalize(k, lp["k_norm"], cfg)
 
     attn, cache_out = attend_write(q, k, v)
     vd = cfg.v_head_dim_effective

@@ -123,6 +123,8 @@ def param_specs(cfg: ModelConfig, spec: MeshSpec,
         # [L] int32 per-layer window leaf: pp shards the layer axis like
         # every other stacked leaf, so each stage carries its own slice
         layers["attn_window"] = P(L)
+    if cfg.rope_layers is not None:   # per-layer NoPE flag, same layout
+        layers["rope_on"] = P(L)
     if not cfg.shared_attn_mlp_norm:   # phi/falcon-7b: one norm per block
         layers["mlp_norm"] = norm_p()
     if cfg.attn_bias and not cfg.mla:   # mla biases set in its branch

@@ -91,6 +91,8 @@ class InferenceEngine:
             # DLI_MLA_LATENT=0 opts out (A/B vs materialized).
             mla_latent_cache=(
                 cfg.mla and cfg.kv_quant is None
+                and cfg.sliding_window is None and cfg.attn_windows is None
+                and cfg.attn_softcap is None
                 and self.mesh_spec.sp == 1 and self.mesh_spec.pp == 1
                 and os.environ.get("DLI_MLA_LATENT") != "0"))
         self.max_seq = min(max_seq or cfg.max_position_embeddings,

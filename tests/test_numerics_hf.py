@@ -1412,3 +1412,92 @@ def test_deepseek_v3_mixed_stack_with_yarn_matches_hf():
     rng = np.random.default_rng(49)
     tokens = rng.integers(0, 128, size=(1, 24), dtype=np.int64)
     _check_model(model, tokens)
+
+
+def test_ernie45_matches_hf():
+    """ERNIE 4.5 dense: llama layout, one use_bias switch on every
+    linear, explicit head_dim decoupled from hidden/heads."""
+    import torch
+    import transformers
+    torch_cfg = transformers.Ernie4_5Config(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, use_bias=True, max_position_embeddings=64,
+        tie_word_embeddings=False, pad_token_id=0)
+    torch.manual_seed(50)
+    model = transformers.Ernie4_5ForCausalLM(torch_cfg).eval()
+    cfg, params = convert.load_hf_model(model, dtype=jnp.float32)
+    assert cfg.attn_bias and cfg.mlp_bias and "b" in params["layers"]["o"]
+    rng = np.random.default_rng(50)
+    tokens = rng.integers(0, 128, size=(2, 10), dtype=np.int64)
+    _check_model(model, tokens)
+
+
+def test_smollm3_nope_layers_match_hf():
+    """SmolLM3: per-layer NoPE (no_rope_layers) — the rope_on leaf must
+    disable rotation exactly on the flagged layers."""
+    import torch
+    import transformers
+    torch_cfg = transformers.SmolLM3Config(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        no_rope_layers=[1, 1, 1, 0], no_rope_layer_interval=4,
+        max_position_embeddings=64, tie_word_embeddings=False,
+        pad_token_id=0)
+    torch.manual_seed(51)
+    model = transformers.SmolLM3ForCausalLM(torch_cfg).eval()
+    cfg, params = convert.load_hf_model(model, dtype=jnp.float32)
+    assert cfg.rope_layers == (1, 1, 1, 0)
+    assert "rope_on" in params["layers"]
+    rng = np.random.default_rng(51)
+    tokens = rng.integers(0, 128, size=(2, 12), dtype=np.int64)
+    _check_model(model, tokens)
+
+
+def test_hunyuan_dense_post_rope_qk_norm_matches_hf():
+    """HunYuan-Dense: shared [head_dim] q/k RMS norms applied AFTER
+    RoPE (query_layernorm/key_layernorm; qwen3 norms before)."""
+    import torch
+    import transformers
+    torch_cfg = transformers.HunYuanDenseV1Config(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=8, max_position_embeddings=64, tie_word_embeddings=False,
+        pad_token_id=0)
+    torch.manual_seed(52)
+    model = transformers.HunYuanDenseV1ForCausalLM(torch_cfg).eval()
+    with torch.no_grad():   # distinguish the norms from identity
+        for lyr in model.model.layers:
+            lyr.self_attn.query_layernorm.weight.mul_(
+                torch.rand_like(lyr.self_attn.query_layernorm.weight) + 0.5)
+            lyr.self_attn.key_layernorm.weight.mul_(
+                torch.rand_like(lyr.self_attn.key_layernorm.weight) + 0.5)
+    cfg, _ = convert.load_hf_model(model, dtype=jnp.float32)
+    assert cfg.qk_norm == "rms_head" and cfg.qk_norm_after_rope
+    rng = np.random.default_rng(52)
+    tokens = rng.integers(0, 128, size=(2, 10), dtype=np.int64)
+    _check_model(model, tokens)
+
+
+def test_exaone4_hybrid_matches_hf():
+    """EXAONE 4.0: sublayer-postnorm topology (x + norm(f(x))), shared
+    [head_dim] q/k norms, hybrid attention — sliding layers rotate,
+    full-attention layers are NoPE — with per-layer windows. Sequence
+    longer than the window so both mechanisms bite."""
+    import torch
+    import transformers
+    torch_cfg = transformers.Exaone4Config(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        sliding_window=4, sliding_window_pattern=4,
+        max_position_embeddings=64, tie_word_embeddings=False,
+        pad_token_id=0)
+    torch.manual_seed(53)
+    model = transformers.Exaone4ForCausalLM(torch_cfg).eval()
+    cfg, params = convert.load_hf_model(model, dtype=jnp.float32)
+    assert cfg.sublayer_postnorm_only and cfg.qk_norm == "rms_head"
+    assert cfg.rope_layers is not None and 0 in cfg.rope_layers
+    assert cfg.attn_windows is not None
+    rng = np.random.default_rng(53)
+    tokens = rng.integers(0, 128, size=(1, 12), dtype=np.int64)
+    _check_model(model, tokens)
