@@ -136,6 +136,10 @@ class ModelConfig:
     # then rotate; HunYuan rotates then norms). Only meaningful with
     # qk_norm.
     qk_norm_after_rope: bool = False
+    # DBRX clip_qkv: the fused qkv projection output is clamped to
+    # ±this before heads split — a runtime nonlinearity on activations
+    # (clamping after our separate q/k/v projections is identical).
+    qkv_clip: Optional[float] = None
     # Per-LAYER rope on/off (SmolLM3 no_rope_layers: every Nth layer is
     # NoPE; Exaone4 hybrid: full-attention layers skip rope while
     # sliding layers rotate). A full per-layer tuple of 1/0; None => all

@@ -141,6 +141,25 @@ def _rope_scaling_params(hf_config, dim: int, what: str):
         "convert")
 
 
+def _layer_windows_from_hf(hf_config, require_use_flag: bool = False):
+    """Per-layer windows from an HF ``layer_types`` list: returns
+    (sliding_window, attn_windows, kinds) ready for the ModelConfig
+    kwargs — the uniform case keeps the static sliding_window (pallas
+    flash kernels stay eligible), the mixed case emits the per-layer
+    tuple. ``require_use_flag``: gate on use_sliding_window (smollm3)
+    instead of sliding_window's presence alone."""
+    kinds = list(getattr(hf_config, "layer_types", None) or [])
+    win = getattr(hf_config, "sliding_window", None)
+    enabled = (bool(getattr(hf_config, "use_sliding_window", win))
+               if require_use_flag else win is not None)
+    wins = tuple(win if (enabled and t == "sliding_attention") else None
+                 for t in kinds)
+    windowed = any(w is not None for w in wins)
+    uniform = not windowed or len(set(wins)) == 1
+    return ((wins[0] if windowed and uniform else None),
+            (None if uniform else wins), kinds)
+
+
 # HF hidden_act -> our activation kinds (models/transformer.py _act).
 # "gelu" is the erf form; gelu_new/gelu_pytorch_tanh are the tanh approx.
 _HF_ACT = {"gelu": "gelu_exact", "gelu_new": "gelu",
@@ -161,7 +180,7 @@ SUPPORTED_MODEL_TYPES = ("gpt2", "opt", "llama", "mistral", "mixtral",
                          "gpt_neo", "gemma2", "cohere", "qwen3",
                          "qwen3_moe", "granite", "olmo2", "glm", "glm4",
                          "nemotron", "deepseek_v3", "ernie4_5", "smollm3",
-                         "hunyuan_v1_dense", "exaone4")
+                         "hunyuan_v1_dense", "exaone4", "dbrx")
 
 
 def config_from_hf(hf_config) -> ModelConfig:
@@ -723,23 +742,15 @@ def config_from_hf(hf_config) -> ModelConfig:
         # Qwen3 (+ MoE): llama layer layout plus per-head RMS q/k norms
         # (ONE [head_dim] scale shared across heads) and an explicit
         # head_dim decoupled from hidden_size/num_heads. The MoE variant
-        # is mixtral-shaped (softmax -> top-k -> renormalize matches our
-        # router only when norm_topk_prob is set).
-        kinds = list(getattr(hf_config, "layer_types", None) or [])
-        win = getattr(hf_config, "sliding_window", None)
-        wins = tuple(win if t == "sliding_attention" else None
-                     for t in kinds)
-        windowed = win is not None and any(w is not None for w in wins)
-        uniform = not windowed or len(set(wins)) == 1
+        # is mixtral-shaped (softmax -> top-k, with norm_topk_prob
+        # driving the renormalize — cfg.moe_norm_topk).
+        sw, aw, _ = _layer_windows_from_hf(hf_config)
         q3_inv_freq, q3_attn_factor, _ = _rope_scaling_params(
             hf_config, getattr(hf_config, "head_dim", None)
             or hf_config.hidden_size // hf_config.num_attention_heads, mt)
         num_experts = 0
         if mt == "qwen3_moe":
             num_experts = hf_config.num_experts
-            if not getattr(hf_config, "norm_topk_prob", True):
-                raise NotImplementedError(
-                    "qwen3_moe with norm_topk_prob=False")
             if list(getattr(hf_config, "mlp_only_layers", []) or []):
                 raise NotImplementedError("qwen3_moe with mlp_only_layers")
             if getattr(hf_config, "decoder_sparse_step", 1) != 1:
@@ -766,11 +777,11 @@ def config_from_hf(hf_config) -> ModelConfig:
             rope_inv_freq=q3_inv_freq, rope_attn_factor=q3_attn_factor,
             attn_bias=getattr(hf_config, "attention_bias", False),
             mlp_bias=False, qk_norm="rms_head",
-            sliding_window=(wins[0] if windowed and uniform else None),
-            attn_windows=None if uniform else wins,
+            sliding_window=sw, attn_windows=aw,
             num_experts=num_experts,
             num_experts_per_tok=getattr(hf_config, "num_experts_per_tok",
                                         2),
+            moe_norm_topk=bool(getattr(hf_config, "norm_topk_prob", True)),
             tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
                                         False))
     if mt == "ernie4_5":
@@ -801,13 +812,7 @@ def config_from_hf(hf_config) -> ModelConfig:
         # SmolLM3: llama layout with per-layer NoPE (no_rope_layers: 1 =
         # rotate, 0 = position-free — config.py rope_layers) and
         # optional per-layer sliding windows via layer_types.
-        kinds = list(getattr(hf_config, "layer_types", None) or [])
-        win = getattr(hf_config, "sliding_window", None)
-        use_win = bool(getattr(hf_config, "use_sliding_window", win))
-        wins = tuple(win if (use_win and t == "sliding_attention")
-                     else None for t in kinds)
-        windowed = any(w is not None for w in wins)
-        uniform = not windowed or len(set(wins)) == 1
+        sw, aw, _ = _layer_windows_from_hf(hf_config, require_use_flag=True)
         nope = tuple(int(v) for v in
                      getattr(hf_config, "no_rope_layers", None) or [])
         return ModelConfig(
@@ -828,8 +833,7 @@ def config_from_hf(hf_config) -> ModelConfig:
             rope_theta=getattr(hf_config, "rope_theta", 10000.0),
             attn_bias=bool(getattr(hf_config, "attention_bias", False)),
             mlp_bias=bool(getattr(hf_config, "mlp_bias", False)),
-            sliding_window=(wins[0] if windowed and uniform else None),
-            attn_windows=None if uniform else wins,
+            sliding_window=sw, attn_windows=aw,
             rope_layers=(nope if nope and not all(nope) else None),
             tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
                                         True))
@@ -863,12 +867,8 @@ def config_from_hf(hf_config) -> ModelConfig:
         # shared [head_dim] q/k RMS norms, hybrid attention — sliding
         # layers rotate, full-attention layers are NoPE (rope_layers) —
         # and per-layer windows from layer_types.
-        kinds = list(getattr(hf_config, "layer_types", None) or [])
-        win = getattr(hf_config, "sliding_window", None)
-        wins = tuple(win if t == "sliding_attention" else None
-                     for t in kinds)
-        windowed = win is not None and any(w is not None for w in wins)
-        uniform = not windowed or len(set(wins)) == 1
+        sw, aw, kinds = _layer_windows_from_hf(hf_config)
+        windowed = sw is not None or aw is not None
         rope_on = (tuple(1 if t == "sliding_attention" else 0
                          for t in kinds) if windowed else None)
         return ModelConfig(
@@ -889,9 +889,44 @@ def config_from_hf(hf_config) -> ModelConfig:
             rope_theta=getattr(hf_config, "rope_theta", 10000.0),
             attn_bias=False, mlp_bias=False, qk_norm="rms_head",
             sublayer_postnorm_only=True,
-            sliding_window=(wins[0] if windowed and uniform else None),
-            attn_windows=None if uniform else wins,
-            rope_layers=rope_on,
+            sliding_window=sw, attn_windows=aw, rope_layers=rope_on,
+            tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
+                                        False))
+    if mt == "dbrx":
+        # DBRX: the standard pre-LN sequential block under unusual
+        # naming (norm_attn_norm.norm_1/norm_2 ≡ attn/mlp pre-norms,
+        # bias-free LayerNorms), fused Wqkv with the clip_qkv activation
+        # clamp (config.py qkv_clip), and a 16-expert GLU MoE whose
+        # router renormalizes top-k weights by their p-norm —
+        # p=1 over softmax weights == our renorm; None == no renorm
+        # (moe_norm_topk); other p values are refused.
+        ac, fc = hf_config.attn_config, hf_config.ffn_config
+        p = getattr(fc, "moe_normalize_expert_weights", 1.0)
+        if p is not None and float(p) != 1.0:
+            raise NotImplementedError(
+                f"dbrx moe_normalize_expert_weights={p} — only 1.0 "
+                "(L1 over positive softmax weights == renormalize) or "
+                "None convert")
+        act = getattr(fc, "ffn_act_fn", None) or {}
+        return ModelConfig(
+            name=getattr(hf_config, "name_or_path", mt) or mt,
+            family="dbrx", vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.d_model,
+            intermediate_size=fc.ffn_hidden_size,
+            num_layers=hf_config.n_layers, num_heads=hf_config.n_heads,
+            num_kv_heads=ac.kv_n_heads,
+            head_dim=hf_config.d_model // hf_config.n_heads,
+            max_position_embeddings=hf_config.max_seq_len,
+            norm_type="layernorm",
+            activation=_act_from_hf(act.get("name", "silu")),
+            gated_mlp=True, position_embedding="rope",
+            rope_theta=getattr(ac, "rope_theta", 10000.0),
+            attn_bias=False, mlp_bias=False,
+            qkv_clip=(float(ac.clip_qkv) if getattr(ac, "clip_qkv", None)
+                      else None),
+            num_experts=fc.moe_num_experts,
+            num_experts_per_tok=fc.moe_top_k,
+            moe_norm_topk=p is not None,
             tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
                                         False))
     if mt == "deepseek_v3":
@@ -1238,6 +1273,50 @@ def convert_state_dict(cfg: ModelConfig, sd, dtype=None):
             "embed": {"tokens": get("model.embed_tokens.weight")},
             "layers": _stack([layer(i) for i in range(cfg.num_layers)]),
             "final_norm": {"scale": get("model.norm.weight") + off},
+        }
+        if not cfg.tie_word_embeddings:
+            params["lm_head"] = {"w": get("lm_head.weight").T}
+    elif fam == "dbrx":
+        # transformer.blocks.N.norm_attn_norm.{norm_1, attn.Wqkv,
+        # attn.out_proj, norm_2} + ffn.{router.layer, experts.mlp.
+        # {w1,v1,w2}}; LayerNorms are bias-free (zero bias is the exact
+        # parametric equivalent), experts are FUSED [E*I, D] stacks —
+        # w1/v1 contract transposed (gate/up), w2 contracts as stored
+        # (down, HF DbrxExpertGLU.forward).
+        D = cfg.hidden_size
+        E, I = cfg.num_experts, cfg.intermediate_size
+        kvd = cfg.num_kv_heads * cfg.head_dim
+        zb = np.zeros((D,), np.float32)
+
+        def layer(i):
+            p = f"transformer.blocks.{i}."
+            qkv = get(p + "norm_attn_norm.attn.Wqkv.weight").T  # [D,D+2kvd]
+            w1 = get(p + "ffn.experts.mlp.w1").reshape(E, I, D)
+            v1 = get(p + "ffn.experts.mlp.v1").reshape(E, I, D)
+            w2 = get(p + "ffn.experts.mlp.w2").reshape(E, I, D)
+            return {
+                "attn_norm": {
+                    "scale": get(p + "norm_attn_norm.norm_1.weight"),
+                    "bias": zb},
+                "q": {"w": qkv[:, :D]},
+                "k": {"w": qkv[:, D:D + kvd]},
+                "v": {"w": qkv[:, D + kvd:]},
+                "o": {"w": get(p + "norm_attn_norm.attn.out_proj.weight").T},
+                "mlp_norm": {
+                    "scale": get(p + "norm_attn_norm.norm_2.weight"),
+                    "bias": zb},
+                "router": {"w": get(p + "ffn.router.layer.weight").T},
+                "experts": {
+                    "gate": {"w": np.swapaxes(w1, 1, 2)},   # [E, D, I]
+                    "up": {"w": np.swapaxes(v1, 1, 2)},
+                    "down": {"w": w2},                      # [E, I, D]
+                },
+            }
+        params = {
+            "embed": {"tokens": get("transformer.wte.weight")},
+            "layers": _stack([layer(i) for i in range(cfg.num_layers)]),
+            "final_norm": {"scale": get("transformer.norm_f.weight"),
+                           "bias": zb},
         }
         if not cfg.tie_word_embeddings:
             params["lm_head"] = {"w": get("lm_head.weight").T}

@@ -182,7 +182,9 @@ def _moe_gates(x, lp, cfg: ModelConfig):
     probs = jax.nn.softmax(router_logits, axis=-1)          # [...,E]
     kth = jax.lax.top_k(probs, k)[0][..., -1:]
     gate = jnp.where(probs >= kth, probs, 0.0)
-    return gate / jnp.sum(gate, axis=-1, keepdims=True)     # [...,E]
+    if cfg.moe_norm_topk:   # dbrx moe_normalize_expert_weights=None
+        gate = gate / jnp.sum(gate, axis=-1, keepdims=True)  # skips this
+    return gate                                             # [...,E]
 
 
 def _moe_dense(x, lp, cfg: ModelConfig):
@@ -623,6 +625,11 @@ def _block_body(x, lp, cfg: ModelConfig, q_positions, attend_write,
         q = _linear(h, lp["q"]).reshape(B, s, cfg.num_heads, cfg.head_dim)
         k = _linear(h, lp["k"]).reshape(B, s, cfg.num_kv_heads, cfg.head_dim)
         v = _linear(h, lp["v"]).reshape(B, s, cfg.num_kv_heads, cfg.head_dim)
+
+        if cfg.qkv_clip is not None:   # dbrx clip_qkv activation clamp
+            q = jnp.clip(q, -cfg.qkv_clip, cfg.qkv_clip)
+            k = jnp.clip(k, -cfg.qkv_clip, cfg.qkv_clip)
+            v = jnp.clip(v, -cfg.qkv_clip, cfg.qkv_clip)
 
         if cfg.qk_norm and not cfg.qk_norm_after_rope:
             q = _qk_normalize(q, lp["q_norm"], cfg)

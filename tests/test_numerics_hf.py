@@ -1501,3 +1501,46 @@ def test_exaone4_hybrid_matches_hf():
     rng = np.random.default_rng(53)
     tokens = rng.integers(0, 128, size=(1, 12), dtype=np.int64)
     _check_model(model, tokens)
+
+
+def test_dbrx_matches_hf():
+    """DBRX: fused-Wqkv pre-LN block with the clip_qkv activation clamp,
+    bias-free LayerNorms, and a fused-GLU MoE whose router renormalizes
+    top-k softmax weights by L1 (p=1). top_k=2 of 4 experts here."""
+    import torch
+    import transformers
+    torch_cfg = transformers.DbrxConfig(
+        vocab_size=128, d_model=32, n_heads=4, n_layers=3, max_seq_len=64,
+        attn_config={"kv_n_heads": 2, "clip_qkv": 0.5,
+                     "rope_theta": 10000.0},
+        ffn_config={"ffn_hidden_size": 16, "moe_num_experts": 4,
+                    "moe_top_k": 2, "moe_normalize_expert_weights": 1.0},
+        tie_word_embeddings=False, pad_token_id=0)
+    torch.manual_seed(54)
+    model = transformers.DbrxForCausalLM(torch_cfg).eval()
+    cfg, params = convert.load_hf_model(model, dtype=jnp.float32)
+    assert cfg.qkv_clip == 0.5 and cfg.num_experts == 4
+    assert cfg.moe_norm_topk
+    rng = np.random.default_rng(54)
+    tokens = rng.integers(0, 128, size=(2, 10), dtype=np.int64)
+    _check_model(model, tokens)
+
+
+def test_qwen3_moe_no_renorm_matches_hf():
+    """qwen3_moe with norm_topk_prob=False (previously refused): the
+    top-k softmax weights apply UNnormalized (cfg.moe_norm_topk)."""
+    import torch
+    import transformers
+    torch_cfg = transformers.Qwen3MoeConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        moe_intermediate_size=16, num_experts=4, num_experts_per_tok=2,
+        norm_topk_prob=False, num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, head_dim=16, max_position_embeddings=64,
+        tie_word_embeddings=False, pad_token_id=0)
+    torch.manual_seed(55)
+    model = transformers.Qwen3MoeForCausalLM(torch_cfg).eval()
+    cfg, _ = convert.load_hf_model(model, dtype=jnp.float32)
+    assert not cfg.moe_norm_topk
+    rng = np.random.default_rng(55)
+    tokens = rng.integers(0, 128, size=(2, 8), dtype=np.int64)
+    _check_model(model, tokens)
