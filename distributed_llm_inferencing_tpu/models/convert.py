@@ -104,7 +104,8 @@ def _rope_scaling_params(hf_config, dim: int, what: str):
     effect is a static frequency-ladder rewrite — "yarn" (+ deepseek's
     mscale), "llama3" (Llama 3.1+ NTK-by-part smoothing, HF
     modeling_rope_utils._compute_llama3_parameters), "linear"
-    (position-interpolation: uniform /factor), "default" — and refuses
+    (position-interpolation: uniform /factor), "longrope" (Phi-3.5
+    factor sets, static regime pick), "default" — and refuses
     the rest loudly (silently ignoring rope_scaling would corrupt
     long-context logits for every scaled checkpoint)."""
     import math
@@ -123,6 +124,34 @@ def _rope_scaling_params(hf_config, dim: int, what: str):
     if kind == "linear":
         return tuple(float(f) for f in inv_freq / float(rs["factor"])), \
             1.0, 1.0
+    if kind == "longrope":
+        # Phi-3-style longrope carries TWO per-dim factor sets that HF
+        # switches per forward at original_max_position_embeddings. A
+        # static conversion must pick ONE regime: we convert for the
+        # window the checkpoint ADVERTISES — long factors (plus the
+        # attention factor) when max_position_embeddings was extended
+        # past the original, short factors otherwise. Exact HF parity
+        # within the chosen regime; sequences in the other regime see
+        # the divergence HF itself acknowledges when the cache crosses
+        # the boundary mid-generation.
+        orig = (getattr(hf_config, "original_max_position_embeddings",
+                        None)
+                or rs.get("original_max_position_embeddings")
+                or hf_config.max_position_embeddings)
+        extended = hf_config.max_position_embeddings > orig
+        ext = np.asarray(rs["long_factor" if extended else "short_factor"],
+                         np.float64)
+        if ext.shape != (dim // 2,):
+            raise NotImplementedError(
+                f"longrope factor set has {ext.shape[0]} entries for "
+                f"rotary dim {dim}")
+        attn_factor = rs.get("attention_factor")
+        if attn_factor is None:
+            f = hf_config.max_position_embeddings / orig
+            attn_factor = (1.0 if f <= 1.0
+                           else math.sqrt(1 + math.log(f) / math.log(orig)))
+        return tuple(float(v) for v in 1.0 / (ext * pos_freqs)), \
+            float(attn_factor), 1.0
     if kind == "llama3":
         factor = float(rs["factor"])
         lo_f = float(rs["low_freq_factor"])
@@ -137,8 +166,8 @@ def _rope_scaling_params(hf_config, dim: int, what: str):
         out = np.where(medium, smoothed, scaled)
         return tuple(float(f) for f in out), 1.0, 1.0
     raise NotImplementedError(
-        f"{what} rope_scaling type {kind!r} — yarn, llama3 and linear "
-        "convert")
+        f"{what} rope_scaling type {kind!r} — yarn, llama3, linear and "
+        "longrope convert")
 
 
 def _layer_windows_from_hf(hf_config, require_use_flag: bool = False):
@@ -591,11 +620,13 @@ def config_from_hf(hf_config) -> ModelConfig:
         # Phi-3: llama semantics (rmsnorm, SwiGLU, full rotary, GQA,
         # bias-free, untied head) with FUSED qkv_proj ([q|k|v] rows) and
         # gate_up_proj ([gate|up] rows) — split in convert_state_dict.
-        if getattr(hf_config, "rope_scaling", None):
-            raise NotImplementedError(
-                "phi3 with rope_scaling (longrope) — only the base-rope "
-                "variants convert")
+        # Longrope (Phi-3.5's 128k extension) converts via the static
+        # regime pick in _rope_scaling_params.
         heads = hf_config.num_attention_heads
+        p3_inv_freq, p3_attn_factor, _ = _rope_scaling_params(
+            hf_config,
+            int((hf_config.hidden_size // heads)
+                * getattr(hf_config, "partial_rotary_factor", 1.0)), mt)
         return ModelConfig(
             name=getattr(hf_config, "name_or_path", mt) or mt,
             family="phi3", vocab_size=hf_config.vocab_size,
@@ -611,6 +642,12 @@ def config_from_hf(hf_config) -> ModelConfig:
                                             "silu")),
             gated_mlp=True, position_embedding="rope",
             rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+            rope_inv_freq=p3_inv_freq, rope_attn_factor=p3_attn_factor,
+            # phi-4-mini ships partial rotary; the scaled ladder above is
+            # already sized to the partial dim, and rope_pct keeps
+            # apply_rope's rotated slice to the same width
+            rope_pct=float(getattr(hf_config, "partial_rotary_factor",
+                                   1.0)),
             attn_bias=False, mlp_bias=False,
             sliding_window=getattr(hf_config, "sliding_window", None),
             tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",

@@ -752,17 +752,30 @@ def test_phi3_matches_hf():
     _check_model(model, tokens)
 
 
-def test_phi3_rope_scaling_rejected():
+def test_phi3_longrope_matches_hf():
+    """Phi-3.5 longrope (previously refused): the static conversion
+    picks the LONG factor set + attention factor when the checkpoint
+    advertises an extended window — exact HF parity for sequences past
+    original_max_position_embeddings (where HF also uses the long set).
+    Sequence length 24 > original 16 here."""
+    import torch
     import transformers
-    import pytest as _pytest
-    cfg = transformers.Phi3Config(
-        vocab_size=64, hidden_size=32, intermediate_size=64,
-        num_hidden_layers=1, num_attention_heads=4,
-        max_position_embeddings=128, original_max_position_embeddings=64,
+    torch_cfg = transformers.Phi3Config(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, original_max_position_embeddings=16,
         rope_scaling={"type": "longrope",
-                      "short_factor": [1.0] * 4, "long_factor": [2.0] * 4})
-    with _pytest.raises(NotImplementedError, match="rope_scaling"):
-        convert.config_from_hf(cfg)
+                      "short_factor": [1.0, 1.1, 1.2, 1.3],
+                      "long_factor": [1.5, 2.0, 3.0, 4.0]},
+        tie_word_embeddings=False, pad_token_id=0)
+    torch.manual_seed(56)
+    model = transformers.Phi3ForCausalLM(torch_cfg).eval()
+    cfg, _ = convert.load_hf_model(model, dtype=jnp.float32)
+    assert cfg.rope_inv_freq is not None and len(cfg.rope_inv_freq) == 4
+    assert cfg.rope_attn_factor > 1.0
+    rng = np.random.default_rng(56)
+    tokens = rng.integers(0, 128, size=(1, 24), dtype=np.int64)
+    _check_model(model, tokens)
 
 
 def test_gpt_neo_matches_hf():
@@ -1543,4 +1556,29 @@ def test_qwen3_moe_no_renorm_matches_hf():
     assert not cfg.moe_norm_topk
     rng = np.random.default_rng(55)
     tokens = rng.integers(0, 128, size=(2, 8), dtype=np.int64)
+    _check_model(model, tokens)
+
+
+def test_phi3_partial_rotary_longrope_matches_hf():
+    """Phi-4-mini shape: partial_rotary_factor < 1 WITH longrope — the
+    scaled ladder sizes to the partial dim and rope_pct keeps the
+    rotated slice to the same width (full-width rotation would
+    shape-mismatch the 6-entry ladder against 8-dim halves)."""
+    import torch
+    import transformers
+    torch_cfg = transformers.Phi3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        partial_rotary_factor=0.75,
+        max_position_embeddings=64, original_max_position_embeddings=16,
+        rope_scaling={"type": "longrope",
+                      "short_factor": [1.0] * 6,
+                      "long_factor": [1.5, 2.0, 2.5, 3.0, 3.5, 4.0]},
+        tie_word_embeddings=False, pad_token_id=0)
+    torch.manual_seed(57)
+    model = transformers.Phi3ForCausalLM(torch_cfg).eval()
+    cfg, _ = convert.load_hf_model(model, dtype=jnp.float32)
+    assert cfg.rope_pct == 0.75 and len(cfg.rope_inv_freq) == 6
+    rng = np.random.default_rng(57)
+    tokens = rng.integers(0, 128, size=(1, 24), dtype=np.int64)
     _check_model(model, tokens)
