@@ -134,11 +134,20 @@ def _rope_scaling_params(hf_config, dim: int, what: str):
         # within the chosen regime; sequences in the other regime see
         # the divergence HF itself acknowledges when the cache crosses
         # the boundary mid-generation.
-        orig = (getattr(hf_config, "original_max_position_embeddings",
-                        None)
-                or rs.get("original_max_position_embeddings")
-                or hf_config.max_position_embeddings)
-        extended = hf_config.max_position_embeddings > orig
+        # HF reads original_max_position_embeddings from the CONFIG
+        # attribute only (never the rope_scaling dict), deriving the
+        # attention-factor base from max/original when present and from
+        # rs["factor"] otherwise (modeling_rope_utils.py
+        # _compute_longrope_parameters)
+        orig = getattr(hf_config, "original_max_position_embeddings",
+                       None)
+        if orig:
+            factor = hf_config.max_position_embeddings / orig
+            extended = hf_config.max_position_embeddings > orig
+        else:
+            orig = hf_config.max_position_embeddings
+            factor = float(rs.get("factor") or 1.0)
+            extended = False   # no original => HF stays on short factors
         ext = np.asarray(rs["long_factor" if extended else "short_factor"],
                          np.float64)
         if ext.shape != (dim // 2,):
@@ -147,9 +156,9 @@ def _rope_scaling_params(hf_config, dim: int, what: str):
                 f"rotary dim {dim}")
         attn_factor = rs.get("attention_factor")
         if attn_factor is None:
-            f = hf_config.max_position_embeddings / orig
-            attn_factor = (1.0 if f <= 1.0
-                           else math.sqrt(1 + math.log(f) / math.log(orig)))
+            attn_factor = (1.0 if factor <= 1.0
+                           else math.sqrt(1 + math.log(factor)
+                                          / math.log(orig)))
         return tuple(float(v) for v in 1.0 / (ext * pos_freqs)), \
             float(attn_factor), 1.0
     if kind == "llama3":

@@ -144,3 +144,59 @@ def test_latent_speculative_verify_matches_plain_greedy():
                         sampling=SamplingParams.greedy(),
                         speculative="ngram").tokens[0]
     assert spec == plain
+
+
+def test_deepseek_materialized_kv8_batcher():
+    """MLA through the continuous batcher with the int8-quantized paged
+    pool (the batcher always uses the materialized layout): greedy
+    trajectory must match the unquantized batcher's closely enough to
+    emit identical tokens on a short run."""
+    from distributed_llm_inferencing_tpu.runtime.batcher import (
+        ContinuousBatcher)
+    base = get_config("tiny-deepseek").replace(dtype="float32",
+                                               attn_backend="xla")
+    params = init_params(base, jax.random.PRNGKey(3), dtype=jnp.float32)
+    prompt = np.random.default_rng(3).integers(
+        0, base.vocab_size, 9).tolist()
+
+    outs = {}
+    for tag, kvq in (("f32", None), ("kv8", "int8")):
+        b = ContinuousBatcher(base.replace(kv_quant=kvq), num_blocks=16,
+                              block_size=8, slots=2, max_seq=32, seed=0,
+                              params=params)
+        r = b.submit(prompt, max_new_tokens=8,
+                     sampling=SamplingParams.greedy())
+        while b.step():
+            pass
+        assert r.error is None
+        outs[tag] = r.tokens
+    assert outs["f32"] == outs["kv8"]
+
+
+def test_deepseek_tp_ep_batcher_matches_engine():
+    """MLA + deepseek MoE through the tp x ep sharded continuous batcher
+    (materialized pool) must emit the same greedy tokens as the
+    single-device engine (which auto-enables the latent cache) — the two
+    layouts and the sharding are all numerically the same attention."""
+    from distributed_llm_inferencing_tpu.parallel.mesh import MeshSpec
+    from distributed_llm_inferencing_tpu.runtime.batcher import (
+        ContinuousBatcher)
+    base = get_config("tiny-deepseek").replace(dtype="float32",
+                                               attn_backend="xla")
+    params = init_params(base, jax.random.PRNGKey(4), dtype=jnp.float32)
+    prompt = np.random.default_rng(4).integers(
+        0, base.vocab_size, 11).tolist()
+
+    spec = MeshSpec(tp=2, ep=2)
+    b = ContinuousBatcher(base, params, num_blocks=16, block_size=8,
+                          slots=2, max_seq=32, mesh_spec=spec)
+    r = b.submit(prompt, max_new_tokens=8,
+                 sampling=SamplingParams.greedy())
+    while b.step():
+        pass
+    assert r.error is None
+
+    eng = InferenceEngine(base, params, max_seq=32)
+    want = eng.generate([prompt], max_new_tokens=8,
+                        sampling=SamplingParams.greedy()).tokens[0]
+    assert r.tokens == want

@@ -1582,3 +1582,23 @@ def test_phi3_partial_rotary_longrope_matches_hf():
     rng = np.random.default_rng(57)
     tokens = rng.integers(0, 128, size=(1, 24), dtype=np.int64)
     _check_model(model, tokens)
+
+
+def test_longrope_without_original_attr_uses_short_and_rs_factor():
+    """HF reads original_max_position_embeddings from the CONFIG
+    attribute only; without it the short factors apply and the
+    attention factor derives from rope_scaling['factor'] (mirrors
+    modeling_rope_utils._compute_longrope_parameters)."""
+    import math
+    from types import SimpleNamespace
+    hf = SimpleNamespace(
+        rope_theta=10000.0, max_position_embeddings=64,
+        rope_scaling={"type": "longrope", "factor": 4.0,
+                      "short_factor": [1.0, 1.1, 1.2, 1.3],
+                      "long_factor": [9.0] * 4})
+    inv, attn, _ = convert._rope_scaling_params(hf, 8, "test")
+    base = 10000.0 ** (np.arange(0, 8, 2) / 8)
+    np.testing.assert_allclose(
+        inv, 1.0 / (np.array([1.0, 1.1, 1.2, 1.3]) * base), rtol=1e-12)
+    assert attn == pytest.approx(
+        math.sqrt(1 + math.log(4.0) / math.log(64)))
