@@ -197,7 +197,9 @@ class ModelConfig:
     num_experts: int = 0
     num_experts_per_tok: int = 2
     # Router convention: "softmax" (Mixtral/Qwen3-MoE: softmax -> top-k
-    # -> renormalize) | "deepseek_v3" (sigmoid scores; selection by
+    # -> renormalize) | "ernie" (ERNIE-4.5-MoE: softmax scores under the
+    # deepseek-style bias-corrected SELECTION, unbiased weights) |
+    # "deepseek_v3" (sigmoid scores; selection by
     # scores + e_score_correction_bias under group-limited top-k —
     # moe_n_group groups scored by their top-2 sum, top moe_topk_group
     # groups kept; weights are the UNbiased scores, renormalized when
@@ -324,7 +326,7 @@ class ModelConfig:
                 "sliding windows or score softcapping (no MLA "
                 "architecture uses them); serve such a config with the "
                 "materialized layout (DLI_MLA_LATENT=0)")
-        assert self.moe_router in ("softmax", "deepseek_v3"), (
+        assert self.moe_router in ("softmax", "deepseek_v3", "ernie"), (
             f"unknown moe_router {self.moe_router!r}")
         if self.dense_prefix_layers:
             assert 0 < self.dense_prefix_layers < self.num_layers, (
@@ -337,7 +339,7 @@ class ModelConfig:
             assert self.dense_intermediate_size, (
                 "dense_prefix_layers needs dense_intermediate_size (the "
                 "prefix MLP width differs from the per-expert width)")
-        if self.moe_router == "deepseek_v3" and self.num_experts:
+        if self.moe_router in ("deepseek_v3", "ernie") and self.num_experts:
             E, G = self.num_experts, self.moe_n_group
             assert G >= 1 and E % G == 0, (
                 f"deepseek routing: num_experts={E} must divide into "

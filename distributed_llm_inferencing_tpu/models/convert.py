@@ -218,7 +218,8 @@ SUPPORTED_MODEL_TYPES = ("gpt2", "opt", "llama", "mistral", "mixtral",
                          "gpt_neo", "gemma2", "cohere", "qwen3",
                          "qwen3_moe", "granite", "olmo2", "glm", "glm4",
                          "nemotron", "deepseek_v3", "ernie4_5", "smollm3",
-                         "hunyuan_v1_dense", "exaone4", "dbrx")
+                         "hunyuan_v1_dense", "exaone4", "dbrx", "glm4_moe",
+                         "ernie4_5_moe")
 
 
 def config_from_hf(hf_config) -> ModelConfig:
@@ -938,6 +939,107 @@ def config_from_hf(hf_config) -> ModelConfig:
             sliding_window=sw, attn_windows=aw, rope_layers=rope_on,
             tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
                                         False))
+    if mt == "ernie4_5_moe":
+        # ERNIE 4.5 MoE: the dense ernie4_5 layout with softmax routing
+        # under deepseek-style bias-corrected SELECTION (moe_statics.
+        # e_score_correction_bias, moe_router="ernie"), shared experts,
+        # and a dense prefix (moe_layer_start_index). Every-Nth-layer
+        # MoE interleaving (moe_layer_interval > 1) and early MoE end
+        # are refused — the segment machinery models prefix+tail only.
+        L = hf_config.num_hidden_layers
+        if getattr(hf_config, "moe_layer_interval", 1) != 1:
+            raise NotImplementedError(
+                "ernie4_5_moe with moe_layer_interval != 1")
+        if getattr(hf_config, "moe_layer_end_index", L - 1) not in (
+                -1, L - 1):
+            raise NotImplementedError(
+                "ernie4_5_moe with moe_layer_end_index before the last "
+                "layer")
+        fk = getattr(hf_config, "moe_layer_start_index", 0) or 0
+        mixed = 0 < fk < L
+        b = bool(getattr(hf_config, "use_bias", False))
+        return ModelConfig(
+            name=getattr(hf_config, "name_or_path", mt) or mt,
+            family="llama", vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            intermediate_size=hf_config.moe_intermediate_size,
+            num_layers=L, num_heads=hf_config.num_attention_heads,
+            num_kv_heads=getattr(hf_config, "num_key_value_heads", None)
+            or hf_config.num_attention_heads,
+            head_dim=getattr(hf_config, "head_dim", None)
+            or hf_config.hidden_size // hf_config.num_attention_heads,
+            max_position_embeddings=hf_config.max_position_embeddings,
+            norm_type="rmsnorm", norm_eps=hf_config.rms_norm_eps,
+            activation=_act_from_hf(hf_config.hidden_act),
+            gated_mlp=True, position_embedding="rope",
+            rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+            attn_bias=b, o_bias=b, mlp_bias=b,
+            num_experts=hf_config.moe_num_experts,
+            num_experts_per_tok=getattr(hf_config, "moe_k", 2),
+            moe_router="ernie",
+            moe_shared_experts=(getattr(hf_config,
+                                        "moe_num_shared_experts", 0)
+                                or 0),
+            dense_prefix_layers=fk if mixed else 0,
+            dense_intermediate_size=(hf_config.intermediate_size
+                                     if mixed else None),
+            tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
+                                        True))
+    if mt == "glm4_moe":
+        # GLM-4.5 (MoE): llama block topology with optional per-head
+        # q/k RMS norms (pre-rope, qwen3-style), partial half-split
+        # rotary, and DeepSeek-V3's exact routing — sigmoid scores,
+        # e_score_correction_bias group-limited top-k, shared experts —
+        # over a first_k_dense_replace mixed dense/MoE stack (HF
+        # modeling_glm4_moe.py Glm4MoeTopkRouter is byte-for-byte
+        # deepseek's).
+        L = hf_config.num_hidden_layers
+        fk = getattr(hf_config, "first_k_dense_replace", 0) or 0
+        all_dense = fk >= L
+        E = 0 if all_dense else hf_config.n_routed_experts
+        mixed = 0 < fk < L
+        hd = (getattr(hf_config, "head_dim", None)
+              or hf_config.hidden_size // hf_config.num_attention_heads)
+        pct = float(getattr(hf_config, "partial_rotary_factor", 1.0))
+        gm_inv_freq, gm_attn_factor, _ = _rope_scaling_params(
+            hf_config, int(hd * pct), mt)
+        return ModelConfig(
+            name=getattr(hf_config, "name_or_path", mt) or mt,
+            family="llama", vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            intermediate_size=(hf_config.intermediate_size if all_dense
+                               else hf_config.moe_intermediate_size),
+            num_layers=L, num_heads=hf_config.num_attention_heads,
+            num_kv_heads=getattr(hf_config, "num_key_value_heads", None)
+            or hf_config.num_attention_heads,
+            head_dim=hd,
+            max_position_embeddings=hf_config.max_position_embeddings,
+            norm_type="rmsnorm", norm_eps=hf_config.rms_norm_eps,
+            activation=_act_from_hf(hf_config.hidden_act),
+            gated_mlp=True, position_embedding="rope",
+            rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+            rope_pct=pct,
+            rope_inv_freq=gm_inv_freq, rope_attn_factor=gm_attn_factor,
+            attn_bias=bool(getattr(hf_config, "attention_bias", False)),
+            o_bias=False, mlp_bias=False,
+            qk_norm=("rms_head" if getattr(hf_config, "use_qk_norm",
+                                           False) else None),
+            num_experts=E,
+            num_experts_per_tok=getattr(hf_config, "num_experts_per_tok",
+                                        8),
+            moe_router="deepseek_v3" if E else "softmax",
+            moe_n_group=getattr(hf_config, "n_group", 1) or 1,
+            moe_topk_group=getattr(hf_config, "topk_group", 1) or 1,
+            moe_routed_scale=float(getattr(hf_config,
+                                           "routed_scaling_factor", 1.0)),
+            moe_norm_topk=bool(getattr(hf_config, "norm_topk_prob", True)),
+            moe_shared_experts=(getattr(hf_config, "n_shared_experts", 0)
+                                or 0) if E else 0,
+            dense_prefix_layers=fk if mixed else 0,
+            dense_intermediate_size=(hf_config.intermediate_size
+                                     if mixed else None),
+            tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
+                                        False))
     if mt == "dbrx":
         # DBRX: the standard pre-LN sequential block under unusual
         # naming (norm_attn_norm.norm_1/norm_2 ≡ attn/mlp pre-norms,
@@ -1265,7 +1367,7 @@ def convert_state_dict(cfg: ModelConfig, sd, dtype=None):
         qs = (cfg.head_dim / (cfg.query_pre_attn_scalar
                               or cfg.head_dim)) ** 0.5
 
-        def layer(i):
+        def layer(i, moe):
             p = f"model.layers.{i}."
             def lin(n, scale=1.0):
                 out = {"w": get(p + n + ".weight").T * scale}
@@ -1293,16 +1395,32 @@ def convert_state_dict(cfg: ModelConfig, sd, dtype=None):
                       else "self_attn.key_layernorm.weight")
                 lp["q_norm"] = {"scale": get(p + qn) * qs}
                 lp["k_norm"] = {"scale": get(p + kn)}
-            if cfg.is_moe and (p + "mlp.gate.weight") in sd:
-                # qwen3_moe naming: mlp.gate + mlp.experts.N.{gate,up,down}_proj
+            if moe and (p + "mlp.gate.weight") in sd:
+                # qwen3_moe / glm4_moe naming: mlp.gate +
+                # mlp.experts.N.{gate,up,down}_proj
                 lp["router"] = {"w": get(p + "mlp.gate.weight").T}
+                if cfg.moe_router in ("deepseek_v3", "ernie"):
+                    # glm4_moe names the bias under the gate; ernie
+                    # under moe_statics (shape [1, E] — squeeze)
+                    bn = p + "mlp.gate.e_score_correction_bias"
+                    if bn in sd:
+                        lp["router"]["bias"] = get(bn)
+                    else:
+                        lp["router"]["bias"] = get(
+                            p + "mlp.moe_statics.e_score_correction_bias"
+                        ).reshape(-1)
                 ex = [f"mlp.experts.{e}." for e in range(cfg.num_experts)]
                 lp["experts"] = {
                     "gate": {"w": np.stack([get(p + e + "gate_proj.weight").T for e in ex])},
                     "up": {"w": np.stack([get(p + e + "up_proj.weight").T for e in ex])},
                     "down": {"w": np.stack([get(p + e + "down_proj.weight").T for e in ex])},
                 }
-            elif cfg.is_moe:
+                if cfg.moe_shared_experts:
+                    s = "mlp.shared_experts."
+                    lp["shared_gate"] = lin(s + "gate_proj")
+                    lp["shared_up"] = lin(s + "up_proj")
+                    lp["shared_down"] = lin(s + "down_proj")
+            elif moe:
                 lp["router"] = {"w": get(p + "block_sparse_moe.gate.weight").T}
                 ex = [f"block_sparse_moe.experts.{e}." for e in range(cfg.num_experts)]
                 lp["experts"] = {
@@ -1315,11 +1433,16 @@ def convert_state_dict(cfg: ModelConfig, sd, dtype=None):
                 lp["up"] = lin("mlp.up_proj")
                 lp["down"] = lin("mlp.down_proj")
             return lp
+        pref = cfg.dense_prefix_layers
         params = {
             "embed": {"tokens": get("model.embed_tokens.weight")},
-            "layers": _stack([layer(i) for i in range(cfg.num_layers)]),
+            "layers": _stack([layer(i, cfg.is_moe)
+                              for i in range(pref, cfg.num_layers)]),
             "final_norm": {"scale": get("model.norm.weight") + off},
         }
+        if pref:   # glm4_moe first_k_dense_replace: dense prefix segment
+            params["layers_dense"] = _stack(
+                [layer(i, False) for i in range(pref)])
         if not cfg.tie_word_embeddings:
             params["lm_head"] = {"w": get("lm_head.weight").T}
     elif fam == "dbrx":

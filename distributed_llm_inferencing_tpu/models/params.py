@@ -134,7 +134,7 @@ def init_params(cfg: ModelConfig, key, dtype=None):
     if cfg.is_moe:
         E = cfg.num_experts
         layers["router"] = {"w": w((L, D, E))}   # kept float (ops/quant.py)
-        if cfg.moe_router == "deepseek_v3":   # e_score_correction_bias
+        if cfg.moe_router in ("deepseek_v3", "ernie"):   # correction bias
             layers["router"]["bias"] = jnp.zeros((L, E), jnp.float32)
         layers["experts"] = {
             "gate": ew((L, E, D, I)),

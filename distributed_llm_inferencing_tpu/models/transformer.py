@@ -162,8 +162,13 @@ def _moe_gates(x, lp, cfg: ModelConfig):
     router_logits = jnp.einsum("...d,de->...e", x.astype(jnp.float32),
                                lp["router"]["w"].astype(jnp.float32))
     k = cfg.num_experts_per_tok
-    if cfg.moe_router == "deepseek_v3":
-        scores = jax.nn.sigmoid(router_logits)              # [...,E]
+    if cfg.moe_router in ("deepseek_v3", "ernie"):
+        # ernie (ERNIE-4.5-MoE): softmax scores under the same
+        # bias-corrected selection (n_group=1 makes the group stage a
+        # no-op); deepseek_v3: sigmoid scores + group-limited top-k
+        scores = (jax.nn.sigmoid(router_logits)
+                  if cfg.moe_router == "deepseek_v3"
+                  else jax.nn.softmax(router_logits, axis=-1))  # [...,E]
         choice = scores + lp["router"]["bias"].astype(jnp.float32)
         G = cfg.moe_n_group
         gs = choice.reshape(*choice.shape[:-1], G, cfg.num_experts // G)

@@ -135,7 +135,7 @@ def param_specs(cfg: ModelConfig, spec: MeshSpec,
         layers["o"]["b"] = P(L, None)
     if cfg.is_moe:
         layers["router"] = {"w": P(L, None, None)}
-        if cfg.moe_router == "deepseek_v3":
+        if cfg.moe_router in ("deepseek_v3", "ernie"):
             layers["router"]["bias"] = P(L, None)
         layers["experts"] = {
             "gate": lin(P(L, "ep", None, "tp")),

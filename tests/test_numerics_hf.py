@@ -1602,3 +1602,71 @@ def test_longrope_without_original_attr_uses_short_and_rs_factor():
         inv, 1.0 / (np.array([1.0, 1.1, 1.2, 1.3]) * base), rtol=1e-12)
     assert attn == pytest.approx(
         math.sqrt(1 + math.log(4.0) / math.log(64)))
+
+
+def test_glm45_moe_matches_hf():
+    """GLM-4.5 (glm4_moe): llama block + per-head q/k norms + partial
+    half-split rotary + DeepSeek-V3's exact sigmoid group-limited
+    routing with shared experts over a first_k_dense_replace mixed
+    stack — every mechanism shared with existing families, composed."""
+    import torch
+    import transformers
+    torch_cfg = transformers.Glm4MoeConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        moe_intermediate_size=16, num_hidden_layers=3,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+        partial_rotary_factor=0.5, use_qk_norm=True,
+        n_routed_experts=8, n_shared_experts=1, num_experts_per_tok=2,
+        n_group=2, topk_group=1, routed_scaling_factor=1.5,
+        norm_topk_prob=True, first_k_dense_replace=1,
+        max_position_embeddings=64, tie_word_embeddings=False,
+        pad_token_id=0)
+    torch.manual_seed(58)
+    model = transformers.Glm4MoeForCausalLM(torch_cfg).eval()
+    with torch.no_grad():   # distinguish norms/bias from identity/zero
+        for lyr in model.model.layers:
+            lyr.self_attn.q_norm.weight.mul_(
+                torch.rand_like(lyr.self_attn.q_norm.weight) + 0.5)
+            lyr.self_attn.k_norm.weight.mul_(
+                torch.rand_like(lyr.self_attn.k_norm.weight) + 0.5)
+            if hasattr(lyr.mlp, "gate"):
+                lyr.mlp.gate.e_score_correction_bias.uniform_(0.0, 0.2)
+    cfg, params = convert.load_hf_model(model, dtype=jnp.float32)
+    assert cfg.moe_router == "deepseek_v3" and cfg.dense_prefix_layers == 1
+    assert cfg.qk_norm == "rms_head" and cfg.rope_pct == 0.5
+    assert "layers_dense" in params
+    assert "bias" in params["layers"]["router"]
+    rng = np.random.default_rng(58)
+    tokens = rng.integers(0, 128, size=(2, 10), dtype=np.int64)
+    _check_model(model, tokens)
+
+
+def test_ernie45_moe_matches_hf():
+    """ERNIE 4.5 MoE: softmax routing with bias-corrected SELECTION
+    (moe_statics.e_score_correction_bias — weights stay unbiased),
+    shared experts, and a dense prefix (moe_layer_start_index) through
+    the mixed-stack machinery."""
+    import torch
+    import transformers
+    torch_cfg = transformers.Ernie4_5_MoeConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        moe_intermediate_size=16, moe_num_experts=4, moe_k=2,
+        moe_num_shared_experts=1, moe_layer_start_index=1,
+        moe_layer_interval=1, num_hidden_layers=3,
+        num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, tie_word_embeddings=False,
+        use_bias=False, pad_token_id=0)
+    torch.manual_seed(59)
+    model = transformers.Ernie4_5_MoeForCausalLM(torch_cfg).eval()
+    with torch.no_grad():   # non-zero selection bias
+        for lyr in model.model.layers:
+            if hasattr(lyr.mlp, "moe_statics"):
+                lyr.mlp.moe_statics.e_score_correction_bias.uniform_(
+                    0.0, 0.3)
+    cfg, params = convert.load_hf_model(model, dtype=jnp.float32)
+    assert cfg.moe_router == "ernie" and cfg.dense_prefix_layers == 1
+    assert cfg.moe_shared_experts == 1
+    assert "bias" in params["layers"]["router"]
+    rng = np.random.default_rng(59)
+    tokens = rng.integers(0, 128, size=(2, 10), dtype=np.int64)
+    _check_model(model, tokens)
