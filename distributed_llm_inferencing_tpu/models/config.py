@@ -197,7 +197,8 @@ class ModelConfig:
     num_experts: int = 0
     num_experts_per_tok: int = 2
     # Router convention: "softmax" (Mixtral/Qwen3-MoE: softmax -> top-k
-    # -> renormalize) | "ernie" (ERNIE-4.5-MoE: softmax scores under the
+    # -> renormalize) | "topk_softmax" (gpt-oss: select by raw biased
+    # logits, weights = softmax over the selected k logits) | "ernie" (ERNIE-4.5-MoE: softmax scores under the
     # deepseek-style bias-corrected SELECTION, unbiased weights) |
     # "deepseek_v3" (sigmoid scores; selection by
     # scores + e_score_correction_bias under group-limited top-k —
@@ -213,6 +214,17 @@ class ModelConfig:
     # moe_shared_experts * (per-expert intermediate), always active,
     # added to the routed output (layer tree leaves shared_gate/up/down).
     moe_shared_experts: int = 0
+    # gpt-oss expert GLU: gate clamped to (-inf, limit], up to ±limit,
+    # glu = gate * sigmoid(alpha * gate), output (up + 1) * glu — with
+    # per-expert BIASES on gate/up/down (leaves carry "b"). None =>
+    # the standard act(gate) * up.
+    moe_swiglu_limit: Optional[float] = None
+    moe_swiglu_alpha: float = 1.702
+    # gpt-oss attention sinks: one learned logit per head ([H] ``sinks``
+    # leaf in the layer tree) appended to every softmax as a virtual
+    # column and dropped after normalization — the sink only inflates
+    # the denominator (ops/attention.attend).
+    attn_sinks: bool = False
     # DeepSeek first_k_dense_replace: the first k layers run a plain
     # dense MLP (width dense_intermediate_size) instead of the MoE. The
     # param tree then carries a second stacked segment ``layers_dense``
@@ -326,7 +338,8 @@ class ModelConfig:
                 "sliding windows or score softcapping (no MLA "
                 "architecture uses them); serve such a config with the "
                 "materialized layout (DLI_MLA_LATENT=0)")
-        assert self.moe_router in ("softmax", "deepseek_v3", "ernie"), (
+        assert self.moe_router in ("softmax", "deepseek_v3", "ernie",
+                                   "topk_softmax"), (
             f"unknown moe_router {self.moe_router!r}")
         if self.dense_prefix_layers:
             assert 0 < self.dense_prefix_layers < self.num_layers, (

@@ -219,7 +219,7 @@ SUPPORTED_MODEL_TYPES = ("gpt2", "opt", "llama", "mistral", "mixtral",
                          "qwen3_moe", "granite", "olmo2", "glm", "glm4",
                          "nemotron", "deepseek_v3", "ernie4_5", "smollm3",
                          "hunyuan_v1_dense", "exaone4", "dbrx", "glm4_moe",
-                         "ernie4_5_moe")
+                         "ernie4_5_moe", "gpt_oss")
 
 
 def config_from_hf(hf_config) -> ModelConfig:
@@ -939,6 +939,49 @@ def config_from_hf(hf_config) -> ModelConfig:
             sliding_window=sw, attn_windows=aw, rope_layers=rope_on,
             tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
                                         False))
+    if mt == "gpt_oss":
+        # gpt-oss: llama-shaped attention (GQA, biases, yarn rope,
+        # alternating sliding/full layers) plus two mechanisms of its
+        # own — learned per-head attention SINKS (a virtual softmax
+        # column, config.py attn_sinks / ops/attention.attend) and a
+        # clamped-swish expert GLU with per-expert biases
+        # (moe_swiglu_limit/alpha, transformer._glu_h) under a
+        # top-k-then-softmax router whose bias is part of the linear
+        # (moe_router="topk_softmax"). HF modeling_gpt_oss.py.
+        hd = (getattr(hf_config, "head_dim", None)
+              or hf_config.hidden_size // hf_config.num_attention_heads)
+        go_inv_freq, go_attn_factor, _ = _rope_scaling_params(
+            hf_config, hd, mt)
+        sw, aw, _ = _layer_windows_from_hf(hf_config)
+        return ModelConfig(
+            name=getattr(hf_config, "name_or_path", mt) or mt,
+            family="gpt_oss", vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            intermediate_size=hf_config.intermediate_size,
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            num_kv_heads=getattr(hf_config, "num_key_value_heads", None)
+            or hf_config.num_attention_heads,
+            head_dim=hd,
+            max_position_embeddings=hf_config.max_position_embeddings,
+            norm_type="rmsnorm", norm_eps=hf_config.rms_norm_eps,
+            activation="silu",   # unused by the clamped GLU, kept sane
+            gated_mlp=True, position_embedding="rope",
+            rope_theta=getattr(hf_config, "rope_theta", 150000.0),
+            rope_inv_freq=go_inv_freq, rope_attn_factor=go_attn_factor,
+            attn_bias=bool(getattr(hf_config, "attention_bias", True)),
+            mlp_bias=True,   # per-expert biases ride the expert leaves
+            sliding_window=sw, attn_windows=aw,
+            attn_sinks=True,
+            num_experts=hf_config.num_local_experts,
+            num_experts_per_tok=getattr(hf_config, "num_experts_per_tok",
+                                        4),
+            moe_router="topk_softmax",
+            moe_swiglu_limit=float(getattr(hf_config, "swiglu_limit",
+                                           7.0)),
+            moe_swiglu_alpha=1.702,
+            tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
+                                        False))
     if mt == "ernie4_5_moe":
         # ERNIE 4.5 MoE: the dense ernie4_5 layout with softmax routing
         # under deepseek-style bias-corrected SELECTION (moe_statics.
@@ -1415,6 +1458,12 @@ def convert_state_dict(cfg: ModelConfig, sd, dtype=None):
                     "up": {"w": np.stack([get(p + e + "up_proj.weight").T for e in ex])},
                     "down": {"w": np.stack([get(p + e + "down_proj.weight").T for e in ex])},
                 }
+                if p + ex[0] + "gate_proj.bias" in sd:
+                    # ernie4_5_moe use_bias=True: per-expert biases
+                    for nm, pj in (("gate", "gate_proj"), ("up", "up_proj"),
+                                   ("down", "down_proj")):
+                        lp["experts"][nm]["b"] = np.stack(
+                            [get(p + e + f"{pj}.bias") for e in ex])
                 if cfg.moe_shared_experts:
                     s = "mlp.shared_experts."
                     lp["shared_gate"] = lin(s + "gate_proj")
@@ -1486,6 +1535,48 @@ def convert_state_dict(cfg: ModelConfig, sd, dtype=None):
             "layers": _stack([layer(i) for i in range(cfg.num_layers)]),
             "final_norm": {"scale": get("transformer.norm_f.weight"),
                            "bias": zb},
+        }
+        if not cfg.tie_word_embeddings:
+            params["lm_head"] = {"w": get("lm_head.weight").T}
+    elif fam == "gpt_oss":
+        # llama projection names with biases + self_attn.sinks per
+        # layer; fused-interleaved expert stacks: gate_up_proj
+        # [E, D, 2I] with gate at even and up at odd columns (HF
+        # GptOssExperts gate_up[..., ::2]/[..., 1::2]); down_proj
+        # [E, I, D] contracts as stored; router is mlp.router (a real
+        # linear with bias).
+        def layer(i):
+            p = f"model.layers.{i}."
+
+            def lin(n):
+                out = {"w": get(p + n + ".weight").T}
+                if p + n + ".bias" in sd:
+                    out["b"] = get(p + n + ".bias")
+                return out
+            gu = get(p + "mlp.experts.gate_up_proj")        # [E, D, 2I]
+            gub = get(p + "mlp.experts.gate_up_proj_bias")  # [E, 2I]
+            return {
+                "attn_norm": {"scale": get(p + "input_layernorm.weight")},
+                "q": lin("self_attn.q_proj"),
+                "k": lin("self_attn.k_proj"),
+                "v": lin("self_attn.v_proj"),
+                "o": lin("self_attn.o_proj"),
+                "sinks": get(p + "self_attn.sinks"),
+                "mlp_norm": {
+                    "scale": get(p + "post_attention_layernorm.weight")},
+                "router": {"w": get(p + "mlp.router.weight").T,
+                           "bias": get(p + "mlp.router.bias")},
+                "experts": {
+                    "gate": {"w": gu[..., 0::2], "b": gub[..., 0::2]},
+                    "up": {"w": gu[..., 1::2], "b": gub[..., 1::2]},
+                    "down": {"w": get(p + "mlp.experts.down_proj"),
+                             "b": get(p + "mlp.experts.down_proj_bias")},
+                },
+            }
+        params = {
+            "embed": {"tokens": get("model.embed_tokens.weight")},
+            "layers": _stack([layer(i) for i in range(cfg.num_layers)]),
+            "final_norm": {"scale": get("model.norm.weight")},
         }
         if not cfg.tie_word_embeddings:
             params["lm_head"] = {"w": get("lm_head.weight").T}

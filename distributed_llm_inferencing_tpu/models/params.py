@@ -129,23 +129,31 @@ def init_params(cfg: ModelConfig, key, dtype=None):
             [-1 if w is None else w for w in cfg.attn_windows], jnp.int32)
     if cfg.rope_layers is not None:   # per-layer NoPE (smollm3/exaone4)
         layers["rope_on"] = jnp.asarray(cfg.rope_layers, jnp.int32)
+    if cfg.attn_sinks:   # gpt-oss: one learned sink logit per head
+        layers["sinks"] = zeros((L, cfg.num_heads))
     if not cfg.shared_attn_mlp_norm:   # phi/falcon-7b: one norm per block
         layers["mlp_norm"] = norm_p()
     if cfg.is_moe:
         E = cfg.num_experts
         layers["router"] = {"w": w((L, D, E))}   # kept float (ops/quant.py)
-        if cfg.moe_router in ("deepseek_v3", "ernie"):   # correction bias
+        if cfg.moe_router in ("deepseek_v3", "ernie", "topk_softmax"):
+            # selection-correction bias (deepseek/ernie) or the router
+            # linear's real bias (gpt-oss)
             layers["router"]["bias"] = jnp.zeros((L, E), jnp.float32)
         layers["experts"] = {
             "gate": ew((L, E, D, I)),
             "up": ew((L, E, D, I)),
             "down": ew((L, E, I, D)),
         }
+        if cfg.mlp_bias:   # gpt-oss: per-expert biases
+            layers["experts"]["gate"]["b"] = zeros((L, E, I))
+            layers["experts"]["up"]["b"] = zeros((L, E, I))
+            layers["experts"]["down"]["b"] = zeros((L, E, D))
         if cfg.moe_shared_experts:   # deepseek always-active shared MLP
             SI = I * cfg.moe_shared_experts
-            layers["shared_gate"] = lin(D, SI, False)
-            layers["shared_up"] = lin(D, SI, False)
-            layers["shared_down"] = lin(SI, D, False)
+            layers["shared_gate"] = lin(D, SI, cfg.mlp_bias)
+            layers["shared_up"] = lin(D, SI, cfg.mlp_bias)
+            layers["shared_down"] = lin(SI, D, cfg.mlp_bias)
     else:
         layers["up"] = lin(D, I, cfg.mlp_bias)
         if cfg.gated_mlp:

@@ -184,6 +184,16 @@ def _moe_gates(x, lp, cfg: ModelConfig):
         if cfg.moe_norm_topk:
             gate = gate / (jnp.sum(gate, axis=-1, keepdims=True) + 1e-20)
         return gate * cfg.moe_routed_scale
+    if cfg.moe_router == "topk_softmax":
+        # gpt-oss: the router bias is part of the LINEAR (not a
+        # selection-only correction); select top-k by the biased logits
+        # and softmax over just the selected k values
+        logits = router_logits + lp["router"]["bias"].astype(jnp.float32)
+        kth = jax.lax.top_k(logits, k)[0][..., -1:]
+        sel = logits >= kth
+        return jnp.where(
+            sel, jax.nn.softmax(jnp.where(sel, logits, -jnp.inf), axis=-1),
+            0.0)
     probs = jax.nn.softmax(router_logits, axis=-1)          # [...,E]
     kth = jax.lax.top_k(probs, k)[0][..., -1:]
     gate = jnp.where(probs >= kth, probs, 0.0)
@@ -192,15 +202,34 @@ def _moe_gates(x, lp, cfg: ModelConfig):
     return gate                                             # [...,E]
 
 
+def _glu_h(gate, up, cfg: ModelConfig):
+    """Expert hidden activation: the standard act(gate) * up, or
+    gpt-oss's clamped swish GLU — gate clamped above at
+    moe_swiglu_limit, up to ±limit, (up + 1) * gate * sigmoid(alpha *
+    gate) (HF modeling_gpt_oss.py GptOssExperts)."""
+    if cfg.moe_swiglu_limit is not None:
+        lim = cfg.moe_swiglu_limit
+        gate = jnp.minimum(gate, lim)
+        up = jnp.clip(up, -lim, lim)
+        return (up + 1.0) * (gate * jax.nn.sigmoid(
+            cfg.moe_swiglu_alpha * gate))
+    return _act(gate, cfg.activation) * up
+
+
 def _moe_dense(x, lp, cfg: ModelConfig):
     """Compute every expert for every token, weight by the gate. E/k× the
     FLOPs of a real dispatch, but no permutation/comm beyond the psum the
     sharded expert axis induces — the right trade at decode batch sizes."""
     gate = _moe_gates(x, lp, cfg)
     ex = lp["experts"]
-    h = _act(_ew(x, ex["gate"], "...d,edi->...ei"), cfg.activation)
-    h = h * _ew(x, ex["up"], "...d,edi->...ei")
+    g = _ew(x, ex["gate"], "...d,edi->...ei")
+    u = _ew(x, ex["up"], "...d,edi->...ei")
+    if "b" in ex["gate"]:   # gpt-oss per-expert biases ([E, I]/[E, D])
+        g, u = g + ex["gate"]["b"], u + ex["up"]["b"]
+    h = _glu_h(g, u, cfg)
     out = _ew(h, ex["down"], "...ei,eid->...ed")  # [...,E,D]
+    if "b" in ex["down"]:
+        out = out + ex["down"]["b"]
     out = jnp.einsum("...ed,...e->...d", out.astype(jnp.float32), gate)
     return out.astype(x.dtype)
 
@@ -240,9 +269,14 @@ def _moe_capacity(x, lp, cfg: ModelConfig):
 
     ex_in = jnp.einsum("nec,nd->ecd", dispatch, xf)         # [E, C, D]
     ex = lp["experts"]
-    h = _act(_ew(ex_in, ex["gate"], "ecd,edi->eci"), cfg.activation)
-    h = h * _ew(ex_in, ex["up"], "ecd,edi->eci")
+    g = _ew(ex_in, ex["gate"], "ecd,edi->eci")
+    u = _ew(ex_in, ex["up"], "ecd,edi->eci")
+    if "b" in ex["gate"]:   # gpt-oss per-expert biases, [E, 1, *]
+        g, u = g + ex["gate"]["b"][:, None, :], u + ex["up"]["b"][:, None, :]
+    h = _glu_h(g, u, cfg)
     out = _ew(h, ex["down"], "eci,eid->ecd")                # [E, C, D]
+    if "b" in ex["down"]:
+        out = out + ex["down"]["b"][:, None, :]
     y = jnp.einsum("ecd,nec->nd", out.astype(jnp.float32), combine)
     return y.reshape(*lead, D).astype(x.dtype)
 
@@ -295,12 +329,18 @@ def _cfg_backend(cfg: ModelConfig, n_devices: int, op: str = "dense"):
     b = resolve_backend(cfg.attn_backend, n_devices, op=op)
     if b.startswith("pallas") and (cfg.attn_windows is not None
                                    or cfg.attn_softcap is not None
-                                   or cfg.mla):
+                                   or cfg.attn_sinks or cfg.mla):
         # mla: qk_head_dim (192) is off the kernels' 128-lane tiling and
         # v rides zero-padded — keep the XLA formulation until a
         # dedicated MLA kernel exists
         return "xla"
     return b
+
+
+def _sinks(cfg: ModelConfig, lp):
+    """[H] per-layer attention-sink logits (gpt-oss) — a layer-tree leaf
+    like the q/k norms, threaded into every attention formulation."""
+    return lp["sinks"] if cfg.attn_sinks else None
 
 
 def _layer_window(cfg: ModelConfig, lp):
@@ -764,10 +804,11 @@ def _block(x, lp, cache_k, cache_v, *, cfg: ModelConfig, q_positions,
                 ring_attend_prefill)
             attn = ring_attend_prefill(
                 q, k, v, q_positions, new_lengths, mesh=mesh,
-                sliding_window=_layer_window(cfg, lp), alibi=_alibi(cfg), softcap=cfg.attn_softcap)
+                sliding_window=_layer_window(cfg, lp), alibi=_alibi(cfg), softcap=cfg.attn_softcap, sinks=_sinks(cfg, lp))
         elif is_prefill:
             attn = attend_prefill(q, k, v, sliding_window=_layer_window(cfg, lp),
-                                  backend=backend, alibi=_alibi(cfg), softcap=cfg.attn_softcap)
+                                  backend=backend, alibi=_alibi(cfg), softcap=cfg.attn_softcap,
+                                  sinks=_sinks(cfg, lp))
         elif mesh is not None and mesh.shape.get("sp", 1) > 1:
             # sp-sharded cache decode: flash-decoding partials per shard +
             # one combine (parallel/ring.py ring_attend_decode) — replaces
@@ -777,7 +818,8 @@ def _block(x, lp, cache_k, cache_v, *, cfg: ModelConfig, q_positions,
             attn = ring_attend_decode(q, ck_at, cv_at, new_lengths,
                                       mesh=mesh,
                                       sliding_window=_layer_window(cfg, lp),
-                                      alibi=_alibi(cfg), softcap=cfg.attn_softcap)
+                                      alibi=_alibi(cfg), softcap=cfg.attn_softcap,
+                                      sinks=_sinks(cfg, lp))
         else:
             # quantized caches pin the xla formulation: the dequant fuses
             # into its matmul, while a pallas kernel input would
@@ -785,7 +827,8 @@ def _block(x, lp, cache_k, cache_v, *, cfg: ModelConfig, q_positions,
             attn = attend_decode(q, ck_at, cv_at, new_lengths,
                                  sliding_window=_layer_window(cfg, lp),
                                  backend="xla" if quantized else backend,
-                                 q_positions=q_positions, alibi=_alibi(cfg), softcap=cfg.attn_softcap)
+                                 q_positions=q_positions, alibi=_alibi(cfg), softcap=cfg.attn_softcap,
+                                 sinks=_sinks(cfg, lp))
         return attn, cache_out
 
     x, cache_out = _block_body(x, lp, cfg, q_positions, attend_write)
@@ -924,7 +967,8 @@ def paged_decode_step(params, cfg: ModelConfig, tokens, paged,
                         sliding_window=_layer_window(seg_cfg, lp),
                         backend=backend,
                         k_scale_layer=nks, v_scale_layer=nvs,
-                        alibi=_alibi(seg_cfg), softcap=seg_cfg.attn_softcap)
+                        alibi=_alibi(seg_cfg), softcap=seg_cfg.attn_softcap,
+                        sinks=_sinks(seg_cfg, lp))
                     return attn, (nk, nv, nks, nvs)
                 nk = write_token(ck, k[:, 0], block_tables, context_lens)
                 nv = write_token(cv, v[:, 0], block_tables, context_lens)
@@ -932,7 +976,8 @@ def paged_decode_step(params, cfg: ModelConfig, tokens, paged,
                     q, nk, nv, block_tables, context_lens + 1,
                     sliding_window=_layer_window(seg_cfg, lp),
                     backend=backend,
-                    alibi=_alibi(seg_cfg), softcap=seg_cfg.attn_softcap)
+                    alibi=_alibi(seg_cfg), softcap=seg_cfg.attn_softcap,
+                    sinks=_sinks(seg_cfg, lp))
                 return attn, (nk, nv)
 
             return _block_body(x, lp, seg_cfg, q_pos, attend_write)
@@ -1078,7 +1123,8 @@ def paged_decode_chunk(params, cfg: ModelConfig, k: int, tokens, paged,
                         jnp.concatenate([pool_pos, side_pos], axis=1),
                         jnp.concatenate([pool_valid, side_valid], axis=1),
                         sliding_window=_layer_window(seg_cfg, lp),
-                        alibi=_alibi(seg_cfg), softcap=seg_cfg.attn_softcap)
+                        alibi=_alibi(seg_cfg), softcap=seg_cfg.attn_softcap,
+                        sinks=_sinks(seg_cfg, lp))
                     return attn, (sk2, sv2)
 
                 x, (sk2, sv2) = _block_body(x, lp, seg_cfg, q_pos,
@@ -1292,7 +1338,8 @@ def paged_speculative_chunk(params, cfg: ModelConfig, k: int, gamma: int,
                         jnp.concatenate([pool_pos, side_pos], axis=1),
                         jnp.concatenate([pool_valid, side_valid], axis=1),
                         sliding_window=_layer_window(seg_cfg, lp),
-                        alibi=_alibi(seg_cfg), softcap=seg_cfg.attn_softcap)
+                        alibi=_alibi(seg_cfg), softcap=seg_cfg.attn_softcap,
+                        sinks=_sinks(seg_cfg, lp))
                     return attn, (sk2, sv2)
 
                 x, (sk2, sv2) = _block_body(x, lp, seg_cfg, qp,
@@ -1443,14 +1490,16 @@ def paged_prefill_tail(params, cfg: ModelConfig, tokens, tail_len,
                         tail_valid,
                         sliding_window=_layer_window(seg_cfg, lp),
                         k_scale_layer=nks, v_scale_layer=nvs,
-                        alibi=_alibi(seg_cfg), softcap=seg_cfg.attn_softcap)
+                        alibi=_alibi(seg_cfg), softcap=seg_cfg.attn_softcap,
+                        sinks=_sinks(seg_cfg, lp))
                     return attn, (nk, nv, nks, nvs)
                 nk = write_block_run(ck, k, tail_blocks)
                 nv = write_block_run(cv, v, tail_blocks)
                 attn = paged_attend_prefix(
                     q, k, v, nk, nv, prefix_blocks, prefix_len, q_pos,
                     tail_valid, sliding_window=_layer_window(seg_cfg, lp),
-                    alibi=_alibi(seg_cfg), softcap=seg_cfg.attn_softcap)
+                    alibi=_alibi(seg_cfg), softcap=seg_cfg.attn_softcap,
+                    sinks=_sinks(seg_cfg, lp))
                 return attn, (nk, nv)
 
             return _block_body(x, lp, seg_cfg, q_pos, attend_write)

@@ -78,6 +78,9 @@ def attend(
     sliding_window: Optional[int] = None,
     alibi=None,          # [H] f32 slopes — bias slope*(kv_pos - q_pos)
     softcap: Optional[float] = None,   # gemma2: cap*tanh(scores/cap)
+    sinks=None,          # [H] gpt-oss attention sinks: one learned
+    # logit per head joins every row's softmax as a virtual column and
+    # is dropped after normalization — it only inflates the denominator
     scale: Optional[float] = None,     # score scale; None => hd**-0.5.
     # MLA's absorbed latent decode passes the ORIGINAL qk head dim's
     # scale — its effective q/k carry the (rd + kv_lora_rank)-wide
@@ -118,8 +121,15 @@ def attend(
                                   kv_positions[:, None, :], sliding_window)
     logits = jnp.where(mask[:, None, :, :], logits, NEG_INF)
 
+    if sinks is not None:
+        sink_col = jnp.broadcast_to(
+            sinks.astype(jnp.float32)[None, :, None, None],
+            logits.shape[:-1] + (1,))
+        logits = jnp.concatenate([logits, sink_col], axis=-1)
     probs = jnp.exp(logits - jnp.max(logits, axis=-1, keepdims=True))
     probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+    if sinks is not None:
+        probs = probs[..., :-1]   # the sink carries no value row
     out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
     return out.astype(q.dtype)
 
@@ -151,7 +161,7 @@ def resolve_backend(requested: str = "auto", n_devices: int = 1,
 
 def attend_prefill(q, k, v, *, sliding_window: Optional[int] = None,
                    backend: str = "xla", alibi=None,
-                   softcap: Optional[float] = None):
+                   softcap: Optional[float] = None, sinks=None):
     """Causal self-attention over the fresh (uncached) K/V block.
 
     Prefill never needs the cache or a validity mask: causality restricts
@@ -159,7 +169,7 @@ def attend_prefill(q, k, v, *, sliding_window: Optional[int] = None,
     sequence's length are garbage the engine never reads. ALiBi rides the
     flash kernel as an in-tile additive bias (one SMEM slope per head).
     """
-    if backend.startswith("pallas"):
+    if backend.startswith("pallas") and sinks is None:
         from distributed_llm_inferencing_tpu.ops.pallas import flash_attention
         return flash_attention(
             q, k, v, sliding_window=sliding_window, alibi=alibi,
@@ -168,13 +178,13 @@ def attend_prefill(q, k, v, *, sliding_window: Optional[int] = None,
     pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
     return attend(q, k, v, pos, pos, jnp.ones((B, S), bool),
                   sliding_window=sliding_window, alibi=alibi,
-                  softcap=softcap)
+                  softcap=softcap, sinks=sinks)
 
 
 def attend_decode(q, cache_k, cache_v, lengths, *,
                   sliding_window: Optional[int] = None,
                   backend: str = "xla", q_positions=None, alibi=None,
-                  softcap: Optional[float] = None,
+                  softcap: Optional[float] = None, sinks=None,
                   scale: Optional[float] = None):
     """Cached attention for decode-regime queries.
 
@@ -198,4 +208,4 @@ def attend_decode(q, cache_k, cache_v, lengths, *,
              else (lengths - 1)[:, None])
     return attend(q, cache_k, cache_v, q_pos, kv_pos, kv_valid,
                   sliding_window=sliding_window, alibi=alibi,
-                  softcap=softcap, scale=scale)
+                  softcap=softcap, sinks=sinks, scale=scale)

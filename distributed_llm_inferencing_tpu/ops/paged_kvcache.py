@@ -116,7 +116,7 @@ def paged_attend_decode(q, cache_k_layer, cache_v_layer, block_tables,
                         sliding_window: Optional[int] = None,
                         backend: str = "xla",
                         k_scale_layer=None, v_scale_layer=None,
-                        alibi=None, softcap: Optional[float] = None):
+                        alibi=None, softcap: Optional[float] = None, sinks=None):
     """Single-token attention over the paged cache.
 
     q: [R, 1, H, hd]; context_lens: [R] — filled slots INCLUDING the token
@@ -136,7 +136,7 @@ def paged_attend_decode(q, cache_k_layer, cache_v_layer, block_tables,
     the pallas kernel has no int8 rule.
     """
     if backend.startswith("pallas") and k_scale_layer is None \
-            and alibi is None:
+            and alibi is None and sinks is None:
         from distributed_llm_inferencing_tpu.ops.pallas.paged_attention import (
             paged_flash_decode)
         return paged_flash_decode(
@@ -157,14 +157,14 @@ def paged_attend_decode(q, cache_k_layer, cache_v_layer, block_tables,
     q_pos = (context_lens - 1)[:, None]
     return attend(q, k, v, q_pos, kv_pos, kv_valid,
                   sliding_window=sliding_window, alibi=alibi,
-                  softcap=softcap)
+                  softcap=softcap, sinks=sinks)
 
 
 def paged_attend_prefix(q, k_new, v_new, cache_k_layer, cache_v_layer,
                         prefix_blocks, prefix_len, q_positions, tail_valid,
                         sliding_window: Optional[int] = None,
                         k_scale_layer=None, v_scale_layer=None,
-                        alibi=None, softcap: Optional[float] = None):
+                        alibi=None, softcap: Optional[float] = None, sinks=None):
     """Tail-prefill attention: fresh tail K/V plus a cached prefix.
 
     This is what makes prefix-cache hits save *compute*, not just memory:
@@ -198,4 +198,4 @@ def paged_attend_prefix(q, k_new, v_new, cache_k_layer, cache_v_layer,
     kv_valid = jnp.concatenate([prefix_valid, tail_valid], axis=1)
     return attend(q, k_all, v_all, q_positions, kv_pos, kv_valid,
                   sliding_window=sliding_window, alibi=alibi,
-                  softcap=softcap)
+                  softcap=softcap, sinks=sinks)

@@ -103,6 +103,12 @@ def validate_spec(spec: MeshSpec, cfg) -> None:
             "prefix MLP width)")
     if cfg.num_layers % spec.pp:
         raise ValueError(f"pp={spec.pp} must divide num_layers={cfg.num_layers}")
+    if spec.sp > 1 and getattr(cfg, "attn_sinks", False):
+        # the ring bodies' chunked online softmax has no virtual-column
+        # hook yet; gpt-oss serves under tp/dp/pp meshes
+        raise NotImplementedError(
+            "sequence parallelism with attention sinks (gpt-oss) is not "
+            "supported — use tp/dp/pp for this model")
     if spec.pp > 1 and getattr(cfg, "dense_prefix_layers", 0):
         # the GPipe stage split assumes ONE uniformly-stacked layer tree
         # to shard over pp; deepseek's dense-prefix + MoE-tail stack is

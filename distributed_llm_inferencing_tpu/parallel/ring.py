@@ -167,6 +167,7 @@ def ring_attend_decode(
     sliding_window: Optional[int] = None,
     alibi=None,   # [H] f32 slopes, sharded over tp with the heads
     softcap: Optional[float] = None,
+    sinks=None,
 ):
     """Single-token attention over the sp-sharded dense cache.
 
@@ -175,6 +176,9 @@ def ring_attend_decode(
     fallback: per device one [B,H,1,S/sp] reduction, then one
     pmax+psum combine of O(B·H·hd) partials.
     """
+    assert sinks is None, (
+        "attention sinks do not ride the ring path (sp x sinks is "
+        "refused at plan time, parallel/mesh.validate_spec)")
     sp = mesh.shape["sp"]
     tp = mesh.shape["tp"]
     B, S = cache_k.shape[0], cache_k.shape[1]
@@ -220,6 +224,7 @@ def ring_attend_prefill(
     sliding_window: Optional[int] = None,
     alibi=None,   # [H] f32 slopes, sharded over tp with the heads
     softcap: Optional[float] = None,
+    sinks=None,
 ):
     """Sequence-parallel causal prefill attention via shard_map over sp.
 
@@ -227,6 +232,9 @@ def ring_attend_prefill(
     the mesh's sp size. dp shards batch, tp shards heads, and each
     (dp, tp) slice runs an independent ring over sp.
     """
+    assert sinks is None, (
+        "attention sinks do not ride the ring path (sp x sinks is "
+        "refused at plan time, parallel/mesh.validate_spec)")
     sp = mesh.shape["sp"]
     tp = mesh.shape["tp"]
     B, S, H, hd = q.shape

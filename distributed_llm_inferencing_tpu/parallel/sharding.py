@@ -125,6 +125,8 @@ def param_specs(cfg: ModelConfig, spec: MeshSpec,
         layers["attn_window"] = P(L)
     if cfg.rope_layers is not None:   # per-layer NoPE flag, same layout
         layers["rope_on"] = P(L)
+    if getattr(cfg, "attn_sinks", False):   # [L, H]: heads over tp
+        layers["sinks"] = P(L, "tp")
     if not cfg.shared_attn_mlp_norm:   # phi/falcon-7b: one norm per block
         layers["mlp_norm"] = norm_p()
     if cfg.attn_bias and not cfg.mla:   # mla biases set in its branch
@@ -135,17 +137,25 @@ def param_specs(cfg: ModelConfig, spec: MeshSpec,
         layers["o"]["b"] = P(L, None)
     if cfg.is_moe:
         layers["router"] = {"w": P(L, None, None)}
-        if cfg.moe_router in ("deepseek_v3", "ernie"):
+        if cfg.moe_router in ("deepseek_v3", "ernie", "topk_softmax"):
             layers["router"]["bias"] = P(L, None)
         layers["experts"] = {
             "gate": lin(P(L, "ep", None, "tp")),
             "up": lin(P(L, "ep", None, "tp")),
             "down": lin(P(L, "ep", "tp", None)),
         }
+        if cfg.mlp_bias:   # gpt-oss per-expert biases
+            layers["experts"]["gate"]["b"] = P(L, "ep", "tp")
+            layers["experts"]["up"]["b"] = P(L, "ep", "tp")
+            layers["experts"]["down"]["b"] = P(L, "ep", None)
         if cfg.moe_shared_experts:   # deepseek always-active shared MLP
             layers["shared_gate"] = lin(P(L, None, "tp"))
             layers["shared_up"] = lin(P(L, None, "tp"))
             layers["shared_down"] = lin(P(L, "tp", None))
+            if cfg.mlp_bias:   # ernie use_bias=True
+                layers["shared_gate"]["b"] = P(L, "tp")
+                layers["shared_up"]["b"] = P(L, "tp")
+                layers["shared_down"]["b"] = P(L, None)
     else:
         layers["up"] = lin(P(L, None, "tp"))
         if cfg.gated_mlp:

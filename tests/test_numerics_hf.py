@@ -1655,7 +1655,8 @@ def test_ernie45_moe_matches_hf():
         moe_layer_interval=1, num_hidden_layers=3,
         num_attention_heads=4, num_key_value_heads=2,
         max_position_embeddings=64, tie_word_embeddings=False,
-        use_bias=False, pad_token_id=0)
+        use_bias=True, pad_token_id=0)   # biases on EVERY linear incl.
+    # the per-expert and shared-expert MLPs
     torch.manual_seed(59)
     model = transformers.Ernie4_5_MoeForCausalLM(torch_cfg).eval()
     with torch.no_grad():   # non-zero selection bias
@@ -1670,3 +1671,85 @@ def test_ernie45_moe_matches_hf():
     rng = np.random.default_rng(59)
     tokens = rng.integers(0, 128, size=(2, 10), dtype=np.int64)
     _check_model(model, tokens)
+
+
+def test_gpt_oss_matches_hf():
+    """gpt-oss: learned per-head attention sinks (virtual softmax
+    column), clamped-swish expert GLU with per-expert biases,
+    top-k-then-softmax routing, alternating sliding/full layers, and
+    yarn rope with truncate=false. Sequence longer than the window and
+    past the original rope window so everything bites."""
+    import torch
+    import transformers
+    torch_cfg = transformers.GptOssConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=16,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=8, num_local_experts=4, num_experts_per_tok=2,
+        sliding_window=4, layer_types=["sliding_attention",
+                                       "full_attention"],
+        max_position_embeddings=64,
+        rope_scaling={"rope_type": "yarn", "factor": 4.0,
+                      "beta_fast": 32.0, "beta_slow": 1.0,
+                      "truncate": False,
+                      "original_max_position_embeddings": 16},
+        tie_word_embeddings=False, pad_token_id=0)
+    torch.manual_seed(60)
+    model = transformers.GptOssForCausalLM(torch_cfg).eval()
+    with torch.no_grad():   # non-trivial sinks (init may be empty/zeros)
+        for lyr in model.model.layers:
+            lyr.self_attn.sinks.normal_(0.0, 1.0)
+    cfg, params = convert.load_hf_model(model, dtype=jnp.float32)
+    assert cfg.attn_sinks and cfg.moe_router == "topk_softmax"
+    assert cfg.moe_swiglu_limit == 7.0
+    assert "sinks" in params["layers"]
+    assert "b" in params["layers"]["experts"]["gate"]
+    rng = np.random.default_rng(60)
+    tokens = rng.integers(0, 128, size=(2, 20), dtype=np.int64)
+    _check_model(model, tokens)
+
+
+def test_gpt_oss_decode_and_batcher_match_hf_generate():
+    """gpt-oss through the REAL serving paths: the sinks column must
+    ride cached decode (dense engine) and the paged batcher's chunk and
+    prefix formulations identically — greedy ≡ HF generate."""
+    import torch
+    import transformers
+    from distributed_llm_inferencing_tpu.ops.sampling import SamplingParams
+    from distributed_llm_inferencing_tpu.runtime.batcher import (
+        ContinuousBatcher)
+    from distributed_llm_inferencing_tpu.runtime.engine import (
+        InferenceEngine)
+    torch_cfg = transformers.GptOssConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=16,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=8, num_local_experts=4, num_experts_per_tok=2,
+        sliding_window=4, layer_types=["sliding_attention",
+                                       "full_attention"],
+        max_position_embeddings=64, rope_scaling=None,
+        tie_word_embeddings=False, pad_token_id=0)
+    torch.manual_seed(61)
+    model = transformers.GptOssForCausalLM(torch_cfg).eval()
+    with torch.no_grad():
+        for lyr in model.model.layers:
+            lyr.self_attn.sinks.normal_(0.0, 1.0)
+    cfg, params = convert.load_hf_model(model, dtype=jnp.float32)
+    cfg = cfg.replace(dtype="float32")
+
+    prompt = np.random.default_rng(61).integers(0, 128, 9).tolist()
+    with torch.no_grad():
+        want = model.generate(
+            torch.tensor([prompt]), max_new_tokens=10, do_sample=False,
+            pad_token_id=0)[0, 9:].tolist()
+
+    eng = InferenceEngine(cfg, max_seq=32, seed=0, params=params)
+    got = eng.generate([prompt], max_new_tokens=10,
+                       sampling=SamplingParams.greedy()).tokens[0]
+    assert got == want
+
+    b = ContinuousBatcher(cfg, num_blocks=16, block_size=8, slots=2,
+                          max_seq=32, seed=0, params=params)
+    r = b.submit(prompt, max_new_tokens=10,
+                 sampling=SamplingParams.greedy())
+    while b.step():
+        pass
+    assert r.error is None and r.tokens == want
