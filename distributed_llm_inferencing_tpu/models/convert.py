@@ -219,7 +219,7 @@ SUPPORTED_MODEL_TYPES = ("gpt2", "opt", "llama", "mistral", "mixtral",
                          "qwen3_moe", "granite", "olmo2", "glm", "glm4",
                          "nemotron", "deepseek_v3", "ernie4_5", "smollm3",
                          "hunyuan_v1_dense", "exaone4", "dbrx", "glm4_moe",
-                         "ernie4_5_moe", "gpt_oss")
+                         "ernie4_5_moe", "gpt_oss", "hunyuan_v1_moe")
 
 
 def config_from_hf(hf_config) -> ModelConfig:
@@ -982,6 +982,39 @@ def config_from_hf(hf_config) -> ModelConfig:
             moe_swiglu_alpha=1.702,
             tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
                                         False))
+    if mt == "hunyuan_v1_moe":
+        # HunYuan-MoE: the hunyuan dense layout (post-RoPE per-head q/k
+        # RMS norms) with mixtral-convention routing (softmax -> top-k
+        # -> renormalize) and an always-active shared MLP of the same
+        # intermediate width.
+        ne = hf_config.num_experts
+        tk = getattr(hf_config, "moe_topk", 1)
+        if not isinstance(ne, int) or not isinstance(tk, int):
+            raise NotImplementedError(
+                "hunyuan_v1_moe with per-layer num_experts/moe_topk "
+                "lists")
+        return ModelConfig(
+            name=getattr(hf_config, "name_or_path", mt) or mt,
+            family="llama", vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            intermediate_size=hf_config.intermediate_size,
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            num_kv_heads=getattr(hf_config, "num_key_value_heads", None)
+            or hf_config.num_attention_heads,
+            head_dim=getattr(hf_config, "head_dim", None)
+            or hf_config.hidden_size // hf_config.num_attention_heads,
+            max_position_embeddings=hf_config.max_position_embeddings,
+            norm_type="rmsnorm", norm_eps=hf_config.rms_norm_eps,
+            activation=_act_from_hf(hf_config.hidden_act),
+            gated_mlp=True, position_embedding="rope",
+            rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+            attn_bias=bool(getattr(hf_config, "attention_bias", False)),
+            mlp_bias=False, qk_norm="rms_head", qk_norm_after_rope=True,
+            num_experts=ne, num_experts_per_tok=tk,
+            moe_shared_experts=1,
+            tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
+                                        False))
     if mt == "ernie4_5_moe":
         # ERNIE 4.5 MoE: the dense ernie4_5 layout with softmax routing
         # under deepseek-style bias-corrected SELECTION (moe_statics.
@@ -1438,10 +1471,13 @@ def convert_state_dict(cfg: ModelConfig, sd, dtype=None):
                       else "self_attn.key_layernorm.weight")
                 lp["q_norm"] = {"scale": get(p + qn) * qs}
                 lp["k_norm"] = {"scale": get(p + kn)}
-            if moe and (p + "mlp.gate.weight") in sd:
-                # qwen3_moe / glm4_moe naming: mlp.gate +
-                # mlp.experts.N.{gate,up,down}_proj
-                lp["router"] = {"w": get(p + "mlp.gate.weight").T}
+            rn = next((c for c in ("mlp.gate.weight", "mlp.gate.wg.weight")
+                       if p + c in sd), None)
+            if moe and rn:
+                # qwen3_moe / glm4_moe name the router mlp.gate,
+                # hunyuan_v1_moe wraps it as mlp.gate.wg; experts are
+                # mlp.experts.N.{gate,up,down}_proj either way
+                lp["router"] = {"w": get(p + rn).T}
                 if cfg.moe_router in ("deepseek_v3", "ernie"):
                     # glm4_moe names the bias under the gate; ernie
                     # under moe_statics (shape [1, E] — squeeze)
@@ -1465,7 +1501,9 @@ def convert_state_dict(cfg: ModelConfig, sd, dtype=None):
                         lp["experts"][nm]["b"] = np.stack(
                             [get(p + e + f"{pj}.bias") for e in ex])
                 if cfg.moe_shared_experts:
-                    s = "mlp.shared_experts."
+                    s = ("mlp.shared_experts."
+                         if p + "mlp.shared_experts.gate_proj.weight" in sd
+                         else "mlp.shared_mlp.")   # hunyuan_v1_moe
                     lp["shared_gate"] = lin(s + "gate_proj")
                     lp["shared_up"] = lin(s + "up_proj")
                     lp["shared_down"] = lin(s + "down_proj")

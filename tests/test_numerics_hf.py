@@ -1753,3 +1753,32 @@ def test_gpt_oss_decode_and_batcher_match_hf_generate():
     while b.step():
         pass
     assert r.error is None and r.tokens == want
+
+
+def test_hunyuan_moe_matches_hf():
+    """HunYuan-MoE: post-RoPE q/k norms + mixtral-convention routing +
+    an always-active shared MLP of the same intermediate width (router
+    named mlp.gate.wg, shared weights under mlp.shared_mlp)."""
+    import torch
+    import transformers
+    torch_cfg = transformers.HunYuanMoEV1Config(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_experts=4, moe_topk=2, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2,
+        head_dim=8, max_position_embeddings=64,
+        tie_word_embeddings=False, pad_token_id=0)
+    torch.manual_seed(62)
+    model = transformers.HunYuanMoEV1ForCausalLM(torch_cfg).eval()
+    with torch.no_grad():
+        for lyr in model.model.layers:
+            lyr.self_attn.query_layernorm.weight.mul_(
+                torch.rand_like(lyr.self_attn.query_layernorm.weight) + 0.5)
+            lyr.self_attn.key_layernorm.weight.mul_(
+                torch.rand_like(lyr.self_attn.key_layernorm.weight) + 0.5)
+    cfg, params = convert.load_hf_model(model, dtype=jnp.float32)
+    assert cfg.num_experts == 4 and cfg.moe_norm_topk
+    assert cfg.moe_shared_experts == 1 and cfg.qk_norm_after_rope
+    assert "shared_gate" in params["layers"]
+    rng = np.random.default_rng(62)
+    tokens = rng.integers(0, 128, size=(2, 10), dtype=np.int64)
+    _check_model(model, tokens)
