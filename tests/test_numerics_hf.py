@@ -1609,28 +1609,8 @@ def test_glm45_moe_matches_hf():
     half-split rotary + DeepSeek-V3's exact sigmoid group-limited
     routing with shared experts over a first_k_dense_replace mixed
     stack — every mechanism shared with existing families, composed."""
-    import torch
-    import transformers
-    torch_cfg = transformers.Glm4MoeConfig(
-        vocab_size=128, hidden_size=32, intermediate_size=64,
-        moe_intermediate_size=16, num_hidden_layers=3,
-        num_attention_heads=4, num_key_value_heads=2, head_dim=16,
-        partial_rotary_factor=0.5, use_qk_norm=True,
-        n_routed_experts=8, n_shared_experts=1, num_experts_per_tok=2,
-        n_group=2, topk_group=1, routed_scaling_factor=1.5,
-        norm_topk_prob=True, first_k_dense_replace=1,
-        max_position_embeddings=64, tie_word_embeddings=False,
-        pad_token_id=0)
-    torch.manual_seed(58)
-    model = transformers.Glm4MoeForCausalLM(torch_cfg).eval()
-    with torch.no_grad():   # distinguish norms/bias from identity/zero
-        for lyr in model.model.layers:
-            lyr.self_attn.q_norm.weight.mul_(
-                torch.rand_like(lyr.self_attn.q_norm.weight) + 0.5)
-            lyr.self_attn.k_norm.weight.mul_(
-                torch.rand_like(lyr.self_attn.k_norm.weight) + 0.5)
-            if hasattr(lyr.mlp, "gate"):
-                lyr.mlp.gate.e_score_correction_bias.uniform_(0.0, 0.2)
+    from conftest import tiny_glm45_moe_model
+    model = tiny_glm45_moe_model(seed=58)
     cfg, params = convert.load_hf_model(model, dtype=jnp.float32)
     assert cfg.moe_router == "deepseek_v3" and cfg.dense_prefix_layers == 1
     assert cfg.qk_norm == "rms_head" and cfg.rope_pct == 0.5
@@ -1713,25 +1693,13 @@ def test_gpt_oss_decode_and_batcher_match_hf_generate():
     ride cached decode (dense engine) and the paged batcher's chunk and
     prefix formulations identically — greedy ≡ HF generate."""
     import torch
-    import transformers
+    from conftest import tiny_gpt_oss_model
     from distributed_llm_inferencing_tpu.ops.sampling import SamplingParams
     from distributed_llm_inferencing_tpu.runtime.batcher import (
         ContinuousBatcher)
     from distributed_llm_inferencing_tpu.runtime.engine import (
         InferenceEngine)
-    torch_cfg = transformers.GptOssConfig(
-        vocab_size=128, hidden_size=32, intermediate_size=16,
-        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
-        head_dim=8, num_local_experts=4, num_experts_per_tok=2,
-        sliding_window=4, layer_types=["sliding_attention",
-                                       "full_attention"],
-        max_position_embeddings=64, rope_scaling=None,
-        tie_word_embeddings=False, pad_token_id=0)
-    torch.manual_seed(61)
-    model = transformers.GptOssForCausalLM(torch_cfg).eval()
-    with torch.no_grad():
-        for lyr in model.model.layers:
-            lyr.self_attn.sinks.normal_(0.0, 1.0)
+    model = tiny_gpt_oss_model(seed=61)
     cfg, params = convert.load_hf_model(model, dtype=jnp.float32)
     cfg = cfg.replace(dtype="float32")
 

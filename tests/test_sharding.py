@@ -144,3 +144,51 @@ def test_gemma2_topology_sharded_equals_unsharded():
     got = _logits(cfg, params, tokens, mesh=create_mesh(spec),
                   mesh_spec=spec)
     np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_gpt_oss_tp_sharded_matches_single_device():
+    """gpt-oss under tp=2: the [L, H] sinks leaf shards over tp with the
+    heads, the expert biases over ep/tp — sharded greedy must equal
+    single-device greedy (sinks/norms randomized in the builder so a
+    mis-sharded leaf is visible)."""
+    from conftest import tiny_gpt_oss_model
+    from distributed_llm_inferencing_tpu.models import convert
+    from distributed_llm_inferencing_tpu.ops.sampling import SamplingParams
+    from distributed_llm_inferencing_tpu.runtime.engine import (
+        InferenceEngine)
+    model = tiny_gpt_oss_model(seed=63)
+    cfg, params = convert.load_hf_model(model, dtype=jnp.float32)
+    cfg = cfg.replace(dtype="float32", attn_backend="xla")
+    prompt = np.random.default_rng(63).integers(0, 128, 8).tolist()
+
+    single = InferenceEngine(cfg, params, max_seq=32).generate(
+        [prompt], max_new_tokens=8, sampling=SamplingParams.greedy()
+    ).tokens[0]
+    sharded = InferenceEngine(cfg, params, max_seq=32,
+                              mesh_spec=MeshSpec(tp=2)).generate(
+        [prompt], max_new_tokens=8, sampling=SamplingParams.greedy()
+    ).tokens[0]
+    assert sharded == single
+
+
+def test_glm45_moe_tp_ep_sharded_matches_single_device():
+    """GLM-4.5 MoE (deepseek routing + mixed dense-prefix stack) under
+    tp=2 x ep=2: sharded greedy equals single-device greedy."""
+    from conftest import tiny_glm45_moe_model
+    from distributed_llm_inferencing_tpu.models import convert
+    from distributed_llm_inferencing_tpu.ops.sampling import SamplingParams
+    from distributed_llm_inferencing_tpu.runtime.engine import (
+        InferenceEngine)
+    model = tiny_glm45_moe_model(seed=64)
+    cfg, params = convert.load_hf_model(model, dtype=jnp.float32)
+    cfg = cfg.replace(dtype="float32", attn_backend="xla")
+    prompt = np.random.default_rng(64).integers(0, 128, 8).tolist()
+
+    single = InferenceEngine(cfg, params, max_seq=32).generate(
+        [prompt], max_new_tokens=8, sampling=SamplingParams.greedy()
+    ).tokens[0]
+    sharded = InferenceEngine(cfg, params, max_seq=32,
+                              mesh_spec=MeshSpec(tp=2, ep=2)).generate(
+        [prompt], max_new_tokens=8, sampling=SamplingParams.greedy()
+    ).tokens[0]
+    assert sharded == single
