@@ -416,3 +416,27 @@ def test_worker_streaming_speculative(worker):
     assert toks == plain["tokens"] and len(toks) == 18
     requests.post(_url(wport, "/unload_model"),
                   json={"model_name": "tiny-gpt2"})
+
+
+def test_worker_serves_deepseek_moe(worker):
+    """The flagship MLA + MoE family through the worker's HTTP surface:
+    load (random-init registry model), infer, unload — the same wire
+    protocol the reference exposes for any model (reference
+    worker/app.py:49-330), exercised on a mixed dense-prefix MLA stack
+    with the latent KV cache auto-enabled by the engine underneath."""
+    _, port = worker
+    r = requests.post(_url(port, "/load_model"), json={
+        "model_name": "tiny-deepseek", "allow_random_init": True,
+        "dtype": "float32", "max_seq": 64})
+    assert r.status_code == 200, r.text
+
+    r = requests.post(_url(port, "/inference"), json={
+        "model_name": "tiny-deepseek", "prompt_tokens": [4, 9, 2, 7],
+        "max_new_tokens": 6, "sampling": {"do_sample": False}})
+    assert r.status_code == 200, r.text
+    data = r.json()
+    assert data["status"] == "success" and len(data["tokens"]) == 6
+
+    r = requests.post(_url(port, "/unload_model"),
+                      json={"model_name": "tiny-deepseek"})
+    assert r.json()["status"] == "success"
