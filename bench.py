@@ -324,11 +324,6 @@ def bench_speculative(new_tokens=NEW_TOKENS):
     return plain, spec
 
 
-def _pct(sorted_vals, p):
-    i = min(len(sorted_vals) - 1, int(round(p / 100 * (len(sorted_vals) - 1))))
-    return sorted_vals[i]
-
-
 def bench_batched(model=MODEL, quant=None, n_requests=8,
                   new_tokens=NEW_TOKENS, dtype=None, repeats=2,
                   prompt_len=PROMPT_LEN, kv_quant=None,
@@ -352,6 +347,7 @@ def bench_batched(model=MODEL, quant=None, n_requests=8,
     from distributed_llm_inferencing_tpu.ops.sampling import SamplingParams
     from distributed_llm_inferencing_tpu.runtime.batcher import (
         ContinuousBatcher)
+    from distributed_llm_inferencing_tpu.utils.metrics import Metrics
 
     cfg = get_config(model)
     if quant:
@@ -363,9 +359,10 @@ def bench_batched(model=MODEL, quant=None, n_requests=8,
     max_seq = prompt_len + new_tokens + 16
     slots = min(n_requests, 32)
     blocks = max(256, n_requests * (-(-max_seq // 16)) + 32)
+    met = Metrics()   # percentiles come from the batcher's own histograms
     b = ContinuousBatcher(cfg, num_blocks=blocks, block_size=16,
                           slots=slots, max_seq=max_seq, seed=0,
-                          speculative=speculative)
+                          speculative=speculative, metrics=met)
     rng = np.random.default_rng(0)
     # the speculative comparison measures greedy on BOTH arms (greedy is
     # the accelerated mode, and the baseline must match it); repetitive
@@ -416,17 +413,27 @@ def bench_batched(model=MODEL, quant=None, n_requests=8,
     _beat(f"warm batched {model} x{n_requests}")
     best, stats = 0.0, {}
     for rep in range(repeats):
+        met.reset_timings()   # percentiles cover exactly this rep's run
         tput, reqs = run(1000 * (rep + 1))
         _beat(f"rep batched {model} x{n_requests}")
         if tput > best:
             best = tput
-            ttfts = sorted(r.ttft_ms for r in reqs)
-            lats = sorted(r.latency_ms for r in reqs)
+            # sourced from the scheduler's own histograms
+            # (runtime/batcher.py observes ttft / inter-token pacing /
+            # e2e latency per request), not bench-side ad-hoc timers
+            t = met.snapshot()["timings"]
+
+            def q(name, p):
+                e = t.get(name)
+                return round(e[p] * 1e3, 1) if e else None
+
             stats = {
-                "ttft_ms_p50": round(_pct(ttfts, 50), 1),
-                "ttft_ms_p95": round(_pct(ttfts, 95), 1),
-                "latency_ms_p50": round(_pct(lats, 50), 1),
-                "latency_ms_p95": round(_pct(lats, 95), 1),
+                "ttft_ms_p50": q("batcher_ttft", "p50"),
+                "ttft_ms_p95": q("batcher_ttft", "p95"),
+                "itl_ms_p50": q("batcher_inter_token", "p50"),
+                "itl_ms_p95": q("batcher_inter_token", "p95"),
+                "latency_ms_p50": q("batcher_e2e_latency", "p50"),
+                "latency_ms_p95": q("batcher_e2e_latency", "p95"),
             }
     return best, stats
 

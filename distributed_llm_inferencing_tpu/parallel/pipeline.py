@@ -28,6 +28,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from distributed_llm_inferencing_tpu.models.config import ModelConfig
 from distributed_llm_inferencing_tpu.ops.kvcache import KVCache
+from distributed_llm_inferencing_tpu.utils import trace as trace_mod
 
 
 def _stage_body(x, layers_p, ck, cv, q_positions, write_starts, new_lengths,
@@ -93,13 +94,20 @@ def pipelined_apply(
                              sp_mesh=mesh if mesh.shape["sp"] > 1 else None)
     layer_spec = jax.tree.map(lambda _: P("pp"), params["layers"])
     cache_spec = P("pp")
-    out = jax.shard_map(
-        body, mesh=mesh, axis_names={"pp"},
-        in_specs=(P(), layer_spec, cache_spec, cache_spec, P(), P(), P()),
-        out_specs=(P(), cache_spec, cache_spec),
-        check_vma=False,
-    )(x, params["layers"], cache.k, cache.v, q_positions, write_starts,
-      new_lengths)
+    # tracing-time span (once per compile, inside jit): records when a
+    # GPipe schedule over pp stages is staged and at what microbatching —
+    # the host-side visibility the per-step XLA profile can't give
+    with trace_mod.get_tracer().span(
+            "pipeline.gpipe.trace",
+            attrs={"pp": int(pp), "n_micro": int(n_micro),
+                   "prefill": bool(is_prefill)}):
+        out = jax.shard_map(
+            body, mesh=mesh, axis_names={"pp"},
+            in_specs=(P(), layer_spec, cache_spec, cache_spec, P(), P(), P()),
+            out_specs=(P(), cache_spec, cache_spec),
+            check_vma=False,
+        )(x, params["layers"], cache.k, cache.v, q_positions, write_starts,
+          new_lengths)
     x, new_k, new_v = out
 
     # ---- final norm + logits (replicated, shared helper) ----

@@ -46,6 +46,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from distributed_llm_inferencing_tpu.ops.attention import NEG_INF, repeat_kv
+from distributed_llm_inferencing_tpu.utils import trace as trace_mod
 
 
 def _resolve_mesh(mesh):
@@ -205,12 +206,19 @@ def ring_attend_decode(
     if alibi is not None:   # slopes shard with the query heads
         in_specs = in_specs + (P("tp"),)
         args = args + (alibi,)
-    return jax.shard_map(
-        body, mesh=_resolve_mesh(mesh),
-        in_specs=in_specs,
-        out_specs=q_spec,
-        check_vma=False,
-    )(*args)
+    # tracing-time span: this body runs once per program compile (inside
+    # jit), so the span exposes when/where ring-collective programs get
+    # staged — the compile cost, not per-step device time (that is what
+    # /profile/start's XLA trace is for)
+    with trace_mod.get_tracer().span(
+            "ring.decode.trace", attrs={"sp": int(sp), "tp": int(tp),
+                                        "cache_len": int(S)}):
+        return jax.shard_map(
+            body, mesh=_resolve_mesh(mesh),
+            in_specs=in_specs,
+            out_specs=q_spec,
+            check_vma=False,
+        )(*args)
 
 
 def ring_attend_prefill(
@@ -263,9 +271,12 @@ def ring_attend_prefill(
     if alibi is not None:   # slopes shard with the query heads
         in_specs = in_specs + (P("tp"),)
         args = args + (alibi,)
-    return jax.shard_map(
-        body, mesh=_resolve_mesh(mesh),
-        in_specs=in_specs,
-        out_specs=q_spec,
-        check_vma=False,
-    )(*args)
+    with trace_mod.get_tracer().span(
+            "ring.prefill.trace", attrs={"sp": int(sp), "tp": int(tp),
+                                         "seq": int(S)}):
+        return jax.shard_map(
+            body, mesh=_resolve_mesh(mesh),
+            in_specs=in_specs,
+            out_specs=q_spec,
+            check_vma=False,
+        )(*args)

@@ -58,6 +58,8 @@ from distributed_llm_inferencing_tpu.ops.sampling import (
 from distributed_llm_inferencing_tpu.parallel import sharding as shd
 from distributed_llm_inferencing_tpu.parallel.mesh import (
     MeshSpec, create_mesh, validate_spec)
+from distributed_llm_inferencing_tpu.utils import trace
+from distributed_llm_inferencing_tpu.utils.metrics import Metrics
 
 TAIL_BUCKETS_X_BS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512)  # × block_size
 PREFIX_BUCKETS = (0, 1, 2, 4, 8, 16, 32, 64, 128, 256, 512)  # blocks
@@ -80,6 +82,11 @@ class BatchRequest:
     submitted_at: float = dataclasses.field(default_factory=time.time)
     first_token_at: Optional[float] = None
     finished_at: Optional[float] = None
+    # submitter's trace context (utils/trace.py SpanCtx): the scheduler
+    # runs in its own thread, so the link to the originating HTTP request
+    # rides the request object instead of a contextvar
+    trace_ctx: Optional[object] = None
+    _last_emit_at: Optional[float] = None
     # internal scheduling state
     _blocks: List[int] = dataclasses.field(default_factory=list)
     _preemptions: int = 0
@@ -160,7 +167,11 @@ class ContinuousBatcher:
                  seed: int = 0, force_python_pool: bool = False,
                  mesh_spec: Optional[MeshSpec] = None,
                  prefill_chunk: Optional[int] = 32,
-                 speculative: Optional[str] = None, spec_gamma: int = 4):
+                 speculative: Optional[str] = None, spec_gamma: int = 4,
+                 metrics: Optional[Metrics] = None):
+        # shared with the worker's registry when serving (so /metrics
+        # carries the scheduler's gauges/histograms); owned otherwise
+        self.metrics = metrics or Metrics()
         self.mesh_spec = mesh_spec or MeshSpec()
         for ax in ("dp", "sp"):
             if getattr(self.mesh_spec, ax) > 1:
@@ -280,7 +291,8 @@ class ContinuousBatcher:
                sampling: Optional[SamplingParams] = None,
                eos_token_id: Optional[int] = None,
                stream_cb: Optional[Callable[[int], None]] = None,
-               seed: Optional[int] = None) -> BatchRequest:
+               seed: Optional[int] = None,
+               trace_ctx=None) -> BatchRequest:
         if not prompt:
             raise ValueError("empty prompt")
         if seed is None:
@@ -289,13 +301,19 @@ class ContinuousBatcher:
                            max_new_tokens=int(max_new_tokens),
                            sampling=sampling or SamplingParams(),
                            eos_token_id=eos_token_id, stream_cb=stream_cb,
-                           seed=int(seed))
+                           seed=int(seed),
+                           # explicit ctx for callers submitting from a
+                           # helper thread (SSE streams), ambient otherwise
+                           trace_ctx=trace_ctx or trace.current())
         if len(req.prompt) + req.max_new_tokens > self.max_seq:
             raise ValueError(
                 f"prompt ({len(req.prompt)}) + max_new_tokens "
                 f"({req.max_new_tokens}) exceeds max_seq {self.max_seq}")
         with self._lock:
             self.queue.append(req)
+            depth = len(self.queue)
+        self.metrics.inc("batcher_requests_submitted")
+        self.metrics.gauge("batcher_queue_depth", depth)
         self._work.set()
         return req
 
@@ -322,8 +340,7 @@ class ContinuousBatcher:
             drained = list(self.queue)
             self.queue.clear()
         for req in drained:
-            req.error = "scheduler stopped"
-            req.done.set()
+            self._fail_req(req, "scheduler stopped")
 
     def stats(self) -> dict:
         return {
@@ -679,14 +696,12 @@ class ContinuousBatcher:
                 break
             req._noslot_bounce = False   # re-marked below if it bounces again
             if req._cancelled:
-                req.error = req.error or "cancelled"
-                req.done.set()
+                self._fail_req(req, "cancelled")
                 continue
             try:
                 prep = self._prep_admit(req)
             except ValueError as e:
-                req.error = str(e)
-                req.done.set()
+                self._fail_req(req, str(e))
                 continue
             if (prep is not None and wave
                     and (self._shared_wave_blocks(wave, prep["prompt"])
@@ -697,15 +712,13 @@ class ContinuousBatcher:
                 # (saves both the blocks and the prefill compute)
                 self.pool.release(prep["prefix_blocks"])
                 self.pool.release(prep["tail_alloc"])
-                with self._lock:
-                    self.queue.appendleft(req)
+                self._requeue_front(req)
                 break
             if prep is None:
                 if wave:
                     # part of the wave is already allocated — admit it now,
                     # retry this request FIRST next step
-                    with self._lock:
-                        self.queue.appendleft(req)
+                    self._requeue_front(req)
                     break
                 # Free memory by preempting the youngest slot, then retry
                 # this request FIRST next step (it goes in front of the
@@ -713,11 +726,9 @@ class ContinuousBatcher:
                 preempted = self._preempt_youngest()
                 if not preempted and not self._admit_order:
                     # no active slots to free: this prompt can never fit
-                    req.error = "KV block pool exhausted"
-                    req.done.set()
+                    self._fail_req(req, "KV block pool exhausted")
                 else:
-                    with self._lock:
-                        self.queue.appendleft(req)
+                    self._requeue_front(req)
                 break
             prep["req"] = req
             if prep["partial"]:
@@ -732,8 +743,7 @@ class ContinuousBatcher:
                 req._noslot_bounce = True
                 self.pool.release(prep["prefix_blocks"])
                 self.pool.release(prep["tail_alloc"])
-                with self._lock:
-                    self.queue.appendleft(req)
+                self._requeue_front(req)
                 break
             prep["slot"] = free[0]
             taken.add(free[0])
@@ -789,11 +799,18 @@ class ContinuousBatcher:
             "steps": steps.tolist(), "temps": temps.tolist(),
             "tks": tks.tolist(), "tps": tps.tolist(), "ds": ds.tolist(),
         }
+        w0 = time.time()
         if self.program_hook is not None:
             first = self.program_hook("admit", admit_args,
                                       lambda: self._run_admit(admit_args))
         else:
             first = self._run_admit(admit_args)
+        w1 = time.time()
+        self.metrics.observe("batcher_admit_wave", w1 - w0)
+        trace.get_tracer().record(
+            "batcher.admit_wave", w0, w1,
+            attrs={"members": len(members), "rows": b,
+                   "tail_bucket": t, "prefix_bucket": pb})
         for j, m in enumerate(members):
             self._post_admit(m, int(first[j]))
 
@@ -837,16 +854,13 @@ class ContinuousBatcher:
                 # pool-sized prompts could re-prefill each other forever
                 req._chunk_stalls += 1
                 if req._chunk_stalls > 4:
-                    req.error = ("KV block pool exhausted "
-                                 "(chunked prefill made no progress)")
-                    req.done.set()
+                    self._fail_req(req, "KV block pool exhausted "
+                                        "(chunked prefill made no progress)")
                     return
             if not req._cancelled:
-                with self._lock:
-                    self.queue.appendleft(req)
+                self._requeue_front(req)
             else:
-                req.error = req.error or "cancelled"
-                req.done.set()
+                self._fail_req(req, "cancelled")
             return
 
         req._blocks = prefix_blocks + tail_real
@@ -869,11 +883,37 @@ class ContinuousBatcher:
         if req.done.is_set() or len(req.tokens) >= req.max_new_tokens:
             self._finish_slot(slot)
 
+    def _requeue_front(self, req: BatchRequest):
+        """Put a request back at the queue head (chunked-prefill resume,
+        preemption, wave overflow) — one counted path for every retry."""
+        self.metrics.inc("batcher_requeues")
+        with self._lock:
+            self.queue.appendleft(req)
+
+    def _fail_req(self, req: BatchRequest, error: Optional[str] = None):
+        """Terminal failure for a request that never reaches _finish_req
+        (cancelled in queue, admission refusal, pool exhaustion, scheduler
+        stop/error) — same metrics/trace accounting as a normal finish, so
+        submitted always reconciles with completed+failed."""
+        req.error = req.error or error or "failed"
+        req.finished_at = req.finished_at or time.time()
+        self._observe_finished(req)
+        req.done.set()
+
     def _emit(self, req: BatchRequest, token: int):
         """Append a sampled token; mark done on eos (eos not kept)."""
         if req.eos_token_id is not None and token == req.eos_token_id:
             self._finish_req(req)
             return
+        now = time.time()
+        if req._last_emit_at is not None:
+            # per-GAP inter-token latency: near-zero inside a chunk's
+            # burst, chunk-sized at boundaries, and stall-sized across a
+            # preemption/re-prefill — a per-request mean would average
+            # that 2s pause invisible
+            self.metrics.observe("batcher_inter_token",
+                                 now - req._last_emit_at)
+        req._last_emit_at = now
         req.tokens.append(token)
         self._tokens_out += 1
         if req.stream_cb:
@@ -886,7 +926,31 @@ class ContinuousBatcher:
         self.pool.release(req._blocks)
         req._blocks = []
         req.finished_at = time.time()
-        req.done.set()
+        self._observe_finished(req)   # before done.set(): a waiter may
+        req.done.set()                # scrape /metrics|/api/trace at once
+
+    def _observe_finished(self, req: BatchRequest):
+        """Per-request histograms + retroactive trace spans, reconstructed
+        from the request's own timestamps (the scheduler thread has no
+        ambient trace context — the link rides req.trace_ctx)."""
+        m = self.metrics
+        m.inc("batcher_requests_failed" if req.error
+              else "batcher_requests_completed")
+        end = req.finished_at or time.time()
+        m.observe("batcher_e2e_latency", end - req.submitted_at)
+        if req.first_token_at is not None:
+            m.observe("batcher_ttft", req.first_token_at - req.submitted_at)
+        tr = trace.get_tracer()
+        attrs = {"tokens": len(req.tokens), "preemptions": req._preemptions}
+        if req.error:
+            attrs["error"] = req.error
+        g = tr.record("batcher.request", req.submitted_at, end,
+                      parent=req.trace_ctx, attrs=attrs)
+        if req.first_token_at is not None:
+            tr.record("batcher.ttft", req.submitted_at, req.first_token_at,
+                      parent=g)
+            tr.record("batcher.decode", req.first_token_at, end, parent=g,
+                      attrs={"tokens": len(req.tokens)})
 
     def _finish_slot(self, slot: int):
         req = self.active[slot]
@@ -902,6 +966,7 @@ class ContinuousBatcher:
         """Free the most recently admitted slot, requeueing its request."""
         if not self._admit_order:
             return False
+        self.metrics.inc("batcher_preemptions")
         slot = self._admit_order.pop()
         req = self.active[slot]
         self.active[slot] = None
@@ -912,13 +977,11 @@ class ContinuousBatcher:
             req._blocks = []
             req._preemptions += 1
             if req._preemptions > 5:
-                req.error = "preempted repeatedly: KV pool too small"
-                req.done.set()
+                self._fail_req(req, "preempted repeatedly: KV pool too small")
             else:
                 # generated tokens are kept; re-admission prefills
                 # prompt+tokens and resumes (see _prep_admit)
-                with self._lock:
-                    self.queue.appendleft(req)
+                self._requeue_front(req)
         return True
 
     def _ensure_growth(self, slot: int, k: int = 1) -> bool:
@@ -948,6 +1011,24 @@ class ContinuousBatcher:
 
     def step(self) -> int:
         """Admit a wave + one K-token decode chunk. Returns active slots."""
+        t0 = time.perf_counter()
+        busy = 0
+        try:
+            busy = self._step_inner()
+            return busy
+        finally:
+            # the hot-path gauges the dashboard and /metrics surface: how
+            # deep the queue is, how full the slots are, how much KV
+            # headroom remains — refreshed every scheduler step
+            m = self.metrics
+            if busy:   # idle polls would drown the step histogram
+                m.observe("batcher_step", time.perf_counter() - t0)
+            m.gauge("batcher_queue_depth", len(self.queue))
+            m.gauge("batcher_active_slots",
+                    sum(a is not None for a in self.active))
+            m.gauge("batcher_free_kv_blocks", self.pool.free_count())
+
+    def _step_inner(self) -> int:
         # drop cancelled slots first — frees their blocks for admission
         for slot in range(self.slots):
             req = self.active[slot]
@@ -1020,12 +1101,18 @@ class ContinuousBatcher:
         }
         if self.speculative:
             return self._step_speculative(active, decode_args)
+        w0 = time.time()
         if self.program_hook is not None:
             toks, emits = self.program_hook(
                 "decode", decode_args, lambda: self._run_decode(decode_args))
         else:
             toks, emits = self._run_decode(decode_args)
         self._step_count += 1
+        w1 = time.time()
+        self.metrics.observe("batcher_decode_chunk", w1 - w0)
+        trace.get_tracer().record(
+            "batcher.decode_chunk", w0, w1,
+            attrs={"k": int(k), "slots": len(active)})
 
         for i in active:
             req = self.active[i]
@@ -1051,6 +1138,7 @@ class ContinuousBatcher:
         g1 = self.spec_gamma + 1
         k_it = -(-int(decode_args["k"]) // g1)
         args = dict(decode_args, k=k_it, gamma=self.spec_gamma)
+        w0 = time.time()
         if self.program_hook is not None:
             # the lockstep mirror ships JSON: broadcast only per-slot
             # history deltas (non-empty just after admissions); followers
@@ -1065,6 +1153,12 @@ class ContinuousBatcher:
             args["hist"] = self._hist
             toks, keeps, eos_seen = self._run_spec_decode(args)
         self._step_count += 1
+        w1 = time.time()
+        self.metrics.observe("batcher_decode_chunk", w1 - w0)
+        trace.get_tracer().record(
+            "batcher.spec_chunk", w0, w1,
+            attrs={"k": k_it, "gamma": self.spec_gamma,
+                   "slots": len(active)})
         self._apply_spec_hist(toks, keeps,
                               np.asarray(decode_args["cl"], np.int32))
 
@@ -1102,8 +1196,7 @@ class ContinuousBatcher:
                     drained = list(self.queue)
                     self.queue.clear()
                 for req in drained:
-                    req.error = f"scheduler error: {e}"
-                    req.done.set()
+                    self._fail_req(req, f"scheduler error: {e}")
                 self._stop.set()
                 return
             if not busy and not self.queue:

@@ -93,6 +93,15 @@ DASHBOARD = f"""<!doctype html><html><head><title>Dashboard</title>{_STYLE}
 <th>Prefix hit rate</th></tr></thead>
 <tbody id="serving"><tr><td colspan="8" class="muted">no batched models
 </td></tr></tbody></table>
+<h2 style="margin-top:24px">Cluster Metrics
+  <span class="muted" style="font-size:12px">(scraped from each worker's
+  /metrics; request timeline at <a href="/api/trace"
+  style="color:var(--accent)">/api/trace</a> — load in Perfetto)</span></h2>
+<table><thead><tr><th>Node</th><th>Status</th><th>Requests</th>
+<th>Tokens</th><th>TTFT p50 (ms)</th><th>ITL p50 (ms)</th><th>Queue</th>
+<th>Free KV blocks</th></tr></thead>
+<tbody id="clustermetrics"><tr><td colspan="8" class="muted">no workers
+</td></tr></tbody></table>
 <h2 style="margin-top:24px">Recent Requests</h2>
 <table><thead><tr><th>ID</th><th>Model</th><th>Status</th><th>tok/s</th>
 <th>Latency (s)</th><th>Node</th></tr></thead>
@@ -121,6 +130,31 @@ async function refresh() {{
         }}
     document.getElementById('serving').innerHTML = rows.join('') ||
       '<tr><td colspan="8" class="muted">no batched models</td></tr>';
+    // per-node metrics: the master's /api/cluster_metrics scrape
+    // (counters summed, histogram p50s interpolated master-side).
+    // Guarded separately: a slow/failed scrape must not freeze the
+    // request counters and tables below it.
+    try {{
+    const cm = await (await fetch('/api/cluster_metrics')).json();
+    const ms = (h, k) => h && h[k] && h[k].p50 != null ?
+      (h[k].p50 * 1000).toFixed(1) : '–';
+    document.getElementById('clustermetrics').innerHTML =
+      (cm.nodes || []).map(n => {{
+        const c = n.counters || {{}}, g = n.gauges || {{}},
+              h = n.histograms || {{}};
+        const st = n.scraped ? 'online' : 'offline';
+        return `<tr><td>${{esc(n.name)}}</td>`+
+          `<td><span class="pill ${{st}}">${{n.scraped ? 'scraped'
+            : esc(n.error || 'unreachable')}}</span></td>`+
+          `<td>${{c.requests_completed ?? 0}}</td>`+
+          `<td>${{c.tokens_generated ?? 0}}</td>`+
+          `<td>${{ms(h, 'batcher_ttft_seconds')}}</td>`+
+          `<td>${{ms(h, 'batcher_inter_token_seconds')}}</td>`+
+          `<td>${{g.batcher_queue_depth ?? '–'}}</td>`+
+          `<td>${{g.batcher_free_kv_blocks ?? '–'}}</td></tr>`;
+      }}).join('') ||
+      '<tr><td colspan="8" class="muted">no workers</td></tr>';
+    }} catch (e) {{ console.error(e); }}
     const r = await (await fetch('/api/inference/recent')).json();
     for (const k of ['pending','processing','completed'])
       document.getElementById('n-'+k).textContent = r.counts[k] || 0;

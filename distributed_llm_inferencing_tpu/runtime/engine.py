@@ -38,6 +38,8 @@ from distributed_llm_inferencing_tpu.ops.sampling import SamplingParams, sample
 from distributed_llm_inferencing_tpu.parallel import sharding as shd
 from distributed_llm_inferencing_tpu.parallel.mesh import (
     MeshSpec, create_mesh, validate_spec)
+from distributed_llm_inferencing_tpu.utils import trace
+from distributed_llm_inferencing_tpu.utils.metrics import Metrics
 
 PREFILL_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192)
 
@@ -69,7 +71,11 @@ class InferenceEngine:
                  mesh_spec: Optional[MeshSpec] = None,
                  max_seq: Optional[int] = None,
                  seed: int = 0,
-                 pipeline_microbatches: Optional[int] = None):
+                 pipeline_microbatches: Optional[int] = None,
+                 metrics: Optional[Metrics] = None):
+        # the worker shares its registry so /metrics carries engine
+        # timings; standalone engines keep their own
+        self.metrics = metrics or Metrics()
         self.mesh_spec = mesh_spec or MeshSpec()
         self._n_micro = pipeline_microbatches
         validate_spec(self.mesh_spec, cfg)
@@ -230,6 +236,25 @@ class InferenceEngine:
 
     # ---- compiled step builders -------------------------------------
 
+    def _timed_first_call(self, fn):
+        """Wrap a freshly-built jitted fn: jit compiles synchronously
+        inside the first call (execution dispatches async), so timing
+        that call observes ``engine_jit_compile`` to within one dispatch.
+        Lives here — not at the call sites — so every compile-cache
+        accessor reports compile time without re-deriving its key shape."""
+        state = {"first": True}
+
+        def wrapper(*args):
+            if state.pop("first", None):
+                t0 = time.perf_counter()
+                out = fn(*args)
+                self.metrics.observe("engine_jit_compile",
+                                     time.perf_counter() - t0)
+                return out
+            return fn(*args)
+
+        return wrapper
+
     def _build_prefill(self, s0: int):
         cfg = self.cfg
         # sp>1 routes prefill attention through the ring (parallel/ring.py);
@@ -253,7 +278,7 @@ class InferenceEngine:
                 logits, idx[:, None, None].astype(jnp.int32), axis=1)[:, 0]
             return last, cache
 
-        return jax.jit(fn, donate_argnums=(3,))
+        return self._timed_first_call(jax.jit(fn, donate_argnums=(3,)))
 
     # Chunk sizes for the scanned decode loop. Any max_new_tokens is a
     # greedy sum of these, so at most len(DECODE_CHUNKS) programs compile
@@ -304,7 +329,7 @@ class InferenceEngine:
                     step, (tokens, cache, key), length=T)
                 return toks, cur, cache, key   # toks: [T, B]
 
-            fn = jax.jit(raw, donate_argnums=(2,))
+            fn = self._timed_first_call(jax.jit(raw, donate_argnums=(2,)))
             # cap scaled to the chunk schedule: ~8 sampling configs' worth
             # of compiled programs before FIFO eviction
             if len(self._decode_fns) >= 8 * len(self.DECODE_CHUNKS):
@@ -385,9 +410,11 @@ class InferenceEngine:
             cache = init_cache(cfg, B, self.max_seq)
             cache = jax.device_put(cache, self._cache_shardings)
 
-            if s0 not in self._prefill_fns:
+            prefill_fresh = s0 not in self._prefill_fns
+            if prefill_fresh:
                 self._prefill_fns[s0] = self._build_prefill(s0)
             t0 = time.perf_counter()
+            wt0 = time.time()
             last_logits, cache = self._prefill_fns[s0](
                 self.params, jnp.asarray(tokens), lengths, cache)
             key = jax.random.PRNGKey(seed)
@@ -403,6 +430,7 @@ class InferenceEngine:
             if incremental:
                 cur.block_until_ready()
             t1 = time.perf_counter()
+            wt1 = time.time()
 
             steps = 1
             remaining = max_new_tokens - 1
@@ -453,14 +481,38 @@ class InferenceEngine:
                     steps += T
                     remaining -= T
             t2 = time.perf_counter()
+            wt2 = time.time()
 
         out = out[:n_real]  # drop dp-padding rows
         # trim trailing eos
         if eos_token_id is not None:
             out = [t[:-1] if t and t[-1] == eos_token_id else t for t in out]
+        self._observe_generate(
+            wt0, wt1, wt2, t1 - t0, t2 - t1, steps,
+            {"model": cfg.name, "batch": n_real, "steps": steps},
+            {"bucket": s0, "compiled": prefill_fresh},
+            {"steps": steps, "incremental": incremental})
         return GenerateResult(
             tokens=out, prefill_ms=(t1 - t0) * 1e3,
             decode_ms=(t2 - t1) * 1e3, steps=steps)
+
+    def _observe_generate(self, wt0, wt1, wt2, prefill_s, decode_s, steps,
+                          gen_attrs, prefill_attrs, decode_attrs):
+        """Shared metrics+trace epilogue for every generate path. Spans
+        are retroactive (utils/trace.py record) and nest under the
+        caller's span — the worker's /inference handler — via the
+        contextvar; wall stamps keep master/worker timelines aligned
+        while the perf_counter deltas feed the histograms."""
+        self.metrics.observe("engine_prefill", prefill_s)
+        self.metrics.observe("engine_decode", decode_s)
+        self.metrics.inc("engine_decode_steps", steps)
+        tracer = trace.get_tracer()
+        g = tracer.record("engine.generate", wt0, wt2,
+                          parent=trace.current(), attrs=gen_attrs)
+        tracer.record("engine.prefill", wt0, wt1, parent=g,
+                      attrs=prefill_attrs)
+        tracer.record("engine.decode", wt1, wt2, parent=g,
+                      attrs=decode_attrs)
 
     # ---- speculative decoding (ops/speculative.py) --------------------
 
@@ -474,7 +526,7 @@ class InferenceEngine:
                 return speculative.verify_step(params, cfg, cache, cur,
                                                drafts, key, sp)
 
-            fn = jax.jit(raw, donate_argnums=(1,))
+            fn = self._timed_first_call(jax.jit(raw, donate_argnums=(1,)))
             if len(self._decode_fns) >= 8 * len(self.DECODE_CHUNKS):
                 self._decode_fns.pop(next(iter(self._decode_fns)))
             self._decode_fns[("spec", sp, g)] = fn
@@ -513,9 +565,11 @@ class InferenceEngine:
         with self.mesh:
             cache = init_cache(cfg, 1, self.max_seq)
             cache = jax.device_put(cache, self._cache_shardings)
-            if s0 not in self._prefill_fns:
+            prefill_fresh = s0 not in self._prefill_fns
+            if prefill_fresh:
                 self._prefill_fns[s0] = self._build_prefill(s0)
             t0 = time.perf_counter()
+            wt0 = time.time()
             last_logits, cache = self._prefill_fns[s0](
                 self.params, jnp.asarray(tokens),
                 jnp.asarray([len(prompt)], jnp.int32), cache)
@@ -523,6 +577,7 @@ class InferenceEngine:
             key, sub = jax.random.split(key)
             cur = int(sample(last_logits, sub, sp)[0])
             t1 = time.perf_counter()
+            wt1 = time.time()
 
             hit_eos = eos_token_id is not None and cur == eos_token_id
             out: List[int] = [] if hit_eos else [cur]
@@ -561,7 +616,14 @@ class InferenceEngine:
                     for j, t in enumerate(kept):
                         stream_cb(len(out) - len(kept) + j, [t])
             t2 = time.perf_counter()
+            wt2 = time.time()
 
+        self._observe_generate(
+            wt0, wt1, wt2, t1 - t0, t2 - t1, steps,
+            {"model": cfg.name, "batch": 1, "steps": steps,
+             "speculative": mode},
+            {"bucket": s0, "compiled": prefill_fresh},
+            {"steps": steps, "incremental": True})
         return GenerateResult(tokens=[out], prefill_ms=(t1 - t0) * 1e3,
                               decode_ms=(t2 - t1) * 1e3, steps=steps)
 

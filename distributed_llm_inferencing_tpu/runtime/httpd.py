@@ -15,6 +15,18 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional, Tuple
 
+from distributed_llm_inferencing_tpu.utils import trace
+
+
+# Monitoring surfaces polled every few seconds (master health loop,
+# dashboard, Prometheus scrapers): their server spans are pure
+# self-inflicted noise that would evict real request spans from the
+# tracer's ring buffer, so they run un-recorded (headers/propagation
+# still work — utils/trace.py span(keep=False)).
+QUIET_TRACE_PATHS = frozenset(
+    {"/health", "/metrics", "/api/trace", "/api/cluster_metrics",
+     "/api/nodes/status", "/api/inference/recent"})
+
 
 class Route:
     def __init__(self, method: str, pattern: str, fn: Callable):
@@ -56,11 +68,22 @@ class JsonHTTPService:
             def log_message(self, fmt, *args):  # quiet; logging via Metrics
                 pass
 
-            def _send_json(self, status: int, payload):
+            def _trace_headers(self):
+                # every response — errors included — names the trace it
+                # belongs to, so a failed request is findable in /api/trace
+                ctx = trace.current()
+                if ctx is not None:
+                    self.send_header(trace.TRACE_HEADER, ctx.trace_id)
+                    self.send_header(trace.SPAN_HEADER, ctx.span_id)
+
+            def _send_json(self, status: int, payload, headers=None):
                 body = json.dumps(payload).encode()
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self._trace_headers()
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -68,6 +91,7 @@ class JsonHTTPService:
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
+                self._trace_headers()
                 self.end_headers()
                 self.wfile.write(data)
 
@@ -78,15 +102,49 @@ class JsonHTTPService:
                 return hdr == f"Bearer {service.auth_key}"
 
             def _dispatch(self, method: str):
-                if not self._authorized():
-                    return self._send_json(401, {"status": "error",
-                                                 "message": "unauthorized"})
+                # Server span for the whole request: adopts the caller's
+                # trace context from X-DLI-Trace-Id/X-DLI-Parent-Span (or
+                # roots a fresh trace), and stays current while the
+                # response is written so even 4xx/5xx lines carry the
+                # trace headers (_send_json._trace_headers).
                 path = self.path.split("?", 1)[0]
+                tracer = trace.get_tracer()
+                with tracer.span(f"http {method} {path}",
+                                 parent=trace.extract(self.headers),
+                                 attrs={"service": service.name,
+                                        "method": method},
+                                 keep=path not in QUIET_TRACE_PATHS) as sp:
+                    self._dispatch_traced(method, path, sp)
+
+            def _drain_body(self):
+                # keep-alive (HTTP/1.1): an unread request body would be
+                # parsed as the NEXT request line on this connection —
+                # discard it before any response sent without dispatching
+                n = int(self.headers.get("Content-Length") or 0)
+                while n > 0:
+                    chunk = self.rfile.read(min(n, 1 << 16))
+                    if not chunk:
+                        break
+                    n -= len(chunk)
+
+            def _dispatch_traced(self, method: str, path: str, sp):
+                def send(status, payload, headers=None):
+                    sp.attrs["status"] = status
+                    return self._send_json(status, payload, headers)
+
+                if not self._authorized():
+                    self._drain_body()
+                    return send(401, {"status": "error",
+                                      "message": "unauthorized"})
+                allowed = set()
                 for r in service.routes:
-                    if r.method != method:
-                        continue
                     m = r.regex.match(path)
                     if not m:
+                        continue
+                    if r.method != method:
+                        # the path exists under another method: keep
+                        # looking for an exact match, 405 if none
+                        allowed.add(r.method)
                         continue
                     body = {}
                     if method in ("POST", "PUT"):
@@ -95,26 +153,35 @@ class JsonHTTPService:
                             try:
                                 body = json.loads(self.rfile.read(n) or b"{}")
                             except json.JSONDecodeError:
-                                return self._send_json(
-                                    400, {"status": "error",
-                                          "message": "invalid JSON body"})
+                                return send(400, {"status": "error",
+                                                  "message": "invalid JSON body"})
                     try:
                         result = r.fn(body, **m.groupdict(), _request=self) \
                             if _wants_request(r.fn) else r.fn(body, **m.groupdict())
                     except _Streaming:
+                        sp.attrs["status"] = 200
                         return  # handler already wrote the response
                     except Exception as e:  # structured 500, like worker/app.py:133-137
-                        return self._send_json(
-                            500, {"status": "error", "message": str(e)})
+                        return send(500, {"status": "error",
+                                          "message": str(e)})
                     if isinstance(result, tuple) and len(result) == 2 and \
                             isinstance(result[0], int):
                         status, payload = result
                     else:
                         status, payload = 200, result
                     if isinstance(payload, tuple):  # (bytes, content_type)
+                        sp.attrs["status"] = status
                         return self._send_raw(status, payload[0], payload[1])
-                    return self._send_json(status, payload)
-                self._send_json(404, {"status": "error", "message": "not found"})
+                    return send(status, payload)
+                self._drain_body()
+                if allowed:
+                    # registered path, wrong method: 405 + Allow, not the
+                    # misleading 404 this used to fall through to
+                    return send(405, {"status": "error",
+                                      "message": f"method {method} not "
+                                                 f"allowed for {path}"},
+                                headers={"Allow": ", ".join(sorted(allowed))})
+                send(404, {"status": "error", "message": "not found"})
 
             def do_GET(self):
                 self._dispatch("GET")
@@ -166,6 +233,7 @@ def sse_stream(request_handler, events):
     request_handler.send_header("Content-Type", "text/event-stream")
     request_handler.send_header("Cache-Control", "no-cache")
     request_handler.send_header("Connection", "close")  # no length: close delimits
+    request_handler._trace_headers()
     request_handler.end_headers()
     try:
         for ev in events:

@@ -30,8 +30,10 @@ import requests as http
 
 from distributed_llm_inferencing_tpu.runtime import dashboard_html, httpd
 from distributed_llm_inferencing_tpu.runtime.state import Store
+from distributed_llm_inferencing_tpu.utils import trace
 from distributed_llm_inferencing_tpu.utils.logging import setup_logging
-from distributed_llm_inferencing_tpu.utils.metrics import Metrics
+from distributed_llm_inferencing_tpu.utils.metrics import (
+    Metrics, hist_quantile, parse_prometheus)
 
 log = setup_logging("master")
 
@@ -61,11 +63,15 @@ class Master:
         if n:
             log.info("requeued %d request(s) stranded by a previous run", n)
         self.metrics = Metrics()
+        trace.set_service("master")
         self.health_interval = health_interval
         self._worker_auth = auth_key or os.environ.get("DLI_AUTH_KEY")
         self._inflight: Dict[int, int] = {}   # node_id -> in-flight count
         self._inflight_lock = threading.Lock()
         self._processing: Dict[int, dict] = {}  # req_id -> node (for cancel)
+        # req_id -> submitter's SpanCtx: dispatch runs on another thread,
+        # so the request's trace link rides this map, not a contextvar
+        self._trace_ctx: Dict[int, object] = {}
         self._stop = threading.Event()
         self._wake = threading.Event()
         self._threads = []
@@ -95,14 +101,21 @@ class Master:
         s.add("POST", "/api/plans/deploy/<plan_id>", self.api_deploy_plan)
         s.add("POST", "/api/models/load", self.api_load_model)
         s.add("GET", "/api/metrics", lambda b: self.metrics.snapshot())
+        s.add("GET", "/metrics", lambda b: (
+            self.metrics.prometheus().encode(), "text/plain; version=0.0.4"))
+        s.add("GET", "/api/trace", self.api_trace)
+        s.add("GET", "/api/cluster_metrics", self.api_cluster_metrics)
         s.add("GET", "/health", lambda b: {"status": "online",
                                            "counts": self.store.counts()})
 
     # ---- worker RPC --------------------------------------------------
 
     def _headers(self):
-        return ({"Authorization": f"Bearer {self._worker_auth}"}
-                if self._worker_auth else {})
+        h = ({"Authorization": f"Bearer {self._worker_auth}"}
+             if self._worker_auth else {})
+        # propagate the active trace onto every worker call, so the
+        # worker's server span joins this request's timeline
+        return trace.inject(h)
 
     def _worker_get(self, node, path, timeout):
         return http.get(self.store.node_url(node) + path,
@@ -252,6 +265,11 @@ class Master:
         req_id = self.store.submit_request(
             model, prompt, max_new, body.get("sampling"),
             max_length=max_length)
+        # remember the submit span so the dispatcher thread can parent the
+        # execution spans to this HTTP request's trace
+        ctx = trace.current()
+        if ctx is not None:
+            self._trace_ctx[req_id] = ctx
         self.metrics.inc("requests_submitted")
         self._wake.set()
         return {"status": "success", "request_id": req_id}
@@ -298,7 +316,81 @@ class Master:
                              "message": f"cancel relay failed: {e}"}
         self.store.mark_failed(req_id, "cancelled by user")
         self.metrics.inc("requests_cancelled")
+        self._trace_done(req_id)
         return {"status": "success", "message": "request cancelled"}
+
+    # ---- observability -----------------------------------------------
+
+    def _scrape_workers(self, path: str):
+        """Fetch ``path`` from every ACTIVE node concurrently (a dead node
+        otherwise serializes its full HEALTH_TIMEOUT into the handler and
+        the 10s dashboard poll piles up behind it). Returns
+        [(node, response-or-None, error-or-None)] for active nodes."""
+        from concurrent.futures import ThreadPoolExecutor
+        nodes = self.store.list_nodes(active_only=True)
+        if not nodes:
+            return []
+
+        def fetch(n):
+            try:
+                r = self._worker_get(n, path, HEALTH_TIMEOUT)
+                r.raise_for_status()
+                return n, r, None
+            except Exception as e:
+                return n, None, str(e)[:200]
+
+        with ThreadPoolExecutor(max_workers=min(8, len(nodes))) as ex:
+            return list(ex.map(fetch, nodes))
+
+    def api_trace(self, body):
+        """Cluster-wide Chrome trace-event export: the master's own span
+        ring buffer merged with a best-effort scrape of every active
+        worker's /api/trace, deduplicated — one request submitted here
+        loads as one connected timeline in Perfetto."""
+        extra = []
+        for n, r, err in self._scrape_workers("/api/trace"):
+            if err is not None:
+                log.debug("trace scrape of node %s failed: %s", n["id"], err)
+                continue
+            try:
+                extra.extend(r.json().get("traceEvents", []))
+            except ValueError:
+                pass
+        return trace.get_tracer().chrome_trace(extra_events=extra)
+
+    def api_cluster_metrics(self, body):
+        """One cluster snapshot: scrape every active worker's /metrics
+        exposition (concurrently), parse it
+        (utils/metrics.parse_prometheus), derive histogram p50/p95 from
+        the cumulative ``le=`` buckets, and sum counters across nodes —
+        the aggregation the dashboard's metrics table renders. Inactive
+        nodes are listed unscraped; unreachable ones report their scrape
+        error instead of silently vanishing from the snapshot."""
+        nodes, totals = [], {}
+        scraped = {}
+        for n, r, err in self._scrape_workers("/metrics"):
+            scraped[n["id"]] = (r, err)
+        for n in self.store.list_nodes():
+            entry = {"id": n["id"], "name": n["name"], "host": n["host"],
+                     "port": n["port"], "is_active": bool(n["is_active"]),
+                     "scraped": False}
+            r, err = scraped.get(n["id"], (None, "inactive"))
+            if r is not None:
+                try:
+                    entry.update(scraped=True,
+                                 **_group_samples(parse_prometheus(r.text)))
+                    for k, v in entry["counters"].items():
+                        totals[k] = totals.get(k, 0.0) + v
+                except ValueError as e:
+                    entry["error"] = str(e)[:200]
+            else:
+                entry["error"] = err
+            nodes.append(entry)
+        return {"status": "success", "nodes": nodes,
+                "cluster": {"counters": totals,
+                            "workers_scraped": sum(
+                                1 for x in nodes if x["scraped"])},
+                "master": self.metrics.snapshot()}
 
     # ---- scheduling --------------------------------------------------
 
@@ -331,9 +423,30 @@ class Master:
 
     def _execute(self, req) -> bool:
         """Run one request on a chosen node. True on success."""
+        tracer = trace.get_tracer()
+        # adopt the submit-time trace (kept across failover retries; freed
+        # when the request reaches a terminal state)
+        ctx = self._trace_ctx.get(req["id"])
+        with tracer.span("master.execute", parent=ctx,
+                         attrs={"req_id": req["id"],
+                                "model": req["model_name"],
+                                "attempt": req["attempts"]}):
+            if req["attempts"] == 0:
+                # make the dispatcher-queue wait visible in the timeline —
+                # first attempt only (on a failover retry, created_at->now
+                # covers the failed execution, not queueing)
+                tracer.record("master.queued", req["created_at"],
+                              time.time(), parent=trace.current())
+            return self._execute_on_node(req)
+
+    def _trace_done(self, req_id: int):
+        self._trace_ctx.pop(req_id, None)
+
+    def _execute_on_node(self, req) -> bool:
         node = self._pick_node(req["model_name"])
         if node is None:
             self.store.mark_failed(req["id"], "no active worker nodes")
+            self._trace_done(req["id"])
             return False
         nid = node["id"]
         with self._inflight_lock:
@@ -355,6 +468,7 @@ class Master:
                     self.store.mark_failed(req["id"],
                                            f"load rejected: {r.text[:200]}")
                     self.metrics.inc("requests_rejected")
+                    self._trace_done(req["id"])
                     return False
                 if r.status_code != 200:
                     raise RuntimeError(f"load_model failed: {r.text[:200]}")
@@ -374,14 +488,21 @@ class Master:
                 infer_body["max_new_tokens"] = req["max_new_tokens"]
             self._processing[req["id"]] = node
             try:
-                r = self._worker_post(node, "/inference", infer_body,
-                                      INFER_TIMEOUT)
+                # the dispatch span is the parent the worker's HTTP server
+                # span links to (trace headers injected by _headers)
+                with trace.get_tracer().span(
+                        "master.dispatch",
+                        attrs={"node_id": nid, "host": node["host"],
+                               "port": node["port"]}):
+                    r = self._worker_post(node, "/inference", infer_body,
+                                          INFER_TIMEOUT)
             finally:
                 self._processing.pop(req["id"], None)
             if 400 <= r.status_code < 500:
                 self.store.mark_failed(req["id"],
                                        f"rejected: {r.text[:200]}")
                 self.metrics.inc("requests_rejected")
+                self._trace_done(req["id"])
                 return False
             if r.status_code != 200:
                 raise RuntimeError(f"inference failed: {r.text[:200]}")
@@ -393,6 +514,7 @@ class Master:
             self.metrics.inc("requests_completed")
             self.metrics.observe("request_latency",
                                  time.time() - req["created_at"])
+            self._trace_done(req["id"])
             return True
         except Exception as e:
             log.warning("request %d failed on node %d: %s", req["id"], nid, e)
@@ -408,9 +530,11 @@ class Master:
                     pass
             if req["attempts"] + 1 < MAX_ATTEMPTS:
                 self.store.requeue(req["id"])   # failover retry
+                self.metrics.inc("requests_requeued")
                 self._wake.set()
             else:
                 self.store.mark_failed(req["id"], str(e))
+                self._trace_done(req["id"])
             # A read timeout means the worker is slow/busy (its generate
             # lock serializes requests), not dead — striking it would
             # deactivate healthy nodes under load. Connection-level errors
@@ -460,6 +584,10 @@ class Master:
                         consecutive_failures=0, last_heartbeat=time.time())
                 except Exception:
                     self._node_failure(n)
+            # queue-depth gauge on the monitor's cadence, not per submit
+            # (counts() is an aggregate query over the requests table)
+            self.metrics.gauge("queue_pending",
+                               self.store.counts().get("pending", 0))
             self._stop.wait(self.health_interval)
 
     # ---- lifecycle ---------------------------------------------------
@@ -484,6 +612,37 @@ class Master:
         self._stop.set()
         self._wake.set()
         self.service.shutdown()
+
+
+def _strip(name: str) -> str:
+    return name[4:] if name.startswith("dli_") else name
+
+
+def _group_samples(samples) -> dict:
+    """Regroup parsed exposition samples into the JSON shape the dashboard
+    consumes: counters (``_total``), gauges, and histograms with p50/p95
+    interpolated from the cumulative buckets."""
+    counters, gauges = {}, {}
+    buckets, sums, counts = {}, {}, {}
+    for name, labels, value in samples:
+        if name.endswith("_total"):
+            counters[_strip(name)[:-6]] = value
+        elif name.endswith("_bucket") and "le" in labels:
+            buckets.setdefault(_strip(name)[:-7], []).append(
+                (float(labels["le"]), value))
+        elif name.endswith("_sum"):
+            sums[_strip(name)[:-4]] = value
+        elif name.endswith("_count"):
+            counts[_strip(name)[:-6]] = value
+        else:
+            gauges[_strip(name)] = value
+    histograms = {}
+    for base, bk in buckets.items():
+        histograms[base] = {
+            "count": counts.get(base), "sum": sums.get(base),
+            "p50": hist_quantile(bk, 0.5), "p95": hist_quantile(bk, 0.95)}
+    return {"counters": counters, "gauges": gauges,
+            "histograms": histograms}
 
 
 def main(argv=None):

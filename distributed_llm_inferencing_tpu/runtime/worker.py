@@ -26,6 +26,7 @@ from distributed_llm_inferencing_tpu.ops.sampling import SamplingParams
 from distributed_llm_inferencing_tpu.parallel.mesh import MeshSpec
 from distributed_llm_inferencing_tpu.runtime import httpd
 from distributed_llm_inferencing_tpu.runtime.engine import InferenceEngine
+from distributed_llm_inferencing_tpu.utils import trace
 from distributed_llm_inferencing_tpu.utils.logging import setup_logging
 from distributed_llm_inferencing_tpu.utils.metrics import Metrics
 from distributed_llm_inferencing_tpu.utils.tokenizer import load_tokenizer
@@ -55,10 +56,12 @@ class WorkerAgent:
         self._loading: set = set()
         self.metrics = Metrics()
         self.started = time.time()
+        trace.set_service("worker")
         self.service = httpd.JsonHTTPService("worker", auth_key)
         s = self.service
         s.add("GET", "/health", self.health)
         s.add("GET", "/metrics", self.prometheus)
+        s.add("GET", "/api/trace", self.api_trace)
         s.add("POST", "/load_model", self.load_model)
         s.add("POST", "/load_shard", self.load_shard)
         s.add("POST", "/unload_model", self.unload_model)
@@ -122,6 +125,12 @@ class WorkerAgent:
 
     def prometheus(self, body):
         return (self.metrics.prometheus().encode(), "text/plain; version=0.0.4")
+
+    def api_trace(self, body):
+        """This process's span ring buffer as Chrome trace-event JSON
+        (utils/trace.py) — load in Perfetto, or let the master's
+        /api/trace merge it into the cluster-wide timeline."""
+        return trace.get_tracer().chrome_trace()
 
     def _do_load(self, body) -> tuple:
         name = body.get("model_name")
@@ -235,13 +244,14 @@ class WorkerAgent:
                 # get up to spec_gamma+1 tokens/iteration bit-identically
                 speculative=body.get("speculative"),
                 spec_gamma=int(body.get("spec_gamma", 4)),
-                mesh_spec=mesh)
+                mesh_spec=mesh, metrics=self.metrics)
             batcher.start()
             lm = LoadedModel(None, tok, source, batcher=batcher)
             stats = batcher.stats()
         else:
             engine = InferenceEngine(
-                cfg, params, mesh_spec=mesh, max_seq=body.get("max_seq"))
+                cfg, params, mesh_spec=mesh, max_seq=body.get("max_seq"),
+                metrics=self.metrics)
             lm = LoadedModel(engine, tok, source)
             stats = engine.stats()
         with self._models_lock:
@@ -340,6 +350,15 @@ class WorkerAgent:
         return m, prompt, sp, max_new, gen_kw
 
     def inference(self, body):
+        # semantic span under the HTTP server span; the batcher/engine
+        # below parent their own spans to it (contextvar or req.trace_ctx)
+        with trace.get_tracer().span(
+                "worker.inference",
+                attrs={"model": str(body.get("model_name")),
+                       "tag": str(body.get("request_tag") or "")}):
+            return self._inference_inner(body)
+
+    def _inference_inner(self, body):
         t0 = time.time()
         try:
             m, prompt, sp, max_new, gen_kw = self._prep_inference(body)
@@ -410,31 +429,18 @@ class WorkerAgent:
         import queue
         q: "queue.Queue" = queue.Queue()
         done = object()
+        ctx = trace.current()   # handler thread's span; run() is scheduled
+        # onto another thread, so the link is explicit
 
         def run():
             try:
-                m, prompt, sp, max_new, gen_kw = self._prep_inference(body)
-                if m.batcher is not None:
-                    raise ValueError(
-                        "engine_stream_events is for engine-mode models")
-
-                def cb(step, toks):
-                    if toks[0] is None:  # sequence finished (post-eos)
-                        return
-                    q.put({"event": "token", "step": step, "token": toks[0],
-                           "text": m.tokenizer.decode([toks[0]])})
-
-                with m.lock:
-                    res = m.engine.generate(
-                        [prompt], max_new_tokens=max_new, sampling=sp,
-                        eos_token_id=m.tokenizer.eos_token_id,
-                        stream_cb=cb, **gen_kw)
-                q.put({"event": "done",
-                       "result": m.tokenizer.decode(res.tokens[0]),
-                       "tokens_per_s": res.decode_tokens_per_s})
+                with trace.get_tracer().span("worker.inference_stream",
+                                             parent=ctx):
+                    return self._run_stream(body, q)
             except Exception as e:
                 q.put({"event": "error", "message": str(e)})
-            q.put(done)
+            finally:
+                q.put(done)
 
         schedule(run)
 
@@ -447,6 +453,27 @@ class WorkerAgent:
             self.metrics.inc("requests_completed")
 
         return events()
+
+    def _run_stream(self, body, q):
+        m, prompt, sp, max_new, gen_kw = self._prep_inference(body)
+        if m.batcher is not None:
+            raise ValueError(
+                "engine_stream_events is for engine-mode models")
+
+        def cb(step, toks):
+            if toks[0] is None:  # sequence finished (post-eos)
+                return
+            q.put({"event": "token", "step": step, "token": toks[0],
+                   "text": m.tokenizer.decode([toks[0]])})
+
+        with m.lock:
+            res = m.engine.generate(
+                [prompt], max_new_tokens=max_new, sampling=sp,
+                eos_token_id=m.tokenizer.eos_token_id,
+                stream_cb=cb, **gen_kw)
+        q.put({"event": "done",
+               "result": m.tokenizer.decode(res.tokens[0]),
+               "tokens_per_s": res.decode_tokens_per_s})
 
     def inference_stream(self, body, _request=None):
         """SSE streaming decode — absent from the reference (SURVEY.md §2.3)."""
@@ -462,6 +489,7 @@ class WorkerAgent:
                 body, lambda fn: threading.Thread(target=fn,
                                                   daemon=True).start())
             return httpd.sse_stream(_request, ev)
+        ctx = trace.current()   # submit happens on a helper thread below
 
         def events():
             import queue
@@ -481,7 +509,7 @@ class WorkerAgent:
                     req = m.batcher.submit(
                         prompt, max_new_tokens=max_new, sampling=sp,
                         eos_token_id=m.tokenizer.eos_token_id, stream_cb=cb,
-                        seed=body.get("seed"))
+                        seed=body.get("seed"), trace_ctx=ctx)
                     toks = req.wait(timeout=float(body.get("timeout", 300)))
                     q.put({"event": "done",
                            "result": m.tokenizer.decode(toks),

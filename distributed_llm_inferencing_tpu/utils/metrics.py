@@ -4,14 +4,51 @@ The reference's only metrics were psutil percentages returned from /health
 (reference: worker/app.py:54-67). Here every worker/master keeps counters
 and latency histograms, exported as JSON and Prometheus text — no external
 deps.
+
+Prometheus exposition follows the text format contract:
+
+- metric names are sanitized to ``[a-zA-Z_:][a-zA-Z0-9_:]*`` (dots and
+  dashes in registry names become ``_``)
+- counters get the ``_total`` suffix, so a counter and a gauge sharing a
+  registry name can never collide into one exposition line
+- every family carries ``# HELP`` and ``# TYPE`` lines
+- timings export as real histograms with cumulative ``le=`` buckets plus
+  ``_sum``/``_count``, maintained monotonically over the process
+  lifetime (never decreasing — a shrinking cumulative bucket reads as a
+  counter reset to a Prometheus server); ``snapshot()`` percentiles come
+  from a separate rolling window of the last ``WINDOW`` observations
 """
 
 from __future__ import annotations
 
+import bisect
+import re
 import threading
 import time
 from collections import deque
-from typing import Dict
+from typing import Dict, List, Optional, Tuple
+
+# Latency-shaped cumulative bucket upper bounds (seconds). Wide on
+# purpose: one schedule serves sub-ms decode chunks and multi-minute
+# model loads.
+HIST_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0)
+
+# Rolling-window size for snapshot() percentiles. Must cover one full
+# bench rep of per-token observations (staggered x32 emits 32x63 = 2016
+# inter-token gaps per rep) or the reported percentiles silently reflect
+# only the drain-down tail of the run.
+WINDOW = 4096
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def sanitize_name(name: str) -> str:
+    """Registry name -> valid Prometheus metric name body."""
+    s = _NAME_RE.sub("_", name)
+    if not s or s[0].isdigit():
+        s = "_" + s
+    return s
 
 
 class Metrics:
@@ -20,6 +57,12 @@ class Metrics:
         self._counters: Dict[str, float] = {}
         self._gauges: Dict[str, float] = {}
         self._timings: Dict[str, deque] = {}
+        # lifetime histogram state per timing — [per-bucket counts
+        # (last slot = overflow), total count, total sum]. Monotone, so
+        # the exposed cumulative buckets never decrease (a shrinking
+        # bucket reads as a counter reset to a Prometheus server; the
+        # rolling window is for snapshot() percentiles only)
+        self._hist: Dict[str, list] = {}
 
     def inc(self, name: str, value: float = 1.0):
         with self._lock:
@@ -31,10 +74,24 @@ class Metrics:
 
     def observe(self, name: str, seconds: float):
         with self._lock:
-            self._timings.setdefault(name, deque(maxlen=512)).append(seconds)
+            self._timings.setdefault(
+                name, deque(maxlen=WINDOW)).append(seconds)
+            h = self._hist.setdefault(
+                name, [[0] * (len(HIST_BUCKETS) + 1), 0, 0.0])
+            h[0][bisect.bisect_left(HIST_BUCKETS, seconds)] += 1
+            h[1] += 1
+            h[2] += seconds
 
     def time(self, name: str):
         return _Timer(self, name)
+
+    def reset_timings(self):
+        """Drop every timing window AND histogram (counters/gauges keep).
+        Benchmark-only: reps call it so percentiles cover exactly one run;
+        a scraped server should never reset (monotonicity)."""
+        with self._lock:
+            self._timings.clear()
+            self._hist.clear()
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -46,22 +103,53 @@ class Metrics:
                     out["timings"][k] = {
                         "count": len(s),
                         "p50": s[len(s) // 2],
+                        "p95": s[min(len(s) - 1, int(len(s) * 0.95))],
                         "p99": s[min(len(s) - 1, int(len(s) * 0.99))],
                         "mean": sum(s) / len(s),
+                        "sum": sum(s),
                     }
             return out
 
     def prometheus(self) -> str:
-        snap = self.snapshot()
-        lines = []
-        for k, v in snap["counters"].items():
-            lines.append(f"dli_{k} {v}")
-        for k, v in snap["gauges"].items():
-            lines.append(f"dli_{k} {v}")
-        for k, t in snap["timings"].items():
-            lines.append(f'dli_{k}_seconds{{q="0.5"}} {t["p50"]}')
-            lines.append(f'dli_{k}_seconds{{q="0.99"}} {t["p99"]}')
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            hists = {k: [list(h[0]), h[1], h[2]]
+                     for k, h in self._hist.items()}
+        lines: List[str] = []
+        for k in sorted(counters):
+            name = f"dli_{sanitize_name(k)}_total"
+            lines.append(f"# HELP {name} Counter {k!r} (process lifetime).")
+            lines.append(f"# TYPE {name} counter")
+            lines.append(f"{name} {_fmt(counters[k])}")
+        for k in sorted(gauges):
+            name = f"dli_{sanitize_name(k)}"
+            lines.append(f"# HELP {name} Gauge {k!r} (last set value).")
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name} {_fmt(gauges[k])}")
+        for k in sorted(hists):
+            per_bucket, count, total = hists[k]
+            name = f"dli_{sanitize_name(k)}_seconds"
+            lines.append(f"# HELP {name} Timing {k!r} histogram "
+                         "(process lifetime).")
+            lines.append(f"# TYPE {name} histogram")
+            cum = 0
+            for le, n in zip(HIST_BUCKETS, per_bucket):
+                cum += n
+                lines.append(f'{name}_bucket{{le="{_fmt(le)}"}} {cum}')
+            lines.append(f'{name}_bucket{{le="+Inf"}} {count}')
+            lines.append(f"{name}_sum {_fmt(total)}")
+            lines.append(f"{name}_count {count}")
         return "\n".join(lines) + "\n"
+
+
+def _fmt(v: float) -> str:
+    """Exposition-safe number: integral values print without exponent or
+    trailing zeros; others as repr floats."""
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
 
 
 class _Timer:
@@ -74,3 +162,57 @@ class _Timer:
 
     def __exit__(self, *exc):
         self.m.observe(self.name, time.perf_counter() - self.t0)
+
+
+# ---- exposition parsing (master-side cluster aggregation) -------------
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"             # metric name
+    r"(?:\{([^}]*)\})?"                        # optional labels
+    r"\s+(-?(?:[0-9.eE+-]+|\+?Inf|NaN))\s*$")  # value
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="([^"\\]*)"')
+
+
+def parse_prometheus(text: str) -> List[Tuple[str, Dict[str, str], float]]:
+    """Parse exposition text into (name, labels, value) samples. Comments
+    and blank lines are skipped; a malformed sample line raises — the
+    master treats an unparseable worker scrape as scrape failure, and the
+    strict-format test drives this same parser."""
+    out = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            raise ValueError(f"invalid exposition sample: {line!r}")
+        name, labels_raw, value = m.groups()
+        labels = dict(_LABEL_RE.findall(labels_raw)) if labels_raw else {}
+        out.append((name, labels, float(value.replace("Inf", "inf"))))
+    return out
+
+
+def hist_quantile(buckets: List[Tuple[float, float]], q: float
+                  ) -> Optional[float]:
+    """Approximate quantile (0..1) from cumulative ``le=`` histogram
+    buckets [(upper_bound, cumulative_count), ...] via linear
+    interpolation inside the landing bucket — how the master derives
+    p50/p95 from a scraped worker histogram."""
+    if not buckets:
+        return None
+    buckets = sorted(buckets)
+    total = buckets[-1][1]
+    if total <= 0:
+        return None
+    target = q * total
+    prev_le, prev_cum = 0.0, 0.0
+    for le, cum in buckets:
+        if cum >= target:
+            if le == float("inf"):
+                return prev_le          # open-ended bucket: lower bound
+            if cum == prev_cum:
+                return le
+            frac = (target - prev_cum) / (cum - prev_cum)
+            return prev_le + frac * (le - prev_le)
+        prev_le, prev_cum = le, cum
+    return buckets[-1][0]

@@ -1,0 +1,279 @@
+"""Request-scoped tracing: nested spans, per-process ring buffer, Chrome
+trace-event export.
+
+The reference system had no request timeline at all — its only timing was
+a wall-clock ``execution_time`` per request (reference: worker/app.py:317)
+— so a slow request was unexplainable: was it master queueing, worker
+dispatch, prefill, batcher admission, or decode? This module gives every
+process one :class:`Tracer` (a bounded ring buffer of finished spans) and
+carries the *current* span through a contextvar, so nested code records
+parent-linked spans without threading handles through every call.
+
+Cross-process propagation rides two HTTP headers:
+
+- ``X-DLI-Trace-Id``  — the id shared by every span of one request
+- ``X-DLI-Parent-Span`` — the caller's span id, adopted as the parent of
+  the callee's server span
+
+``runtime/httpd.py`` extracts them on dispatch and injects them onto
+responses; the master's worker-client calls inject them on the way out —
+so one inference request yields one connected timeline across master
+queueing, worker dispatch, engine prefill, batcher waves and decode.
+
+Export is Chrome trace-event JSON (``chrome_trace()``): load the output
+of ``GET /api/trace`` in Perfetto (https://ui.perfetto.dev) or
+``chrome://tracing``. Spans also carry their ids in ``args`` so traces
+can be joined programmatically.
+
+Threads: the contextvar isolates concurrent requests in the threaded
+HTTP servers for free. Work that hops threads (the master's dispatcher,
+the batcher loop) passes an explicit ``parent=`` SpanCtx instead.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import dataclasses
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+TRACE_HEADER = "X-DLI-Trace-Id"
+PARENT_HEADER = "X-DLI-Parent-Span"
+SPAN_HEADER = "X-DLI-Span-Id"
+
+
+def _new_id() -> str:
+    return os.urandom(8).hex()
+
+
+@dataclasses.dataclass(frozen=True)
+class SpanCtx:
+    """The propagatable identity of a span: what children and remote
+    callees need to link to it. Immutable so it can be stored/shared
+    across threads freely."""
+    trace_id: str
+    span_id: str
+
+
+@dataclasses.dataclass
+class Span:
+    name: str
+    trace_id: str
+    span_id: str
+    parent_id: Optional[str]
+    start: float          # epoch seconds (time.time — aligned across hosts)
+    end: float
+    attrs: Dict[str, object]
+    tid: int              # recording thread ident
+
+    @property
+    def duration_ms(self) -> float:
+        return (self.end - self.start) * 1e3
+
+    def ctx(self) -> SpanCtx:
+        return SpanCtx(self.trace_id, self.span_id)
+
+
+_current: "contextvars.ContextVar[Optional[SpanCtx]]" = \
+    contextvars.ContextVar("dli_current_span", default=None)
+
+# sentinel: distinguish "no parent given, use the contextvar" from an
+# explicit parent=None (start a fresh trace)
+_FROM_CONTEXT = object()
+
+
+def current() -> Optional[SpanCtx]:
+    """The active span's ctx in this thread/context, if any."""
+    return _current.get()
+
+
+def extract(headers) -> Optional[SpanCtx]:
+    """Read a propagated trace context from a mapping of HTTP headers
+    (any object with .get, e.g. http.client message or a plain dict)."""
+    tid = headers.get(TRACE_HEADER)
+    if not tid:
+        return None
+    return SpanCtx(trace_id=str(tid),
+                   span_id=str(headers.get(PARENT_HEADER) or ""))
+
+
+def inject(headers: dict, ctx: Optional[SpanCtx] = None) -> dict:
+    """Write the given (or current) trace context into an outgoing header
+    dict; no-op when there is nothing to propagate."""
+    ctx = ctx or current()
+    if ctx is not None:
+        headers[TRACE_HEADER] = ctx.trace_id
+        headers[PARENT_HEADER] = ctx.span_id
+    return headers
+
+
+class Tracer:
+    """Bounded ring buffer of finished spans for one process.
+
+    ``span()`` is the nesting-aware context manager; ``record()`` logs a
+    retroactive span from timestamps already taken (the batcher finishes a
+    request long after submit — its timeline is reconstructed from the
+    request's own stamps, not measured inline).
+    """
+
+    def __init__(self, service: str = "dli", capacity: int = 4096):
+        self.service = service
+        self.capacity = capacity
+        self._buf: deque = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+
+    # ---- recording ---------------------------------------------------
+
+    def record(self, name: str, start: float, end: float, *,
+               parent: Optional[SpanCtx] = None,
+               trace_id: Optional[str] = None,
+               attrs: Optional[dict] = None) -> SpanCtx:
+        """Append an already-finished span. ``parent`` supplies both the
+        trace id and the parent span id; ``trace_id`` alone starts/joins a
+        trace with no parent link."""
+        if parent is not None and trace_id is None:
+            trace_id = parent.trace_id
+        sp = Span(name=name, trace_id=trace_id or _new_id(),
+                  span_id=_new_id(),
+                  parent_id=(parent.span_id or None) if parent else None,
+                  start=start, end=end, attrs=dict(attrs or {}),
+                  tid=threading.get_ident())
+        with self._lock:
+            self._buf.append(sp)
+        return sp.ctx()
+
+    @contextlib.contextmanager
+    def span(self, name: str, *, parent=_FROM_CONTEXT,
+             attrs: Optional[dict] = None, keep: bool = True):
+        """Measure a nested span. Default parent is the context-current
+        span; pass ``parent=ctx`` to adopt a cross-thread/-process parent
+        or ``parent=None`` to root a fresh trace. Yields the live
+        :class:`Span` so callers can add attrs (e.g. the HTTP status).
+
+        ``keep=False`` runs the full span protocol (context propagation,
+        response headers see a current span) but drops the record on exit
+        — for high-frequency scrape endpoints that would otherwise evict
+        real request spans from the ring."""
+        if parent is _FROM_CONTEXT:
+            parent = _current.get()
+        trace_id = parent.trace_id if parent else _new_id()
+        sp = Span(name=name, trace_id=trace_id, span_id=_new_id(),
+                  parent_id=(parent.span_id or None) if parent else None,
+                  start=time.time(), end=0.0, attrs=dict(attrs or {}),
+                  tid=threading.get_ident())
+        token = _current.set(sp.ctx())
+        try:
+            yield sp
+        except BaseException as e:
+            sp.attrs.setdefault("error", f"{type(e).__name__}: {e}")
+            raise
+        finally:
+            _current.reset(token)
+            sp.end = time.time()
+            if keep:
+                with self._lock:
+                    self._buf.append(sp)
+
+    # ---- introspection / export --------------------------------------
+
+    def spans(self) -> List[Span]:
+        with self._lock:
+            return list(self._buf)
+
+    def clear(self):
+        with self._lock:
+            self._buf.clear()
+
+    def find(self, trace_id: str) -> List[Span]:
+        return [s for s in self.spans() if s.trace_id == trace_id]
+
+    def export_pid(self) -> int:
+        """Synthetic pid for trace export. os.getpid() alone collides in a
+        containerized deploy (master + each worker can all be PID 1 in
+        their own containers), which would merge every process onto one
+        Perfetto track — so the exported pid hashes in service name and
+        hostname as well."""
+        import socket
+        import zlib
+        ident = f"{self.service}:{socket.gethostname()}:{os.getpid()}"
+        return zlib.crc32(ident.encode()) & 0x7FFFFFFF
+
+    def chrome_events(self) -> List[dict]:
+        """This process's spans as Chrome trace-event dicts (``ph: "X"``
+        complete events, ts/dur in microseconds) plus process/thread
+        metadata events — the list ``chrome_trace()`` wraps."""
+        pid = self.export_pid()
+        events = [{
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": f"{self.service} ({socket_host()}:"
+                             f"{os.getpid()})"},
+        }]
+        for s in self.spans():
+            args = {"trace_id": s.trace_id, "span_id": s.span_id}
+            if s.parent_id:
+                args["parent_id"] = s.parent_id
+            args.update(s.attrs)
+            events.append({
+                "name": s.name, "cat": self.service, "ph": "X",
+                "ts": s.start * 1e6,
+                "dur": max(0.0, (s.end - s.start) * 1e6),
+                "pid": pid, "tid": s.tid, "args": args,
+            })
+        return events
+
+    def chrome_trace(self, extra_events: Optional[List[dict]] = None
+                     ) -> dict:
+        """Full Chrome trace-event JSON object, loadable in Perfetto.
+        ``extra_events`` lets an aggregator (the master) merge scraped
+        worker events into one timeline; duplicates (same span id seen via
+        both a local buffer and a scrape) are dropped."""
+        events = self.chrome_events() + list(extra_events or [])
+        return {"traceEvents": dedupe_events(events),
+                "displayTimeUnit": "ms"}
+
+
+def socket_host() -> str:
+    import socket
+    try:
+        return socket.gethostname()
+    except OSError:
+        return "unknown"
+
+
+def dedupe_events(events: List[dict]) -> List[dict]:
+    """Drop duplicate span/metadata events after a merge. Span identity is
+    its id (unique per recorded span); metadata identity is (pid, name,
+    args) — each process emits the same process_name line every export."""
+    seen = set()
+    out = []
+    for ev in events:
+        if ev.get("ph") == "M":
+            key = ("M", ev.get("pid"), ev.get("name"), str(ev.get("args")))
+        else:
+            sid = (ev.get("args") or {}).get("span_id")
+            key = ("X", ev.get("pid"), sid) if sid else ("X", id(ev))
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(ev)
+    return out
+
+
+_tracer = Tracer(service=os.environ.get("DLI_TRACE_SERVICE", "dli"))
+
+
+def get_tracer() -> Tracer:
+    """The process-global tracer. Components share one buffer; the
+    ``service``/``cat`` tag and span attrs say who recorded what."""
+    return _tracer
+
+
+def set_service(name: str):
+    """Name this process's track in exported traces ("master"/"worker").
+    First caller wins per process unless the name is still the default."""
+    if _tracer.service == "dli":
+        _tracer.service = name

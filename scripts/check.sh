@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Tier-1 gate: byte-compile everything, then run the ROADMAP.md tier-1
+# verify command. Later PRs run this in CI (.github/workflows/tier1.yml)
+# so "no worse than seed" is checked automatically.
+set -uo pipefail
+
+cd "$(dirname "$0")/.."
+
+echo "== compileall =="
+python -m compileall -q distributed_llm_inferencing_tpu tests bench.py \
+    benchmarks || exit 1
+
+echo "== tier-1 tests (ROADMAP.md verify command) =="
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)
+exit $rc
