@@ -342,6 +342,14 @@ class ContinuousBatcher:
         for req in drained:
             self._fail_req(req, "scheduler stopped")
 
+    def inflight(self) -> int:
+        """Requests the scheduler still owes an answer (active slots +
+        queue) — what a graceful drain waits on (runtime/worker.py
+        _wait_idle polls this alongside its own handler count)."""
+        with self._lock:
+            queued = len(self.queue)
+        return sum(a is not None for a in self.active) + queued
+
     def stats(self) -> dict:
         return {
             "slots": self.slots,

@@ -251,10 +251,18 @@ async function refresh() {{
       `${{esc(m.name)}} [${{esc(m.serving === 'batched' ? 'batched'
         : Object.entries(m.mesh || {{}}).filter(e=>e[1]>1)
         .map(e=>e.join('=')).join(' ') || '1 chip')}}]`).join('<br>');
+    // breaker-aware status: closed=online, open=tripped offline,
+    // half_open=probing its way back, draining=finishing in-flight work
+    const st = n.draining ? 'draining'
+      : (n.breaker || (n.is_active ? 'closed' : 'open'));
+    const stCls = st === 'closed' ? 'online'
+      : st === 'open' ? 'offline' : 'pending';
+    const stTxt = (st === 'closed' ? 'online'
+      : st === 'open' ? 'tripped' : st.replace('_', '-'))
+      + (n.strikes ? ` (${{n.strikes}} strikes)` : '');
     return `<tr><td>${{n.id}}</td><td>${{esc(n.name)}}</td>`+
     `<td>${{esc(n.host)}}:${{esc(n.port)}}</td>`+
-    `<td><span class="pill ${{n.is_active?'online':'offline'}}">`+
-    `${{n.is_active?'online':'offline'}}</span></td>`+
+    `<td><span class="pill ${{stCls}}">${{stTxt}}</span></td>`+
     `<td>${{dev}}</td>`+
     `<td>${{n.resources && n.resources.cpu != null ? n.resources.cpu : ''}}</td>`+
     `<td>${{n.resources && n.resources.memory != null ? n.resources.memory : ''}}</td>`+

@@ -10,12 +10,15 @@ auth (reference: worker/app.py:32-47), and structured error responses
 from __future__ import annotations
 
 import json
+import os
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional, Tuple
 
 from distributed_llm_inferencing_tpu.utils import trace
+from distributed_llm_inferencing_tpu.utils.faults import FaultInjector
 
 
 # Monitoring surfaces polled every few seconds (master health loop,
@@ -39,9 +42,10 @@ class Route:
 class JsonHTTPService:
     """Register handlers; serve with ThreadingHTTPServer.
 
-    Handler signature: fn(body: dict, **path_params) -> (status, payload)
-    or -> payload (200 implied). Payload of type (bytes, content_type)
-    passes through raw (HTML pages, SSE handled separately).
+    Handler signature: fn(body: dict, **path_params) -> (status, payload),
+    -> (status, payload, headers), or -> payload (200 implied). Payload
+    of type (bytes, content_type) passes through raw (HTML pages, SSE
+    handled separately).
     """
 
     def __init__(self, name: str, auth_key: Optional[str] = None):
@@ -49,6 +53,19 @@ class JsonHTTPService:
         self.auth_key = auth_key
         self.routes: List[Route] = []
         self._server: Optional[ThreadingHTTPServer] = None
+        # Fault-injection harness (utils/faults.py): armed from DLI_FAULTS
+        # at construction or at runtime via the admin endpoints below.
+        # Pays one lock acquire per request when nothing is armed. The
+        # admin surface is a remote kill switch (mode "crash"), so it
+        # only exists when fault injection is explicitly enabled —
+        # production services never expose it by accident.
+        self.faults = FaultInjector.from_env(name)
+        if os.environ.get("DLI_FAULTS") or \
+                os.environ.get("DLI_FAULTS_ENABLE", "").lower() in \
+                ("1", "true"):
+            self.add("GET", "/api/faults", self.faults.api_get)
+            self.add("POST", "/api/faults", self.faults.api_post)
+            self.add("POST", "/api/faults/clear", self.faults.api_clear)
 
     def route(self, method: str, pattern: str):
         def deco(fn):
@@ -67,6 +84,15 @@ class JsonHTTPService:
 
             def log_message(self, fmt, *args):  # quiet; logging via Metrics
                 pass
+
+            def handle(self):
+                try:
+                    super().handle()
+                except (BrokenPipeError, ConnectionResetError):
+                    # the client vanished mid-response (its timeout fired,
+                    # or a fault dropped the link) — normal under failure
+                    # testing, not a server error worth a traceback
+                    pass
 
             def _trace_headers(self):
                 # every response — errors included — names the trace it
@@ -116,6 +142,54 @@ class JsonHTTPService:
                                  keep=path not in QUIET_TRACE_PATHS) as sp:
                     self._dispatch_traced(method, path, sp)
 
+            def _inject_fault(self, f) -> bool:
+                """Apply one armed fault (utils/faults.py FaultSpec).
+                Returns True when the request was consumed — no normal
+                dispatch should follow."""
+                import socket
+                if f.mode == "latency":
+                    time.sleep(f.delay_s)
+                    return False      # then handle the request normally
+                if f.delay_s:
+                    time.sleep(f.delay_s)
+                self.close_connection = True
+                if f.mode == "corrupt":
+                    body = b"#!<<injected corrupt body; not JSON>>"
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return True
+                if f.mode == "error":
+                    self._send_json(500, {"status": "error",
+                                          "message": "injected fault"})
+                    return True
+                if f.mode == "disconnect":
+                    # headers + a partial body, then a hard close: the
+                    # client fails mid-read (IncompleteRead)
+                    try:
+                        self.send_response(200)
+                        self.send_header("Content-Type", "application/json")
+                        self.send_header("Content-Length", "65536")
+                        self.end_headers()
+                        self.wfile.write(b'{"status": "succ')
+                        self.wfile.flush()
+                    except OSError:
+                        pass
+                elif f.mode == "crash":
+                    # kill the whole server: the listener closes, so
+                    # every later connect is refused — a crashed worker
+                    threading.Thread(target=service.shutdown,
+                                     daemon=True).start()
+                # reset / disconnect / crash: abort the connection with
+                # zero (further) response bytes
+                try:
+                    self.connection.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                return True
+
             def _drain_body(self):
                 # keep-alive (HTTP/1.1): an unread request body would be
                 # parsed as the NEXT request line on this connection —
@@ -136,6 +210,15 @@ class JsonHTTPService:
                     self._drain_body()
                     return send(401, {"status": "error",
                                       "message": "unauthorized"})
+                # fault harness — after auth, so unauthenticated traffic
+                # can neither trigger a crash fault nor consume a
+                # times-bounded schedule; never intercepts its own admin
+                # surface, or an armed "*" fault could not be cleared
+                if not path.startswith("/api/faults"):
+                    f = service.faults.intercept(path)
+                    if f is not None and self._inject_fault(f):
+                        sp.attrs["status"] = 0   # connection-level fault
+                        return
                 allowed = set()
                 for r in service.routes:
                     m = r.regex.match(path)
@@ -164,7 +247,12 @@ class JsonHTTPService:
                     except Exception as e:  # structured 500, like worker/app.py:133-137
                         return send(500, {"status": "error",
                                           "message": str(e)})
-                    if isinstance(result, tuple) and len(result) == 2 and \
+                    hdrs = None
+                    if isinstance(result, tuple) and len(result) == 3 and \
+                            isinstance(result[0], int) and \
+                            isinstance(result[2], dict):
+                        status, payload, hdrs = result
+                    elif isinstance(result, tuple) and len(result) == 2 and \
                             isinstance(result[0], int):
                         status, payload = result
                     else:
@@ -172,7 +260,7 @@ class JsonHTTPService:
                     if isinstance(payload, tuple):  # (bytes, content_type)
                         sp.attrs["status"] = status
                         return self._send_raw(status, payload[0], payload[1])
-                    return send(status, payload)
+                    return send(status, payload, hdrs)
                 self._drain_body()
                 if allowed:
                     # registered path, wrong method: 405 + Allow, not the
@@ -210,9 +298,12 @@ class JsonHTTPService:
         return self._server.server_address[1] if self._server else 0
 
     def shutdown(self):
-        if self._server:
-            self._server.shutdown()
-            self._server.server_close()
+        """Stop serving and close the listener. Idempotent — a crash
+        fault may already have shut the server before teardown runs."""
+        srv, self._server = self._server, None
+        if srv:
+            srv.shutdown()
+            srv.server_close()
 
 
 class _Streaming(Exception):

@@ -22,9 +22,10 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, Optional, Set
 
 import requests as http
 
@@ -42,28 +43,55 @@ HEALTH_TIMEOUT = 5
 UNLOAD_TIMEOUT = 10
 LOAD_TIMEOUT = 300
 INFER_TIMEOUT = 120
-# Budget the worker gets for the request itself: strictly less than the
+# The worker's own generation budget stays strictly less than the
 # master's HTTP timeout, so the worker 408s (and frees its batcher slot)
 # BEFORE the master gives up — the reference had the opposite relation
 # (master 120s vs worker holding gunicorn 300s, views.py:352 vs
-# worker/Dockerfile:47) and a timed-out generation kept running for nobody.
-WORKER_INFER_BUDGET = INFER_TIMEOUT - 5
+# worker/Dockerfile:47) and a timed-out generation kept running for
+# nobody. Computed per-Master from infer_timeout (worker_infer_budget).
 
 MAX_ATTEMPTS = 3          # reference: 1 attempt, terminal (views.py:364-378)
-FAILURE_STRIKES = 3       # reference: one strike (views.py:99-105)
+FAILURE_STRIKES = 3       # breaker trip threshold (reference: one strike
+                          # and terminal deactivation, views.py:99-105)
+# Failover retry backoff: base * 2^attempt, with up to +100% jitter so a
+# burst of requeues from one dead node doesn't re-dispatch in lockstep.
+RETRY_BACKOFF_BASE = float(os.environ.get("DLI_RETRY_BACKOFF_BASE", 0.5))
+RETRY_BACKOFF_MAX = float(os.environ.get("DLI_RETRY_BACKOFF_MAX", 30.0))
+
+
+class _NodeUnavailable(Exception):
+    """Worker is up but not taking work (draining, degraded slice, own
+    budget expired): failover to another node WITHOUT a breaker strike.
+    ``in_flight`` means the node still RUNS this request's generation —
+    the retry must return to it (join/replay), not fail over."""
+
+    def __init__(self, message: str, in_flight: bool = False):
+        super().__init__(message)
+        self.in_flight = in_flight
 
 
 class Master:
     def __init__(self, db_path: str = ":memory:", *,
                  dispatcher_threads: int = 4,
                  health_interval: float = 10.0,
-                 auth_key: Optional[str] = None):
+                 auth_key: Optional[str] = None,
+                 infer_timeout: float = INFER_TIMEOUT,
+                 retry_backoff_base: float = RETRY_BACKOFF_BASE):
         self.store = Store(db_path)
-        n = self.store.recover_stale_processing()
+        self.infer_timeout = infer_timeout
+        self.worker_infer_budget = max(1.0, infer_timeout - 5)
+        self.retry_backoff_base = retry_backoff_base
+        n = self.store.recover_stale_processing(max_attempts=MAX_ATTEMPTS)
         if n:
-            log.info("requeued %d request(s) stranded by a previous run", n)
+            log.info("recovered %d request(s) stranded by a previous run", n)
         self.metrics = Metrics()
         trace.set_service("master")
+        # Dispatch tags are the worker-side idempotency key, so they must
+        # be unique across master *instances*: request ids restart at 1
+        # for a fresh DB, and a bare id could replay another request's
+        # cached generation out of a long-lived worker.
+        import uuid
+        self._run_nonce = uuid.uuid4().hex[:8]
         self.health_interval = health_interval
         self._worker_auth = auth_key or os.environ.get("DLI_AUTH_KEY")
         self._inflight: Dict[int, int] = {}   # node_id -> in-flight count
@@ -110,6 +138,10 @@ class Master:
 
     # ---- worker RPC --------------------------------------------------
 
+    def _tag(self, req_id) -> str:
+        """Worker-side idempotency/cancel key for a request."""
+        return f"{self._run_nonce}:{req_id}"
+
     def _headers(self):
         h = ({"Authorization": f"Bearer {self._worker_auth}"}
              if self._worker_auth else {})
@@ -117,11 +149,29 @@ class Master:
         # worker's server span joins this request's timeline
         return trace.inject(h)
 
+    def _rpc_fault(self, path):
+        """Client-side fault point ``rpc:<path>`` (utils/faults.py): lets
+        the chaos harness simulate a network partition from the master's
+        side — the worker process never sees the request."""
+        f = self.service.faults.intercept(f"rpc:{path}")
+        if f is None:
+            return
+        if f.mode == "latency":
+            time.sleep(f.delay_s)
+            return
+        if f.delay_s:
+            time.sleep(f.delay_s)
+        if f.mode == "timeout":
+            raise http.exceptions.ReadTimeout("injected rpc timeout")
+        raise http.exceptions.ConnectionError("injected rpc fault")
+
     def _worker_get(self, node, path, timeout):
+        self._rpc_fault(path)
         return http.get(self.store.node_url(node) + path,
                         headers=self._headers(), timeout=timeout)
 
     def _worker_post(self, node, path, body, timeout):
+        self._rpc_fault(path)
         return http.post(self.store.node_url(node) + path, json=body,
                          headers=self._headers(), timeout=timeout)
 
@@ -148,6 +198,7 @@ class Master:
         if existing:
             self.store.update_node(existing["id"], is_active=1,
                                    consecutive_failures=0,
+                                   breaker_state="closed", draining=0,
                                    last_heartbeat=time.time(), info=info)
             return {"status": "success", "node_id": existing["id"],
                     "message": "node re-activated"}
@@ -186,6 +237,9 @@ class Master:
             nodes.append({
                 "id": n["id"], "name": n["name"], "host": n["host"],
                 "port": n["port"], "is_active": bool(n["is_active"]),
+                "breaker": n.get("breaker_state") or "closed",
+                "strikes": n["consecutive_failures"],
+                "draining": bool(n.get("draining")),
                 "last_heartbeat": n["last_heartbeat"],
                 "resources": info.get("resources"),
                 "loaded_models": info.get("loaded_models", []),
@@ -228,7 +282,7 @@ class Master:
         r = self._worker_post(node, "/load_shard", payload, LOAD_TIMEOUT)
         if r.status_code == 200:
             self.store.mark_plan_loaded(plan["id"], node["id"])
-        return r.status_code, r.json()
+        return _relay_json(r)
 
     def api_load_model(self, body):
         """Explicit model pre-load on a chosen or scheduled node."""
@@ -237,12 +291,8 @@ class Master:
         if node is None:
             return 503, {"status": "error", "message": "no active nodes"}
         r = self._worker_post(node, "/load_model", body, LOAD_TIMEOUT)
-        try:
-            payload = r.json()
-        except ValueError:
-            payload = {"status": "error", "message": r.text[:200]}
         self._refresh_node(node)
-        return r.status_code, payload
+        return _relay_json(r)
 
     # ---- inference API -----------------------------------------------
 
@@ -302,7 +352,7 @@ class Master:
         if node is not None:
             try:
                 w = self._worker_post(node, "/cancel",
-                                      {"request_tag": str(req_id)}, 10)
+                                      {"request_tag": self._tag(req_id)}, 10)
                 if w.status_code == 200:
                     return {"status": "success",
                             "message": "cancel relayed to worker"}
@@ -321,13 +371,16 @@ class Master:
 
     # ---- observability -----------------------------------------------
 
-    def _scrape_workers(self, path: str):
+    def _scrape_workers(self, path: str, nodes=None):
         """Fetch ``path`` from every ACTIVE node concurrently (a dead node
         otherwise serializes its full HEALTH_TIMEOUT into the handler and
         the 10s dashboard poll piles up behind it). Returns
-        [(node, response-or-None, error-or-None)] for active nodes."""
+        [(node, response-or-None, error-or-None)]. Pass ``nodes`` to
+        probe an explicit set (the health loop probes inactive nodes too
+        — that is how a tripped breaker finds its way back)."""
         from concurrent.futures import ThreadPoolExecutor
-        nodes = self.store.list_nodes(active_only=True)
+        if nodes is None:
+            nodes = self.store.list_nodes(active_only=True)
         if not nodes:
             return []
 
@@ -398,18 +451,55 @@ class Master:
         info = json.loads(node.get("info") or "{}")
         return {m["name"] for m in info.get("loaded_models", [])}
 
-    def _pick_node(self, model: Optional[str]):
-        """Least-loaded active node, preferring ones with the model already
-        loaded (reference: always .first(), views.py:389-391)."""
-        nodes = self.store.list_nodes(active_only=True)
-        if not nodes:
-            return None
+    def _pick_node(self, model: Optional[str],
+                   exclude: Optional[Set[int]] = None,
+                   reserve: bool = False,
+                   prefer: Optional[int] = None):
+        """Least-loaded schedulable node, preferring ones with the model
+        already loaded (reference: always .first(), views.py:389-391).
+
+        Schedulable = breaker not open AND not draining. A half-open
+        node admits at most ONE in-flight request — the probe whose
+        outcome closes or re-opens the breaker. Nodes in ``exclude``
+        (ones this request already failed on) are used only when no
+        other node qualifies: better the suspect node than a spurious
+        terminal failure on a single-node cluster.
+
+        ``reserve=True`` increments the node's in-flight count inside the
+        same lock as the selection (the caller MUST decrement when done)
+        — without it two dispatcher threads could both pass the one-probe
+        check on a half-open node and send two concurrent probes.
+
+        ``prefer`` pins the choice to that node when it is schedulable
+        and not excluded: a timeout retry goes back to the node that
+        still holds the in-flight generation (idempotency join/replay)
+        instead of re-generating on an idle-looking peer.
+        """
+        exclude = exclude or set()
+        nodes = [n for n in self.store.list_nodes(active_only=True)
+                 if not n.get("draining")]
         with self._inflight_lock:
             def load_key(n):
                 return self._inflight.get(n["id"], 0)
-            have = [n for n in nodes if model and model in self._node_models(n)]
-            pool = have or nodes
-            return min(pool, key=load_key)
+
+            def probe_ok(n):
+                return ((n.get("breaker_state") or "closed") != "half_open"
+                        or self._inflight.get(n["id"], 0) == 0)
+
+            usable = [n for n in nodes if probe_ok(n)]
+            for pool in ([n for n in usable if n["id"] not in exclude],
+                         usable):
+                if not pool:
+                    continue
+                pinned = [n for n in pool if n["id"] == prefer]
+                have = pinned or [n for n in pool
+                                  if model and model in self._node_models(n)]
+                chosen = min(have or pool, key=load_key)
+                if reserve:
+                    self._inflight[chosen["id"]] = \
+                        self._inflight.get(chosen["id"], 0) + 1
+                return chosen
+        return None
 
     def _refresh_node(self, node):
         try:
@@ -442,15 +532,38 @@ class Master:
     def _trace_done(self, req_id: int):
         self._trace_ctx.pop(req_id, None)
 
+    def _backoff(self, attempts: int) -> float:
+        """Exponential backoff with full jitter for the next attempt;
+        the cap bounds the jittered value, so DLI_RETRY_BACKOFF_MAX is a
+        real ceiling."""
+        d = self.retry_backoff_base * (2 ** (attempts + 1))
+        return min(RETRY_BACKOFF_MAX, d * (1.0 + random.random()))
+
     def _execute_on_node(self, req) -> bool:
-        node = self._pick_node(req["model_name"])
+        excluded = set(req.get("excluded_nodes") or [])
+        # a retry whose previous node is NOT excluded got there via a
+        # pure timeout: that node still holds the in-flight generation,
+        # so pin the retry to it (join/replay beats re-generating)
+        prefer = (req.get("node_id")
+                  if req.get("node_id") and req["node_id"] not in excluded
+                  else None)
+        node = self._pick_node(req["model_name"], exclude=excluded,
+                               reserve=True, prefer=prefer)
         if node is None:
-            self.store.mark_failed(req["id"], "no active worker nodes")
-            self._trace_done(req["id"])
+            # nothing schedulable right now (all breakers open / nodes
+            # draining): park instead of failing — at least a health
+            # interval and a half, so the loop's half-open recovery edge
+            # gets a chance to run before the attempt budget burns down
+            if req["attempts"] + 1 < MAX_ATTEMPTS:
+                self.store.requeue(req["id"],
+                                   delay_s=max(self._backoff(req["attempts"]),
+                                               self.health_interval * 1.5))
+                self.metrics.inc("requests_requeued")
+            else:
+                self.store.mark_failed(req["id"], "no active worker nodes")
+                self._trace_done(req["id"])
             return False
-        nid = node["id"]
-        with self._inflight_lock:
-            self._inflight[nid] = self._inflight.get(nid, 0) + 1
+        nid = node["id"]   # in-flight slot already reserved by _pick_node
         try:
             if req["model_name"] not in self._node_models(node):
                 # lazy load, like reference views.py:397-401 — random init is
@@ -462,7 +575,9 @@ class Master:
                 if req["sampling"].get("checkpoint_path"):
                     body["checkpoint_path"] = req["sampling"]["checkpoint_path"]
                 r = self._worker_post(node, "/load_model", body, LOAD_TIMEOUT)
-                if 400 <= r.status_code < 500:
+                if r.status_code == 503:
+                    raise _NodeUnavailable(f"load refused: {r.text[:200]}")
+                if 400 <= r.status_code < 500 and r.status_code != 408:
                     # user error (unknown model, bad request): terminal, and
                     # NOT the node's fault — no strike, no retry
                     self.store.mark_failed(req["id"],
@@ -478,9 +593,11 @@ class Master:
                 "prompt": req["prompt"],
                 "sampling": req["sampling"],
                 # worker-side generation budget < our HTTP timeout, and a
-                # tag so we (or an operator) can cancel mid-flight
-                "timeout": WORKER_INFER_BUDGET,
-                "request_tag": str(req["id"]),
+                # tag that makes dispatch idempotent: the worker caches
+                # the completed result under it, so a timeout retry
+                # replays the generation instead of re-running it
+                "timeout": self.worker_infer_budget,
+                "request_tag": self._tag(req["id"]),
             }
             if req.get("max_length") is not None:
                 infer_body["max_length"] = req["max_length"]
@@ -495,9 +612,22 @@ class Master:
                         attrs={"node_id": nid, "host": node["host"],
                                "port": node["port"]}):
                     r = self._worker_post(node, "/inference", infer_body,
-                                          INFER_TIMEOUT)
+                                          self.infer_timeout)
             finally:
                 self._processing.pop(req["id"], None)
+            if r.status_code in (503, 408):
+                # 503: draining / degraded slice — up but not taking
+                # work. 408: the worker's own budget expired (busy, not
+                # broken). Neither is the node's *fault*: failover
+                # without a strike. An in_flight-flagged 408 (idempotency
+                # join timed out) additionally pins the retry here.
+                try:
+                    still = bool(r.json().get("in_flight"))
+                except ValueError:
+                    still = False
+                raise _NodeUnavailable(
+                    f"worker unavailable ({r.status_code}): {r.text[:200]}",
+                    in_flight=still)
             if 400 <= r.status_code < 500:
                 self.store.mark_failed(req["id"],
                                        f"rejected: {r.text[:200]}")
@@ -507,56 +637,137 @@ class Master:
             if r.status_code != 200:
                 raise RuntimeError(f"inference failed: {r.text[:200]}")
             data = r.json()
+            prev = req.get("node_id")
+            if prev and prev != nid:
+                # an earlier timed-out attempt may have left a
+                # generation running on another node; it completed here
+                # instead, so stop that orphan from generating for
+                # nobody (best-effort — 404 if it already finished)
+                prev_node = self.store.get_node(prev)
+                if prev_node:
+                    try:
+                        self._worker_post(prev_node, "/cancel",
+                                          {"request_tag":
+                                           self._tag(req["id"])}, 10)
+                    except Exception:
+                        pass
             self.store.mark_completed(
                 req["id"], data.get("result", ""), nid,
                 data.get("execution_time", 0.0),
                 data.get("tokens_per_s", 0.0))
             self.metrics.inc("requests_completed")
+            if data.get("idempotent"):
+                # a retry hit the worker's completed-result cache: the
+                # generation ran exactly once despite >1 dispatch
+                self.metrics.inc("requests_idempotent_replayed")
             self.metrics.observe("request_latency",
                                  time.time() - req["created_at"])
             self._trace_done(req["id"])
+            self._node_success(node)
             return True
         except Exception as e:
             log.warning("request %d failed on node %d: %s", req["id"], nid, e)
             self.metrics.inc("requests_errored")
-            if isinstance(e, http.exceptions.Timeout):
-                # our timeout fired first (clock skew / slow network):
-                # best-effort cancel so the worker stops generating for
-                # nobody and frees its batcher slot
-                try:
-                    self._worker_post(node, "/cancel",
-                                      {"request_tag": str(req["id"])}, 10)
-                except Exception:
-                    pass
-            if req["attempts"] + 1 < MAX_ATTEMPTS:
-                self.store.requeue(req["id"])   # failover retry
+            is_timeout = isinstance(e, http.exceptions.Timeout)
+            unavailable = isinstance(e, _NodeUnavailable)
+            terminal = req["attempts"] + 1 >= MAX_ATTEMPTS
+            if not terminal:
+                # Failover retry: exclude this node for the rest of the
+                # request's life, park the next attempt behind
+                # exponential backoff + jitter (an unavailable node gets
+                # no backoff — another node can take it immediately).
+                # A pure master-side timeout — or a join 408 flagged
+                # in_flight — does NOT exclude: the same node still holds
+                # the in-flight generation, and the retry (pinned back to
+                # it via the recorded node_id) joins it / replays its
+                # cached result instead of re-generating on a peer.
+                sticky = is_timeout or getattr(e, "in_flight", False)
+                # Delay policy: a sticky retry waits out the backoff so
+                # the generation it intends to join/replay has time to
+                # finish (immediate re-joins would burn the attempt
+                # budget in seconds). A plain unavailable (503/408)
+                # fails over with zero delay ONLY when a different node
+                # can actually take it — on a single-node cluster the
+                # fallback would hand the same draining node straight
+                # back, so park on the health loop's cadence instead.
+                if sticky or not unavailable:
+                    delay = self._backoff(req["attempts"])
+                elif any(n["id"] not in excluded and n["id"] != nid
+                         and not n.get("draining")
+                         for n in self.store.list_nodes(active_only=True)):
+                    delay = 0.0
+                else:
+                    delay = max(self._backoff(req["attempts"]),
+                                self.health_interval * 1.5)
+                self.store.requeue(
+                    req["id"],
+                    excluded_node_id=None if sticky else nid,
+                    delay_s=delay, last_node_id=nid)
                 self.metrics.inc("requests_requeued")
                 self._wake.set()
             else:
                 self.store.mark_failed(req["id"], str(e))
                 self._trace_done(req["id"])
+                if is_timeout:
+                    # terminal timeout: nobody will ever claim the
+                    # result — best-effort cancel so the worker stops
+                    # generating for nobody. (With retries left the
+                    # generation KEEPS running: its result lands in the
+                    # worker's idempotency cache for the retry.)
+                    try:
+                        self._worker_post(node, "/cancel",
+                                          {"request_tag":
+                                           self._tag(req["id"])}, 10)
+                    except Exception:
+                        pass
             # A read timeout means the worker is slow/busy (its generate
-            # lock serializes requests), not dead — striking it would
-            # deactivate healthy nodes under load. Connection-level errors
-            # do strike.
-            if not isinstance(e, http.exceptions.Timeout):
+            # lock serializes requests), not dead; a 503/408 means it is
+            # managing its own load. Striking either would deactivate
+            # healthy nodes. Connection-level errors do count toward the
+            # breaker.
+            if not (is_timeout or unavailable):
                 self._node_failure(node)
             return False
         finally:
             with self._inflight_lock:
                 self._inflight[nid] = max(0, self._inflight.get(nid, 1) - 1)
 
+    # ---- circuit breaker ---------------------------------------------
+
     def _node_failure(self, node):
+        """Record a node-fault failure: closed --N strikes--> open; a
+        failed half-open probe re-opens immediately (the reference
+        deactivated on ONE strike, forever — SURVEY.md §3.4)."""
         n = self.store.get_node(node["id"])
         if not n:
             return
+        state = n.get("breaker_state") or "closed"
         strikes = n["consecutive_failures"] + 1
         fields = {"consecutive_failures": strikes}
-        if strikes >= FAILURE_STRIKES:
-            fields["is_active"] = 0
-            log.warning("node %d deactivated after %d strikes",
-                        n["id"], strikes)
+        if state == "half_open" or strikes >= FAILURE_STRIKES:
+            fields.update(breaker_state="open", is_active=0,
+                          breaker_opened_at=time.time())
+            if state != "open":
+                self.metrics.inc("breaker_opened")
+                log.warning("node %d breaker OPEN (%s, %d strikes)",
+                            n["id"], state, strikes)
         self.store.update_node(n["id"], **fields)
+
+    def _node_success(self, node):
+        """A real request completed on the node: a half-open probe
+        success closes the breaker; accumulated strikes clear."""
+        n = self.store.get_node(node["id"])
+        if not n:
+            return
+        state = n.get("breaker_state") or "closed"
+        if state == "closed" and not n["consecutive_failures"]:
+            return   # steady state: skip the DB write on the hot path
+        if state == "half_open":
+            self.metrics.inc("breaker_closed")
+            log.info("node %d breaker CLOSED (half-open probe succeeded)",
+                     n["id"])
+        self.store.update_node(n["id"], breaker_state="closed",
+                               consecutive_failures=0, is_active=1)
 
     # ---- background loops --------------------------------------------
 
@@ -571,24 +782,62 @@ class Master:
 
     def _health_loop(self):
         """Push-based monitoring with auto-reactivation — the upgrade over
-        the reference's UI-driven polls (SURVEY.md §3.4)."""
+        the reference's UI-driven polls (SURVEY.md §3.4). Probes run
+        concurrently through _scrape_workers: a dead node used to
+        serialize its full HEALTH_TIMEOUT into the sweep, so a few dead
+        nodes blew the health interval and delayed detection for the
+        healthy ones."""
         while not self._stop.is_set():
-            for n in self.store.list_nodes():
-                if self._stop.is_set():
-                    break
-                try:
-                    r = self._worker_get(n, "/health", HEALTH_TIMEOUT)
-                    r.raise_for_status()
-                    self.store.update_node(
-                        n["id"], info=r.json(), is_active=1,
-                        consecutive_failures=0, last_heartbeat=time.time())
-                except Exception:
-                    self._node_failure(n)
+            self._health_sweep()
             # queue-depth gauge on the monitor's cadence, not per submit
             # (counts() is an aggregate query over the requests table)
             self.metrics.gauge("queue_pending",
                                self.store.counts().get("pending", 0))
             self._stop.wait(self.health_interval)
+
+    def _health_sweep(self):
+        """One concurrent probe pass over EVERY node (inactive included:
+        an open breaker has no other road back). Probe outcomes drive
+        the breaker state machine's recovery edge — open + reachable ->
+        half_open; real request traffic closes it from there — and the
+        worker-declared draining flag."""
+        nodes = self.store.list_nodes()
+        by_state = {"closed": 0, "half_open": 0, "open": 0}
+        draining_n = 0
+        for n, r, err in self._scrape_workers("/health", nodes=nodes):
+            if self._stop.is_set():
+                return
+            state = n.get("breaker_state") or "closed"
+            info = None
+            if err is None:
+                try:
+                    info = r.json()
+                except ValueError:
+                    err = "unparseable health body"
+            if info is None:
+                self._node_failure(n)
+                state = ((self.store.get_node(n["id"]) or n)
+                         .get("breaker_state") or "closed")
+            else:
+                draining = 1 if info.get("status") == "draining" else 0
+                fields = {"info": info, "last_heartbeat": time.time(),
+                          "draining": draining}
+                if state == "open":
+                    # the fault cleared: schedulable again, but only as
+                    # a probe until a real request succeeds
+                    state = "half_open"
+                    fields.update(breaker_state="half_open", is_active=1)
+                    self.metrics.inc("breaker_half_opened")
+                    log.info("node %d breaker HALF-OPEN "
+                             "(health probe succeeded)", n["id"])
+                elif state == "closed":
+                    fields.update(is_active=1, consecutive_failures=0)
+                self.store.update_node(n["id"], **fields)
+                draining_n += draining
+            by_state[state] = by_state.get(state, 0) + 1
+        for s, count in by_state.items():
+            self.metrics.gauge(f"breaker_{s}_nodes", count)
+        self.metrics.gauge("draining_nodes", draining_n)
 
     # ---- lifecycle ---------------------------------------------------
 
@@ -612,6 +861,19 @@ class Master:
         self._stop.set()
         self._wake.set()
         self.service.shutdown()
+
+
+def _relay_json(r):
+    """(status, payload) from a relayed worker response. An unparseable
+    body (corrupt response, proxy error page) becomes a structured 502
+    with the offending body truncated — not a raw ValueError out of
+    ``r.json()`` that the HTTP layer turns into an opaque 500."""
+    try:
+        return r.status_code, r.json()
+    except ValueError:
+        return 502, {"status": "error",
+                     "message": "worker returned unparseable response "
+                                f"(HTTP {r.status_code}): {r.text[:200]}"}
 
 
 def _strip(name: str) -> str:

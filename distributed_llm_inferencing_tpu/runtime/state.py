@@ -30,6 +30,9 @@ CREATE TABLE IF NOT EXISTS nodes (
     port INTEGER NOT NULL,
     is_active INTEGER DEFAULT 0,
     consecutive_failures INTEGER DEFAULT 0,
+    breaker_state TEXT DEFAULT 'closed',
+    breaker_opened_at REAL,
+    draining INTEGER DEFAULT 0,
     last_heartbeat REAL,
     added_at REAL,
     info TEXT DEFAULT '{}'
@@ -51,6 +54,8 @@ CREATE TABLE IF NOT EXISTS requests (
     error TEXT,
     node_id INTEGER,
     attempts INTEGER DEFAULT 0,
+    excluded_nodes TEXT DEFAULT '[]',
+    next_attempt_at REAL DEFAULT 0,
     max_new_tokens INTEGER,
     max_length INTEGER,
     sampling TEXT DEFAULT '{}',
@@ -61,6 +66,17 @@ CREATE TABLE IF NOT EXISTS requests (
     tokens_per_s REAL
 );
 """
+
+# Columns added after the seed schema: an existing on-disk DB (the
+# master's sqlite file survives restarts by design) is upgraded in
+# place at open.
+_MIGRATIONS = {
+    "nodes": (("breaker_state", "TEXT DEFAULT 'closed'"),
+              ("breaker_opened_at", "REAL"),
+              ("draining", "INTEGER DEFAULT 0")),
+    "requests": (("excluded_nodes", "TEXT DEFAULT '[]'"),
+                 ("next_attempt_at", "REAL DEFAULT 0")),
+}
 
 
 def _row_to_dict(cur, row):
@@ -74,6 +90,13 @@ class Store:
         self._db.execute("PRAGMA journal_mode=WAL")
         with self._lock, self._db:
             self._db.executescript(_SCHEMA)
+            for table, cols in _MIGRATIONS.items():
+                have = {r[1] for r in self._db.execute(
+                    f"PRAGMA table_info({table})")}
+                for col, decl in cols:
+                    if col not in have:
+                        self._db.execute(
+                            f"ALTER TABLE {table} ADD COLUMN {col} {decl}")
 
     def _all(self, sql, args=()) -> List[Dict[str, Any]]:
         with self._lock:
@@ -162,35 +185,80 @@ class Store:
         r = self._one("SELECT * FROM requests WHERE id=?", (req_id,))
         if r:
             r["sampling"] = json.loads(r["sampling"] or "{}")
+            r["excluded_nodes"] = json.loads(r.get("excluded_nodes") or "[]")
         return r
 
     def claim_next_pending(self) -> Optional[Dict[str, Any]]:
-        """Atomically move the oldest pending request to processing."""
+        """Atomically move the oldest *due* pending request to processing.
+        A request parked by a backoff retry (``next_attempt_at`` in the
+        future) is invisible until its delay elapses — the dispatcher's
+        idle poll re-examines the queue on its own cadence."""
         with self._lock:
             row = self._one(
                 "SELECT * FROM requests WHERE status='pending' "
-                "ORDER BY id LIMIT 1")
+                "AND next_attempt_at<=? ORDER BY id LIMIT 1",
+                (time.time(),))
             if row is None:
                 return None
             self._exec(
                 "UPDATE requests SET status='processing', started_at=? "
                 "WHERE id=?", (time.time(), row["id"]))
             row["sampling"] = json.loads(row["sampling"] or "{}")
+            row["excluded_nodes"] = json.loads(
+                row.get("excluded_nodes") or "[]")
             return row
 
-    def requeue(self, req_id: int):
-        self._exec("UPDATE requests SET status='pending', "
-                   "attempts=attempts+1 WHERE id=?", (req_id,))
+    def requeue(self, req_id: int, excluded_node_id: Optional[int] = None,
+                delay_s: float = 0.0, last_node_id: Optional[int] = None):
+        """Failover retry: back to pending with the attempt counted, the
+        failed node recorded for cross-attempt exclusion, and the next
+        attempt parked ``delay_s`` into the future (backoff).
+        ``last_node_id`` records where this attempt ran (the row's
+        node_id) — a timeout retry prefers that node, since it still
+        holds the in-flight generation."""
+        with self._lock, self._db:
+            extra = ""
+            args: list = []
+            if excluded_node_id is not None:
+                row = self._one("SELECT excluded_nodes FROM requests "
+                                "WHERE id=?", (req_id,))
+                seen = json.loads((row or {}).get("excluded_nodes") or "[]")
+                if excluded_node_id not in seen:
+                    seen.append(excluded_node_id)
+                extra += ", excluded_nodes=?"
+                args.append(json.dumps(seen))
+            if last_node_id is not None:
+                extra += ", node_id=?"
+                args.append(last_node_id)
+            self._db.execute(
+                "UPDATE requests SET status='pending', attempts=attempts+1, "
+                f"next_attempt_at=?{extra} WHERE id=?",
+                (time.time() + max(0.0, delay_s), *args, req_id))
 
-    def recover_stale_processing(self) -> int:
+    def recover_stale_processing(self, max_attempts: Optional[int] = None
+                                 ) -> int:
         """Requeue requests stranded in 'processing' by a master crash —
         the reference left these stuck forever (no recovery path at all,
-        SURVEY.md §5.3). Called once at master startup."""
+        SURVEY.md §5.3). Called once at master startup.
+
+        Recovery counts as an attempt: a poison request that kills its
+        worker (or the master) must not be re-dispatched forever across
+        restarts, so anything at ``max_attempts`` fails permanently here
+        instead of re-entering the queue.
+        """
         with self._lock, self._db:
+            failed = 0
+            if max_attempts is not None:
+                cur = self._db.execute(
+                    "UPDATE requests SET status='failed', completed_at=?, "
+                    "error='abandoned after repeated crash recovery "
+                    "(poison request?)' WHERE status='processing' "
+                    "AND attempts+1>=?", (time.time(), max_attempts))
+                failed = cur.rowcount
             cur = self._db.execute(
-                "UPDATE requests SET status='pending' "
-                "WHERE status='processing'")
-            return cur.rowcount
+                "UPDATE requests SET status='pending', attempts=attempts+1, "
+                "next_attempt_at=0 WHERE status='processing'")
+            return cur.rowcount + failed
 
     def mark_completed(self, req_id: int, result: str, node_id: int,
                        execution_time: float, tokens_per_s: float):

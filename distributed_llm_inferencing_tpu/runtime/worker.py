@@ -14,6 +14,7 @@ JAX program (runtime/engine.py) instead of HF ``generate`` on torch
 
 from __future__ import annotations
 
+import collections
 import os
 import threading
 import time
@@ -32,6 +33,11 @@ from distributed_llm_inferencing_tpu.utils.metrics import Metrics
 from distributed_llm_inferencing_tpu.utils.tokenizer import load_tokenizer
 
 log = setup_logging("worker")
+
+# Completed-result cache size for idempotent dispatch: the master
+# retries with the same request_tag after a timeout, and the cached
+# result makes at-least-once delivery execute exactly once.
+IDEM_CACHE = int(os.environ.get("DLI_IDEM_CACHE", 256))
 
 
 class LoadedModel:
@@ -68,6 +74,8 @@ class WorkerAgent:
         s.add("POST", "/inference", self.inference)
         s.add("POST", "/inference_stream", self.inference_stream)
         s.add("POST", "/cancel", self.cancel)
+        s.add("POST", "/drain", self.drain)
+        s.add("POST", "/undrain", self.undrain)
         s.add("POST", "/profile/start", self.profile_start)
         s.add("POST", "/profile/stop", self.profile_stop)
         s.add("GET", "/memory_profile", self.memory_profile)
@@ -78,6 +86,18 @@ class WorkerAgent:
         # on its own timeout, or an operator) can cancel and free the slot
         self._tagged: Dict[str, object] = {}
         self._tagged_lock = threading.Lock()
+        # Idempotent dispatch (at-least-once delivery, exactly-once
+        # execution): completed results keyed by request_tag in a bounded
+        # LRU, plus an in-flight registry so a duplicate dispatch JOINS
+        # the running execution instead of re-generating.
+        self._idem: "collections.OrderedDict[str, dict]" = \
+            collections.OrderedDict()
+        self._idem_lock = threading.Lock()
+        self._inflight_tags: Dict[str, threading.Event] = {}
+        # graceful drain: finish in-flight work, 503 new inference
+        self._draining = False
+        self._active = 0
+        self._active_cv = threading.Condition()
 
     # ---- endpoints ---------------------------------------------------
 
@@ -115,7 +135,7 @@ class WorkerAgent:
                                    "mesh": m.engine.mesh_spec.axis_sizes(),
                                    "max_seq": m.engine.max_seq})
         return {
-            "status": "online",
+            "status": "draining" if self._draining else "online",
             "uptime_s": time.time() - self.started,
             "resources": {"cpu": cpu, "memory": mem, "devices": devices,
                           "device": jax.default_backend()},
@@ -264,6 +284,8 @@ class WorkerAgent:
                      "stats": stats}
 
     def load_model(self, body):
+        if self._draining:
+            return self._refuse_draining()
         with self.metrics.time("load_model"):
             return self._do_load(body)
 
@@ -274,6 +296,8 @@ class WorkerAgent:
         parallel/plan.py) rather than a weight-file directory — loading a
         'shard' is loading the model with that plan's mesh.
         """
+        if self._draining:
+            return self._refuse_draining()
         plan = body.get("plan")
         if not plan:
             return 400, {"status": "error",
@@ -349,16 +373,141 @@ class WorkerAgent:
         }
         return m, prompt, sp, max_new, gen_kw
 
+    # ---- drain / idempotency plumbing --------------------------------
+
+    def _refuse_draining(self):
+        return 503, {"status": "error", "draining": True,
+                     "message": "worker is draining; retry another node"}, \
+               {"Retry-After": "5"}
+
+    def _try_begin_inference(self) -> bool:
+        """Atomically either register an in-flight inference or refuse
+        because a drain is in progress. The draining check and the
+        active-count increment share one lock: without that, a request
+        could pass the check before drain set the flag yet not be
+        counted when drain samples the in-flight total — and drain
+        would report idle with work about to start."""
+        with self._active_cv:
+            if self._draining:
+                return False
+            self._active += 1
+        return True
+
+    def _end_inference(self):
+        with self._active_cv:
+            self._active -= 1
+            self._active_cv.notify_all()
+
+    def _busy_count(self) -> int:
+        """Requests still owed an answer. A batched HTTP request shows
+        up in BOTH the handler count and its batcher's inflight() —
+        max() de-duplicates that (it is exact for idle detection: zero
+        iff both are zero) while still covering batcher requests whose
+        handler already gave up (cancelled/abandoned tags)."""
+        with self._active_cv:
+            n = self._active
+        with self._models_lock:
+            models = list(self.models.values())
+        batched = sum(m.batcher.inflight() for m in models
+                      if m.batcher is not None)
+        return max(n, batched)
+
+    def _wait_idle(self, timeout: float) -> bool:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self._busy_count() == 0:
+                return True
+            time.sleep(0.05)
+        return self._busy_count() == 0
+
+    def drain(self, body):
+        """Graceful drain — no reference counterpart (its only lifecycle
+        was kill -9). Marks the worker draining: new inference gets 503
+        with Retry-After (the master fails over without recording a
+        strike, runtime/master.py), in-flight batcher/engine requests
+        run to completion, and this call returns once idle (or when
+        ``timeout`` seconds elapse, reporting what is still in flight).
+        """
+        with self._active_cv:   # fences against _try_begin_inference
+            self._draining = True
+        self.metrics.gauge("draining", 1)
+        idle = self._wait_idle(float(body.get("timeout", 30)))
+        return {"status": "success", "drained": idle,
+                "in_flight": self._busy_count()}
+
+    def undrain(self, body):
+        """Re-open a drained worker for new inference."""
+        with self._active_cv:
+            self._draining = False
+        self.metrics.gauge("draining", 0)
+        return {"status": "success"}
+
     def inference(self, body):
         # semantic span under the HTTP server span; the batcher/engine
         # below parent their own spans to it (contextvar or req.trace_ctx)
-        with trace.get_tracer().span(
-                "worker.inference",
-                attrs={"model": str(body.get("model_name")),
-                       "tag": str(body.get("request_tag") or "")}):
-            return self._inference_inner(body)
+        if not self._try_begin_inference():
+            return self._refuse_draining()
+        try:
+            with trace.get_tracer().span(
+                    "worker.inference",
+                    attrs={"model": str(body.get("model_name")),
+                           "tag": str(body.get("request_tag") or "")}):
+                return self._inference_idempotent(body)
+        finally:
+            self._end_inference()
 
-    def _inference_inner(self, body):
+    def _inference_idempotent(self, body):
+        """Exactly-once execution around _inference_execute: a duplicate
+        dispatch (master timeout retry — at-least-once delivery) either
+        replays the cached result or joins the still-running execution
+        and waits for ITS result, so the generation never runs twice for
+        one request_tag."""
+        tag = str(body["request_tag"]) if body.get("request_tag") else None
+        if tag is None:
+            return self._inference_execute(body)
+        deadline = time.time() + float(body.get("timeout", 300))
+        my_ev = None
+        while True:
+            with self._idem_lock:
+                cached = self._idem.get(tag)
+                if cached is not None:
+                    self._idem.move_to_end(tag)
+                    ev = None
+                else:
+                    ev = self._inflight_tags.get(tag)
+                    if ev is None:
+                        my_ev = self._inflight_tags[tag] = threading.Event()
+            if cached is not None:
+                self.metrics.inc("idempotent_hits")
+                return dict(cached, idempotent=True)
+            if ev is None:
+                break      # we own the execution
+            # join the in-flight execution instead of re-generating
+            self.metrics.inc("idempotent_joins")
+            if not ev.wait(timeout=max(0.0, deadline - time.time())):
+                # in_flight tells the master the generation is STILL
+                # running here — retry this node (join again later), do
+                # not fail over and re-generate on a peer
+                return 408, {"status": "error", "in_flight": True,
+                             "message": f"execution for tag {tag!r} still "
+                                        "running past the request budget"}
+            # loop: either its result is cached now (replay it), or the
+            # original attempt failed — then we take ownership and re-run
+        res = None
+        try:
+            res = self._inference_execute(body)
+            return res
+        finally:
+            with self._idem_lock:
+                if isinstance(res, dict):   # 200 success: cache for replays
+                    self._idem[tag] = res
+                    self._idem.move_to_end(tag)
+                    while len(self._idem) > IDEM_CACHE:
+                        self._idem.popitem(last=False)
+                self._inflight_tags.pop(tag, None)
+                my_ev.set()   # joiners re-check the cache under the lock
+
+    def _inference_execute(self, body):
         t0 = time.time()
         try:
             m, prompt, sp, max_new, gen_kw = self._prep_inference(body)
@@ -477,6 +626,14 @@ class WorkerAgent:
 
     def inference_stream(self, body, _request=None):
         """SSE streaming decode — absent from the reference (SURVEY.md §2.3)."""
+        if not self._try_begin_inference():
+            return self._refuse_draining()
+        try:
+            return self._inference_stream_inner(body, _request)
+        finally:
+            self._end_inference()
+
+    def _inference_stream_inner(self, body, _request=None):
         try:
             # validate up front so bad requests get a proper 400, matching
             # /inference; execution still re-preps inside the stream thread

@@ -1,0 +1,179 @@
+"""Deterministic fault injection for the serving runtime.
+
+The reference system's distributed story collapsed on the first fault —
+one strike deactivated a node forever and a timed-out generation kept
+running for nobody (SURVEY.md §3.4, §5.3) — and nothing could *test*
+that, because no part of the stack could simulate a crashed worker or a
+flaky network. This module is the missing harness: named fault points
+checked at the HTTP boundary (runtime/httpd.py) and inside the master's
+worker-RPC client (runtime/master.py), armed either from the
+environment or at runtime via ``POST /api/faults``.
+
+A fault spec is a JSON dict:
+
+    {"point": "/inference",      # fnmatch pattern against the fault point
+     "mode":  "latency",         # what to do when it fires (below)
+     "delay_s": 2.0,             # latency/latency+mode extra delay
+     "times": 3,                 # fire at most N times (None = forever)
+     "after": 1,                 # skip the first N matching hits
+     "p": 1.0,                   # fire probability (seeded RNG)
+     "service": "worker"}        # optional: only this service name
+
+Server-side points are request paths (``/inference``, ``/health``, or a
+glob like ``/inference*``); the master's RPC client checks points named
+``rpc:<path>`` (e.g. ``rpc:/inference``) so a network partition can be
+simulated from the caller's side without touching the worker process.
+
+Modes (server side, runtime/httpd.py):
+
+- ``latency``      sleep ``delay_s`` then handle the request normally
+- ``reset``        close the connection before any response bytes
+                   (client sees connection reset / empty reply)
+- ``disconnect``   send headers + a partial body, then close mid-response
+- ``corrupt``      respond 200 with a non-JSON body
+- ``error``        respond 500 with a structured JSON error
+- ``crash``        drop the connection AND kill the whole HTTP server
+                   (listener closed: later connects are refused) —
+                   "worker crash on Nth request" via ``after``
+
+Modes (client side, master._worker_get/_worker_post):
+
+- ``latency``      sleep ``delay_s`` then make the real call
+- ``reset``        raise ``requests.exceptions.ConnectionError``
+- ``timeout``      raise ``requests.exceptions.ReadTimeout``
+
+Reproducibility: probabilistic specs draw from one ``random.Random``
+seeded at arm time (``seed`` in the arm body, or ``DLI_FAULTS_SEED``),
+so a failing chaos run replays with the same schedule.
+
+Environment arming (read once at service construction):
+
+    DLI_FAULTS='[{"point":"/inference","mode":"corrupt","times":1}]'
+    DLI_FAULTS_SEED=0
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+import os
+import threading
+from typing import Dict, List, Optional
+
+SERVER_MODES = ("latency", "reset", "disconnect", "corrupt", "error",
+                "crash")
+CLIENT_MODES = ("latency", "reset", "timeout")
+MODES = tuple(sorted(set(SERVER_MODES) | set(CLIENT_MODES)))
+
+
+class FaultSpec:
+    """One armed fault: match state + firing budget."""
+
+    def __init__(self, raw: dict):
+        if not isinstance(raw, dict):
+            raise ValueError(f"fault spec must be an object, got {raw!r}")
+        self.point = str(raw.get("point") or "")
+        if not self.point:
+            raise ValueError("fault spec needs a 'point'")
+        self.mode = str(raw.get("mode") or "")
+        if self.mode not in MODES:
+            raise ValueError(f"unknown fault mode {self.mode!r} "
+                             f"(known: {', '.join(MODES)})")
+        self.delay_s = float(raw.get("delay_s", 0.0))
+        self.times = (int(raw["times"]) if raw.get("times") is not None
+                      else None)
+        self.after = int(raw.get("after", 0))
+        self.p = float(raw.get("p", 1.0))
+        self.service = raw.get("service")
+        self.hits = 0      # matching requests seen (incl. skipped)
+        self.fired = 0     # times the fault actually fired
+
+    def to_dict(self) -> dict:
+        return {"point": self.point, "mode": self.mode,
+                "delay_s": self.delay_s, "times": self.times,
+                "after": self.after, "p": self.p, "service": self.service,
+                "hits": self.hits, "fired": self.fired}
+
+
+class FaultInjector:
+    """Per-process registry of armed faults; thread-safe."""
+
+    def __init__(self, service: str = "", seed: int = 0):
+        self.service = service
+        self._lock = threading.Lock()
+        self._specs: List[FaultSpec] = []
+        self._seed = seed
+        import random
+        self._rng = random.Random(seed)
+
+    @classmethod
+    def from_env(cls, service: str) -> "FaultInjector":
+        inj = cls(service, seed=int(os.environ.get("DLI_FAULTS_SEED", 0)))
+        raw = os.environ.get("DLI_FAULTS")
+        if raw:
+            inj.arm(json.loads(raw))
+        return inj
+
+    def arm(self, specs: List[dict], seed: Optional[int] = None,
+            replace: bool = True):
+        """Install fault specs (validated before any state changes)."""
+        parsed = [FaultSpec(s) for s in specs]
+        with self._lock:
+            if seed is not None:
+                import random
+                self._seed = int(seed)
+                self._rng = random.Random(self._seed)
+            if replace:
+                self._specs = parsed
+            else:
+                self._specs.extend(parsed)
+
+    def clear(self):
+        with self._lock:
+            self._specs = []
+
+    def state(self) -> dict:
+        with self._lock:
+            return {"service": self.service, "seed": self._seed,
+                    "faults": [s.to_dict() for s in self._specs]}
+
+    def intercept(self, point: str) -> Optional[FaultSpec]:
+        """First armed spec that fires for ``point`` this hit, or None.
+
+        Cheap when nothing is armed (one lock + empty loop), so the hot
+        path pays ~nothing in production.
+        """
+        with self._lock:
+            for s in self._specs:
+                if s.service and s.service != self.service:
+                    continue
+                if not fnmatch.fnmatchcase(point, s.point):
+                    continue
+                s.hits += 1
+                if s.hits <= s.after:
+                    continue
+                if s.times is not None and s.fired >= s.times:
+                    continue
+                if s.p < 1.0 and self._rng.random() >= s.p:
+                    continue
+                s.fired += 1
+                return s
+        return None
+
+    # ---- admin API handlers (mounted by JsonHTTPService) -------------
+
+    def api_get(self, body):
+        return self.state()
+
+    def api_post(self, body):
+        """Arm faults: {"faults": [...], "seed": 0, "replace": true}."""
+        try:
+            self.arm(body.get("faults", []), seed=body.get("seed"),
+                     replace=bool(body.get("replace", True)))
+        except (ValueError, TypeError) as e:
+            return 400, {"status": "error", "message": str(e)}
+        return {"status": "success", **self.state()}
+
+    def api_clear(self, body):
+        self.clear()
+        return {"status": "success"}
