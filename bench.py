@@ -238,7 +238,12 @@ def bench_reference_stack():
     torch.manual_seed(0)
     model = transformers.GPT2LMHeadModel(transformers.GPT2Config()).eval()
     prompt = torch.randint(0, 50257, (1, PROMPT_LEN))
-    kw = dict(do_sample=True, top_p=0.95, top_k=50, temperature=0.8)
+    # explicit attention_mask + pad_token_id: without them HF warns per
+    # call AND may behave differently around the (absent) pad token — the
+    # baseline must measure exactly what we compare against, quietly
+    kw = dict(do_sample=True, top_p=0.95, top_k=50, temperature=0.8,
+              attention_mask=torch.ones_like(prompt),
+              pad_token_id=model.config.eos_token_id)
     best = 0.0
     with torch.no_grad():
         model.generate(prompt, max_new_tokens=8, **kw)  # warmup
@@ -414,6 +419,7 @@ def bench_batched(model=MODEL, quant=None, n_requests=8,
     best, stats = 0.0, {}
     for rep in range(repeats):
         met.reset_timings()   # percentiles cover exactly this rep's run
+        c0 = met.snapshot()["counters"]   # counters are monotone: deltas
         tput, reqs = run(1000 * (rep + 1))
         _beat(f"rep batched {model} x{n_requests}")
         if tput > best:
@@ -421,12 +427,17 @@ def bench_batched(model=MODEL, quant=None, n_requests=8,
             # sourced from the scheduler's own histograms
             # (runtime/batcher.py observes ttft / inter-token pacing /
             # e2e latency per request), not bench-side ad-hoc timers
-            t = met.snapshot()["timings"]
+            snap = met.snapshot()
+            t, c1 = snap["timings"], snap["counters"]
 
             def q(name, p):
                 e = t.get(name)
                 return round(e[p] * 1e3, 1) if e else None
 
+            def delta(name):
+                return c1.get(name, 0) - c0.get(name, 0)
+
+            passes = delta("batcher_weight_passes")
             stats = {
                 "ttft_ms_p50": q("batcher_ttft", "p50"),
                 "ttft_ms_p95": q("batcher_ttft", "p95"),
@@ -434,7 +445,21 @@ def bench_batched(model=MODEL, quant=None, n_requests=8,
                 "itl_ms_p95": q("batcher_inter_token", "p95"),
                 "latency_ms_p50": q("batcher_e2e_latency", "p50"),
                 "latency_ms_p95": q("batcher_e2e_latency", "p95"),
+                # amortization: tokens per weight-streaming pass over the
+                # whole rep (== mean decode batch occupancy) — continuous
+                # batching's reason to exist, now measurable per run
+                "tokens_per_weight_pass": (
+                    round(delta("batcher_tokens_emitted") / passes, 2)
+                    if passes else None),
+                "overlapped_dispatches": int(
+                    delta("batcher_overlapped_dispatches")) or None,
             }
+            if speculative:
+                sa = b.stats().get("spec_adaptive")
+                if sa:   # adaptive verdict rides the artifact
+                    stats["spec_mode"] = sa["mode"]
+                    stats["spec_gamma"] = sa["gamma"]
+                    stats["spec_fallbacks"] = sa["fallbacks"]
     return best, stats
 
 
@@ -754,7 +779,16 @@ def run_all(platform, degraded, probe_info=None):
                                              repetitive=True)
                 result[f"batched_greedy_rep{tag}_tokens_per_s"] = round(
                     tput, 2)
-                print(f"batched greedy repetitive{tag}: {tput:.2f} tok/s",
+                if spec:
+                    # the adaptive verdict must reach the artifact: a
+                    # speculative regression with no mode/fallback
+                    # evidence is undiagnosable after the fact
+                    result.update(
+                        {f"batched_greedy_rep_spec_{k}": v
+                         for k, v in pstats.items()
+                         if k.startswith("spec_")})
+                print(f"batched greedy repetitive{tag}: {tput:.2f} tok/s "
+                      f"{ {k: v for k, v in pstats.items() if k.startswith('spec_')} }",
                       file=sys.stderr)
             except Exception as e:
                 print(f"batched spec{tag} bench skipped: {e!r}",
@@ -952,8 +986,13 @@ def main():
         deadline = _T0 + window
         while info["degraded"] and time.time() < deadline:
             wait = min(60.0, max(1.0, deadline - time.time()))
-            print(f"TPU probe degraded; re-probing in {wait:.0f}s "
-                  f"(window {window:.0f}s)", file=sys.stderr)
+            # the probe now reports WHICH phase it died/hung in
+            # (utils/platform.py phase markers) — log it per retry so a
+            # degraded artifact's history shows the failure mode evolving
+            # (or not) across the window
+            print(f"TPU probe degraded ({info.get('probe_last_error')}); "
+                  f"re-probing in {wait:.0f}s (window {window:.0f}s)",
+                  file=sys.stderr)
             time.sleep(wait)
             info = ensure_backend(attempts=1)
             attempts += info.get("probe_attempts", 1)

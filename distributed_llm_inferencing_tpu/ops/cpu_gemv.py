@@ -8,6 +8,14 @@ kernel that streams the weights int8 and dequantizes in registers — as a
 jit-compatible ``jax.ffi`` call, the CPU sibling of the Pallas int4
 fused-unpack kernel (ops/pallas/quant_matmul.py) on the TPU side.
 
+The kernels run over a persistent row-partitioned thread pool inside the
+native lib (qgemv.cc RowPool): decode is weight-streaming-bound and one
+core's bandwidth is the single-thread ceiling, so output channels split
+into contiguous per-thread ranges. ``DLI_NATIVE_THREADS`` sets the count
+(default: all cores — native.configured_threads); ``set_threads`` resizes
+a live process. Results are bitwise identical across thread counts: a row
+is computed start-to-finish by exactly one thread.
+
 Built on first use with g++ (same pattern as native/__init__.py's block
 pool); if the toolchain or ``jax.ffi`` is unavailable, ``available()``
 is False and callers keep the portable XLA path. The reference has no
@@ -41,6 +49,23 @@ _TARGET = "dli_qgemv_i8"
 _lock = threading.Lock()
 _state = {"ready": False, "failed": False}
 
+
+def _ffi_mod():
+    """The FFI module wherever this jax puts it: ``jax.ffi`` (>= 0.4.38)
+    or ``jax.extend.ffi`` (0.4.3x — the callable-returning ``ffi_call``
+    form exists in both). Without this shim the whole native path is
+    silently dead on 0.4.3x installs — ``available()`` False, every int8
+    matmul on the XLA dequant fallback — which is exactly what the bench
+    host was doing."""
+    try:
+        import jax.ffi as m
+        if hasattr(m, "ffi_call"):
+            return m
+    except ImportError:
+        pass
+    from jax.extend import ffi as m
+    return m
+
 # the kernel keeps per-row accumulators for up to this many activation
 # rows while a weight row is hot in L1; larger M is compute-bound and
 # belongs on the XLA dequant matmul (see MAX_FAST_M use in callers)
@@ -48,7 +73,7 @@ MAX_FAST_M = 4
 
 
 def _build():
-    import jax.ffi
+    ffi = _ffi_mod()
     if (os.path.exists(_LIB)
             and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC)):
         return _LIB
@@ -59,13 +84,17 @@ def _build():
         # fast-math applies at COMPILE only (the dot reassociates/
         # vectorizes); linking without it keeps crtfastmath.o out of the
         # .so — that startup object would flip FTZ/DAZ in MXCSR for the
-        # whole process the moment the library loads
+        # whole process the moment the library loads. -pthread on both
+        # steps: the kernel's persistent row pool (qgemv.cc RowPool)
+        # needs it, and a lib silently built without it would deadlock
+        # on first dispatch.
         subprocess.run(
             ["g++", "-O3", "-march=native", "-ffast-math", "-std=c++17",
-             "-c", "-fPIC", f"-I{jax.ffi.include_dir()}", _SRC, "-o", obj],
+             "-pthread", "-c", "-fPIC", f"-I{ffi.include_dir()}",
+             _SRC, "-o", obj],
             check=True, capture_output=True, timeout=180)
         subprocess.run(
-            ["g++", "-shared", obj, "-o", tmp],
+            ["g++", "-shared", "-pthread", obj, "-o", tmp],
             check=True, capture_output=True, timeout=60)
         os.rename(tmp, _LIB)  # atomic: concurrent procs never half-load
     finally:
@@ -82,18 +111,22 @@ def _ensure():
         if _state["ready"] or _state["failed"]:
             return _state["ready"]
         try:
-            import jax
-            import jax.ffi
+            ffi = _ffi_mod()
             lib = ctypes.CDLL(_build())
-            jax.ffi.register_ffi_target(
-                _TARGET, jax.ffi.pycapsule(lib.QGemvI8), platform="cpu")
-            jax.ffi.register_ffi_target(
-                "dli_gemv_f32", jax.ffi.pycapsule(lib.GemvF32),
+            ffi.register_ffi_target(
+                _TARGET, ffi.pycapsule(lib.QGemvI8), platform="cpu")
+            ffi.register_ffi_target(
+                "dli_gemv_f32", ffi.pycapsule(lib.GemvF32),
                 platform="cpu")
-            jax.ffi.register_ffi_target(
-                "dli_gemv_bf16", jax.ffi.pycapsule(lib.GemvBf16),
+            ffi.register_ffi_target(
+                "dli_gemv_bf16", ffi.pycapsule(lib.GemvBf16),
                 platform="cpu")
+            lib.DliGemvGetThreads.restype = ctypes.c_int
+            lib.DliGemvSetThreads.argtypes = [ctypes.c_int]
+            _state["lib"] = lib
             _state["ready"] = True
+            log.info("cpu gemv kernels ready (threads=%d)",
+                     lib.DliGemvGetThreads())
         except Exception as e:  # missing g++ / headers / old jax: fall back
             log.warning("cpu int8 gemv unavailable (%s); int8 matmuls use "
                         "the XLA dequant path on cpu", e)
@@ -104,6 +137,27 @@ def _ensure():
 def available() -> bool:
     """True once the kernel is built+registered (attempts on first call)."""
     return _ensure()
+
+
+def get_threads() -> int:
+    """Active row-pool thread count inside the native lib (0 when the
+    kernel is unavailable). Initial value honors ``DLI_NATIVE_THREADS``
+    (native.configured_threads documents the same default)."""
+    if not _ensure():
+        return 0
+    return int(_state["lib"].DliGemvGetThreads())
+
+
+def set_threads(n: int) -> int:
+    """Resize the native row pool at runtime (n < 1 restores the
+    ``DLI_NATIVE_THREADS``/core-count default). Output is bitwise
+    identical for ANY setting — each output row stays on one thread —
+    so this is purely a throughput/oversubscription knob. Returns the
+    applied count (0 when the kernel is unavailable)."""
+    if not _ensure():
+        return 0
+    _state["lib"].DliGemvSetThreads(int(n))
+    return int(_state["lib"].DliGemvGetThreads())
 
 
 def usable_for_rows(rows: int) -> bool:
@@ -125,11 +179,11 @@ def qgemv_i8(x, wt, scale):
     ``available()`` and keep M small (<= MAX_FAST_M) — large M is
     compute-bound and faster on the XLA dequant matmul.
     """
-    import jax.ffi
+    import jax
     import jax.numpy as jnp
     m, _ = x.shape
     n = wt.shape[0]
-    call = jax.ffi.ffi_call(
+    call = _ffi_mod().ffi_call(
         _TARGET, jax.ShapeDtypeStruct((m, n), jnp.float32))
     return call(x.astype(jnp.float32), wt, scale.astype(jnp.float32))
 
@@ -137,11 +191,11 @@ def qgemv_i8(x, wt, scale):
 def gemv_w(x, wt):
     """y[M,N] = x[M,K] @ wt[N,K].T for f32 or bf16-stored weights, f32
     out (f32 accumulate either way). Same caveats as qgemv_i8."""
-    import jax.ffi
+    import jax
     import jax.numpy as jnp
     m, _ = x.shape
     n = wt.shape[0]
     target = "dli_gemv_bf16" if wt.dtype == jnp.bfloat16 else "dli_gemv_f32"
-    call = jax.ffi.ffi_call(
+    call = _ffi_mod().ffi_call(
         target, jax.ShapeDtypeStruct((m, n), jnp.float32))
     return call(x.astype(jnp.float32), wt)

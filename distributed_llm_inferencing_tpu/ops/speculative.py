@@ -20,10 +20,20 @@ reference worker/app.py:297-305).
 Verification runs entirely on device (ops/sampling.py warp_logits gives
 the same warped distribution ``sample`` draws from); the host syncs once
 per verify step and receives up to gamma+1 tokens.
+
+Drafting is a bet, and ``AdaptiveSpecController`` is the bankroll
+manager: it tracks the rolling draft-acceptance rate and the *measured*
+tok/s of the speculative vs plain arms, shrinks gamma when drafts miss,
+falls back to plain decode when drafting measurably loses, and re-probes
+periodically so a workload turning repetitive flips it back on. The
+continuous batcher consults it every chunk (runtime/batcher.py
+_step_speculative), which is what makes ``speculative="ngram"`` safe to
+leave on.
 """
 
 from __future__ import annotations
 
+import collections
 from typing import List, Optional, Sequence
 
 import jax
@@ -33,6 +43,194 @@ from distributed_llm_inferencing_tpu.models import transformer
 from distributed_llm_inferencing_tpu.models.config import ModelConfig
 from distributed_llm_inferencing_tpu.ops.sampling import (
     PREFIX_K, SamplingParams, nucleus_mask_sorted, sample_batch, warp_logits)
+
+
+class AdaptiveSpecController:
+    """Chunk-by-chunk decision: draft (and at what gamma) or run plain
+    decode — so ``speculative="ngram"`` can never lose to plain for long.
+
+    Drafting pays only when drafts get accepted: a rejected draft still
+    costs a (gamma+1)-wide verify forward, and BENCH_r05 measured the
+    always-on path at 5.54 tok/s vs 17.04 plain on a draft-hostile
+    workload. The controller is *empirical*, not model-based — it trusts
+    measured throughput over any cost model:
+
+    - EMAs of decode tokens/s for the spec and plain arms (chunks that
+      just compiled are excluded: compile time is not decode time).
+    - A rolling acceptance rate (accepted draft tokens / drafted tokens)
+      over the last ``window`` speculative chunks.
+    - In spec mode: acceptance below ``min_accept`` halves gamma (a
+      shorter draft wastes less verify width), and below-min at the
+      floor — or measured spec tok/s clearly under plain — falls back to
+      plain. Acceptance above ``grow_accept`` doubles gamma back toward
+      the configured maximum.
+    - Probes keep BOTH arms measured: in plain mode every
+      ``probe_every`` chunks one speculative probe runs (a workload
+      turning repetitive flips drafting back on), and in spec mode one
+      PLAIN probe runs on the same cadence — without it ``plain_tps``
+      would stay unmeasured and a high-acceptance workload on a
+      dispatch-dominated host (BENCH_r05's regression: drafting loses
+      even at full acceptance) could pin the slow arm forever. The
+      probe overhead, 1/probe_every, bounds the cost of being wrong in
+      either direction.
+
+    The batcher owns the measurements (runtime/batcher.py
+    _step_speculative); this object owns the policy, so the engine or a
+    future tree-drafting tier can reuse it unchanged.
+
+    Determinism note: greedy output is mode-invariant, so adaptivity
+    never changes greedy tokens. Sampled REALIZATIONS can differ between
+    a drafted and a plain chunk (same distribution, different draws);
+    acceptance-driven decisions are PRNG-deterministic per (seed,
+    position), and the one clock-driven clause (tok/s comparison) only
+    arms once BOTH arms have been measured — i.e. after the first
+    cross-arm probe or fallback, at earliest ``probe_every`` chunks in —
+    so short generations stay bit-reproducible and long-running sampled
+    workloads trade strict replay for never-slower-than-plain.
+    """
+
+    def __init__(self, gamma_max: int, *, window: int = 16,
+                 probe_every: int = 32, warmup: int = 3,
+                 min_evidence: int = 3, min_accept: float = 0.12,
+                 grow_accept: float = 0.5, hysteresis: float = 0.9,
+                 ema_alpha: float = 0.3):
+        self.gamma_max = max(1, int(gamma_max))
+        self.gamma = self.gamma_max
+        self.mode = "spec"           # "spec" | "plain"
+        self.window = window
+        self.probe_every = probe_every
+        self.warmup = warmup
+        self.min_evidence = max(1, min_evidence)
+        self.min_accept = min_accept
+        self.grow_accept = grow_accept
+        self.hysteresis = hysteresis
+        self.ema_alpha = ema_alpha
+        self.spec_tps: Optional[float] = None
+        self.plain_tps: Optional[float] = None
+        self.fallbacks = 0           # spec -> plain transitions
+        self.reactivations = 0       # plain -> spec transitions
+        self._accept = collections.deque(maxlen=window)  # (accepted, drafted)
+        self._spec_chunks = 0
+        self._plain_chunks = 0
+        self._since_probe = 0        # plain mode: chunks since spec probe
+        self._since_plain_probe = 0  # spec mode: chunks since plain probe
+
+    # ---- decision ------------------------------------------------------
+
+    def choose(self) -> int:
+        """Gamma for the next chunk; 0 means run plain decode."""
+        if self.mode == "spec":
+            self._since_plain_probe += 1
+            if (self._spec_chunks >= self.warmup
+                    and self._since_plain_probe >= self.probe_every):
+                self._since_plain_probe = 0
+                return 0             # plain probe: measure the other arm
+            return self.gamma
+        self._since_probe += 1
+        if self._since_probe >= self.probe_every:
+            self._since_probe = 0
+            return self.gamma        # spec probe
+        return 0
+
+    # ---- feedback ------------------------------------------------------
+
+    def acceptance(self) -> Optional[float]:
+        drafted = sum(d for _, d in self._accept)
+        if not drafted:
+            return None
+        return sum(a for a, _ in self._accept) / drafted
+
+    def _ema(self, prev: Optional[float], x: float) -> float:
+        if prev is None:
+            return x
+        return prev + self.ema_alpha * (x - prev)
+
+    def record(self, mode: str, *, emitted: int, elapsed_s: float,
+               drafted: int = 0, accepted: int = 0,
+               compiled: bool = False) -> None:
+        """Feed one chunk's measurements back. ``drafted``/``accepted``
+        are draft-token counts for spec chunks; ``compiled`` marks a
+        chunk whose dispatch included a fresh XLA compile (throughput
+        excluded — it would poison the EMA for dozens of chunks)."""
+        # elapsed at/below clock resolution is unmeasurable, not "0
+        # tok/s" — recording zero would drag a WINNING arm's EMA down
+        tps = emitted / elapsed_s if elapsed_s > 0 else None
+        if mode == "spec":
+            self._spec_chunks += 1
+            if drafted:
+                self._accept.append((accepted, drafted))
+            if not compiled and tps is not None:
+                self.spec_tps = self._ema(self.spec_tps, tps)
+            self._after_spec()
+        else:
+            self._plain_chunks += 1
+            if not compiled and tps is not None:
+                self.plain_tps = self._ema(self.plain_tps, tps)
+
+    def _after_spec(self) -> None:
+        if self._spec_chunks < self.warmup:
+            return
+        # acceptance verdicts need a few chunks of evidence (one noisy
+        # post-gamma-shrink chunk must not trigger the next shrink); the
+        # plain-mode probe branch below judges on whatever it has — a
+        # wrong reactivation just falls back again, a slow one idles
+        # probe_every chunks of potential speedup
+        acc = (self.acceptance()
+               if len(self._accept) >= self.min_evidence else None)
+        losing_tps = (self.spec_tps is not None
+                      and self.plain_tps is not None
+                      and self.spec_tps < self.plain_tps * self.hysteresis)
+        if self.mode == "plain":
+            # probe verdict: judge THIS probe alone — the window still
+            # holds earlier failed probes, and averaging against them
+            # would delay reactivation ~window more probe rounds after
+            # the workload turns draft-friendly. A wrong single-probe
+            # reactivation self-corrects: min_evidence chunks later the
+            # spec-mode rules fall back again.
+            acc = None
+            if self._accept:
+                a, d = self._accept[-1]
+                acc = a / d if d else None
+            if ((acc is not None and acc >= self.grow_accept)
+                    or (self.spec_tps is not None
+                        and self.plain_tps is not None
+                        and self.spec_tps * self.hysteresis
+                        > self.plain_tps)):
+                self.mode = "spec"
+                self.reactivations += 1
+                self._since_plain_probe = 0
+            return
+        if losing_tps or (acc is not None and acc < self.min_accept):
+            if self.gamma > 2 and not losing_tps:
+                self.gamma = max(2, self.gamma // 2)  # shorter draft first
+                self._accept.clear()   # re-measure at the new gamma
+            else:
+                self.mode = "plain"
+                self.fallbacks += 1
+                self._since_probe = 0
+                # probes must be judged on probe evidence alone — the
+                # draft-hostile window that caused the fallback would
+                # otherwise dilute a now-repetitive workload's probe for
+                # ~window/probe acceptance entries (~4 probe rounds)
+                self._accept.clear()
+        elif (acc is not None and acc >= self.grow_accept
+                and self.gamma < self.gamma_max):
+            self.gamma = min(self.gamma_max, self.gamma * 2)
+
+    def stats(self) -> dict:
+        acc = self.acceptance()
+        return {
+            "mode": self.mode, "gamma": self.gamma,
+            "acceptance": None if acc is None else round(acc, 3),
+            "spec_tokens_per_s":
+                None if self.spec_tps is None else round(self.spec_tps, 1),
+            "plain_tokens_per_s":
+                None if self.plain_tps is None else round(self.plain_tps, 1),
+            "fallbacks": self.fallbacks,
+            "reactivations": self.reactivations,
+            "spec_chunks": self._spec_chunks,
+            "plain_chunks": self._plain_chunks,
+        }
 
 
 def propose_ngram(history: Sequence[int], gamma: int,

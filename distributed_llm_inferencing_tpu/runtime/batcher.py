@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import os
 import threading
 import time
 from typing import Callable, List, Optional, Sequence
@@ -168,6 +169,8 @@ class ContinuousBatcher:
                  mesh_spec: Optional[MeshSpec] = None,
                  prefill_chunk: Optional[int] = 32,
                  speculative: Optional[str] = None, spec_gamma: int = 4,
+                 spec_adaptive: Optional[bool] = None,
+                 decode_overlap: Optional[bool] = None,
                  metrics: Optional[Metrics] = None):
         # shared with the worker's registry when serving (so /metrics
         # carries the scheduler's gauges/histograms); owned otherwise
@@ -216,6 +219,20 @@ class ContinuousBatcher:
         else:
             self.prefill_chunk = None
         self._chunked_admissions = 0
+        # Double-buffered decode dispatch: when the next chunk pair is
+        # provably stop-check-free (no eos, no streaming callback, every
+        # active budget covers BOTH chunks, nothing queued), dispatch
+        # chunk N+1 fed by chunk N's device-resident last tokens and sync
+        # the pair once — chunk N's token transfer overlaps chunk N+1's
+        # compute, halving host round trips on the steady-state decode
+        # path. Single-host only (the lockstep broadcast ships JSON args;
+        # a device-array token feed cannot ride it). DLI_DECODE_OVERLAP=0
+        # opts out for A/B.
+        if decode_overlap is None:
+            decode_overlap = os.environ.get(
+                "DLI_DECODE_OVERLAP", "1") not in ("0", "false")
+        self.decode_overlap = bool(decode_overlap)
+        self._overlapped_dispatches = 0
         # Speculative decoding (models/transformer.py
         # paged_speculative_chunk): on-device prompt-lookup drafts, up to
         # spec_gamma+1 tokens per slot per iteration. Greedy requests get
@@ -227,6 +244,23 @@ class ContinuousBatcher:
         self.speculative = speculative
         self.spec_gamma = int(spec_gamma)
         self._spec_accepted = 0
+        # Adaptive drafting (ops/speculative.py AdaptiveSpecController):
+        # gamma shrinks / drafting auto-falls-back to plain chunks when
+        # measured acceptance or tok/s says drafting loses, with periodic
+        # re-probes — "speculative=ngram" must never be slower than off.
+        # Default on; DLI_SPEC_ADAPTIVE=0 pins the always-draft behavior
+        # (A/B and the fixed-gamma parity tests).
+        if spec_adaptive is None:
+            spec_adaptive = os.environ.get(
+                "DLI_SPEC_ADAPTIVE", "1") not in ("0", "false")
+        self._spec_ctl = None
+        # spec_gamma < 1 is an explicit zero-draft request: no controller
+        # (it would clamp gamma up to 1 and start drafting), the step's
+        # gamma==0 branch runs plain chunks
+        if speculative and spec_adaptive and self.spec_gamma >= 1:
+            from distributed_llm_inferencing_tpu.ops.speculative import (
+                AdaptiveSpecController)
+            self._spec_ctl = AdaptiveSpecController(self.spec_gamma)
         # device-drafting token history, maintained incrementally (a
         # per-step rebuild would be O(slots * max_seq) host work on the
         # hot path): row i holds slot i's prompt + emitted tokens
@@ -364,8 +398,12 @@ class ContinuousBatcher:
                                    if not isinstance(key[0], str)}),
             "chunked_admissions": self._chunked_admissions,
             "prefill_chunk": self.prefill_chunk,
+            "decode_overlap": self.decode_overlap,
+            "overlapped_dispatches": self._overlapped_dispatches,
             "speculative": self.speculative,
             "spec_accepted_tokens": self._spec_accepted,
+            "spec_adaptive": (self._spec_ctl.stats()
+                              if self._spec_ctl is not None else None),
             "pool": self.pool.stats(),
         }
 
@@ -412,16 +450,19 @@ class ContinuousBatcher:
 
     def _decode_jit(self, k: int, r: int, mb: int):
         """K-token decode chunk (transformer.paged_decode_chunk), one host
-        sync per K tokens for all slots."""
+        sync per K tokens for all slots. ``tokens`` rides as its own
+        argument — not packed into ``ints`` — so a double-buffered step
+        can feed chunk N+1 the device-resident last tokens of chunk N
+        without a host round trip (_step_overlapped)."""
         fn = self._decode_fns.get((k, r, mb))
         if fn is None:
             cfg, dummy = self.cfg, self._dummy
             pp, mesh = self.mesh_spec.pp, self.mesh
 
-            def chunk(p, ints, floats, paged):
+            def chunk(p, tokens, ints, floats, paged):
                 bt = ints[:r * mb].reshape(r, mb)
-                (tokens, cl, seeds, steps0, tks, budget, eos_ids,
-                 ds) = ints[r * mb:].reshape(8, r)
+                (cl, seeds, steps0, tks, budget, eos_ids,
+                 ds) = ints[r * mb:].reshape(7, r)
                 temps, tps = floats
                 if pp > 1:
                     from distributed_llm_inferencing_tpu.parallel import (
@@ -434,7 +475,7 @@ class ContinuousBatcher:
                     p, cfg, k, tokens, paged, bt, cl, seeds, steps0, temps,
                     tks, tps, ds.astype(bool), budget, eos_ids, dummy)
 
-            fn = jax.jit(chunk, donate_argnums=(3,))
+            fn = jax.jit(chunk, donate_argnums=(4,))
             self._decode_fns[(k, r, mb)] = fn
         return fn
 
@@ -497,21 +538,30 @@ class ContinuousBatcher:
                                    jnp.asarray(floats), self.paged)
             return np.asarray(first)   # ONE host sync per admission wave
 
-    def _run_decode(self, a: dict):
+    def _run_decode(self, a: dict, tokens_dev=None, sync: bool = True):
         """Launch one decode chunk's program from a JSON-safe arg dict.
-        Returns (toks [K, R], emits [K, R]) as host arrays."""
+        Returns (toks [K, R], emits [K, R]) — host arrays when ``sync``
+        (the default: ONE host sync per chunk), device arrays otherwise
+        (the double-buffered step syncs two chunks at once).
+        ``tokens_dev`` overrides ``a["tokens"]`` with a device-resident
+        [R] token vector — chunk N's last sampled tokens feed chunk N+1
+        without ever visiting the host."""
         bt = np.asarray(a["bt"], np.int32)
         r, mb = bt.shape
         ints = np.concatenate([bt.reshape(-1)] + [
             np.asarray(a[key], np.int32) for key in
-            ("tokens", "cl", "seeds", "steps", "tks", "budget", "eos", "ds")])
+            ("cl", "seeds", "steps", "tks", "budget", "eos", "ds")])
         floats = np.stack([np.asarray(a["temps"], np.float32),
                            np.asarray(a["tps"], np.float32)])
         fn = self._decode_jit(int(a["k"]), r, mb)
         with self.mesh:
-            toks, emits, self.paged = fn(self.params, jnp.asarray(ints),
+            tokens = (tokens_dev if tokens_dev is not None
+                      else jnp.asarray(np.asarray(a["tokens"], np.int32)))
+            toks, emits, self.paged = fn(self.params, tokens,
+                                         jnp.asarray(ints),
                                          jnp.asarray(floats), self.paged)
-            # ONE host sync per K-token chunk for all slots
+            if not sync:
+                return toks, emits
             return jax.device_get((toks, emits))
 
     def _hist_deltas(self) -> list:
@@ -550,6 +600,20 @@ class ContinuousBatcher:
                 self._hist_synced[r] = min(self._hist_synced[r] + kept,
                                            self.max_seq + 1)
 
+    def _apply_plain_hist(self, toks, emits, cl):
+        """Mirror a PLAIN decode chunk's emitted tokens into the drafting
+        history: the adaptive speculation controller interleaves plain
+        chunks (fallback / probes) into a speculative batcher, and stale
+        history rows would draft garbage (rejected — correct but wasted).
+        The plain case IS the spec case at draft width 1 — ``emits`` is a
+        monotone 0/1 keeps column — so the lockstep-critical watermark
+        arithmetic lives once, in _apply_spec_hist. No-op when drafting
+        is off."""
+        if self._hist is None:
+            return
+        self._apply_spec_hist(np.asarray(toks)[:, :, None],
+                              np.asarray(emits).astype(np.int32), cl)
+
     def _run_spec_decode(self, a: dict):
         """Launch one speculative chunk's program. Returns (toks
         [K, R, g+1], keeps [K, R], eos_seen [K, R]) as host arrays —
@@ -584,7 +648,16 @@ class ContinuousBatcher:
         if kind == "admit":
             self._run_admit(args)
         elif kind == "decode":
-            self._run_decode(args)
+            if self._hist is not None:
+                # admission-time rows ride the broadcast (see
+                # _dispatch_plain_chunk); appends derive from outputs
+                for r, off, row in args.get("hist_delta") or []:
+                    self._hist[r, off:off + len(row)] = row
+            toks, emits = self._run_decode(args)
+            # adaptive speculation interleaves plain chunks: followers
+            # mirror the leader's history appends from program outputs
+            self._apply_plain_hist(toks, emits,
+                                   np.asarray(args["cl"], np.int32))
         elif kind == "spec_decode":
             toks, keeps, _ = self._run_spec_decode(args)
             if "hist" not in args:
@@ -1032,8 +1105,11 @@ class ContinuousBatcher:
             if busy:   # idle polls would drown the step histogram
                 m.observe("batcher_step", time.perf_counter() - t0)
             m.gauge("batcher_queue_depth", len(self.queue))
-            m.gauge("batcher_active_slots",
-                    sum(a is not None for a in self.active))
+            active_slots = sum(a is not None for a in self.active)
+            m.gauge("batcher_active_slots", active_slots)
+            if busy:   # idle polls would peg occupancy at 0 between bursts
+                m.gauge("batcher_batch_occupancy",
+                        active_slots / self.slots)
             m.gauge("batcher_free_kv_blocks", self.pool.free_count())
 
     def _step_inner(self) -> int:
@@ -1109,8 +1185,27 @@ class ContinuousBatcher:
         }
         if self.speculative:
             return self._step_speculative(active, decode_args)
+        if self._overlap_eligible(active, k):
+            return self._step_overlapped(active, decode_args, k)
+        self._dispatch_plain_chunk(active, decode_args)
+        return len([a for a in self.active if a is not None])
+
+    def _dispatch_plain_chunk(self, active, decode_args: dict) -> int:
+        """One plain K-token decode chunk: dispatch (hook-aware), sync,
+        emit, finish dead slots. Shared by the plain step and the
+        adaptive-speculation fallback/probe path. Returns tokens
+        emitted."""
+        k = int(decode_args["k"])
+        budget = decode_args["budget"]
         w0 = time.time()
         if self.program_hook is not None:
+            if self._hist is not None:
+                # adaptive fallback under lockstep: a freshly-admitted
+                # row's prompt region must still reach the followers, or
+                # _apply_plain_hist would advance the watermark past a
+                # hole the next spec probe's delta then skips forever
+                decode_args = dict(decode_args,
+                                   hist_delta=self._hist_deltas())
             toks, emits = self.program_hook(
                 "decode", decode_args, lambda: self._run_decode(decode_args))
         else:
@@ -1120,8 +1215,24 @@ class ContinuousBatcher:
         self.metrics.observe("batcher_decode_chunk", w1 - w0)
         trace.get_tracer().record(
             "batcher.decode_chunk", w0, w1,
-            attrs={"k": int(k), "slots": len(active)})
+            attrs={"k": k, "slots": len(active)})
+        # drafting history stays current even when the adaptive controller
+        # runs plain chunks in a speculative batcher — pure function of
+        # program outputs, so lockstep followers mirror it in replay()
+        self._apply_plain_hist(toks, emits,
+                               np.asarray(decode_args["cl"], np.int32))
+        return self._emit_chunk_outputs(active, toks, emits, k,
+                                        budget=budget)
 
+    def _emit_chunk_outputs(self, active, toks, emits, passes: int,
+                            budget=None) -> int:
+        """Shared emit/finish/amortization epilogue for [K, R]-shaped
+        chunk outputs (plain and overlapped paths; the speculative path's
+        outputs are [K, R, G+1] keeps-shaped and handled in place).
+        ``budget`` enables the stopped-before-budget eos inference —
+        overlapped pairs are provably eos-free and pass None. Returns
+        tokens emitted."""
+        emitted = 0
         for i in active:
             req = self.active[i]
             # emits[:, i] is True exactly for this slot's emitted prefix
@@ -1130,10 +1241,82 @@ class ContinuousBatcher:
             cnt = int(emits[:, i].sum())
             for tok in toks[:cnt, i]:
                 self._emit(req, int(tok))
+            emitted += cnt
             self.context_lens[i] += cnt
-            hit_eos = cnt < int(budget[i])   # stopped before its budget
+            hit_eos = (budget is not None
+                       and cnt < int(budget[i]))   # stopped pre-budget
             if hit_eos or len(req.tokens) >= req.max_new_tokens:
                 self._finish_slot(i)
+        # amortization: emitted tokens per weight-streaming pass (one
+        # pass per decode iteration) — THE number continuous batching
+        # exists to raise. Gauge for live /metrics, counters for
+        # windowed ratios (bench.py takes per-rep deltas).
+        self.metrics.gauge("decode_tokens_per_weight_pass",
+                           emitted / passes if passes else 0.0)
+        self.metrics.inc("batcher_weight_passes", passes)
+        self.metrics.inc("batcher_tokens_emitted", emitted)
+        return emitted
+
+    def _overlap_eligible(self, active, k: int) -> bool:
+        """True when a chunk pair can dispatch back-to-back with no host
+        decision in between: single-host, nothing queued (admission waits
+        a chunk otherwise), and every active slot provably emits exactly
+        ``k`` tokens per chunk twice over — no eos stop-check, no
+        streaming callback wanting tokens at chunk granularity, budget
+        covering both chunks — with growth blocks for 2k pre-allocated."""
+        if not self.decode_overlap or self.program_hook is not None:
+            return False
+        with self._lock:
+            if self.queue:
+                return False
+        for i in active:
+            req = self.active[i]
+            if (req.eos_token_id is not None or req.stream_cb is not None
+                    or req.max_new_tokens - len(req.tokens) < 2 * k):
+                return False
+        # growth extension may fail at the pool/max_blocks edge: the step
+        # then simply runs single-chunk (already-granted blocks stay with
+        # their slots — they back the very next chunk)
+        return all(self._ensure_growth(i, 2 * k) for i in active)
+
+    def _step_overlapped(self, active, args_a: dict, k: int) -> int:
+        """Double-buffered decode: dispatch chunk B fed by chunk A's
+        device-resident last-iteration tokens, then sync the PAIR once —
+        A's device->host token transfer rides under B's compute, and the
+        per-chunk dispatch round trip is paid once per 2k tokens.
+        Eligibility (_overlap_eligible) guarantees A emits exactly k per
+        active slot, so B's context/step offsets advance deterministically
+        host-side without seeing A's tokens."""
+        # _overlap_eligible's 2k growth ran AFTER the step snapshotted the
+        # block tables — refresh, or chunk B scatters into blocks its
+        # table doesn't know (A ignores entries past its write range:
+        # gathers are position-masked below cl0)
+        args_a = dict(args_a, bt=self.block_tables.tolist())
+        cl_b = list(args_a["cl"])
+        st_b = list(args_a["steps"])
+        for i in active:
+            cl_b[i] += k
+            st_b[i] += k
+        args_b = dict(args_a, cl=cl_b, steps=st_b)
+        w0 = time.time()
+        toks_a, emits_a = self._run_decode(args_a, sync=False)
+        toks_b, emits_b = self._run_decode(args_b, tokens_dev=toks_a[-1],
+                                           sync=False)
+        self._step_count += 2
+        self._overlapped_dispatches += 1
+        self.metrics.inc("batcher_overlapped_dispatches")
+        toks_a, emits_a, toks_b, emits_b = jax.device_get(
+            (toks_a, emits_a, toks_b, emits_b))   # ONE sync for the pair
+        w1 = time.time()
+        self.metrics.observe("batcher_decode_chunk", (w1 - w0) / 2)
+        self.metrics.observe("batcher_decode_chunk", (w1 - w0) / 2)
+        trace.get_tracer().record(
+            "batcher.decode_chunk", w0, w1,
+            attrs={"k": 2 * k, "slots": len(active), "overlapped": True})
+
+        toks = np.concatenate([toks_a, toks_b], axis=0)
+        emits = np.concatenate([emits_a, emits_b], axis=0)
+        self._emit_chunk_outputs(active, toks, emits, 2 * k)
         return len([a for a in self.active if a is not None])
 
     def _step_speculative(self, active, decode_args: dict) -> int:
@@ -1142,10 +1325,43 @@ class ContinuousBatcher:
         when drafts miss, and up to (gamma+1)x fewer dispatches when they
         hit. Block growth was already ensured for k tokens — accepted
         cache writes never exceed the budget, and rejected scratch
-        entries scatter to the dummy block."""
-        g1 = self.spec_gamma + 1
+        entries scatter to the dummy block.
+
+        With the adaptive controller (default) the step first asks it for
+        a gamma: 0 means this chunk runs PLAIN (fallback steady state, or
+        the stretch between probes) — on-device drafting resumes the
+        moment a probe measures the workload draft-friendly again. Every
+        chunk's (acceptance, emitted, elapsed) feeds back, with
+        fresh-compile dispatches excluded from the throughput EMAs."""
+        ctl = self._spec_ctl
+        gamma = ctl.choose() if ctl is not None else self.spec_gamma
+        m = self.metrics
+        if ctl is not None:
+            m.gauge("spec_mode", 1.0 if gamma else 0.0)
+            m.gauge("spec_gamma_current", float(gamma or ctl.gamma))
+            acc = ctl.acceptance()
+            if acc is not None:
+                m.gauge("spec_acceptance_rate", acc)
+        if gamma == 0:
+            # controller fallback — or spec_gamma=0 with adaptivity off,
+            # where a degenerate zero-draft chunk has nothing to verify:
+            # both run the plain program (ctl may be None in the latter)
+            k = int(decode_args["k"])
+            compiled = (k, self.slots,
+                        self.max_blocks) not in self._decode_fns
+            w0 = time.time()
+            emitted = self._dispatch_plain_chunk(active, decode_args)
+            if ctl is not None:
+                ctl.record("plain", emitted=emitted,
+                           elapsed_s=time.time() - w0, compiled=compiled)
+            return len([a for a in self.active if a is not None])
+
+        g1 = gamma + 1
         k_it = -(-int(decode_args["k"]) // g1)
-        args = dict(decode_args, k=k_it, gamma=self.spec_gamma)
+        args = dict(decode_args, k=k_it, gamma=gamma)
+        spec_key = ("spec", k_it, gamma, self.slots, self.max_blocks,
+                    self._hist.shape[1])
+        compiled = spec_key not in self._decode_fns
         w0 = time.time()
         if self.program_hook is not None:
             # the lockstep mirror ships JSON: broadcast only per-slot
@@ -1165,11 +1381,13 @@ class ContinuousBatcher:
         self.metrics.observe("batcher_decode_chunk", w1 - w0)
         trace.get_tracer().record(
             "batcher.spec_chunk", w0, w1,
-            attrs={"k": k_it, "gamma": self.spec_gamma,
-                   "slots": len(active)})
+            attrs={"k": k_it, "gamma": gamma, "slots": len(active)})
         self._apply_spec_hist(toks, keeps,
                               np.asarray(decode_args["cl"], np.int32))
 
+        emitted = 0
+        live_iters = 0       # iterations where a row was alive (emitted)
+        accepted = 0         # draft tokens kept beyond one-per-iteration
         for i in active:
             req = self.active[i]
             cnt = int(keeps[:, i].sum())
@@ -1177,13 +1395,30 @@ class ContinuousBatcher:
                 for tok in toks[t, i, : int(keeps[t, i])]:
                     self._emit(req, int(tok))
             # speedup accounting: tokens beyond one-per-iteration
-            self._spec_accepted += cnt - int((keeps[:, i] > 0).sum())
+            live = int((keeps[:, i] > 0).sum())
+            self._spec_accepted += cnt - live
+            emitted += cnt
+            live_iters += live
+            accepted += cnt - live
             self.context_lens[i] += cnt
             # a slot may legitimately emit fewer than its budget when
             # every draft missed (1 token/iteration) — only the device's
             # cumulative eos flag or an exhausted budget finishes it
             if bool(eos_seen[-1, i]) or len(req.tokens) >= req.max_new_tokens:
                 self._finish_slot(i)
+        # amortization: a verify iteration streams the weights once
+        # however wide the draft is — that width is the whole speedup
+        m.gauge("decode_tokens_per_weight_pass",
+                emitted / k_it if k_it else 0.0)
+        m.inc("batcher_weight_passes", k_it)
+        m.inc("batcher_tokens_emitted", emitted)
+        if ctl is not None:
+            ctl.record("spec", emitted=emitted,
+                       elapsed_s=time.time() - w0,
+                       drafted=gamma * live_iters, accepted=accepted,
+                       compiled=compiled)
+            if ctl.fallbacks:
+                m.gauge("spec_fallbacks", float(ctl.fallbacks))
         return len([a for a in self.active if a is not None])
 
     # ---- background loop ----------------------------------------------

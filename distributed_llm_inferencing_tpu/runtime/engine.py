@@ -459,11 +459,44 @@ class InferenceEngine:
                 if stream_cb:
                     stream_cb(0, [int(cur[i]) for i in range(n_real)])
 
-                while remaining > 0 and not all(done):
+                # Without an eos stop-check the chunk schedule is data-
+                # independent: keep a BOUNDED lookahead of dispatched
+                # chunks (depth 2 — chunk N+1 launches before chunk N's
+                # tokens transfer back, which is all the dispatch/
+                # transfer overlap there is to win) rather than queueing
+                # the whole generation: a stream_cb that dies mid-stream
+                # (client disconnect) then wastes at most the in-flight
+                # pair, not every remaining chunk. With eos the host
+                # must see each chunk's tokens before dispatching more.
+                pipelined: list = []
+                rem_dispatch = remaining if eos_token_id is None else 0
+
+                def dispatch_next():
+                    nonlocal rem_dispatch, cur, cache, key
                     T = next(c for c in self.DECODE_CHUNKS
-                             if c <= min(remaining, self.STREAM_CHUNK_MAX))
+                             if c <= min(rem_dispatch,
+                                         self.STREAM_CHUNK_MAX))
                     decode = self._decode_jitted(sp, T)
-                    toks_dev, cur, cache, key = decode(self.params, cur, cache, key)
+                    toks_dev, cur, cache, key = decode(
+                        self.params, cur, cache, key)
+                    pipelined.append((toks_dev, T))
+                    rem_dispatch -= T
+
+                while rem_dispatch > 0 and len(pipelined) < 2:
+                    dispatch_next()
+
+                while remaining > 0 and not all(done):
+                    if pipelined:
+                        toks_dev, T = pipelined.pop(0)
+                        if rem_dispatch > 0:   # refill BEFORE blocking
+                            dispatch_next()
+                    else:
+                        T = next(c for c in self.DECODE_CHUNKS
+                                 if c <= min(remaining,
+                                             self.STREAM_CHUNK_MAX))
+                        decode = self._decode_jitted(sp, T)
+                        toks_dev, cur, cache, key = decode(
+                            self.params, cur, cache, key)
                     toks = np.asarray(toks_dev)    # [T, B] — one sync per chunk
                     for t in range(T):
                         # stream exactly what lands in `out` this step;
@@ -585,19 +618,55 @@ class InferenceEngine:
                 stream_cb(0, [cur])   # same contract as the plain path
             history = prompt + out
             steps = 1
-            verify = self._verify_jitted(sp, gamma)
+            # Adaptive drafting (ops/speculative.py): a verify dispatch
+            # costs one host sync per <= gamma+1 tokens, while a plain
+            # chunk syncs once per <= STREAM_CHUNK_MAX — on a host where
+            # dispatch dominates, drafting loses even at full acceptance
+            # (BENCH_r05: 5.54 vs 17.04 tok/s). The controller measures
+            # both arms and hands the loop to whichever is faster, so
+            # ``speculative="ngram"`` can never stay slower than off.
+            # Fresh per call — a request's output must stay a function
+            # of (params, prompt, seed), never of neighbor requests —
+            # with a SHORT probe cadence so even a few-dozen-token
+            # generation measures the plain arm and can fall back
+            # mid-request (probe schedules count chunks, so same-seed
+            # reruns make identical decisions until both arms are
+            # measured). DLI_SPEC_ADAPTIVE=0 pins always-draft
+            # (parity tests / A/B).
+            ctl = (speculative.AdaptiveSpecController(gamma, probe_every=8)
+                   if os.environ.get("DLI_SPEC_ADAPTIVE", "1")
+                   not in ("0", "false") else None)
             while len(out) < max_new_tokens and not hit_eos:
-                drafts = speculative.propose_ngram(history, gamma)
-                if drafts is None:
-                    # no n-gram hit: verify a dummy draft — still emits
-                    # >= 1 correct token for one dispatch
-                    drafts = [history[-1]] * gamma
-                toks_dev, n_emit, cache, key = verify(
-                    self.params, cache, jnp.asarray([out[-1]], jnp.int32),
-                    jnp.asarray([drafts], jnp.int32), key)
-                steps += 1
-                n = int(n_emit[0])
-                emitted = [int(t) for t in np.asarray(toks_dev)[0, :n]]
+                g_now = ctl.choose() if ctl is not None else gamma
+                p0 = time.perf_counter()
+                if g_now == 0:
+                    # plain fallback: same chunk trade as the streaming
+                    # decode path (eos checked host-side per chunk)
+                    rem = max_new_tokens - len(out)
+                    T = next(c for c in self.DECODE_CHUNKS
+                             if c <= min(rem, self.STREAM_CHUNK_MAX))
+                    compiled = (sp, T) not in self._decode_fns
+                    decode = self._decode_jitted(sp, T)
+                    toks_dev, _, cache, key = decode(
+                        self.params, jnp.asarray([out[-1]], jnp.int32),
+                        cache, key)
+                    emitted = [int(t) for t in np.asarray(toks_dev)[:, 0]]
+                    steps += T
+                else:
+                    drafts = speculative.propose_ngram(history, g_now)
+                    if drafts is None:
+                        # no n-gram hit: verify a dummy draft — still
+                        # emits >= 1 correct token for one dispatch
+                        drafts = [history[-1]] * g_now
+                    compiled = ("spec", sp, g_now) not in self._decode_fns
+                    verify = self._verify_jitted(sp, g_now)
+                    toks_dev, n_emit, cache, key = verify(
+                        self.params, cache,
+                        jnp.asarray([out[-1]], jnp.int32),
+                        jnp.asarray([drafts], jnp.int32), key)
+                    steps += 1
+                    n = int(n_emit[0])
+                    emitted = [int(t) for t in np.asarray(toks_dev)[0, :n]]
                 # keep (and stream) only what the result will contain:
                 # nothing past max_new_tokens, nothing at/after eos
                 kept = []
@@ -608,6 +677,16 @@ class InferenceEngine:
                     kept.append(t)
                     if len(out) + len(kept) >= max_new_tokens:
                         break
+                if ctl is not None:
+                    dt = time.perf_counter() - p0
+                    if g_now == 0:
+                        ctl.record("plain", emitted=len(emitted),
+                                   elapsed_s=dt, compiled=compiled)
+                    else:
+                        ctl.record("spec", emitted=len(emitted),
+                                   elapsed_s=dt, drafted=g_now,
+                                   accepted=len(emitted) - 1,
+                                   compiled=compiled)
                 out.extend(kept)
                 history.extend(kept)
                 if stream_cb:

@@ -10,6 +10,25 @@ echo "== compileall =="
 python -m compileall -q distributed_llm_inferencing_tpu tests bench.py \
     benchmarks || exit 1
 
+echo "== native kernels (threaded GEMV/GEMM must build; no silent fallback) =="
+# The decode hot path leans on the -pthread row-pool kernel
+# (native/src/qgemv.cc via ops/cpu_gemv.py). A build regression must fail
+# HERE, loudly — not degrade every int8 matmul to the XLA dequant path.
+JAX_PLATFORMS=cpu python - <<'PY' || exit 1
+from distributed_llm_inferencing_tpu.native import configured_threads
+from distributed_llm_inferencing_tpu.ops import cpu_gemv
+assert cpu_gemv.available(), (
+    "native qgemv failed to build/register -- the threaded decode hot "
+    "path would silently fall back to the XLA dequant matmul")
+print(f"qgemv ready: {cpu_gemv.get_threads()} threads "
+      f"(configured default {configured_threads()})")
+PY
+
+echo "== perf hot-path suites (threaded GEMV + adaptive speculation) =="
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_gemv_threads.py tests/test_adaptive_spec.py \
+    -q -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+
 echo "== chaos suite (fault injection + self-healing dispatch) =="
 # Deterministic fault schedules: a failure here reproduces locally with
 #   DLI_FAULTS_SEED=0 JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q
@@ -20,7 +39,7 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu DLI_FAULTS_ENABLE=1 \
     -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
 
 echo "== tier-1 tests (ROADMAP.md verify command) =="
-# (the chaos/lifecycle suites already ran above with the seeded env —
+# (the chaos/lifecycle and perf hot-path suites already ran above —
 #  skipped here so check.sh doesn't pay for them twice; the bare ROADMAP
 #  command still collects them)
 rm -f /tmp/_t1.log
@@ -28,6 +47,8 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
     -p no:xdist -p no:randomly \
     --ignore=tests/test_chaos.py --ignore=tests/test_node_lifecycle.py \
+    --ignore=tests/test_gemv_threads.py \
+    --ignore=tests/test_adaptive_spec.py \
     2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \

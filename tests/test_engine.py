@@ -57,6 +57,22 @@ def test_streaming_callback_sees_every_token():
     assert [s for s, _ in seen] == list(range(5))
 
 
+def test_streaming_pipelined_matches_plain_across_chunks():
+    """Without an eos stop-check the streaming path queues every chunk's
+    dispatch up front (runtime/engine.py) — tokens and stream ordering
+    must be identical to the fire-and-forget path across multiple chunk
+    boundaries (40 tokens spans the 32/8 chunk schedule)."""
+    _, eng = _tiny_hf_engine()
+    plain = eng.generate([[1, 2, 3]], max_new_tokens=40,
+                         sampling=SamplingParams.greedy())
+    seen = []
+    res = eng.generate([[1, 2, 3]], max_new_tokens=40,
+                       sampling=SamplingParams.greedy(),
+                       stream_cb=lambda step, toks: seen.append(toks[0]))
+    assert res.tokens[0] == plain.tokens[0]
+    assert seen == res.tokens[0]
+
+
 def test_eos_stops_decode():
     _, eng = _tiny_hf_engine()
     # find which token greedy emits first, use it as "eos"

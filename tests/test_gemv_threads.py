@@ -1,0 +1,162 @@
+"""Threaded native GEMV/GEMM kernels (native/src/qgemv.cc RowPool).
+
+The threading contract is PARTITION-ONLY determinism: every output row is
+computed start-to-finish by exactly one thread running the identical
+scalar loop, so any ``DLI_NATIVE_THREADS`` setting must produce bitwise-
+identical results — asserted here across 1/2/4 threads for all three
+weight dtypes, at decode-shaped and GEMM-shaped M and odd K/N (no
+vector-width alignment to hide an off-by-one in the row partition).
+
+The batcher smoke test pins the tentpole's point: batch must amortize
+weight streaming, i.e. batched decode throughput clearly beats
+single-stream on the same host (every slot shares each weight pass, and
+the per-chunk dispatch cost is paid once for all slots).
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llm_inferencing_tpu.models.params import init_params
+from distributed_llm_inferencing_tpu.models.registry import get_config
+from distributed_llm_inferencing_tpu.native import configured_threads
+from distributed_llm_inferencing_tpu.ops import cpu_gemv
+from distributed_llm_inferencing_tpu.ops.sampling import SamplingParams
+from distributed_llm_inferencing_tpu.runtime.batcher import ContinuousBatcher
+
+RNG = np.random.default_rng(7)
+THREADS = (1, 2, 4)
+
+
+@pytest.fixture
+def restore_threads():
+    yield
+    if cpu_gemv.available():
+        cpu_gemv.set_threads(0)   # back to the env/core-count default
+
+
+needs_native = pytest.mark.skipif(
+    not cpu_gemv.available(),
+    reason="native qgemv not built (no g++ / ffi headers)")
+
+
+def test_configured_threads_parses_env(monkeypatch):
+    monkeypatch.setenv("DLI_NATIVE_THREADS", "3")
+    assert configured_threads() == 3
+    monkeypatch.setenv("DLI_NATIVE_THREADS", "junk")
+    assert configured_threads() >= 1   # falls back to core count
+    monkeypatch.delenv("DLI_NATIVE_THREADS")
+    assert configured_threads() >= 1
+
+
+@needs_native
+def test_set_threads_roundtrip(restore_threads):
+    for t in THREADS:
+        assert cpu_gemv.set_threads(t) == t
+    assert cpu_gemv.get_threads() == THREADS[-1]
+    assert cpu_gemv.set_threads(0) >= 1   # default restored
+
+
+@needs_native
+@pytest.mark.parametrize("m", [1, 2, 4, 8])
+def test_int8_parity_and_thread_invariance(m, restore_threads):
+    k, n = 193, 515   # odd K/N
+    x = jnp.asarray(RNG.standard_normal((m, k)), jnp.float32)
+    wt = jnp.asarray(RNG.integers(-127, 128, (n, k)), jnp.int8)
+    s = jnp.asarray(RNG.random(n) * 0.02 + 1e-3, jnp.float32)
+    outs = []
+    for t in THREADS:
+        assert cpu_gemv.set_threads(t) == t
+        outs.append(np.asarray(cpu_gemv.qgemv_i8(x, wt, s)))
+    for o in outs[1:]:   # bitwise: the partition decides WHO, never WHAT
+        assert np.array_equal(outs[0], o)
+    want = np.asarray(x) @ (np.asarray(wt, np.float32).T
+                            * np.asarray(s)[None, :])
+    np.testing.assert_allclose(outs[0], want, rtol=2e-5, atol=2e-5)
+
+
+@needs_native
+@pytest.mark.parametrize("m", [1, 2, 4, 8])
+@pytest.mark.parametrize("wdtype", ["float32", "bfloat16"])
+def test_float_parity_and_thread_invariance(m, wdtype, restore_threads):
+    k, n = 97, 131   # odd K/N
+    x = jnp.asarray(RNG.standard_normal((m, k)), jnp.float32)
+    w = jnp.asarray(RNG.standard_normal((n, k)), jnp.float32)
+    if wdtype == "bfloat16":
+        w = w.astype(jnp.bfloat16)
+    outs = []
+    for t in THREADS:
+        assert cpu_gemv.set_threads(t) == t
+        outs.append(np.asarray(cpu_gemv.gemv_w(x, w)))
+    for o in outs[1:]:
+        assert np.array_equal(outs[0], o)
+    want = np.asarray(x) @ np.asarray(w.astype(jnp.float32)).T
+    # -ffast-math reassociates the reduction: tolerance, not bit-equality,
+    # vs the jnp reference (bit-equality is asserted across THREADS above)
+    tol = dict(rtol=5e-2, atol=5e-3) if wdtype == "bfloat16" \
+        else dict(rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(outs[0], want, **tol)
+
+
+@needs_native
+def test_threaded_inside_jit(restore_threads):
+    """The pool must be reentrant-safe under XLA's own threading: drive
+    the custom call from inside jit at every thread count."""
+    k, n = 64, 96
+    wt = jnp.asarray(RNG.integers(-127, 128, (n, k)), jnp.int8)
+    s = jnp.ones((n,), jnp.float32)
+    x = jnp.asarray(RNG.standard_normal((2, k)), jnp.float32)
+    f = jax.jit(lambda a: cpu_gemv.qgemv_i8(a, wt, s))
+    outs = []
+    for t in THREADS:
+        cpu_gemv.set_threads(t)
+        outs.append(np.asarray(f(x)))
+    assert all(np.array_equal(outs[0], o) for o in outs[1:])
+
+
+def test_batched_throughput_amortizes_weight_streaming():
+    """Continuous batching must actually amortize: 8 concurrent requests
+    through the batcher beat one request by >= 1.5x tokens/s on the same
+    host (every active slot shares each weight-streaming pass and the
+    per-chunk dispatch). Also pins the amortization counters the /metrics
+    gauge and bench.py report."""
+    cfg = get_config("tiny-llama").replace(dtype="float32",
+                                           attn_backend="xla")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    b = ContinuousBatcher(cfg, params, num_blocks=256, block_size=8,
+                          slots=8, max_seq=128)
+    sp = SamplingParams.greedy()
+    new_tokens = 48
+
+    def run(n_req, seed):
+        rng = np.random.default_rng(seed)   # fresh prompts: no radix hits
+        prompts = [rng.integers(0, cfg.vocab_size, 16).tolist()
+                   for _ in range(n_req)]
+        t0 = time.perf_counter()
+        reqs = [b.submit(p, max_new_tokens=new_tokens, sampling=sp)
+                for p in prompts]
+        guard = 0
+        while not all(r.done.is_set() for r in reqs):
+            b.step()
+            guard += 1
+            assert guard < 2000
+        dt = time.perf_counter() - t0
+        for r in reqs:
+            assert r.error is None, r.error
+        return sum(len(r.tokens) for r in reqs) / dt
+
+    run(8, 0)   # warmup: compiles the admission + chunk programs
+    run(1, 1)
+    single = max(run(1, 2), run(1, 3))
+    batched = max(run(8, 4), run(8, 5))
+    if batched < 1.5 * single:   # one retry: absorb a CI scheduler stall
+        single = min(single, max(run(1, 6), run(1, 7)))
+        batched = max(batched, run(8, 8), run(8, 9))
+    assert batched >= 1.5 * single, (batched, single)
+    # the amortization counters saw the batch: > 1.5 tokens per weight
+    # pass over the batched run is what the wall-clock win is made of
+    c = b.metrics.snapshot()["counters"]
+    assert c["batcher_tokens_emitted"] >= 1.5 * c["batcher_weight_passes"]
