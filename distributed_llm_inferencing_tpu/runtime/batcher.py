@@ -321,12 +321,15 @@ class ContinuousBatcher:
 
     # ---- public API ---------------------------------------------------
 
-    def submit(self, prompt: Sequence[int], max_new_tokens: int = 100,
-               sampling: Optional[SamplingParams] = None,
-               eos_token_id: Optional[int] = None,
-               stream_cb: Optional[Callable[[int], None]] = None,
-               seed: Optional[int] = None,
-               trace_ctx=None) -> BatchRequest:
+    def _make_request(self, prompt: Sequence[int], max_new_tokens: int = 100,
+                      sampling: Optional[SamplingParams] = None,
+                      eos_token_id: Optional[int] = None,
+                      stream_cb: Optional[Callable[[int], None]] = None,
+                      seed: Optional[int] = None,
+                      trace_ctx=None) -> BatchRequest:
+        """Validate and build one BatchRequest WITHOUT enqueueing it —
+        submit()/submit_many() construct first so a bad spec can never
+        leave siblings half-enqueued."""
         if not prompt:
             raise ValueError("empty prompt")
         if seed is None:
@@ -343,6 +346,16 @@ class ContinuousBatcher:
             raise ValueError(
                 f"prompt ({len(req.prompt)}) + max_new_tokens "
                 f"({req.max_new_tokens}) exceeds max_seq {self.max_seq}")
+        return req
+
+    def submit(self, prompt: Sequence[int], max_new_tokens: int = 100,
+               sampling: Optional[SamplingParams] = None,
+               eos_token_id: Optional[int] = None,
+               stream_cb: Optional[Callable[[int], None]] = None,
+               seed: Optional[int] = None,
+               trace_ctx=None) -> BatchRequest:
+        req = self._make_request(prompt, max_new_tokens, sampling,
+                                 eos_token_id, stream_cb, seed, trace_ctx)
         with self._lock:
             self.queue.append(req)
             depth = len(self.queue)
@@ -350,6 +363,24 @@ class ContinuousBatcher:
         self.metrics.gauge("batcher_queue_depth", depth)
         self._work.set()
         return req
+
+    def submit_many(self, specs: Sequence[dict]) -> List[BatchRequest]:
+        """Multi-submit entry for batched RPC dispatch (the worker's
+        ``/inference_batch`` handler): validate and build every request
+        FIRST (all-or-nothing — a ValueError enqueues nothing), then
+        append them under ONE lock acquisition with one scheduler wake,
+        preserving the caller's order end-to-end. One master dispatch
+        batch therefore admits FIFO, exactly as submitted."""
+        reqs = [self._make_request(**spec) for spec in specs]
+        if not reqs:
+            return []
+        with self._lock:
+            self.queue.extend(reqs)
+            depth = len(self.queue)
+        self.metrics.inc("batcher_requests_submitted", len(reqs))
+        self.metrics.gauge("batcher_queue_depth", depth)
+        self._work.set()
+        return reqs
 
     def start(self):
         if self._thread is None:

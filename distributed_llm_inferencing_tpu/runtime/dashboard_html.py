@@ -175,6 +175,7 @@ NODES = f"""<!doctype html><html><head><title>Nodes</title>{_STYLE}
 <h2>Worker Nodes</h2>
 <table><thead><tr><th>ID</th><th>Name</th><th>Address</th><th>Status</th>
 <th>Devices</th><th>CPU %</th><th>Mem %</th><th>Models</th><th>In-flight</th>
+<th>Queue</th><th>Free KV</th><th>Lat EWMA</th>
 <th></th></tr></thead><tbody id="nodes"></tbody></table>
 <h2 style="margin-top:24px">Placement Plans</h2>
 <table><thead><tr><th>ID</th><th>Model</th><th>Mesh</th><th>Devices</th>
@@ -267,6 +268,11 @@ async function refresh() {{
     `<td>${{n.resources && n.resources.cpu != null ? n.resources.cpu : ''}}</td>`+
     `<td>${{n.resources && n.resources.memory != null ? n.resources.memory : ''}}</td>`+
     `<td>${{models}}</td><td>${{n.inflight}}</td>`+
+    // queue-aware scheduler inputs: worker-reported batcher queue
+    // depth, free KV blocks, and the master's completion-latency EWMA
+    `<td>${{n.queue_depth ?? '–'}}</td>`+
+    `<td>${{n.free_kv_blocks ?? '–'}}</td>`+
+    `<td>${{n.latency_ewma_ms != null ? n.latency_ewma_ms+' ms' : '–'}}</td>`+
     `<td><button onclick="removeNode(${{n.id}})">Remove</button></td></tr>`;
   }}).join('');
 }}

@@ -284,7 +284,7 @@ class JsonHTTPService:
 
     def serve(self, host: str, port: int, background: bool = False
               ) -> ThreadingHTTPServer:
-        self._server = ThreadingHTTPServer((host, port), self.make_handler())
+        self._server = _TrackingHTTPServer((host, port), self.make_handler())
         self._server.daemon_threads = True
         if background:
             t = threading.Thread(target=self._server.serve_forever, daemon=True)
@@ -298,12 +298,52 @@ class JsonHTTPService:
         return self._server.server_address[1] if self._server else 0
 
     def shutdown(self):
-        """Stop serving and close the listener. Idempotent — a crash
-        fault may already have shut the server before teardown runs."""
+        """Stop serving, close the listener, AND sever every live
+        client connection. Keep-alive clients (the master's pooled RPC
+        sessions) otherwise keep talking to this 'dead' server through
+        their established sockets — a real process death closes them
+        all, so a simulated one (chaos crash fault, test teardown) must
+        too. Idempotent — a crash fault may already have shut the
+        server before teardown runs."""
         srv, self._server = self._server, None
         if srv:
             srv.shutdown()
             srv.server_close()
+            if hasattr(srv, "close_client_connections"):
+                srv.close_client_connections()
+
+
+class _TrackingHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer that remembers live client sockets so
+    shutdown can hard-close persistent (keep-alive) connections, not
+    just the listener."""
+
+    def __init__(self, *a, **kw):
+        self._client_socks: set = set()
+        self._client_socks_lock = threading.Lock()
+        super().__init__(*a, **kw)
+
+    def get_request(self):
+        sock, addr = super().get_request()
+        with self._client_socks_lock:
+            self._client_socks.add(sock)
+        return sock, addr
+
+    def shutdown_request(self, request):
+        with self._client_socks_lock:
+            self._client_socks.discard(request)
+        super().shutdown_request(request)
+
+    def close_client_connections(self):
+        import socket
+        with self._client_socks_lock:
+            socks = list(self._client_socks)
+            self._client_socks.clear()
+        for s in socks:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
 
 
 class _Streaming(Exception):
@@ -316,6 +356,35 @@ def _wants_request(fn) -> bool:
         return "_request" in inspect.signature(fn).parameters
     except (TypeError, ValueError):
         return False
+
+
+def jsonl_stream(request_handler, events):
+    """Write a chunked JSON-lines response from an iterator of dict
+    events — one JSON object per line, flushed as produced. Unlike
+    ``sse_stream`` the connection stays keep-alive (chunked framing
+    delimits the body), so a master demultiplexing per-sub-request
+    results off ``POST /inference_batch`` returns the connection to its
+    pool when the stream ends instead of paying a fresh TCP handshake
+    per batch."""
+    request_handler.send_response(200)
+    request_handler.send_header("Content-Type", "application/jsonlines")
+    request_handler.send_header("Transfer-Encoding", "chunked")
+    request_handler._trace_headers()
+    request_handler.end_headers()
+    try:
+        for ev in events:
+            data = json.dumps(ev).encode() + b"\n"
+            request_handler.wfile.write(
+                f"{len(data):x}\r\n".encode() + data + b"\r\n")
+            request_handler.wfile.flush()
+        request_handler.wfile.write(b"0\r\n\r\n")
+        request_handler.wfile.flush()
+    except (BrokenPipeError, ConnectionResetError):
+        # the caller vanished mid-stream (its timeout fired, or a fault
+        # cut the link): the producer threads still run to completion so
+        # their results land in the idempotency cache for the retry
+        request_handler.close_connection = True
+    raise _Streaming()
 
 
 def sse_stream(request_handler, events):

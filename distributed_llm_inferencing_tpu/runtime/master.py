@@ -34,7 +34,7 @@ from distributed_llm_inferencing_tpu.runtime.state import Store
 from distributed_llm_inferencing_tpu.utils import trace
 from distributed_llm_inferencing_tpu.utils.logging import setup_logging
 from distributed_llm_inferencing_tpu.utils.metrics import (
-    Metrics, hist_quantile, parse_prometheus)
+    Metrics, hist_quantile, parse_prometheus, sanitize_name)
 
 log = setup_logging("master")
 
@@ -58,12 +58,65 @@ FAILURE_STRIKES = 3       # breaker trip threshold (reference: one strike
 RETRY_BACKOFF_BASE = float(os.environ.get("DLI_RETRY_BACKOFF_BASE", 0.5))
 RETRY_BACKOFF_MAX = float(os.environ.get("DLI_RETRY_BACKOFF_MAX", 30.0))
 
+# Control-plane shape (docs/serving.md knob table): how many dispatcher
+# threads pump the claim->group->RPC pipeline, how many requests one
+# claim transaction may take, how many keep-alive connections each
+# per-node session pools, and how fast a connect must fail (a
+# black-holed SYN must not burn the 120s read budget before the breaker
+# can see it).
+DISPATCH_WORKERS = int(os.environ.get("DLI_DISPATCH_WORKERS", 8))
+DISPATCH_BATCH = max(1, int(os.environ.get("DLI_DISPATCH_BATCH", 8)))
+# The worker rejects batches larger than its own DLI_BATCH_RPC_MAX
+# (worker.py) with a whole-batch 400 — a deterministic config mismatch
+# the retry loop can never fix. Mirror the same knob/default here and
+# chunk oversized groups so a mistuned DLI_DISPATCH_BATCH degrades to
+# more RPCs instead of a strike-and-requeue storm.
+BATCH_RPC_CAP = max(1, int(os.environ.get("DLI_BATCH_RPC_MAX", 256)))
+RPC_POOL_SIZE = int(os.environ.get("DLI_RPC_POOL_SIZE", 8))
+RPC_CONNECT_TIMEOUT = float(os.environ.get("DLI_RPC_CONNECT_TIMEOUT", 5.0))
+# Queue-aware scheduling: EWMA smoothing for observed per-node
+# completion latency, and how old a worker-reported queue/KV snapshot
+# may be before the scheduler stops trusting it.
+SCHED_EWMA_ALPHA = float(os.environ.get("DLI_SCHED_EWMA_ALPHA", 0.2))
+SCHED_STALE_S = float(os.environ.get("DLI_SCHED_STALE_S", 30.0))
+_BATCH_SIZE_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0)
+MODEL_GAUGES_MAX = 32     # per-model queue gauges (client-named) cap
+
+
+try:
+    from urllib3.exceptions import ReadTimeoutError as _U3ReadTimeout
+except Exception:                                    # pragma: no cover
+    class _U3ReadTimeout(Exception):
+        pass
+
+
+def _is_timeout_error(e) -> bool:
+    """requests raises a plain read timeout as ``exceptions.Timeout``,
+    but one that fires MID-STREAM (inside ``iter_lines`` on a batch
+    RPC) is re-raised as ``ConnectionError`` wrapping the urllib3
+    ``ReadTimeoutError``. Both mean the worker is slow, not dead: the
+    sticky join/replay retry semantics must apply, and the breaker must
+    not be struck."""
+    if isinstance(e, http.exceptions.ConnectTimeout):
+        # SYN never answered: unreachable, not slow. ConnectTimeout
+        # subclasses Timeout, but it must strike/exclude like any
+        # connection fault — the whole point of the fast (connect,
+        # read) tuple is that the breaker sees a black-holed node in
+        # seconds, and there is no in-flight generation to rejoin
+        return False
+    if isinstance(e, http.exceptions.Timeout):
+        return True
+    return (isinstance(e, http.exceptions.ConnectionError)
+            and any(isinstance(a, _U3ReadTimeout)
+                    for a in getattr(e, "args", ())))
+
 
 class _NodeUnavailable(Exception):
     """Worker is up but not taking work (draining, degraded slice, own
     budget expired): failover to another node WITHOUT a breaker strike.
-    ``in_flight`` means the node still RUNS this request's generation —
-    the retry must return to it (join/replay), not fail over."""
+    ``in_flight`` means the node still holds work for this request — a
+    running generation to join/replay, or a mid-flight model load — so
+    the retry must return to it (no exclusion), not fail over."""
 
     def __init__(self, message: str, in_flight: bool = False):
         super().__init__(message)
@@ -72,15 +125,40 @@ class _NodeUnavailable(Exception):
 
 class Master:
     def __init__(self, db_path: str = ":memory:", *,
-                 dispatcher_threads: int = 4,
+                 dispatcher_threads: int = DISPATCH_WORKERS,
                  health_interval: float = 10.0,
                  auth_key: Optional[str] = None,
                  infer_timeout: float = INFER_TIMEOUT,
-                 retry_backoff_base: float = RETRY_BACKOFF_BASE):
-        self.store = Store(db_path)
+                 retry_backoff_base: float = RETRY_BACKOFF_BASE,
+                 dispatch_batch: int = DISPATCH_BATCH,
+                 rpc_pool: Optional[bool] = None,
+                 rpc_pool_size: int = RPC_POOL_SIZE):
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        # Group-commit store: the dispatch hot path's status writes
+        # batch into one transaction per flush window; terminal writes
+        # barrier on the commit (durability before client visibility),
+        # and a flushed requeue wakes the dispatchers immediately.
+        self.store = Store(db_path, group_commit=True,
+                           on_flush=self._wake.set)
         self.infer_timeout = infer_timeout
         self.worker_infer_budget = max(1.0, infer_timeout - 5)
         self.retry_backoff_base = retry_backoff_base
+        self.dispatch_batch = max(1, int(dispatch_batch))
+        if rpc_pool is None:
+            rpc_pool = os.environ.get("DLI_RPC_POOL", "1") not in (
+                "0", "false")
+        self._rpc_pool = bool(rpc_pool)
+        self._rpc_pool_size = max(1, int(rpc_pool_size))
+        self._sessions: Dict[tuple, object] = {}   # (host, port) -> Session
+        self._sessions_lock = threading.Lock()
+        # queue-aware scheduling state: worker-reported batcher queue
+        # depth + free KV blocks (health sweeps and inference responses
+        # both refresh it) and an EWMA of observed completion latency
+        self._node_runtime: Dict[int, dict] = {}
+        self._node_lat_ewma: Dict[int, float] = {}
+        self._ewma_alpha = SCHED_EWMA_ALPHA
+        self._pending_models: Set[str] = set()
         n = self.store.recover_stale_processing(max_attempts=MAX_ATTEMPTS)
         if n:
             log.info("recovered %d request(s) stranded by a previous run", n)
@@ -100,8 +178,6 @@ class Master:
         # req_id -> submitter's SpanCtx: dispatch runs on another thread,
         # so the request's trace link rides this map, not a contextvar
         self._trace_ctx: Dict[int, object] = {}
-        self._stop = threading.Event()
-        self._wake = threading.Event()
         self._threads = []
         self._dispatcher_threads = dispatcher_threads
 
@@ -165,15 +241,111 @@ class Master:
             raise http.exceptions.ReadTimeout("injected rpc timeout")
         raise http.exceptions.ConnectionError("injected rpc fault")
 
-    def _worker_get(self, node, path, timeout):
-        self._rpc_fault(path)
-        return http.get(self.store.node_url(node) + path,
-                        headers=self._headers(), timeout=timeout)
+    def _session(self, node):
+        """Per-node keep-alive ``requests.Session`` with a bounded
+        connection pool. The worker's httpd speaks HTTP/1.1 keep-alive
+        and drains request bodies, so reuse is free — the old per-call
+        module-level ``requests.get/post`` paid a TCP handshake for
+        every RPC, health probe, and metrics scrape."""
+        if not self._rpc_pool:
+            return None
+        key = (node["host"], node["port"])
+        with self._sessions_lock:
+            s = self._sessions.get(key)
+            if s is None:
+                s = http.Session()
+                adapter = http.adapters.HTTPAdapter(
+                    pool_connections=2, pool_maxsize=self._rpc_pool_size)
+                s.mount("http://", adapter)
+                s.mount("https://", adapter)
+                s._dli_conns_seen = 0
+                s._dli_reuse_debt = 0
+                # per-session accounting lock: the reuse bookkeeping is
+                # on every RPC's hot path, and the global _sessions_lock
+                # would serialize independent nodes' dispatchers
+                s._dli_lock = threading.Lock()
+                self._sessions[key] = s
+            return s
 
-    def _worker_post(self, node, path, body, timeout):
+    def _purge_session(self, node):
+        """Drop the node's pooled keep-alive sockets after a
+        connection-level fault. A worker restart leaves up to
+        pool_maxsize dead sockets in the pool; without the purge each
+        subsequent RPC pulls one, fails before any bytes move, and
+        turns ONE fault event into pool_maxsize breaker strikes against
+        a healthy process. The next RPC dials fresh."""
+        with self._sessions_lock:
+            s = self._sessions.pop((node["host"], node["port"]), None)
+        if s is not None:
+            try:
+                s.close()
+            except Exception:
+                pass
+
+    def _count_conn_reuse(self, sess):
+        """Created-vs-reused accounting: urllib3's per-host pool counts
+        every real socket it opens (``num_connections``); the delta
+        since the last RPC on this session is how many THIS call
+        created. No delta means the call rode a pooled connection."""
+        try:
+            # private urllib3 surface: if a renamed attr ever breaks
+            # this, fail into the except (counters freeze at 0 and the
+            # smoke gate trips loudly) rather than counting every call
+            # as reused with pooling silently broken
+            pools = sess.get_adapter("http://").poolmanager.pools
+            created = sum(p.num_connections
+                          for p in list(pools._container.values()))
+        except Exception:
+            return
+        with sess._dli_lock:
+            delta = created - sess._dli_conns_seen
+            if delta > 0:
+                sess._dli_conns_seen = created
+                # a delta > 1 means concurrent calls opened the extra
+                # sockets; they will each observe delta == 0 later and
+                # must NOT count as reuse — carry the debt so the
+                # invariant reused == calls - sockets_created holds
+                sess._dli_reuse_debt += delta - 1
+                reused = False
+            elif sess._dli_reuse_debt > 0:
+                sess._dli_reuse_debt -= 1
+                reused = False
+            else:
+                reused = True
+        if delta > 0:
+            self.metrics.inc("master_rpc_conns_created", delta)
+        elif reused:
+            self.metrics.inc("master_rpc_conns_reused")
+
+    def _worker_get(self, node, path, timeout, stream=False):
         self._rpc_fault(path)
-        return http.post(self.store.node_url(node) + path, json=body,
-                         headers=self._headers(), timeout=timeout)
+        url = self.store.node_url(node) + path
+        to = (min(RPC_CONNECT_TIMEOUT, timeout), timeout)
+        sess = self._session(node)
+        if sess is None:
+            r = http.get(url, headers=self._headers(), timeout=to,
+                         stream=stream)
+            self.metrics.inc("master_rpc_conns_created")
+            return r
+        r = sess.get(url, headers=self._headers(), timeout=to,
+                     stream=stream)
+        self._count_conn_reuse(sess)
+        return r
+
+    def _worker_post(self, node, path, body, timeout, stream=False):
+        self._rpc_fault(path)
+        url = self.store.node_url(node) + path
+        to = (min(RPC_CONNECT_TIMEOUT, timeout), timeout)
+        sess = self._session(node)
+        if sess is None:
+            r = http.post(url, json=body, headers=self._headers(),
+                          timeout=to, stream=stream)
+            self.metrics.inc("master_rpc_conns_created")
+            return r
+        r = sess.post(url, json=body, headers=self._headers(), timeout=to,
+                      stream=stream)
+        self._count_conn_reuse(sess)
+        return r
 
     # ---- node API ----------------------------------------------------
 
@@ -187,8 +359,9 @@ class Master:
             return 400, {"status": "error", "message": "name and host required"}
         node = {"host": host, "port": port}
         try:
-            r = http.get(f"http://{host}:{port}/health",
-                         headers=self._headers(), timeout=HEALTH_TIMEOUT)
+            # through the pooled session: the registration probe warms
+            # the keep-alive connection the health loop will reuse
+            r = self._worker_get(node, "/health", HEALTH_TIMEOUT)
             r.raise_for_status()
             info = r.json()
         except Exception as e:
@@ -226,6 +399,9 @@ class Master:
         except Exception as e:
             log.warning("unload during remove failed: %s", e)
         self.store.remove_node(int(node_id))
+        self._purge_session(node)
+        self._node_runtime.pop(int(node_id), None)
+        self._node_lat_ewma.pop(int(node_id), None)
         return {"status": "success"}
 
     def api_node_status(self, body):
@@ -234,6 +410,10 @@ class Master:
         nodes = []
         for n in self.store.list_nodes():
             info = json.loads(n.get("info") or "{}")
+            rt = self._node_runtime.get(n["id"]) or {}
+            rt_fresh = bool(rt) and (time.time() - rt.get("at", 0)
+                                     <= SCHED_STALE_S)
+            ewma = self._node_lat_ewma.get(n["id"])
             nodes.append({
                 "id": n["id"], "name": n["name"], "host": n["host"],
                 "port": n["port"], "is_active": bool(n["is_active"]),
@@ -244,6 +424,15 @@ class Master:
                 "resources": info.get("resources"),
                 "loaded_models": info.get("loaded_models", []),
                 "inflight": self._inflight.get(n["id"], 0),
+                # queue-aware scheduler inputs (nodes dashboard
+                # columns), behind the same staleness cutoff the
+                # scheduler applies — a worker that stopped reporting
+                # must not render its frozen stats as current
+                "queue_depth": rt.get("queue") if rt_fresh else None,
+                "free_kv_blocks": (rt.get("free_blocks")
+                                   if rt_fresh else None),
+                "latency_ewma_ms": (round(ewma * 1e3, 1)
+                                    if ewma is not None else None),
             })
         return {"status": "success", "nodes": nodes}
 
@@ -448,13 +637,113 @@ class Master:
     # ---- scheduling --------------------------------------------------
 
     def _node_models(self, node) -> set:
-        info = json.loads(node.get("info") or "{}")
-        return {m["name"] for m in info.get("loaded_models", [])}
+        # memoized on the row dict: a dispatch wave reuses one node
+        # snapshot across every claimed request, and the info blob
+        # (full worker /health body) is expensive to re-parse per pick
+        cached = node.get("_models")
+        if cached is None:
+            info = json.loads(node.get("info") or "{}")
+            cached = {m["name"] for m in info.get("loaded_models", [])}
+            node["_models"] = cached
+        return cached
+
+    def _note_runtime(self, node_id: int, info: dict,
+                      merge: bool = False):
+        """Fold a worker's self-reported scheduler state (already in its
+        /health body: batcher queue depth + free KV blocks per loaded
+        model) into the queue-aware scheduler's view. Engine-mode-only
+        nodes report no scheduler stats and fall back to in-flight
+        counting. ``merge=True`` means the payload covers only the
+        models it names (a completion's piggyback): other models keep
+        their last-known stats — replacing the whole-node aggregate
+        with ONE model's view would make a busy multi-model node look
+        idle until the next health sweep."""
+        models: Dict[str, dict] = {}
+        for m in info.get("loaded_models", []):
+            sch = m.get("scheduler")
+            if not isinstance(sch, dict):
+                continue
+            bf = sch.get("blocks_free")
+            models[str(m.get("name") or "")] = {
+                "queue": int(sch.get("queued") or 0),
+                "free": int(bf) if bf is not None else None}
+        if merge:
+            prev = self._node_runtime.get(node_id)
+            if prev and prev.get("models"):
+                merged = dict(prev["models"])
+                merged.update(models)
+                models = merged
+        queue = free = None
+        for st in models.values():
+            queue = (queue or 0) + st["queue"]
+            if st["free"] is not None:
+                free = st["free"] if free is None else min(free, st["free"])
+        self._node_runtime[node_id] = {
+            "queue": queue, "free_blocks": free, "at": time.time(),
+            "models": models}
+
+    def _note_latency(self, node_id: int, seconds: float):
+        prev = self._node_lat_ewma.get(node_id)
+        a = self._ewma_alpha
+        self._node_lat_ewma[node_id] = (
+            seconds if prev is None else a * seconds + (1 - a) * prev)
+
+    def _score_pick(self, cands):
+        """Queue-aware choice among schedulable candidates. Primary
+        load = max(master-side in-flight, worker-reported batcher queue
+        depth) — max, not sum: every request this master dispatched and
+        the worker still queues would otherwise count twice, biasing
+        picks TOWARD nodes that report no scheduler stats (the honest
+        reporter loses). The worker-side number still dominates when
+        other masters feed the same node. Ties break to the node with
+        the most free KV blocks,
+        then the lowest completion-latency EWMA. With no fresh
+        worker-reported state at all this degrades to the old
+        least-in-flight rule. Returns (node, reason) — the reason feeds
+        the ``scheduler_pick_*`` counters so the policy is observable.
+        Caller holds ``_inflight_lock``."""
+        now = time.time()
+        rt = {}
+        for n in cands:
+            s = self._node_runtime.get(n["id"])
+            if s and now - s["at"] <= SCHED_STALE_S and \
+                    s.get("queue") is not None:
+                rt[n["id"]] = s
+        if not rt:
+            return min(cands, key=lambda n: self._inflight.get(n["id"], 0)), \
+                "fallback"
+
+        def primary(n):
+            s = rt.get(n["id"])
+            return max(self._inflight.get(n["id"], 0),
+                       s["queue"] if s else 0)
+
+        lo = min(primary(n) for n in cands)
+        tied = [n for n in cands if primary(n) == lo]
+        if len(tied) == 1:
+            return tied[0], "queue_depth"
+        free = {n["id"]: (rt.get(n["id"]) or {}).get("free_blocks")
+                for n in tied}
+        known = [v for v in free.values() if v is not None]
+        if known and len(set(known)) > 1:
+            best = max(known)
+            tied = [n for n in tied if free[n["id"]] == best]
+            if len(tied) == 1:
+                return tied[0], "free_blocks"
+        ew = {n["id"]: self._node_lat_ewma.get(n["id"]) for n in tied}
+        vals = [v for v in ew.values() if v is not None]
+        if vals and len(set(vals)) > 1:
+            best = min(vals)
+            for n in tied:
+                if ew[n["id"]] == best:
+                    return n, "latency_ewma"
+        return tied[0], "queue_depth"
 
     def _pick_node(self, model: Optional[str],
                    exclude: Optional[Set[int]] = None,
                    reserve: bool = False,
-                   prefer: Optional[int] = None):
+                   prefer: Optional[int] = None,
+                   nodes: Optional[list] = None):
         """Least-loaded schedulable node, preferring ones with the model
         already loaded (reference: always .first(), views.py:389-391).
 
@@ -474,14 +763,18 @@ class Master:
         and not excluded: a timeout retry goes back to the node that
         still holds the in-flight generation (idempotency join/replay)
         instead of re-generating on an idle-looking peer.
+
+        ``nodes`` supplies a pre-fetched active-node snapshot: one
+        dispatch wave reserves a node per claimed request, and one
+        store query per WAVE replaces one per request (the in-flight
+        counts that make picks diverge live in memory, not in the
+        snapshot).
         """
         exclude = exclude or set()
-        nodes = [n for n in self.store.list_nodes(active_only=True)
-                 if not n.get("draining")]
+        if nodes is None:
+            nodes = self.store.list_nodes(active_only=True)
+        nodes = [n for n in nodes if not n.get("draining")]
         with self._inflight_lock:
-            def load_key(n):
-                return self._inflight.get(n["id"], 0)
-
             def probe_ok(n):
                 return ((n.get("breaker_state") or "closed") != "half_open"
                         or self._inflight.get(n["id"], 0) == 0)
@@ -494,7 +787,11 @@ class Master:
                 pinned = [n for n in pool if n["id"] == prefer]
                 have = pinned or [n for n in pool
                                   if model and model in self._node_models(n)]
-                chosen = min(have or pool, key=load_key)
+                if pinned:
+                    chosen, reason = pinned[0], "pinned"
+                else:
+                    chosen, reason = self._score_pick(have or pool)
+                self.metrics.inc(f"scheduler_pick_{reason}")
                 if reserve:
                     self._inflight[chosen["id"]] = \
                         self._inflight.get(chosen["id"], 0) + 1
@@ -505,14 +802,22 @@ class Master:
         try:
             r = self._worker_get(node, "/health", HEALTH_TIMEOUT)
             r.raise_for_status()
+            info = r.json()
+            node.pop("_models", None)   # invalidate the pick memo
+            # refresh the shared wave-snapshot dict too: later chunks /
+            # fallback singles of this wave re-read node["info"], and a
+            # stale copy would pay a redundant /load_model + /health
+            # pair per request right after a lazy load
+            node["info"] = json.dumps(info)
             self.store.update_node(
-                node["id"], info=r.json(), is_active=1,
+                node["id"], info=info, is_active=1,
                 consecutive_failures=0, last_heartbeat=time.time())
         except Exception:
             pass
 
-    def _execute(self, req) -> bool:
-        """Run one request on a chosen node. True on success."""
+    def _execute(self, req, node=None) -> bool:
+        """Run one request on a chosen (or pre-reserved) node. True on
+        success."""
         tracer = trace.get_tracer()
         # adopt the submit-time trace (kept across failover retries; freed
         # when the request reaches a terminal state)
@@ -527,7 +832,7 @@ class Master:
                 # covers the failed execution, not queueing)
                 tracer.record("master.queued", req["created_at"],
                               time.time(), parent=trace.current())
-            return self._execute_on_node(req)
+            return self._execute_on_node(req, node)
 
     def _trace_done(self, req_id: int):
         self._trace_ctx.pop(req_id, None)
@@ -539,7 +844,12 @@ class Master:
         d = self.retry_backoff_base * (2 ** (attempts + 1))
         return min(RETRY_BACKOFF_MAX, d * (1.0 + random.random()))
 
-    def _execute_on_node(self, req) -> bool:
+    def _reserve_node_for(self, req, nodes=None):
+        """Pick (and reserve an in-flight slot on) a node for one
+        claimed request, honoring its exclusion set and the timeout-
+        retry pin. ``nodes`` forwards a per-wave snapshot to
+        _pick_node. Returns None after parking or terminally failing
+        the request when nothing is schedulable."""
         excluded = set(req.get("excluded_nodes") or [])
         # a retry whose previous node is NOT excluded got there via a
         # pure timeout: that node still holds the in-flight generation,
@@ -548,7 +858,7 @@ class Master:
                   if req.get("node_id") and req["node_id"] not in excluded
                   else None)
         node = self._pick_node(req["model_name"], exclude=excluded,
-                               reserve=True, prefer=prefer)
+                               reserve=True, prefer=prefer, nodes=nodes)
         if node is None:
             # nothing schedulable right now (all breakers open / nodes
             # draining): park instead of failing — at least a health
@@ -562,47 +872,226 @@ class Master:
             else:
                 self.store.mark_failed(req["id"], "no active worker nodes")
                 self._trace_done(req["id"])
-            return False
+        return node
+
+    def _infer_body(self, req) -> dict:
+        """The worker-side sub-request payload for one claimed request:
+        generation budget strictly under our HTTP timeout, plus the
+        idempotency/cancel tag."""
+        body = {
+            "model_name": req["model_name"],
+            "prompt": req["prompt"],
+            "sampling": req["sampling"],
+            "timeout": self.worker_infer_budget,
+            "request_tag": self._tag(req["id"]),
+        }
+        if req.get("max_length") is not None:
+            body["max_length"] = req["max_length"]
+        else:
+            body["max_new_tokens"] = req["max_new_tokens"]
+        return body
+
+    def _complete_request(self, req, node, data) -> None:
+        """Terminal success tail shared by the single and batched
+        dispatch paths: orphan-generation cancel, store write (behind
+        the durability barrier), metrics, latency EWMA, trace cleanup,
+        breaker success edge."""
+        nid = node["id"]
+        prev = req.get("node_id")
+        if prev and prev != nid:
+            # an earlier timed-out attempt may have left a generation
+            # running on another node; it completed here instead, so
+            # stop that orphan from generating for nobody (best-effort
+            # — 404 if it already finished)
+            prev_node = self.store.get_node(prev)
+            if prev_node:
+                # fire-and-forget: the previous node is often DOWN
+                # (that's why the request failed over), and a blocking
+                # cancel here would stall the batch demux loop 5-10s
+                # per failed-over sub while siblings' results wait
+                def _cancel(tag=self._tag(req["id"]), pn=prev_node):
+                    try:
+                        self._worker_post(pn, "/cancel",
+                                          {"request_tag": tag}, 10)
+                    except Exception:
+                        pass
+                threading.Thread(target=_cancel, daemon=True,
+                                 name="cancel-orphan").start()
+        # barrier=False: the commit still gates client visibility (reads
+        # see only committed state); not blocking here keeps the batch
+        # demultiplexer reading result lines instead of waiting out a
+        # flush per sub-request
+        self.store.mark_completed(
+            req["id"], data.get("result", ""), nid,
+            data.get("execution_time", 0.0),
+            data.get("tokens_per_s", 0.0), barrier=False)
+        self.metrics.inc("requests_completed")
+        if data.get("idempotent"):
+            # a retry hit the worker's completed-result cache: the
+            # generation ran exactly once despite >1 dispatch
+            self.metrics.inc("requests_idempotent_replayed")
+        now = time.time()
+        self.metrics.observe("request_latency", now - req["created_at"])
+        if req.get("started_at"):
+            self._note_latency(nid, now - req["started_at"])
+            self.metrics.observe(
+                "master_dispatch_overhead",
+                max(0.0, now - req["started_at"]
+                    - float(data.get("execution_time") or 0.0)))
+        sch = data.get("scheduler")
+        if isinstance(sch, dict):
+            # piggybacked scheduler stats: fresher than the last health
+            # sweep, so fold them into the queue-aware view — merge, as
+            # they describe this request's model only
+            self._note_runtime(
+                nid, {"loaded_models": [{"name": req["model_name"],
+                                         "scheduler": sch}]}, merge=True)
+        self._trace_done(req["id"])
+        self._node_success(node)
+
+    def _fail_sub(self, req, node, e, strike=True, nodes=None) -> None:
+        """Terminal/requeue failure tail shared by the single and
+        batched dispatch paths — the semantics are per REQUEST even when
+        the RPC carried many: exclusion, sticky timeout pinning, parked
+        backoff, poison-request bounding, orphan cancel on a terminal
+        timeout. ``strike=False`` suppresses the breaker strike when the
+        caller already struck once for a shared connection-level fault
+        (one socket failure is one fault event, not N). ``nodes``
+        optionally supplies the caller's active-node snapshot so a
+        batch-wide fault resolves N subs with one store query."""
+        nid = node["id"]
+        log.warning("request %d failed on node %d: %s", req["id"], nid, e)
+        self.metrics.inc("requests_errored")
+        is_timeout = _is_timeout_error(e)
+        unavailable = isinstance(e, _NodeUnavailable)
+        terminal = req["attempts"] + 1 >= MAX_ATTEMPTS
+        excluded = set(req.get("excluded_nodes") or [])
+        if not terminal:
+            # Failover retry: exclude this node for the rest of the
+            # request's life, park the next attempt behind
+            # exponential backoff + jitter (an unavailable node gets
+            # no backoff — another node can take it immediately).
+            # A pure master-side timeout — or a join 408 flagged
+            # in_flight — does NOT exclude: the same node still holds
+            # the in-flight generation, and the retry (pinned back to
+            # it via the recorded node_id) joins it / replays its
+            # cached result instead of re-generating on a peer.
+            sticky = is_timeout or getattr(e, "in_flight", False)
+            # Delay policy: a sticky retry waits out the backoff so
+            # the generation it intends to join/replay has time to
+            # finish (immediate re-joins would burn the attempt
+            # budget in seconds). A plain unavailable (503/408)
+            # fails over with zero delay ONLY when a different node
+            # can actually take it — on a single-node cluster the
+            # fallback would hand the same draining node straight
+            # back, so park on the health loop's cadence instead.
+            if sticky or not unavailable:
+                delay = self._backoff(req["attempts"])
+            elif any(n["id"] not in excluded and n["id"] != nid
+                     and not n.get("draining")
+                     for n in (nodes if nodes is not None
+                               else self.store.list_nodes(active_only=True))):
+                delay = 0.0
+            else:
+                delay = max(self._backoff(req["attempts"]),
+                            self.health_interval * 1.5)
+            self.store.requeue(
+                req["id"],
+                excluded_node_id=None if sticky else nid,
+                delay_s=delay, last_node_id=nid)
+            self.metrics.inc("requests_requeued")
+            self._wake.set()
+        else:
+            self.store.mark_failed(req["id"], str(e), barrier=False)
+            self._trace_done(req["id"])
+            if is_timeout:
+                # terminal timeout: nobody will ever claim the
+                # result — best-effort cancel so the worker stops
+                # generating for nobody. (With retries left the
+                # generation KEEPS running: its result lands in the
+                # worker's idempotency cache for the retry.)
+                # fire-and-forget: a batch-wide terminal timeout would
+                # otherwise serialize up to a chunk's worth of blocking
+                # 10s cancel POSTs on the one group thread
+                def _cancel(tag=self._tag(req["id"])):
+                    try:
+                        self._worker_post(node, "/cancel",
+                                          {"request_tag": tag}, 10)
+                    except Exception:
+                        pass
+                threading.Thread(target=_cancel, daemon=True,
+                                 name="cancel-orphan").start()
+        # A read timeout means the worker is slow/busy (its generate
+        # lock serializes requests), not dead; a 503/408 means it is
+        # managing its own load. Striking either would deactivate
+        # healthy nodes. Connection-level errors do count toward the
+        # breaker.
+        if strike and not (is_timeout or unavailable):
+            self._node_failure(node)
+
+    def _reject(self, req, msg: str) -> None:
+        """Terminal user-error rejection (4xx except 408), identical on
+        the single and batched paths: no strike, no retry, no requeue.
+        barrier=False for the same reason as _complete_request — client
+        reads only see committed state, so the commit gates visibility."""
+        self.store.mark_failed(req["id"], msg, barrier=False)
+        self.metrics.inc("requests_rejected")
+        self._trace_done(req["id"])
+
+    def _ensure_model_loaded(self, node, model, sampling):
+        """Lazy-load ``model`` on ``node`` if missing (reference
+        views.py:397-401 — random init is NOT silently allowed; the
+        operator must preload, or the request must opt in). Shared by
+        the single and batched dispatch paths so failure classification
+        cannot diverge. Returns an error string for a terminal
+        client-side rejection (4xx except 408: user error, not the
+        node's fault — no strike, no retry); raises _NodeUnavailable /
+        RuntimeError for failover-class failures; None on success."""
+        if model in self._node_models(node):
+            return None
+        body = {"model_name": model}
+        if sampling.get("allow_random_init"):
+            body["allow_random_init"] = True
+        if sampling.get("checkpoint_path"):
+            body["checkpoint_path"] = sampling["checkpoint_path"]
+        r = self._worker_post(node, "/load_model", body, LOAD_TIMEOUT)
+        if r.status_code == 503:
+            raise _NodeUnavailable(f"load refused: {r.text[:200]}")
+        if r.status_code == 409:
+            # another dispatcher's load of this model is mid-flight on
+            # the node (worker _do_load): transient, not user error —
+            # park/failover instead of terminally rejecting, which on
+            # the batched path would reject a whole group at once.
+            # in_flight=True borrows the sticky retry shape: no
+            # exclusion (a lifetime exclusion would strand requests on
+            # a single-node cluster), backoff delay, retry pinned back
+            # here — by then the load has likely finished
+            raise _NodeUnavailable(f"load in progress: {r.text[:200]}",
+                                   in_flight=True)
+        if 400 <= r.status_code < 500 and r.status_code != 408:
+            return f"load rejected: {r.text[:200]}"
+        if r.status_code != 200:
+            raise RuntimeError(f"load_model failed: {r.text[:200]}")
+        self._refresh_node(node)
+        return None
+
+    def _execute_on_node(self, req, node=None) -> bool:
+        if node is None:
+            node = self._reserve_node_for(req)
+            if node is None:
+                return False
         nid = node["id"]   # in-flight slot already reserved by _pick_node
         try:
-            if req["model_name"] not in self._node_models(node):
-                # lazy load, like reference views.py:397-401 — random init is
-                # NOT silently allowed; operator must preload or register a
-                # checkpointed model unless the request says otherwise.
-                body = {"model_name": req["model_name"]}
-                if req["sampling"].get("allow_random_init"):
-                    body["allow_random_init"] = True
-                if req["sampling"].get("checkpoint_path"):
-                    body["checkpoint_path"] = req["sampling"]["checkpoint_path"]
-                r = self._worker_post(node, "/load_model", body, LOAD_TIMEOUT)
-                if r.status_code == 503:
-                    raise _NodeUnavailable(f"load refused: {r.text[:200]}")
-                if 400 <= r.status_code < 500 and r.status_code != 408:
-                    # user error (unknown model, bad request): terminal, and
-                    # NOT the node's fault — no strike, no retry
-                    self.store.mark_failed(req["id"],
-                                           f"load rejected: {r.text[:200]}")
-                    self.metrics.inc("requests_rejected")
-                    self._trace_done(req["id"])
-                    return False
-                if r.status_code != 200:
-                    raise RuntimeError(f"load_model failed: {r.text[:200]}")
-                self._refresh_node(node)
-            infer_body = {
-                "model_name": req["model_name"],
-                "prompt": req["prompt"],
-                "sampling": req["sampling"],
-                # worker-side generation budget < our HTTP timeout, and a
-                # tag that makes dispatch idempotent: the worker caches
-                # the completed result under it, so a timeout retry
-                # replays the generation instead of re-running it
-                "timeout": self.worker_infer_budget,
-                "request_tag": self._tag(req["id"]),
-            }
-            if req.get("max_length") is not None:
-                infer_body["max_length"] = req["max_length"]
-            else:
-                infer_body["max_new_tokens"] = req["max_new_tokens"]
+            err = self._ensure_model_loaded(node, req["model_name"],
+                                            req["sampling"])
+            if err is not None:
+                self._reject(req, err)
+                return False
+            # worker-side generation budget < our HTTP timeout, and a
+            # tag that makes dispatch idempotent: the worker caches
+            # the completed result under it, so a timeout retry
+            # replays the generation instead of re-running it
+            infer_body = self._infer_body(req)
             self._processing[req["id"]] = node
             try:
                 # the dispatch span is the parent the worker's HTTP server
@@ -629,108 +1118,244 @@ class Master:
                     f"worker unavailable ({r.status_code}): {r.text[:200]}",
                     in_flight=still)
             if 400 <= r.status_code < 500:
-                self.store.mark_failed(req["id"],
-                                       f"rejected: {r.text[:200]}")
-                self.metrics.inc("requests_rejected")
-                self._trace_done(req["id"])
+                self._reject(req, f"rejected: {r.text[:200]}")
                 return False
             if r.status_code != 200:
                 raise RuntimeError(f"inference failed: {r.text[:200]}")
             data = r.json()
-            prev = req.get("node_id")
-            if prev and prev != nid:
-                # an earlier timed-out attempt may have left a
-                # generation running on another node; it completed here
-                # instead, so stop that orphan from generating for
-                # nobody (best-effort — 404 if it already finished)
-                prev_node = self.store.get_node(prev)
-                if prev_node:
-                    try:
-                        self._worker_post(prev_node, "/cancel",
-                                          {"request_tag":
-                                           self._tag(req["id"])}, 10)
-                    except Exception:
-                        pass
-            self.store.mark_completed(
-                req["id"], data.get("result", ""), nid,
-                data.get("execution_time", 0.0),
-                data.get("tokens_per_s", 0.0))
-            self.metrics.inc("requests_completed")
-            if data.get("idempotent"):
-                # a retry hit the worker's completed-result cache: the
-                # generation ran exactly once despite >1 dispatch
-                self.metrics.inc("requests_idempotent_replayed")
-            self.metrics.observe("request_latency",
-                                 time.time() - req["created_at"])
-            self._trace_done(req["id"])
-            self._node_success(node)
+            self._complete_request(req, node, data)
             return True
         except Exception as e:
-            log.warning("request %d failed on node %d: %s", req["id"], nid, e)
-            self.metrics.inc("requests_errored")
-            is_timeout = isinstance(e, http.exceptions.Timeout)
-            unavailable = isinstance(e, _NodeUnavailable)
-            terminal = req["attempts"] + 1 >= MAX_ATTEMPTS
-            if not terminal:
-                # Failover retry: exclude this node for the rest of the
-                # request's life, park the next attempt behind
-                # exponential backoff + jitter (an unavailable node gets
-                # no backoff — another node can take it immediately).
-                # A pure master-side timeout — or a join 408 flagged
-                # in_flight — does NOT exclude: the same node still holds
-                # the in-flight generation, and the retry (pinned back to
-                # it via the recorded node_id) joins it / replays its
-                # cached result instead of re-generating on a peer.
-                sticky = is_timeout or getattr(e, "in_flight", False)
-                # Delay policy: a sticky retry waits out the backoff so
-                # the generation it intends to join/replay has time to
-                # finish (immediate re-joins would burn the attempt
-                # budget in seconds). A plain unavailable (503/408)
-                # fails over with zero delay ONLY when a different node
-                # can actually take it — on a single-node cluster the
-                # fallback would hand the same draining node straight
-                # back, so park on the health loop's cadence instead.
-                if sticky or not unavailable:
-                    delay = self._backoff(req["attempts"])
-                elif any(n["id"] not in excluded and n["id"] != nid
-                         and not n.get("draining")
-                         for n in self.store.list_nodes(active_only=True)):
-                    delay = 0.0
-                else:
-                    delay = max(self._backoff(req["attempts"]),
-                                self.health_interval * 1.5)
-                self.store.requeue(
-                    req["id"],
-                    excluded_node_id=None if sticky else nid,
-                    delay_s=delay, last_node_id=nid)
-                self.metrics.inc("requests_requeued")
-                self._wake.set()
-            else:
-                self.store.mark_failed(req["id"], str(e))
-                self._trace_done(req["id"])
-                if is_timeout:
-                    # terminal timeout: nobody will ever claim the
-                    # result — best-effort cancel so the worker stops
-                    # generating for nobody. (With retries left the
-                    # generation KEEPS running: its result lands in the
-                    # worker's idempotency cache for the retry.)
-                    try:
-                        self._worker_post(node, "/cancel",
-                                          {"request_tag":
-                                           self._tag(req["id"])}, 10)
-                    except Exception:
-                        pass
-            # A read timeout means the worker is slow/busy (its generate
-            # lock serializes requests), not dead; a 503/408 means it is
-            # managing its own load. Striking either would deactivate
-            # healthy nodes. Connection-level errors do count toward the
-            # breaker.
-            if not (is_timeout or unavailable):
-                self._node_failure(node)
+            if (isinstance(e, (http.exceptions.ConnectionError,
+                               http.exceptions.ChunkedEncodingError))
+                    and not _is_timeout_error(e)):
+                self._purge_session(node)
+            self._fail_sub(req, node, e)
             return False
         finally:
             with self._inflight_lock:
                 self._inflight[nid] = max(0, self._inflight.get(nid, 1) - 1)
+
+    def _finish_sub(self, req, node, status, body) -> None:
+        """Demultiplex one per-sub-request result line off a batch RPC,
+        applying the exact single-dispatch status semantics to just this
+        request: 200 completes, 503/408 fails over without a strike
+        (in_flight pins the retry), other 4xx is a terminal user-error
+        reject, 5xx requeues with exclusion and a breaker strike."""
+        status = int(status or 500)
+        if status == 200:
+            self._complete_request(req, node, body or {})
+            return
+        text = json.dumps(body or {})[:200]
+        if status in (503, 408):
+            self._fail_sub(req, node, _NodeUnavailable(
+                f"worker unavailable ({status}): {text}",
+                in_flight=bool((body or {}).get("in_flight"))))
+            return
+        if 400 <= status < 500:
+            self._reject(req, f"rejected: {text}")
+            return
+        self._fail_sub(req, node,
+                       RuntimeError(f"inference failed ({status}): {text}"))
+
+    def _execute_batch(self, node, model, reqs) -> None:
+        """Multiplexed dispatch: ONE ``POST /inference_batch`` carries
+        every claimed request bound for (node, model); the worker
+        streams per-sub-request results back on the same connection as
+        each completes (chunked JSON lines) and this demultiplexes
+        them. Sub-request failures resolve per request — a poisoned
+        sub-request requeues alone while its batch siblings complete. A
+        connection-level failure (timeout, reset, truncated stream)
+        resolves every still-unanswered sub-request individually with
+        the single-dispatch semantics for that failure class, but
+        strikes the breaker at most ONCE (one socket fault is one fault
+        event, not N)."""
+        nid = node["id"]
+        open_subs = {self._tag(r["id"]): r
+                     for r in reqs}       # tag -> req awaiting a result
+        undone = {r["id"] for r in reqs}  # in-flight slots to release
+        try:
+            # lazy load, once per batch (the single path's per-request
+            # load, amortized); sampling carries the same opt-ins on
+            # every sub-request the master grouped here
+            err = self._ensure_model_loaded(node, model,
+                                            reqs[0]["sampling"])
+            if err is not None:
+                for req in reqs:
+                    self._reject(req, err)
+                open_subs.clear()
+                return
+            tracer = trace.get_tracer()
+            t_dispatch = time.time()
+            sub_bodies = []
+            for r_ in reqs:
+                sb = self._infer_body(r_)
+                # per-sub trace propagation: the batch RPC carries each
+                # sub-request's own submit-time context in its body, so
+                # the worker's per-sub spans join the request's trace —
+                # not the batch umbrella's (which has N parents, i.e.
+                # none). Same wire format as the HTTP headers.
+                ctx = self._trace_ctx.get(r_["id"])
+                if ctx is not None:
+                    trace.inject(sb, ctx)
+                    if r_["attempts"] == 0:
+                        tracer.record("master.queued", r_["created_at"],
+                                      t_dispatch, parent=ctx)
+                sub_bodies.append(sb)
+            batch_body = {"model_name": model, "requests": sub_bodies}
+            for req in reqs:
+                self._processing[req["id"]] = node
+            with tracer.span("master.dispatch_batch",
+                             attrs={"node_id": nid, "n": len(reqs),
+                                    "model": model}):
+                r = self._worker_post(node, "/inference_batch", batch_body,
+                                      self.infer_timeout, stream=True)
+                if r.status_code in (503, 408):
+                    body_text = r.text    # drains; conn back to the pool
+                    raise _NodeUnavailable(
+                        f"worker unavailable ({r.status_code}): "
+                        f"{body_text[:200]}")
+                if 400 <= r.status_code < 500:
+                    # whole-batch rejection (e.g. a fleet-wide
+                    # DLI_BATCH_RPC_MAX mismatch the master-side chunk
+                    # cap couldn't see): deterministic, so re-sending
+                    # the batch can never succeed and striking would
+                    # walk every node's breaker open in turn. Degrade
+                    # to the single path per sub — size cannot be the
+                    # problem there, and a genuinely bad sub-request
+                    # gets its own per-request 4xx reject.
+                    log.warning(
+                        "batch of %d rejected by node %d (%d: %s); "
+                        "falling back to single dispatch",
+                        len(reqs), nid, r.status_code, r.text[:200])
+                    open_subs.clear()
+                    undone.clear()   # singles decrement their own slots
+                    for req in reqs:
+                        # _execute_on_node, not _execute: the wrapper
+                        # would record a second master.queued span for
+                        # attempts==0 subs already recorded above
+                        self._execute_on_node(req, node)
+                    return
+                if r.status_code != 200:
+                    raise RuntimeError(
+                        f"inference_batch failed ({r.status_code}): "
+                        f"{r.text[:200]}")
+                try:
+                    # chunk_size=None: deliver each chunked frame the
+                    # moment it arrives — the default 512-byte read
+                    # buffer would hold a finished sub-request's line
+                    # hostage until LATER results pad the buffer out
+                    for line in r.iter_lines(chunk_size=None):
+                        if not line:
+                            continue
+                        msg = json.loads(line)
+                        req = open_subs.pop(msg.get("request_tag"), None)
+                        if req is None:
+                            continue
+                        self._processing.pop(req["id"], None)
+                        ctx = self._trace_ctx.get(req["id"])
+                        if ctx is not None:
+                            # the batch-path twin of master.execute:
+                            # this sub-request's dispatch->result window
+                            # in ITS trace (ctx is freed by _finish_sub
+                            # on terminal states — record first)
+                            tracer.record(
+                                "master.execute", t_dispatch, time.time(),
+                                parent=ctx,
+                                attrs={"req_id": req["id"], "model": model,
+                                       "attempt": req["attempts"],
+                                       "batched": True})
+                        self._finish_sub(req, node, msg.get("status"),
+                                         msg.get("body") or {})
+                        with self._inflight_lock:
+                            self._inflight[nid] = max(
+                                0, self._inflight.get(nid, 1) - 1)
+                        undone.discard(req["id"])
+                finally:
+                    r.close()
+            if open_subs:
+                # the stream ended cleanly but short: the worker never
+                # answered these — treat like a dropped connection
+                raise http.exceptions.ConnectionError(
+                    f"batch stream ended with {len(open_subs)} "
+                    "unanswered sub-request(s)")
+        except Exception as e:
+            is_timeout = _is_timeout_error(e)
+            unavailable = isinstance(e, _NodeUnavailable)
+            # ChunkedEncodingError is a truncated stream — the worker
+            # died mid-batch — but it is NOT a requests ConnectionError
+            # subclass; it kills pooled sockets all the same
+            if (isinstance(e, (http.exceptions.ConnectionError,
+                               http.exceptions.ChunkedEncodingError))
+                    and not is_timeout):
+                self._purge_session(node)
+            if not (is_timeout or unavailable):
+                self._node_failure(node)     # once per RPC fault
+            # one snapshot for every unanswered sub: their zero-delay
+            # failover checks are identical, N queries would hammer the
+            # store during exactly the load spike this path absorbs
+            snap = (self.store.list_nodes(active_only=True)
+                    if open_subs else None)
+            for req in open_subs.values():
+                self._fail_sub(req, node, e, strike=False, nodes=snap)
+        finally:
+            for req in reqs:
+                self._processing.pop(req["id"], None)
+            if undone:
+                with self._inflight_lock:
+                    for _ in undone:
+                        self._inflight[nid] = max(
+                            0, self._inflight.get(nid, 1) - 1)
+
+    def _dispatch_claimed(self, reqs) -> None:
+        """One dispatcher-pipeline turn: reserve a node per claimed
+        request (respecting exclusions, pins, and the half-open single-
+        probe rule), group by (node, model), and send each multi-request
+        group as ONE batch RPC — a single request keeps the plain
+        /inference path."""
+        self.metrics.observe("master_dispatch_batch_size", float(len(reqs)),
+                             buckets=_BATCH_SIZE_BUCKETS, unit="")
+        groups: Dict[tuple, list] = {}
+        # one active-node snapshot for the whole wave: per-request picks
+        # diverge on the in-memory in-flight/queue state, not the rows
+        snapshot = self.store.list_nodes(active_only=True)
+        for req in reqs:
+            node = self._reserve_node_for(req, nodes=snapshot)
+            if node is None:
+                continue            # parked or terminally failed
+            # the lazy-load opt-ins are part of the group key: the batch
+            # loads the model ONCE with reqs[0]'s opt-ins, so siblings
+            # must agree — else one member's allow_random_init (or lack
+            # of it) would decide load semantics for requests that never
+            # consented (or terminally fail ones that did)
+            load_key = (bool(req["sampling"].get("allow_random_init")),
+                        req["sampling"].get("checkpoint_path"))
+            groups.setdefault((node["id"], req["model_name"], load_key),
+                              [node, []])[1].append(req)
+        def run_group(node, model, rs):
+            # sequential chunks keep per-node FIFO when a group exceeds
+            # the worker's per-RPC sub-request cap
+            for i in range(0, len(rs), BATCH_RPC_CAP):
+                chunk = rs[i:i + BATCH_RPC_CAP]
+                if len(chunk) == 1:
+                    self._execute(chunk[0], node)
+                else:
+                    self._execute_batch(node, model, chunk)
+
+        items = [(node, model, rs)
+                 for (nid, model, _lk), (node, rs) in groups.items()]
+        if len(items) == 1:
+            run_group(*items[0])
+            return
+        # groups target different (node, model) pairs: their RPCs must
+        # overlap, not queue behind each other on this dispatcher thread
+        # (the join keeps claim order intact across loop turns)
+        threads = [threading.Thread(target=run_group, args=it, daemon=True)
+                   for it in items]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
 
     # ---- circuit breaker ---------------------------------------------
 
@@ -772,13 +1397,19 @@ class Master:
     # ---- background loops --------------------------------------------
 
     def _dispatch_loop(self):
+        """Pipeline-shaped dispatcher: claim up to ``dispatch_batch``
+        due requests in ONE locked transaction, then ship them grouped
+        as multiplexed batch RPCs. Cluster concurrency is
+        dispatcher_threads x dispatch_batch, not dispatcher_threads —
+        the one-thread-per-blocking-HTTP-call shape (and the reference's
+        thread-per-request master before it) is gone."""
         while not self._stop.is_set():
-            req = self.store.claim_next_pending()
-            if req is None:
+            reqs = self.store.claim_next_pending_many(self.dispatch_batch)
+            if not reqs:
                 self._wake.wait(timeout=0.5)
                 self._wake.clear()
                 continue
-            self._execute(req)
+            self._dispatch_claimed(reqs)
 
     def _health_loop(self):
         """Push-based monitoring with auto-reactivation — the upgrade over
@@ -789,10 +1420,29 @@ class Master:
         healthy ones."""
         while not self._stop.is_set():
             self._health_sweep()
-            # queue-depth gauge on the monitor's cadence, not per submit
-            # (counts() is an aggregate query over the requests table)
+            # queue-depth gauges on the monitor's cadence, not per submit
+            # (aggregate queries over the requests table) — the global
+            # gauge plus one per model, so a starving model is visible
+            # behind a healthy aggregate; models whose queue drained
+            # keep reporting an explicit 0 instead of a stale number
             self.metrics.gauge("queue_pending",
                                self.store.counts().get("pending", 0))
+            # model_name is client-supplied: cap the tracked set so
+            # arbitrary names can't grow the exposition without bound,
+            # and sanitize at KEY time — two raw names that sanitize to
+            # the same exposition name ('m.1'/'m-1') must share one
+            # series, not emit duplicate samples scrapers reject
+            by_model: Dict[str, int] = {}
+            for mn, c in self.store.pending_by_model().items():
+                k = sanitize_name(str(mn))
+                by_model[k] = by_model.get(k, 0) + c
+            for mn in sorted(by_model):
+                if (mn not in self._pending_models
+                        and len(self._pending_models) < MODEL_GAUGES_MAX):
+                    self._pending_models.add(mn)
+            for mn in self._pending_models:
+                self.metrics.gauge(f"queue_pending_model_{mn}",
+                                   by_model.get(mn, 0))
             self._stop.wait(self.health_interval)
 
     def _health_sweep(self):
@@ -815,6 +1465,10 @@ class Master:
                 except ValueError:
                     err = "unparseable health body"
             if info is None:
+                # an unreachable worker's pooled sockets are dead too:
+                # drop them so its comeback probe dials fresh instead of
+                # failing through the stale pool
+                self._purge_session(n)
                 self._node_failure(n)
                 state = ((self.store.get_node(n["id"]) or n)
                          .get("breaker_state") or "closed")
@@ -822,6 +1476,9 @@ class Master:
                 draining = 1 if info.get("status") == "draining" else 0
                 fields = {"info": info, "last_heartbeat": time.time(),
                           "draining": draining}
+                # refresh the queue-aware scheduler's per-node view
+                # (batcher queue depth + free KV blocks ride /health)
+                self._note_runtime(n["id"], info)
                 if state == "open":
                     # the fault cleared: schedulable again, but only as
                     # a probe until a real request succeeds
@@ -861,6 +1518,16 @@ class Master:
         self._stop.set()
         self._wake.set()
         self.service.shutdown()
+        # flush the write-behind buffer (any parked requeues commit) and
+        # release the keep-alive connection pools
+        self.store.close()
+        with self._sessions_lock:
+            sessions, self._sessions = list(self._sessions.values()), {}
+        for s in sessions:
+            try:
+                s.close()
+            except Exception:
+                pass
 
 
 def _relay_json(r):

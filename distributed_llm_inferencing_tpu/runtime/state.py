@@ -17,10 +17,14 @@ state across raw threads unguarded, SURVEY.md §5.2).
 from __future__ import annotations
 
 import json
+import logging
+import os
 import sqlite3
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
+
+log = logging.getLogger("dli_tpu.state")
 
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS nodes (
@@ -84,7 +88,10 @@ def _row_to_dict(cur, row):
 
 
 class Store:
-    def __init__(self, path: str = ":memory:"):
+    def __init__(self, path: str = ":memory:", *,
+                 group_commit: bool = False,
+                 flush_interval: Optional[float] = None,
+                 on_flush: Optional[Callable[[], None]] = None):
         self._lock = threading.RLock()
         self._db = sqlite3.connect(path, check_same_thread=False)
         self._db.execute("PRAGMA journal_mode=WAL")
@@ -97,6 +104,142 @@ class Store:
                     if col not in have:
                         self._db.execute(
                             f"ALTER TABLE {table} ADD COLUMN {col} {decl}")
+        # Group-commit write-behind (the master's dispatch hot path): the
+        # per-request status writes (requeue/complete/fail) queue up and
+        # land in ONE transaction per flush cycle instead of one
+        # transaction + lock round trip each — N dispatcher threads
+        # completing a batch coalesce their writes naturally while a
+        # flush is in progress. Durability barrier: every status write
+        # blocks until its op is committed, so a status the API can
+        # serve (and a requeue a dispatcher can re-claim) is always on
+        # disk first. DLI_STORE_FLUSH_MS>0 adds an explicit
+        # accumulation window per flush; the default (0) batches purely
+        # by backpressure. A crash mid-buffer leaves rows 'processing'
+        # for recover_stale_processing() at next startup — the same
+        # contract a crash mid-UPDATE always had.
+        self._gc_enabled = bool(group_commit)
+        self._gc_on_flush = on_flush
+        if self._gc_enabled:
+            if flush_interval is None:
+                flush_interval = float(
+                    os.environ.get("DLI_STORE_FLUSH_MS", 0)) / 1e3
+            self._gc_interval = max(0.0, flush_interval)
+            self._gc_cv = threading.Condition()
+            self._gc_flush_lock = threading.Lock()
+            self._gc_buf: List[tuple] = []
+            self._gc_enqueued = 0       # ticket of the newest buffered op
+            self._gc_flushed = 0        # ticket of the newest committed op
+            self._gc_wake = threading.Event()
+            self._gc_stop = threading.Event()
+            self._gc_thread = threading.Thread(
+                target=self._gc_loop, daemon=True, name="store-flush")
+            self._gc_thread.start()
+
+    # ---- group-commit plumbing --------------------------------------
+
+    def _submit_write(self, sql: str, args: tuple, barrier: bool):
+        """Route one UPDATE through the write-behind buffer (group
+        commit) or execute it synchronously when group commit is off.
+        ``barrier=True`` waits for the commit — the durability barrier
+        in front of any client-visible terminal status."""
+        if not self._gc_enabled:
+            self._exec(sql, args)
+            return
+        with self._gc_cv:
+            self._gc_buf.append((sql, args))
+            self._gc_enqueued += 1
+            ticket = self._gc_enqueued
+        self._gc_wake.set()
+        if self._gc_stop.is_set():
+            # flusher gone (a dispatcher finishing its in-flight RPC after
+            # close()): without this, a barrier=False write would sit in
+            # the buffer forever and the terminal status would be lost
+            self._flush_writes()
+            return
+        if barrier:
+            while True:
+                with self._gc_cv:
+                    if self._gc_flushed >= ticket:
+                        return
+                    self._gc_cv.wait(timeout=1.0)
+                    if self._gc_flushed >= ticket:
+                        return
+                if self._gc_stop.is_set():
+                    # flusher gone (close() raced this write): any thread
+                    # may flush — _flush_writes is safe to call anywhere
+                    self._flush_writes()
+
+    def _flush_writes(self):
+        # One flusher at a time: swap -> commit -> publish must be atomic
+        # against other flushers, or a concurrent caller (barrier waiter
+        # self-flushing after close(), or close() itself) could swap an
+        # empty buffer, read the latest ticket, and publish it while THIS
+        # flush still holds uncommitted ops — the barrier would report
+        # durability for writes not yet on disk.
+        with self._gc_flush_lock:
+            with self._gc_cv:
+                ops, self._gc_buf = self._gc_buf, []
+                ticket = self._gc_enqueued
+            if ops:
+                try:
+                    with self._lock, self._db:
+                        for sql, args in ops:
+                            self._db.execute(sql, args)
+                except Exception:
+                    # sqlite hiccup (disk full, I/O error): the 'with
+                    # _db' transaction rolled back, so nothing reached
+                    # disk. Put the batch back AHEAD of anything
+                    # buffered since (order preserved) and leave the
+                    # ticket unpublished — barrier waiters correctly
+                    # stay blocked until a later flush succeeds.
+                    with self._gc_cv:
+                        self._gc_buf[:0] = ops
+                    raise
+            with self._gc_cv:
+                self._gc_flushed = max(self._gc_flushed, ticket)
+                self._gc_cv.notify_all()
+        if ops and self._gc_on_flush is not None:
+            # e.g. the master's dispatcher wake event: a flushed requeue
+            # is now claimable, don't wait out the idle poll to see it
+            self._gc_on_flush()
+
+    def _gc_loop(self):
+        while not self._gc_stop.is_set():
+            self._gc_wake.wait(timeout=0.5)
+            if self._gc_stop.is_set():
+                break
+            self._gc_wake.clear()
+            if self._gc_interval:
+                # the group window: let concurrent dispatchers pile
+                # their writes into this flush's transaction
+                time.sleep(self._gc_interval)
+            try:
+                self._flush_writes()
+            except Exception:
+                # The batch went back on the buffer. The flusher MUST
+                # survive: if this thread died with _gc_stop unset,
+                # every barrier=True writer would wait forever with no
+                # recourse. Retry on the next cycle instead.
+                log.exception("group-commit flush failed; "
+                              "ops re-buffered, will retry")
+                self._gc_wake.set()
+                time.sleep(0.5)
+        try:
+            self._flush_writes()
+        except Exception:
+            with self._gc_cv:
+                n_lost = len(self._gc_buf)
+            log.exception("final group-commit flush failed; "
+                          "%d op(s) still buffered", n_lost)
+
+    def close(self):
+        """Flush buffered writes and stop the flusher. Idempotent."""
+        if self._gc_enabled and self._gc_thread is not None:
+            self._gc_stop.set()
+            self._gc_wake.set()
+            self._gc_thread.join(timeout=5)
+            self._gc_thread = None
+            self._flush_writes()
 
     def _all(self, sql, args=()) -> List[Dict[str, Any]]:
         with self._lock:
@@ -193,20 +336,33 @@ class Store:
         A request parked by a backoff retry (``next_attempt_at`` in the
         future) is invisible until its delay elapses — the dispatcher's
         idle poll re-examines the queue on its own cadence."""
+        rows = self.claim_next_pending_many(1)
+        return rows[0] if rows else None
+
+    def claim_next_pending_many(self, limit: int = 1
+                                ) -> List[Dict[str, Any]]:
+        """Atomically claim up to ``limit`` due pending requests, oldest
+        first, in ONE locked transaction (single SELECT + executemany
+        status flip) — the multiplexed dispatcher's entry point. FIFO:
+        the returned order is id order, which is submission order."""
         with self._lock:
-            row = self._one(
+            now = time.time()
+            rows = self._all(
                 "SELECT * FROM requests WHERE status='pending' "
-                "AND next_attempt_at<=? ORDER BY id LIMIT 1",
-                (time.time(),))
-            if row is None:
-                return None
-            self._exec(
-                "UPDATE requests SET status='processing', started_at=? "
-                "WHERE id=?", (time.time(), row["id"]))
-            row["sampling"] = json.loads(row["sampling"] or "{}")
-            row["excluded_nodes"] = json.loads(
-                row.get("excluded_nodes") or "[]")
-            return row
+                "AND next_attempt_at<=? ORDER BY id LIMIT ?",
+                (now, int(limit)))
+            if not rows:
+                return []
+            with self._db:
+                self._db.executemany(
+                    "UPDATE requests SET status='processing', started_at=? "
+                    "WHERE id=?", [(now, r["id"]) for r in rows])
+            for row in rows:
+                row["started_at"] = now
+                row["sampling"] = json.loads(row["sampling"] or "{}")
+                row["excluded_nodes"] = json.loads(
+                    row.get("excluded_nodes") or "[]")
+            return rows
 
     def requeue(self, req_id: int, excluded_node_id: Optional[int] = None,
                 delay_s: float = 0.0, last_node_id: Optional[int] = None):
@@ -215,25 +371,32 @@ class Store:
         attempt parked ``delay_s`` into the future (backoff).
         ``last_node_id`` records where this attempt ran (the row's
         node_id) — a timeout retry prefers that node, since it still
-        holds the in-flight generation."""
-        with self._lock, self._db:
-            extra = ""
-            args: list = []
-            if excluded_node_id is not None:
-                row = self._one("SELECT excluded_nodes FROM requests "
-                                "WHERE id=?", (req_id,))
-                seen = json.loads((row or {}).get("excluded_nodes") or "[]")
-                if excluded_node_id not in seen:
-                    seen.append(excluded_node_id)
-                extra += ", excluded_nodes=?"
-                args.append(json.dumps(seen))
-            if last_node_id is not None:
-                extra += ", node_id=?"
-                args.append(last_node_id)
-            self._db.execute(
-                "UPDATE requests SET status='pending', attempts=attempts+1, "
-                f"next_attempt_at=?{extra} WHERE id=?",
-                (time.time() + max(0.0, delay_s), *args, req_id))
+        holds the in-flight generation.
+
+        Like the terminal writes this flows through the group-commit
+        buffer and waits for the commit: a requeue must be claim-visible
+        the moment it returns (dispatchers and tests read their own
+        writes), and the read side of the ``excluded_nodes``
+        read-modify-write stays safe because a request has at most one
+        in-flight status op at a time."""
+        extra = ""
+        args: list = []
+        if excluded_node_id is not None:
+            row = self._one("SELECT excluded_nodes FROM requests "
+                            "WHERE id=?", (req_id,))
+            seen = json.loads((row or {}).get("excluded_nodes") or "[]")
+            if excluded_node_id not in seen:
+                seen.append(excluded_node_id)
+            extra += ", excluded_nodes=?"
+            args.append(json.dumps(seen))
+        if last_node_id is not None:
+            extra += ", node_id=?"
+            args.append(last_node_id)
+        self._submit_write(
+            "UPDATE requests SET status='pending', attempts=attempts+1, "
+            f"next_attempt_at=?{extra} WHERE id=?",
+            (time.time() + max(0.0, delay_s), *args, req_id),
+            barrier=True)
 
     def recover_stale_processing(self, max_attempts: Optional[int] = None
                                  ) -> int:
@@ -261,18 +424,29 @@ class Store:
             return cur.rowcount + failed
 
     def mark_completed(self, req_id: int, result: str, node_id: int,
-                       execution_time: float, tokens_per_s: float):
-        # ≙ InferenceRequest.mark_completed (reference models.py:52-56)
-        self._exec(
+                       execution_time: float, tokens_per_s: float,
+                       barrier: bool = True):
+        # ≙ InferenceRequest.mark_completed (reference models.py:52-56).
+        # Terminal status: with barrier=True the write is committed
+        # before this returns. barrier=False still upholds the
+        # durability-before-client-visibility rule — reads only ever
+        # see committed state, so a status poll cannot observe
+        # 'completed' before the commit lands; what it relaxes is THIS
+        # caller blocking on the flush. The master's batch demultiplexer
+        # uses that: a barrier wait per sub-request would hold up
+        # reading the next result line off the stream.
+        self._submit_write(
             "UPDATE requests SET status='completed', result=?, node_id=?, "
             "completed_at=?, execution_time=?, tokens_per_s=? WHERE id=?",
-            (result, node_id, time.time(), execution_time, tokens_per_s, req_id))
+            (result, node_id, time.time(), execution_time, tokens_per_s,
+             req_id), barrier=barrier)
 
-    def mark_failed(self, req_id: int, error: str):
-        # ≙ InferenceRequest.mark_failed (reference models.py:58-62)
-        self._exec(
+    def mark_failed(self, req_id: int, error: str, barrier: bool = True):
+        # ≙ InferenceRequest.mark_failed (reference models.py:58-62);
+        # terminal — same barrier semantics as mark_completed
+        self._submit_write(
             "UPDATE requests SET status='failed', error=?, completed_at=? "
-            "WHERE id=?", (error, time.time(), req_id))
+            "WHERE id=?", (error, time.time(), req_id), barrier=barrier)
 
     def recent_requests(self, limit: int = 20):
         return self._all(
@@ -282,3 +456,11 @@ class Store:
         rows = self._all(
             "SELECT status, COUNT(*) AS n FROM requests GROUP BY status")
         return {r["status"]: r["n"] for r in rows}
+
+    def pending_by_model(self) -> Dict[str, int]:
+        """Pending-queue depth per model (the per-model ``queue_pending``
+        gauges on the master's health cadence)."""
+        rows = self._all(
+            "SELECT model_name, COUNT(*) AS n FROM requests "
+            "WHERE status='pending' GROUP BY model_name")
+        return {r["model_name"]: r["n"] for r in rows}

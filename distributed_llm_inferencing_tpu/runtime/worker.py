@@ -39,6 +39,11 @@ log = setup_logging("worker")
 # result makes at-least-once delivery execute exactly once.
 IDEM_CACHE = int(os.environ.get("DLI_IDEM_CACHE", 256))
 
+# Upper bound on sub-requests per /inference_batch RPC: each sub costs
+# a worker thread, so the cap turns an arbitrarily long client list
+# from a thread bomb into a 400.
+BATCH_RPC_MAX = int(os.environ.get("DLI_BATCH_RPC_MAX", 256))
+
 
 class LoadedModel:
     def __init__(self, engine, tokenizer, source: str, batcher=None):
@@ -72,6 +77,7 @@ class WorkerAgent:
         s.add("POST", "/load_shard", self.load_shard)
         s.add("POST", "/unload_model", self.unload_model)
         s.add("POST", "/inference", self.inference)
+        s.add("POST", "/inference_batch", self.inference_batch)
         s.add("POST", "/inference_stream", self.inference_stream)
         s.add("POST", "/cancel", self.cancel)
         s.add("POST", "/drain", self.drain)
@@ -456,6 +462,225 @@ class WorkerAgent:
         finally:
             self._end_inference()
 
+    def inference_batch(self, body, _request=None):
+        """Multiplexed dispatch: N sub-requests in ONE RPC, per-request
+        results streamed back as chunked JSON lines the moment each
+        completes (httpd.jsonl_stream keeps the connection reusable).
+        Every sub-request keeps the exact /inference semantics — its own
+        idempotency tag (replay/join), its own drain refusal, its own
+        structured error — so a master can fail/requeue ONE sub-request
+        without touching its batch siblings. Batcher-mode models admit
+        owned (fresh-tag) sub-requests through ContinuousBatcher
+        .submit_many in wire order, so FIFO survives the multiplexing.
+        """
+        subs = body.get("requests")
+        if not isinstance(subs, list) or not subs:
+            return 400, {"status": "error",
+                         "message": "requests: non-empty list required"}
+        if len(subs) > BATCH_RPC_MAX:
+            # one thread + one queue slot per sub: an uncapped list is
+            # a one-connection thread bomb (masters send DISPATCH_BATCH)
+            return 400, {"status": "error",
+                         "message": f"requests: at most {BATCH_RPC_MAX} "
+                                    f"sub-requests per batch RPC"}
+        if self._draining:
+            # whole-batch refusal BEFORE any work starts: the master
+            # fails the batch over without a breaker strike
+            return self._refuse_draining()
+        model = body.get("model_name")
+        with self._models_lock:
+            m = self.models.get(model)
+        self.metrics.inc("batch_rpcs")
+        self.metrics.inc("batch_sub_requests", len(subs))
+        import queue as _queue
+        out: "_queue.Queue" = _queue.Queue()
+        ctx = trace.current()   # sub-request work runs on helper threads
+
+        def emit(tag, status, payload):
+            out.put({"request_tag": tag, "status": status, "body": payload})
+
+        def norm(res):
+            if isinstance(res, tuple):
+                return res[0], res[1]
+            return 200, res
+
+        def run_generic(sub_body, tag):
+            """One sub-request through the standard idempotent path —
+            joins, engine-mode models, untagged requests."""
+            try:
+                if not self._try_begin_inference():
+                    st, pl = norm(self._refuse_draining())
+                else:
+                    try:
+                        # the master injects each sub-request's own trace
+                        # context into its body — parent there so this
+                        # span lands in the request's trace, not the
+                        # batch RPC's
+                        with trace.get_tracer().span(
+                                "worker.inference",
+                                parent=trace.extract(sub_body) or ctx,
+                                attrs={"model": str(model),
+                                       "tag": tag or ""}):
+                            st, pl = norm(self._inference_idempotent(
+                                sub_body))
+                    finally:
+                        self._end_inference()
+            except Exception as e:
+                st, pl = 500, {"status": "error", "message": str(e)}
+            emit(tag, st, pl)
+
+        owned = []   # (sub_body, tag, my_event-or-None) for batcher path
+        for sub in subs:
+            sub_body = dict(sub)
+            sub_body["model_name"] = model
+            tag = (str(sub.get("request_tag"))
+                   if sub.get("request_tag") else None)
+            if m is not None and m.batcher is not None:
+                if tag is None:
+                    owned.append((sub_body, None, None))
+                    continue
+                kind, obj = self._idem_claim(tag)
+                if kind == "cached":
+                    self.metrics.inc("idempotent_hits")
+                    emit(tag, 200, dict(obj, idempotent=True))
+                    continue
+                if kind == "own":
+                    owned.append((sub_body, tag, obj))
+                    continue
+                # kind == "join": the generic path's join loop handles it
+            threading.Thread(target=run_generic, args=(sub_body, tag),
+                             daemon=True).start()
+
+        self._start_owned_batch(m, owned, emit, ctx)
+
+        def events():
+            # every sub-request emits exactly one line, on every path
+            for _ in range(len(subs)):
+                yield out.get()
+
+        return httpd.jsonl_stream(_request, events())
+
+    def _start_owned_batch(self, m, owned, emit, ctx):
+        """Prep + multi-submit the owned (fresh) batcher sub-requests in
+        wire order, then wait each out on its own thread. Prep/validation
+        failures resolve per sub-request (400 line + ownership release),
+        never the batch."""
+        specs, metas = [], []
+        for sub_body, tag, my_ev in owned:
+            t0 = time.time()
+            try:
+                _m, prompt, sp, max_new, _gk = self._prep_inference(sub_body)
+                if len(prompt) + max_new > m.batcher.max_seq:
+                    raise ValueError(
+                        f"prompt ({len(prompt)}) + max_new_tokens "
+                        f"({max_new}) exceeds max_seq {m.batcher.max_seq}")
+            except Exception as e:
+                # EVERY prep failure must resolve this sub in place —
+                # an exception escaping the loop would leak the earlier
+                # subs' _active counts and never-released idempotency
+                # events (specs built but submit_many never reached)
+                if my_ev is not None:
+                    self._idem_release(tag, my_ev, None)
+                st = 400 if isinstance(e, (KeyError, ValueError)) else 500
+                emit(tag, st, {"status": "error", "message": str(e)})
+                continue
+            if not self._try_begin_inference():
+                if my_ev is not None:
+                    self._idem_release(tag, my_ev, None)
+                st, pl = self._refuse_draining()[:2]
+                emit(tag, st, pl)
+                continue
+            specs.append({"prompt": prompt, "max_new_tokens": max_new,
+                          "sampling": sp,
+                          "eos_token_id": m.tokenizer.eos_token_id,
+                          "seed": sub_body.get("seed"),
+                          "trace_ctx": trace.extract(sub_body) or ctx})
+            metas.append((sub_body, tag, my_ev, t0))
+        try:
+            reqs = m.batcher.submit_many(specs) if specs else []
+        except Exception as e:
+            # all-or-nothing submit refused the whole group: release
+            # every admitted sub (count + idempotency event) in place
+            for _sub_body, tag, my_ev, _t0 in metas:
+                if my_ev is not None:
+                    self._idem_release(tag, my_ev, None)
+                self._end_inference()
+                emit(tag, 500, {"status": "error", "message": str(e)})
+            return
+        for breq, meta in zip(reqs, metas):
+            threading.Thread(target=self._wait_owned,
+                             args=(m, breq, emit) + meta,
+                             daemon=True).start()
+
+    def _wait_owned(self, m, breq, emit, sub_body, tag, my_ev, t0):
+        """Block on one batch-submitted generation; mirror the single
+        /inference result shape, metrics, cancel registration, and
+        idempotency-cache population."""
+        res = None
+        st, pl = 500, {"status": "error", "message": "internal error"}
+        if tag is not None:
+            with self._tagged_lock:
+                self._tagged[tag] = breq
+        try:
+            with self.metrics.time("inference"):
+                toks = breq.wait(
+                    timeout=float(sub_body.get("timeout", 300)))
+            res = {
+                "status": "success",
+                "result": m.tokenizer.decode(toks),
+                "tokens": toks,
+                "execution_time": time.time() - t0,
+                "ttft_ms": breq.ttft_ms,
+                "scheduler": m.batcher.stats(),
+            }
+            self.metrics.inc("requests_completed")
+            self.metrics.inc("tokens_generated", len(toks))
+            st, pl = 200, res
+        except TimeoutError as e:
+            breq.cancel()   # free the slot; don't generate for nobody
+            st, pl = 408, {"status": "error", "message": str(e)}
+        except (ValueError, RuntimeError) as e:
+            st, pl = 400, {"status": "error", "message": str(e)}
+        except Exception as e:
+            st, pl = 500, {"status": "error", "message": str(e)}
+        finally:
+            if tag is not None:
+                with self._tagged_lock:
+                    self._tagged.pop(tag, None)
+                self._idem_release(tag, my_ev, res)
+            self._end_inference()
+            emit(tag, st, pl)
+
+    def _idem_claim(self, tag: str):
+        """One atomic look at the idempotency state for ``tag``:
+        ``("cached", result)`` — a completed result to replay;
+        ``("join", event)`` — an execution is in flight, wait on it;
+        ``("own", event)`` — the caller now OWNS the execution and must
+        _idem_release() when done (the registered event is returned)."""
+        with self._idem_lock:
+            cached = self._idem.get(tag)
+            if cached is not None:
+                self._idem.move_to_end(tag)
+                return "cached", cached
+            ev = self._inflight_tags.get(tag)
+            if ev is not None:
+                return "join", ev
+            my_ev = self._inflight_tags[tag] = threading.Event()
+            return "own", my_ev
+
+    def _idem_release(self, tag: str, my_ev: threading.Event, res):
+        """End an owned execution: cache a success dict for replays
+        (bounded LRU), drop the in-flight registration, and wake joiners
+        — they re-check the cache under the lock."""
+        with self._idem_lock:
+            if isinstance(res, dict):   # 200 success: cache for replays
+                self._idem[tag] = res
+                self._idem.move_to_end(tag)
+                while len(self._idem) > IDEM_CACHE:
+                    self._idem.popitem(last=False)
+            self._inflight_tags.pop(tag, None)
+            my_ev.set()
+
     def _inference_idempotent(self, body):
         """Exactly-once execution around _inference_execute: a duplicate
         dispatch (master timeout retry — at-least-once delivery) either
@@ -466,25 +691,17 @@ class WorkerAgent:
         if tag is None:
             return self._inference_execute(body)
         deadline = time.time() + float(body.get("timeout", 300))
-        my_ev = None
         while True:
-            with self._idem_lock:
-                cached = self._idem.get(tag)
-                if cached is not None:
-                    self._idem.move_to_end(tag)
-                    ev = None
-                else:
-                    ev = self._inflight_tags.get(tag)
-                    if ev is None:
-                        my_ev = self._inflight_tags[tag] = threading.Event()
-            if cached is not None:
+            kind, obj = self._idem_claim(tag)
+            if kind == "cached":
                 self.metrics.inc("idempotent_hits")
-                return dict(cached, idempotent=True)
-            if ev is None:
-                break      # we own the execution
+                return dict(obj, idempotent=True)
+            if kind == "own":
+                my_ev = obj
+                break
             # join the in-flight execution instead of re-generating
             self.metrics.inc("idempotent_joins")
-            if not ev.wait(timeout=max(0.0, deadline - time.time())):
+            if not obj.wait(timeout=max(0.0, deadline - time.time())):
                 # in_flight tells the master the generation is STILL
                 # running here — retry this node (join again later), do
                 # not fail over and re-generate on a peer
@@ -498,14 +715,8 @@ class WorkerAgent:
             res = self._inference_execute(body)
             return res
         finally:
-            with self._idem_lock:
-                if isinstance(res, dict):   # 200 success: cache for replays
-                    self._idem[tag] = res
-                    self._idem.move_to_end(tag)
-                    while len(self._idem) > IDEM_CACHE:
-                        self._idem.popitem(last=False)
-                self._inflight_tags.pop(tag, None)
-                my_ev.set()   # joiners re-check the cache under the lock
+            self._idem_release(tag, my_ev, res if isinstance(res, dict)
+                               else None)
 
     def _inference_execute(self, body):
         t0 = time.time()

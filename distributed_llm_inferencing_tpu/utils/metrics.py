@@ -63,6 +63,12 @@ class Metrics:
         # bucket reads as a counter reset to a Prometheus server; the
         # rolling window is for snapshot() percentiles only)
         self._hist: Dict[str, list] = {}
+        # per-histogram bucket/unit overrides (first observation wins —
+        # the bucket layout of a live cumulative histogram can't change):
+        # value-shaped histograms (batch sizes) don't fit the latency
+        # schedule and shouldn't advertise a `_seconds` unit
+        self._buckets: Dict[str, tuple] = {}
+        self._units: Dict[str, str] = {}
 
     def inc(self, name: str, value: float = 1.0):
         with self._lock:
@@ -72,13 +78,20 @@ class Metrics:
         with self._lock:
             self._gauges[name] = value
 
-    def observe(self, name: str, seconds: float):
+    def observe(self, name: str, seconds: float,
+                buckets: Optional[tuple] = None, unit: Optional[str] = None):
         with self._lock:
+            if name not in self._hist:
+                if buckets is not None:
+                    self._buckets[name] = tuple(buckets)
+                if unit is not None:
+                    self._units[name] = unit
+            bk = self._buckets.get(name, HIST_BUCKETS)
             self._timings.setdefault(
                 name, deque(maxlen=WINDOW)).append(seconds)
             h = self._hist.setdefault(
-                name, [[0] * (len(HIST_BUCKETS) + 1), 0, 0.0])
-            h[0][bisect.bisect_left(HIST_BUCKETS, seconds)] += 1
+                name, [[0] * (len(bk) + 1), 0, 0.0])
+            h[0][bisect.bisect_left(bk, seconds)] += 1
             h[1] += 1
             h[2] += seconds
 
@@ -92,6 +105,8 @@ class Metrics:
         with self._lock:
             self._timings.clear()
             self._hist.clear()
+            self._buckets.clear()
+            self._units.clear()
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -116,6 +131,11 @@ class Metrics:
             gauges = dict(self._gauges)
             hists = {k: [list(h[0]), h[1], h[2]]
                      for k, h in self._hist.items()}
+            # same lock acquisition as the hists copy: a reset_timings()
+            # between two separate blocks would render a custom-bucket
+            # histogram against the default bucket schedule
+            bucket_of = dict(self._buckets)
+            unit_of = dict(self._units)
         lines: List[str] = []
         for k in sorted(counters):
             name = f"dli_{sanitize_name(k)}_total"
@@ -129,12 +149,13 @@ class Metrics:
             lines.append(f"{name} {_fmt(gauges[k])}")
         for k in sorted(hists):
             per_bucket, count, total = hists[k]
-            name = f"dli_{sanitize_name(k)}_seconds"
+            unit = unit_of.get(k, "seconds")
+            name = f"dli_{sanitize_name(k)}" + (f"_{unit}" if unit else "")
             lines.append(f"# HELP {name} Timing {k!r} histogram "
                          "(process lifetime).")
             lines.append(f"# TYPE {name} histogram")
             cum = 0
-            for le, n in zip(HIST_BUCKETS, per_bucket):
+            for le, n in zip(bucket_of.get(k, HIST_BUCKETS), per_bucket):
                 cum += n
                 lines.append(f'{name}_bucket{{le="{_fmt(le)}"}} {cum}')
             lines.append(f'{name}_bucket{{le="+Inf"}} {count}')

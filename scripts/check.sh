@@ -29,6 +29,16 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu \
     python -m pytest tests/test_gemv_threads.py tests/test_adaptive_spec.py \
     -q -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
 
+echo "== control-plane suite + saturation smoke (batched dispatch) =="
+# Multiplexed batched dispatch, pooled RPC, queue-aware scheduling
+# (docs/serving.md "Control plane"); the smoke drives a live
+# master + in-proc worker and gates on zero failures + connection reuse
+timeout -k 10 600 env JAX_PLATFORMS=cpu DLI_FAULTS_ENABLE=1 \
+    python -m pytest tests/test_dispatch_batch.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python bench.py --scenario control_plane --smoke || exit 1
+
 echo "== chaos suite (fault injection + self-healing dispatch) =="
 # Deterministic fault schedules: a failure here reproduces locally with
 #   DLI_FAULTS_SEED=0 JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q
@@ -49,6 +59,7 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     --ignore=tests/test_chaos.py --ignore=tests/test_node_lifecycle.py \
     --ignore=tests/test_gemv_threads.py \
     --ignore=tests/test_adaptive_spec.py \
+    --ignore=tests/test_dispatch_batch.py \
     2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \

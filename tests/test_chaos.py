@@ -432,6 +432,51 @@ def test_corrupt_deploy_relay_returns_structured_502(clean_worker, master):
     assert "unparseable" in r.json()["message"]
 
 
+# ---- multiplexed batch dispatch under faults -------------------------
+
+def test_mid_batch_disconnect_recovers_each_subrequest_exactly_once(
+        clean_worker):
+    """A batch RPC dies mid-stream (disconnect fault on
+    /inference_batch): the master requeues every unanswered sub-request
+    individually, strikes the node at most once for the shared socket
+    fault, and the retries land each prompt exactly once — no
+    double-generation, no lost request."""
+    agent, wport = clean_worker
+    m = Master(":memory:", dispatcher_threads=1, health_interval=0.3,
+               infer_timeout=15, retry_backoff_base=0.05,
+               dispatch_batch=4)
+    msrv = m.service.serve("127.0.0.1", 0, background=True)
+    mport = msrv.server_address[1]
+    try:
+        nid = _add_node(mport, wport)
+        before = agent.metrics.snapshot()["timings"].get(
+            "inference", {}).get("count", 0)
+        _arm(wport, [{"point": "/inference_batch", "mode": "disconnect",
+                      "times": 1}])
+        # submit before the dispatcher starts so one claim batches all 4
+        rids = [_submit(mport) for _ in range(4)]
+        m.start_background()
+        finals = {rid: _wait_terminal(mport, rid, timeout=90)
+                  for rid in rids}
+        assert all(r["status"] == "completed" for r in finals.values()), \
+            finals
+        # each sub-request burned the failed batch attempt, exactly once
+        assert all(r["attempts"] >= 1 for r in finals.values())
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            after = agent.metrics.snapshot()["timings"]["inference"]["count"]
+            if after - before == len(rids):
+                break
+            time.sleep(0.2)
+        assert after - before == len(rids), \
+            "a sub-request was generated more than once (or lost)"
+        # one socket fault = one strike, not four: breaker still closed
+        n = _node(mport, nid)
+        assert n["breaker"] == "closed" and n["strikes"] <= 1, n
+    finally:
+        m.stop()
+
+
 # ---- barrage: every request ends in exactly one terminal state -------
 
 def test_mixed_fault_barrage_all_requests_terminal(clean_worker, master):
