@@ -496,14 +496,250 @@ def bench_control_plane(n_requests=160, concurrency=32, n_workers=2,
                 agent.service.shutdown()
 
 
+def _prefix_sys(g: int) -> str:
+    """64-char shared 'system prompt' for group g: 8 whole 8-token blocks
+    with the byte tokenizer, 4 whole 16-byte digest chunks."""
+    return f"<{g:03d}>" + "s" * 59
+
+
+def _prefix_prompt(g: int, i: int) -> str:
+    """Group-shared system prefix + a 15-char per-request tail (the tail
+    never block-aligns into the shared prefix)."""
+    return _prefix_sys(g) + f"|u{i:04d}|" + "t" * 7
+
+
+_PREFIX_DIGEST_CHUNK = 16   # bytes; 64-char sys prefix = 4 whole chunks
+
+
+def _prefix_cache_workers(n_workers, kv_host_mb, kv_blocks=64):
+    """In-proc batched workers for the prefix-cache scenario: small KV
+    pool (eviction pressure is part of the workload), host arena sized by
+    ``kv_host_mb`` (0 = tier off), and a staged warm that compiles both
+    admission shapes the timed run dispatches — cold full-prompt tails
+    and warm shared-prefix tails — per power-of-two wave bucket, using
+    warm-only prompt groups so the timed groups start radix-cold."""
+    import requests as _rq
+    from distributed_llm_inferencing_tpu.runtime.worker import WorkerAgent
+
+    workers = []
+    for _ in range(n_workers):
+        agent = WorkerAgent()
+        srv = agent.serve("127.0.0.1", 0, background=True)
+        wport = srv.server_address[1]
+        r = _rq.post(f"http://127.0.0.1:{wport}/load_model", json={
+            "model_name": "tiny-llama", "allow_random_init": True,
+            "dtype": "float32", "serving": "batched", "slots": 8,
+            "kv_blocks": kv_blocks, "kv_block_size": 8, "max_seq": 128,
+            "kv_host_mb": kv_host_mb,
+            "kv_digest_chunk": _PREFIX_DIGEST_CHUNK}, timeout=600)
+        assert r.status_code == 200, r.text
+
+        def wave(subs):
+            rr = _rq.post(f"http://127.0.0.1:{wport}/inference_batch",
+                          json={"model_name": "tiny-llama",
+                                "requests": subs}, timeout=600)
+            assert rr.status_code == 200, rr.text
+
+        for k in (8, 4, 2, 1):
+            # cold shape: k DISTINCT warm groups in one wave (no same-
+            # wave shared prefix, so all k admit as one k-row bucket)
+            wave([{"prompt": _prefix_prompt(900 + k * 10 + j, j),
+                   "max_new_tokens": 4, "sampling": {"do_sample": False}}
+                  for j in range(k)])
+            # warm shape: same groups again, new tails -> shared-prefix
+            # admissions (small tail bucket, deep prefix bucket)
+            wave([{"prompt": _prefix_prompt(900 + k * 10 + j, 100 + j),
+                   "max_new_tokens": 4, "sampling": {"do_sample": False}}
+                  for j in range(k)])
+        # plain single-request path
+        r = _rq.post(f"http://127.0.0.1:{wport}/inference", json={
+            "model_name": "tiny-llama", "prompt": _prefix_prompt(990, 0),
+            "max_new_tokens": 4, "sampling": {"do_sample": False}},
+            timeout=600)
+        assert r.status_code == 200, r.text
+        workers.append((agent, wport))
+    return workers
+
+
+def bench_prefix_cache(n_requests=96, concurrency=8, n_workers=2,
+                       groups=6, tier_on=True, workers=None):
+    """Shared-system-prompt serving through a live master: ``groups``
+    request families share a 64-char system prefix within the family,
+    submitted interleaved (round-robin over groups) from ``concurrency``
+    client threads — the workload where prefix-blind routing scatters a
+    family over every worker and each pays full prefill.
+
+    ``tier_on`` toggles the WHOLE cluster prefix tier: affinity routing
+    (master ``prefix_weight``) plus the workers' host arena + digest
+    advertisement (``kv_host_mb``). Reports completed/failed, client
+    latency percentiles, the cluster-wide prefill cached-token fraction
+    (tokens served from the radix/arena tiers vs run through prefill),
+    affinity pick counts, and arena offload/restore traffic.
+    """
+    import threading as _th
+    import requests as _rq
+    from distributed_llm_inferencing_tpu.runtime.master import Master
+
+    own_workers = workers is None
+    if own_workers:
+        workers = _prefix_cache_workers(n_workers,
+                                        kv_host_mb=64 if tier_on else 0)
+    m = Master(":memory:", health_interval=1.0,
+               prefix_weight=None if tier_on else 0.0)
+    msrv = m.service.serve("127.0.0.1", 0, background=True)
+    base = f"http://127.0.0.1:{msrv.server_address[1]}"
+    try:
+        for i, (_, wport) in enumerate(workers):
+            r = _rq.post(f"{base}/api/nodes/add", json={
+                "name": f"w{i}", "host": "127.0.0.1",
+                "port": wport}).json()
+            assert r["status"] == "success", r
+        m.start_background()
+        time.sleep(1.2)   # one health sweep: queue/digest state is fresh
+        done, failed, lats, lock = [], [], [], _th.Lock()
+        next_i = [0]
+
+        def client():
+            sess = _rq.Session()
+            while True:
+                with lock:
+                    if next_i[0] >= n_requests:
+                        return
+                    i = next_i[0]
+                    next_i[0] += 1
+                t0 = time.time()
+                rid = sess.post(f"{base}/api/inference/submit", json={
+                    "model_name": "tiny-llama",
+                    "prompt": _prefix_prompt(i % groups, i),
+                    "max_new_tokens": 4,
+                    "sampling": {"do_sample": False,
+                                 "allow_random_init": True},
+                }).json()["request_id"]
+                poll = 0.02
+                while True:
+                    st = sess.get(
+                        f"{base}/api/inference/status/{rid}"
+                    ).json()["request"]
+                    if st["status"] in ("completed", "failed"):
+                        with lock:
+                            lats.append(time.time() - t0)
+                            (done if st["status"] == "completed"
+                             else failed).append(st)
+                        break
+                    time.sleep(poll)
+                    poll = min(0.2, poll * 1.5)
+
+        t0 = time.time()
+        threads = [_th.Thread(target=client) for _ in range(concurrency)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        wall = time.time() - t0
+        wc = {}
+        for agent, _ in workers:
+            for k, v in agent.metrics.snapshot()["counters"].items():
+                wc[k] = wc.get(k, 0.0) + v
+        cached = wc.get("prefill_cached_tokens", 0.0)
+        uncached = wc.get("prefill_uncached_tokens", 0.0)
+        mc = m.metrics.snapshot()["counters"]
+        lats.sort()
+        return {
+            "tier": "on" if tier_on else "off",
+            "requests": n_requests, "groups": groups,
+            "completed": len(done), "failed": len(failed),
+            "wall_s": round(wall, 2),
+            "completed_req_per_s": round(len(done) / max(wall, 1e-9), 2),
+            "latency_ms_p50": round(
+                lats[len(lats) // 2] * 1e3, 1) if lats else None,
+            "latency_ms_p95": round(
+                lats[min(len(lats) - 1, int(len(lats) * 0.95))] * 1e3,
+                1) if lats else None,
+            "prefill_cached_tokens": int(cached),
+            "prefill_uncached_tokens": int(uncached),
+            "prefill_cached_fraction": round(
+                cached / max(1.0, cached + uncached), 3),
+            "affinity_picks": int(
+                mc.get("scheduler_pick_prefix_affinity", 0)),
+            "kvtier_offloaded_blocks": int(
+                wc.get("kvtier_offloaded_blocks", 0)),
+            "kvtier_restored_tokens": int(
+                wc.get("kvtier_restored_tokens", 0)),
+            "radix_hits": int(wc.get("radix_prefix_hits", 0)),
+            "radix_misses": int(wc.get("radix_prefix_misses", 0)),
+        }
+    finally:
+        m.stop()
+        if own_workers:
+            for agent, _ in workers:
+                agent.service.shutdown()
+
+
+def _prefix_cache_scenario(argv, opt, smoke):
+    """--scenario prefix_cache [--smoke|--ab]: the tier A/B runs each leg
+    against a FRESH worker set (cache state is the measured object; a
+    shared warm cluster would leak leg 1's radix contents into leg 2).
+    The speedup is prefill-tokens-saved: cached fraction on / off."""
+    if smoke:
+        n, conc, nw, groups = (opt("--requests", 24),
+                               opt("--concurrency", 4), 2, 8)
+    else:
+        # 3 members per prefix family: the off leg's prefix-blind
+        # scatter then pays a whole redundant prefix prefill per extra
+        # worker a family lands on (2P vs 1P of reusable prefix for a
+        # 3-member family on 2 nodes), and family members arrive far
+        # enough apart that the radix has evicted the prefix in between
+        # — the host arena (on leg) restores it, the off leg re-prefills
+        n, conc, nw, groups = (opt("--requests", 96),
+                               opt("--concurrency", 8),
+                               opt("--workers", 2), opt("--groups", 32))
+    result = {"scenario": "prefix_cache", "smoke": smoke}
+    if "--ab" in argv:
+        off = bench_prefix_cache(n, conc, nw, groups, tier_on=False)
+        on = bench_prefix_cache(n, conc, nw, groups, tier_on=True)
+        result.update(off=off, on=on)
+        base_frac = off["prefill_cached_fraction"]
+        result["prefill_saved_x"] = round(
+            on["prefill_cached_fraction"] / max(base_frac, 1e-3), 2)
+        if off.get("latency_ms_p50") and on.get("latency_ms_p50"):
+            result["latency_p50_x"] = round(
+                off["latency_ms_p50"] / max(on["latency_ms_p50"], 1e-3), 2)
+    else:
+        result.update(bench_prefix_cache(n, conc, nw, groups, tier_on=True))
+    print(json.dumps(result))
+    if smoke:
+        run = result.get("on", result)
+        ok = (run.get("completed") == n and run.get("failed") == 0
+              and run.get("affinity_picks", 0) > 0
+              and run.get("prefill_cached_fraction", 0) > 0.15)
+        if not ok:
+            print("prefix-cache smoke FAILED", file=sys.stderr)
+            return 1
+        print(f"prefix-cache smoke ok: cached fraction "
+              f"{run['prefill_cached_fraction']}, "
+              f"affinity picks {run['affinity_picks']}", file=sys.stderr)
+    return 0
+
+
 def _scenario_main(argv):
-    """`bench.py --scenario control_plane [--smoke|--ab] [--requests N]
-    [--concurrency C] [--workers W]` — standalone scenario entry, one
-    JSON line on stdout, nonzero rc on smoke failure."""
+    """`bench.py --scenario {control_plane|prefix_cache} [--smoke|--ab]
+    [--requests N] [--concurrency C] [--workers W]` — standalone scenario
+    entry, one JSON line on stdout, nonzero rc on smoke failure."""
     def opt(name, default, cast=int):
         return cast(argv[argv.index(name) + 1]) if name in argv else default
 
     name = argv[argv.index("--scenario") + 1]
+    if name == "prefix_cache":
+        # persistent compilation cache: the A/B's second worker set (and
+        # repeat CI runs) reuse compiled executables instead of re-paying
+        # the cold XLA compiles that would dwarf the measured window
+        try:
+            from distributed_llm_inferencing_tpu.utils.platform import (
+                enable_compilation_cache)
+            enable_compilation_cache()
+        except Exception:
+            pass
+        return _prefix_cache_scenario(argv, opt, "--smoke" in argv)
     if name != "control_plane":
         print(json.dumps({"error": f"unknown scenario {name!r}"}))
         return 2

@@ -120,6 +120,11 @@ def _load():
                                        ctypes.POINTER(ctypes.c_int64)]
         lib.dli_pool_refcount.restype = ctypes.c_int32
         lib.dli_pool_refcount.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.dli_pool_set_evict_log.argtypes = [ctypes.c_void_p,
+                                               ctypes.c_int32]
+        lib.dli_pool_evict_pop.restype = ctypes.c_int32
+        lib.dli_pool_evict_pop.argtypes = [ctypes.c_void_p, i32p, i32p,
+                                           ctypes.c_int32]
         _lib = lib
         return _lib
 
@@ -149,6 +154,10 @@ class BlockPool:
         self.num_blocks = num_blocks
         self.block_size = block_size
         self._lock = threading.Lock()
+        # eviction hook (runtime/kvtier.py host-offload tier): called with
+        # [(block_id, full_token_chain), ...] after any alloc() that
+        # evicted cached blocks — while their device KV is still resident
+        self._evict_hook = None
         lib = None if force_python else _load()
         self._lib = lib
         if lib is not None:
@@ -184,6 +193,37 @@ class BlockPool:
                 return self._lib.dli_pool_free_count(self._pool)
             return self._py.free_count()
 
+    def set_evict_hook(self, fn) -> None:
+        """Register ``fn(evictions)`` — ``evictions`` is a list of
+        ``(block_id, token_chain)`` for radix blocks the pool evicted to
+        satisfy an alloc. Called OUTSIDE the pool lock, after the alloc
+        that triggered the evictions returns, but before the caller can
+        dispatch any program that overwrites the block — the window in
+        which the block's device KV is still intact and can be copied to
+        the host arena. ``None`` unregisters."""
+        with self._lock:
+            self._evict_hook = fn
+            cap = self.num_blocks if fn is not None else 0
+            if self._lib:
+                self._lib.dli_pool_set_evict_log(self._pool, cap)
+            else:
+                self._py.set_evict_log(cap)
+
+    def _drain_evictions(self) -> list:
+        """Collect logged evictions (caller holds the lock)."""
+        if self._lib:
+            out = []
+            blk = ctypes.c_int32()
+            toks = (ctypes.c_int32 * (self.num_blocks * self.block_size))()
+            while True:
+                n = self._lib.dli_pool_evict_pop(
+                    self._pool, ctypes.byref(blk), toks, len(toks))
+                if n < 0:
+                    break
+                out.append((int(blk.value), list(toks[:n])))
+            return out
+        return self._py.drain_evictions()
+
     def alloc(self, n: int) -> Optional[List[int]]:
         if n == 0:
             return []
@@ -191,8 +231,21 @@ class BlockPool:
             if self._lib:
                 out = (ctypes.c_int32 * n)()
                 ok = self._lib.dli_pool_alloc(self._pool, n, out)
-                return list(out) if ok else None
-            return self._py.alloc(n)
+                got = list(out) if ok else None
+            else:
+                got = self._py.alloc(n)
+            hook = self._evict_hook
+            evicted = self._drain_evictions() if hook is not None else []
+        if evicted and hook is not None:
+            try:
+                hook(evicted)
+            except Exception:
+                # the hook is an opportunistic offload: a failure loses
+                # that copy, nothing more. Raising here would propagate
+                # out of alloc() AFTER the blocks were handed out — the
+                # caller never learns the ids, leaking them forever.
+                log.exception("evict hook failed; evictions not offloaded")
+        return got
 
     def release(self, blocks: Sequence[int]) -> None:
         if not blocks:
@@ -285,6 +338,17 @@ class _PyPool:
         self.evictable = set()        # (last_use, block)
         self.clock = 0
         self.hits = self.misses = self.evictions = 0
+        self.evict_log_cap = 0
+        self.evict_log = []           # (block, full token chain)
+
+    def set_evict_log(self, cap: int):
+        self.evict_log_cap = cap
+        if cap <= 0:
+            self.evict_log.clear()
+
+    def drain_evictions(self):
+        out, self.evict_log = self.evict_log, []
+        return out
 
     def free_count(self):
         return len(self.free_list)
@@ -313,6 +377,15 @@ class _PyPool:
             return False
         key = min(self.evictable)
         victim = self.block_node[key[1]]
+        if self.evict_log_cap > 0:
+            chain, node = [], victim
+            while node is not None and node.parent is not None:
+                chain.append(node.tokens)
+                node = node.parent
+            flat = [t for toks in reversed(chain) for t in toks]
+            self.evict_log.append((victim.block, flat))
+            if len(self.evict_log) > self.evict_log_cap:
+                self.evict_log.pop(0)
         self.evictable.discard(key)
         victim.in_evictable = False
         self.free_list.append(victim.block)

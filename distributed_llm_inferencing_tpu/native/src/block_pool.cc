@@ -61,6 +61,14 @@ struct Pool {
   uint64_t clock = 0;
   // stats
   int64_t hits = 0, misses = 0, evictions = 0;
+  // Eviction log for the host KV-offload tier (runtime/kvtier.py): when
+  // enabled, each eviction records (block, full token chain root->victim)
+  // so the host can copy the block's still-resident device KV into its
+  // RAM arena BEFORE the block id is recycled. Bounded: overflow drops
+  // the oldest entry (a lost offload opportunity, never a leak).
+  int32_t evict_log_cap = 0;
+  std::deque<std::pair<int32_t, std::vector<int32_t>>> evict_log;
+  int64_t evict_log_dropped = 0;
 
   explicit Pool(int32_t n, int32_t bs) : num_blocks(n), block_size(bs) {
     refcount.assign(n, 0);
@@ -98,6 +106,25 @@ struct Pool {
     if (evictable.empty()) return false;
     auto it = evictable.begin();
     RadixNode* victim = block_node[it->second];
+    if (evict_log_cap > 0) {
+      // reconstruct the victim's full token prefix (root -> victim):
+      // parent-chain walk collects per-edge token runs in reverse order
+      std::vector<const std::vector<int32_t>*> edges;
+      for (RadixNode* n = victim; n != nullptr && n->parent != nullptr;
+           n = n->parent) {
+        edges.push_back(&n->tokens);
+      }
+      std::vector<int32_t> chain;
+      chain.reserve(edges.size() * block_size);
+      for (auto e = edges.rbegin(); e != edges.rend(); ++e) {
+        chain.insert(chain.end(), (*e)->begin(), (*e)->end());
+      }
+      evict_log.emplace_back(victim->block, std::move(chain));
+      while ((int32_t)evict_log.size() > evict_log_cap) {
+        evict_log.pop_front();
+        ++evict_log_dropped;
+      }
+    }
     evictable.erase(it);
     victim->in_evictable = false;
     free_list.push_back(victim->block);
@@ -228,6 +255,29 @@ int32_t dli_pool_match(void* p, const int32_t* tokens, int32_t len,
 void dli_pool_insert(void* p, const int32_t* tokens, int32_t len,
                      const int32_t* blocks, int32_t skip) {
   static_cast<Pool*>(p)->insert(tokens, len, blocks, skip);
+}
+
+// Enable/disable the eviction log (cap entries; 0 disables and clears).
+void dli_pool_set_evict_log(void* p, int32_t cap) {
+  Pool* pool = static_cast<Pool*>(p);
+  pool->evict_log_cap = cap;
+  if (cap <= 0) pool->evict_log.clear();
+}
+
+// Pop the oldest logged eviction. Returns the token-chain length (written
+// to out_tokens, truncated at max_tokens) with the block id in out_block;
+// -1 when the log is empty.
+int32_t dli_pool_evict_pop(void* p, int32_t* out_block, int32_t* out_tokens,
+                           int32_t max_tokens) {
+  Pool* pool = static_cast<Pool*>(p);
+  if (pool->evict_log.empty()) return -1;
+  auto& front = pool->evict_log.front();
+  *out_block = front.first;
+  int32_t n = (int32_t)front.second.size();
+  if (n > max_tokens) n = max_tokens;
+  std::memcpy(out_tokens, front.second.data(), n * sizeof(int32_t));
+  pool->evict_log.pop_front();
+  return n;
 }
 
 void dli_pool_stats(void* p, int64_t* out3) {

@@ -59,6 +59,7 @@ from distributed_llm_inferencing_tpu.ops.sampling import (
 from distributed_llm_inferencing_tpu.parallel import sharding as shd
 from distributed_llm_inferencing_tpu.parallel.mesh import (
     MeshSpec, create_mesh, validate_spec)
+from distributed_llm_inferencing_tpu.runtime import kvtier as kvtier_mod
 from distributed_llm_inferencing_tpu.utils import trace
 from distributed_llm_inferencing_tpu.utils.metrics import Metrics
 
@@ -98,6 +99,11 @@ class BatchRequest:
     # prompts could re-prefill each other's evictions forever)
     _chunk_high: int = 0
     _chunk_stalls: int = 0
+    # prompt extent already counted into the prefill cached/uncached
+    # metrics: a resumed chunk pass (or a preemption re-admission)
+    # re-matches this request's OWN earlier blocks, which must not be
+    # reported as cross-request cache wins
+    _prefill_counted: int = 0
     # set when a no-free-slot pop found the request non-partial (its long
     # prompt is mostly radix-cached): skip re-popping it — and the
     # match_prefix + alloc churn that costs — until a slot frees
@@ -171,6 +177,8 @@ class ContinuousBatcher:
                  speculative: Optional[str] = None, spec_gamma: int = 4,
                  spec_adaptive: Optional[bool] = None,
                  decode_overlap: Optional[bool] = None,
+                 kv_host_mb: Optional[float] = None,
+                 kv_digest_chunk: Optional[int] = None,
                  metrics: Optional[Metrics] = None):
         # shared with the worker's registry when serving (so /metrics
         # carries the scheduler's gauges/histograms); owned otherwise
@@ -292,6 +300,26 @@ class ContinuousBatcher:
             shd.named(self.mesh, shd.paged_cache_specs(cfg, self.mesh_spec)))
         self.block_tables = np.full((slots, self.max_blocks), self._dummy,
                                     np.int32)
+        # Host-RAM KV offload tier (runtime/kvtier.py): radix-evicted
+        # blocks copy their device KV pages into a bounded, content-keyed
+        # host arena; admission restores matching blocks with one scatter
+        # instead of re-prefilling. DLI_KV_HOST_MB (or the kv_host_mb
+        # kwarg) sizes the arena; 0 disables the tier — advertisement
+        # included (docs/serving.md "Prefix-cache tier").
+        if kv_host_mb is None:
+            try:
+                kv_host_mb = float(os.environ.get(
+                    "DLI_KV_HOST_MB", kvtier_mod.DEFAULT_HOST_MB))
+            except ValueError:
+                kv_host_mb = kvtier_mod.DEFAULT_HOST_MB
+        self.kvtier = (kvtier_mod.KVTier(
+            block_size, kv_host_mb,
+            digest_chunk=kv_digest_chunk or kvtier_mod.DIGEST_CHUNK)
+            if kv_host_mb and kv_host_mb > 0 else None)
+        if self.kvtier is not None:
+            self.pool.set_evict_hook(self._offload_evicted)
+        self._restore_fns = {}        # restore-scatter jits per row bucket
+        self._last_pool_stats = {}    # radix counter -> metrics delta base
         self.context_lens = np.zeros((slots,), np.int32)
         self.active: List[Optional[BatchRequest]] = [None] * slots
         self._admit_order: collections.deque = collections.deque()  # slot ids
@@ -436,6 +464,14 @@ class ContinuousBatcher:
             "spec_adaptive": (self._spec_ctl.stats()
                               if self._spec_ctl is not None else None),
             "pool": self.pool.stats(),
+            # host KV tier + routing advertisement (runtime/kvtier.py):
+            # the digests ride the worker's /health body into the
+            # master's per-node runtime snapshot; state.py strips them
+            # from the PERSISTED node row (ephemeral routing state)
+            "kvtier": (self.kvtier.stats()
+                       if self.kvtier is not None else None),
+            "prefix_digests": (self.kvtier.index.advertise()
+                               if self.kvtier is not None else None),
         }
 
     # ---- compiled steps ----------------------------------------------
@@ -735,6 +771,170 @@ class ContinuousBatcher:
             best = max(best, n // bs)
         return best
 
+    # ---- host KV tier (offload on evict, restore on admission) --------
+
+    def _offload_evicted(self, evictions):
+        """Eviction hook (native BlockPool.set_evict_hook): copy each
+        evicted radix block's still-resident device KV pages into the
+        host arena, keyed by the block's token-chain digest. Runs
+        synchronously inside ``pool.alloc`` — after the block id returns
+        to the free list but before any program that could overwrite it
+        is dispatched, which is exactly the window where the device bytes
+        are still the evicted prefix's KV. One batched device->host
+        gather covers every block the alloc evicted."""
+        if self.kvtier is None or self.program_hook is not None:
+            return
+        ev = [(b, toks) for b, toks in evictions if toks]
+        if not ev:
+            return
+        # a restored block's arena entry stays resident (HostKVArena.get
+        # keeps it), so its re-eviction needs no copy at all — filter
+        # before the gather, which is a blocking device sync
+        digs = [self.kvtier.block_digests(toks)[-1] for _, toks in ev]
+        keep = [j for j, d in enumerate(digs)
+                if not self.kvtier.arena.peek(d)]
+        if not keep:
+            return
+        w0 = time.time()
+        idx = np.asarray([ev[j][0] for j in keep], np.int32)
+        leaves = [lf for lf in self.paged if lf is not None]
+        with self.mesh:
+            pages = jax.device_get([lf[:, idx] for lf in leaves])
+        stored = 0
+        for col, j in enumerate(keep):
+            if self.kvtier.arena.put(digs[j], [p[:, col] for p in pages]):
+                stored += 1
+        self.metrics.inc("kvtier_offloaded_blocks", stored)
+        trace.get_tracer().record(
+            "batcher.kv_offload", w0, time.time(),
+            attrs={"blocks": len(ev), "stored": stored})
+
+    def _restore_jit(self, b: int, nleaves: int):
+        """Scatter ``b`` restored blocks back into every paged-cache
+        leaf at once (the block axis is axis 1) — the admission-side twin
+        of ops/paged_kvcache.write_block_run, but for whole blocks whose
+        contents come from the host arena rather than fresh prefill."""
+        fn = self._restore_fns.get(b)
+        if fn is None:
+            def restore(ids, vals, *leaves):
+                return tuple(lf.at[:, ids].set(v.astype(lf.dtype))
+                             for lf, v in zip(leaves, vals))
+            fn = jax.jit(restore,
+                         donate_argnums=tuple(range(2, 2 + nleaves)))
+            self._restore_fns[b] = fn
+        return fn
+
+    def _run_restore(self, blocks, pages):
+        """Write arena pages for ``blocks`` back to device. Row count is
+        bucketed to a power of two (padding rows target the reserved
+        dummy block, whose content is never read) so restores of any
+        length share a handful of compiled scatters."""
+        nb = len(blocks)
+        b = 1
+        while b < nb:
+            b *= 2
+        ids = np.full((b,), self._dummy, np.int32)
+        ids[:nb] = blocks
+        live = [lf for lf in self.paged if lf is not None]
+        vals = []
+        for j, lf in enumerate(live):
+            v = np.zeros((lf.shape[0], b) + tuple(lf.shape[2:]),
+                         dtype=lf.dtype)
+            for i, pg in enumerate(pages):
+                v[:, i] = pg[j]
+            vals.append(v)
+        fn = self._restore_jit(b, len(live))
+        with self.mesh:
+            new_leaves = fn(jnp.asarray(ids),
+                            tuple(jnp.asarray(v) for v in vals), *live)
+        it = iter(new_leaves)
+        self.paged = type(self.paged)(
+            *[next(it) if lf is not None else None for lf in self.paged])
+
+    def _restore_from_arena(self, prompt, n, prefix_blocks, cached):
+        """Second-tier prefix lookup on a (partial) radix miss: restore
+        the longest consecutive run of arena-held blocks that extends the
+        radix match, register them in the radix tree, and return the
+        extended (prefix_blocks, cached). Opportunistic — any failure
+        (no free device blocks, arena LRU race) simply falls back to
+        prefilling that span. Restored bytes are the exact evicted
+        bytes, so downstream outputs are bitwise identical to a cold
+        prefill."""
+        bs = self.block_size
+        start = cached // bs
+        limit = (n - 1) // bs   # >=1 token must remain for the tail
+        if start >= limit:
+            return prefix_blocks, cached
+        digs = self.kvtier.block_digests(prompt[:limit * bs])
+        run = []
+        for i in range(start, limit):
+            if self.kvtier.arena.peek(digs[i]):
+                run.append(digs[i])
+            else:
+                break
+        if not run:
+            return prefix_blocks, cached
+        blocks = self.pool.alloc(len(run))
+        if blocks is None:
+            return prefix_blocks, cached
+        pages = []
+        for d in run:
+            pg = self.kvtier.arena.get(d)
+            if pg is None:   # LRU-dropped by our own alloc's offloads
+                break
+            pages.append(pg)
+        if len(pages) < len(blocks):
+            self.pool.release(blocks[len(pages):])
+            blocks = blocks[:len(pages)]
+        if not blocks:
+            return prefix_blocks, cached
+        w0 = time.time()
+        self._run_restore(blocks, pages)
+        end = start + len(blocks)
+        self.pool.insert_prefix(prompt[:end * bs], blocks, skip=start)
+        self.metrics.inc("kvtier_restored_blocks", len(blocks))
+        self.metrics.inc("kvtier_restored_tokens", len(blocks) * bs)
+        trace.get_tracer().record(
+            "batcher.kv_restore", w0, time.time(),
+            attrs={"blocks": len(blocks), "tokens": len(blocks) * bs})
+        return prefix_blocks + blocks, end * bs
+
+    def _gauge_stall_streak(self, req):
+        """chunk_prefill_stall_streak = the WORST current streak across
+        chunked-prefill requests, not the last writer's — one progressing
+        prompt must not zero the gauge while another sits one stall from
+        a 'pool exhausted' failure (``req`` is mid-admission, so it is
+        not in the queue)."""
+        with self._lock:
+            worst = max((r._chunk_stalls for r in self.queue), default=0)
+        self.metrics.gauge("chunk_prefill_stall_streak",
+                           max(worst, req._chunk_stalls))
+
+    def _sync_cache_metrics(self):
+        """Mirror the native pool's lifetime radix counters — and the
+        host arena's occupancy — into the metrics registry, so the
+        cluster-metrics pipeline (master /api/cluster_metrics) sees them:
+        until now prefix_hits/misses lived only in ``stats()["pool"]``,
+        invisible to /metrics scrapes."""
+        st = self.pool.stats()
+        last = self._last_pool_stats
+        for key, mname in (("prefix_hits", "radix_prefix_hits"),
+                           ("prefix_misses", "radix_prefix_misses"),
+                           ("evictions", "radix_evictions")):
+            d = st[key] - last.get(key, 0)
+            # inc even when 0: the counter must EXIST in /metrics from
+            # the first step (a scraper can't tell "no hits yet" from
+            # "metric not exported" otherwise)
+            self.metrics.inc(mname, max(0, d))
+            last[key] = st[key]
+        if self.kvtier is not None:
+            a = self.kvtier.arena.stats()
+            self.metrics.gauge("kvtier_host_blocks", a["blocks"])
+            self.metrics.gauge("kvtier_host_bytes", a["bytes"])
+            self.metrics.gauge(
+                "kvtier_occupancy",
+                a["bytes"] / max(1, a["capacity_bytes"]))
+
     def _prep_admit(self, req: BatchRequest) -> Optional[dict]:
         """Host-side admission prep: radix prefix match + block allocation.
         None if blocks are unavailable (caller decides preempt/requeue).
@@ -749,6 +949,12 @@ class ContinuousBatcher:
         # Leave >=1 token for the tail: prefill must produce the last
         # token's logits (a fully-cached prompt would have nothing to run).
         prefix_blocks, cached = self.pool.match_prefix(prompt[:n - 1])
+        if self.kvtier is not None and self.program_hook is None:
+            # tier 2: extend the radix match from the host arena before
+            # falling back to recompute (multi-host lockstep opts out —
+            # a host-initiated scatter cannot ride the program broadcast)
+            prefix_blocks, cached = self._restore_from_arena(
+                prompt, n, prefix_blocks, cached)
         tail_alloc = []
         partial = False
         try:
@@ -941,6 +1147,17 @@ class ContinuousBatcher:
         bs = self.block_size
         n, cached, tail_len = m["n"], m["cached"], m["tail_len"]
         tail_alloc, prefix_blocks = m["tail_alloc"], m["prefix_blocks"]
+        # prefill amortization counters (bench --scenario prefix_cache
+        # A/Bs the cluster-wide cached fraction): tokens served from the
+        # cache tiers vs tokens actually run through prefill — counted at
+        # real admission, not at prep (a rolled-back wave-overflow prep
+        # would double count), and only BEYOND the request's own prior
+        # extent (a resumed chunk pass re-matching its own pass-N-1
+        # blocks is not a cache win)
+        self.metrics.inc("prefill_cached_tokens",
+                         max(0, cached - req._prefill_counted))
+        self.metrics.inc("prefill_uncached_tokens", tail_len)
+        req._prefill_counted = max(req._prefill_counted, n)
         tail_real = tail_alloc[: -(-tail_len // bs)]
         self.pool.release(tail_alloc[len(tail_real):])  # padding blocks
 
@@ -961,11 +1178,18 @@ class ContinuousBatcher:
             if n > req._chunk_high:
                 req._chunk_high = n
                 req._chunk_stalls = 0
+                self._gauge_stall_streak(req)
             else:
                 # eviction between passes undid progress; bounded, or two
-                # pool-sized prompts could re-prefill each other forever
+                # pool-sized prompts could re-prefill each other forever.
+                # Surfaced as a counter + streak gauge so operators see
+                # cache-pressure thrash BEFORE it becomes a stall/failure
+                # (docs/serving.md "Prefix-cache tier").
                 req._chunk_stalls += 1
+                self.metrics.inc("chunk_prefill_stalls")
+                self._gauge_stall_streak(req)
                 if req._chunk_stalls > 4:
+                    self.metrics.inc("chunk_prefill_stall_failures")
                     self._fail_req(req, "KV block pool exhausted "
                                         "(chunked prefill made no progress)")
                     return
@@ -1142,6 +1366,7 @@ class ContinuousBatcher:
                 m.gauge("batcher_batch_occupancy",
                         active_slots / self.slots)
             m.gauge("batcher_free_kv_blocks", self.pool.free_count())
+            self._sync_cache_metrics()
 
     def _step_inner(self) -> int:
         # drop cancelled slots first — frees their blocks for admission

@@ -175,7 +175,7 @@ NODES = f"""<!doctype html><html><head><title>Nodes</title>{_STYLE}
 <h2>Worker Nodes</h2>
 <table><thead><tr><th>ID</th><th>Name</th><th>Address</th><th>Status</th>
 <th>Devices</th><th>CPU %</th><th>Mem %</th><th>Models</th><th>In-flight</th>
-<th>Queue</th><th>Free KV</th><th>Lat EWMA</th>
+<th>Queue</th><th>Free KV</th><th>Lat EWMA</th><th>Prefix hit</th>
 <th></th></tr></thead><tbody id="nodes"></tbody></table>
 <h2 style="margin-top:24px">Placement Plans</h2>
 <table><thead><tr><th>ID</th><th>Model</th><th>Mesh</th><th>Devices</th>
@@ -273,6 +273,10 @@ async function refresh() {{
     `<td>${{n.queue_depth ?? '–'}}</td>`+
     `<td>${{n.free_kv_blocks ?? '–'}}</td>`+
     `<td>${{n.latency_ewma_ms != null ? n.latency_ewma_ms+' ms' : '–'}}</td>`+
+    // prefix-cache tier outcome: the node's radix hit ratio (affinity
+    // routing should drive this UP on shared-prefix traffic)
+    `<td>${{n.prefix_hit_ratio != null
+        ? Math.round(n.prefix_hit_ratio*100)+'%' : '–'}}</td>`+
     `<td><button onclick="removeNode(${{n.id}})">Remove</button></td></tr>`;
   }}).join('');
 }}

@@ -30,6 +30,8 @@ from typing import Dict, Optional, Set
 import requests as http
 
 from distributed_llm_inferencing_tpu.runtime import dashboard_html, httpd
+from distributed_llm_inferencing_tpu.runtime.kvtier import (
+    estimate_cached_tokens)
 from distributed_llm_inferencing_tpu.runtime.state import Store
 from distributed_llm_inferencing_tpu.utils import trace
 from distributed_llm_inferencing_tpu.utils.logging import setup_logging
@@ -79,6 +81,14 @@ RPC_CONNECT_TIMEOUT = float(os.environ.get("DLI_RPC_CONNECT_TIMEOUT", 5.0))
 # may be before the scheduler stops trusting it.
 SCHED_EWMA_ALPHA = float(os.environ.get("DLI_SCHED_EWMA_ALPHA", 0.2))
 SCHED_STALE_S = float(os.environ.get("DLI_SCHED_STALE_S", 30.0))
+# Prefix-affinity routing (runtime/kvtier.py, FlowKV's load-aware rule):
+# a candidate whose advertised prefix digests cover the incoming prompt
+# wins the pick ONLY while its load stays within PREFIX_SLACK queue
+# entries of the least-loaded candidate — affinity must never turn a hot
+# node into a convoy. WEIGHT scales the advertised token estimate
+# (w * est >= 1 token to act); 0 disables affinity entirely.
+SCHED_PREFIX_WEIGHT = float(os.environ.get("DLI_SCHED_PREFIX_WEIGHT", 1.0))
+SCHED_PREFIX_SLACK = int(os.environ.get("DLI_SCHED_PREFIX_SLACK", 2))
 _BATCH_SIZE_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0)
 MODEL_GAUGES_MAX = 32     # per-model queue gauges (client-named) cap
 
@@ -132,7 +142,9 @@ class Master:
                  retry_backoff_base: float = RETRY_BACKOFF_BASE,
                  dispatch_batch: int = DISPATCH_BATCH,
                  rpc_pool: Optional[bool] = None,
-                 rpc_pool_size: int = RPC_POOL_SIZE):
+                 rpc_pool_size: int = RPC_POOL_SIZE,
+                 prefix_weight: Optional[float] = None,
+                 prefix_slack: Optional[int] = None):
         self._stop = threading.Event()
         self._wake = threading.Event()
         # Group-commit store: the dispatch hot path's status writes
@@ -158,6 +170,12 @@ class Master:
         self._node_runtime: Dict[int, dict] = {}
         self._node_lat_ewma: Dict[int, float] = {}
         self._ewma_alpha = SCHED_EWMA_ALPHA
+        # prefix-affinity routing knobs (instance-level so a bench can
+        # A/B two masters with the tier on/off in one process)
+        self._prefix_weight = (SCHED_PREFIX_WEIGHT if prefix_weight is None
+                               else float(prefix_weight))
+        self._prefix_slack = (SCHED_PREFIX_SLACK if prefix_slack is None
+                              else int(prefix_slack))
         self._pending_models: Set[str] = set()
         n = self.store.recover_stale_processing(max_attempts=MAX_ATTEMPTS)
         if n:
@@ -414,6 +432,12 @@ class Master:
             rt_fresh = bool(rt) and (time.time() - rt.get("at", 0)
                                      <= SCHED_STALE_S)
             ewma = self._node_lat_ewma.get(n["id"])
+            # per-node radix prefix-hit ratio (averaged over the node's
+            # batcher-served models): the affinity policy's outcome
+            # metric on the nodes dashboard
+            ratios = [m.get("hit_ratio")
+                      for m in (rt.get("models") or {}).values()
+                      if m.get("hit_ratio") is not None] if rt_fresh else []
             nodes.append({
                 "id": n["id"], "name": n["name"], "host": n["host"],
                 "port": n["port"], "is_active": bool(n["is_active"]),
@@ -433,6 +457,8 @@ class Master:
                                    if rt_fresh else None),
                 "latency_ewma_ms": (round(ewma * 1e3, 1)
                                     if ewma is not None else None),
+                "prefix_hit_ratio": (round(sum(ratios) / len(ratios), 3)
+                                     if ratios else None),
             })
         return {"status": "success", "nodes": nodes}
 
@@ -664,9 +690,23 @@ class Master:
             if not isinstance(sch, dict):
                 continue
             bf = sch.get("blocks_free")
-            models[str(m.get("name") or "")] = {
+            entry = {
                 "queue": int(sch.get("queued") or 0),
                 "free": int(bf) if bf is not None else None}
+            # prefix-cache tier advertisement (runtime/kvtier.py): the
+            # digest chains ride here — the master's ONLY view of what
+            # prompts a worker has warm (the persisted node row strips
+            # them) — plus the radix hit ratio the dashboard renders
+            adv = sch.get("prefix_digests")
+            if isinstance(adv, dict) and adv.get("top"):
+                entry["digests"] = adv
+            pool = sch.get("pool")
+            if isinstance(pool, dict):
+                h = int(pool.get("prefix_hits") or 0)
+                miss = int(pool.get("prefix_misses") or 0)
+                if h + miss:
+                    entry["hit_ratio"] = h / (h + miss)
+            models[str(m.get("name") or "")] = entry
         if merge:
             prev = self._node_runtime.get(node_id)
             if prev and prev.get("models"):
@@ -688,14 +728,25 @@ class Master:
         self._node_lat_ewma[node_id] = (
             seconds if prev is None else a * seconds + (1 - a) * prev)
 
-    def _score_pick(self, cands):
+    def _score_pick(self, cands, model=None, prompt=None):
         """Queue-aware choice among schedulable candidates. Primary
         load = max(master-side in-flight, worker-reported batcher queue
         depth) — max, not sum: every request this master dispatched and
         the worker still queues would otherwise count twice, biasing
         picks TOWARD nodes that report no scheduler stats (the honest
         reporter loses). The worker-side number still dominates when
-        other masters feed the same node. Ties break to the node with
+        other masters feed the same node.
+
+        Prefix affinity runs first (FlowKV's load-aware rule): a
+        candidate whose advertised prefix-digest chains cover a prefix
+        of ``prompt`` wins — but only while its load stays within
+        ``prefix_slack`` of the least-loaded candidate, so a node that
+        accumulated every hot prefix cannot also accumulate every
+        request. Advertisements ride the same staleness-gated runtime
+        snapshot as queue depths: a node silent past SCHED_STALE_S
+        drops out of affinity exactly as it drops out of queue scoring.
+
+        Otherwise: lowest primary load; ties break to the node with
         the most free KV blocks,
         then the lowest completion-latency EWMA. With no fresh
         worker-reported state at all this degrades to the old
@@ -719,6 +770,25 @@ class Master:
                        s["queue"] if s else 0)
 
         lo = min(primary(n) for n in cands)
+        if prompt and model and self._prefix_weight > 0 and len(cands) > 1:
+            memo: Dict[int, list] = {}   # prompt digest chains per chunk
+            aff = []
+            for n in cands:
+                entry = ((rt.get(n["id"]) or {}).get("models")
+                         or {}).get(model)
+                est = estimate_cached_tokens(
+                    prompt, (entry or {}).get("digests"), memo)
+                if (est * self._prefix_weight >= 1
+                        and primary(n) <= lo + self._prefix_slack):
+                    aff.append((est, n))
+            # affinity must SEPARATE candidates: when every candidate
+            # holds the same prefix depth there is nothing to win, and
+            # the load-based policy below picks better
+            if aff and (len(aff) < len(cands)
+                        or len({e for e, _ in aff}) > 1):
+                best = max(e for e, _ in aff)
+                top = [n for e, n in aff if e == best]
+                return min(top, key=primary), "prefix_affinity"
         tied = [n for n in cands if primary(n) == lo]
         if len(tied) == 1:
             return tied[0], "queue_depth"
@@ -743,7 +813,8 @@ class Master:
                    exclude: Optional[Set[int]] = None,
                    reserve: bool = False,
                    prefer: Optional[int] = None,
-                   nodes: Optional[list] = None):
+                   nodes: Optional[list] = None,
+                   prompt: Optional[str] = None):
         """Least-loaded schedulable node, preferring ones with the model
         already loaded (reference: always .first(), views.py:389-391).
 
@@ -790,7 +861,9 @@ class Master:
                 if pinned:
                     chosen, reason = pinned[0], "pinned"
                 else:
-                    chosen, reason = self._score_pick(have or pool)
+                    chosen, reason = self._score_pick(have or pool,
+                                                      model=model,
+                                                      prompt=prompt)
                 self.metrics.inc(f"scheduler_pick_{reason}")
                 if reserve:
                     self._inflight[chosen["id"]] = \
@@ -858,7 +931,8 @@ class Master:
                   if req.get("node_id") and req["node_id"] not in excluded
                   else None)
         node = self._pick_node(req["model_name"], exclude=excluded,
-                               reserve=True, prefer=prefer, nodes=nodes)
+                               reserve=True, prefer=prefer, nodes=nodes,
+                               prompt=req.get("prompt"))
         if node is None:
             # nothing schedulable right now (all breakers open / nodes
             # draining): park instead of failing — at least a health

@@ -83,6 +83,28 @@ _MIGRATIONS = {
 }
 
 
+def _strip_ephemeral(info):
+    """Drop ephemeral scheduler payloads (Store.EPHEMERAL_SCHEDULER_KEYS,
+    e.g. the prefix-digest advertisement) from a worker /health body
+    before it is persisted as the node's info row. Non-destructive: the
+    caller's dict is not mutated — the master's in-memory runtime
+    snapshot still sees the full advertisement."""
+    if not isinstance(info, dict) or "loaded_models" not in info:
+        return info
+    out = dict(info)
+    models = []
+    for m in out.get("loaded_models") or []:
+        sch = m.get("scheduler") if isinstance(m, dict) else None
+        if isinstance(sch, dict) and any(
+                k in sch for k in Store.EPHEMERAL_SCHEDULER_KEYS):
+            m = dict(m)
+            m["scheduler"] = {k: v for k, v in sch.items()
+                              if k not in Store.EPHEMERAL_SCHEDULER_KEYS}
+        models.append(m)
+    out["loaded_models"] = models
+    return out
+
+
 def _row_to_dict(cur, row):
     return {d[0]: row[i] for i, d in enumerate(cur.description)}
 
@@ -278,13 +300,21 @@ class Store:
 
     def update_node(self, node_id: int, **fields):
         if "info" in fields and not isinstance(fields["info"], str):
-            fields["info"] = json.dumps(fields["info"])
+            fields["info"] = json.dumps(_strip_ephemeral(fields["info"]))
         sets = ", ".join(f"{k}=?" for k in fields)
         self._exec(f"UPDATE nodes SET {sets} WHERE id=?",
                    (*fields.values(), node_id))
 
     def remove_node(self, node_id: int):
         self._exec("DELETE FROM nodes WHERE id=?", (node_id,))
+
+    # kept out of the persisted node row: ephemeral routing state that is
+    # re-advertised on every health scrape and only consumed from the
+    # master's in-memory per-node runtime snapshot (_note_runtime). The
+    # prefix-digest advertisement alone is up to a few KB per model per
+    # sweep — persisting it would grow every health write for data that
+    # is stale the moment the next scrape lands.
+    EPHEMERAL_SCHEDULER_KEYS = ("prefix_digests",)
 
     def node_url(self, node) -> str:
         # ≙ WorkerNode.get_url (reference models.py:16-17)

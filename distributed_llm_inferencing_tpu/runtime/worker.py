@@ -270,6 +270,13 @@ class WorkerAgent:
                 # get up to spec_gamma+1 tokens/iteration bit-identically
                 speculative=body.get("speculative"),
                 spec_gamma=int(body.get("spec_gamma", 4)),
+                # host-RAM KV offload arena budget (runtime/kvtier.py);
+                # None defers to DLI_KV_HOST_MB, 0 disables the tier
+                kv_host_mb=(float(body["kv_host_mb"])
+                            if body.get("kv_host_mb") is not None
+                            else None),
+                kv_digest_chunk=(int(body["kv_digest_chunk"])
+                                 if body.get("kv_digest_chunk") else None),
                 mesh_spec=mesh, metrics=self.metrics)
             batcher.start()
             lm = LoadedModel(None, tok, source, batcher=batcher)
@@ -595,6 +602,7 @@ class WorkerAgent:
                           "eos_token_id": m.tokenizer.eos_token_id,
                           "seed": sub_body.get("seed"),
                           "trace_ctx": trace.extract(sub_body) or ctx})
+            self._note_prefix(m, sub_body, prompt)
             metas.append((sub_body, tag, my_ev, t0))
         try:
             reqs = m.batcher.submit_many(specs) if specs else []
@@ -650,6 +658,18 @@ class WorkerAgent:
                 self._idem_release(tag, my_ev, res)
             self._end_inference()
             emit(tag, st, pl)
+
+    def _note_prefix(self, m, body, prompt) -> None:
+        """Feed a served prompt into the prefix-digest advertisement
+        (runtime/kvtier.py PrefixDigestIndex): called at batcher submit
+        time — the prompt's KV is entering the radix cache — with the
+        prompt TEXT, because the master routes on text-level digests (it
+        never tokenizes). Token-id submissions have no text to chain and
+        are simply not advertised."""
+        b = m.batcher
+        if (b is not None and b.kvtier is not None
+                and isinstance(body.get("prompt"), str) and body["prompt"]):
+            b.kvtier.note_text(body["prompt"], len(prompt))
 
     def _idem_claim(self, tag: str):
         """One atomic look at the idempotency state for ``tag``:
@@ -734,6 +754,7 @@ class WorkerAgent:
                         prompt, max_new_tokens=max_new, sampling=sp,
                         eos_token_id=m.tokenizer.eos_token_id,
                         seed=body.get("seed"))
+                    self._note_prefix(m, body, prompt)
                     if tag:
                         with self._tagged_lock:
                             self._tagged[str(tag)] = req
@@ -878,6 +899,7 @@ class WorkerAgent:
                         prompt, max_new_tokens=max_new, sampling=sp,
                         eos_token_id=m.tokenizer.eos_token_id, stream_cb=cb,
                         seed=body.get("seed"), trace_ctx=ctx)
+                    self._note_prefix(m, body, prompt)
                     toks = req.wait(timeout=float(body.get("timeout", 300)))
                     q.put({"event": "done",
                            "result": m.tokenizer.decode(toks),

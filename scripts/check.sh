@@ -39,6 +39,17 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu DLI_FAULTS_ENABLE=1 \
 timeout -k 10 600 env JAX_PLATFORMS=cpu \
     python bench.py --scenario control_plane --smoke || exit 1
 
+echo "== prefix-cache tier suite + shared-prefix smoke (kv offload + affinity) =="
+# Host-RAM KV offload arena + prefix-digest advertisement + affinity
+# routing (docs/serving.md "Prefix-cache tier"); the smoke drives a live
+# master + 2 in-proc workers over a shared-system-prompt workload and
+# gates on zero failures + affinity picks + cached-prefill fraction
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_kvtier.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python bench.py --scenario prefix_cache --smoke || exit 1
+
 echo "== chaos suite (fault injection + self-healing dispatch) =="
 # Deterministic fault schedules: a failure here reproduces locally with
 #   DLI_FAULTS_SEED=0 JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q
@@ -60,6 +71,7 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     --ignore=tests/test_gemv_threads.py \
     --ignore=tests/test_adaptive_spec.py \
     --ignore=tests/test_dispatch_batch.py \
+    --ignore=tests/test_kvtier.py \
     2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
