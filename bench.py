@@ -374,6 +374,38 @@ def _control_plane_workers(n_workers, max_new=1):
     return workers
 
 
+def _goodput(done, wall):
+    """SLO/goodput rollup over completed request rows. The master
+    persists each request's cost-ledger record onto its row, so the
+    bench evaluates the SAME per-request signal the master's SLO
+    evaluator uses (runtime/tsdb.py cost_within_slo) — goodput is
+    requests completing WITHIN the declared SLO per second, reported
+    next to raw completed-req/s in every scenario."""
+    from distributed_llm_inferencing_tpu.runtime import tsdb
+    targets = tsdb.slo_targets()
+    evaluated = good = 0
+    for st in done:
+        cost = st.get("cost")
+        if isinstance(cost, str):
+            try:
+                cost = json.loads(cost)
+            except ValueError:
+                cost = None
+        ok = tsdb.cost_within_slo(cost, targets)
+        if ok is None:
+            continue
+        evaluated += 1
+        good += bool(ok)
+    return {
+        "ttft_target_ms": targets["ttft_ms"],
+        "itl_p95_target_ms": targets["itl_p95_ms"],
+        "evaluated": evaluated,
+        "within_slo": good,
+        "attainment": (round(good / evaluated, 3) if evaluated else None),
+        "goodput_req_per_s": round(good / max(wall, 1e-9), 2),
+    }
+
+
 def bench_control_plane(n_requests=160, concurrency=32, n_workers=2,
                         mode="batched", max_new=1, workers=None):
     """Control-plane saturation: master + in-proc batched workers, N
@@ -488,6 +520,7 @@ def bench_control_plane(n_requests=160, concurrency=32, n_workers=2,
             "sched_picks": {k[len("scheduler_pick_"):]: int(v)
                             for k, v in c.items()
                             if k.startswith("scheduler_pick_")},
+            "slo": _goodput(done, wall),
         }
     finally:
         m.stop()
@@ -667,6 +700,7 @@ def bench_prefix_cache(n_requests=96, concurrency=8, n_workers=2,
                 wc.get("kvtier_restored_tokens", 0)),
             "radix_hits": int(wc.get("radix_prefix_hits", 0)),
             "radix_misses": int(wc.get("radix_prefix_misses", 0)),
+            "slo": _goodput(done, wall),
         }
     finally:
         m.stop()
@@ -780,13 +814,18 @@ def _scenario_main(argv):
         # under --ab the per-run stats are nested; gate on the batched leg
         run = result.get("batched", result)
         ok = (run.get("completed") == n and run.get("failed") == 0
-              and run.get("rpc_conn_reuse_ratio", 0) > 0.5)
+              and run.get("rpc_conn_reuse_ratio", 0) > 0.5
+              # cost-ledger plumbing: every completed request's row must
+              # carry an evaluable cost record (worker -> master -> row)
+              and run.get("slo", {}).get("evaluated") == n)
         if not ok:
             print("control-plane smoke FAILED", file=sys.stderr)
             return 1
         print(f"control-plane smoke ok: "
               f"{run['completed_req_per_s']} req/s, "
-              f"reuse {run['rpc_conn_reuse_ratio']}", file=sys.stderr)
+              f"reuse {run['rpc_conn_reuse_ratio']}, "
+              f"goodput {run['slo']['goodput_req_per_s']} req/s "
+              f"(attainment {run['slo']['attainment']})", file=sys.stderr)
     return 0
 
 

@@ -60,8 +60,10 @@ from distributed_llm_inferencing_tpu.parallel import sharding as shd
 from distributed_llm_inferencing_tpu.parallel.mesh import (
     MeshSpec, create_mesh, validate_spec)
 from distributed_llm_inferencing_tpu.runtime import kvtier as kvtier_mod
+from distributed_llm_inferencing_tpu.runtime import tsdb as tsdb_mod
 from distributed_llm_inferencing_tpu.utils import trace
 from distributed_llm_inferencing_tpu.utils.metrics import Metrics
+from distributed_llm_inferencing_tpu.utils.profiler import PhaseProfiler
 
 TAIL_BUCKETS_X_BS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512)  # × block_size
 PREFIX_BUCKETS = (0, 1, 2, 4, 8, 16, 32, 64, 128, 256, 512)  # blocks
@@ -84,6 +86,13 @@ class BatchRequest:
     submitted_at: float = dataclasses.field(default_factory=time.time)
     first_token_at: Optional[float] = None
     finished_at: Optional[float] = None
+    # cost ledger: when the FIRST admission wave carrying this request
+    # started dispatching — queue_ms = admitted_at - submitted_at, and
+    # queue + prefill + decode sum exactly to the e2e span
+    admitted_at: Optional[float] = None
+    # the finished record (phase ms + resource counts), built once in
+    # _observe_finished; the worker attaches it to the response payload
+    cost: Optional[dict] = None
     # submitter's trace context (utils/trace.py SpanCtx): the scheduler
     # runs in its own thread, so the link to the originating HTTP request
     # rides the request object instead of a contextvar
@@ -108,6 +117,16 @@ class BatchRequest:
     # prompt is mostly radix-cached): skip re-popping it — and the
     # match_prefix + alloc churn that costs — until a slot frees
     _noslot_bounce: bool = False
+    # cost-ledger accumulators (freed with the request)
+    _gaps: List[float] = dataclasses.field(default_factory=list)
+    _cost_cached: int = 0       # prompt tokens served from cache tiers
+    _cost_uncached: int = 0     # prompt tokens actually prefilled
+    _weight_passes: int = 0     # decode iterations this request rode
+    _kv_peak: int = 0           # peak device KV blocks owned at once
+    _arena_restored_bytes: int = 0
+    _arena_offloaded_bytes: int = 0
+    _spec_acc: int = 0          # draft tokens accepted beyond 1/iteration
+    _spec_rej: int = 0          # draft tokens rejected by verification
 
     def wait(self, timeout: Optional[float] = None) -> List[int]:
         if not self.done.wait(timeout):
@@ -320,6 +339,16 @@ class ContinuousBatcher:
             self.pool.set_evict_hook(self._offload_evicted)
         self._restore_fns = {}        # restore-scatter jits per row bucket
         self._last_pool_stats = {}    # radix counter -> metrics delta base
+        # cost-ledger attribution: the request whose admission prep is
+        # currently allocating (scheduler-thread-local by construction) —
+        # arena offloads triggered by ITS alloc bill to it
+        self._admitting: Optional[BatchRequest] = None
+        # declarative SLO targets (runtime/tsdb.py): used worker-side
+        # only to flag SLO-violating requests for trace tail-retention
+        self._slo_targets = tsdb_mod.slo_targets()
+        # opt-in sampling phase profiler for this step loop
+        # (utils/profiler.py; DLI_PROFILE=1 or worker POST /api/profile)
+        self.profiler = PhaseProfiler.from_env()
         self.context_lens = np.zeros((slots,), np.int32)
         self.active: List[Optional[BatchRequest]] = [None] * slots
         self._admit_order: collections.deque = collections.deque()  # slot ids
@@ -622,14 +651,18 @@ class ContinuousBatcher:
                            np.asarray(a["tps"], np.float32)])
         fn = self._decode_jit(int(a["k"]), r, mb)
         with self.mesh:
-            tokens = (tokens_dev if tokens_dev is not None
-                      else jnp.asarray(np.asarray(a["tokens"], np.int32)))
-            toks, emits, self.paged = fn(self.params, tokens,
-                                         jnp.asarray(ints),
-                                         jnp.asarray(floats), self.paged)
+            with self.profiler.phase("dispatch"):
+                tokens = (tokens_dev if tokens_dev is not None
+                          else jnp.asarray(np.asarray(a["tokens"],
+                                                      np.int32)))
+                toks, emits, self.paged = fn(self.params, tokens,
+                                             jnp.asarray(ints),
+                                             jnp.asarray(floats),
+                                             self.paged)
             if not sync:
                 return toks, emits
-            return jax.device_get((toks, emits))
+            with self.profiler.phase("device_wait"):
+                return jax.device_get((toks, emits))
 
     def _hist_deltas(self) -> list:
         """JSON-safe per-slot history deltas for the lockstep broadcast:
@@ -702,10 +735,12 @@ class ContinuousBatcher:
         fn = self._spec_jit(int(a["k"]), int(a["gamma"]), r, mb,
                             hist.shape[1])
         with self.mesh:
-            toks, keeps, eos_seen, self.paged = fn(
-                self.params, jnp.asarray(ints), jnp.asarray(floats),
-                self.paged)
-            return jax.device_get((toks, keeps, eos_seen))
+            with self.profiler.phase("dispatch"):
+                toks, keeps, eos_seen, self.paged = fn(
+                    self.params, jnp.asarray(ints), jnp.asarray(floats),
+                    self.paged)
+            with self.profiler.phase("device_wait"):
+                return jax.device_get((toks, keeps, eos_seen))
 
     def replay(self, kind: str, args: dict):
         """Re-execute a program the lockstep leader broadcast. SPMD
@@ -801,10 +836,18 @@ class ContinuousBatcher:
         with self.mesh:
             pages = jax.device_get([lf[:, idx] for lf in leaves])
         stored = 0
+        nbytes = 0
         for col, j in enumerate(keep):
-            if self.kvtier.arena.put(digs[j], [p[:, col] for p in pages]):
+            cols = [p[:, col] for p in pages]
+            if self.kvtier.arena.put(digs[j], cols):
                 stored += 1
+                nbytes += sum(c.nbytes for c in cols)
         self.metrics.inc("kvtier_offloaded_blocks", stored)
+        if self._admitting is not None and nbytes:
+            # cost ledger: the alloc that evicted these blocks belongs to
+            # the request currently admitting/growing — its ledger shows
+            # the device->host traffic it displaced
+            self._admitting._arena_offloaded_bytes += nbytes
         trace.get_tracer().record(
             "batcher.kv_offload", w0, time.time(),
             attrs={"blocks": len(ev), "stored": stored})
@@ -894,6 +937,9 @@ class ContinuousBatcher:
         self.pool.insert_prefix(prompt[:end * bs], blocks, skip=start)
         self.metrics.inc("kvtier_restored_blocks", len(blocks))
         self.metrics.inc("kvtier_restored_tokens", len(blocks) * bs)
+        if self._admitting is not None:
+            self._admitting._arena_restored_bytes += sum(
+                p.nbytes for pg in pages for p in pg)
         trace.get_tracer().record(
             "batcher.kv_restore", w0, time.time(),
             attrs={"blocks": len(blocks), "tokens": len(blocks) * bs})
@@ -1017,10 +1063,15 @@ class ContinuousBatcher:
                 self._fail_req(req, "cancelled")
                 continue
             try:
+                # cost-ledger attribution window: arena offloads fired
+                # by this prep's allocs bill to this request
+                self._admitting = req
                 prep = self._prep_admit(req)
             except ValueError as e:
                 self._fail_req(req, str(e))
                 continue
+            finally:
+                self._admitting = None
             if (prep is not None and wave
                     and (self._shared_wave_blocks(wave, prep["prompt"])
                          * self.block_size > prep["cached"])):
@@ -1118,6 +1169,12 @@ class ContinuousBatcher:
             "tks": tks.tolist(), "tps": tps.tolist(), "ds": ds.tolist(),
         }
         w0 = time.time()
+        for m in members:
+            # cost ledger: queue phase ends when the FIRST wave carrying
+            # the request starts dispatching (chunked-prefill passes and
+            # preemption re-admissions keep the original stamp)
+            if m["req"].admitted_at is None:
+                m["req"].admitted_at = w0
         if self.program_hook is not None:
             first = self.program_hook("admit", admit_args,
                                       lambda: self._run_admit(admit_args))
@@ -1157,6 +1214,10 @@ class ContinuousBatcher:
         self.metrics.inc("prefill_cached_tokens",
                          max(0, cached - req._prefill_counted))
         self.metrics.inc("prefill_uncached_tokens", tail_len)
+        # cost ledger mirrors the cluster counters' exact expressions, so
+        # a request's record reconciles with the kvtier metrics deltas
+        req._cost_cached += max(0, cached - req._prefill_counted)
+        req._cost_uncached += tail_len
         req._prefill_counted = max(req._prefill_counted, n)
         tail_real = tail_alloc[: -(-tail_len // bs)]
         self.pool.release(tail_alloc[len(tail_real):])  # padding blocks
@@ -1200,6 +1261,7 @@ class ContinuousBatcher:
             return
 
         req._blocks = prefix_blocks + tail_real
+        req._kv_peak = max(req._kv_peak, len(req._blocks))
         self.block_tables[slot, :] = self._dummy
         owned = prefix_blocks + tail_real
         self.block_tables[slot, :len(owned)] = owned
@@ -1247,8 +1309,12 @@ class ContinuousBatcher:
             # burst, chunk-sized at boundaries, and stall-sized across a
             # preemption/re-prefill — a per-request mean would average
             # that 2s pause invisible
-            self.metrics.observe("batcher_inter_token",
-                                 now - req._last_emit_at)
+            gap = now - req._last_emit_at
+            self.metrics.observe("batcher_inter_token", gap)
+            # per-request gap list for the cost record's ITL p95 (the
+            # SLO evaluator's per-request signal); bounded by the
+            # request's own max_new_tokens, freed with the request
+            req._gaps.append(gap)
         req._last_emit_at = now
         req.tokens.append(token)
         self._tokens_out += 1
@@ -1265,10 +1331,44 @@ class ContinuousBatcher:
         self._observe_finished(req)   # before done.set(): a waiter may
         req.done.set()                # scrape /metrics|/api/trace at once
 
+    def _cost_record(self, req: BatchRequest, end: float) -> dict:
+        """Assemble the request's cost-ledger record. The three phases
+        partition [submitted_at, end) exactly — queue ends when the
+        first admission wave starts dispatching, prefill ends at the
+        first token, decode ends at finish — so queue + prefill + decode
+        sum to the e2e span by construction (preemption re-prefills land
+        in the decode phase, where the stall actually happened)."""
+        admitted = req.admitted_at if req.admitted_at is not None else end
+        first = req.first_token_at if req.first_token_at is not None \
+            else admitted
+        gaps = sorted(req._gaps)
+        cost = {
+            "queue_ms": round(max(0.0, admitted - req.submitted_at) * 1e3,
+                              3),
+            "prefill_ms": round(max(0.0, first - admitted) * 1e3, 3),
+            "decode_ms": round(max(0.0, end - first) * 1e3, 3),
+            "prefill_cached_tokens": req._cost_cached,
+            "prefill_uncached_tokens": req._cost_uncached,
+            "decode_tokens": len(req.tokens),
+            "weight_passes": req._weight_passes,
+            "kv_blocks_peak": req._kv_peak,
+            "arena_restored_bytes": req._arena_restored_bytes,
+            "arena_offloaded_bytes": req._arena_offloaded_bytes,
+            "spec_accepted_tokens": req._spec_acc,
+            "spec_rejected_tokens": req._spec_rej,
+            "preemptions": req._preemptions,
+        }
+        if gaps:
+            cost["itl_p95_ms"] = round(
+                gaps[min(len(gaps) - 1, int(len(gaps) * 0.95))] * 1e3, 3)
+            cost["itl_max_ms"] = round(gaps[-1] * 1e3, 3)
+        return cost
+
     def _observe_finished(self, req: BatchRequest):
         """Per-request histograms + retroactive trace spans, reconstructed
         from the request's own timestamps (the scheduler thread has no
-        ambient trace context — the link rides req.trace_ctx)."""
+        ambient trace context — the link rides req.trace_ctx), plus the
+        cost-ledger record the worker returns with the result."""
         m = self.metrics
         m.inc("batcher_requests_failed" if req.error
               else "batcher_requests_completed")
@@ -1276,8 +1376,12 @@ class ContinuousBatcher:
         m.observe("batcher_e2e_latency", end - req.submitted_at)
         if req.first_token_at is not None:
             m.observe("batcher_ttft", req.first_token_at - req.submitted_at)
+        cost = req.cost = self._cost_record(req, end)
         tr = trace.get_tracer()
-        attrs = {"tokens": len(req.tokens), "preemptions": req._preemptions}
+        attrs = {"tokens": len(req.tokens), "preemptions": req._preemptions,
+                 "queue_ms": cost["queue_ms"],
+                 "prefill_ms": cost["prefill_ms"],
+                 "decode_ms": cost["decode_ms"]}
         if req.error:
             attrs["error"] = req.error
         g = tr.record("batcher.request", req.submitted_at, end,
@@ -1287,6 +1391,12 @@ class ContinuousBatcher:
                       parent=g)
             tr.record("batcher.decode", req.first_token_at, end, parent=g,
                       attrs={"tokens": len(req.tokens)})
+        # trace tail-sampling: errored and SLO-violating requests keep
+        # their spans in the tracer's retained ring, so the postmortem
+        # doesn't race the main ring's oldest-first eviction
+        if req.error or tsdb_mod.cost_within_slo(
+                cost, self._slo_targets) is False:
+            tr.retain(g.trace_id)
 
     def _finish_slot(self, slot: int):
         req = self.active[slot]
@@ -1335,12 +1445,17 @@ class ContinuousBatcher:
                 if self.block_tables[slot, bi] == self._dummy]
         if not need:
             return True
-        got = self.pool.alloc(len(need))
+        self._admitting = req   # bill growth-triggered offloads here too
+        try:
+            got = self.pool.alloc(len(need))
+        finally:
+            self._admitting = None
         if got is None:
             return False
         for bi, blk in zip(need, got):
             self.block_tables[slot, bi] = blk
         req._blocks.extend(got)
+        req._kv_peak = max(req._kv_peak, len(req._blocks))
         return True
 
     # ---- the step -----------------------------------------------------
@@ -1349,6 +1464,8 @@ class ContinuousBatcher:
         """Admit a wave + one K-token decode chunk. Returns active slots."""
         t0 = time.perf_counter()
         busy = 0
+        work0 = (self._step_count, self._tokens_out)
+        prof_rec = self.profiler.step_begin()
         try:
             busy = self._step_inner()
             return busy
@@ -1357,16 +1474,26 @@ class ContinuousBatcher:
             # deep the queue is, how full the slots are, how much KV
             # headroom remains — refreshed every scheduler step
             m = self.metrics
-            if busy:   # idle polls would drown the step histogram
-                m.observe("batcher_step", time.perf_counter() - t0)
-            m.gauge("batcher_queue_depth", len(self.queue))
-            active_slots = sum(a is not None for a in self.active)
-            m.gauge("batcher_active_slots", active_slots)
-            if busy:   # idle polls would peg occupancy at 0 between bursts
-                m.gauge("batcher_batch_occupancy",
-                        active_slots / self.slots)
-            m.gauge("batcher_free_kv_blocks", self.pool.free_count())
-            self._sync_cache_metrics()
+            with self.profiler.phase("bookkeeping"):
+                if busy:   # idle polls would drown the step histogram
+                    m.observe("batcher_step", time.perf_counter() - t0)
+                m.gauge("batcher_queue_depth", len(self.queue))
+                active_slots = sum(a is not None for a in self.active)
+                m.gauge("batcher_active_slots", active_slots)
+                if busy:   # idle polls would peg occupancy at 0
+                    m.gauge("batcher_batch_occupancy",
+                            active_slots / self.slots)
+                m.gauge("batcher_free_kv_blocks", self.pool.free_count())
+                self._sync_cache_metrics()
+            # idle polls are discarded — the profile attributes steps
+            # that did work, not the wait-for-work loop. "Did work" is
+            # dispatched-or-emitted, NOT end-of-step occupancy: a short
+            # request can admit, decode, and finish inside ONE step
+            # (busy == 0 on return), and that step is exactly the kind
+            # the profile must see
+            did_work = bool(busy) or \
+                (self._step_count, self._tokens_out) != work0
+            self.profiler.step_end(prof_rec, keep=did_work, active=busy)
 
     def _step_inner(self) -> int:
         # drop cancelled slots first — frees their blocks for admission
@@ -1376,69 +1503,72 @@ class ContinuousBatcher:
                 req.error = req.error or "cancelled"
                 self._finish_slot(slot)
 
-        self._admit_wave()
+        with self.profiler.phase("admit"):
+            self._admit_wave()
 
         active = [i for i, a in enumerate(self.active) if a is not None]
         if not active:
             return 0
 
-        # chunk size: cover the largest remaining budget in one dispatch
-        # when the overshoot is small (dead compute beats a round trip);
-        # otherwise the largest chunk some active slot can fill
-        max_rem = max(self.active[i].max_new_tokens
-                      - len(self.active[i].tokens) for i in active)
-        up = min((c for c in self.DECODE_CHUNKS if c >= max_rem),
-                 default=None)
-        if up is not None and up - max_rem <= self.CHUNK_OVERSHOOT_MAX:
-            k = up
-        else:
-            k = next(c for c in self.DECODE_CHUNKS if c <= max_rem)
+        with self.profiler.phase("host_prep"):
+            # chunk size: cover the largest remaining budget in one
+            # dispatch when the overshoot is small (dead compute beats a
+            # round trip); otherwise the largest chunk some slot can fill
+            max_rem = max(self.active[i].max_new_tokens
+                          - len(self.active[i].tokens) for i in active)
+            up = min((c for c in self.DECODE_CHUNKS if c >= max_rem),
+                     default=None)
+            if up is not None and up - max_rem <= self.CHUNK_OVERSHOOT_MAX:
+                k = up
+            else:
+                k = next(c for c in self.DECODE_CHUNKS if c <= max_rem)
 
-        # growth blocks for every position this chunk can write
-        for slot in range(self.slots):
-            while (self.active[slot] is not None
-                   and not self._ensure_growth(slot, k)):
-                # _preempt_youngest may free `slot` itself — the loop
-                # condition re-checks before retrying
-                if not self._preempt_youngest():
-                    self.active[slot].error = "cannot grow KV allocation"
-                    self._finish_slot(slot)
-                    break
-        active = [i for i, a in enumerate(self.active) if a is not None]
-        if not active:
-            return 0
+            # growth blocks for every position this chunk can write
+            for slot in range(self.slots):
+                while (self.active[slot] is not None
+                       and not self._ensure_growth(slot, k)):
+                    # _preempt_youngest may free `slot` itself — the loop
+                    # condition re-checks before retrying
+                    if not self._preempt_youngest():
+                        self.active[slot].error = \
+                            "cannot grow KV allocation"
+                        self._finish_slot(slot)
+                        break
+            active = [i for i, a in enumerate(self.active) if a is not None]
+            if not active:
+                return 0
 
-        r = self.slots
-        tokens = np.zeros((r,), np.int32)
-        seeds = np.zeros((r,), np.int32)
-        steps = np.zeros((r,), np.int32)
-        temps = np.full((r,), 1.0, np.float32)
-        tks = np.zeros((r,), np.int32)
-        tps = np.ones((r,), np.float32)
-        ds = np.zeros((r,), bool)
-        budget = np.zeros((r,), np.int32)
-        eos = np.full((r,), -1, np.int32)
-        for i in active:
-            req = self.active[i]
-            tokens[i] = req.tokens[-1]
-            seeds[i] = req.seed
-            steps[i] = len(req.tokens)
-            temps[i] = req.sampling.temperature
-            tks[i] = req.sampling.top_k
-            tps[i] = req.sampling.top_p
-            ds[i] = req.sampling.do_sample
-            budget[i] = min(k, req.max_new_tokens - len(req.tokens))
-            if req.eos_token_id is not None:
-                eos[i] = req.eos_token_id
+            r = self.slots
+            tokens = np.zeros((r,), np.int32)
+            seeds = np.zeros((r,), np.int32)
+            steps = np.zeros((r,), np.int32)
+            temps = np.full((r,), 1.0, np.float32)
+            tks = np.zeros((r,), np.int32)
+            tps = np.ones((r,), np.float32)
+            ds = np.zeros((r,), bool)
+            budget = np.zeros((r,), np.int32)
+            eos = np.full((r,), -1, np.int32)
+            for i in active:
+                req = self.active[i]
+                tokens[i] = req.tokens[-1]
+                seeds[i] = req.seed
+                steps[i] = len(req.tokens)
+                temps[i] = req.sampling.temperature
+                tks[i] = req.sampling.top_k
+                tps[i] = req.sampling.top_p
+                ds[i] = req.sampling.do_sample
+                budget[i] = min(k, req.max_new_tokens - len(req.tokens))
+                if req.eos_token_id is not None:
+                    eos[i] = req.eos_token_id
 
-        decode_args = {
-            "k": int(k),
-            "tokens": tokens.tolist(), "bt": self.block_tables.tolist(),
-            "cl": self.context_lens.tolist(), "seeds": seeds.tolist(),
-            "steps": steps.tolist(), "temps": temps.tolist(),
-            "tks": tks.tolist(), "tps": tps.tolist(), "ds": ds.tolist(),
-            "budget": budget.tolist(), "eos": eos.tolist(),
-        }
+            decode_args = {
+                "k": int(k),
+                "tokens": tokens.tolist(), "bt": self.block_tables.tolist(),
+                "cl": self.context_lens.tolist(), "seeds": seeds.tolist(),
+                "steps": steps.tolist(), "temps": temps.tolist(),
+                "tks": tks.tolist(), "tps": tps.tolist(), "ds": ds.tolist(),
+                "budget": budget.tolist(), "eos": eos.tolist(),
+            }
         if self.speculative:
             return self._step_speculative(active, decode_args)
         if self._overlap_eligible(active, k):
@@ -1489,20 +1619,23 @@ class ContinuousBatcher:
         overlapped pairs are provably eos-free and pass None. Returns
         tokens emitted."""
         emitted = 0
-        for i in active:
-            req = self.active[i]
-            # emits[:, i] is True exactly for this slot's emitted prefix
-            # (monotone: once dead — eos or budget — never true again; the
-            # device masks eos out, so _emit's eos branch can't re-trigger)
-            cnt = int(emits[:, i].sum())
-            for tok in toks[:cnt, i]:
-                self._emit(req, int(tok))
-            emitted += cnt
-            self.context_lens[i] += cnt
-            hit_eos = (budget is not None
-                       and cnt < int(budget[i]))   # stopped pre-budget
-            if hit_eos or len(req.tokens) >= req.max_new_tokens:
-                self._finish_slot(i)
+        with self.profiler.phase("emit"):
+            for i in active:
+                req = self.active[i]
+                # emits[:, i] is True exactly for this slot's emitted
+                # prefix (monotone: once dead — eos or budget — never
+                # true again; the device masks eos out, so _emit's eos
+                # branch can't re-trigger)
+                cnt = int(emits[:, i].sum())
+                for tok in toks[:cnt, i]:
+                    self._emit(req, int(tok))
+                emitted += cnt
+                req._weight_passes += passes
+                self.context_lens[i] += cnt
+                hit_eos = (budget is not None
+                           and cnt < int(budget[i]))  # stopped pre-budget
+                if hit_eos or len(req.tokens) >= req.max_new_tokens:
+                    self._finish_slot(i)
         # amortization: emitted tokens per weight-streaming pass (one
         # pass per decode iteration) — THE number continuous batching
         # exists to raise. Gauge for live /metrics, counters for
@@ -1561,8 +1694,9 @@ class ContinuousBatcher:
         self._step_count += 2
         self._overlapped_dispatches += 1
         self.metrics.inc("batcher_overlapped_dispatches")
-        toks_a, emits_a, toks_b, emits_b = jax.device_get(
-            (toks_a, emits_a, toks_b, emits_b))   # ONE sync for the pair
+        with self.profiler.phase("device_wait"):
+            toks_a, emits_a, toks_b, emits_b = jax.device_get(
+                (toks_a, emits_a, toks_b, emits_b))  # ONE sync for the pair
         w1 = time.time()
         self.metrics.observe("batcher_decode_chunk", (w1 - w0) / 2)
         self.metrics.observe("batcher_decode_chunk", (w1 - w0) / 2)
@@ -1644,24 +1778,30 @@ class ContinuousBatcher:
         emitted = 0
         live_iters = 0       # iterations where a row was alive (emitted)
         accepted = 0         # draft tokens kept beyond one-per-iteration
-        for i in active:
-            req = self.active[i]
-            cnt = int(keeps[:, i].sum())
-            for t in range(keeps.shape[0]):
-                for tok in toks[t, i, : int(keeps[t, i])]:
-                    self._emit(req, int(tok))
-            # speedup accounting: tokens beyond one-per-iteration
-            live = int((keeps[:, i] > 0).sum())
-            self._spec_accepted += cnt - live
-            emitted += cnt
-            live_iters += live
-            accepted += cnt - live
-            self.context_lens[i] += cnt
-            # a slot may legitimately emit fewer than its budget when
-            # every draft missed (1 token/iteration) — only the device's
-            # cumulative eos flag or an exhausted budget finishes it
-            if bool(eos_seen[-1, i]) or len(req.tokens) >= req.max_new_tokens:
-                self._finish_slot(i)
+        with self.profiler.phase("emit"):
+            for i in active:
+                req = self.active[i]
+                cnt = int(keeps[:, i].sum())
+                for t in range(keeps.shape[0]):
+                    for tok in toks[t, i, : int(keeps[t, i])]:
+                        self._emit(req, int(tok))
+                # speedup accounting: tokens beyond one-per-iteration
+                live = int((keeps[:, i] > 0).sum())
+                self._spec_accepted += cnt - live
+                emitted += cnt
+                live_iters += live
+                accepted += cnt - live
+                req._weight_passes += k_it
+                req._spec_acc += cnt - live
+                req._spec_rej += max(0, gamma * live - (cnt - live))
+                self.context_lens[i] += cnt
+                # a slot may legitimately emit fewer than its budget when
+                # every draft missed (1 token/iteration) — only the
+                # device's cumulative eos flag or an exhausted budget
+                # finishes it
+                if bool(eos_seen[-1, i]) \
+                        or len(req.tokens) >= req.max_new_tokens:
+                    self._finish_slot(i)
         # amortization: a verify iteration streams the weights once
         # however wide the draft is — that width is the whole speedup
         m.gauge("decode_tokens_per_weight_pass",

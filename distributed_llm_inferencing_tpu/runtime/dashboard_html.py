@@ -51,6 +51,11 @@ pre.result { background:#10161c; padding:12px; border-radius:6px;
   white-space:pre-wrap; margin-top:12px; min-height:60px; }
 .grid2 { display:grid; grid-template-columns:1fr 1fr; gap:24px; }
 .muted { color:var(--muted); }
+.charts { display:grid; grid-template-columns:repeat(3,1fr); gap:12px;
+          margin-bottom:24px; }
+.chart svg { width:100%; height:64px; display:block; }
+.chart .legend { font-size:11px; color:var(--muted); }
+.chart .legend b { font-weight:500; }
 </style>
 """
 
@@ -102,6 +107,21 @@ DASHBOARD = f"""<!doctype html><html><head><title>Dashboard</title>{_STYLE}
 <th>Free KV blocks</th></tr></thead>
 <tbody id="clustermetrics"><tr><td colspan="8" class="muted">no workers
 </td></tr></tbody></table>
+<h2 style="margin-top:24px">Telemetry
+  <span class="muted" style="font-size:12px">(master TSDB —
+  <a href="/api/timeseries" style="color:var(--accent)">/api/timeseries</a>;
+  per-request cost at /api/requests/&lt;id&gt;/cost)</span></h2>
+<div class="cards" id="slo-cards">
+  <div class="card"><div class="num" id="slo-att">–</div>
+    <div class="label">SLO attainment (5m)</div></div>
+  <div class="card"><div class="num" id="slo-burn">–</div>
+    <div class="label">burn rate (5m)</div></div>
+  <div class="card"><div class="num" id="slo-viol">–</div>
+    <div class="label">violations / requests</div></div>
+  <div class="card"><div class="num" id="slo-targets">–</div>
+    <div class="label">targets TTFT / ITL p95 (ms)</div></div>
+</div>
+<div class="charts" id="charts"></div>
 <h2 style="margin-top:24px">Recent Requests</h2>
 <table><thead><tr><th>ID</th><th>Model</th><th>Status</th><th>tok/s</th>
 <th>Latency (s)</th><th>Node</th></tr></thead>
@@ -167,6 +187,78 @@ async function refresh() {{
   }} catch (e) {{ console.error(e); }}
 }}
 refresh(); setInterval(refresh, 10000);  // 10s, like reference dashboard.html:119-134
+
+// ---- telemetry charts: live sparklines off the master TSDB ----------
+const TS_METRICS = [
+  ['tokens_generated', 'tok/s (rate, per node)'],
+  ['batcher_queue_depth', 'queue depth (per node)'],
+  ['batcher_free_kv_blocks', 'free KV blocks (per node)'],
+  ['prefix_hit_ratio', 'prefix-cache hit ratio'],
+  ['breaker_state', 'breaker (0 closed / 1 half-open / 2 open)'],
+  ['slo_attainment', 'SLO attainment (master)'],
+];
+const TS_COLORS = ['#4da3ff','#3fb76f','#e0a33c','#e0565b','#b07cf0',
+                   '#52c7d8','#8a939e'];
+function sparkline(series, w, h) {{
+  // shared y-scale across the metric's nodes so lines are comparable
+  let lo = Infinity, hi = -Infinity;
+  for (const s of series) for (const [, v] of s.points) {{
+    if (v < lo) lo = v; if (v > hi) hi = v; }}
+  if (!isFinite(lo)) return '<svg></svg>';
+  if (hi === lo) {{ hi = lo + 1; }}
+  let t0 = Infinity, t1 = -Infinity;
+  for (const s of series) for (const [t] of s.points) {{
+    if (t < t0) t0 = t; if (t > t1) t1 = t; }}
+  if (t1 === t0) t1 = t0 + 1;
+  const x = t => 2 + (w - 4) * (t - t0) / (t1 - t0);
+  const y = v => h - 3 - (h - 6) * (v - lo) / (hi - lo);
+  const lines = series.map((s, i) =>
+    `<polyline fill="none" stroke="${{TS_COLORS[i % TS_COLORS.length]}}"
+      stroke-width="1.5" points="${{s.points.map(
+        ([t, v]) => x(t).toFixed(1) + ',' + y(v).toFixed(1)).join(' ')}}"/>`
+  ).join('');
+  return `<svg viewBox="0 0 ${{w}} ${{h}}" preserveAspectRatio="none">`
+    + `<text x="2" y="10" fill="#8a939e" font-size="9">`
+    + `${{hi.toPrecision(3)}}</text>`
+    + `<text x="2" y="${{h - 1}}" fill="#8a939e" font-size="9">`
+    + `${{lo.toPrecision(3)}}</text>` + lines + '</svg>';
+}}
+async function refreshTelemetry() {{
+  try {{
+    const slo = await (await fetch('/api/slo')).json();
+    const att = slo.attainment_fast;
+    document.getElementById('slo-att').textContent =
+      att != null ? (att * 100).toFixed(1) + '%' : '–';
+    document.getElementById('slo-burn').textContent =
+      slo.burn_rate_fast != null ? slo.burn_rate_fast.toFixed(2) : '–';
+    document.getElementById('slo-viol').textContent =
+      `${{slo.violations_total ?? 0}} / ${{slo.requests_total ?? 0}}`;
+    const t = slo.targets || {{}};
+    document.getElementById('slo-targets').textContent =
+      `${{t.ttft_ms ?? '–'}} / ${{t.itl_p95_ms ?? '–'}}`;
+    // all six series fetched in parallel: a refresh costs one RTT, not
+    // sum-of-latencies, and one slow endpoint can't stall the rest
+    const results = await Promise.all(TS_METRICS.map(([m]) =>
+      fetch('/api/timeseries?metric=' + encodeURIComponent(m))
+        .then(r => r.json()).catch(() => ({{}}))));
+    const cards = TS_METRICS.map(([m, title], j) => {{
+      // >= 2: a one-point polyline draws nothing and reads as a broken
+      // chart — show the placeholder until a line can exist
+      const series = (results[j].series || [])
+        .filter(s => s.points.length >= 2);
+      const legend = series.map((s, i) =>
+        `<b style="color:${{TS_COLORS[i % TS_COLORS.length]}}">●</b> `
+        + esc(s.node)).join(' ');
+      return `<div class="card chart"><div class="label">`
+        + `${{esc(title)}}</div>`
+        + (series.length ? sparkline(series, 260, 64)
+                         : '<div class="muted">no samples</div>')
+        + `<div class="legend">${{legend}}</div></div>`;
+    }});
+    document.getElementById('charts').innerHTML = cards.join('');
+  }} catch (e) {{ console.error(e); }}
+}}
+refreshTelemetry(); setInterval(refreshTelemetry, 10000);
 </script></main></body></html>"""
 
 

@@ -63,6 +63,24 @@ class GenerateResult:
         total = sum(len(t) for t in self.tokens)
         return total / (self.decode_ms / 1e3) if self.decode_ms > 0 else 0.0
 
+    def cost(self) -> dict:
+        """Engine-mode cost-ledger record, schema-compatible with the
+        batcher's (runtime/batcher.py _cost_record). The engine serves
+        one blocking generate at a time behind the per-model lock, so
+        queue time is the caller's to measure — 0 here; a decode step
+        is one weight-streaming pass."""
+        total = sum(len(t) for t in self.tokens)
+        return {
+            "queue_ms": 0.0,
+            "prefill_ms": round(self.prefill_ms, 3),
+            "decode_ms": round(self.decode_ms, 3),
+            "prefill_cached_tokens": 0,
+            "prefill_uncached_tokens": 0,
+            "decode_tokens": total,
+            "weight_passes": self.steps,
+            "engine_mode": True,
+        }
+
 
 class InferenceEngine:
     """Owns params on device + compiled step functions for one model."""

@@ -28,7 +28,8 @@ from distributed_llm_inferencing_tpu.utils.faults import FaultInjector
 # still work — utils/trace.py span(keep=False)).
 QUIET_TRACE_PATHS = frozenset(
     {"/health", "/metrics", "/api/trace", "/api/cluster_metrics",
-     "/api/nodes/status", "/api/inference/recent"})
+     "/api/nodes/status", "/api/inference/recent", "/api/timeseries",
+     "/api/slo", "/api/profile"})
 
 
 class Route:
@@ -133,14 +134,14 @@ class JsonHTTPService:
                 # roots a fresh trace), and stays current while the
                 # response is written so even 4xx/5xx lines carry the
                 # trace headers (_send_json._trace_headers).
-                path = self.path.split("?", 1)[0]
+                path, _, query = self.path.partition("?")
                 tracer = trace.get_tracer()
                 with tracer.span(f"http {method} {path}",
                                  parent=trace.extract(self.headers),
                                  attrs={"service": service.name,
                                         "method": method},
                                  keep=path not in QUIET_TRACE_PATHS) as sp:
-                    self._dispatch_traced(method, path, sp)
+                    self._dispatch_traced(method, path, query, sp)
 
             def _inject_fault(self, f) -> bool:
                 """Apply one armed fault (utils/faults.py FaultSpec).
@@ -201,7 +202,8 @@ class JsonHTTPService:
                         break
                     n -= len(chunk)
 
-            def _dispatch_traced(self, method: str, path: str, sp):
+            def _dispatch_traced(self, method: str, path: str, query: str,
+                                 sp):
                 def send(status, payload, headers=None):
                     sp.attrs["status"] = status
                     return self._send_json(status, payload, headers)
@@ -238,6 +240,16 @@ class JsonHTTPService:
                             except json.JSONDecodeError:
                                 return send(400, {"status": "error",
                                                   "message": "invalid JSON body"})
+                    if query and method == "GET" and isinstance(body, dict):
+                        # GET-only: query params reach handlers through
+                        # the body dict (GET /api/timeseries?metric=…).
+                        # POST/PUT bodies stay JSON-typed — a raw query
+                        # string like ?enabled=false merged into them
+                        # would coerce wrong (bool("false") is True)
+                        from urllib.parse import parse_qs
+                        for k, vs in parse_qs(
+                                query, keep_blank_values=True).items():
+                            body.setdefault(k, vs[-1])
                     try:
                         result = r.fn(body, **m.groupdict(), _request=self) \
                             if _wants_request(r.fn) else r.fn(body, **m.groupdict())

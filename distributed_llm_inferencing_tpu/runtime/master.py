@@ -21,6 +21,7 @@ One-for-one capability replacement of the reference's Django master
 from __future__ import annotations
 
 import json
+import math
 import os
 import random
 import threading
@@ -30,6 +31,7 @@ from typing import Dict, Optional, Set
 import requests as http
 
 from distributed_llm_inferencing_tpu.runtime import dashboard_html, httpd
+from distributed_llm_inferencing_tpu.runtime import tsdb as tsdb_mod
 from distributed_llm_inferencing_tpu.runtime.kvtier import (
     estimate_cached_tokens)
 from distributed_llm_inferencing_tpu.runtime.state import Store
@@ -144,7 +146,9 @@ class Master:
                  rpc_pool: Optional[bool] = None,
                  rpc_pool_size: int = RPC_POOL_SIZE,
                  prefix_weight: Optional[float] = None,
-                 prefix_slack: Optional[int] = None):
+                 prefix_slack: Optional[int] = None,
+                 tsdb_step_s: Optional[float] = None,
+                 tsdb_window_s: Optional[float] = None):
         self._stop = threading.Event()
         self._wake = threading.Event()
         # Group-commit store: the dispatch hot path's status writes
@@ -177,6 +181,16 @@ class Master:
         self._prefix_slack = (SCHED_PREFIX_SLACK if prefix_slack is None
                               else int(prefix_slack))
         self._pending_models: Set[str] = set()
+        # Telemetry plane (runtime/tsdb.py, docs/observability.md): a
+        # bounded in-memory TSDB fed by the background scrape loop
+        # (/metrics of every active node + the master's own registry,
+        # through the pooled keep-alive sessions), plus the SLO
+        # evaluator fed one outcome per terminal request.
+        self.tsdb = tsdb_mod.TSDB(window_s=tsdb_window_s,
+                                  step_s=tsdb_step_s)
+        self.slo = tsdb_mod.SLOEvaluator()
+        self._cost_models: Set[str] = set()   # per-model cost hist cap
+        self._ratio_prev: Dict[str, tuple] = {}   # node -> (hits, misses)
         n = self.store.recover_stale_processing(max_attempts=MAX_ATTEMPTS)
         if n:
             log.info("recovered %d request(s) stranded by a previous run", n)
@@ -227,6 +241,12 @@ class Master:
             self.metrics.prometheus().encode(), "text/plain; version=0.0.4"))
         s.add("GET", "/api/trace", self.api_trace)
         s.add("GET", "/api/cluster_metrics", self.api_cluster_metrics)
+        # telemetry plane: retained history, per-request cost ledger,
+        # SLO rollup, decode-profiler scrape (docs/observability.md)
+        s.add("GET", "/api/timeseries", self.api_timeseries)
+        s.add("GET", "/api/requests/<req_id>/cost", self.api_request_cost)
+        s.add("GET", "/api/slo", self.api_slo)
+        s.add("GET", "/api/profile", self.api_profile)
         s.add("GET", "/health", lambda b: {"status": "online",
                                            "counts": self.store.counts()})
 
@@ -420,6 +440,12 @@ class Master:
         self._purge_session(node)
         self._node_runtime.pop(int(node_id), None)
         self._node_lat_ewma.pop(int(node_id), None)
+        # telemetry state is keyed by node NAME: drop the retained
+        # series and ratio baseline too, or fleet churn leaks up to
+        # DLI_TSDB_MAX_SERIES ring buffers per removed node and the
+        # /api/timeseries catalog lists ghosts forever
+        self.tsdb.drop_node(node["name"])
+        self._ratio_prev.pop(node["name"], None)
         return {"status": "success"}
 
     def api_node_status(self, body):
@@ -644,13 +670,20 @@ class Master:
                      "scraped": False}
             r, err = scraped.get(n["id"], (None, "inactive"))
             if r is not None:
-                try:
-                    entry.update(scraped=True,
-                                 **_group_samples(parse_prometheus(r.text)))
+                samples = parse_prometheus(r.text)
+                if not samples and r.text.strip():
+                    # tolerant parsing means garbage never raises — but a
+                    # non-empty body yielding ZERO samples (an HTML error
+                    # page behind a 200) is a failed scrape, not a
+                    # healthy node with no metrics
+                    entry["error"] = "no exposition samples in body"
+                else:
+                    entry.update(scraped=True, **_group_samples(samples))
                     for k, v in entry["counters"].items():
-                        totals[k] = totals.get(k, 0.0) + v
-                except ValueError as e:
-                    entry["error"] = str(e)[:200]
+                        # the tolerant parser passes NaN/Inf samples
+                        # through; they must not poison the cluster sums
+                        if math.isfinite(v):
+                            totals[k] = totals.get(k, 0.0) + v
             else:
                 entry["error"] = err
             nodes.append(entry)
@@ -659,6 +692,143 @@ class Master:
                             "workers_scraped": sum(
                                 1 for x in nodes if x["scraped"])},
                 "master": self.metrics.snapshot()}
+
+    # ---- telemetry plane (TSDB + SLO + profiler scrape) --------------
+
+    def api_timeseries(self, body):
+        """Retained per-(node, metric) history from the master TSDB.
+        ``?metric=<name>[&node=<name>][&window=<s>]`` returns each
+        node's series as [t, value] points (counters as per-second
+        rates); without ``metric`` it returns the series catalog."""
+        metric = body.get("metric")
+        if not metric:
+            return {"status": "success", "step_s": self.tsdb.step_s,
+                    "window_s": self.tsdb.window_s,
+                    "series_count": self.tsdb.series_count(),
+                    "metrics": self.tsdb.catalog()}
+        try:
+            window = float(body["window"]) if body.get("window") else None
+        except (TypeError, ValueError):
+            return 400, {"status": "error", "message": "bad window"}
+        return {"status": "success", "metric": metric,
+                "step_s": self.tsdb.step_s,
+                "series": self.tsdb.query(metric, node=body.get("node"),
+                                          window=window)}
+
+    def api_request_cost(self, body, req_id):
+        """One completed request's cost-ledger record (persisted on the
+        request row at completion): queue/prefill/decode phase ms —
+        summing to the e2e span — plus cached/uncached prefill tokens,
+        KV peak, arena traffic and speculation accounting."""
+        try:
+            r = self.store.get_request(int(req_id))
+        except ValueError:
+            return 400, {"status": "error", "message": "bad request id"}
+        if not r:
+            return 404, {"status": "error", "message": "no such request"}
+        cost = r.get("cost")
+        if not cost:
+            return 404, {"status": "error",
+                         "message": f"request {req_id} has no cost record "
+                                    f"(status: {r['status']})"}
+        return {"status": "success", "request_id": r["id"],
+                "model_name": r["model_name"],
+                "request_status": r["status"],
+                "e2e_ms": (round((r["completed_at"] - r["created_at"])
+                                 * 1e3, 1)
+                           if r.get("completed_at") else None),
+                "execution_time": r.get("execution_time"),
+                "within_slo": tsdb_mod.cost_within_slo(cost,
+                                                       self.slo.targets),
+                "cost": cost}
+
+    def api_slo(self, body):
+        """Rolling SLO attainment + multi-window burn rate (see
+        docs/observability.md for the targets' knobs)."""
+        return dict({"status": "success"}, **self.slo.snapshot())
+
+    def api_profile(self, body):
+        """Cluster decode-profiler readout: every active worker's
+        ``/api/profile`` merged per node (see utils/profiler.py)."""
+        nodes = {}
+        for n, r, err in self._scrape_workers("/api/profile"):
+            if err is not None:
+                nodes[n["name"]] = {"error": err}
+                continue
+            try:
+                nodes[n["name"]] = r.json().get("profilers", {})
+            except ValueError:
+                nodes[n["name"]] = {"error": "unparseable body"}
+        return {"status": "success", "nodes": nodes}
+
+    def _telemetry_loop(self):
+        """Background scrape loop feeding the TSDB: every TSDB step,
+        scrape each active node's /metrics (pooled keep-alive sessions,
+        tolerant parse), fold in master-observed node state (breaker),
+        derived per-node ratios, the SLO gauges, and the master's own
+        registry. One failed/slow node costs its scrape only — the
+        other nodes' samples land regardless."""
+        while not self._stop.is_set():
+            t_next = time.time() + self.tsdb.step_s
+            try:
+                self._telemetry_sweep()
+            except Exception as e:   # the loop must survive anything
+                log.debug("telemetry sweep failed: %s", e)
+            self._stop.wait(max(0.05, t_next - time.time()))
+
+    def _telemetry_sweep(self):
+        now = time.time()
+        nodes = self.store.list_nodes()
+        active = [n for n in nodes if n.get("is_active")]
+        for n, r, err in self._scrape_workers("/metrics", nodes=active):
+            if self._stop.is_set():
+                return
+            if err is not None:
+                continue   # staleness renders as a gap, not a zero
+            name = n["name"]
+            try:
+                samples = parse_prometheus(r.text)
+            except Exception:
+                continue
+            self.tsdb.ingest_prometheus(name, samples, t=now)
+            # derived: per-scrape-interval radix prefix-hit ratio (the
+            # two raw counters chart poorly against each other)
+            vals = {s[0]: s[2] for s in samples if not s[1]}
+            hits = vals.get("dli_radix_prefix_hits_total")
+            misses = vals.get("dli_radix_prefix_misses_total")
+            if hits is not None and misses is not None:
+                ph, pm = self._ratio_prev.get(name, (hits, misses))
+                dh, dm = max(0.0, hits - ph), max(0.0, misses - pm)
+                self._ratio_prev[name] = (hits, misses)
+                if dh + dm > 0:
+                    self.tsdb.record(name, "prefix_hit_ratio",
+                                     dh / (dh + dm), t=now)
+        # master-observed per-node state: breaker position as a numeric
+        # series (0 closed / 1 half-open / 2 open) for every node, dead
+        # ones included — that is exactly when the series matters
+        code = {"closed": 0.0, "half_open": 1.0, "open": 2.0}
+        for n in nodes:
+            self.tsdb.record(
+                n["name"], "breaker_state",
+                code.get(n.get("breaker_state") or "closed", 0.0), t=now)
+        # SLO gauges refresh on the scrape cadence, then ride the
+        # master's own registry into the TSDB like any other gauge
+        s = self.slo.snapshot(now)
+        slo_fresh = s["attainment_fast"] is not None
+        if slo_fresh:
+            self.metrics.gauge("slo_attainment", s["attainment_fast"])
+            self.metrics.gauge("slo_burn_rate", s["burn_rate_fast"])
+        snap = self.metrics.snapshot()
+        for k, v in snap["counters"].items():
+            self.tsdb.record("master", k, v, kind="counter", t=now)
+        for k, v in snap["gauges"].items():
+            if not slo_fresh and k in ("slo_attainment", "slo_burn_rate"):
+                # the fast window emptied: the registry still holds the
+                # last value (gauges don't expire), but re-ingesting it
+                # would chart a frozen burn as ongoing — staleness must
+                # render as a gap here like everywhere else
+                continue
+            self.tsdb.record("master", k, v, kind="gauge", t=now)
 
     # ---- scheduling --------------------------------------------------
 
@@ -945,6 +1115,7 @@ class Master:
                 self.metrics.inc("requests_requeued")
             else:
                 self.store.mark_failed(req["id"], "no active worker nodes")
+                self._note_slo_miss(req)
                 self._trace_done(req["id"])
         return node
 
@@ -994,12 +1165,17 @@ class Master:
         # barrier=False: the commit still gates client visibility (reads
         # see only committed state); not blocking here keeps the batch
         # demultiplexer reading result lines instead of waiting out a
-        # flush per sub-request
+        # flush per sub-request. The cost-ledger record rides the same
+        # UPDATE, so the row and its ledger commit atomically.
+        cost = data.get("cost")
+        if not isinstance(cost, dict):
+            cost = None
         self.store.mark_completed(
             req["id"], data.get("result", ""), nid,
             data.get("execution_time", 0.0),
-            data.get("tokens_per_s", 0.0), barrier=False)
+            data.get("tokens_per_s", 0.0), barrier=False, cost=cost)
         self.metrics.inc("requests_completed")
+        self._note_cost(req, cost, ttft_ms=data.get("ttft_ms"))
         if data.get("idempotent"):
             # a retry hit the worker's completed-result cache: the
             # generation ran exactly once despite >1 dispatch
@@ -1022,6 +1198,56 @@ class Master:
                                          "scheduler": sch}]}, merge=True)
         self._trace_done(req["id"])
         self._node_success(node)
+
+    def _note_cost(self, req, cost, ttft_ms=None) -> None:
+        """Completion-side telemetry tail: per-model ``dli_cost_*``
+        histograms, the SLO outcome for this request, and trace
+        tail-retention of SLO violators. Model names are client-supplied
+        — the tracked set is capped (overflow lands in ``other``)."""
+        if cost is not None:
+            mn = sanitize_name(str(req["model_name"]))[:48]
+            if mn not in self._cost_models:
+                if len(self._cost_models) < MODEL_GAUGES_MAX:
+                    self._cost_models.add(mn)
+                else:
+                    mn = "other"
+            for key, metric in (("queue_ms", "cost_queue"),
+                                ("prefill_ms", "cost_prefill"),
+                                ("decode_ms", "cost_decode")):
+                v = cost.get(key)
+                if isinstance(v, (int, float)):
+                    self.metrics.observe(f"{metric}_{mn}", v / 1e3)
+        ok = tsdb_mod.cost_within_slo(cost, self.slo.targets)
+        if ok is None and ttft_ms is not None:
+            # engine-mode/legacy workers: fall back to the worker's own
+            # TTFT measurement against the TTFT target alone
+            try:
+                ok = float(ttft_ms) <= self.slo.targets["ttft_ms"]
+            except (TypeError, ValueError):
+                ok = None
+        if ok is None:
+            return
+        self.slo.record(ok)
+        self.metrics.inc("slo_requests")
+        if not ok:
+            self.metrics.inc("slo_violations")
+            ctx = self._trace_ctx.get(req["id"])
+            if ctx is not None:
+                trace.get_tracer().retain(ctx.trace_id)
+
+    def _note_slo_miss(self, req) -> None:
+        """A terminally failed request is an SLO miss by definition —
+        goodput counts requests that COMPLETED within target. Retains
+        the failed trace for the postmortem."""
+        self.slo.record(False)
+        self.metrics.inc("slo_requests")
+        self.metrics.inc("slo_violations")
+        self._retain_trace(req)
+
+    def _retain_trace(self, req) -> None:
+        ctx = self._trace_ctx.get(req["id"])
+        if ctx is not None:
+            trace.get_tracer().retain(ctx.trace_id)
 
     def _fail_sub(self, req, node, e, strike=True, nodes=None) -> None:
         """Terminal/requeue failure tail shared by the single and
@@ -1077,6 +1303,7 @@ class Master:
             self._wake.set()
         else:
             self.store.mark_failed(req["id"], str(e), barrier=False)
+            self._note_slo_miss(req)
             self._trace_done(req["id"])
             if is_timeout:
                 # terminal timeout: nobody will ever claim the
@@ -1110,6 +1337,10 @@ class Master:
         reads only see committed state, so the commit gates visibility."""
         self.store.mark_failed(req["id"], msg, barrier=False)
         self.metrics.inc("requests_rejected")
+        # a user-error rejection is NOT an SLO miss (4xx doesn't burn
+        # the service's error budget) — but its trace is still worth
+        # keeping for the postmortem ring
+        self._retain_trace(req)
         self._trace_done(req["id"])
 
     def _ensure_model_loaded(self, node, model, sampling):
@@ -1580,6 +1811,10 @@ class Master:
             self._threads.append(t)
         t = threading.Thread(target=self._health_loop, daemon=True,
                              name="health")
+        t.start()
+        self._threads.append(t)
+        t = threading.Thread(target=self._telemetry_loop, daemon=True,
+                             name="telemetry")
         t.start()
         self._threads.append(t)
 

@@ -79,7 +79,12 @@ _MIGRATIONS = {
               ("breaker_opened_at", "REAL"),
               ("draining", "INTEGER DEFAULT 0")),
     "requests": (("excluded_nodes", "TEXT DEFAULT '[]'"),
-                 ("next_attempt_at", "REAL DEFAULT 0")),
+                 ("next_attempt_at", "REAL DEFAULT 0"),
+                 # per-request cost-ledger record (JSON: queue/prefill/
+                 # decode phase ms, cached/uncached prefill tokens, KV
+                 # peak, spec accounting — runtime/batcher.py), persisted
+                 # at completion and served via /api/requests/<id>/cost
+                 ("cost", "TEXT")),
 }
 
 
@@ -359,6 +364,11 @@ class Store:
         if r:
             r["sampling"] = json.loads(r["sampling"] or "{}")
             r["excluded_nodes"] = json.loads(r.get("excluded_nodes") or "[]")
+            if r.get("cost"):
+                try:
+                    r["cost"] = json.loads(r["cost"])
+                except ValueError:
+                    r["cost"] = None
         return r
 
     def claim_next_pending(self) -> Optional[Dict[str, Any]]:
@@ -455,7 +465,8 @@ class Store:
 
     def mark_completed(self, req_id: int, result: str, node_id: int,
                        execution_time: float, tokens_per_s: float,
-                       barrier: bool = True):
+                       barrier: bool = True,
+                       cost: Optional[dict] = None):
         # ≙ InferenceRequest.mark_completed (reference models.py:52-56).
         # Terminal status: with barrier=True the write is committed
         # before this returns. barrier=False still upholds the
@@ -464,11 +475,15 @@ class Store:
         # 'completed' before the commit lands; what it relaxes is THIS
         # caller blocking on the flush. The master's batch demultiplexer
         # uses that: a barrier wait per sub-request would hold up
-        # reading the next result line off the stream.
+        # reading the next result line off the stream. The cost record
+        # rides the same UPDATE, so row and ledger commit atomically
+        # (group-commit safe: one op, one transaction slot).
         self._submit_write(
             "UPDATE requests SET status='completed', result=?, node_id=?, "
-            "completed_at=?, execution_time=?, tokens_per_s=? WHERE id=?",
+            "completed_at=?, execution_time=?, tokens_per_s=?, cost=? "
+            "WHERE id=?",
             (result, node_id, time.time(), execution_time, tokens_per_s,
+             json.dumps(cost) if cost is not None else None,
              req_id), barrier=barrier)
 
     def mark_failed(self, req_id: int, error: str, barrier: bool = True):

@@ -1,0 +1,324 @@
+"""Master-side time-series store + SLO/goodput evaluator.
+
+``/api/cluster_metrics`` (PR 1) scrapes each worker's exposition on
+demand and throws the sample away — there is no history to answer "did
+tok/s degrade after the last deploy", no per-node throughput profile
+for an auto-parallelism planner to consume, and no rolling SLO signal
+to drive load shedding. This module is the retention layer behind the
+master's background scrape loop:
+
+- :class:`TSDB` — a bounded in-memory store of per-(node, metric)
+  series. Each series is a pair of fixed-interval ring buffers: a
+  *fine* ring at ``DLI_TSDB_STEP_S`` covering the recent past and a
+  *coarse* ring downsampled 8x covering the full ``DLI_TSDB_WINDOW_S``
+  window, so memory is O(buckets), not O(samples), and a 1h query
+  doesn't return 720 points per node. Counters are converted to
+  per-second *rates* at ingest (a cumulative value would make every
+  chart a ramp); a counter reset (worker restart) is detected by the
+  value dropping and re-baselines instead of emitting a negative spike.
+  Buckets with no sample stay absent — staleness renders as a gap, not
+  a frozen line.
+
+- :class:`SLOEvaluator` — declarative latency SLOs
+  (``DLI_SLO_TTFT_MS``, ``DLI_SLO_ITL_P95_MS``) evaluated per completed
+  request from its cost record (runtime/batcher.py cost ledger),
+  aggregated into rolling attainment over a fast and a slow window plus
+  the multi-window error-budget *burn rate* that alerting and
+  (ROADMAP item 4) load shedding key off.
+
+Everything here is stdlib + lock-guarded; the master owns one TSDB and
+feeds it from ``_telemetry_loop`` (pooled keep-alive scrapes through
+``_scrape_workers`` + the tolerant ``utils.metrics.parse_prometheus``).
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+# Retention knobs: total window retained per series, and the fine-ring
+# bucket width. The fine ring is capped at FINE_BUCKETS_MAX buckets;
+# history past that is served from the 8x-downsampled coarse ring.
+DEFAULT_WINDOW_S = 3600.0
+DEFAULT_STEP_S = 5.0
+DOWNSAMPLE_X = 8
+FINE_BUCKETS_MAX = 512
+# per-node series cap: metric names ultimately come from process
+# registries (bounded), but a buggy/hostile worker must not grow the
+# master's memory without bound
+MAX_SERIES_PER_NODE = int(os.environ.get("DLI_TSDB_MAX_SERIES", 512))
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def slo_targets() -> dict:
+    """Declarative SLO targets, read per call so tests/benches can flip
+    the env. ``availability`` is the attainment objective the burn rate
+    is computed against (burn 1.0 = exactly consuming the error budget;
+    >1 = on track to miss the SLO)."""
+    return {
+        "ttft_ms": _env_float("DLI_SLO_TTFT_MS", 2000.0),
+        "itl_p95_ms": _env_float("DLI_SLO_ITL_P95_MS", 250.0),
+        "availability": min(0.9999, max(0.5, _env_float(
+            "DLI_SLO_TARGET", 0.99))),
+    }
+
+
+def cost_within_slo(cost: Optional[dict], targets: dict) -> Optional[bool]:
+    """Evaluate one request's cost record against the targets. None when
+    there is nothing to evaluate (no/garbled record). TTFT is
+    queue + prefill (the cost ledger's phases sum to the e2e span);
+    the ITL target applies to the request's own p95 inter-token gap."""
+    if not isinstance(cost, dict):
+        return None
+    if cost.get("queue_ms") is None and cost.get("prefill_ms") is None:
+        return None   # schema drift must read as unevaluable, not as a
+        # TTFT of 0 that silently inflates attainment
+    try:
+        ttft = float(cost.get("queue_ms") or 0.0) \
+            + float(cost.get("prefill_ms") or 0.0)
+    except (TypeError, ValueError):
+        return None
+    ok = ttft <= targets["ttft_ms"]
+    itl = cost.get("itl_p95_ms")
+    if itl is not None:
+        try:
+            ok = ok and float(itl) <= targets["itl_p95_ms"]
+        except (TypeError, ValueError):
+            pass
+    return ok
+
+
+class Series:
+    """One (node, metric) series: fine + downsampled coarse rings of
+    (bucket_epoch, value). Counters store per-second rates."""
+
+    __slots__ = ("kind", "step", "coarse_step", "fine", "coarse",
+                 "_prev_raw", "_prev_t", "_acc")
+
+    def __init__(self, kind: str, step: float, window: float):
+        self.kind = kind            # "gauge" | "counter" (stored as rate)
+        self.step = step
+        fine_n = max(2, min(FINE_BUCKETS_MAX, int(math.ceil(window / step))))
+        self.fine: collections.deque = collections.deque(maxlen=fine_n)
+        self.coarse_step = step * DOWNSAMPLE_X
+        coarse_n = max(2, int(math.ceil(window / self.coarse_step)))
+        self.coarse: collections.deque = collections.deque(maxlen=coarse_n)
+        self._prev_raw: Optional[float] = None   # counter-rate state
+        self._prev_t: Optional[float] = None
+        self._acc: Optional[list] = None         # [coarse_bucket, sum, n]
+
+    def record(self, value: float, t: float):
+        v = float(value)
+        if not math.isfinite(v):
+            return   # a NaN/Inf sample must not poison the ring
+        if self.kind == "counter":
+            prev, pt = self._prev_raw, self._prev_t
+            self._prev_raw, self._prev_t = v, t
+            if prev is None or pt is None or t <= pt:
+                return             # first sight: no interval to rate over
+            delta = v - prev
+            if delta < 0:
+                # counter reset (worker restart): the new cumulative IS
+                # the growth since the restart — monotone rate, no
+                # negative spike
+                delta = v
+            v = delta / (t - pt)
+        bt = t - (t % self.step)
+        if self.fine and self.fine[-1][0] == bt:
+            self.fine[-1] = (bt, v)      # same bucket: freshest wins
+        else:
+            self.fine.append((bt, v))
+        # downsample into the coarse ring: mean of the fine samples that
+        # landed in each coarse bucket, flushed when the bucket rolls
+        cb = t - (t % self.coarse_step)
+        if self._acc is None or self._acc[0] != cb:
+            if self._acc is not None and self._acc[2]:
+                self.coarse.append((self._acc[0],
+                                    self._acc[1] / self._acc[2]))
+            self._acc = [cb, 0.0, 0]
+        self._acc[1] += v
+        self._acc[2] += 1
+
+    def points(self, window: float, now: float) -> List[Tuple[float, float]]:
+        """Samples within ``window`` of ``now``: coarse history up to
+        where the fine ring begins, then the fine ring."""
+        cutoff = now - window
+        fine = [(t, v) for t, v in self.fine if t >= cutoff]
+        fine_t0 = fine[0][0] if fine else now
+        out = [(t, v) for t, v in self.coarse
+               if cutoff <= t < fine_t0]
+        if (self._acc is not None and self._acc[2]
+                and cutoff <= self._acc[0] < fine_t0):
+            out.append((self._acc[0], self._acc[1] / self._acc[2]))
+        return out + fine
+
+
+class TSDB:
+    """Bounded per-(node, metric) time-series store."""
+
+    def __init__(self, window_s: Optional[float] = None,
+                 step_s: Optional[float] = None,
+                 max_series_per_node: int = MAX_SERIES_PER_NODE):
+        self.window_s = float(window_s if window_s is not None
+                              else _env_float("DLI_TSDB_WINDOW_S",
+                                              DEFAULT_WINDOW_S))
+        self.step_s = float(step_s if step_s is not None
+                            else _env_float("DLI_TSDB_STEP_S",
+                                            DEFAULT_STEP_S))
+        self.step_s = max(0.1, self.step_s)
+        self.window_s = max(self.step_s * 4, self.window_s)
+        self._max_series = max(1, int(max_series_per_node))
+        self._lock = threading.Lock()
+        self._series: Dict[str, Dict[str, Series]] = {}   # node -> metric
+
+    def record(self, node: str, metric: str, value,
+               kind: str = "gauge", t: Optional[float] = None):
+        t = time.time() if t is None else t
+        with self._lock:
+            per_node = self._series.setdefault(str(node), {})
+            s = per_node.get(metric)
+            if s is None:
+                if len(per_node) >= self._max_series:
+                    return           # cap: drop new names, keep old series
+                s = per_node[metric] = Series(kind, self.step_s,
+                                              self.window_s)
+            s.record(value, t)
+
+    def ingest_prometheus(self, node: str, samples,
+                          t: Optional[float] = None):
+        """Feed one scrape's parsed exposition samples
+        ((name, labels, value) tuples from ``parse_prometheus``).
+        Histogram components are skipped (their cardinality belongs to
+        the scrape-time aggregation, not the retention layer); counters
+        (``_total``) are ingested for rate conversion, everything else
+        as a gauge. The ``dli_`` prefix is stripped so series names
+        match the in-process registry names."""
+        t = time.time() if t is None else t
+        for name, labels, value in samples:
+            if labels or name.endswith(("_bucket", "_sum", "_count")):
+                continue
+            if name.startswith("dli_"):
+                name = name[4:]
+            if name.endswith("_total"):
+                self.record(node, name[:-6], value, kind="counter", t=t)
+            else:
+                self.record(node, name, value, kind="gauge", t=t)
+
+    def query(self, metric: str, node: Optional[str] = None,
+              window: Optional[float] = None,
+              now: Optional[float] = None) -> List[dict]:
+        """All nodes' series for ``metric`` (optionally one node), each
+        as ``{"node", "metric", "kind", "points": [[t, v], ...]}``.
+        Counter series return per-second rates."""
+        now = time.time() if now is None else now
+        window = min(self.window_s,
+                     window if window else self.window_s)
+        out = []
+        with self._lock:
+            # points() iterates the ring deques, and record() appends to
+            # them from the scrape loop — reading under the same lock
+            # keeps a dashboard query landing mid-sweep from a "deque
+            # mutated during iteration" 500
+            for n, d in self._series.items():
+                if node is not None and n != str(node):
+                    continue
+                s = d.get(metric)
+                if s is None:
+                    continue
+                pts = s.points(window, now)
+                if pts:
+                    out.append({"node": n, "metric": metric,
+                                "kind": s.kind,
+                                "points": [[round(t, 3), v]
+                                           for t, v in pts]})
+        return out
+
+    def catalog(self) -> Dict[str, List[str]]:
+        with self._lock:
+            return {n: sorted(d.keys()) for n, d in self._series.items()}
+
+    def series_count(self) -> int:
+        with self._lock:
+            return sum(len(d) for d in self._series.values())
+
+    def drop_node(self, node: str):
+        with self._lock:
+            self._series.pop(str(node), None)
+
+
+class SLOEvaluator:
+    """Rolling SLO attainment + multi-window burn rate over per-request
+    outcomes. ``record(ok)`` per terminal request (a failed request is
+    an SLO miss); attainment is the within-SLO fraction over a window;
+    burn rate is (1 - attainment) / (1 - availability_target), reported
+    for the fast window (paging signal) with the slow window as the
+    confirmation (classic multi-window burn alerting)."""
+
+    def __init__(self, targets: Optional[dict] = None,
+                 fast_window_s: float = 300.0,
+                 slow_window_s: float = 3600.0, maxlen: int = 16384):
+        self.targets = dict(targets or slo_targets())
+        self.fast_window_s = float(fast_window_s)
+        self.slow_window_s = float(slow_window_s)
+        self._events: collections.deque = collections.deque(maxlen=maxlen)
+        self._lock = threading.Lock()
+        self.total = 0
+        self.violations = 0
+
+    def record(self, ok: bool, t: Optional[float] = None):
+        t = time.time() if t is None else t
+        with self._lock:
+            self._events.append((t, bool(ok)))
+            self.total += 1
+            if not ok:
+                self.violations += 1
+
+    def attainment(self, window_s: float,
+                   now: Optional[float] = None) -> Optional[float]:
+        now = time.time() if now is None else now
+        cutoff = now - window_s
+        with self._lock:
+            evs = [ok for t, ok in self._events if t >= cutoff]
+        if not evs:
+            return None
+        return sum(evs) / len(evs)
+
+    def burn_rate(self, window_s: float,
+                  now: Optional[float] = None) -> Optional[float]:
+        att = self.attainment(window_s, now)
+        if att is None:
+            return None
+        budget = 1.0 - self.targets["availability"]
+        return (1.0 - att) / max(budget, 1e-6)
+
+    def snapshot(self, now: Optional[float] = None) -> dict:
+        now = time.time() if now is None else now
+        fast = self.attainment(self.fast_window_s, now)
+        slow = self.attainment(self.slow_window_s, now)
+        # burn derives from the attainments already in hand — snapshot()
+        # runs per scrape step and per dashboard poll, and each
+        # attainment() is a lock-held scan of the event deque
+        budget = max(1.0 - self.targets["availability"], 1e-6)
+        return {
+            "targets": dict(self.targets),
+            "fast_window_s": self.fast_window_s,
+            "slow_window_s": self.slow_window_s,
+            "attainment_fast": round(fast, 4) if fast is not None else None,
+            "attainment_slow": round(slow, 4) if slow is not None else None,
+            "burn_rate_fast": (round((1.0 - fast) / budget, 3)
+                               if fast is not None else None),
+            "burn_rate_slow": (round((1.0 - slow) / budget, 3)
+                               if slow is not None else None),
+            "requests_total": self.total,
+            "violations_total": self.violations,
+        }

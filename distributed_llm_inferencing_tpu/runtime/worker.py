@@ -84,6 +84,11 @@ class WorkerAgent:
         s.add("POST", "/undrain", self.undrain)
         s.add("POST", "/profile/start", self.profile_start)
         s.add("POST", "/profile/stop", self.profile_stop)
+        # decode phase profiler (utils/profiler.py), distinct from the
+        # XLA device profiler above: GET reads per-model summaries +
+        # flamegraph JSON, POST toggles at runtime
+        s.add("GET", "/api/profile", self.api_profile)
+        s.add("POST", "/api/profile", self.api_profile_config)
         s.add("GET", "/memory_profile", self.memory_profile)
         s.add("POST", "/ssh_setup", self.ssh_setup)
         self._profile_dir: Optional[str] = None
@@ -155,8 +160,50 @@ class WorkerAgent:
     def api_trace(self, body):
         """This process's span ring buffer as Chrome trace-event JSON
         (utils/trace.py) — load in Perfetto, or let the master's
-        /api/trace merge it into the cluster-wide timeline."""
-        return trace.get_tracer().chrome_trace()
+        /api/trace merge it into the cluster-wide timeline. When the
+        decode profiler is armed, its sampled per-phase step spans merge
+        onto a dedicated track of the same export."""
+        tracer = trace.get_tracer()
+        extra = []
+        with self._models_lock:
+            models = list(self.models.values())
+        for m in models:
+            if m.batcher is not None and m.batcher.profiler.enabled:
+                extra.extend(m.batcher.profiler.chrome_events(
+                    tracer.export_pid()))
+        return tracer.chrome_trace(extra_events=extra)
+
+    def _batcher_profilers(self):
+        with self._models_lock:
+            return [(n, m.batcher.profiler)
+                    for n, m in self.models.items()
+                    if m.batcher is not None]
+
+    def api_profile(self, body):
+        """Decode-profiler readout: per-phase wall attribution of the
+        batcher step loop (summary + d3-flamegraph JSON) per batched
+        model. Zero-cost when the profiler is off — the payload then
+        just reports enabled=false."""
+        out = {}
+        for name, p in self._batcher_profilers():
+            out[name] = {"summary": p.summary(), "flame": p.flame()}
+        return {"status": "success", "profilers": out}
+
+    def api_profile_config(self, body):
+        """Runtime toggle: ``{"enabled": true, "sample_every": 4}``
+        arms every batched model's profiler (``reset`` clears the
+        ring). Applies to models loaded NOW; a model loaded later
+        starts from the DLI_PROFILE env default."""
+        cfgs = {}
+        for name, p in self._batcher_profilers():
+            cfgs[name] = p.configure(
+                enabled=body.get("enabled"),
+                sample_every=body.get("sample_every"),
+                reset=bool(body.get("reset")))
+        if not cfgs:
+            return 409, {"status": "error",
+                         "message": "no batched models loaded"}
+        return {"status": "success", "profilers": cfgs}
 
     def _do_load(self, body) -> tuple:
         name = body.get("model_name")
@@ -639,6 +686,7 @@ class WorkerAgent:
                 "tokens": toks,
                 "execution_time": time.time() - t0,
                 "ttft_ms": breq.ttft_ms,
+                "cost": breq.cost,
                 "scheduler": m.batcher.stats(),
             }
             self.metrics.inc("requests_completed")
@@ -776,6 +824,7 @@ class WorkerAgent:
                 "tokens": toks,
                 "execution_time": time.time() - t0,
                 "ttft_ms": req.ttft_ms,
+                "cost": req.cost,
                 "scheduler": m.batcher.stats(),
             }
         try:
@@ -799,6 +848,7 @@ class WorkerAgent:
             "prefill_ms": res.prefill_ms,
             "decode_ms": res.decode_ms,
             "tokens_per_s": res.decode_tokens_per_s,
+            "cost": res.cost(),
         }
 
     def engine_stream_events(self, body, schedule):

@@ -187,29 +187,53 @@ class _Timer:
 
 # ---- exposition parsing (master-side cluster aggregation) -------------
 
+# label block is greedy to the LAST '}' on the line: a quoted label
+# value may legally contain '}' and the numeric value never does
 _SAMPLE_RE = re.compile(
     r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"             # metric name
-    r"(?:\{([^}]*)\})?"                        # optional labels
-    r"\s+(-?(?:[0-9.eE+-]+|\+?Inf|NaN))\s*$")  # value
-_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="([^"\\]*)"')
+    r"(?:\{(.*)\})?"                           # optional labels
+    r"\s+(\S+)"                                # value (validated by float)
+    r"(?:\s+-?[0-9]+)?\s*$")                   # optional timestamp (ms)
+# label values may carry the exposition escapes \\ \" \n
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+_LABEL_UNESC = re.compile(r'\\(["\\n])')
 
 
-def parse_prometheus(text: str) -> List[Tuple[str, Dict[str, str], float]]:
+def _unescape_label(v: str) -> str:
+    return _LABEL_UNESC.sub(
+        lambda m: {'"': '"', "\\": "\\", "n": "\n"}[m.group(1)], v)
+
+
+def parse_prometheus(text: str, strict: bool = False
+                     ) -> List[Tuple[str, Dict[str, str], float]]:
     """Parse exposition text into (name, labels, value) samples. Comments
-    and blank lines are skipped; a malformed sample line raises — the
-    master treats an unparseable worker scrape as scrape failure, and the
-    strict-format test drives this same parser."""
+    and blank lines are skipped. NaN/±Inf and exponent-formatted values
+    parse; label values may use the exposition escapes (``\\"``,
+    ``\\\\``, ``\\n``). By default a malformed sample line is SKIPPED —
+    one corrupt line must not blank a node's whole scrape (the master's
+    cluster aggregation and the TSDB scrape loop both ride this).
+    ``strict=True`` restores the raising behavior for format checkers."""
     out = []
     for line in text.splitlines():
         line = line.strip()
         if not line or line.startswith("#"):
             continue
         m = _SAMPLE_RE.match(line)
-        if not m:
-            raise ValueError(f"invalid exposition sample: {line!r}")
-        name, labels_raw, value = m.groups()
-        labels = dict(_LABEL_RE.findall(labels_raw)) if labels_raw else {}
-        out.append((name, labels, float(value.replace("Inf", "inf"))))
+        value = None
+        if m is not None:
+            try:
+                value = float(m.group(3))
+            except ValueError:
+                value = None
+        if value is None:
+            if strict:
+                raise ValueError(f"invalid exposition sample: {line!r}")
+            continue
+        name, labels_raw, _ = m.groups()
+        labels = ({k: _unescape_label(v)
+                   for k, v in _LABEL_RE.findall(labels_raw)}
+                  if labels_raw else {})
+        out.append((name, labels, value))
     return out
 
 

@@ -32,6 +32,7 @@ the batcher loop) passes an explicit ``parent=`` SpanCtx instead.
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import contextvars
 import dataclasses
@@ -120,11 +121,23 @@ class Tracer:
     request's own stamps, not measured inline).
     """
 
-    def __init__(self, service: str = "dli", capacity: int = 4096):
+    def __init__(self, service: str = "dli", capacity: int = 4096,
+                 retain_capacity: int = 2048):
         self.service = service
         self.capacity = capacity
         self._buf: deque = deque(maxlen=capacity)
         self._lock = threading.Lock()
+        # Tail-sampling retention: traces flagged interesting (errored /
+        # SLO-violating requests) keep their spans in a separate bounded
+        # ring, so a postmortem doesn't race the main ring's oldest-first
+        # eviction under steady scrape/request traffic. _retain_ids is
+        # the bounded set of flagged trace ids — spans recorded AFTER the
+        # flag (e.g. the master's side of a worker-flagged trace) are
+        # captured too.
+        self._retained: deque = deque(maxlen=retain_capacity)
+        self._retain_ids: "collections.OrderedDict" = \
+            collections.OrderedDict()
+        self._retain_ids_max = 256
 
     # ---- recording ---------------------------------------------------
 
@@ -144,6 +157,8 @@ class Tracer:
                   tid=threading.get_ident())
         with self._lock:
             self._buf.append(sp)
+            if sp.trace_id in self._retain_ids:
+                self._retained.append(sp)
         return sp.ctx()
 
     @contextlib.contextmanager
@@ -177,6 +192,8 @@ class Tracer:
             if keep:
                 with self._lock:
                     self._buf.append(sp)
+                    if sp.trace_id in self._retain_ids:
+                        self._retained.append(sp)
 
     # ---- introspection / export --------------------------------------
 
@@ -184,9 +201,33 @@ class Tracer:
         with self._lock:
             return list(self._buf)
 
+    def retain(self, trace_id: Optional[str]):
+        """Flag a trace as retention-worthy (errored / SLO-violating
+        request): its spans already in the main ring are copied into the
+        bounded retained ring NOW (before eviction can race the
+        postmortem), and spans recorded under this trace id afterwards
+        are captured as they arrive. Idempotent per trace."""
+        if not trace_id:
+            return
+        with self._lock:
+            if trace_id in self._retain_ids:
+                return
+            self._retain_ids[trace_id] = None
+            while len(self._retain_ids) > self._retain_ids_max:
+                self._retain_ids.popitem(last=False)
+            for s in self._buf:
+                if s.trace_id == trace_id:
+                    self._retained.append(s)
+
+    def retained_spans(self) -> List[Span]:
+        with self._lock:
+            return list(self._retained)
+
     def clear(self):
         with self._lock:
             self._buf.clear()
+            self._retained.clear()
+            self._retain_ids.clear()
 
     def find(self, trace_id: str) -> List[Span]:
         return [s for s in self.spans() if s.trace_id == trace_id]
@@ -212,7 +253,10 @@ class Tracer:
             "args": {"name": f"{self.service} ({socket_host()}:"
                              f"{os.getpid()})"},
         }]
-        for s in self.spans():
+        # retained spans export alongside the live ring; the overlap
+        # window (a span in both) deduplicates by span id in
+        # chrome_trace's dedupe_events
+        for s in self.spans() + self.retained_spans():
             args = {"trace_id": s.trace_id, "span_id": s.span_id}
             if s.parent_id:
                 args["parent_id"] = s.parent_id

@@ -50,6 +50,19 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu \
 timeout -k 10 600 env JAX_PLATFORMS=cpu \
     python bench.py --scenario prefix_cache --smoke || exit 1
 
+echo "== telemetry plane (TSDB + cost ledger + SLO + profiler) =="
+# Time-series retention, per-request cost ledger, SLO accounting, decode
+# profiler (docs/observability.md "Telemetry plane"); the smoke drives a
+# live master + in-proc worker, waits two scrape intervals, asserts
+# /api/timeseries serves multi-sample series + the cost ledger
+# round-trips, and leaves a debug bundle at /tmp/dli_debug_bundle.tar.gz
+# (uploaded as a CI artifact on tier-1 failure)
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_tsdb.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python scripts/telemetry_smoke.py || exit 1
+
 echo "== chaos suite (fault injection + self-healing dispatch) =="
 # Deterministic fault schedules: a failure here reproduces locally with
 #   DLI_FAULTS_SEED=0 JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q
@@ -72,6 +85,7 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     --ignore=tests/test_adaptive_spec.py \
     --ignore=tests/test_dispatch_batch.py \
     --ignore=tests/test_kvtier.py \
+    --ignore=tests/test_tsdb.py \
     2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \

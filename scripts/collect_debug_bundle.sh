@@ -1,0 +1,58 @@
+#!/usr/bin/env bash
+# Snapshot a live master's observability surfaces into one tarball for
+# bug reports (docs/robustness.md "Fault runbook"): retained time-series
+# history, the cluster trace export, decode-profiler readout, SLO
+# rollup, node/breaker state, cluster metrics, and recent request rows.
+#
+# Usage: scripts/collect_debug_bundle.sh [MASTER_URL] [OUT_TARBALL]
+#   MASTER_URL   default http://127.0.0.1:8000
+#   OUT_TARBALL  default dli-debug-bundle-<timestamp>.tar.gz
+# Honors DLI_MASTER_AUTH_KEY for a bearer-authed master and
+# DLI_BUNDLE_TIMEOUT (seconds per fetch, default 30). Each fetch is
+# best-effort: an unreachable surface records its error in place instead
+# of sinking the whole bundle.
+set -uo pipefail
+
+MASTER="${1:-http://127.0.0.1:8000}"
+OUT="${2:-dli-debug-bundle-$(date +%Y%m%d-%H%M%S).tar.gz}"
+TMP="$(mktemp -d)"
+trap 'rm -rf "$TMP"' EXIT
+
+HDR=()
+if [ -n "${DLI_MASTER_AUTH_KEY:-}" ]; then
+    HDR=(-H "Authorization: Bearer $DLI_MASTER_AUTH_KEY")
+fi
+
+fetch() {  # fetch <path> <outfile>
+    # ${HDR[@]+...}: an empty array under `set -u` is an unbound-variable
+    # abort on bash < 4.4 (macOS /bin/bash 3.2) — expand only when set
+    if ! curl -fsS --max-time "${DLI_BUNDLE_TIMEOUT:-30}" \
+            ${HDR[@]+"${HDR[@]}"} \
+            "$MASTER$1" -o "$TMP/$2" 2>"$TMP/$2.err"; then
+        printf '{"error": "fetch %s failed: %s"}\n' \
+            "$1" "$(tr -d '"\n' < "$TMP/$2.err" | head -c 200)" > "$TMP/$2"
+    fi
+    rm -f "$TMP/$2.err"
+}
+
+fetch /api/timeseries timeseries_catalog.json
+for m in tokens_generated batcher_queue_depth batcher_free_kv_blocks \
+         prefix_hit_ratio breaker_state slo_attainment slo_burn_rate \
+         requests_completed; do
+    fetch "/api/timeseries?metric=$m" "timeseries_$m.json"
+done
+fetch /api/trace trace.json              # open in Perfetto
+fetch /api/profile profile.json          # decode-profiler readout
+fetch /api/slo slo.json
+fetch /api/nodes/status nodes_status.json
+fetch /api/cluster_metrics cluster_metrics.json
+fetch /api/inference/recent recent_requests.json
+fetch /metrics master_metrics.prom
+
+{
+    echo "collected_at: $(date -u +%Y-%m-%dT%H:%M:%SZ)"
+    echo "master: $MASTER"
+} > "$TMP/MANIFEST"
+
+tar -czf "$OUT" -C "$TMP" .
+echo "$OUT"

@@ -1,0 +1,141 @@
+"""Telemetry-plane CI smoke: start a live master + one in-proc batched
+worker, run a short batched wave, wait out two TSDB scrape intervals,
+and assert the retention layer actually retained:
+
+- ``GET /api/timeseries`` serves multi-sample per-node series for tok/s
+  (counter->rate) and queue depth after the run;
+- a completed request's cost record round-trips through the worker
+  response, the master row, and ``GET /api/requests/<id>/cost``, with
+  its phases summing to ~the e2e window;
+- the SLO evaluator saw every completed request.
+
+Always finishes by collecting a debug bundle from the live cluster into
+/tmp/dli_debug_bundle.tar.gz — on a later tier-1 failure the workflow
+uploads it as the postmortem artifact (scripts/collect_debug_bundle.sh).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+# runnable as `python scripts/telemetry_smoke.py` from the repo root
+# (sys.path[0] is scripts/ then, and the package wouldn't resolve)
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import requests
+
+STEP_S = 0.5
+
+
+def main():
+    from distributed_llm_inferencing_tpu.runtime.master import Master
+    from distributed_llm_inferencing_tpu.runtime.worker import WorkerAgent
+
+    agent = WorkerAgent()
+    wsrv = agent.serve("127.0.0.1", 0, background=True)
+    wport = wsrv.server_address[1]
+    r = requests.post(f"http://127.0.0.1:{wport}/load_model", json={
+        "model_name": "tiny-llama", "allow_random_init": True,
+        "dtype": "float32", "serving": "batched", "slots": 4,
+        "kv_blocks": 128, "kv_block_size": 8, "max_seq": 64}, timeout=600)
+    assert r.status_code == 200, r.text
+
+    m = Master(":memory:", health_interval=1.0, tsdb_step_s=STEP_S)
+    msrv = m.service.serve("127.0.0.1", 0, background=True)
+    base = f"http://127.0.0.1:{msrv.server_address[1]}"
+    rc = 1
+    try:
+        r = requests.post(f"{base}/api/nodes/add", json={
+            "name": "w0", "host": "127.0.0.1", "port": wport}).json()
+        assert r["status"] == "success", r
+        m.start_background()
+
+        rids = []
+        for i in range(6):
+            rids.append(requests.post(f"{base}/api/inference/submit", json={
+                "model_name": "tiny-llama", "prompt": f"telemetry {i}",
+                "max_new_tokens": 8,
+                "sampling": {"do_sample": False,
+                             "allow_random_init": True}}).json()
+                ["request_id"])
+        deadline = time.time() + 300
+        rows = {}
+        while time.time() < deadline and len(rows) < len(rids):
+            for rid in rids:
+                if rid in rows:
+                    continue
+                st = requests.get(
+                    f"{base}/api/inference/status/{rid}").json()["request"]
+                if st["status"] in ("completed", "failed"):
+                    rows[rid] = st
+            time.sleep(0.2)
+        assert len(rows) == len(rids), f"only {len(rows)} finished"
+        failed = [r for r in rows.values() if r["status"] != "completed"]
+        assert not failed, failed
+
+        # two scrape intervals so the tok/s rate series has >= 2 samples
+        time.sleep(4 * STEP_S)
+
+        for metric, min_points in (("tokens_generated", 2),
+                                   ("batcher_queue_depth", 2)):
+            ts = requests.get(f"{base}/api/timeseries",
+                              params={"metric": metric}).json()
+            series = [s for s in ts["series"] if s["node"] == "w0"]
+            assert series, f"no {metric} series for w0: {ts}"
+            pts = series[0]["points"]
+            assert len(pts) >= min_points, (metric, pts)
+        # the rate series must have seen the run's tokens move
+        ts = requests.get(f"{base}/api/timeseries",
+                          params={"metric": "tokens_generated"}).json()
+        assert any(v > 0 for s in ts["series"] for _, v in s["points"]), ts
+
+        # cost ledger round-trip + phase-sum sanity
+        rid = rids[0]
+        c = requests.get(f"{base}/api/requests/{rid}/cost").json()
+        assert c["status"] == "success", c
+        cost = c["cost"]
+        phase_sum = (cost["queue_ms"] + cost["prefill_ms"]
+                     + cost["decode_ms"])
+        # phases sum exactly to the batcher's e2e span; the worker's
+        # execution_time adds only handler overhead around that span,
+        # while the master's e2e_ms also adds dispatch overhead (a fixed
+        # ~10ms that dwarfs a warm millisecond-scale request) — so gate
+        # tightly against the worker window, loosely against the master
+        e2e = c["e2e_ms"]
+        exec_ms = c["execution_time"] * 1e3
+        assert 0.85 * exec_ms <= phase_sum <= min(1.02 * exec_ms,
+                                                  1.02 * e2e), (phase_sum,
+                                                                exec_ms, c)
+        assert cost["decode_tokens"] == 8, cost
+        # SLO evaluator saw every completed request
+        slo = requests.get(f"{base}/api/slo").json()
+        assert slo["requests_total"] >= len(rids), slo
+        # decode profiler surface answers (disabled by default)
+        prof = requests.get(f"{base}/api/profile").json()
+        assert prof["nodes"]["w0"]["tiny-llama"]["summary"][
+            "enabled"] is False, prof
+
+        out = subprocess.run(
+            ["bash", "scripts/collect_debug_bundle.sh", base,
+             "/tmp/dli_debug_bundle.tar.gz"],
+            capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stderr
+        print("telemetry smoke ok:",
+              json.dumps({"series_points": len(pts),
+                          "phase_sum_ms": round(phase_sum, 1),
+                          "e2e_ms": e2e,
+                          "slo_requests": slo["requests_total"],
+                          "bundle": out.stdout.strip()}),
+              file=sys.stderr)
+        rc = 0
+    finally:
+        m.stop()
+        agent.service.shutdown()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

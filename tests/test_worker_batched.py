@@ -66,6 +66,15 @@ def test_concurrent_inference_shares_batch(worker):
         assert r["status"] == "success", r
         assert len(r["tokens"]) == 16
         assert r["ttft_ms"] is not None
+        # cost ledger rides every completed response: phases partition
+        # the e2e span (queue+prefill+decode ≈ execution_time, which
+        # adds only handler overhead around the batcher span)
+        c = r["cost"]
+        phase_sum_ms = c["queue_ms"] + c["prefill_ms"] + c["decode_ms"]
+        assert 0 < phase_sum_ms <= r["execution_time"] * 1e3 * 1.02, r
+        assert c["decode_tokens"] == 16
+        assert c["weight_passes"] >= 1
+        assert c["kv_blocks_peak"] >= 1
     # identical prompts -> identical greedy outputs
     r_a = requests.post(_url(port, "/inference"), json={
         "model_name": "tiny-llama", "prompt_tokens": [3, 5, 7, 11],
@@ -74,6 +83,36 @@ def test_concurrent_inference_shares_batch(worker):
     assert r_a["tokens"] == results[0]["tokens"]
     # the scheduler actually ran these (prefix cache saw the repeats)
     assert r_a["scheduler"]["tokens_out"] >= 7 * 16
+
+
+def test_cost_ledger_cached_tokens_match_kvtier_counters(worker):
+    """The cost record's cached/uncached prefill tokens use the exact
+    expressions behind the cluster ``dli_prefill_{cached,uncached}_
+    tokens_total`` counters, so per-request ledgers reconcile with the
+    fleet metrics (the acceptance contract of the telemetry PR)."""
+    agent, port = worker
+    prompt = list(range(101, 121))    # 20 tokens: 2 full 8-token blocks
+    before = dict(agent.metrics.snapshot()["counters"])
+    costs = []
+    for _ in range(2):
+        r = requests.post(_url(port, "/inference"), json={
+            "model_name": "tiny-llama", "prompt_tokens": prompt,
+            "max_new_tokens": 4, "sampling": {"do_sample": False},
+        }, timeout=300)
+        assert r.status_code == 200, r.text
+        costs.append(r.json()["cost"])
+    after = agent.metrics.snapshot()["counters"]
+
+    def delta(name):
+        return after.get(name, 0) - before.get(name, 0)
+
+    # the second identical prompt hit the radix cache for its two full
+    # prefix blocks (the first may also hit KV left by earlier tests)
+    assert costs[1]["prefill_cached_tokens"] >= 16, costs
+    assert sum(c["prefill_cached_tokens"] for c in costs) == \
+        delta("prefill_cached_tokens")
+    assert sum(c["prefill_uncached_tokens"] for c in costs) == \
+        delta("prefill_uncached_tokens")
 
 
 def test_streaming_batched(worker):
