@@ -636,7 +636,7 @@ def _mla_latent_attn(h, lp, cfg: ModelConfig, q_positions, cache_k,
 
 
 def _block_body(x, lp, cfg: ModelConfig, q_positions, attend_write,
-                mla_latent_attend=None):
+                mla_latent_attend=None, fused_q_attend=None):
     """One transformer block: norm → QKV (+RoPE) → attend → norm → MLP/MoE.
 
     The single definition of the block structure, shared by the dense path
@@ -644,6 +644,13 @@ def _block_body(x, lp, cfg: ModelConfig, q_positions, attend_write,
     paged_prefill_tail) so the three can never diverge. ``attend_write(q,
     k, v) -> (attn [B,s,H,hd], cache_out)`` owns the regime-specific part:
     cache update + attention formulation.
+
+    ``fused_q_attend(h, k, v) -> (attn, cache_out)`` (DLI_FUSED_DECODE,
+    ops/pallas/fused_decode.py): the q projection + RoPE + attention run
+    fused inside the callback's single pallas_call — the block computes
+    ONLY k/v here (their projections feed the cache write, which the
+    kernel reads back). The caller gates eligibility
+    (fused_decode.supported); ineligible configs never reach this arm.
 
     cfg.post_norm flips pre-LN (norm -> sublayer -> residual) to the
     post-LN order opt-350m uses (sublayer -> residual -> norm);
@@ -663,6 +670,23 @@ def _block_body(x, lp, cfg: ModelConfig, q_positions, attend_write,
         vd = cfg.v_head_dim_effective
         attn = _linear(attn.reshape(B, s, cfg.num_heads * vd), lp["o"],
                        row_sharded=cfg.tp_row_sharded)
+        return _block_tail(x, h, attn, cache_out, lp, cfg)
+    if fused_q_attend is not None:
+        # fused decode arm: project/rotate ONLY k and v (the kernel owns
+        # q end-to-end); eligibility (no qk_norm/clip, full-width
+        # non-interleaved rope) was gated by the caller
+        k = _linear(h, lp["k"]).reshape(B, s, cfg.num_kv_heads,
+                                        cfg.head_dim)
+        v = _linear(h, lp["v"]).reshape(B, s, cfg.num_kv_heads,
+                                        cfg.head_dim)
+        if cfg.position_embedding == "rope":
+            k = apply_rope(k, q_positions, cfg.rope_theta, cfg.rope_pct,
+                           cfg.rope_interleaved,
+                           inv_freq=cfg.rope_inv_freq,
+                           attn_factor=cfg.rope_attn_factor)
+        attn, cache_out = fused_q_attend(h, k, v)
+        attn = _linear(attn.reshape(B, s, cfg.num_heads * cfg.head_dim),
+                       lp["o"], row_sharded=cfg.tp_row_sharded)
         return _block_tail(x, h, attn, cache_out, lp, cfg)
     if cfg.mla:
         q, k, v = _mla_qkv(h, lp, cfg, q_positions)   # rope applied inside
@@ -941,15 +965,44 @@ def paged_decode_step(params, cfg: ModelConfig, tokens, paged,
     """
     from distributed_llm_inferencing_tpu.ops.paged_kvcache import (
         PagedKVCache, paged_attend_decode, write_token)
+    from distributed_llm_inferencing_tpu.ops.pallas import fused_decode
     r = tokens.shape[0]
     backend = _cfg_backend(cfg, jax.device_count())
     q_pos = context_lens[:, None]                       # [R, 1]
     x = embed(params, cfg, tokens[:, None], q_pos)      # [R, 1, D]
     quantized = paged.quantized
+    # Fused dequant-GEMV -> RoPE -> paged flash attention
+    # (ops/pallas/fused_decode.py, DLI_FUSED_DECODE): one pallas_call per
+    # layer replaces the q einsum + rope + attention chain — q never
+    # round-trips HBM. Interpret mode off-TPU (the differential oracle
+    # path the parity suite exercises); the unfused formulation below
+    # stays bitwise-authoritative everywhere the gate declines.
+    use_fused = fused_decode.eligible(cfg, quantized)
+    fused_interpret = jax.default_backend() != "tpu"
+    rope_cos = rope_sin = None
+    if use_fused and cfg.position_embedding == "rope":
+        rope_cos, rope_sin = fused_decode.rope_cos_sin(
+            cfg, context_lens, cfg.head_dim)
 
     def make_body(seg_cfg):
         def body(x, layer_in):
             lp, ck, cv, *scales = layer_in              # ck: [NB, bs, Hkv, hd]
+
+            if use_fused and fused_decode.supported(seg_cfg, lp["q"]):
+                def fused_q_attend(h, k, v):
+                    nk = write_token(ck, k[:, 0], block_tables,
+                                     context_lens)
+                    nv = write_token(cv, v[:, 0], block_tables,
+                                     context_lens)
+                    attn = fused_decode.fused_decode_step(
+                        h[:, 0], lp["q"], nk, nv, block_tables,
+                        context_lens + 1,
+                        rope_cos=rope_cos, rope_sin=rope_sin,
+                        sliding_window=_layer_window(seg_cfg, lp),
+                        interpret=fused_interpret)
+                    return attn[:, None], (nk, nv)
+                return _block_body(x, lp, seg_cfg, q_pos, None,
+                                   fused_q_attend=fused_q_attend)
 
             def attend_write(q, k, v):
                 if quantized:
@@ -1040,11 +1093,14 @@ def paged_decode_chunk(params, cfg: ModelConfig, k: int, tokens, paged,
         PagedKVCache, gather_seq)
     from distributed_llm_inferencing_tpu.ops.sampling import sample_batch
 
-    if _cfg_backend(cfg, jax.device_count(),
-                    op="paged").startswith("pallas"):
-        # explicit pallas request (A/B and debug escape hatch): the
-        # side-buffer formulation below bypasses the paged kernel, so run
-        # the stepwise write+attend loop that dispatches to it instead
+    from distributed_llm_inferencing_tpu.ops.pallas import fused_decode
+    if (_cfg_backend(cfg, jax.device_count(),
+                     op="paged").startswith("pallas")
+            or fused_decode.eligible(cfg, paged.quantized)):
+        # explicit pallas request (A/B and debug escape hatch) or the
+        # fused decode kernel (DLI_FUSED_DECODE): the side-buffer
+        # formulation below bypasses the paged/fused kernels, so run the
+        # stepwise write+attend loop that dispatches to them instead
         return _paged_decode_chunk_stepwise(
             params, cfg, k, tokens, paged, block_tables, context_lens,
             seeds, steps0, temps, tks, tps, ds, budget, eos_ids,
@@ -1205,7 +1261,8 @@ def _paged_decode_chunk_stepwise(params, cfg: ModelConfig, k: int, tokens,
 def paged_speculative_chunk(params, cfg: ModelConfig, k: int, gamma: int,
                             tokens, history, paged, block_tables,
                             context_lens, seeds, steps0, temps, tks, tps,
-                            ds, budget, eos_ids, dummy_block: int):
+                            ds, budget, eos_ids, dummy_block: int,
+                            gammas=None):
     """K speculative iterations on device for R serving slots: draft
     gamma tokens per slot by on-device prompt lookup
     (ops/speculative.py propose_ngram_device), score [cur, drafts] in one
@@ -1245,6 +1302,17 @@ def paged_speculative_chunk(params, cfg: ModelConfig, k: int, gamma: int,
     history: [R, H] all known tokens per slot (prompt + emitted; row r
     valid to context_lens[r] + 1). Block tables must cover
     ``context_lens + k*(gamma+1)`` growth.
+
+    ``gammas`` ([R] int32 in [0, gamma], default gamma) is the per-slot
+    draft WIDTH for wave-level speculation: ``gamma`` stays the compiled
+    program's static maximum (one compiled program per chunk shape
+    regardless of the wave's width mix) while each slot's effective
+    width rides as data (ops/speculative.py accept_rejection_batch
+    ``widths``). A gamma-0 slot accepts no drafts and emits exactly one
+    plain-decode token per iteration — it rides the shared verify pass
+    instead of forcing a wave-wide fallback; its gamma_max draft entries
+    still occupy (dummy-targeted) scratch, the price of the uniform
+    program shape.
 
     Returns (toks [K, R, gamma+1], keeps [K, R], eos_seen [K, R],
     new paged): iteration t of slot r emitted ``toks[t, r, :keeps[t,r]]``;
@@ -1360,7 +1428,8 @@ def paged_speculative_chunk(params, cfg: ModelConfig, k: int, gamma: int,
         # warped distribution sample_batch draws from — real speedups for
         # do_sample requests with the target distribution preserved
         toks_out, n_emit = accept_rejection_batch(
-            logits, drafts, seeds, steps0 + emitted, temps, tks, tps, ds)
+            logits, drafts, seeds, steps0 + emitted, temps, tks, tps, ds,
+            widths=gammas)
         idx = jnp.arange(g1, dtype=jnp.int32)[None, :]
 
         # eos / budget clamping

@@ -10,8 +10,18 @@ two attention regimes —
 - ``flash_decode``: single-token cached attention streaming the KV cache
   from HBM (bandwidth-bound)
 
-Both run in interpreter mode on CPU for tests (tests/test_pallas_attention.py)
-and compiled on TPU via ops/attention.py's backend dispatch.
+plus the paged and fused decode kernels:
+
+- ``paged_attention.paged_flash_decode``: block-table-driven decode
+  attention straight out of the paged pool (no gather materialization)
+- ``quant_matmul.q4_matmul``: nibble-packed int4 dequant-GEMV that
+  never materializes unpacked weights in HBM
+- ``fused_decode.fused_decode_step``: dequant-GEMV -> RoPE -> paged
+  flash attention chained in ONE pallas_call (``DLI_FUSED_DECODE``)
+
+All run in interpreter mode on CPU for tests (tests/test_pallas_attention.py,
+tests/test_pallas_parity.py — the differential suite against the XLA
+oracles) and compiled on TPU via ops/attention.py's backend dispatch.
 """
 
 from distributed_llm_inferencing_tpu.ops.pallas.flash_attention import (  # noqa: F401

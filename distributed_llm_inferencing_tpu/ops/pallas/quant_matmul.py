@@ -155,6 +155,23 @@ from jax.sharding import NamedSharding  # noqa: E402
 from jax.sharding import PartitionSpec as P  # noqa: E402
 
 
+def _def_partition(prim, **kwargs):
+    """``def_partition`` across jax versions: the shardy factor kwargs
+    (``sharding_rule``/``need_replication_factors``/``reduction_factors``)
+    only exist on newer jax — on 0.4.3x installs (this container) passing
+    them was an import-time TypeError that silently killed the ENTIRE
+    int4 pallas path (every caller fell back to the XLA unpack). Strip
+    them when unsupported: the GSPMD callbacks carry the full
+    partitioning semantics either way."""
+    try:
+        prim.def_partition(**kwargs)
+    except TypeError:
+        for k in ("sharding_rule", "need_replication_factors",
+                  "reduction_factors"):
+            kwargs.pop(k, None)
+        prim.def_partition(**kwargs)
+
+
 def _spec_of(shape_with_sharding):
     sh = getattr(shape_with_sharding, "sharding", None)
     spec = getattr(sh, "spec", None)
@@ -193,7 +210,8 @@ def _q4_matmul_p(x, p4, scale, interpret):
     return _q4_pallas(x, p4, scale, interpret)
 
 
-_q4_matmul_p.def_partition(
+_def_partition(
+    _q4_matmul_p,
     partition=_q4_partition,
     infer_sharding_from_operands=_q4_infer,
     sharding_rule="m k, h n, n -> m n",
@@ -265,7 +283,8 @@ def _q4_matmul_row_p(x, p4, scale, interpret, chunks):
     return ((x.astype(jnp.float32) @ w) * scale[None, :]).astype(x.dtype)
 
 
-_q4_matmul_row_p.def_partition(
+_def_partition(
+    _q4_matmul_row_p,
     partition=_q4_row_partition,
     infer_sharding_from_operands=_q4_row_infer,
     sharding_rule="m k, h n, n -> m n",

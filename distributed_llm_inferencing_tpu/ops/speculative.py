@@ -297,7 +297,7 @@ def propose_ngram_device(history, lengths, gamma: int, n: int = 2):
 
 
 def accept_rejection_batch(logits, drafts, seeds, steps, temps, top_ks,
-                           top_ps, ds):
+                           top_ps, ds, widths=None):
     """Per-row data-parameterized draft acceptance for the BATCHED
     speculative path (models/transformer.py paged_speculative_chunk):
     one compiled program serves any mix of greedy / sampled requests,
@@ -307,9 +307,25 @@ def accept_rejection_batch(logits, drafts, seeds, steps, temps, top_ks,
 
     logits: [R, G+1, V] f32 — position i scores the token after accepting
     i drafts; drafts: [R, G] int32; seeds/steps: [R] int32 — ``steps`` is
-    the row's emitted-token count, so its PRNG stream stays a pure
-    function of (prompt, seed) and a rerun reproduces the trajectory.
+    the row's emitted-token count. PRNG keying is per absolute POSITION:
+    the acceptance draw for draft i uses stream (seed, steps + i) and the
+    stop draw uses (seed, steps + n_acc) — each emitted position's
+    randomness is a pure function of (seed, position), invariant to how
+    chunk boundaries or the draft width partition the trajectory (the
+    old chunk-start keying made a rerun with a different gamma or chunk
+    split correlate residual draws with earlier acceptance draws at the
+    same (seed, chunk-start) point).
     temps/top_ps: [R] f32; top_ks: [R] int32 (0 disables); ds: [R] bool.
+
+    ``widths`` ([R] int32 in [0, G], default G) is the per-row draft
+    width for wave-level speculation (runtime/batcher.py
+    _step_speculative): row r considers only its first ``widths[r]``
+    drafts; a width-0 row accepts nothing and its stop token is an
+    ordinary single-token draw from position 0's distribution — plain
+    decode riding the verify pass, with greedy rows emitting exactly
+    the plain argmax. Running out of width is NOT a rejection: the stop
+    token at position ``widths[r]`` draws from the full distribution
+    (the bonus-token rule), not the leave-one-out residual.
 
     Acceptance, per row:
     - greedy (``~ds``): accept draft i while it equals the raw argmax;
@@ -354,23 +370,36 @@ def accept_rejection_batch(logits, drafts, seeds, steps, temps, top_ks,
         jnp.where(match, jnp.exp(m[:, :-1] - z[:, :-1, None]), 0.0),
         axis=-1)                                                # [R,G]
 
-    # per-row PRNG: fold the emitted-count stream position, then a spec
-    # tag per use — reproducible, independent of chunk-mates
-    def _keys(s, t):
-        base = jax.random.fold_in(jax.random.PRNGKey(s), t)
-        return (jax.random.fold_in(base, 0x5acc),
-                jax.random.fold_in(base, 0x570b))
-    k_acc, k_stop = jax.vmap(_keys)(seeds, steps)
-    u = jax.vmap(lambda kk: jax.random.uniform(kk, (g,)))(k_acc)
+    if widths is None:
+        widths = jnp.full((r,), g, jnp.int32)
+    widths = jnp.clip(widths.astype(jnp.int32), 0, g)
+
+    # per-row PRNG: each use folds its ABSOLUTE stream position
+    # (steps + offset within this verify step), then a spec tag — the
+    # draw at a given emitted position is a pure function of
+    # (seed, position), independent of chunk-mates, chunk boundaries
+    # and the draft width
+    def _acc_u(s, t):
+        def one(i):
+            kk = jax.random.fold_in(
+                jax.random.fold_in(jax.random.PRNGKey(s), t + i), 0x5acc)
+            return jax.random.uniform(kk)
+        return jax.vmap(one)(jnp.arange(g, dtype=jnp.int32))
+    u = jax.vmap(_acc_u)(seeds, steps)                          # [R,G]
 
     targets = jnp.argmax(logits, axis=-1).astype(jnp.int32)     # [R,G1]
     acc_greedy = drafts == targets[:, :-1]
     acc_sample = covered[:, None] & (u < p_draft)
     acc = jnp.where(ds[:, None], acc_sample, acc_greedy)
+    acc &= jnp.arange(g, dtype=jnp.int32)[None, :] < widths[:, None]
     prefix = jnp.cumprod(acc.astype(jnp.int32), axis=1)
-    n_acc = prefix.sum(axis=1)                                  # [R] 0..G
+    n_acc = prefix.sum(axis=1)                           # [R] 0..widths
 
-    # stop token at position n_acc, per mechanism
+    # stop token at position n_acc, per mechanism; keyed by its absolute
+    # position so the draw is chunk-boundary/width invariant
+    k_stop = jax.vmap(lambda s, t: jax.random.fold_in(
+        jax.random.fold_in(jax.random.PRNGKey(s), t), 0x570b))(
+        seeds, steps + n_acc)
     stop_greedy = jnp.take_along_axis(targets, n_acc[:, None],
                                       axis=1)[:, 0]
     m_stop = jnp.take_along_axis(
@@ -379,7 +408,9 @@ def accept_rejection_batch(logits, drafts, seeds, steps, temps, top_ks,
         idx, n_acc[:, None, None], axis=1)[:, 0]                # [R,KS]
     rejected = jnp.take_along_axis(
         drafts, jnp.minimum(n_acc, g - 1)[:, None], axis=1)[:, 0]
-    was_rejection = n_acc < g
+    # ran-out-of-width is a bonus draw, not a rejection: only mask the
+    # draft token when a draft at this position was actually judged
+    was_rejection = n_acc < widths
     m_res = jnp.where((idx_stop == rejected[:, None])
                       & was_rejection[:, None], -jnp.inf, m_stop)
     j = jax.vmap(lambda kk, l: jax.random.categorical(kk, l))(k_stop, m_res)

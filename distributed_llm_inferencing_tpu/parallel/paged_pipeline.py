@@ -283,11 +283,12 @@ def paged_speculative_chunk_pp(params, cfg: ModelConfig, k: int, gamma: int,
                                tokens, history, paged, block_tables,
                                context_lens, seeds, steps0, temps, tks, tps,
                                ds, budget, eos_ids, dummy_block: int,
-                               *, mesh: Mesh):
+                               gammas=None, *, mesh: Mesh):
     """K speculative iterations with the layer stack pipelined over
     ``pp``. Same contract as transformer.paged_speculative_chunk:
     returns (toks [K, R, gamma+1], keeps [K, R], eos_seen [K, R],
-    new paged).
+    new paged) — including the per-slot ``gammas`` draft widths
+    (wave-level speculation; ``gamma`` stays the static maximum).
 
     This is the round-3/4 gap closed one level up: speculation pays most
     exactly where decode is slowest — the pp-sharded big models — and
@@ -474,7 +475,8 @@ def paged_speculative_chunk_pp(params, cfg: ModelConfig, k: int, gamma: int,
             logits = tf.unembed(pd, cfg, x2)                  # [mb, g1, V]
             toks_out, n_emit = accept_rejection_batch(
                 logits, drafts, mrows(seeds, m), mrows(steps0, m) + emitted,
-                mrows(temps, m), mrows(tks, m), mrows(tps, m), mrows(ds, m))
+                mrows(temps, m), mrows(tks, m), mrows(tps, m), mrows(ds, m),
+                widths=(mrows(gammas, m) if gammas is not None else None))
             idx = jnp.arange(g1, dtype=jnp.int32)[None, :]
             eos_m = mrows(eos_ids, m)
             emit_sl = idx < n_emit[:, None]

@@ -127,6 +127,13 @@ class BatchRequest:
     _arena_offloaded_bytes: int = 0
     _spec_acc: int = 0          # draft tokens accepted beyond 1/iteration
     _spec_rej: int = 0          # draft tokens rejected by verification
+    _spec_drafted: int = 0      # draft tokens proposed for this request
+    # wave-level speculation (DLI_SPEC_WAVE): this request's OWN
+    # drafting controller (ops/speculative.py AdaptiveSpecController) —
+    # created lazily at its first speculative chunk, surviving
+    # preemption/re-admission so a request's acceptance history follows
+    # it across slots
+    _spec_ctl: Optional[object] = None
 
     def wait(self, timeout: Optional[float] = None) -> List[int]:
         if not self.done.wait(timeout):
@@ -195,6 +202,7 @@ class ContinuousBatcher:
                  prefill_chunk: Optional[int] = 32,
                  speculative: Optional[str] = None, spec_gamma: int = 4,
                  spec_adaptive: Optional[bool] = None,
+                 spec_wave: Optional[bool] = None,
                  decode_overlap: Optional[bool] = None,
                  kv_host_mb: Optional[float] = None,
                  kv_digest_chunk: Optional[int] = None,
@@ -280,11 +288,49 @@ class ContinuousBatcher:
         if spec_adaptive is None:
             spec_adaptive = os.environ.get(
                 "DLI_SPEC_ADAPTIVE", "1") not in ("0", "false")
+        self._spec_adaptive = bool(spec_adaptive)
+        # Wave-level speculation (DLI_SPEC_WAVE, default on): ONE shared
+        # verify pass serves the whole active wave with PER-SLOT draft
+        # widths as data — each request carries its own
+        # AdaptiveSpecController (BatchRequest._spec_ctl), so a
+        # draft-hostile request converges to width 0 and rides the wave's
+        # verify pass as plain decode while its draft-friendly chunk-mates
+        # keep their speedup (no wave-wide fallback cliff). Off: the
+        # pre-wave global controller arbitrates one gamma for the wave.
+        if spec_wave is None:
+            spec_wave = os.environ.get(
+                "DLI_SPEC_WAVE", "1") not in ("0", "false")
+        self.spec_wave = bool(spec_wave) and bool(speculative)
+        self._spec_wave_dispatches = 0
+        # Cross-request arbitration state for wave mode: measured spec /
+        # plain tok/s and the probe clocks are HOST+WORKLOAD properties,
+        # not per-request ones — a fresh request's controller seeds from
+        # them (and starts in plain mode when the fleet measurements say
+        # drafting loses), so short generations inherit the fleet's
+        # verdict instead of each re-paying the discovery cost.
+        # Acceptance windows, gamma and MODE transitions stay
+        # per-request: one draft-hostile request still can't drag its
+        # chunk-mates off the speculative path.
+        self._wave_shared = {"spec_tps": None, "plain_tps": None,
+                             "since_plain_probe": 0, "since_probe": 0}
+        # register the headline gauge + wave counters at 0 up front so a
+        # scrape (and the TSDB catalog behind it) can't confuse "no
+        # decode yet" with "metric not exported" — PR 5's radix-counter
+        # rule applied to the amortization plane
+        self.metrics.gauge("decode_tokens_per_weight_pass", 0.0)
+        if self.spec_wave:
+            for name in ("spec_wave_dispatches", "spec_wave_drafted_tokens",
+                         "spec_wave_accepted_tokens",
+                         "spec_wave_plain_rides"):
+                self.metrics.inc(name, 0)
+            self.metrics.gauge("spec_wave_drafting_slots", 0.0)
+            self.metrics.gauge("spec_wave_gamma_mean", 0.0)
         self._spec_ctl = None
         # spec_gamma < 1 is an explicit zero-draft request: no controller
         # (it would clamp gamma up to 1 and start drafting), the step's
         # gamma==0 branch runs plain chunks
-        if speculative and spec_adaptive and self.spec_gamma >= 1:
+        if (speculative and spec_adaptive and self.spec_gamma >= 1
+                and not self.spec_wave):
             from distributed_llm_inferencing_tpu.ops.speculative import (
                 AdaptiveSpecController)
             self._spec_ctl = AdaptiveSpecController(self.spec_gamma)
@@ -492,6 +538,7 @@ class ContinuousBatcher:
             "spec_accepted_tokens": self._spec_accepted,
             "spec_adaptive": (self._spec_ctl.stats()
                               if self._spec_ctl is not None else None),
+            "spec_wave": self._spec_wave_stats(),
             "pool": self.pool.stats(),
             # host KV tier + routing advertisement (runtime/kvtier.py):
             # the digests ride the worker's /health body into the
@@ -501,6 +548,25 @@ class ContinuousBatcher:
                        if self.kvtier is not None else None),
             "prefix_digests": (self.kvtier.index.advertise()
                                if self.kvtier is not None else None),
+        }
+
+    def _spec_wave_stats(self) -> Optional[dict]:
+        """Aggregate view of wave-level speculation: per-request
+        controllers live on the requests (BatchRequest._spec_ctl), so
+        the batcher-level summary counts ACTIVE requests' modes/widths —
+        the live width mix a scraper sees, not lifetime history."""
+        if not self.spec_wave:
+            return None
+        ctls = [a._spec_ctl for a in self.active
+                if a is not None and a._spec_ctl is not None]
+        return {
+            "dispatches": self._spec_wave_dispatches,
+            "active_controllers": len(ctls),
+            "drafting": sum(c.mode == "spec" for c in ctls),
+            "plain": sum(c.mode == "plain" for c in ctls),
+            "fallbacks": sum(c.fallbacks for c in ctls),
+            "gamma_mean": (round(float(np.mean([c.gamma for c in ctls])),
+                                 2) if ctls else None),
         }
 
     # ---- compiled steps ----------------------------------------------
@@ -578,7 +644,10 @@ class ContinuousBatcher:
     def _spec_jit(self, k: int, g: int, r: int, mb: int, hh: int):
         """K speculative verify iterations
         (transformer.paged_speculative_chunk): up to (g+1)K tokens per
-        slot per host sync."""
+        slot per host sync. ``g`` is the compiled STATIC maximum draft
+        width; the per-slot effective widths ride the ints pack as data
+        (wave-level speculation), so one compiled program serves every
+        width mix the per-request controllers produce."""
         key = ("spec", k, g, r, mb, hh)
         fn = self._decode_fns.get(key)
         if fn is None:
@@ -589,7 +658,7 @@ class ContinuousBatcher:
                 bt = ints[:r * mb].reshape(r, mb)
                 hist = ints[r * mb:r * (mb + hh)].reshape(r, hh)
                 (tokens, cl, seeds, steps0, tks, budget, eos_ids,
-                 ds) = ints[r * (mb + hh):].reshape(8, r)
+                 ds, gammas) = ints[r * (mb + hh):].reshape(9, r)
                 temps, tps = floats
                 if pp > 1:
                     from distributed_llm_inferencing_tpu.parallel import (
@@ -597,15 +666,70 @@ class ContinuousBatcher:
                     return paged_pipeline.paged_speculative_chunk_pp(
                         p, cfg, k, g, tokens, hist, paged, bt, cl, seeds,
                         steps0, temps, tks, tps, ds.astype(bool), budget,
-                        eos_ids, dummy, mesh=mesh)
+                        eos_ids, dummy, gammas=gammas, mesh=mesh)
                 return transformer.paged_speculative_chunk(
                     p, cfg, k, g, tokens, hist, paged, bt, cl, seeds,
                     steps0, temps, tks, tps, ds.astype(bool), budget,
-                    eos_ids, dummy)
+                    eos_ids, dummy, gammas=gammas)
 
             fn = jax.jit(chunk, donate_argnums=(3,))
             self._decode_fns[key] = fn
         return fn
+
+    def warm_decode_programs(self) -> int:
+        """AOT-compile (jit.lower().compile()) every decode-chunk program
+        this scheduler can dispatch — the plain chunk per DECODE_CHUNKS
+        size and, with speculation, each distinct ceil(k/(gamma+1))
+        verify variant (plus the halved-gamma statics the wave-off global
+        controller can request) — and install the compiled executables
+        in the program cache.
+
+        A speculative trajectory's chunk-size sequence is
+        acceptance-dependent, so workload warmup cannot cover the
+        program space: a late-appearing tail variant then pays its XLA
+        compile inside a measured window (or a live request's ITL).
+        Bench legs call this after their admission warmup; serving can
+        call it at model-load time. Returns the number of programs
+        compiled. No-op for programs already warm (AOT executables feed
+        the persistent compilation cache, so repeat processes pay
+        deserialization, not compilation)."""
+        r, mb = self.slots, self.max_blocks
+        paged_sds = jax.tree_util.tree_map(
+            lambda a: (None if a is None else
+                       jax.ShapeDtypeStruct(a.shape, a.dtype)),
+            self.paged)
+        floats = jax.ShapeDtypeStruct((2, r), jnp.float32)
+        toks = jax.ShapeDtypeStruct((r,), jnp.int32)
+        n = 0
+        with self.mesh:
+            for k in self.DECODE_CHUNKS:
+                fn = self._decode_jit(k, r, mb)
+                if hasattr(fn, "lower"):   # not yet AOT-compiled
+                    ints = jax.ShapeDtypeStruct((r * (mb + 7),), jnp.int32)
+                    self._decode_fns[(k, r, mb)] = fn.lower(
+                        self.params, toks, ints, floats,
+                        paged_sds).compile()
+                    n += 1
+                if not (self.speculative and self.spec_gamma >= 1):
+                    continue
+                gs = {self.spec_gamma}
+                if not self.spec_wave:
+                    g = self.spec_gamma   # global-controller halvings
+                    while g > 2:
+                        g = max(2, g // 2)
+                        gs.add(g)
+                hh = self._hist.shape[1]
+                for g in gs:
+                    k_it = -(-k // (g + 1))
+                    sfn = self._spec_jit(k_it, g, r, mb, hh)
+                    if hasattr(sfn, "lower"):
+                        ints = jax.ShapeDtypeStruct(
+                            (r * (mb + hh + 9),), jnp.int32)
+                        self._decode_fns[("spec", k_it, g, r, mb, hh)] = \
+                            sfn.lower(self.params, ints, floats,
+                                      paged_sds).compile()
+                        n += 1
+        return n
 
     # ---- program launch (shared by the scheduler and lockstep replay) --
 
@@ -727,19 +851,24 @@ class ContinuousBatcher:
                 self._hist[r, off:off + len(row)] = row
             hist = self._hist
         r, mb = bt.shape
+        gammas = np.asarray(
+            a.get("gammas") or [int(a["gamma"])] * r, np.int32)
         ints = np.concatenate([bt.reshape(-1), hist.reshape(-1)] + [
             np.asarray(a[key], np.int32) for key in
-            ("tokens", "cl", "seeds", "steps", "tks", "budget", "eos", "ds")])
+            ("tokens", "cl", "seeds", "steps", "tks", "budget", "eos", "ds")
+        ] + [gammas])
         floats = np.stack([np.asarray(a["temps"], np.float32),
                            np.asarray(a["tps"], np.float32)])
         fn = self._spec_jit(int(a["k"]), int(a["gamma"]), r, mb,
                             hist.shape[1])
+        # draft+verify run fused in one device program; the profiler
+        # attributes the whole dispatch+sync to the verify phase (the
+        # host-side drafting state prep is tagged spec_draft by the step)
         with self.mesh:
-            with self.profiler.phase("dispatch"):
+            with self.profiler.phase("spec_verify"):
                 toks, keeps, eos_seen, self.paged = fn(
                     self.params, jnp.asarray(ints), jnp.asarray(floats),
                     self.paged)
-            with self.profiler.phase("device_wait"):
                 return jax.device_get((toks, keeps, eos_seen))
 
     def replay(self, kind: str, args: dict):
@@ -1356,6 +1485,7 @@ class ContinuousBatcher:
             "arena_offloaded_bytes": req._arena_offloaded_bytes,
             "spec_accepted_tokens": req._spec_acc,
             "spec_rejected_tokens": req._spec_rej,
+            "spec_drafted_tokens": req._spec_drafted,
             "preemptions": req._preemptions,
         }
         if gaps:
@@ -1717,12 +1847,16 @@ class ContinuousBatcher:
         cache writes never exceed the budget, and rejected scratch
         entries scatter to the dummy block.
 
-        With the adaptive controller (default) the step first asks it for
-        a gamma: 0 means this chunk runs PLAIN (fallback steady state, or
-        the stretch between probes) — on-device drafting resumes the
-        moment a probe measures the workload draft-friendly again. Every
-        chunk's (acceptance, emitted, elapsed) feeds back, with
-        fresh-compile dispatches excluded from the throughput EMAs."""
+        Wave mode (``spec_wave``, default): per-slot draft widths from
+        per-request controllers, one shared verify pass
+        (_step_spec_wave). Off: this pre-wave path — ONE global
+        controller arbitrates one gamma for the whole wave, and gamma 0
+        runs the entire chunk plain (the wave-wide cliff wave mode
+        exists to remove). Every chunk's (acceptance, emitted, elapsed)
+        feeds back, with fresh-compile dispatches excluded from the
+        throughput EMAs."""
+        if self.spec_wave:
+            return self._step_spec_wave(active, decode_args)
         ctl = self._spec_ctl
         gamma = ctl.choose() if ctl is not None else self.spec_gamma
         m = self.metrics
@@ -1775,33 +1909,12 @@ class ContinuousBatcher:
         self._apply_spec_hist(toks, keeps,
                               np.asarray(decode_args["cl"], np.int32))
 
-        emitted = 0
-        live_iters = 0       # iterations where a row was alive (emitted)
-        accepted = 0         # draft tokens kept beyond one-per-iteration
-        with self.profiler.phase("emit"):
-            for i in active:
-                req = self.active[i]
-                cnt = int(keeps[:, i].sum())
-                for t in range(keeps.shape[0]):
-                    for tok in toks[t, i, : int(keeps[t, i])]:
-                        self._emit(req, int(tok))
-                # speedup accounting: tokens beyond one-per-iteration
-                live = int((keeps[:, i] > 0).sum())
-                self._spec_accepted += cnt - live
-                emitted += cnt
-                live_iters += live
-                accepted += cnt - live
-                req._weight_passes += k_it
-                req._spec_acc += cnt - live
-                req._spec_rej += max(0, gamma * live - (cnt - live))
-                self.context_lens[i] += cnt
-                # a slot may legitimately emit fewer than its budget when
-                # every draft missed (1 token/iteration) — only the
-                # device's cumulative eos flag or an exhausted budget
-                # finishes it
-                if bool(eos_seen[-1, i]) \
-                        or len(req.tokens) >= req.max_new_tokens:
-                    self._finish_slot(i)
+        per = self._emit_spec_outputs(
+            active, toks, keeps, eos_seen, k_it,
+            np.full((self.slots,), gamma, np.int32))
+        emitted = sum(cnt for (_, cnt, _, _) in per.values())
+        live_iters = sum(live for (_, _, live, _) in per.values())
+        accepted = emitted - live_iters
         # amortization: a verify iteration streams the weights once
         # however wide the draft is — that width is the whole speedup
         m.gauge("decode_tokens_per_weight_pass",
@@ -1815,6 +1928,201 @@ class ContinuousBatcher:
                        compiled=compiled)
             if ctl.fallbacks:
                 m.gauge("spec_fallbacks", float(ctl.fallbacks))
+        return len([a for a in self.active if a is not None])
+
+    def _emit_spec_outputs(self, active, toks, keeps, eos_seen,
+                           k_it: int, gammas) -> dict:
+        """Shared emit/accounting epilogue for [K, R, G+1]-shaped
+        speculative outputs — the single definition both arbitration
+        modes use (wave-off passes a uniform width vector), so the most
+        correctness-sensitive bookkeeping in the batcher cannot drift
+        between DLI_SPEC_WAVE settings. Per slot: emit the kept tokens,
+        advance context/ledger counters, finish on the device's
+        cumulative eos flag or an exhausted budget (a slot may
+        legitimately emit fewer than its budget when every draft missed
+        — 1 token/iteration). Returns {slot: (req, cnt, live, drafted)}
+        for the callers' controller feedback."""
+        out = {}
+        with self.profiler.phase("emit"):
+            for i in active:
+                req = self.active[i]
+                g_i = int(gammas[i])
+                cnt = int(keeps[:, i].sum())
+                for t in range(keeps.shape[0]):
+                    for tok in toks[t, i, : int(keeps[t, i])]:
+                        self._emit(req, int(tok))
+                # speedup accounting: tokens beyond one-per-iteration
+                live = int((keeps[:, i] > 0).sum())
+                acc_i = cnt - live
+                drafted_i = g_i * live
+                self._spec_accepted += acc_i
+                req._weight_passes += k_it
+                req._spec_acc += acc_i
+                req._spec_rej += max(0, drafted_i - acc_i)
+                req._spec_drafted += drafted_i
+                self.context_lens[i] += cnt
+                out[i] = (req, cnt, live, drafted_i)
+                if bool(eos_seen[-1, i]) \
+                        or len(req.tokens) >= req.max_new_tokens:
+                    self._finish_slot(i)
+        return out
+
+    def _seed_wave_ctl(self, ctl):
+        """Seed a fresh per-request controller from the batcher's shared
+        arbitration state: the throughput EMAs and probe clocks carry
+        over (they measure the host/workload, not the request), and when
+        the fleet measurements already say drafting loses — the same
+        hysteresis rule the controller applies itself — the request
+        starts in plain mode instead of re-discovering the inversion
+        over its own (possibly whole) lifetime. Probes keep both arms
+        measured at the fleet cadence, so a workload shift flips the
+        verdict back within probe_every chunks."""
+        sh = self._wave_shared
+        ctl.spec_tps = sh["spec_tps"]
+        ctl.plain_tps = sh["plain_tps"]
+        ctl._since_plain_probe = sh["since_plain_probe"]
+        ctl._since_probe = sh["since_probe"]
+        if (ctl.spec_tps is not None and ctl.plain_tps is not None
+                and ctl.spec_tps < ctl.plain_tps * ctl.hysteresis):
+            ctl.mode = "plain"
+        return ctl
+
+    def _sync_wave_shared(self, ctl):
+        """Write one controller's arbitration state back to the shared
+        store (last writer wins: active controllers tick in lockstep, so
+        any of them is a good fleet clock)."""
+        sh = self._wave_shared
+        sh["spec_tps"] = ctl.spec_tps
+        sh["plain_tps"] = ctl.plain_tps
+        sh["since_plain_probe"] = ctl._since_plain_probe
+        sh["since_probe"] = ctl._since_probe
+
+    def _step_spec_wave(self, active, decode_args: dict) -> int:
+        """Wave-level batched speculation: ONE fused draft+verify program
+        serves the whole active wave, with per-slot draft widths riding
+        as data (transformer.paged_speculative_chunk ``gammas``).
+
+        Each active request consults its OWN AdaptiveSpecController for
+        this chunk's width: 0 means the slot rides the shared verify
+        pass as plain decode (one exact token per iteration — including
+        its plain-arm probes, which measure what riding actually costs
+        it), so one draft-hostile request never drags its chunk-mates
+        off the speculative path. The compiled program's gamma stays the
+        configured static maximum — width mixes change DATA, never the
+        compile key. Only when EVERY slot chooses 0 does the step run a
+        true plain chunk (cheaper than a degenerate all-width-0 verify).
+
+        Greedy rows are bitwise identical to plain decode at any width
+        assignment (argmax acceptance); sampled rows keep the exact
+        target distribution per position (ops/speculative.py
+        accept_rejection_batch position-keyed PRNG), and the lockstep
+        broadcast carries the widths in the args, so followers replay
+        the identical program."""
+        from distributed_llm_inferencing_tpu.ops.speculative import (
+            AdaptiveSpecController)
+        m = self.metrics
+        g_max = self.spec_gamma
+        with self.profiler.phase("spec_draft"):
+            gammas = np.zeros((self.slots,), np.int32)
+            for i in active:
+                req = self.active[i]
+                if self._spec_adaptive and g_max >= 1:
+                    if req._spec_ctl is None:
+                        req._spec_ctl = self._seed_wave_ctl(
+                            AdaptiveSpecController(g_max))
+                    gammas[i] = req._spec_ctl.choose()
+                else:
+                    gammas[i] = max(0, g_max)
+        drafting = [i for i in active if gammas[i] > 0]
+        riding = [i for i in active if gammas[i] == 0]
+        m.gauge("spec_wave_drafting_slots", float(len(drafting)))
+        m.gauge("spec_wave_gamma_mean",
+                float(np.mean([gammas[i] for i in active])))
+        m.gauge("spec_mode", 1.0 if drafting else 0.0)
+
+        if not drafting:
+            # every controller (or an explicit zero-draft spec_gamma)
+            # says plain this chunk: run a true plain program and feed
+            # each request's controller its own slice of the measurement
+            k = int(decode_args["k"])
+            compiled = (k, self.slots,
+                        self.max_blocks) not in self._decode_fns
+            reqs = {i: self.active[i] for i in active}
+            before = {i: len(r.tokens) for i, r in reqs.items()}
+            w0 = time.time()
+            self._dispatch_plain_chunk(active, decode_args)
+            dt = time.time() - w0
+            for i, req in reqs.items():
+                if req._spec_ctl is not None:
+                    req._spec_ctl.record(
+                        "plain", emitted=len(req.tokens) - before[i],
+                        elapsed_s=dt, compiled=compiled)
+                    self._sync_wave_shared(req._spec_ctl)
+            return len([a for a in self.active if a is not None])
+
+        g1 = g_max + 1
+        k_it = -(-int(decode_args["k"]) // g1)
+        args = dict(decode_args, k=k_it, gamma=g_max,
+                    gammas=gammas.tolist())
+        spec_key = ("spec", k_it, g_max, self.slots, self.max_blocks,
+                    self._hist.shape[1])
+        compiled = spec_key not in self._decode_fns
+        w0 = time.time()
+        if self.program_hook is not None:
+            # lockstep: widths are scheduler decisions, so they ride the
+            # broadcast args; history still ships as per-slot deltas
+            with self.profiler.phase("spec_draft"):
+                args["hist_delta"] = self._hist_deltas()
+            local = dict(args, hist=self._hist)
+            toks, keeps, eos_seen = self.program_hook(
+                "spec_decode", args, lambda: self._run_spec_decode(local))
+        else:
+            args["hist"] = self._hist
+            toks, keeps, eos_seen = self._run_spec_decode(args)
+        self._step_count += 1
+        self._spec_wave_dispatches += 1
+        w1 = time.time()
+        m.inc("spec_wave_dispatches")
+        m.observe("batcher_decode_chunk", w1 - w0)
+        trace.get_tracer().record(
+            "batcher.spec_wave_chunk", w0, w1,
+            attrs={"k": k_it, "gamma_max": g_max, "slots": len(active),
+                   "drafting": len(drafting), "riding": len(riding)})
+        self._apply_spec_hist(toks, keeps,
+                              np.asarray(decode_args["cl"], np.int32))
+
+        per = self._emit_spec_outputs(active, toks, keeps, eos_seen,
+                                      k_it, gammas)
+        emitted = sum(cnt for (_, cnt, _, _) in per.values())
+        drafted_total = sum(d for (_, _, _, d) in per.values())
+        accepted_total = emitted - sum(
+            live for (_, _, live, _) in per.values())
+        dt = w1 - w0
+        for i, (req, cnt, live, drafted_i) in per.items():
+            if req._spec_ctl is None:
+                continue
+            if int(gammas[i]) > 0:
+                req._spec_ctl.record("spec", emitted=cnt, elapsed_s=dt,
+                                     drafted=drafted_i,
+                                     accepted=cnt - live,
+                                     compiled=compiled)
+            else:
+                req._spec_ctl.record("plain", emitted=cnt, elapsed_s=dt,
+                                     compiled=compiled)
+            self._sync_wave_shared(req._spec_ctl)
+        # THE headline metric: emitted tokens per weight-streaming pass.
+        # A verify iteration streams the weights once however wide the
+        # per-slot drafts are — wave speculation exists to push this
+        # past plain batching's 1.0-per-live-slot.
+        m.gauge("decode_tokens_per_weight_pass",
+                emitted / k_it if k_it else 0.0)
+        m.inc("batcher_weight_passes", k_it)
+        m.inc("batcher_tokens_emitted", emitted)
+        m.inc("spec_wave_drafted_tokens", drafted_total)
+        m.inc("spec_wave_accepted_tokens", accepted_total)
+        m.inc("spec_wave_plain_rides", len(riding))
+        if drafted_total:
+            m.gauge("spec_acceptance_rate", accepted_total / drafted_total)
         return len([a for a in self.active if a is not None])
 
     # ---- background loop ----------------------------------------------

@@ -191,6 +191,8 @@ refresh(); setInterval(refresh, 10000);  // 10s, like reference dashboard.html:1
 // ---- telemetry charts: live sparklines off the master TSDB ----------
 const TS_METRICS = [
   ['tokens_generated', 'tok/s (rate, per node)'],
+  ['decode_tokens_per_weight_pass', 'tokens / weight pass (per node)'],
+  ['spec_wave_accepted_tokens', 'spec accepted tok/s (rate, per node)'],
   ['batcher_queue_depth', 'queue depth (per node)'],
   ['batcher_free_kv_blocks', 'free KV blocks (per node)'],
   ['prefix_hit_ratio', 'prefix-cache hit ratio'],
@@ -236,7 +238,7 @@ async function refreshTelemetry() {{
     const t = slo.targets || {{}};
     document.getElementById('slo-targets').textContent =
       `${{t.ttft_ms ?? '–'}} / ${{t.itl_p95_ms ?? '–'}}`;
-    // all six series fetched in parallel: a refresh costs one RTT, not
+    // all series fetched in parallel: a refresh costs one RTT, not
     // sum-of-latencies, and one slow endpoint can't stall the rest
     const results = await Promise.all(TS_METRICS.map(([m]) =>
       fetch('/api/timeseries?metric=' + encodeURIComponent(m))

@@ -51,9 +51,14 @@ from collections import deque
 from typing import Dict, List, Optional
 
 # canonical in-step phase order (chrome export lays phases out in this
-# order inside each sampled step; unknown phases sort after these)
-PHASE_ORDER = ("admit", "host_prep", "dispatch", "device_wait", "emit",
-               "bookkeeping", "other")
+# order inside each sampled step; unknown phases sort after these).
+# spec_draft = host-side drafting state prep (width selection, history
+# deltas); spec_verify = the fused draft+verify device program incl.
+# its sync — together they attribute speculation wall time in
+# /api/profile separately from plain-chunk dispatch/device_wait.
+PHASE_ORDER = ("admit", "host_prep", "spec_draft", "dispatch",
+               "spec_verify", "device_wait", "emit", "bookkeeping",
+               "other")
 
 DEFAULT_CAPACITY = 2048
 

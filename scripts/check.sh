@@ -29,6 +29,20 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu \
     python -m pytest tests/test_gemv_threads.py tests/test_adaptive_spec.py \
     -q -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
 
+echo "== wave speculation + pallas kernel parity + decode-speed smoke =="
+# Wave-level batched speculation (per-slot draft widths, per-request
+# controllers — docs/serving.md "Wave-level speculation") and the
+# interpret-mode differential suite pinning every pallas kernel — incl.
+# the fused dequant-GEMV->RoPE->paged-attention decode step behind
+# DLI_FUSED_DECODE — against its XLA oracle; the smoke gates the
+# per-slot tokens-per-weight-pass amortization and the single-stream
+# spec-vs-plain regression (BENCH_r05's inversion must stay gone)
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_spec_wave.py tests/test_pallas_parity.py \
+    -q -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python bench.py --scenario decode_speed --smoke || exit 1
+
 echo "== control-plane suite + saturation smoke (batched dispatch) =="
 # Multiplexed batched dispatch, pooled RPC, queue-aware scheduling
 # (docs/serving.md "Control plane"); the smoke drives a live
@@ -83,6 +97,8 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     --ignore=tests/test_chaos.py --ignore=tests/test_node_lifecycle.py \
     --ignore=tests/test_gemv_threads.py \
     --ignore=tests/test_adaptive_spec.py \
+    --ignore=tests/test_spec_wave.py \
+    --ignore=tests/test_pallas_parity.py \
     --ignore=tests/test_dispatch_batch.py \
     --ignore=tests/test_kvtier.py \
     --ignore=tests/test_tsdb.py \

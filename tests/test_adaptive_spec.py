@@ -171,9 +171,13 @@ def _drain(b, reqs, limit=600):
 
 
 def _spec_batcher():
+    # spec_wave=False: these suites pin the pre-wave GLOBAL-controller
+    # arbitration (one gamma per wave, whole-wave plain fallback), which
+    # stays supported behind DLI_SPEC_WAVE=0; the wave-mode per-request
+    # controllers have their own suite (tests/test_spec_wave.py)
     b = ContinuousBatcher(CFG, PARAMS, num_blocks=256, block_size=8,
                           slots=4, max_seq=160, speculative="ngram",
-                          spec_gamma=3)
+                          spec_gamma=3, spec_wave=False)
     b.DECODE_CHUNKS = (4, 2, 1)   # many small chunks -> many decisions
     return b
 
@@ -246,7 +250,7 @@ def test_lockstep_plain_chunks_keep_follower_history_in_sync():
     import json
     mk = lambda: ContinuousBatcher(  # noqa: E731
         CFG, PARAMS, num_blocks=64, block_size=8, slots=2, max_seq=96,
-        seed=0, speculative="ngram", spec_gamma=3)
+        seed=0, speculative="ngram", spec_gamma=3, spec_wave=False)
     leader, follower = mk(), mk()
     # force the fallback steady state from the start: every chunk until
     # the first probe runs PLAIN, including the one right after admission
