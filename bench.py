@@ -755,6 +755,409 @@ def _prefix_cache_scenario(argv, opt, smoke):
     return 0
 
 
+_DISAGG_MODEL = "tiny-llama-long"     # 1k-context tiny llama (registry)
+
+
+def _disagg_prompt_long(i):
+    """~770 byte-tokens (96 full 8-token blocks), unique per request —
+    shared prefixes would let the radix/affinity tiers hide exactly the
+    prefill interference this scenario measures. At this length a
+    prefill program costs tens of decode steps of compute, so colocated
+    prefill visibly stalls co-resident decode streams."""
+    return f"<L{i:03d}>" + \
+        "The quick brown fox jumps over the lazy dog. " * 17
+
+
+def _disagg_prompt_short(i):
+    return f"<s{i:03d}> please continue the story"
+
+
+def _disagg_workers(roles):
+    """In-proc batched workers for the disaggregation scenario, one per
+    role. Warm compiles the long-admission, short-admission, and decode
+    shapes the timed run dispatches; a (prefill, decode) pair also warms
+    the export -> /kv_fetch -> restore path end to end."""
+    import requests as _rq
+    from distributed_llm_inferencing_tpu.runtime.worker import WorkerAgent
+
+    workers = []
+    for i, role in enumerate(roles):
+        agent = WorkerAgent(role=role)
+        srv = agent.serve("127.0.0.1", 0, background=True)
+        wport = srv.server_address[1]
+        r = _rq.post(f"http://127.0.0.1:{wport}/load_model", json={
+            "model_name": _DISAGG_MODEL, "allow_random_init": True,
+            "dtype": "float32", "serving": "batched", "slots": 2,
+            "kv_blocks": 1280, "kv_block_size": 8, "max_seq": 1024,
+            # both legs run UNCHUNKED prefill: chunked prefill is the
+            # orthogonal interference mitigation (it bounds a stall at
+            # the cost of prefill efficiency); the A/B isolates what
+            # DISAGGREGATION removes — on the decode pool a transferred
+            # prompt's admission is a block scatter plus a tail-only
+            # prefill no matter how long the prompt is
+            "prefill_chunk": 0,
+            # latency-tier decode: 8-token chunk cap so inter-token gaps
+            # track steps — a 64-token mega-chunk would deliver a whole
+            # short request as one burst and hide every stall from the
+            # ITL percentiles (same cap both legs)
+            "decode_chunk_cap": 8}, timeout=600)
+        assert r.status_code == 200, r.text
+        for prompt, mx in ((_disagg_prompt_long(900 + i), 1),
+                           (_disagg_prompt_short(900 + i), 24)):
+            rr = _rq.post(f"http://127.0.0.1:{wport}/inference", json={
+                "model_name": _DISAGG_MODEL, "prompt": prompt,
+                "max_new_tokens": mx, "sampling": {"do_sample": False}},
+                timeout=600)
+            assert rr.status_code == 200, rr.text
+        workers.append((agent, wport))
+    if "prefill" in roles and "decode" in roles:
+        pport = workers[roles.index("prefill")][1]
+        dport = workers[roles.index("decode")][1]
+        prompt = _disagg_prompt_long(990)
+        rr = _rq.post(f"http://127.0.0.1:{pport}/inference", json={
+            "model_name": _DISAGG_MODEL, "prompt": prompt,
+            "max_new_tokens": 1, "kv_export": True,
+            "sampling": {"do_sample": False}}, timeout=600)
+        assert rr.status_code == 200, rr.text
+        rr = _rq.post(f"http://127.0.0.1:{dport}/inference", json={
+            "model_name": _DISAGG_MODEL, "prompt": prompt,
+            "max_new_tokens": 1,
+            "kv_source": {"url": f"http://127.0.0.1:{pport}",
+                          "model": _DISAGG_MODEL},
+            "sampling": {"do_sample": False}}, timeout=600)
+        assert rr.status_code == 200, rr.text
+    return workers
+
+
+def _pct(vals, q):
+    if not vals:
+        return None
+    vals = sorted(vals)
+    return round(vals[min(len(vals) - 1, int(len(vals) * q))], 1)
+
+
+def bench_disagg(n_long=16, n_short=24, long_clients=4, short_clients=2,
+                 disagg=True):
+    """Long-prompt/short-decode interference through a live master
+    (FlowKV's disaggregation workload). Two closed-loop client pools:
+    ``long_clients`` keep unique ~114-token prefills in flight on both
+    legs (the background pressure), while ``short_clients`` stream
+    decode-heavy requests at a modest rate and MEASURE — worker-side
+    TTFT (queue+prefill ms from the cost ledger) and decode ITL p95.
+    The short pool is deliberately far below saturation: the scenario
+    measures the interference a co-resident prefill inflicts on a
+    decode stream, not raw fleet capacity (on this CPU box a tiny
+    model's capacity story favors whichever leg has more decode slots;
+    the accelerator-relevant signal is the stall a prefill program puts
+    into a decode stream's token gaps, which disaggregation removes).
+    ``disagg`` toggles the fleet's role split — (prefill, decode) pools
+    with cross-node KV transfer vs the colocated (mixed, mixed)
+    baseline."""
+    import threading as _th
+    import requests as _rq
+    from distributed_llm_inferencing_tpu.runtime.master import Master
+
+    roles = ("prefill", "decode") if disagg else ("mixed", "mixed")
+    workers = _disagg_workers(roles)
+    m = Master(":memory:", health_interval=1.0, disagg_min_prompt=64)
+    msrv = m.service.serve("127.0.0.1", 0, background=True)
+    base = f"http://127.0.0.1:{msrv.server_address[1]}"
+    try:
+        for i, (_, wport) in enumerate(workers):
+            r = _rq.post(f"{base}/api/nodes/add", json={
+                "name": f"w{i}", "host": "127.0.0.1",
+                "port": wport}).json()
+            assert r["status"] == "success", r
+        m.start_background()
+        time.sleep(1.2)   # one health sweep: roles + digests are fresh
+        done, failed, lock = [], [], _th.Lock()
+        short_next = [0]
+
+        def run_one(sess, kind, i):
+            body = {"model_name": _DISAGG_MODEL,
+                    "sampling": {"do_sample": False,
+                                 "allow_random_init": True}}
+            if kind == "long":
+                # prefill-dominated: one sampled token, all prompt — the
+                # canonical long-prompt ingest (summarization/RAG) shape
+                body.update(prompt=_disagg_prompt_long(i),
+                            max_new_tokens=1)
+            else:
+                body.update(prompt=_disagg_prompt_short(i),
+                            max_new_tokens=24)
+            rid = sess.post(f"{base}/api/inference/submit",
+                            json=body).json()["request_id"]
+            poll = 0.02
+            while True:
+                st = sess.get(f"{base}/api/inference/status/{rid}"
+                              ).json()["request"]
+                if st["status"] in ("completed", "failed"):
+                    st["_kind"] = kind
+                    with lock:
+                        (done if st["status"] == "completed"
+                         else failed).append(st)
+                    return
+                time.sleep(poll)
+                poll = min(0.2, poll * 1.5)
+
+        # Arrival shapes match the phenomenon under test. Long prompts
+        # arrive in synchronized BURSTS of ``long_clients`` (batch
+        # ingest / RAG pipelines are bursty): during a burst every
+        # colocated node is prefilling at once, so the queue-aware
+        # scheduler has no idle node to dodge to — which is exactly the
+        # regime FlowKV disaggregates away. The short stream is paced
+        # (closed loop + think time) below saturation: its TTFT/ITL
+        # then measure collision probability with prefill work, not
+        # queue-drain luck.
+        def long_pump():
+            i = 0
+            while i < n_long:
+                burst = min(long_clients, n_long - i)
+                ts = [_th.Thread(target=run_one,
+                                 args=(_rq.Session(), "long", i + j))
+                      for j in range(burst)]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join(timeout=600)
+                i += burst
+                time.sleep(0.25)
+
+        def short_client():
+            sess = _rq.Session()
+            while True:
+                with lock:
+                    if short_next[0] >= n_short:
+                        return
+                    i = short_next[0]
+                    short_next[0] += 1
+                run_one(sess, "short", i)
+                time.sleep(0.12)
+
+        t0 = time.time()
+        threads = ([_th.Thread(target=long_pump)]
+                   + [_th.Thread(target=short_client)
+                      for _ in range(short_clients)])
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        wall = time.time() - t0
+        short_ttft, short_itl, long_e2e = [], [], []
+        for st in done:
+            cost = st.get("cost")
+            if isinstance(cost, str):
+                try:
+                    cost = json.loads(cost)
+                except ValueError:
+                    cost = None
+            if st["_kind"] == "long":
+                if st.get("completed_at") and st.get("created_at"):
+                    long_e2e.append(
+                        (st["completed_at"] - st["created_at"]) * 1e3)
+                continue
+            if not cost:
+                continue
+            short_ttft.append(cost["queue_ms"] + cost["prefill_ms"])
+            if cost.get("itl_p95_ms") is not None:
+                short_itl.append(cost["itl_p95_ms"])
+        wc = {}
+        for agent, _ in workers:
+            for k, v in agent.metrics.snapshot()["counters"].items():
+                wc[k] = wc.get(k, 0.0) + v
+        mc = m.metrics.snapshot()["counters"]
+        n = n_long + n_short
+        return {
+            "mode": "disagg" if disagg else "colocated",
+            "requests": n, "long": n_long, "short": n_short,
+            "completed": len(done), "failed": len(failed),
+            "wall_s": round(wall, 2),
+            "ttft_ms_p50": _pct(short_ttft, 0.5),
+            "ttft_ms_p95": _pct(short_ttft, 0.95),
+            "itl_p95_ms_p50": _pct(short_itl, 0.5),
+            "itl_p95_ms_p95": _pct(short_itl, 0.95),
+            "long_e2e_ms_p50": _pct(long_e2e, 0.5),
+            "kv_transfer_blocks": int(wc.get("kv_transfer_blocks", 0)),
+            "kv_transfer_bytes": int(wc.get("kv_transfer_bytes", 0)),
+            "kv_transfer_failures": int(
+                wc.get("kv_transfer_failures", 0)),
+            "kvtier_exported_blocks": int(
+                wc.get("kvtier_exported_blocks", 0)),
+            "disagg_transfers": int(
+                mc.get("scheduler_disagg_transfer", 0)),
+            "disagg_recomputes": int(
+                mc.get("scheduler_disagg_recompute", 0)),
+            "disagg_prefill_failed": int(
+                mc.get("disagg_prefill_failed", 0)),
+            "role_picks": {
+                "prefill": int(mc.get("scheduler_pick_role_prefill", 0)),
+                "decode": int(mc.get("scheduler_pick_role_decode", 0))},
+            "slo": _goodput(done, wall),
+        }
+    finally:
+        m.stop()
+        for agent, _ in workers:
+            agent.service.shutdown()
+
+
+def bench_disagg_probe(disagg=True, rounds=6):
+    """Controlled interference probe: what does a LONG-PROMPT ARRIVAL
+    cost a decode stream already running on the target node? Per round:
+    a probe short (64 decode tokens) streams on the target node; mid-
+    decode, a long prompt lands on that node together with a second
+    short. Measured: the in-flight short's worst inter-token gap (the
+    stall the long's admission injects into its decode) and the
+    arriving short's worker-side TTFT.
+
+    ``disagg=True`` stages the long's prefill on a prefill-role peer
+    first (kv_export — in steady state phase 1 happened earlier on the
+    prefill pool) and the arrival is the decode-role dispatch with a
+    ``kv_source`` hint: admission is a block scatter + tail-only
+    prefill. ``disagg=False`` is the colocated arrival: a cold full
+    prefill on the busy node — the fleet-busy case where queue-aware
+    routing has no idle node to dodge to. Deterministic sequencing
+    makes this the low-variance twin of the open workload's percentile
+    comparison."""
+    import threading as _th
+    import requests as _rq
+
+    roles = ("prefill", "decode") if disagg else ("mixed",)
+    workers = _disagg_workers(roles)
+    tgt = workers[-1][1]        # decode node / the colocated node
+    pport = workers[0][1]
+    try:
+        def infer(port, body):
+            body.setdefault("sampling", {"do_sample": False})
+            body["model_name"] = _DISAGG_MODEL
+            r = _rq.post(f"http://127.0.0.1:{port}/inference", json=body,
+                         timeout=600)
+            assert r.status_code == 200, r.text
+            return r.json()
+
+        stalls, ttfts, fails = [], [], [0]
+        for k in range(rounds):
+            long_p = _disagg_prompt_long(600 + k)
+            body_long = {"prompt": long_p, "max_new_tokens": 1}
+            if disagg:
+                infer(pport, {"prompt": long_p, "max_new_tokens": 1,
+                              "kv_export": True})
+                body_long["kv_source"] = {
+                    "url": f"http://127.0.0.1:{pport}",
+                    "model": _DISAGG_MODEL}
+            out = {}
+
+            def run(name, port, body):
+                try:
+                    out[name] = infer(port, body)
+                except AssertionError:
+                    fails[0] += 1
+
+            a = _th.Thread(target=run, args=("A", tgt, {
+                "prompt": _disagg_prompt_short(600 + k),
+                "max_new_tokens": 64}))
+            a.start()
+            time.sleep(0.1)         # A is mid-decode when the long lands
+            lt = _th.Thread(target=run, args=("long", tgt, body_long))
+            bt = _th.Thread(target=run, args=("B", tgt, {
+                "prompt": _disagg_prompt_short(700 + k),
+                "max_new_tokens": 8}))
+            lt.start()
+            # B arrives strictly AFTER the long's admission began — a
+            # simultaneous submit would race the FIFO queue and
+            # sometimes measure B in FRONT of the long
+            time.sleep(0.04)
+            bt.start()
+            for t in (a, lt, bt):
+                t.join(timeout=600)
+            if len(out) == 3:
+                stalls.append(out["A"]["cost"]["itl_max_ms"])
+                cb = out["B"]["cost"]
+                ttfts.append(cb["queue_ms"] + cb["prefill_ms"])
+        return {
+            "mode": "disagg" if disagg else "colocated",
+            "rounds": rounds, "failed": fails[0],
+            "probe_stall_ms_p50": _pct(stalls, 0.5),
+            "probe_short_ttft_ms_p50": _pct(ttfts, 0.5),
+        }
+    finally:
+        for agent, _ in workers:
+            agent.service.shutdown()
+
+
+def _disagg_scenario(argv, opt, smoke):
+    """--scenario disagg [--smoke|--ab]: disaggregated prefill/decode
+    pools vs the colocated baseline. The smoke gates zero failures plus
+    at least one real cross-node transfer; the A/B additionally reports
+    the short stream's TTFT p50 and decode ITL p95 improvement ratios
+    (colocated / disaggregated — above 1.0 means disaggregation wins)."""
+    if smoke:
+        n_long, n_short, lc, sc = (opt("--long", 4), opt("--short", 8),
+                                   2, 2)
+    else:
+        n_long, n_short, lc, sc = (opt("--long", 24), opt("--short", 36),
+                                   opt("--long-clients", 4),
+                                   opt("--short-clients", 2))
+    result = {"scenario": "disagg", "smoke": smoke}
+    if "--ab" in argv:
+        # the open workload (failures, transfers, tail percentiles
+        # under stochastic arrivals) plus the controlled interference
+        # probe (the low-variance measurement of what one long-prompt
+        # arrival costs a decode stream — the ratio the acceptance
+        # criteria gate on; open-workload MEDIANS at this CPU scale
+        # measure queue luck, see bench_disagg's docstring)
+        colo = bench_disagg(n_long, n_short, lc, sc, disagg=False)
+        dis = bench_disagg(n_long, n_short, lc, sc, disagg=True)
+        p_colo = bench_disagg_probe(disagg=False)
+        p_dis = bench_disagg_probe(disagg=True)
+        result.update(colocated=colo, disagg=dis,
+                      probe_colocated=p_colo, probe_disagg=p_dis)
+        if p_colo.get("probe_short_ttft_ms_p50") \
+                and p_dis.get("probe_short_ttft_ms_p50"):
+            result["ttft_p50_x"] = round(
+                p_colo["probe_short_ttft_ms_p50"]
+                / max(p_dis["probe_short_ttft_ms_p50"], 1e-3), 2)
+        if p_colo.get("probe_stall_ms_p50") \
+                and p_dis.get("probe_stall_ms_p50"):
+            result["itl_stall_x"] = round(
+                p_colo["probe_stall_ms_p50"]
+                / max(p_dis["probe_stall_ms_p50"], 1e-3), 2)
+        if colo.get("itl_p95_ms_p95") and dis.get("itl_p95_ms_p95"):
+            result["workload_itl_p95_x"] = round(
+                colo["itl_p95_ms_p95"]
+                / max(dis["itl_p95_ms_p95"], 1e-3), 2)
+        ok = (colo.get("failed") == 0 and dis.get("failed") == 0
+              and p_colo.get("failed") == 0 and p_dis.get("failed") == 0
+              and dis.get("kv_transfer_blocks", 0) >= 1
+              and result.get("ttft_p50_x", 0) > 1.0
+              and result.get("itl_stall_x", 0) > 1.0)
+        print(json.dumps(result))
+        if not ok:
+            print("disagg A/B gate FAILED", file=sys.stderr)
+            return 1
+        print(f"disagg A/B ok: arriving-short TTFT p50 "
+              f"{result['ttft_p50_x']}x, in-flight decode stall "
+              f"{result['itl_stall_x']}x, workload ITL tail "
+              f"{result.get('workload_itl_p95_x')}x, 0 failures both "
+              f"legs", file=sys.stderr)
+        return 0
+    result.update(bench_disagg(n_long, n_short, lc, sc, disagg=True))
+    print(json.dumps(result))
+    if smoke:
+        run = result
+        n = n_long + n_short
+        ok = (run.get("completed") == n and run.get("failed") == 0
+              and run.get("kv_transfer_blocks", 0) >= 1
+              and run.get("disagg_transfers", 0) >= 1)
+        if not ok:
+            print("disagg smoke FAILED", file=sys.stderr)
+            return 1
+        print(f"disagg smoke ok: {run['kv_transfer_blocks']} blocks "
+              f"({run['kv_transfer_bytes']} B) transferred across "
+              f"{run['disagg_transfers']} disaggregated dispatches, "
+              f"0 failures", file=sys.stderr)
+    return 0
+
+
 def bench_decode_speed_leg(model, n_requests, new_tokens, prompt_len,
                            wave_on, repeats=2):
     """One decode-speed leg through the in-proc continuous batcher on a
@@ -868,7 +1271,7 @@ def _decode_speed_scenario(argv, opt, smoke):
 
 
 def _scenario_main(argv):
-    """`bench.py --scenario {control_plane|prefix_cache|decode_speed}
+    """`bench.py --scenario {control_plane|prefix_cache|decode_speed|disagg}
     [--smoke|--ab] [--requests N] [--concurrency C] [--workers W]` —
     standalone scenario entry, one JSON line on stdout, nonzero rc on
     smoke/gate failure."""
@@ -895,6 +1298,16 @@ def _scenario_main(argv):
         except Exception:
             pass
         return _prefix_cache_scenario(argv, opt, "--smoke" in argv)
+    if name == "disagg":
+        # compilation cache: the two legs' fresh worker sets (and repeat
+        # CI runs) reuse compiled executables
+        try:
+            from distributed_llm_inferencing_tpu.utils.platform import (
+                enable_compilation_cache)
+            enable_compilation_cache()
+        except Exception:
+            pass
+        return _disagg_scenario(argv, opt, "--smoke" in argv)
     if name != "control_plane":
         print(json.dumps({"error": f"unknown scenario {name!r}"}))
         return 2

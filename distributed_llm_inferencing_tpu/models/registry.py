@@ -235,6 +235,16 @@ register(ModelConfig(
     activation="silu", gated_mlp=True, position_embedding="rope",
     attn_bias=False, mlp_bias=False, tie_word_embeddings=False))
 register(ModelConfig(
+    # tiny-llama with a 1k context: the disaggregation bench's workload
+    # model (bench.py --scenario disagg) — long prompts need prefill
+    # that costs real compute relative to a decode step, which the
+    # 128-token tiny-llama cannot express
+    name="tiny-llama-long", family="llama", vocab_size=256, hidden_size=64,
+    intermediate_size=128, num_layers=4, num_heads=8, num_kv_heads=4,
+    head_dim=8, max_position_embeddings=1024, norm_type="rmsnorm",
+    activation="silu", gated_mlp=True, position_embedding="rope",
+    attn_bias=False, mlp_bias=False, tie_word_embeddings=False))
+register(ModelConfig(
     name="tiny-mixtral", family="llama", vocab_size=256, hidden_size=64,
     intermediate_size=128, num_layers=2, num_heads=8, num_kv_heads=4,
     head_dim=8, max_position_embeddings=128, norm_type="rmsnorm",

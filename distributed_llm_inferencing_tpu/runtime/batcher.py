@@ -117,6 +117,15 @@ class BatchRequest:
     # prompt is mostly radix-cached): skip re-popping it — and the
     # match_prefix + alloc churn that costs — until a slot frees
     _noslot_bounce: bool = False
+    # Disaggregated prefill/decode (runtime/kvwire.py): where to pull
+    # missing prefix KV from ({"url": peer base URL, "model": name} — the
+    # master's kv_source dispatch hint), and whether to export this
+    # request's prompt KV into the host arena at finish so a decode peer
+    # can fetch it. One peer RPC per request, success or not.
+    kv_source: Optional[dict] = None
+    kv_export: bool = False
+    _peer_fetch_done: bool = False
+    _kv_transfer_bytes: int = 0
     # cost-ledger accumulators (freed with the request)
     _gaps: List[float] = dataclasses.field(default_factory=list)
     _cost_cached: int = 0       # prompt tokens served from cache tiers
@@ -200,12 +209,14 @@ class ContinuousBatcher:
                  seed: int = 0, force_python_pool: bool = False,
                  mesh_spec: Optional[MeshSpec] = None,
                  prefill_chunk: Optional[int] = 32,
+                 decode_chunk_cap: Optional[int] = None,
                  speculative: Optional[str] = None, spec_gamma: int = 4,
                  spec_adaptive: Optional[bool] = None,
                  spec_wave: Optional[bool] = None,
                  decode_overlap: Optional[bool] = None,
                  kv_host_mb: Optional[float] = None,
                  kv_digest_chunk: Optional[int] = None,
+                 kv_fetcher=None,
                  metrics: Optional[Metrics] = None):
         # shared with the worker's registry when serving (so /metrics
         # carries the scheduler's gauges/histograms); owned otherwise
@@ -254,6 +265,12 @@ class ContinuousBatcher:
         else:
             self.prefill_chunk = None
         self._chunked_admissions = 0
+        # Decode-chunk cap (latency-tier knob): bigger chunks amortize
+        # dispatch RTT, but a K-token chunk also delivers its tokens as
+        # one K-sized burst — a latency-tier model (or an ITL-measuring
+        # bench) caps the chunk so inter-token gaps track real steps.
+        self._decode_chunk_cap = (int(decode_chunk_cap)
+                                  if decode_chunk_cap else None)
         # Double-buffered decode dispatch: when the next chunk pair is
         # provably stop-check-free (no eos, no streaming callback, every
         # active budget covers BOTH chunks, nothing queued), dispatch
@@ -383,6 +400,18 @@ class ContinuousBatcher:
             if kv_host_mb and kv_host_mb > 0 else None)
         if self.kvtier is not None:
             self.pool.set_evict_hook(self._offload_evicted)
+        # Cross-node KV transfer (runtime/kvwire.py): the worker injects
+        # its shared KVFetchClient (pooled peer sessions, fault point,
+        # conn accounting in the worker registry); a standalone batcher
+        # builds its own lazily at the first kv_source admission.
+        self.kv_fetcher = kv_fetcher
+        if self.kvtier is not None:
+            # pre-register the transfer plane at 0 (PR 5 rule): the TSDB
+            # catalog and a first scrape must see the counters exist
+            for name in ("kv_transfer_blocks", "kv_transfer_bytes",
+                         "kv_transfer_ms", "kv_transfer_failures",
+                         "kvtier_exported_blocks"):
+                self.metrics.inc(name, 0)
         self._restore_fns = {}        # restore-scatter jits per row bucket
         self._last_pool_stats = {}    # radix counter -> metrics delta base
         # cost-ledger attribution: the request whose admission prep is
@@ -422,6 +451,16 @@ class ContinuousBatcher:
         # mirror was the multi-host throughput ceiling).
         self.program_hook = None
 
+    @property
+    def decode_chunks(self):
+        """DECODE_CHUNKS filtered by the instance's decode_chunk_cap —
+        a live view (tests override DECODE_CHUNKS per instance)."""
+        if self._decode_chunk_cap is None:
+            return self.DECODE_CHUNKS
+        return tuple(c for c in self.DECODE_CHUNKS
+                     if c <= self._decode_chunk_cap) \
+            or (min(self.DECODE_CHUNKS),)
+
     # ---- public API ---------------------------------------------------
 
     def _make_request(self, prompt: Sequence[int], max_new_tokens: int = 100,
@@ -429,6 +468,9 @@ class ContinuousBatcher:
                       eos_token_id: Optional[int] = None,
                       stream_cb: Optional[Callable[[int], None]] = None,
                       seed: Optional[int] = None,
+                      kv_source: Optional[dict] = None,
+                      kv_export: bool = False,
+                      kv_transfer_bytes: int = 0,
                       trace_ctx=None) -> BatchRequest:
         """Validate and build one BatchRequest WITHOUT enqueueing it —
         submit()/submit_many() construct first so a bad spec can never
@@ -442,9 +484,16 @@ class ContinuousBatcher:
                            sampling=sampling or SamplingParams(),
                            eos_token_id=eos_token_id, stream_cb=stream_cb,
                            seed=int(seed),
+                           kv_source=(kv_source if isinstance(kv_source,
+                                                              dict)
+                                      else None),
+                           kv_export=bool(kv_export),
                            # explicit ctx for callers submitting from a
                            # helper thread (SSE streams), ambient otherwise
                            trace_ctx=trace_ctx or trace.current())
+        # cost-ledger seed for a submit-time prefetch (the worker pulls
+        # the peer KV on its handler thread, then attributes here)
+        req._kv_transfer_bytes = int(kv_transfer_bytes or 0)
         if len(req.prompt) + req.max_new_tokens > self.max_seq:
             raise ValueError(
                 f"prompt ({len(req.prompt)}) + max_new_tokens "
@@ -456,9 +505,14 @@ class ContinuousBatcher:
                eos_token_id: Optional[int] = None,
                stream_cb: Optional[Callable[[int], None]] = None,
                seed: Optional[int] = None,
+               kv_source: Optional[dict] = None,
+               kv_export: bool = False,
+               kv_transfer_bytes: int = 0,
                trace_ctx=None) -> BatchRequest:
         req = self._make_request(prompt, max_new_tokens, sampling,
-                                 eos_token_id, stream_cb, seed, trace_ctx)
+                                 eos_token_id, stream_cb, seed,
+                                 kv_source, kv_export, kv_transfer_bytes,
+                                 trace_ctx)
         with self._lock:
             self.queue.append(req)
             depth = len(self.queue)
@@ -702,7 +756,7 @@ class ContinuousBatcher:
         toks = jax.ShapeDtypeStruct((r,), jnp.int32)
         n = 0
         with self.mesh:
-            for k in self.DECODE_CHUNKS:
+            for k in self.decode_chunks:
                 fn = self._decode_jit(k, r, mb)
                 if hasattr(fn, "lower"):   # not yet AOT-compiled
                     ints = jax.ShapeDtypeStruct((r * (mb + 7),), jnp.int32)
@@ -1010,10 +1064,15 @@ class ContinuousBatcher:
         live = [lf for lf in self.paged if lf is not None]
         vals = []
         for j, lf in enumerate(live):
+            # one C-level stack per leaf, not a python copy per page —
+            # this runs on the scheduler thread between decode chunks
+            stacked = np.stack([pg[j] for pg in pages], axis=1)
+            if b == nb and stacked.dtype == lf.dtype:
+                vals.append(stacked)
+                continue
             v = np.zeros((lf.shape[0], b) + tuple(lf.shape[2:]),
                          dtype=lf.dtype)
-            for i, pg in enumerate(pages):
-                v[:, i] = pg[j]
+            v[:, :nb] = stacked
             vals.append(v)
         fn = self._restore_jit(b, len(live))
         with self.mesh:
@@ -1074,6 +1133,164 @@ class ContinuousBatcher:
             attrs={"blocks": len(blocks), "tokens": len(blocks) * bs})
         return prefix_blocks + blocks, end * bs
 
+    def _get_kv_fetcher(self):
+        """The shared peer-fetch client (worker-injected), or a lazily
+        built one for standalone batchers. None only if the import
+        itself fails (no requests on the box)."""
+        if self.kv_fetcher is None:
+            try:
+                from distributed_llm_inferencing_tpu.runtime.kvwire import (
+                    KVFetchClient)
+                self.kv_fetcher = KVFetchClient(metrics=self.metrics)
+            except Exception:
+                return None
+        return self.kv_fetcher
+
+    def _fetch_into_arena(self, url, model, prompt, limit,
+                          start: int = 0) -> int:
+        """Pull the arena-missing chain digests of ``prompt``'s blocks
+        ``[start, limit)`` from the peer at ``url`` into the LOCAL host
+        arena. Fetched bytes are the peer's exact evicted/exported
+        device bytes, so a restore from them stays bitwise identical to
+        a cold prefill. Strictly opportunistic: ANY failure —
+        transport, corrupt frame, peer missing the blocks, shape drift
+        — degrades to recompute, never to a request failure. Returns
+        the bytes stored (0 on failure)."""
+        bs = self.block_size
+        digs = self.kvtier.block_digests(prompt[:limit * bs])
+        want = [d for d in digs[start:limit]
+                if not self.kvtier.arena.peek(d)]
+        if not want:
+            return 0
+        fetcher = self._get_kv_fetcher()
+        if fetcher is None:
+            return 0
+        w0 = time.time()
+        try:
+            got = fetcher.fetch(url, model, want)
+        except Exception as e:
+            self.metrics.inc("kv_transfer_failures")
+            trace.get_tracer().record(
+                "batcher.kv_fetch", w0, time.time(),
+                attrs={"peer": url, "error": str(e)[:200]})
+            return 0
+        # shape-check against the live paged leaves BEFORE the arena
+        # sees anything: a buggy/mismatched peer (different model or
+        # cache config) must degrade to recompute here, not crash the
+        # scheduler thread inside the restore scatter
+        live = [lf for lf in self.paged if lf is not None]
+        expect = [((lf.shape[0],) + tuple(lf.shape[2:]), lf.dtype)
+                  for lf in live]
+        blocks = bytes_in = 0
+        for d in want:
+            pages = got.get(d)
+            if pages is None:
+                continue           # peer didn't have it: plain recompute
+            if (len(pages) != len(expect)
+                    or any(tuple(p.shape) != shp or p.dtype != dt
+                           for p, (shp, dt) in zip(pages, expect))):
+                self.metrics.inc("kv_transfer_failures")
+                continue
+            if self.kvtier.arena.put(d, pages, count_offload=False):
+                blocks += 1
+                bytes_in += sum(p.nbytes for p in pages)
+        elapsed = time.time() - w0
+        self.metrics.inc("kv_transfer_blocks", blocks)
+        self.metrics.inc("kv_transfer_bytes", bytes_in)
+        self.metrics.inc("kv_transfer_ms", elapsed * 1e3)
+        trace.get_tracer().record(
+            "batcher.kv_fetch", w0, time.time(),
+            attrs={"peer": url, "blocks": blocks, "bytes": bytes_in})
+        return bytes_in
+
+    def prefetch_kv(self, prompt: Sequence[int], kv_source) -> int:
+        """Caller-thread transfer for a disaggregated request: pull the
+        prompt's prefix blocks from the ``kv_source`` peer into the host
+        arena BEFORE submission. The worker calls this on its HTTP
+        handler thread, so the wire transfer overlaps the decode loop —
+        admission then finds the blocks arena-resident and pays only the
+        device scatter, instead of stalling every co-resident decode
+        stream behind a blocking fetch. Returns bytes transferred (0 on
+        any failure: the request simply recomputes)."""
+        if (self.kvtier is None or self.program_hook is not None
+                or not isinstance(kv_source, dict)):
+            return 0
+        url = kv_source.get("url")
+        if not url:
+            return 0
+        prompt = list(map(int, prompt))
+        limit = (len(prompt) - 1) // self.block_size
+        if limit <= 0:
+            return 0
+        try:
+            return self._fetch_into_arena(
+                url, str(kv_source.get("model") or ""), prompt, limit)
+        except Exception:
+            self.metrics.inc("kv_transfer_failures")
+            return 0
+
+    def _restore_from_peer(self, req, prompt, n, cached):
+        """Scheduler-thread fallback of :meth:`prefetch_kv` for direct
+        batcher users (the worker prefetches at submit time instead and
+        clears ``kv_source``): pull the request's missing block digests
+        from its designated peer into the local arena, then let the
+        ordinary ``_restore_from_arena`` scatter take over. One peer
+        RPC per request."""
+        src = req.kv_source
+        if (src is None or req._peer_fetch_done or self.kvtier is None
+                or self.program_hook is not None):
+            return
+        url = src.get("url") if isinstance(src, dict) else None
+        if not url:
+            req._peer_fetch_done = True
+            return
+        bs = self.block_size
+        start = cached // bs
+        limit = (n - 1) // bs
+        if start >= limit:
+            return
+        digs = self.kvtier.block_digests(prompt[:limit * bs])
+        if all(self.kvtier.arena.peek(d) for d in digs[start:limit]):
+            return                  # nothing missing: no RPC, no flag
+        req._peer_fetch_done = True
+        req._kv_transfer_bytes += self._fetch_into_arena(
+            url, str(src.get("model") or ""), prompt, limit, start=start)
+
+    def _export_request_kv(self, req):
+        """Finish-time export for a disaggregated prefill pass
+        (``kv_export`` dispatch flag): copy the request's PROMPT blocks'
+        device KV into the host arena under their token-chain digests —
+        the blocks a decode-role peer's ``/kv_fetch`` will ask for. Runs
+        while the request still owns its blocks (before release), so the
+        device bytes are exactly the prefilled prefix. Skips blocks the
+        eviction path already offloaded."""
+        if (self.kvtier is None or self.program_hook is not None
+                or req.error or not req._blocks):
+            return
+        bs = self.block_size
+        n_full = min(len(req.prompt) // bs, len(req._blocks))
+        if n_full <= 0:
+            return
+        digs = self.kvtier.block_digests(req.prompt[:n_full * bs])
+        keep = [i for i in range(n_full)
+                if not self.kvtier.arena.peek(digs[i])]
+        if not keep:
+            return
+        w0 = time.time()
+        idx = np.asarray([req._blocks[i] for i in keep], np.int32)
+        leaves = [lf for lf in self.paged if lf is not None]
+        with self.mesh:
+            pages = jax.device_get([lf[:, idx] for lf in leaves])
+        stored = 0
+        for col, i in enumerate(keep):
+            cols = [p[:, col] for p in pages]
+            if self.kvtier.arena.put(digs[i], cols, count_offload=False):
+                stored += 1
+        self.metrics.inc("kvtier_exported_blocks", stored)
+        trace.get_tracer().record(
+            "batcher.kv_export", w0, time.time(),
+            attrs={"blocks": n_full, "stored": stored})
+
     def _gauge_stall_streak(self, req):
         """chunk_prefill_stall_streak = the WORST current streak across
         chunked-prefill requests, not the last writer's — one progressing
@@ -1125,9 +1342,14 @@ class ContinuousBatcher:
         # token's logits (a fully-cached prompt would have nothing to run).
         prefix_blocks, cached = self.pool.match_prefix(prompt[:n - 1])
         if self.kvtier is not None and self.program_hook is None:
-            # tier 2: extend the radix match from the host arena before
-            # falling back to recompute (multi-host lockstep opts out —
-            # a host-initiated scatter cannot ride the program broadcast)
+            # tier 2b: a disaggregated request pulls its missing prefix
+            # blocks from the prefill peer into the local arena first
+            # (runtime/kvwire.py; any failure degrades to recompute) ...
+            self._restore_from_peer(req, prompt, n, cached)
+            # ... then tier 2: extend the radix match from the host
+            # arena before falling back to recompute (multi-host
+            # lockstep opts out — a host-initiated scatter cannot ride
+            # the program broadcast)
             prefix_blocks, cached = self._restore_from_arena(
                 prompt, n, prefix_blocks, cached)
         tail_alloc = []
@@ -1454,6 +1676,14 @@ class ContinuousBatcher:
                 pass
 
     def _finish_req(self, req: BatchRequest):
+        if req.kv_export:
+            # disaggregated prefill pass: park the prompt's KV in the
+            # host arena (while the blocks are still owned) so the
+            # decode peer's /kv_fetch finds it
+            try:
+                self._export_request_kv(req)
+            except Exception:
+                pass   # export is best-effort; the peer recomputes
         self.pool.release(req._blocks)
         req._blocks = []
         req.finished_at = time.time()
@@ -1483,6 +1713,7 @@ class ContinuousBatcher:
             "kv_blocks_peak": req._kv_peak,
             "arena_restored_bytes": req._arena_restored_bytes,
             "arena_offloaded_bytes": req._arena_offloaded_bytes,
+            "kv_transfer_bytes": req._kv_transfer_bytes,
             "spec_accepted_tokens": req._spec_acc,
             "spec_rejected_tokens": req._spec_rej,
             "spec_drafted_tokens": req._spec_drafted,
@@ -1646,12 +1877,12 @@ class ContinuousBatcher:
             # round trip); otherwise the largest chunk some slot can fill
             max_rem = max(self.active[i].max_new_tokens
                           - len(self.active[i].tokens) for i in active)
-            up = min((c for c in self.DECODE_CHUNKS if c >= max_rem),
+            up = min((c for c in self.decode_chunks if c >= max_rem),
                      default=None)
             if up is not None and up - max_rem <= self.CHUNK_OVERSHOOT_MAX:
                 k = up
             else:
-                k = next(c for c in self.DECODE_CHUNKS if c <= max_rem)
+                k = next(c for c in self.decode_chunks if c <= max_rem)
 
             # growth blocks for every position this chunk can write
             for slot in range(self.slots):

@@ -196,6 +196,7 @@ const TS_METRICS = [
   ['batcher_queue_depth', 'queue depth (per node)'],
   ['batcher_free_kv_blocks', 'free KV blocks (per node)'],
   ['prefix_hit_ratio', 'prefix-cache hit ratio'],
+  ['kv_transfer_bytes', 'KV transfer B/s (rate, per node)'],
   ['breaker_state', 'breaker (0 closed / 1 half-open / 2 open)'],
   ['slo_attainment', 'SLO attainment (master)'],
 ];
@@ -268,8 +269,10 @@ NODES = f"""<!doctype html><html><head><title>Nodes</title>{_STYLE}
 </head><body>{_nav("/nodes")}<main>
 <h2>Worker Nodes</h2>
 <table><thead><tr><th>ID</th><th>Name</th><th>Address</th><th>Status</th>
+<th>Role</th>
 <th>Devices</th><th>CPU %</th><th>Mem %</th><th>Models</th><th>In-flight</th>
-<th>Queue</th><th>Free KV</th><th>Lat EWMA</th><th>Prefix hit</th>
+<th>Queue</th><th>Free KV</th><th>Arena</th><th>Lat EWMA</th>
+<th>Prefix hit</th>
 <th></th></tr></thead><tbody id="nodes"></tbody></table>
 <h2 style="margin-top:24px">Placement Plans</h2>
 <table><thead><tr><th>ID</th><th>Model</th><th>Mesh</th><th>Devices</th>
@@ -358,6 +361,9 @@ async function refresh() {{
     return `<tr><td>${{n.id}}</td><td>${{esc(n.name)}}</td>`+
     `<td>${{esc(n.host)}}:${{esc(n.port)}}</td>`+
     `<td><span class="pill ${{stCls}}">${{stTxt}}</span></td>`+
+    // disaggregation role (DLI_WORKER_ROLE): prefill/decode pools vs
+    // the backward-compatible mixed default
+    `<td>${{esc(n.role || 'mixed')}}</td>`+
     `<td>${{dev}}</td>`+
     `<td>${{n.resources && n.resources.cpu != null ? n.resources.cpu : ''}}</td>`+
     `<td>${{n.resources && n.resources.memory != null ? n.resources.memory : ''}}</td>`+
@@ -366,6 +372,9 @@ async function refresh() {{
     // depth, free KV blocks, and the master's completion-latency EWMA
     `<td>${{n.queue_depth ?? '–'}}</td>`+
     `<td>${{n.free_kv_blocks ?? '–'}}</td>`+
+    // host-arena occupancy: >90% triggers the prefill-pick avoidance
+    `<td>${{n.arena_occupancy != null
+        ? Math.round(n.arena_occupancy*100)+'%' : '–'}}</td>`+
     `<td>${{n.latency_ewma_ms != null ? n.latency_ewma_ms+' ms' : '–'}}</td>`+
     // prefix-cache tier outcome: the node's radix hit ratio (affinity
     // routing should drive this UP on shared-prefix traffic)

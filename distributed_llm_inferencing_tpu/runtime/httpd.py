@@ -399,6 +399,34 @@ def jsonl_stream(request_handler, events):
     raise _Streaming()
 
 
+def binary_stream(request_handler, chunks,
+                  content_type="application/octet-stream"):
+    """Write a chunked binary response from an iterator of byte chunks —
+    the KV-transfer twin of ``jsonl_stream`` (runtime/kvwire.py frames
+    ride this out of ``POST /kv_fetch``). Chunked framing delimits the
+    body, so the peer's pooled keep-alive session gets its connection
+    back when the stream ends."""
+    request_handler.send_response(200)
+    request_handler.send_header("Content-Type", content_type)
+    request_handler.send_header("Transfer-Encoding", "chunked")
+    request_handler._trace_headers()
+    request_handler.end_headers()
+    try:
+        for data in chunks:
+            if not data:
+                continue
+            request_handler.wfile.write(
+                f"{len(data):x}\r\n".encode() + data + b"\r\n")
+            request_handler.wfile.flush()
+        request_handler.wfile.write(b"0\r\n\r\n")
+        request_handler.wfile.flush()
+    except (BrokenPipeError, ConnectionResetError):
+        # the fetching peer vanished mid-transfer (its timeout fired, or
+        # a fault cut the link): it degrades to recompute on its side
+        request_handler.close_connection = True
+    raise _Streaming()
+
+
 def sse_stream(request_handler, events):
     """Write an SSE response from an iterator of dict events."""
     request_handler.send_response(200)

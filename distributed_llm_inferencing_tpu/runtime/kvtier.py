@@ -123,9 +123,15 @@ class HostKVArena:
         with self._lock:
             return digest in self._entries
 
-    def put(self, digest: str, pages: Sequence[np.ndarray]) -> bool:
+    def put(self, digest: str, pages: Sequence[np.ndarray],
+            count_offload: bool = True) -> bool:
         """Insert one block's pages; returns False when the block alone
-        exceeds the whole budget (never stored)."""
+        exceeds the whole budget (never stored). ``count_offload=False``
+        keeps inserts from the transfer paths (peer fetch, finish-time
+        export) out of the ``offloaded`` counter — that stat means
+        device-eviction offloads, and the TSDB series charting it must
+        not spike when a decode node merely pulls blocks over the
+        wire."""
         pages = tuple(np.ascontiguousarray(p) for p in pages)
         nbytes = sum(p.nbytes for p in pages)
         if nbytes > self.capacity_bytes:
@@ -136,7 +142,8 @@ class HostKVArena:
                 self._bytes -= old[1]
             self._entries[digest] = (pages, nbytes)
             self._bytes += nbytes
-            self.offloaded += 1
+            if count_offload:
+                self.offloaded += 1
             while self._bytes > self.capacity_bytes and self._entries:
                 _, (_, freed) = self._entries.popitem(last=False)
                 self._bytes -= freed
@@ -163,6 +170,19 @@ class HostKVArena:
         with self._lock:
             return digest in self._entries
 
+    def peek_pages(self, digest: str) -> Optional[tuple]:
+        """Pages for ``digest`` WITHOUT the hit/miss/restored accounting
+        ``get`` does — the ``/kv_fetch`` export path reads blocks on a
+        peer's behalf, and counting that as a local restore would make
+        the arena's own tiering stats lie. LRU order is still touched:
+        a block peers keep pulling is a block worth keeping resident."""
+        with self._lock:
+            hit = self._entries.get(digest)
+            if hit is None:
+                return None
+            self._entries.move_to_end(digest)
+            return hit[0]
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._entries)
@@ -171,6 +191,11 @@ class HostKVArena:
         with self._lock:
             return {"blocks": len(self._entries), "bytes": self._bytes,
                     "capacity_bytes": self.capacity_bytes,
+                    # occupancy fraction rides /health into the master's
+                    # runtime snapshot: the scheduler keeps prefill off
+                    # nodes whose arena would evict what a decode peer
+                    # is about to fetch (DLI_SCHED_ARENA_FULL)
+                    "occupancy": self._bytes / max(1, self.capacity_bytes),
                     "hits": self.hits, "misses": self.misses,
                     "offloaded": self.offloaded, "restored": self.restored,
                     "dropped": self.dropped}

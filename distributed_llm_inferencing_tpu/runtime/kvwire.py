@@ -1,0 +1,324 @@
+"""Cross-node KV transfer wire: frame codec + worker-side fetch client.
+
+PR 5 made the KV cache a *cluster-level* resource in name — content-
+addressed host arenas plus a digest advertisement — but a block's bytes
+still never left the node that prefilled them. This module is the wire
+that makes the cluster's KV mobile (FlowKV, arxiv 2504.03775): a
+prefill-role worker serves its arena blocks over ``POST /kv_fetch`` as a
+stream of length-prefixed binary frames, and a decode-role worker pulls
+the blocks it is missing into its own arena before admission
+(runtime/batcher.py ``_restore_from_peer``), falling through to the
+existing bitwise-identical arena restore.
+
+Wire format (one chunked ``application/octet-stream`` response)::
+
+    frame    := MAGIC(4) | hdr_len(u32 BE) | payload_len(u32 BE)
+                | hdr(JSON, hdr_len bytes) | payload(payload_len bytes)
+    hdr      := {"digest": str, "pages": [{"dtype": str, "shape": [...]},
+                 ...]}                          # one block's pages
+              | {"end": true, "served": int, "missing": [...],
+                 "truncated": int}              # terminal frame, no payload
+    payload  := concatenated C-order page bytes, in hdr order
+
+The payload is the arena entry's exact bytes — the same bytes the radix
+cache evicted on the source — so a restore from a fetched block stays
+bitwise identical to a cold prefill. Every structural surprise (bad
+magic, over-cap lengths, short read, shape/dtype drift) raises
+:class:`WireError`; the caller treats any failure as "recompute", never
+as a request failure.
+
+:class:`KVFetchClient` is the pull side: per-peer pooled keep-alive
+``requests.Session`` with ``(connect, read)`` timeout tuples, breaker-
+style session teardown on connection faults (the PR 4 ``_purge_session``
+treatment, worker-side), exact created-vs-reused connection accounting
+(``dli_worker_peer_conns_created/reused_total``), and a ``rpc:/kv_fetch``
+client-side fault point so the chaos harness can cut the transfer from
+the decode node's side of the wire.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+MAGIC = b"KVF1"
+_HDR_STRUCT = struct.Struct(">II")
+# Structural sanity caps — a corrupt length prefix must fail fast, not
+# allocate gigabytes: one header is a small JSON dict, one payload is one
+# KV block's pages (a few MB at most for any real config).
+MAX_HDR_BYTES = 1 << 16
+MAX_FRAME_PAYLOAD = 256 << 20
+# Per-fetch digest-count cap (both sides enforce it): bounds one RPC's
+# worst-case working set independently of the byte cap.
+MAX_DIGESTS = 4096
+
+
+class WireError(ValueError):
+    """Structurally invalid / truncated / corrupt KV transfer stream."""
+
+
+class KVFetchError(RuntimeError):
+    """Transfer failed at the HTTP layer (non-200, connection fault)."""
+
+
+def encode_frame(digest: str, pages: Sequence[np.ndarray]) -> bytes:
+    """One block's pages as a self-describing binary frame."""
+    pages = [np.ascontiguousarray(p) for p in pages]
+    hdr = json.dumps({
+        "digest": str(digest),
+        "pages": [{"dtype": p.dtype.str, "shape": list(p.shape)}
+                  for p in pages]}).encode()
+    payload = b"".join(p.tobytes() for p in pages)
+    return MAGIC + _HDR_STRUCT.pack(len(hdr), len(payload)) + hdr + payload
+
+
+def encode_end(served: int, missing: Sequence[str],
+               truncated: int = 0) -> bytes:
+    """Terminal frame: how the stream ended, so a short-but-clean close
+    is distinguishable from a mid-stream disconnect. The missing LIST is
+    capped (a 4096-digest fetch against a cold arena would otherwise
+    build a header past the decoder's MAX_HDR_BYTES and fail the whole
+    stream); ``missing_count`` always carries the true total."""
+    missing = list(missing)
+    hdr = json.dumps({"end": True, "served": int(served),
+                      "missing": missing[:256],
+                      "missing_count": len(missing),
+                      "truncated": int(truncated)}).encode()
+    return MAGIC + _HDR_STRUCT.pack(len(hdr), 0) + hdr
+
+
+class _StreamReader:
+    """Exact-count reads over an iterator of byte chunks."""
+
+    def __init__(self, chunks: Iterable[bytes]):
+        self._it = iter(chunks)
+        self._buf = bytearray()
+
+    def read(self, n: int) -> bytes:
+        while len(self._buf) < n:
+            try:
+                chunk = next(self._it)
+            except StopIteration:
+                raise WireError(
+                    f"stream truncated: wanted {n} bytes, "
+                    f"got {len(self._buf)}")
+            if chunk:
+                self._buf.extend(chunk)
+        out = bytes(self._buf[:n])
+        del self._buf[:n]
+        return out
+
+
+def decode_frames(chunks: Iterable[bytes],
+                  max_total_bytes: Optional[int] = None
+                  ) -> Tuple[Dict[str, List[np.ndarray]], dict]:
+    """Parse a /kv_fetch response stream into {digest: pages} plus the
+    terminal frame's header. Raises :class:`WireError` on any structural
+    problem — including a stream that ends without its terminal frame
+    (a mid-stream disconnect must not pass for a clean short answer)."""
+    rd = _StreamReader(chunks)
+    out: Dict[str, List[np.ndarray]] = {}
+    total = 0
+    while True:
+        head = rd.read(4 + _HDR_STRUCT.size)
+        if head[:4] != MAGIC:
+            raise WireError("bad frame magic (corrupt stream)")
+        hdr_len, payload_len = _HDR_STRUCT.unpack(head[4:])
+        if hdr_len > MAX_HDR_BYTES or payload_len > MAX_FRAME_PAYLOAD:
+            raise WireError("frame length prefix out of bounds")
+        try:
+            hdr = json.loads(rd.read(hdr_len))
+        except ValueError:
+            raise WireError("unparseable frame header")
+        if not isinstance(hdr, dict):
+            raise WireError("frame header is not an object")
+        if hdr.get("end"):
+            return out, hdr
+        total += payload_len
+        if max_total_bytes is not None and total > max_total_bytes:
+            raise WireError(f"stream exceeds byte cap ({max_total_bytes})")
+        payload = rd.read(payload_len)
+        digest = hdr.get("digest")
+        specs = hdr.get("pages")
+        if not isinstance(digest, str) or not isinstance(specs, list):
+            raise WireError("frame header missing digest/pages")
+        pages, off = [], 0
+        for spec in specs:
+            try:
+                dt = np.dtype(spec["dtype"])
+                shape = tuple(int(s) for s in spec["shape"])
+            except (KeyError, TypeError, ValueError):
+                raise WireError("bad page spec in frame header")
+            nbytes = dt.itemsize * int(np.prod(shape, dtype=np.int64))
+            if off + nbytes > len(payload):
+                raise WireError("frame payload shorter than page specs")
+            # read-only view into the payload bytes, NOT a copy: the
+            # fetch runs on a worker handler thread, and per-page copies
+            # are GIL time stolen from the decode loop (the arena stores
+            # the views; the payload bytes stay alive through them)
+            pages.append(np.frombuffer(
+                payload, dtype=dt, count=nbytes // dt.itemsize,
+                offset=off).reshape(shape))
+            off += nbytes
+        if off != len(payload):
+            raise WireError("frame payload longer than page specs")
+        out[digest] = pages
+
+
+class KVFetchClient:
+    """Decode-side puller: fetch arena blocks from a peer worker.
+
+    One pooled keep-alive session per peer (the PR 4 treatment applied
+    worker-side): ``(connect, read)`` timeout tuples so a black-holed
+    peer fails in seconds, session teardown on connection-level faults
+    so a restarted peer doesn't feed the next fetch a dead socket, and
+    created-vs-reused socket accounting in the worker's registry.
+    Thread-safe; shared by every batcher a worker hosts.
+    """
+
+    def __init__(self, auth_key: Optional[str] = None, faults=None,
+                 metrics=None, connect_timeout: float = 5.0,
+                 read_timeout: float = 30.0,
+                 max_mb: Optional[float] = None, pool_size: int = 2):
+        import os
+        from distributed_llm_inferencing_tpu.utils.metrics import Metrics
+        self.auth_key = auth_key
+        self.faults = faults
+        self.metrics = metrics or Metrics()
+        self.timeout = (float(connect_timeout), float(read_timeout))
+        if max_mb is None:
+            try:
+                max_mb = float(os.environ.get("DLI_KV_FETCH_MAX_MB", 256))
+            except ValueError:
+                max_mb = 256.0
+        self.max_bytes = int(max_mb * 1024 * 1024)
+        self._pool_size = max(1, int(pool_size))
+        self._sessions: Dict[str, object] = {}
+        self._lock = threading.Lock()
+        # pre-register (PR 5 rule): a scrape must be able to tell "no
+        # transfers yet" from "metric not exported"
+        self.metrics.inc("worker_peer_conns_created", 0)
+        self.metrics.inc("worker_peer_conns_reused", 0)
+
+    def _session(self, base_url: str):
+        import requests as http
+        with self._lock:
+            s = self._sessions.get(base_url)
+            if s is None:
+                s = http.Session()
+                adapter = http.adapters.HTTPAdapter(
+                    pool_connections=1, pool_maxsize=self._pool_size)
+                s.mount("http://", adapter)
+                s.mount("https://", adapter)
+                s._dli_conns_seen = 0
+                self._sessions[base_url] = s
+            return s
+
+    def purge(self, base_url: str) -> None:
+        """Drop the peer's pooled sockets after a connection-level fault
+        (the next fetch dials fresh instead of failing through a dead
+        keep-alive socket)."""
+        with self._lock:
+            s = self._sessions.pop(base_url, None)
+        if s is not None:
+            try:
+                s.close()
+            except Exception:
+                pass
+
+    def close(self) -> None:
+        with self._lock:
+            sessions, self._sessions = list(self._sessions.values()), {}
+        for s in sessions:
+            try:
+                s.close()
+            except Exception:
+                pass
+
+    def _count_conn_reuse(self, sess) -> None:
+        """Same urllib3 socket-count delta the master's RPC pool uses:
+        ``num_connections`` grows only when a real socket was dialed, so
+        no delta means this call rode a pooled connection."""
+        try:
+            pools = sess.get_adapter("http://").poolmanager.pools
+            created = sum(p.num_connections
+                          for p in list(pools._container.values()))
+        except Exception:
+            return
+        with self._lock:
+            delta = created - sess._dli_conns_seen
+            if delta > 0:
+                sess._dli_conns_seen = created
+        if delta > 0:
+            self.metrics.inc("worker_peer_conns_created", delta)
+        else:
+            self.metrics.inc("worker_peer_conns_reused")
+
+    def _rpc_fault(self, path: str) -> None:
+        """Client-side fault point ``rpc:/kv_fetch`` (utils/faults.py):
+        cut the transfer from the decode node's side without touching
+        the peer process."""
+        if self.faults is None:
+            return
+        f = self.faults.intercept(f"rpc:{path}")
+        if f is None:
+            return
+        import time as _time
+        import requests as http
+        if f.mode == "latency":
+            _time.sleep(f.delay_s)
+            return
+        if f.delay_s:
+            _time.sleep(f.delay_s)
+        if f.mode == "timeout":
+            raise http.exceptions.ReadTimeout("injected kv_fetch timeout")
+        raise http.exceptions.ConnectionError("injected kv_fetch fault")
+
+    def fetch(self, base_url: str, model: str, digests: Sequence[str]
+              ) -> Dict[str, List[np.ndarray]]:
+        """Pull ``digests``' blocks from the peer's arena. Returns only
+        the blocks the peer actually served — absent digests are the
+        caller's recompute problem, not an error. Raises
+        :class:`KVFetchError` / :class:`WireError` on transport or
+        stream corruption (the caller degrades to recompute)."""
+        import requests as http
+        base_url = base_url.rstrip("/")
+        digests = [str(d) for d in digests][:MAX_DIGESTS]
+        self._rpc_fault("/kv_fetch")
+        sess = self._session(base_url)
+        headers = ({"Authorization": f"Bearer {self.auth_key}"}
+                   if self.auth_key else {})
+        try:
+            r = sess.post(f"{base_url}/kv_fetch",
+                          json={"model_name": model, "digests": digests},
+                          headers=headers, timeout=self.timeout,
+                          stream=True)
+        except Exception:
+            self.purge(base_url)
+            raise
+        try:
+            if r.status_code != 200:
+                r.close()
+                raise KVFetchError(
+                    f"kv_fetch refused ({r.status_code}): {r.text[:200]}")
+            # no Content-Type gate: an injected corrupt fault (or a
+            # proxy error page) can answer 200 with a JSON/garbage
+            # body — parse it as a wire stream and let the magic
+            # check reject it
+            try:
+                blocks, _end = decode_frames(
+                    r.iter_content(chunk_size=1 << 18),
+                    max_total_bytes=self.max_bytes)
+            finally:
+                r.close()
+        except (http.exceptions.RequestException, OSError) as e:
+            # mid-stream disconnect/reset: the pooled socket is dead
+            self.purge(base_url)
+            raise KVFetchError(f"kv_fetch transport failed: {e}")
+        self._count_conn_reuse(sess)
+        allowed = set(digests)
+        return {d: pages for d, pages in blocks.items() if d in allowed}

@@ -91,6 +91,30 @@ SCHED_STALE_S = float(os.environ.get("DLI_SCHED_STALE_S", 30.0))
 # (w * est >= 1 token to act); 0 disables affinity entirely.
 SCHED_PREFIX_WEIGHT = float(os.environ.get("DLI_SCHED_PREFIX_WEIGHT", 1.0))
 SCHED_PREFIX_SLACK = int(os.environ.get("DLI_SCHED_PREFIX_SLACK", 2))
+# Disaggregated prefill/decode pools (FlowKV, docs/architecture.md
+# "Disaggregation"): when the fleet declares role-split workers
+# (DLI_WORKER_ROLE on the worker), a long prompt runs its prefill pass
+# on a prefill-role node (which exports the prompt's KV to its host
+# arena), then the decode request lands on a decode-role node with a
+# kv_source hint pointing back at the prefill peer — the decode node
+# pulls the prefix KV over /kv_fetch instead of recomputing it. A fleet
+# of `mixed` workers (the default) never disaggregates: fully backward
+# compatible. Knobs: DLI_DISAGG=0 kills the policy; prompts shorter
+# than DISAGG_MIN_PROMPT chars never disaggregate (short prompts are
+# cheaper to recompute than to round-trip); RECOMPUTE_FLOOR_MS is the
+# transfer-vs-recompute decision's floor — when the cost-ledger prefill
+# EWMA prices the prompt's recompute below it, recompute wins.
+DISAGG = os.environ.get("DLI_DISAGG", "1") not in ("0", "false")
+DISAGG_MIN_PROMPT = int(os.environ.get("DLI_DISAGG_MIN_PROMPT_CHARS", 256))
+DISAGG_RECOMPUTE_FLOOR_MS = float(
+    os.environ.get("DLI_DISAGG_RECOMPUTE_FLOOR_MS", 0.0))
+# Arena-pressure guard: prefill-role picks avoid nodes whose host arena
+# is fuller than this fraction — a full arena silently evicts the very
+# blocks the decode peer is about to fetch.
+SCHED_ARENA_FULL = float(os.environ.get("DLI_SCHED_ARENA_FULL", 0.9))
+# crude chars-per-token estimate for sizing a prompt the master never
+# tokenizes (same spirit as the prefix-digest byte-fraction estimates)
+_DISAGG_CHARS_PER_TOKEN = 4
 _BATCH_SIZE_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0)
 MODEL_GAUGES_MAX = 32     # per-model queue gauges (client-named) cap
 
@@ -147,6 +171,9 @@ class Master:
                  rpc_pool_size: int = RPC_POOL_SIZE,
                  prefix_weight: Optional[float] = None,
                  prefix_slack: Optional[int] = None,
+                 disagg: Optional[bool] = None,
+                 disagg_min_prompt: Optional[int] = None,
+                 disagg_recompute_floor_ms: Optional[float] = None,
                  tsdb_step_s: Optional[float] = None,
                  tsdb_window_s: Optional[float] = None):
         self._stop = threading.Event()
@@ -180,6 +207,19 @@ class Master:
                                else float(prefix_weight))
         self._prefix_slack = (SCHED_PREFIX_SLACK if prefix_slack is None
                               else int(prefix_slack))
+        # disaggregated prefill/decode policy knobs (instance-level so a
+        # bench can A/B disagg on/off against one process)
+        self._disagg = DISAGG if disagg is None else bool(disagg)
+        self._disagg_min_prompt = (DISAGG_MIN_PROMPT
+                                   if disagg_min_prompt is None
+                                   else int(disagg_min_prompt))
+        self._disagg_floor_ms = (DISAGG_RECOMPUTE_FLOOR_MS
+                                 if disagg_recompute_floor_ms is None
+                                 else float(disagg_recompute_floor_ms))
+        # per-model prefill cost EWMA (ms per uncached prompt token),
+        # learned from the cost ledger — the recompute side of the
+        # transfer-vs-recompute decision
+        self._prefill_ewma: Dict[str, float] = {}
         self._pending_models: Set[str] = set()
         # Telemetry plane (runtime/tsdb.py, docs/observability.md): a
         # bounded in-memory TSDB fed by the background scrape loop
@@ -195,6 +235,16 @@ class Master:
         if n:
             log.info("recovered %d request(s) stranded by a previous run", n)
         self.metrics = Metrics()
+        # pre-register the role/disaggregation decision counters at 0
+        # (PR 5 rule: a scrape and the TSDB catalog must see them exist
+        # before the first role-split fleet ever forms)
+        for name in ("scheduler_pick_role_prefill",
+                     "scheduler_pick_role_decode",
+                     "scheduler_pick_arena_full_avoided",
+                     "scheduler_disagg_transfer",
+                     "scheduler_disagg_recompute",
+                     "disagg_prefill_failed"):
+            self.metrics.inc(name, 0)
         trace.set_service("master")
         # Dispatch tags are the worker-side idempotency key, so they must
         # be unique across master *instances*: request ids restart at 1
@@ -467,6 +517,11 @@ class Master:
             nodes.append({
                 "id": n["id"], "name": n["name"], "host": n["host"],
                 "port": n["port"], "is_active": bool(n["is_active"]),
+                # disaggregation role (DLI_WORKER_ROLE, rides /health)
+                # and host-arena fullness — the prefill-pick guard input
+                "role": info.get("role") or "mixed",
+                "arena_occupancy": (rt.get("arena_occ")
+                                    if rt_fresh else None),
                 "breaker": n.get("breaker_state") or "closed",
                 "strikes": n["consecutive_failures"],
                 "draining": bool(n.get("draining")),
@@ -876,6 +931,14 @@ class Master:
                 miss = int(pool.get("prefix_misses") or 0)
                 if h + miss:
                     entry["hit_ratio"] = h / (h + miss)
+            # host-arena occupancy fraction (runtime/kvtier.py): the
+            # arena-pressure input to prefill-role picks — a nearly
+            # full arena would evict the blocks a decode peer is about
+            # to /kv_fetch
+            kv = sch.get("kvtier")
+            if isinstance(kv, dict) and isinstance(
+                    kv.get("occupancy"), (int, float)):
+                entry["arena_occ"] = float(kv["occupancy"])
             models[str(m.get("name") or "")] = entry
         if merge:
             prev = self._node_runtime.get(node_id)
@@ -883,14 +946,66 @@ class Master:
                 merged = dict(prev["models"])
                 merged.update(models)
                 models = merged
-        queue = free = None
+        queue = free = occ = None
         for st in models.values():
             queue = (queue or 0) + st["queue"]
             if st["free"] is not None:
                 free = st["free"] if free is None else min(free, st["free"])
+            if st.get("arena_occ") is not None:
+                occ = max(occ or 0.0, st["arena_occ"])
+        if occ is None and isinstance(
+                info.get("arena_occupancy"), (int, float)):
+            occ = float(info["arena_occupancy"])
         self._node_runtime[node_id] = {
-            "queue": queue, "free_blocks": free, "at": time.time(),
-            "models": models}
+            "queue": queue, "free_blocks": free, "arena_occ": occ,
+            "at": time.time(), "models": models}
+
+    def _node_role(self, node) -> str:
+        """The worker's declared serving role (prefill|decode|mixed),
+        memoized on the row dict like _node_models — it rides the
+        /health body into the persisted node info."""
+        cached = node.get("_role")
+        if cached is None:
+            try:
+                info = json.loads(node.get("info") or "{}")
+                cached = str(info.get("role") or "mixed")
+            except ValueError:
+                cached = "mixed"
+            node["_role"] = cached
+        return cached
+
+    @staticmethod
+    def _role_ok(node_role: str, want: str) -> bool:
+        """mixed serves everything; a strict role serves only its own
+        phase."""
+        return node_role == "mixed" or node_role == want
+
+    def _arena_occ(self, node_id: int) -> Optional[float]:
+        s = self._node_runtime.get(node_id)
+        if not s or time.time() - s["at"] > SCHED_STALE_S:
+            return None
+        return s.get("arena_occ")
+
+    def _node_can_export(self, node) -> bool:
+        """Does this worker actually have a host arena to export KV
+        into? An engine-serving or kv_host_mb=0 prefill-role node would
+        answer a kv_export pass with 200 while exporting NOTHING — the
+        decode peer then recomputes every prompt and the fleet silently
+        pays double prefill. /health reports ``arena_occupancy: null``
+        exactly in that case; prefer the fresh runtime view, fall back
+        to the registration-time info on the row (memoized)."""
+        occ = self._arena_occ(node["id"])
+        if occ is not None:
+            return True
+        cached = node.get("_can_export")
+        if cached is None:
+            try:
+                info = json.loads(node.get("info") or "{}")
+                cached = info.get("arena_occupancy") is not None
+            except ValueError:
+                cached = False
+            node["_can_export"] = cached
+        return cached
 
     def _note_latency(self, node_id: int, seconds: float):
         prev = self._node_lat_ewma.get(node_id)
@@ -984,7 +1099,8 @@ class Master:
                    reserve: bool = False,
                    prefer: Optional[int] = None,
                    nodes: Optional[list] = None,
-                   prompt: Optional[str] = None):
+                   prompt: Optional[str] = None,
+                   role: Optional[str] = None):
         """Least-loaded schedulable node, preferring ones with the model
         already loaded (reference: always .first(), views.py:389-391).
 
@@ -1015,6 +1131,29 @@ class Master:
         if nodes is None:
             nodes = self.store.list_nodes(active_only=True)
         nodes = [n for n in nodes if not n.get("draining")]
+        if role:
+            # role pools (docs/architecture.md "Disaggregation"): keep
+            # the request's phase on nodes declaring a compatible role.
+            # The sticky-retry pin survives the filter (the pinned node
+            # still holds the in-flight generation), and an empty
+            # role-compatible pool falls back to everyone — better a
+            # wrong-role node than a spurious terminal failure.
+            keep = [n for n in nodes
+                    if self._role_ok(self._node_role(n), role)
+                    or n["id"] == prefer]
+            if keep:
+                if len(keep) < len(nodes):
+                    self.metrics.inc(f"scheduler_pick_role_{role}")
+                nodes = keep
+        if role == "prefill" and len(nodes) > 1:
+            # arena-pressure guard: a >90%-full arena is about to evict
+            # the very blocks the decode peer will fetch — route the
+            # prefill elsewhere while any alternative exists
+            ok = [n for n in nodes
+                  if (self._arena_occ(n["id"]) or 0.0) <= SCHED_ARENA_FULL]
+            if ok and len(ok) < len(nodes):
+                self.metrics.inc("scheduler_pick_arena_full_avoided")
+                nodes = ok
         with self._inflight_lock:
             def probe_ok(n):
                 return ((n.get("breaker_state") or "closed") != "half_open"
@@ -1046,7 +1185,8 @@ class Master:
             r = self._worker_get(node, "/health", HEALTH_TIMEOUT)
             r.raise_for_status()
             info = r.json()
-            node.pop("_models", None)   # invalidate the pick memo
+            node.pop("_models", None)   # invalidate the pick memos
+            node.pop("_role", None)
             # refresh the shared wave-snapshot dict too: later chunks /
             # fallback singles of this wave re-read node["info"], and a
             # stale copy would pay a redundant /load_model + /health
@@ -1100,9 +1240,13 @@ class Master:
         prefer = (req.get("node_id")
                   if req.get("node_id") and req["node_id"] not in excluded
                   else None)
+        # full requests (prefill+decode on one node) count as decode
+        # traffic for role purposes: a role-split fleet keeps its strict
+        # prefill pool clear for disaggregated prefill passes, and a
+        # mixed fleet is unaffected (the filter falls through)
         node = self._pick_node(req["model_name"], exclude=excluded,
                                reserve=True, prefer=prefer, nodes=nodes,
-                               prompt=req.get("prompt"))
+                               prompt=req.get("prompt"), role="decode")
         if node is None:
             # nothing schedulable right now (all breakers open / nodes
             # draining): park instead of failing — at least a health
@@ -1134,6 +1278,11 @@ class Master:
             body["max_length"] = req["max_length"]
         else:
             body["max_new_tokens"] = req["max_new_tokens"]
+        if req.get("_kv_source"):
+            # disaggregated dispatch: tell the decode node which prefill
+            # peer holds this prompt's KV (runtime/batcher.py
+            # _restore_from_peer pulls it over /kv_fetch)
+            body["kv_source"] = req["_kv_source"]
         return body
 
     def _complete_request(self, req, node, data) -> None:
@@ -1217,6 +1366,21 @@ class Master:
                 v = cost.get(key)
                 if isinstance(v, (int, float)):
                     self.metrics.observe(f"{metric}_{mn}", v / 1e3)
+            # prefill-cost EWMA (ms per uncached prompt token): the
+            # recompute side of the disaggregation decision. Only
+            # mostly-uncached prefills teach it — a cache-hit request's
+            # prefill_ms says nothing about recompute cost.
+            pf = cost.get("prefill_ms")
+            unc = cost.get("prefill_uncached_tokens")
+            cah = cost.get("prefill_cached_tokens") or 0
+            if (isinstance(pf, (int, float)) and isinstance(unc, int)
+                    and unc > 0 and unc >= cah):
+                per_tok = pf / unc
+                model = str(req["model_name"])
+                prev = self._prefill_ewma.get(model)
+                a = self._ewma_alpha
+                self._prefill_ewma[model] = (
+                    per_tok if prev is None else a * per_tok + (1 - a) * prev)
         ok = tsdb_mod.cost_within_slo(cost, self.slo.targets)
         if ok is None and ttft_ms is not None:
             # engine-mode/legacy workers: fall back to the worker's own
@@ -1612,19 +1776,178 @@ class Master:
                         self._inflight[nid] = max(
                             0, self._inflight.get(nid, 1) - 1)
 
+    # ---- disaggregated prefill/decode --------------------------------
+
+    def _plan_disagg(self, req, nodes):
+        """FlowKV's load-aware transfer-vs-recompute decision for one
+        claimed request. Returns ``(prefill_node, decode_node)`` — BOTH
+        with an in-flight slot reserved — when the request should run
+        its prefill pass on a prefill-role node and decode elsewhere
+        with a ``kv_source`` hint; None means the plain single-node
+        path. Only first attempts disaggregate: a retry already carries
+        exclusion/pin state the two-phase flow would complicate, and
+        plain dispatch is the safe degradation everywhere."""
+        if (not self._disagg or req["attempts"] > 0
+                or req.get("excluded_nodes")):
+            return None
+        prompt = req.get("prompt") or ""
+        if not isinstance(prompt, str) \
+                or len(prompt) < self._disagg_min_prompt:
+            return None
+        # a strict prefill pool must exist — a mixed fleet (the default)
+        # never reaches the decision at all
+        if not any(self._node_role(n) == "prefill" for n in nodes
+                   if not n.get("draining")):
+            return None
+        model = req["model_name"]
+        est_tokens = max(1, len(prompt.encode("utf-8", "replace"))
+                         // _DISAGG_CHARS_PER_TOKEN)
+        # recompute side: if a decode-eligible node already advertises
+        # most of this prompt's prefix warm, affinity routing beats a
+        # transfer (the blocks are already where the decode runs) —
+        # and if the learned prefill cost prices the recompute below
+        # the decision floor, the transfer round trip isn't worth it
+        memo: Dict[int, list] = {}
+        warm = 0
+        now = time.time()
+        for n in nodes:
+            if not self._role_ok(self._node_role(n), "decode"):
+                continue
+            s = self._node_runtime.get(n["id"])
+            if not s or now - s["at"] > SCHED_STALE_S:
+                continue   # stale advertisements don't drive decisions
+            entry = (s.get("models") or {}).get(model)
+            warm = max(warm, estimate_cached_tokens(
+                prompt, (entry or {}).get("digests"), memo))
+        if warm * 2 >= est_tokens:
+            self.metrics.inc("scheduler_disagg_recompute")
+            return None
+        ewma = self._prefill_ewma.get(str(model))
+        if ewma is not None and est_tokens * ewma < self._disagg_floor_ms:
+            self.metrics.inc("scheduler_disagg_recompute")
+            return None
+        pnode = self._pick_node(model, reserve=True, nodes=nodes,
+                                role="prefill")
+        if (pnode is None or self._node_role(pnode) != "prefill"
+                or not self._node_can_export(pnode)):
+            # role fallback handed back a non-prefill node, or the
+            # prefill node has no host arena to export into: no usable
+            # prefill pool right now — release and run the plain path
+            if pnode is not None:
+                with self._inflight_lock:
+                    self._inflight[pnode["id"]] = max(
+                        0, self._inflight.get(pnode["id"], 1) - 1)
+            return None
+        dnode = self._pick_node(model, exclude={pnode["id"]},
+                                reserve=True, nodes=nodes,
+                                prompt=prompt, role="decode")
+        if dnode is None or dnode["id"] == pnode["id"]:
+            with self._inflight_lock:
+                self._inflight[pnode["id"]] = max(
+                    0, self._inflight.get(pnode["id"], 1) - 1)
+                if dnode is not None:
+                    self._inflight[dnode["id"]] = max(
+                        0, self._inflight.get(dnode["id"], 1) - 1)
+            return None
+        self.metrics.inc("scheduler_disagg_transfer")
+        return pnode, dnode
+
+    def _execute_disagg(self, req, pnode, dnode) -> bool:
+        """Two-phase disaggregated dispatch: (1) a one-token prefill
+        pass on the prefill-role node with ``kv_export`` set — its
+        sampled token is discarded, its side effect is the prompt's KV
+        parked in the node's host arena; (2) the real request on the
+        decode node with a ``kv_source`` hint pointing back at the
+        prefill peer. Phase-1 failure of ANY kind degrades to plain
+        dispatch on the decode node (recompute) — disaggregation must
+        never turn a servable request into a failure."""
+        tracer = trace.get_tracer()
+        ctx = self._trace_ctx.get(req["id"])
+        ok_prefill = False
+        t0 = time.time()
+        try:
+            try:
+                err = self._ensure_model_loaded(pnode, req["model_name"],
+                                                req["sampling"])
+                if err is None:
+                    body = self._infer_body(req)
+                    body.pop("max_length", None)
+                    body.update(max_new_tokens=1, kv_export=True,
+                                request_tag=self._tag(req["id"]) + ".p")
+                    with tracer.span("master.disagg_prefill", parent=ctx,
+                                     attrs={"req_id": req["id"],
+                                            "node_id": pnode["id"]}):
+                        r = self._worker_post(pnode, "/inference", body,
+                                              self.infer_timeout)
+                    ok_prefill = r.status_code == 200
+                    if ok_prefill:
+                        data = r.json()
+                        sch = data.get("scheduler")
+                        if isinstance(sch, dict):
+                            self._note_runtime(
+                                pnode["id"],
+                                {"loaded_models": [
+                                    {"name": req["model_name"],
+                                     "scheduler": sch}]}, merge=True)
+                        self._node_success(pnode)
+                    elif r.status_code >= 500 and r.status_code != 503:
+                        # same breaker semantics as the normal dispatch
+                        # path's 5xx: prefill-role nodes see no other
+                        # request traffic, so without this strike a
+                        # persistently erroring prefill node would never
+                        # trip its breaker. 503/408 stay strike-free —
+                        # the node is managing its own load
+                        self._node_failure(pnode)
+            except Exception as e:
+                if (isinstance(e, (http.exceptions.ConnectionError,
+                                   http.exceptions.ChunkedEncodingError))
+                        and not _is_timeout_error(e)):
+                    self._purge_session(pnode)
+                # mirror _fail_sub's breaker classes: connection faults
+                # strike; pure timeouts (slow, not dead) and
+                # _NodeUnavailable (draining / load-in-progress — the
+                # node is managing its own load) don't
+                if not (_is_timeout_error(e)
+                        or isinstance(e, _NodeUnavailable)):
+                    self._node_failure(pnode)
+                log.warning("disagg prefill for request %d failed on "
+                            "node %d: %s", req["id"], pnode["id"], e)
+        finally:
+            with self._inflight_lock:
+                self._inflight[pnode["id"]] = max(
+                    0, self._inflight.get(pnode["id"], 1) - 1)
+        if ok_prefill:
+            req["_kv_source"] = {"url": self.store.node_url(pnode),
+                                 "model": req["model_name"]}
+            self.metrics.observe("disagg_prefill_phase",
+                                 time.time() - t0)
+        else:
+            self.metrics.inc("disagg_prefill_failed")
+        # phase 2 (dnode's in-flight slot is released inside): with a
+        # kv_source hint when the prefill pass landed, plain recompute
+        # dispatch otherwise
+        return self._execute_on_node(req, dnode)
+
     def _dispatch_claimed(self, reqs) -> None:
         """One dispatcher-pipeline turn: reserve a node per claimed
         request (respecting exclusions, pins, and the half-open single-
         probe rule), group by (node, model), and send each multi-request
         group as ONE batch RPC — a single request keeps the plain
-        /inference path."""
+        /inference path. Disaggregation-eligible requests (long prompt,
+        role-split fleet, transfer beats recompute) leave the grouping
+        and run the two-phase prefill->transfer->decode flow instead."""
         self.metrics.observe("master_dispatch_batch_size", float(len(reqs)),
                              buckets=_BATCH_SIZE_BUCKETS, unit="")
         groups: Dict[tuple, list] = {}
+        disagg: list = []
         # one active-node snapshot for the whole wave: per-request picks
         # diverge on the in-memory in-flight/queue state, not the rows
         snapshot = self.store.list_nodes(active_only=True)
         for req in reqs:
+            plan = self._plan_disagg(req, snapshot)
+            if plan is not None:
+                disagg.append((req, plan[0], plan[1]))
+                continue
             node = self._reserve_node_for(req, nodes=snapshot)
             if node is None:
                 continue            # parked or terminally failed
@@ -1649,14 +1972,18 @@ class Master:
 
         items = [(node, model, rs)
                  for (nid, model, _lk), (node, rs) in groups.items()]
-        if len(items) == 1:
+        if len(items) == 1 and not disagg:
             run_group(*items[0])
             return
-        # groups target different (node, model) pairs: their RPCs must
-        # overlap, not queue behind each other on this dispatcher thread
-        # (the join keeps claim order intact across loop turns)
+        # groups target different (node, model) pairs — and each
+        # disaggregated request is its own two-RPC sequence: their RPCs
+        # must overlap, not queue behind each other on this dispatcher
+        # thread (the join keeps claim order intact across loop turns)
         threads = [threading.Thread(target=run_group, args=it, daemon=True)
                    for it in items]
+        threads += [threading.Thread(target=self._execute_disagg,
+                                     args=(req, pn, dn), daemon=True)
+                    for req, pn, dn in disagg]
         for t in threads:
             t.start()
         for t in threads:

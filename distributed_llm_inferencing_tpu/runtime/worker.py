@@ -44,6 +44,18 @@ IDEM_CACHE = int(os.environ.get("DLI_IDEM_CACHE", 256))
 # from a thread bomb into a 400.
 BATCH_RPC_MAX = int(os.environ.get("DLI_BATCH_RPC_MAX", 256))
 
+# Disaggregated serving role (FlowKV, docs/architecture.md): `prefill`
+# nodes take long-prompt prefill passes, `decode` nodes take decode
+# traffic (pulling prefix KV from prefill peers over /kv_fetch), and
+# the default `mixed` keeps the pre-disaggregation behavior — a fleet
+# that never sets the knob never changes.
+WORKER_ROLES = ("prefill", "decode", "mixed")
+
+# Byte budget for one /kv_fetch response (the size cap on the KV export
+# wire): the stream truncates at the cap and reports how many blocks
+# were cut, and the fetching peer recomputes the rest.
+KV_FETCH_MAX_MB = float(os.environ.get("DLI_KV_FETCH_MAX_MB", 256))
+
 
 class LoadedModel:
     def __init__(self, engine, tokenizer, source: str, batcher=None):
@@ -57,11 +69,17 @@ class LoadedModel:
 class WorkerAgent:
     """Holds loaded models and serves the lifecycle + inference RPC API."""
 
-    def __init__(self, auth_key: Optional[str] = None):
+    def __init__(self, auth_key: Optional[str] = None,
+                 role: Optional[str] = None):
         auth_key = auth_key if auth_key is not None else (
             os.environ.get("DLI_AUTH_KEY")
             if os.environ.get("DLI_AUTH_ENABLED", "").lower() in ("1", "true")
             else None)
+        role = (role or os.environ.get("DLI_WORKER_ROLE") or "mixed").lower()
+        if role not in WORKER_ROLES:
+            raise ValueError(f"DLI_WORKER_ROLE must be one of "
+                             f"{WORKER_ROLES}, got {role!r}")
+        self.role = role
         self.models: Dict[str, LoadedModel] = {}
         self._models_lock = threading.Lock()
         self._loading: set = set()
@@ -78,6 +96,9 @@ class WorkerAgent:
         s.add("POST", "/unload_model", self.unload_model)
         s.add("POST", "/inference", self.inference)
         s.add("POST", "/inference_batch", self.inference_batch)
+        # KV export wire (runtime/kvwire.py): stream host-arena blocks
+        # to a decode-role peer as length-prefixed binary frames
+        s.add("POST", "/kv_fetch", self.kv_fetch)
         s.add("POST", "/inference_stream", self.inference_stream)
         s.add("POST", "/cancel", self.cancel)
         s.add("POST", "/drain", self.drain)
@@ -109,6 +130,17 @@ class WorkerAgent:
         self._draining = False
         self._active = 0
         self._active_cv = threading.Condition()
+        # shared peer-fetch client for every batched model on this
+        # worker (pooled keep-alive sessions to each prefill peer, the
+        # worker's own fault injector for rpc:/kv_fetch chaos, conn
+        # accounting in this registry); lazily built — engine-only
+        # workers never pay the requests import
+        self._peer_client = None
+        self._peer_client_lock = threading.Lock()
+        # pre-register the serve-side transfer counters (PR 5 rule)
+        for name in ("kv_fetch_requests", "kv_fetch_served_blocks",
+                     "kv_fetch_served_bytes", "kv_fetch_missing_blocks"):
+            self.metrics.inc(name, 0)
 
     # ---- endpoints ---------------------------------------------------
 
@@ -145,9 +177,19 @@ class WorkerAgent:
                     loaded.append({"name": n, "source": m.source,
                                    "mesh": m.engine.mesh_spec.axis_sizes(),
                                    "max_seq": m.engine.max_seq})
+        # host-arena occupancy fraction (worst across batched models):
+        # the master's scheduler keeps prefill traffic off nodes whose
+        # arena is about to evict the blocks a decode peer needs
+        occ = None
+        for lm in loaded:
+            kv = (lm.get("scheduler") or {}).get("kvtier")
+            if isinstance(kv, dict) and kv.get("occupancy") is not None:
+                occ = max(occ or 0.0, float(kv["occupancy"]))
         return {
             "status": "draining" if self._draining else "online",
             "uptime_s": time.time() - self.started,
+            "role": self.role,
+            "arena_occupancy": occ,
             "resources": {"cpu": cpu, "memory": mem, "devices": devices,
                           "device": jax.default_backend()},
             "loaded_models": loaded,
@@ -324,6 +366,14 @@ class WorkerAgent:
                             else None),
                 kv_digest_chunk=(int(body["kv_digest_chunk"])
                                  if body.get("kv_digest_chunk") else None),
+                # latency-tier knob: cap the decode-chunk size so token
+                # gaps track real steps instead of K-sized bursts
+                decode_chunk_cap=(int(body["decode_chunk_cap"])
+                                  if body.get("decode_chunk_cap")
+                                  else None),
+                # cross-node KV transfer (runtime/kvwire.py): every
+                # batched model shares the worker's peer-fetch client
+                kv_fetcher=self.peer_client(),
                 mesh_spec=mesh, metrics=self.metrics)
             batcher.start()
             lm = LoadedModel(None, tok, source, batcher=batcher)
@@ -648,9 +698,26 @@ class WorkerAgent:
                           "sampling": sp,
                           "eos_token_id": m.tokenizer.eos_token_id,
                           "seed": sub_body.get("seed"),
+                          "kv_transfer_bytes": 0,
+                          "kv_export": bool(sub_body.get("kv_export")),
                           "trace_ctx": trace.extract(sub_body) or ctx})
             self._note_prefix(m, sub_body, prompt)
             metas.append((sub_body, tag, my_ev, t0))
+        # peer KV prefetches run CONCURRENTLY across the batch: serial
+        # blocking fetches in the loop above would let one dead peer's
+        # connect timeout delay every later sibling's submission by the
+        # full timeout each — in parallel the batch pays one timeout
+        fetch_idx = [i for i, (sub_body, *_r) in enumerate(metas)
+                     if sub_body.get("kv_source")]
+        if fetch_idx:
+            from concurrent.futures import ThreadPoolExecutor
+            with ThreadPoolExecutor(
+                    max_workers=min(8, len(fetch_idx))) as ex:
+                for i, pre in zip(fetch_idx, ex.map(
+                        lambda i: self._prefetch_kv(
+                            m, metas[i][0], specs[i]["prompt"]),
+                        fetch_idx)):
+                    specs[i]["kv_transfer_bytes"] = pre
         try:
             reqs = m.batcher.submit_many(specs) if specs else []
         except Exception as e:
@@ -706,6 +773,89 @@ class WorkerAgent:
                 self._idem_release(tag, my_ev, res)
             self._end_inference()
             emit(tag, st, pl)
+
+    def peer_client(self):
+        """The worker-wide KVFetchClient (runtime/kvwire.py), built on
+        first use and injected into every batched model's batcher."""
+        with self._peer_client_lock:
+            if self._peer_client is None:
+                from distributed_llm_inferencing_tpu.runtime.kvwire import (
+                    KVFetchClient)
+                self._peer_client = KVFetchClient(
+                    auth_key=self.service.auth_key,
+                    faults=self.service.faults, metrics=self.metrics)
+            return self._peer_client
+
+    def kv_fetch(self, body, _request=None):
+        """KV export wire (runtime/kvwire.py): given a model and a list
+        of block digests, stream the matching host-arena blocks back as
+        length-prefixed binary frames over the chunked httpd response.
+        Auth-gated like every route (fleet bearer token); size-capped at
+        DLI_KV_FETCH_MAX_MB — past the cap the stream truncates and the
+        terminal frame says so, and the peer recomputes the rest. Blocks
+        the arena no longer holds are simply reported missing: eviction
+        raced the fetch, recompute covers it."""
+        from distributed_llm_inferencing_tpu.runtime import kvwire
+        name = body.get("model_name")
+        with self._models_lock:
+            m = self.models.get(name)
+        if m is None or m.batcher is None or m.batcher.kvtier is None:
+            return 404, {"status": "error",
+                         "message": f"model {name} not serving a KV "
+                                    "arena on this worker"}
+        digests = body.get("digests")
+        if (not isinstance(digests, list) or not digests
+                or not all(isinstance(d, str) for d in digests)):
+            return 400, {"status": "error",
+                         "message": "digests: non-empty list of strings "
+                                    "required"}
+        if len(digests) > kvwire.MAX_DIGESTS:
+            return 400, {"status": "error",
+                         "message": f"at most {kvwire.MAX_DIGESTS} "
+                                    "digests per fetch"}
+        arena = m.batcher.kvtier.arena
+        cap = int(KV_FETCH_MAX_MB * 1024 * 1024)
+        self.metrics.inc("kv_fetch_requests")
+
+        def frames():
+            sent = served = truncated = 0
+            missing = []
+            for i, d in enumerate(digests):
+                pages = arena.peek_pages(d)
+                if pages is None:
+                    missing.append(d)
+                    self.metrics.inc("kv_fetch_missing_blocks")
+                    continue
+                frame = kvwire.encode_frame(d, pages)
+                if sent + len(frame) > cap:
+                    truncated = len(digests) - i
+                    break
+                sent += len(frame)
+                served += 1
+                self.metrics.inc("kv_fetch_served_blocks")
+                self.metrics.inc("kv_fetch_served_bytes", len(frame))
+                yield frame
+            yield kvwire.encode_end(served, missing, truncated)
+
+        return httpd.binary_stream(_request, frames())
+
+    def _prefetch_kv(self, m, body, prompt) -> int:
+        """Submit-time KV prefetch for a disaggregated dispatch (the
+        ``kv_source`` hint): pull the prompt's prefix blocks from the
+        prefill peer into the local arena ON THIS HANDLER THREAD — the
+        transfer overlaps the batcher's decode loop instead of stalling
+        co-resident streams at admission. Returns bytes transferred for
+        the cost ledger; the request is then submitted WITHOUT the
+        kv_source (no scheduler-thread fetch fallback: a dead peer must
+        cost this request a recompute, not stall the decode loop on a
+        connect timeout)."""
+        src = body.get("kv_source")
+        if not src or m.batcher is None:
+            return 0
+        try:
+            return m.batcher.prefetch_kv(prompt, src)
+        except Exception:
+            return 0
 
     def _note_prefix(self, m, body, prompt) -> None:
         """Feed a served prompt into the prefix-digest advertisement
@@ -798,10 +948,13 @@ class WorkerAgent:
             tag = body.get("request_tag")
             try:
                 with self.metrics.time("inference"):
+                    pre = self._prefetch_kv(m, body, prompt)
                     req = m.batcher.submit(
                         prompt, max_new_tokens=max_new, sampling=sp,
                         eos_token_id=m.tokenizer.eos_token_id,
-                        seed=body.get("seed"))
+                        seed=body.get("seed"),
+                        kv_transfer_bytes=pre,
+                        kv_export=bool(body.get("kv_export")))
                     self._note_prefix(m, body, prompt)
                     if tag:
                         with self._tagged_lock:
@@ -945,10 +1098,12 @@ class WorkerAgent:
 
                 try:
                     _, prompt, sp, max_new, _gk = self._prep_inference(body)
+                    pre = self._prefetch_kv(m, body, prompt)
                     req = m.batcher.submit(
                         prompt, max_new_tokens=max_new, sampling=sp,
                         eos_token_id=m.tokenizer.eos_token_id, stream_cb=cb,
-                        seed=body.get("seed"), trace_ctx=ctx)
+                        seed=body.get("seed"),
+                        kv_transfer_bytes=pre, trace_ctx=ctx)
                     self._note_prefix(m, body, prompt)
                     toks = req.wait(timeout=float(body.get("timeout", 300)))
                     q.put({"event": "done",

@@ -64,6 +64,17 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu \
 timeout -k 10 600 env JAX_PLATFORMS=cpu \
     python bench.py --scenario prefix_cache --smoke || exit 1
 
+echo "== disaggregated prefill/decode + KV transfer suite + smoke =="
+# Role-split pools, /kv_fetch wire, bitwise transferred-decode, chaos on
+# the transfer (docs/architecture.md "Disaggregation"); the smoke drives
+# a live master + prefill/decode worker pair and gates on zero failures
+# plus at least one real cross-node KV transfer
+timeout -k 10 600 env JAX_PLATFORMS=cpu DLI_FAULTS_ENABLE=1 \
+    python -m pytest tests/test_disagg.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python bench.py --scenario disagg --smoke || exit 1
+
 echo "== telemetry plane (TSDB + cost ledger + SLO + profiler) =="
 # Time-series retention, per-request cost ledger, SLO accounting, decode
 # profiler (docs/observability.md "Telemetry plane"); the smoke drives a
@@ -101,6 +112,7 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     --ignore=tests/test_pallas_parity.py \
     --ignore=tests/test_dispatch_batch.py \
     --ignore=tests/test_kvtier.py \
+    --ignore=tests/test_disagg.py \
     --ignore=tests/test_tsdb.py \
     2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}

@@ -258,6 +258,11 @@ def _batch_tokens(monkeypatch, fused: bool, quant=None, spec=False):
     return [r.wait() for r in reqs]
 
 
+@pytest.mark.slow   # two full batcher runs per form — the suite's most
+                    # exhaustive parametrization; check.sh's dedicated
+                    # pallas step runs it (no -m filter), and the
+                    # kernel-level fused parity grid above stays in the
+                    # bare tier-1 command's budget
 @pytest.mark.parametrize("quant", [None, "int8"])
 def test_batcher_greedy_bitwise_fused_on_off(monkeypatch, quant):
     """The acceptance bar: greedy decode through the continuous batcher
