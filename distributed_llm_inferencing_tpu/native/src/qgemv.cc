@@ -357,6 +357,23 @@ ffi::Error GemvBf16Impl(ffi::Buffer<ffi::DataType::F32> x,
 extern "C" int DliGemvGetThreads() { return RowPool::Get().Threads(); }
 extern "C" void DliGemvSetThreads(int n) { RowPool::Get().SetThreads(n); }
 
+// Direct C entries for the TSan harness (scripts/tsan_gemv_driver.py):
+// the exact GemvImpl dispatch the XLA FFI handlers run, minus the XLA
+// call frame, so ThreadSanitizer can hammer the RowPool (worker spawn,
+// runtime resize, job handoff, completion barrier) from ctypes without
+// dragging a TSan-instrumented process through a jax import (minutes
+// per import under interception). Not used on any serving path.
+extern "C" void DliGemvI8Direct(const float* x, const int8_t* wt,
+                                const float* scale, float* y, int64_t m,
+                                int64_t k, int64_t n) {
+  GemvImpl<int8_t>(m, k, n, x, wt, scale, y);
+}
+
+extern "C" void DliGemvF32Direct(const float* x, const float* wt, float* y,
+                                 int64_t m, int64_t k, int64_t n) {
+  GemvImpl<float>(m, k, n, x, wt, nullptr, y);
+}
+
 XLA_FFI_DEFINE_HANDLER_SYMBOL(
     QGemvI8, QGemvI8Impl,
     ffi::Ffi::Bind()

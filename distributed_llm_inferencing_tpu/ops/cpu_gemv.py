@@ -44,7 +44,21 @@ log = logging.getLogger("dli.cpu_gemv")
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(os.path.dirname(_HERE), "native", "src", "qgemv.cc")
 _LIB = os.path.join(os.path.dirname(_HERE), "native", "libdli_qgemv.so")
+# ThreadSanitizer build (scripts/check.sh --tsan): separate artifact so
+# the instrumented and plain builds never clobber each other's mtime
+# freshness check
+_LIB_TSAN = os.path.join(os.path.dirname(_HERE), "native",
+                         "libdli_qgemv_tsan.so")
 _TARGET = "dli_qgemv_i8"
+
+
+def tsan_requested() -> bool:
+    """``DLI_NATIVE_TSAN=1`` builds/loads the ``-fsanitize=thread -g``
+    variant of the RowPool kernel. The TSan *runtime* must be present in
+    the process (run python under ``LD_PRELOAD=libtsan.so``, as
+    ``scripts/check.sh --tsan`` does) or the dlopen fails and the whole
+    native path reports unavailable — loudly, by design."""
+    return os.environ.get("DLI_NATIVE_TSAN", "").lower() in ("1", "true")
 
 _lock = threading.Lock()
 _state = {"ready": False, "failed": False}
@@ -74,12 +88,18 @@ MAX_FAST_M = 4
 
 def _build():
     ffi = _ffi_mod()
-    if (os.path.exists(_LIB)
-            and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC)):
-        return _LIB
-    fd, tmp = tempfile.mkstemp(suffix=".so", dir=os.path.dirname(_LIB))
+    tsan = tsan_requested()
+    lib_path = _LIB_TSAN if tsan else _LIB
+    if (os.path.exists(lib_path)
+            and os.path.getmtime(lib_path) >= os.path.getmtime(_SRC)):
+        return lib_path
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=os.path.dirname(lib_path))
     os.close(fd)
     obj = tmp + ".o"
+    # TSan instruments every load/store in the RowPool (and wants -g so
+    # reports carry source lines); -O1 keeps reports honest where -O3's
+    # reordering can fold the racing accesses away
+    extra = ["-fsanitize=thread", "-g", "-O1"] if tsan else ["-O3"]
     try:
         # fast-math applies at COMPILE only (the dot reassociates/
         # vectorizes); linking without it keeps crtfastmath.o out of the
@@ -89,19 +109,20 @@ def _build():
         # needs it, and a lib silently built without it would deadlock
         # on first dispatch.
         subprocess.run(
-            ["g++", "-O3", "-march=native", "-ffast-math", "-std=c++17",
+            ["g++", *extra, "-march=native", "-ffast-math", "-std=c++17",
              "-pthread", "-c", "-fPIC", f"-I{ffi.include_dir()}",
              _SRC, "-o", obj],
             check=True, capture_output=True, timeout=180)
         subprocess.run(
-            ["g++", "-shared", "-pthread", obj, "-o", tmp],
+            ["g++", "-shared", "-pthread",
+             *(["-fsanitize=thread"] if tsan else []), obj, "-o", tmp],
             check=True, capture_output=True, timeout=60)
-        os.rename(tmp, _LIB)  # atomic: concurrent procs never half-load
+        os.rename(tmp, lib_path)  # atomic: concurrent procs never half-load
     finally:
         for p in (tmp, obj):
             if os.path.exists(p):
                 os.unlink(p)
-    return _LIB
+    return lib_path
 
 
 def _ensure():

@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import logging
 import os
 import threading
 import time
@@ -61,9 +62,11 @@ from distributed_llm_inferencing_tpu.parallel.mesh import (
     MeshSpec, create_mesh, validate_spec)
 from distributed_llm_inferencing_tpu.runtime import kvtier as kvtier_mod
 from distributed_llm_inferencing_tpu.runtime import tsdb as tsdb_mod
-from distributed_llm_inferencing_tpu.utils import trace
+from distributed_llm_inferencing_tpu.utils import locks, trace
 from distributed_llm_inferencing_tpu.utils.metrics import Metrics
 from distributed_llm_inferencing_tpu.utils.profiler import PhaseProfiler
+
+log = logging.getLogger("dli.batcher")
 
 TAIL_BUCKETS_X_BS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512)  # × block_size
 PREFIX_BUCKETS = (0, 1, 2, 4, 8, 16, 32, 64, 128, 256, 512)  # blocks
@@ -335,6 +338,11 @@ class ContinuousBatcher:
         # decode yet" with "metric not exported" — PR 5's radix-counter
         # rule applied to the amortization plane
         self.metrics.gauge("decode_tokens_per_weight_pass", 0.0)
+        # the dashboard's TSDB panel charts these from the first scrape;
+        # without pre-registration the series is invisible until the
+        # first submit/step (dlilint metric-not-preregistered)
+        self.metrics.gauge("batcher_queue_depth", 0.0)
+        self.metrics.gauge("batcher_free_kv_blocks", 0.0)
         if self.spec_wave:
             for name in ("spec_wave_dispatches", "spec_wave_drafted_tokens",
                          "spec_wave_accepted_tokens",
@@ -377,6 +385,10 @@ class ContinuousBatcher:
         self.pool = BlockPool(num_blocks + 1, block_size,
                               force_python=force_python_pool)
         [self._dummy] = self.pool.alloc(1)
+        # overwrite the 0 pre-registration with the truth now the pool
+        # exists — a scrape between construction and the first step must
+        # not read "0 free blocks" as exhaustion
+        self.metrics.gauge("batcher_free_kv_blocks", self.pool.free_count())
         self.paged = jax.device_put(
             init_paged_cache(cfg, num_blocks + 1, block_size),
             shd.named(self.mesh, shd.paged_cache_specs(cfg, self.mesh_spec)))
@@ -429,7 +441,7 @@ class ContinuousBatcher:
         self._admit_order: collections.deque = collections.deque()  # slot ids
 
         self.queue: collections.deque = collections.deque()
-        self._lock = threading.Lock()
+        self._lock = locks.lock("batcher.state")
         self._work = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -1672,8 +1684,14 @@ class ContinuousBatcher:
         if req.stream_cb:
             try:
                 req.stream_cb(token)
-            except Exception:
-                pass
+            except Exception as e:
+                # delivery is best-effort (the client likely vanished),
+                # but a broken callback must not fail silently forever
+                if not getattr(req, "_stream_cb_warned", False):
+                    req._stream_cb_warned = True
+                    log.warning("stream callback failed for request "
+                                "%s (%r); further tokens buffered only",
+                                getattr(req, "request_tag", "?"), e)
 
     def _finish_req(self, req: BatchRequest):
         if req.kv_export:
@@ -1682,8 +1700,12 @@ class ContinuousBatcher:
             # decode peer's /kv_fetch finds it
             try:
                 self._export_request_kv(req)
-            except Exception:
-                pass   # export is best-effort; the peer recomputes
+            except Exception as e:
+                # export is best-effort; the peer recomputes — but the
+                # disagg plan paid for this prefill expecting a transfer
+                log.warning("kv export failed for request %s (%r); "
+                            "decode peer will recompute",
+                            getattr(req, "request_tag", "?"), e)
         self.pool.release(req._blocks)
         req._blocks = []
         req.finished_at = time.time()

@@ -17,7 +17,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional, Tuple
 
-from distributed_llm_inferencing_tpu.utils import trace
+from distributed_llm_inferencing_tpu.utils import locks, trace
 from distributed_llm_inferencing_tpu.utils.faults import FaultInjector
 
 
@@ -332,7 +332,7 @@ class _TrackingHTTPServer(ThreadingHTTPServer):
 
     def __init__(self, *a, **kw):
         self._client_socks: set = set()
-        self._client_socks_lock = threading.Lock()
+        self._client_socks_lock = locks.lock("httpd.client_socks")
         super().__init__(*a, **kw)
 
     def get_request(self):

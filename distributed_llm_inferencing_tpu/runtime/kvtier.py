@@ -41,11 +41,12 @@ from __future__ import annotations
 
 import hashlib
 import os
-import threading
 from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from distributed_llm_inferencing_tpu.utils import locks
 
 # Host arena budget (MB). 0 disables the offload tier entirely.
 DEFAULT_HOST_MB = 256.0
@@ -110,7 +111,7 @@ class HostKVArena:
 
     def __init__(self, capacity_bytes: int):
         self.capacity_bytes = int(capacity_bytes)
-        self._lock = threading.Lock()
+        self._lock = locks.lock("kvtier.arena")
         self._entries: "OrderedDict[str, Tuple[tuple, int]]" = OrderedDict()
         self._bytes = 0
         self.hits = 0
@@ -223,7 +224,7 @@ class PrefixDigestIndex:
                  top_k: int = DIGEST_TOP_K):
         self.chunk = int(chunk)
         self.top_k = int(top_k)
-        self._lock = threading.Lock()
+        self._lock = locks.lock("kvtier.digests")
         # chain key (deepest digest) -> [(digest, est_tokens), ...]
         self._chains: "OrderedDict[str, list]" = OrderedDict()
 

@@ -39,11 +39,15 @@ the decode node's side of the wire.
 from __future__ import annotations
 
 import json
+import logging
 import struct
-import threading
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from distributed_llm_inferencing_tpu.utils import locks
+
+log = logging.getLogger("dli.kvwire")
 
 MAGIC = b"KVF1"
 _HDR_STRUCT = struct.Struct(">II")
@@ -198,7 +202,7 @@ class KVFetchClient:
         self.max_bytes = int(max_mb * 1024 * 1024)
         self._pool_size = max(1, int(pool_size))
         self._sessions: Dict[str, object] = {}
-        self._lock = threading.Lock()
+        self._lock = locks.lock("kvwire.peer_sessions")
         # pre-register (PR 5 rule): a scrape must be able to tell "no
         # transfers yet" from "metric not exported"
         self.metrics.inc("worker_peer_conns_created", 0)
@@ -227,8 +231,9 @@ class KVFetchClient:
         if s is not None:
             try:
                 s.close()
-            except Exception:
-                pass
+            except Exception as e:
+                # closing an already-dead socket — harmless, but visible
+                log.debug("purged peer session close failed: %r", e)
 
     def close(self) -> None:
         with self._lock:
@@ -236,8 +241,8 @@ class KVFetchClient:
         for s in sessions:
             try:
                 s.close()
-            except Exception:
-                pass
+            except Exception as e:
+                log.debug("peer session close failed at teardown: %r", e)
 
     def _count_conn_reuse(self, sess) -> None:
         """Same urllib3 socket-count delta the master's RPC pool uses:

@@ -35,7 +35,7 @@ from distributed_llm_inferencing_tpu.runtime import tsdb as tsdb_mod
 from distributed_llm_inferencing_tpu.runtime.kvtier import (
     estimate_cached_tokens)
 from distributed_llm_inferencing_tpu.runtime.state import Store
-from distributed_llm_inferencing_tpu.utils import trace
+from distributed_llm_inferencing_tpu.utils import locks, trace
 from distributed_llm_inferencing_tpu.utils.logging import setup_logging
 from distributed_llm_inferencing_tpu.utils.metrics import (
     Metrics, hist_quantile, parse_prometheus, sanitize_name)
@@ -194,7 +194,7 @@ class Master:
         self._rpc_pool = bool(rpc_pool)
         self._rpc_pool_size = max(1, int(rpc_pool_size))
         self._sessions: Dict[tuple, object] = {}   # (host, port) -> Session
-        self._sessions_lock = threading.Lock()
+        self._sessions_lock = locks.lock("master.sessions")
         # queue-aware scheduling state: worker-reported batcher queue
         # depth + free KV blocks (health sweeps and inference responses
         # both refresh it) and an EWMA of observed completion latency
@@ -245,6 +245,12 @@ class Master:
                      "scheduler_disagg_recompute",
                      "disagg_prefill_failed"):
             self.metrics.inc(name, 0)
+        # same rule for the SLO gauges the dashboard charts: they must
+        # exist in the exposition from the first scrape (the telemetry
+        # loop still withholds them from the TSDB until the fast window
+        # has real attainment, so a chart never renders this 0)
+        self.metrics.gauge("slo_attainment", 0.0)
+        self.metrics.gauge("slo_burn_rate", 0.0)
         trace.set_service("master")
         # Dispatch tags are the worker-side idempotency key, so they must
         # be unique across master *instances*: request ids restart at 1
@@ -255,7 +261,7 @@ class Master:
         self.health_interval = health_interval
         self._worker_auth = auth_key or os.environ.get("DLI_AUTH_KEY")
         self._inflight: Dict[int, int] = {}   # node_id -> in-flight count
-        self._inflight_lock = threading.Lock()
+        self._inflight_lock = locks.lock("master.inflight")
         self._processing: Dict[int, dict] = {}  # req_id -> node (for cancel)
         # req_id -> submitter's SpanCtx: dispatch runs on another thread,
         # so the request's trace link rides this map, not a contextvar
@@ -351,7 +357,7 @@ class Master:
                 # per-session accounting lock: the reuse bookkeeping is
                 # on every RPC's hot path, and the global _sessions_lock
                 # would serialize independent nodes' dispatchers
-                s._dli_lock = threading.Lock()
+                s._dli_lock = locks.lock("master.session_acct")
                 self._sessions[key] = s
             return s
 
@@ -367,8 +373,9 @@ class Master:
         if s is not None:
             try:
                 s.close()
-            except Exception:
-                pass
+            except Exception as e:
+                # the pool being purged is usually already dead
+                log.debug("purged RPC session close failed: %r", e)
 
     def _count_conn_reuse(self, sess):
         """Created-vs-reused accounting: urllib3's per-host pool counts
@@ -1195,8 +1202,12 @@ class Master:
             self.store.update_node(
                 node["id"], info=info, is_active=1,
                 consecutive_failures=0, last_heartbeat=time.time())
-        except Exception:
-            pass
+        except Exception as e:
+            # dispatch proceeds on the stale snapshot; the health loop
+            # refreshes the row next interval — but a store UPDATE
+            # failing is never routine
+            log.warning("node snapshot refresh failed for node %s: %r",
+                        node.get("id"), e)
 
     def _execute(self, req, node=None) -> bool:
         """Run one request on a chosen (or pre-reserved) node. True on
@@ -1307,8 +1318,11 @@ class Master:
                     try:
                         self._worker_post(pn, "/cancel",
                                           {"request_tag": tag}, 10)
-                    except Exception:
-                        pass
+                    except Exception as e:
+                        # expected: the node is often down — that is why
+                        # the request failed over in the first place
+                        log.debug("orphan cancel on previous node "
+                                  "failed: %r", e)
                 threading.Thread(target=_cancel, daemon=True,
                                  name="cancel-orphan").start()
         # barrier=False: the commit still gates client visibility (reads
@@ -1482,8 +1496,10 @@ class Master:
                     try:
                         self._worker_post(node, "/cancel",
                                           {"request_tag": tag}, 10)
-                    except Exception:
-                        pass
+                    except Exception as e:
+                        # expected when the timeout was the node dying
+                        log.debug("orphan cancel after terminal timeout "
+                                  "failed: %r", e)
                 threading.Thread(target=_cancel, daemon=True,
                                  name="cancel-orphan").start()
         # A read timeout means the worker is slow/busy (its generate
@@ -2162,8 +2178,8 @@ class Master:
         for s in sessions:
             try:
                 s.close()
-            except Exception:
-                pass
+            except Exception as e:
+                log.debug("RPC session close failed at shutdown: %r", e)
 
 
 def _relay_json(r):

@@ -44,6 +44,7 @@ from typing import Callable, Dict, List, Optional
 import requests as http
 
 from distributed_llm_inferencing_tpu.runtime import httpd
+from distributed_llm_inferencing_tpu.utils import locks
 from distributed_llm_inferencing_tpu.utils.logging import setup_logging
 
 log = setup_logging("multihost")
@@ -56,7 +57,7 @@ class LockstepExecutor:
 
     def __init__(self):
         self._heap: list = []
-        self._cv = threading.Condition()
+        self._cv = locks.condition("multihost.exec")
         self._next = 0
         self._stopped = False
         self._thread = threading.Thread(target=self._loop, daemon=True,
@@ -199,7 +200,7 @@ class LockstepLeader:
                           for f in followers]
         self._auth = auth_key
         self.exec = LockstepExecutor()
-        self._mirror_lock = threading.Lock()
+        self._mirror_lock = locks.lock("multihost.mirror")
         self._seq = 0
         self._epoch = 0
         self._degraded: Optional[str] = None
@@ -406,8 +407,9 @@ class LockstepLeader:
                     st = http.get(f"{f}/lockstep/status",
                                   headers=self._headers(), timeout=5).json()
                     self._epoch = max(self._epoch, int(st.get("epoch", 0)))
-                except Exception:
-                    pass   # unreachable follower fails the reset below
+                except Exception as e:
+                    # unreachable follower fails the reset below
+                    log.debug("epoch probe of follower %s failed: %r", f, e)
             self._epoch += 1
             epoch = self._epoch
             for f in self.followers:
@@ -559,7 +561,7 @@ class LockstepFollower:
     def __init__(self, agent):
         self.agent = agent
         self.exec = LockstepExecutor()
-        self._seen_lock = threading.Lock()
+        self._seen_lock = locks.lock("multihost.seen")
         self._seen: set = set()
         self._epoch = 0
         self._last_recv = -1   # forwards are serialized: seqs must arrive

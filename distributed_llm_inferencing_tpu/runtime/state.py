@@ -24,6 +24,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from distributed_llm_inferencing_tpu.utils import locks
+
 log = logging.getLogger("dli_tpu.state")
 
 _SCHEMA = """
@@ -119,7 +121,7 @@ class Store:
                  group_commit: bool = False,
                  flush_interval: Optional[float] = None,
                  on_flush: Optional[Callable[[], None]] = None):
-        self._lock = threading.RLock()
+        self._lock = locks.rlock("state.store")
         self._db = sqlite3.connect(path, check_same_thread=False)
         self._db.execute("PRAGMA journal_mode=WAL")
         with self._lock, self._db:
@@ -151,8 +153,8 @@ class Store:
                 flush_interval = float(
                     os.environ.get("DLI_STORE_FLUSH_MS", 0)) / 1e3
             self._gc_interval = max(0.0, flush_interval)
-            self._gc_cv = threading.Condition()
-            self._gc_flush_lock = threading.Lock()
+            self._gc_cv = locks.condition("state.gc")
+            self._gc_flush_lock = locks.lock("state.gc_flush")
             self._gc_buf: List[tuple] = []
             self._gc_enqueued = 0       # ticket of the newest buffered op
             self._gc_flushed = 0        # ticket of the newest committed op

@@ -36,9 +36,10 @@ from __future__ import annotations
 import collections
 import math
 import os
-import threading
 import time
 from typing import Dict, List, Optional, Tuple
+
+from distributed_llm_inferencing_tpu.utils import locks
 
 # Retention knobs: total window retained per series, and the fine-ring
 # bucket width. The fine ring is capped at FINE_BUCKETS_MAX buckets;
@@ -178,7 +179,7 @@ class TSDB:
         self.step_s = max(0.1, self.step_s)
         self.window_s = max(self.step_s * 4, self.window_s)
         self._max_series = max(1, int(max_series_per_node))
-        self._lock = threading.Lock()
+        self._lock = locks.lock("tsdb.series")
         self._series: Dict[str, Dict[str, Series]] = {}   # node -> metric
 
     def record(self, node: str, metric: str, value,
@@ -271,7 +272,7 @@ class SLOEvaluator:
         self.fast_window_s = float(fast_window_s)
         self.slow_window_s = float(slow_window_s)
         self._events: collections.deque = collections.deque(maxlen=maxlen)
-        self._lock = threading.Lock()
+        self._lock = locks.lock("tsdb.slo")
         self.total = 0
         self.violations = 0
 

@@ -27,7 +27,7 @@ from distributed_llm_inferencing_tpu.ops.sampling import SamplingParams
 from distributed_llm_inferencing_tpu.parallel.mesh import MeshSpec
 from distributed_llm_inferencing_tpu.runtime import httpd
 from distributed_llm_inferencing_tpu.runtime.engine import InferenceEngine
-from distributed_llm_inferencing_tpu.utils import trace
+from distributed_llm_inferencing_tpu.utils import locks, trace
 from distributed_llm_inferencing_tpu.utils.logging import setup_logging
 from distributed_llm_inferencing_tpu.utils.metrics import Metrics
 from distributed_llm_inferencing_tpu.utils.tokenizer import load_tokenizer
@@ -63,7 +63,7 @@ class LoadedModel:
         self.tokenizer = tokenizer
         self.source = source
         self.batcher = batcher          # ContinuousBatcher or None
-        self.lock = threading.Lock()  # engine.generate is not reentrant
+        self.lock = locks.lock("worker.model")  # engine.generate is not reentrant
 
 
 class WorkerAgent:
@@ -81,7 +81,7 @@ class WorkerAgent:
                              f"{WORKER_ROLES}, got {role!r}")
         self.role = role
         self.models: Dict[str, LoadedModel] = {}
-        self._models_lock = threading.Lock()
+        self._models_lock = locks.lock("worker.models")
         self._loading: set = set()
         self.metrics = Metrics()
         self.started = time.time()
@@ -113,33 +113,36 @@ class WorkerAgent:
         s.add("GET", "/memory_profile", self.memory_profile)
         s.add("POST", "/ssh_setup", self.ssh_setup)
         self._profile_dir: Optional[str] = None
-        self._profile_lock = threading.Lock()
+        self._profile_lock = locks.lock("worker.profile")
         # request_tag -> in-flight batcher request, so a caller (the master
         # on its own timeout, or an operator) can cancel and free the slot
         self._tagged: Dict[str, object] = {}
-        self._tagged_lock = threading.Lock()
+        self._tagged_lock = locks.lock("worker.tagged")
         # Idempotent dispatch (at-least-once delivery, exactly-once
         # execution): completed results keyed by request_tag in a bounded
         # LRU, plus an in-flight registry so a duplicate dispatch JOINS
         # the running execution instead of re-generating.
         self._idem: "collections.OrderedDict[str, dict]" = \
             collections.OrderedDict()
-        self._idem_lock = threading.Lock()
+        self._idem_lock = locks.lock("worker.idem")
         self._inflight_tags: Dict[str, threading.Event] = {}
         # graceful drain: finish in-flight work, 503 new inference
         self._draining = False
         self._active = 0
-        self._active_cv = threading.Condition()
+        self._active_cv = locks.condition("worker.active")
         # shared peer-fetch client for every batched model on this
         # worker (pooled keep-alive sessions to each prefill peer, the
         # worker's own fault injector for rpc:/kv_fetch chaos, conn
         # accounting in this registry); lazily built — engine-only
         # workers never pay the requests import
         self._peer_client = None
-        self._peer_client_lock = threading.Lock()
-        # pre-register the serve-side transfer counters (PR 5 rule)
+        self._peer_client_lock = locks.lock("worker.peer_client")
+        # pre-register the serve-side transfer counters and the
+        # headline throughput counter the dashboard's TSDB rate series
+        # charts (PR 5 rule — dlilint metric-not-preregistered)
         for name in ("kv_fetch_requests", "kv_fetch_served_blocks",
-                     "kv_fetch_served_bytes", "kv_fetch_missing_blocks"):
+                     "kv_fetch_served_bytes", "kv_fetch_missing_blocks",
+                     "tokens_generated"):
             self.metrics.inc(name, 0)
 
     # ---- endpoints ---------------------------------------------------
@@ -156,8 +159,9 @@ class WorkerAgent:
                 if ms:
                     entry["bytes_in_use"] = ms.get("bytes_in_use")
                     entry["bytes_limit"] = ms.get("bytes_limit")
-            except Exception:
-                pass
+            except Exception as e:
+                # CPU backends raise per scrape — stats stay best-effort
+                log.debug("device memory_stats unavailable: %r", e)
             devices.append(entry)
         try:
             import psutil

@@ -1,0 +1,270 @@
+"""Opt-in runtime lock-order watchdog (``DLI_LOCK_CHECK=1``).
+
+The static half of thread hygiene lives in tools/dlilint/check_threads.py
+(lock-acquisition graph over the AST). This is the dynamic half: when
+``DLI_LOCK_CHECK=1`` is set, every runtime lock created through the
+factories below becomes an instrumented wrapper that records, per
+thread, the order in which *named* locks are acquired into one global
+edge graph:
+
+    edge A -> B  ==  some thread acquired B while holding A
+
+A cycle in that graph is a potential deadlock (thread 1 holds A wants
+B, thread 2 holds B wants A) even if the run never actually deadlocked
+— which is exactly why the chaos suite arms it in CI: dynamic
+lock-order inversions fail the *build*, not production. The watchdog
+also reports:
+
+- same-instance re-acquire of a non-reentrant lock (guaranteed
+  self-deadlock the moment it blocks),
+- locks held longer than ``DLI_LOCK_HELD_WARN_MS`` (default 5000).
+
+Edges are keyed by lock *name* (the role — ``"batcher.lock"``), not
+instance: order discipline is a property of the code paths, and two
+batcher instances interleaving must still honor one order. Nesting two
+*different instances* of the same name is ignored rather than reported
+as a self-cycle (per-model arenas legitimately nest under fleet sweeps).
+
+Disabled (the default), the factories return the stock
+``threading.Lock/RLock/Condition`` — zero wrappers, zero overhead.
+Everything here is stdlib-only and import-cycle-free (no other
+dli module is imported).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Set
+
+_MAX_REPORTS = 256
+
+
+def enabled() -> bool:
+    """Whether new locks are created instrumented. Read per factory
+    call (not cached at import) so tests and the chaos harness can flip
+    the env before building a service."""
+    return os.environ.get("DLI_LOCK_CHECK", "").lower() in ("1", "true")
+
+
+def _held_warn_s() -> float:
+    try:
+        return float(os.environ.get("DLI_LOCK_HELD_WARN_MS", 5000)) / 1e3
+    except (TypeError, ValueError):
+        return 5.0
+
+
+class _Watchdog:
+    """Global acquisition-order graph + report ring. One per process;
+    its own plain lock guards the graph (never instrumented — the
+    watchdog must not watch itself)."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._tls = threading.local()
+        # name -> set of names acquired while <name> was held, with one
+        # witness (thread, names) per edge for the report
+        self._edges: Dict[str, Set[str]] = {}
+        self._witness: Dict[tuple, str] = {}
+        self._reports: List[dict] = []
+
+    # ---- per-thread held stack ---------------------------------------
+
+    def _held(self) -> list:
+        h = getattr(self._tls, "held", None)
+        if h is None:
+            h = self._tls.held = []
+        return h
+
+    # ---- report plumbing ---------------------------------------------
+
+    def _report(self, kind: str, **kw):
+        with self._mu:
+            if len(self._reports) < _MAX_REPORTS:
+                kw["kind"] = kind
+                kw["thread"] = threading.current_thread().name
+                self._reports.append(kw)
+
+    def reports(self, kind: Optional[str] = None) -> List[dict]:
+        with self._mu:
+            out = list(self._reports)
+        if kind is not None:
+            out = [r for r in out if r["kind"] == kind]
+        return out
+
+    def reset(self):
+        """Drop reports AND the learned edge graph (test isolation)."""
+        with self._mu:
+            self._edges.clear()
+            self._witness.clear()
+            self._reports.clear()
+
+    def snapshot(self) -> dict:
+        """Copy of the full state, for save-around tests: the deliberate
+        inversions in tests/test_locks.py must not wipe reports a chaos
+        run accumulated earlier in the same pytest session (the conftest
+        session gate asserts on those)."""
+        with self._mu:
+            return {"edges": {k: set(v) for k, v in self._edges.items()},
+                    "witness": dict(self._witness),
+                    "reports": list(self._reports)}
+
+    def restore(self, state: dict):
+        with self._mu:
+            self._edges = {k: set(v) for k, v in state["edges"].items()}
+            self._witness = dict(state["witness"])
+            self._reports = list(state["reports"])
+
+    def edges(self) -> Dict[str, Set[str]]:
+        with self._mu:
+            return {k: set(v) for k, v in self._edges.items()}
+
+    # ---- graph maintenance -------------------------------------------
+
+    def _path(self, src: str, dst: str) -> Optional[List[str]]:
+        """DFS path src -> dst in the edge graph (caller holds _mu)."""
+        stack, seen = [(src, [src])], {src}
+        while stack:
+            node, path = stack.pop()
+            for nxt in self._edges.get(node, ()):
+                if nxt == dst:
+                    return path + [nxt]
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+    def note_acquired(self, lock: "_Instrumented"):
+        held = self._held()
+        entry = [lock, time.monotonic()]
+        if any(h[0] is lock for h in held):
+            # reentrant acquire of an RLock: bookkeeping only, no edges
+            held.append(entry)
+            return
+        new_edges = []
+        for h, _t in held:
+            if h.name == lock.name:
+                continue   # different instances of one role: no ordering
+            new_edges.append(h.name)
+        if new_edges:
+            with self._mu:
+                for src in new_edges:
+                    if lock.name in self._edges.get(src, ()):
+                        continue
+                    # does the REVERSE order already exist? a path
+                    # lock -> ... -> src means adding src -> lock
+                    # closes a cycle
+                    cyc = self._path(lock.name, src)
+                    self._edges.setdefault(src, set()).add(lock.name)
+                    self._witness.setdefault(
+                        (src, lock.name), threading.current_thread().name)
+                    if cyc is not None and len(self._reports) < _MAX_REPORTS:
+                        self._reports.append({
+                            "kind": "lock_order_cycle",
+                            "thread": threading.current_thread().name,
+                            "edge": [src, lock.name],
+                            # full loop: src -> lock -> ... -> src
+                            "cycle": [src] + cyc,
+                            "witness": self._witness.get(
+                                (lock.name, src)),
+                        })
+        held.append(entry)
+
+    def note_blocking_reacquire(self, lock: "_Instrumented"):
+        self._report("self_deadlock", lock=lock.name)
+
+    def note_released(self, lock: "_Instrumented"):
+        held = self._held()
+        # out-of-order release is legal (Condition.wait releases under
+        # the hood): remove the most recent entry for this instance
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] is lock:
+                _, t0 = held.pop(i)
+                el = time.monotonic() - t0
+                if el > _held_warn_s():
+                    self._report("held_too_long", lock=lock.name,
+                                 held_ms=round(el * 1e3, 1))
+                return
+
+
+_watchdog = _Watchdog()
+
+
+def watchdog() -> _Watchdog:
+    return _watchdog
+
+
+def cycle_reports() -> List[dict]:
+    """The reports that must be empty for a chaos-suite pass to count
+    (held-too-long is advisory on a loaded CI box; cycles never are)."""
+    return _watchdog.reports("lock_order_cycle") \
+        + _watchdog.reports("self_deadlock")
+
+
+class _Instrumented:
+    """Wrapper around a real lock. Quacks enough like one for ``with``,
+    ``acquire(blocking, timeout)``, ``release`` and
+    ``threading.Condition`` (which falls back to plain acquire/release
+    when the lock has no ``_release_save``)."""
+
+    __slots__ = ("name", "_lk", "_reentrant")
+
+    def __init__(self, name: str, reentrant: bool):
+        self.name = name
+        self._reentrant = reentrant
+        self._lk = threading.RLock() if reentrant else threading.Lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        if (not self._reentrant and blocking
+                and any(h[0] is self for h in _watchdog._held())):
+            # a blocking re-acquire of a plain Lock deadlocks this
+            # thread for real; report BEFORE blocking so the run's
+            # artifact names the culprit even if CI then times out
+            _watchdog.note_blocking_reacquire(self)
+        got = self._lk.acquire(blocking, timeout)
+        if got:
+            _watchdog.note_acquired(self)
+        return got
+
+    def release(self):
+        _watchdog.note_released(self)
+        self._lk.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        locked = getattr(self._lk, "locked", None)
+        return locked() if locked is not None else False
+
+    def __repr__(self):
+        return (f"<dli.locks.{'RLock' if self._reentrant else 'Lock'} "
+                f"{self.name!r}>")
+
+
+def lock(name: str):
+    """A named mutex: ``threading.Lock()`` normally, instrumented when
+    ``DLI_LOCK_CHECK=1``. ``name`` is the lock's *role* ("master.inflight"),
+    shared by every instance filling that role."""
+    if enabled():
+        return _Instrumented(name, reentrant=False)
+    return threading.Lock()
+
+
+def rlock(name: str):
+    if enabled():
+        return _Instrumented(name, reentrant=True)
+    return threading.RLock()
+
+
+def condition(name: str, lk=None):
+    """A Condition over a named (possibly instrumented) lock. Passing an
+    existing factory-made lock shares it; otherwise a fresh ``name``d
+    lock backs the condition."""
+    return threading.Condition(lk if lk is not None else lock(name))

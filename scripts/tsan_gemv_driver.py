@@ -1,0 +1,120 @@
+"""ThreadSanitizer harness for the native RowPool (qgemv.cc).
+
+Run by ``scripts/check.sh --tsan`` as:
+
+    DLI_NATIVE_TSAN=1 python scripts/tsan_gemv_driver.py --build-only
+    LD_PRELOAD=$(g++ -print-file-name=libtsan.so) \\
+        TSAN_OPTIONS="suppressions=scripts/tsan.supp exitcode=66" \\
+        python scripts/tsan_gemv_driver.py
+
+The build step runs WITHOUT the TSan runtime preloaded (it only needs
+g++ and the XLA FFI headers off a normal-speed jax import); the run
+step loads the instrumented library through ctypes — never importing
+jax — because a TSan-intercepted process pays minutes per heavyweight
+import while numpy+ctypes stay in seconds.
+
+What it exercises (every concurrency edge the pool has):
+
+- concurrent GEMV dispatches from many python threads (the pool
+  serializes them on ``api_mu_`` — a regression there is exactly what
+  TSan exists to catch),
+- runtime pool resizes (``DliGemvSetThreads``) racing those dispatches,
+  including mid-run worker spawns picking up the current generation,
+- every kernel shape class: M == 1 (fused path), M in 2..4 (register
+  block), M > 4 (blocked fallback), int8 and f32 weight formats,
+- a numerical cross-check against numpy per thread, so the harness
+  also fails on data corruption, not just on TSan reports.
+
+Exit codes: 0 clean, 1 harness failure (wrong numerics / lib missing),
+66 TSan report (set via TSAN_OPTIONS exitcode — TSan exits the process
+itself when a race is found and ``halt_on_error=1``).
+"""
+
+import argparse
+import ctypes
+import os
+import sys
+import threading
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIB = os.path.join(ROOT, "distributed_llm_inferencing_tpu", "native",
+                   "libdli_qgemv_tsan.so")
+
+
+def build() -> int:
+    os.environ["DLI_NATIVE_TSAN"] = "1"
+    sys.path.insert(0, ROOT)
+    from distributed_llm_inferencing_tpu.ops import cpu_gemv
+    path = cpu_gemv._build()
+    print(f"tsan build: {path}")
+    return 0 if os.path.exists(path) else 1
+
+
+def run(threads: int = 8, iters: int = 200) -> int:
+    import numpy as np
+    if not os.path.exists(LIB):
+        print(f"tsan lib missing ({LIB}); run --build-only first",
+              file=sys.stderr)
+        return 1
+    lib = ctypes.CDLL(LIB)
+    i64 = ctypes.c_int64
+    lib.DliGemvI8Direct.argtypes = [ctypes.c_void_p] * 4 + [i64] * 3
+    lib.DliGemvF32Direct.argtypes = [ctypes.c_void_p] * 3 + [i64] * 3
+    lib.DliGemvSetThreads.argtypes = [ctypes.c_int]
+    lib.DliGemvGetThreads.restype = ctypes.c_int
+
+    k, n = 384, 512
+    rng = np.random.default_rng(0)
+    w = rng.standard_normal((n, k), dtype=np.float32)
+    wq = np.clip(np.round(w * 16), -127, 127).astype(np.int8)
+    scale = np.full((n,), 1 / 16, np.float32)
+    failures = []
+
+    def hammer(tid: int):
+        r = np.random.default_rng(tid)
+        for i in range(iters):
+            m = int(r.integers(1, 9))      # 1 / 2-4 / blocked paths
+            x = r.standard_normal((m, k), dtype=np.float32)
+            y = np.empty((m, n), np.float32)
+            if i % 2 == 0:
+                lib.DliGemvI8Direct(
+                    x.ctypes.data, wq.ctypes.data, scale.ctypes.data,
+                    y.ctypes.data, m, k, n)
+                want = x @ (wq.astype(np.float32).T * scale)
+            else:
+                lib.DliGemvF32Direct(
+                    x.ctypes.data, w.ctypes.data, y.ctypes.data, m, k, n)
+                want = x @ w.T
+            if not np.allclose(y, want, rtol=2e-3, atol=2e-3):
+                failures.append((tid, i, float(np.abs(y - want).max())))
+                return
+
+    def resizer():
+        r = np.random.default_rng(99)
+        for _ in range(iters // 2):
+            lib.DliGemvSetThreads(int(r.integers(1, 7)))
+        lib.DliGemvSetThreads(0)            # restore the default
+
+    ts = [threading.Thread(target=hammer, args=(t,))
+          for t in range(threads)] + [threading.Thread(target=resizer)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    if failures:
+        print(f"numerical mismatches under concurrency: {failures[:5]}",
+              file=sys.stderr)
+        return 1
+    print(f"tsan harness clean: {threads} threads x {iters} dispatches, "
+          f"pool now {lib.DliGemvGetThreads()} threads")
+    return 0
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--build-only", action="store_true")
+    ap.add_argument("--threads", type=int, default=8)
+    ap.add_argument("--iters", type=int, default=200)
+    a = ap.parse_args()
+    sys.exit(build() if a.build_only
+             else run(threads=a.threads, iters=a.iters))

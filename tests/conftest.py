@@ -73,3 +73,24 @@ def tiny_glm45_moe_model(seed=58):
             if hasattr(lyr.mlp, "gate"):
                 lyr.mlp.gate.e_score_correction_bias.uniform_(0.0, 0.2)
     return model
+
+
+# ---- lock-order watchdog gate (utils/locks.py) ------------------------
+# When the suite runs with DLI_LOCK_CHECK=1 (scripts/check.sh arms it
+# for the chaos suite), every runtime lock is instrumented and a
+# dynamic lock-order inversion anywhere in the run must fail the build.
+# The deliberate-inversion tests in tests/test_locks.py reset the
+# watchdog behind themselves, so any report left at session end is real.
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _lock_watchdog_gate():
+    yield
+    from distributed_llm_inferencing_tpu.utils import locks
+    if locks.enabled():
+        reports = locks.cycle_reports()
+        assert not reports, (
+            "lock-order watchdog detected potential deadlocks during "
+            f"the run: {reports}")

@@ -1,0 +1,458 @@
+"""dlilint suite: each checker catches its seeded-violation fixture AND
+runs clean on the real tree.
+
+The fixtures are tiny synthetic repos built in tmp_path and handed to
+the checkers through a hand-assembled ``Ctx`` — the same entry points
+``python -m tools.dlilint`` drives, minus the repo-root discovery. The
+clean-tree assertions are the actual CI gate duplicated in-process, so
+a regression that sneaks past scripts/check.sh still fails the tier-1
+suite.
+"""
+
+import os
+import sys
+import textwrap
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.dlilint import CHECKERS, run_all
+from tools.dlilint.core import Ctx, SourceFile
+from tools.dlilint import check_jit, check_knobs, check_metrics, \
+    check_threads
+
+
+def _sf(tmp_path, rel, source):
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(source))
+    return SourceFile.load(str(p), str(tmp_path))
+
+
+def _ctx(tmp_path, **kw):
+    kw.setdefault("package_files", [])
+    kw.setdefault("runtime_files", [])
+    kw.setdefault("gate_files", [])
+    kw.setdefault("doc_paths", [])
+    return Ctx(root=str(tmp_path), **kw)
+
+
+def _rules(violations):
+    return sorted(v.rule for v in violations)
+
+
+# ---- knobs checker -----------------------------------------------------
+
+def test_knobs_unregistered_read_caught(tmp_path):
+    sf = _sf(tmp_path, "pkg/mod.py", """\
+        import os
+        X = os.environ.get("DLI_FAKE_KNOB", "1")
+        Y = os.getenv("DLI_OTHER_KNOB")
+        Z = os.environ["DLI_SUBSCRIPT_KNOB"]
+        """)
+    out = check_knobs.check(_ctx(tmp_path, package_files=[sf],
+                                 knob_registry={}))
+    assert _rules(out) == ["knob-unregistered"] * 3
+    names = {v.msg.split()[2] for v in out}
+    assert names == {"DLI_FAKE_KNOB", "DLI_OTHER_KNOB",
+                     "DLI_SUBSCRIPT_KNOB"}
+
+
+def test_knobs_name_through_module_constant_resolved(tmp_path):
+    sf = _sf(tmp_path, "pkg/mod.py", """\
+        import os
+        KNOB = "DLI_INDIRECT_KNOB"
+        V = os.environ.get(KNOB, "0")
+        """)
+    out = check_knobs.check(_ctx(tmp_path, package_files=[sf],
+                                 knob_registry={}))
+    assert len(out) == 1 and "DLI_INDIRECT_KNOB" in out[0].msg
+
+
+def test_knobs_dead_registry_row_caught(tmp_path):
+    sf = _sf(tmp_path, "pkg/mod.py", "x = 1\n")
+    out = check_knobs.check(_ctx(tmp_path, package_files=[sf],
+                                 knob_registry={"DLI_GHOST": object()}))
+    assert _rules(out) == ["knob-dead"]
+
+
+def test_knobs_doc_dead_token_caught(tmp_path):
+    doc = tmp_path / "docs" / "serving.md"
+    doc.parent.mkdir()
+    doc.write_text("Set `DLI_NO_SUCH_KNOB=1` to win.\n")
+    out = check_knobs.check(_ctx(tmp_path, doc_paths=[str(doc)],
+                                 knob_registry={}))
+    assert _rules(out) == ["knob-doc-dead"]
+    assert "DLI_NO_SUCH_KNOB" in out[0].msg
+
+
+def test_knobs_pragma_suppresses(tmp_path):
+    sf = _sf(tmp_path, "pkg/mod.py", """\
+        import os
+        # dlilint: disable=knob-unregistered
+        X = os.environ.get("DLI_WAIVED_KNOB")
+        """)
+    out = check_knobs.check(_ctx(tmp_path, package_files=[sf],
+                                 knob_registry={}))
+    assert out == []
+
+
+def test_knobs_shell_read_counts_as_code_read(tmp_path):
+    sh = tmp_path / "scripts" / "check.sh"
+    sh.parent.mkdir()
+    sh.write_text('if [[ "${DLI_SHELL_ONLY:-}" == "1" ]]; then :; fi\n'
+                  'DLI_ARMED_FOR_CHILD=1 python x.py\n')
+    # the expansion is a read; the assignment form is not
+    reads = {n for _, _, n in check_knobs.collect_shell_reads([str(sh)])}
+    assert reads == {"DLI_SHELL_ONLY"}
+    out = check_knobs.check(_ctx(
+        tmp_path, shell_paths=[str(sh)],
+        knob_registry={"DLI_SHELL_ONLY": object()}))
+    assert out == []   # registered shell-only knob is not knob-dead
+
+
+def test_knobs_internal_underscore_names_exempt(tmp_path):
+    sf = _sf(tmp_path, "pkg/mod.py", """\
+        import os
+        X = os.environ.get("_DLI_PRIVATE_HANDSHAKE")
+        """)
+    out = check_knobs.check(_ctx(tmp_path, package_files=[sf],
+                                 knob_registry={}))
+    assert out == []
+
+
+# ---- metrics checker ---------------------------------------------------
+
+_REGISTERING_MOD = """\
+    class M:
+        def __init__(self, metrics):
+            self.metrics = metrics
+            self.metrics.inc("good_counter", 0)
+            self.metrics.gauge("good_gauge", 0.0)
+            self.metrics.inc("unseeded_counter")   # registered, not at 0
+
+        def step(self):
+            self.metrics.observe("good_latency", 0.1)
+            for key, mname in (("a", "looped_counter"),):
+                self.metrics.inc(mname, 0)
+    """
+
+
+def test_metrics_dashboard_unregistered_series_caught(tmp_path):
+    pkg = _sf(tmp_path, "pkg/mod.py", _REGISTERING_MOD)
+    dash = _sf(tmp_path, "pkg/dashboard_html.py", """\
+        PAGE = '''
+        const TS_METRICS = [
+          ['good_counter', 'fine'],
+          ['ghost_series', 'boom'],
+        ];
+        '''
+        """)
+    out = check_metrics.check(_ctx(tmp_path, package_files=[pkg],
+                                   dashboard_file=dash))
+    assert [v.rule for v in out] == ["metric-unregistered"]
+    assert "ghost_series" in out[0].msg
+
+
+def test_metrics_not_preregistered_caught(tmp_path):
+    pkg = _sf(tmp_path, "pkg/mod.py", _REGISTERING_MOD)
+    dash = _sf(tmp_path, "pkg/dashboard_html.py", """\
+        PAGE = '''
+        const TS_METRICS = [
+          ['unseeded_counter', 'exists but invisible until first inc'],
+          ['looped_counter', 'pre-registered through the loop idiom'],
+        ];
+        '''
+        """)
+    out = check_metrics.check(_ctx(tmp_path, package_files=[pkg],
+                                   dashboard_file=dash))
+    assert _rules(out) == ["metric-not-preregistered"]
+    assert "unseeded_counter" in out[0].msg
+
+
+def test_metrics_doc_counter_without_total_caught(tmp_path):
+    pkg = _sf(tmp_path, "pkg/mod.py", _REGISTERING_MOD)
+    doc = tmp_path / "docs" / "observability.md"
+    doc.parent.mkdir()
+    doc.write_text("Watch `dli_good_counter` (sic) and "
+                   "`dli_good_counter_total` and `dli_good_gauge` and "
+                   "`dli_good_latency_seconds` and `dli_nonexistent_total`.\n")
+    out = check_metrics.check(_ctx(tmp_path, package_files=[pkg],
+                                   doc_paths=[str(doc)]))
+    assert _rules(out) == ["metric-counter-no-total", "metric-unregistered"]
+
+
+def test_metrics_gate_series_and_fstring_patterns(tmp_path):
+    pkg = _sf(tmp_path, "pkg/mod.py", """\
+        class M:
+            def pick(self, reason, metrics):
+                metrics.inc(f"scheduler_pick_{reason}")
+        """)
+    gate = _sf(tmp_path, "bench.py", """\
+        def report(mc):
+            ok = mc.get("scheduler_pick_queue_depth", 0)
+            bad = mc.get("totally_unknown_series", 0)
+        """)
+    out = check_metrics.check(_ctx(tmp_path, package_files=[pkg],
+                                   gate_files=[gate]))
+    assert [v.rule for v in out] == ["metric-unregistered"]
+    assert "totally_unknown_series" in out[0].msg
+
+
+# ---- jit purity checker ------------------------------------------------
+
+def test_jit_impure_time_and_env_caught(tmp_path):
+    sf = _sf(tmp_path, "pkg/mod.py", """\
+        import os
+        import time
+        import jax
+
+        def step(x):
+            t0 = time.perf_counter()
+            flag = os.environ.get("DLI_SPEC_WAVE")
+            return x * t0
+
+        fn = jax.jit(step)
+        """)
+    out = check_jit.check(_ctx(tmp_path, package_files=[sf]))
+    assert _rules(out) == ["jit-impure", "jit-impure"]
+
+
+def test_jit_impure_through_callee_caught(tmp_path):
+    sf = _sf(tmp_path, "pkg/mod.py", """\
+        import jax
+        import numpy as np
+
+        def noise(shape):
+            return np.random.randn(*shape)
+
+        def step(x):
+            return x + noise(x.shape)
+
+        fn = jax.jit(step)
+        """)
+    out = check_jit.check(_ctx(tmp_path, package_files=[sf]))
+    assert _rules(out) == ["jit-impure"]
+    assert "np.random" in out[0].msg
+
+
+def test_jit_logging_and_lock_caught(tmp_path):
+    sf = _sf(tmp_path, "pkg/mod.py", """\
+        import jax
+        import logging
+
+        log = logging.getLogger("x")
+
+        class Engine:
+            def _block(self, x):
+                log.info("tracing now")
+                with self._lock:
+                    y = x + 1
+                return y
+
+            def compile(self):
+                return jax.jit(self._block)
+        """)
+    out = check_jit.check(_ctx(tmp_path, package_files=[sf]))
+    assert _rules(out) == ["jit-impure", "jit-impure"]
+
+
+def test_jit_in_loop_caught_and_cached_ok(tmp_path):
+    sf = _sf(tmp_path, "pkg/mod.py", """\
+        import jax
+
+        def bad(fs, xs):
+            out = []
+            for x in xs:
+                fn = jax.jit(lambda v: v + 1)
+                out.append(fn(x))
+            return out
+
+        def good(cache, key, f):
+            if key not in cache:
+                cache[key] = jax.jit(f)
+            return cache[key]
+        """)
+    out = check_jit.check(_ctx(tmp_path, package_files=[sf]))
+    assert _rules(out) == ["jit-in-loop"]
+
+
+def test_jit_pure_function_clean(tmp_path):
+    sf = _sf(tmp_path, "pkg/mod.py", """\
+        import jax
+        import jax.numpy as jnp
+
+        def step(x, w):
+            return jnp.dot(x, w)
+
+        fn = jax.jit(step, donate_argnums=(0,))
+        """)
+    out = check_jit.check(_ctx(tmp_path, package_files=[sf]))
+    assert out == []
+
+
+# ---- thread hygiene checker --------------------------------------------
+
+def test_threads_silent_except_caught_and_pragma(tmp_path):
+    sf = _sf(tmp_path, "pkg/runtime/mod.py", """\
+        def flusher():
+            try:
+                flush()
+            except Exception:
+                pass
+
+        def teardown():
+            try:
+                close()
+            # dlilint: disable=silent-except
+            except Exception:
+                pass
+        """)
+    out = check_threads.check(_ctx(tmp_path, package_files=[sf],
+                                   runtime_files=[sf]))
+    assert _rules(out) == ["silent-except"]
+    assert out[0].line == 4
+
+
+def test_threads_logged_except_clean(tmp_path):
+    sf = _sf(tmp_path, "pkg/runtime/mod.py", """\
+        import logging
+        log = logging.getLogger("x")
+
+        def flusher():
+            try:
+                flush()
+            except Exception as e:
+                log.warning("flush failed: %r", e)
+        """)
+    out = check_threads.check(_ctx(tmp_path, package_files=[sf],
+                                   runtime_files=[sf]))
+    assert out == []
+
+
+_CYCLING_CLASS = """\
+    import threading
+
+    class Biter:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def one_way(self):
+            with self._a:
+                with self._b:
+                    return 1
+
+        def other_way(self):
+            with self._b:
+                with self._a:
+                    return 2
+    """
+
+
+def test_threads_lock_cycle_caught(tmp_path):
+    sf = _sf(tmp_path, "pkg/mod.py", _CYCLING_CLASS)
+    out = check_threads.check(_ctx(tmp_path, package_files=[sf]))
+    assert _rules(out) == ["lock-order-cycle"]
+    assert "Biter._a" in out[0].msg and "Biter._b" in out[0].msg
+
+
+def test_threads_cycle_through_method_call_caught(tmp_path):
+    sf = _sf(tmp_path, "pkg/mod.py", """\
+        import threading
+
+        class Sneaky:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def helper(self):
+                with self._a:
+                    return 1
+
+            def outer(self):
+                with self._b:
+                    return self.helper()
+
+            def direct(self):
+                with self._a:
+                    with self._b:
+                        return 2
+        """)
+    out = check_threads.check(_ctx(tmp_path, package_files=[sf]))
+    assert _rules(out) == ["lock-order-cycle"]
+
+
+def test_threads_consistent_order_clean(tmp_path):
+    sf = _sf(tmp_path, "pkg/mod.py", """\
+        import threading
+
+        class Fine:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def m1(self):
+                with self._a:
+                    with self._b:
+                        return 1
+
+            def m2(self):
+                with self._a:
+                    with self._b:
+                        return 2
+        """)
+    out = check_threads.check(_ctx(tmp_path, package_files=[sf]))
+    assert out == []
+
+
+def test_threads_locks_factory_recognized(tmp_path):
+    sf = _sf(tmp_path, "pkg/mod.py", _CYCLING_CLASS.replace(
+        "threading.Lock()", 'locks.lock("x")').replace(
+        "import threading", "from pkg.utils import locks"))
+    out = check_threads.check(_ctx(tmp_path, package_files=[sf]))
+    assert _rules(out) == ["lock-order-cycle"]
+
+
+# ---- the real tree is the fixture for "runs clean" ---------------------
+
+@pytest.fixture(scope="module")
+def repo_results():
+    return run_all()
+
+
+@pytest.mark.parametrize("checker", sorted(CHECKERS))
+def test_real_tree_clean(repo_results, checker):
+    assert repo_results[checker] == [], (
+        f"dlilint {checker} found violations on the committed tree — "
+        f"run `python -m tools.dlilint` (docs/static_analysis.md)")
+
+
+def test_knob_registry_three_way_parity():
+    """Acceptance: code knobs == registry == docs, exactly. "Code"
+    includes shell scripts: a check.sh-only knob (DLI_TSAN_FAST) is a
+    knob like any other."""
+    from distributed_llm_inferencing_tpu.utils import knobs
+    ctx = Ctx.for_repo()
+    reads = {name for _, _, name in
+             check_knobs.collect_env_reads(
+                 ctx.package_files + ctx.gate_files)}
+    reads |= {name for _, _, name in
+              check_knobs.collect_shell_reads(ctx.shell_paths)}
+    assert reads == set(knobs.registry()), (
+        "registry drifted from code reads")
+    with open(ctx.serving_md, encoding="utf-8") as f:
+        serving = f.read()
+    missing = [n for n in knobs.registry() if n not in serving]
+    assert not missing, f"knobs missing from docs/serving.md: {missing}"
+
+
+def test_cli_exits_zero_on_clean_tree():
+    import subprocess
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run([sys.executable, "-m", "tools.dlilint"],
+                       cwd=root, capture_output=True, text=True,
+                       timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "— clean" in r.stdout

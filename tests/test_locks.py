@@ -1,0 +1,199 @@
+"""Runtime lock-order watchdog suite (utils/locks.py).
+
+The watchdog learns the fleet-wide acquisition-order graph from real
+executions: edge A -> B when some thread acquired B while holding A, a
+cycle = a potential deadlock even if this run never interleaved badly
+enough to hang. ``DLI_LOCK_CHECK=1`` arms it (scripts/check.sh does for
+the chaos suite; the conftest session gate fails the run on any cycle
+report). Every test here resets the watchdog behind itself so the
+deliberate inversions can't leak into that gate.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from distributed_llm_inferencing_tpu.utils import locks
+
+
+@pytest.fixture(autouse=True)
+def _armed(monkeypatch):
+    # save-around, NOT reset: when check.sh runs this file in the same
+    # pytest session as the chaos suite, reports a real chaos inversion
+    # accumulated must survive for the conftest session gate — only the
+    # deliberate inversions seeded HERE may be discarded
+    monkeypatch.setenv("DLI_LOCK_CHECK", "1")
+    saved = locks.watchdog().snapshot()
+    locks.watchdog().reset()
+    yield
+    locks.watchdog().restore(saved)
+
+
+def _run(*fns):
+    """Run each callable in its own thread, strictly one after another
+    (inversions are detected from the learned graph — the threads never
+    need to actually contend, and must not, or the test would deadlock
+    for real)."""
+    for fn in fns:
+        t = threading.Thread(target=fn)
+        t.start()
+        t.join(timeout=10)
+        assert not t.is_alive()
+
+
+def test_deliberate_inversion_produces_exactly_one_report():
+    a, b = locks.lock("inv.A"), locks.lock("inv.B")
+
+    def order_ab():
+        with a:
+            with b:
+                pass
+
+    def order_ba():
+        with b:
+            with a:
+                pass
+
+    _run(order_ab, order_ba)
+    reports = locks.cycle_reports()
+    assert len(reports) == 1, reports
+    (rep,) = reports
+    assert rep["kind"] == "lock_order_cycle"
+    assert set(rep["edge"]) == {"inv.A", "inv.B"}
+    assert rep["cycle"][0] == rep["cycle"][-1]          # a closed loop
+    assert set(rep["cycle"]) == {"inv.A", "inv.B"}
+    # the witness names the thread that established the opposite order
+    assert rep["witness"] is not None
+
+
+def test_consistent_order_stays_silent():
+    a, b, c = (locks.lock("ok.A"), locks.lock("ok.B"), locks.lock("ok.C"))
+
+    def nested():
+        with a:
+            with b:
+                with c:
+                    pass
+
+    _run(*[nested] * 4)
+    assert locks.cycle_reports() == []
+    # the graph learned the edges all the same
+    edges = locks.watchdog().edges()
+    assert "ok.B" in edges["ok.A"] and "ok.C" in edges["ok.B"]
+
+
+def test_three_lock_cycle_detected():
+    a, b, c = (locks.lock("tri.A"), locks.lock("tri.B"), locks.lock("tri.C"))
+    _run(lambda: _nest(a, b), lambda: _nest(b, c), lambda: _nest(c, a))
+    reports = locks.cycle_reports()
+    assert len(reports) == 1, reports
+    assert set(reports[0]["cycle"]) == {"tri.A", "tri.B", "tri.C"}
+
+
+def _nest(outer, inner):
+    with outer:
+        with inner:
+            pass
+
+
+def test_same_name_different_instances_not_a_cycle():
+    # two arenas (one per model) legitimately nest under a fleet sweep;
+    # same ROLE nesting across instances must not read as A -> A
+    outer = locks.lock("multi.sweep")
+    a1, a2 = locks.lock("multi.arena"), locks.lock("multi.arena")
+    with outer:
+        with a1:
+            with a2:
+                pass
+    assert locks.cycle_reports() == []
+
+
+def test_rlock_reentrant_acquire_is_not_a_self_deadlock():
+    r = locks.rlock("re.R")
+    with r:
+        with r:
+            pass
+    assert locks.cycle_reports() == []
+
+
+def test_blocking_reacquire_of_plain_lock_reported():
+    lk = locks.lock("dead.L")
+    reported = threading.Event()
+
+    def victim():
+        lk.acquire()
+        # the watchdog must report BEFORE this blocks for real
+        lk.acquire()
+        lk.release()
+
+    t = threading.Thread(target=victim, daemon=True)
+    t.start()
+    for _ in range(100):
+        if locks.watchdog().reports("self_deadlock"):
+            reported.set()
+            break
+        time.sleep(0.02)
+    assert reported.is_set()
+    # threading.Lock may be released from any thread: free the victim
+    lk.release()
+    t.join(timeout=5)
+    assert not t.is_alive()
+
+
+def test_condition_wait_notify_clean():
+    cv = locks.condition("cv.test")
+    got = []
+
+    def waiter():
+        with cv:
+            cv.wait(timeout=5)
+            got.append(1)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    with cv:
+        cv.notify_all()
+    t.join(timeout=5)
+    assert got == [1]
+    assert locks.cycle_reports() == []
+
+
+def test_held_too_long_reported(monkeypatch):
+    monkeypatch.setenv("DLI_LOCK_HELD_WARN_MS", "10")
+    lk = locks.lock("slow.L")
+    with lk:
+        time.sleep(0.05)
+    reps = locks.watchdog().reports("held_too_long")
+    assert reps and reps[0]["lock"] == "slow.L"
+    assert reps[0]["held_ms"] >= 10
+    # advisory only: never part of the cycle gate
+    assert locks.cycle_reports() == []
+
+
+def test_disabled_returns_stock_primitives(monkeypatch):
+    monkeypatch.delenv("DLI_LOCK_CHECK", raising=False)
+    assert isinstance(locks.lock("x"), type(threading.Lock()))
+    assert isinstance(locks.rlock("x"), type(threading.RLock()))
+    assert isinstance(locks.condition("x"), threading.Condition)
+
+
+def test_runtime_store_creates_instrumented_locks_when_armed():
+    # integration: the runtime factories actually flow through
+    # utils/locks — a group-commit Store exercises lock + rlock +
+    # condition across its flusher thread with zero reports
+    from distributed_llm_inferencing_tpu.runtime.state import Store
+    st = Store(":memory:", group_commit=True)
+    try:
+        assert isinstance(st._lock, locks._Instrumented)
+        assert isinstance(st._gc_flush_lock, locks._Instrumented)
+        nid = st.add_node("n0", "127.0.0.1", 1234)
+        rid = st.submit_request("tiny", "hello")
+        st.mark_completed(rid, "out", nid, 0.01, 10.0, barrier=True)
+        assert st.get_request(rid)["status"] == "completed"
+        assert st.get_node(nid)["name"] == "n0"
+    finally:
+        st.close()
+    assert locks.cycle_reports() == []

@@ -1,0 +1,52 @@
+"""dlilint — repo-native static analysis for this codebase's invariants.
+
+Eight PRs established invariants that only reviewer memory enforced:
+metrics pre-registered at 0 (the PR 5 rule), every ``DLI_*`` knob
+documented with a default, no host work inside jitted decode code, lock
+discipline across 20+ runtime locks. dlilint machine-checks them as a
+hard CI gate (``scripts/check.sh`` "dlilint" step):
+
+==================  ===================================================
+checker             rules
+==================  ===================================================
+knobs               knob-unregistered, knob-dead, knob-undocumented,
+                    knob-doc-dead, knob-table-stale
+metrics             metric-unregistered, metric-counter-no-total,
+                    metric-not-preregistered
+jit                 jit-impure, jit-in-loop
+threads             lock-order-cycle, silent-except
+==================  ===================================================
+
+Run: ``python -m tools.dlilint`` (exit 0 = clean). Suppress a reviewed
+exception with ``# dlilint: disable=<rule>`` on (or right above) the
+line. Full docs: docs/static_analysis.md. The dynamic twin of the
+``threads`` checker is the ``DLI_LOCK_CHECK=1`` runtime watchdog in
+``utils/locks.py``, armed during the chaos suite in CI.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from . import check_jit, check_knobs, check_metrics, check_threads
+from .core import Ctx, Violation
+
+CHECKERS = {
+    "knobs": check_knobs.check,
+    "metrics": check_metrics.check,
+    "jit": check_jit.check,
+    "threads": check_threads.check,
+}
+
+
+def run_all(ctx: Ctx = None, only=None) -> Dict[str, List[Violation]]:
+    """Run every checker (or the named subset) over ``ctx`` (defaults
+    to the real repo). Returns checker -> violations."""
+    if ctx is None:
+        ctx = Ctx.for_repo()
+    out: Dict[str, List[Violation]] = {}
+    for name, fn in CHECKERS.items():
+        if only and name not in only:
+            continue
+        out[name] = fn(ctx)
+    return out

@@ -1,0 +1,66 @@
+"""CLI: ``python -m tools.dlilint [--only a,b] [--write-knob-table]``.
+
+Prints every violation (``path:line: [rule] message``) plus a
+per-checker count summary, and exits non-zero when anything fired —
+the form scripts/check.sh consumes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from . import CHECKERS, run_all
+from .core import Ctx
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.dlilint",
+        description="Repo-native invariant checkers (docs/static_analysis.md)")
+    ap.add_argument("--only", default="",
+                    help="comma list of checkers to run "
+                         f"({', '.join(CHECKERS)})")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: auto-detected)")
+    ap.add_argument("--write-knob-table", action="store_true",
+                    help="regenerate the docs/serving.md knob table from "
+                         "utils/knobs.py, then check")
+    args = ap.parse_args(argv)
+
+    ctx = Ctx.for_repo(args.root)
+    if args.write_knob_table:
+        from .check_knobs import write_knob_table
+        if ctx.serving_md is None:
+            print("dlilint: docs/serving.md not found", file=sys.stderr)
+            return 2
+        changed = write_knob_table(ctx.serving_md)
+        print(f"knob table: {'rewritten' if changed else 'already current'}")
+        ctx = Ctx.for_repo(args.root)   # re-read the docs we just wrote
+
+    only = {s.strip() for s in args.only.split(",") if s.strip()} or None
+    bad = sorted((only or set()) - set(CHECKERS))
+    if bad:
+        print(f"dlilint: unknown checker(s): {', '.join(bad)}",
+              file=sys.stderr)
+        return 2
+
+    results = run_all(ctx, only=only)
+    total = 0
+    for name in CHECKERS:
+        if name not in results:
+            continue
+        for v in sorted(results[name], key=lambda v: (v.path, v.line)):
+            print(v)
+        total += len(results[name])
+    print("--")
+    for name in CHECKERS:
+        if name in results:
+            print(f"dlilint {name}: {len(results[name])} violation(s)")
+    print(f"dlilint total: {total} violation(s) "
+          f"{'— FAIL' if total else '— clean'}")
+    return 1 if total else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,282 @@
+"""JIT purity checker: no host work inside traced code.
+
+Anything reachable from a ``jax.jit`` / ``pallas_call`` callable runs
+at *trace* time — a ``time.time()`` there stamps the compile, not the
+step; ``np.random`` silently freezes one draw into the compiled
+program; logging and lock acquisition execute once per compile and then
+never again, which is almost never what the author meant. And a
+``jax.jit(...)`` *constructed* inside a loop or per-request path builds
+a fresh cache entry per iteration — the classic recompile hazard
+(BENCH_r05's inversion was one of these at heart: compiles landing
+inside measured windows).
+
+Rules:
+
+- ``jit-impure``  — ``time.*``, ``np.random.*``, ``os.environ`` /
+  ``os.getenv``, logging/``print``, or lock acquisition inside a
+  function reachable from a ``jax.jit`` / ``pallas_call`` site.
+- ``jit-in-loop`` — ``jax.jit(...)`` called lexically inside a
+  ``for``/``while`` body (wrap once outside, or memoize in a program
+  cache keyed by static shape).
+
+Reachability is best-effort static analysis: from each jitted callable,
+same-module calls resolve by name (module functions, nested defs,
+``self.`` methods), and ``from X import f`` calls follow into package
+modules, to a bounded depth. Dynamic dispatch it cannot see; the
+checker is a tripwire, not a proof.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Set, Tuple
+
+from .core import Ctx, SourceFile, Violation, dotted_name, filter_suppressed
+
+RULES = ("jit-impure", "jit-in-loop")
+
+MAX_DEPTH = 3
+
+_TIME_CALLS = {"time.time", "time.perf_counter", "time.monotonic",
+               "time.sleep", "time.process_time", "time.thread_time"}
+_LOG_METHODS = {"debug", "info", "warning", "error", "exception",
+                "critical", "warn"}
+_LOG_BASES = {"log", "logger", "logging"}
+
+
+def _is_jit_call(node: ast.Call) -> bool:
+    dn = dotted_name(node.func)
+    return dn in ("jax.jit", "jit") or (dn or "").endswith(".jit")
+
+
+def _is_pallas_call(node: ast.Call) -> bool:
+    dn = dotted_name(node.func) or ""
+    return dn == "pallas_call" or dn.endswith(".pallas_call")
+
+
+class _Module:
+    """Per-file symbol tables for call resolution."""
+
+    def __init__(self, sf: SourceFile):
+        self.sf = sf
+        self.funcs: Dict[str, List[ast.AST]] = {}
+        self.imports: Dict[str, Tuple[str, str]] = {}   # name -> (mod, orig)
+        if sf.tree is None:
+            return
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.funcs.setdefault(node.name, []).append(node)
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for alias in node.names:
+                    self.imports[alias.asname or alias.name] = (
+                        node.module, alias.name)
+
+
+class _Impurity(ast.NodeVisitor):
+    """Scan ONE function body (not nested defs) for host work, and
+    collect outgoing calls for the reachability walk."""
+
+    def __init__(self, root_fn: ast.AST):
+        self.root = root_fn
+        self.impure: List[Tuple[int, str]] = []
+        self.calls: List[ast.Call] = []
+
+    def run(self):
+        for stmt in self.root.body:
+            self.visit(stmt)
+        return self
+
+    def visit_FunctionDef(self, node):
+        pass   # nested defs analyzed only if actually called
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        self.generic_visit(node)   # inline lambdas trace with the body
+
+    def visit_Call(self, node: ast.Call):
+        dn = dotted_name(node.func)
+        if dn in _TIME_CALLS:
+            self.impure.append((node.lineno, f"{dn}() traces host time "
+                                "into the compiled program"))
+        elif dn in ("os.getenv",):
+            self.impure.append((node.lineno,
+                                "os.getenv freezes env state at trace time"))
+        elif dn == "print":
+            self.impure.append((node.lineno,
+                                "print() runs once per compile, not per "
+                                "step (use jax.debug.print)"))
+        elif isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _LOG_METHODS:
+            base = dotted_name(node.func.value)
+            if base and (base in _LOG_BASES or base.startswith("logging.")
+                         or base.split(".")[-1] in _LOG_BASES):
+                self.impure.append((node.lineno,
+                                    f"logging call {base}.{node.func.attr} "
+                                    "inside traced code"))
+        elif isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "acquire":
+            self.impure.append((node.lineno,
+                                "lock acquisition inside traced code"))
+        self.calls.append(node)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute):
+        dn = dotted_name(node)
+        if dn:
+            if dn.startswith(("np.random.", "numpy.random.")):
+                self.impure.append((node.lineno,
+                                    f"{dn} draws host randomness at trace "
+                                    "time (use jax.random)"))
+                return   # don't re-report the inner np.random node
+            if dn in ("os.environ",) or dn.startswith("os.environ."):
+                self.impure.append((node.lineno,
+                                    "os.environ read freezes env state at "
+                                    "trace time"))
+                return   # don't re-report os.environ inside the chain
+        self.generic_visit(node)
+
+    def visit_With(self, node: ast.With):
+        for item in node.items:
+            dn = dotted_name(item.context_expr) or ""
+            if "lock" in dn.lower().rsplit(".", 1)[-1]:
+                self.impure.append((item.context_expr.lineno,
+                                    f"lock `{dn}` held around traced code"))
+        self.generic_visit(node)
+
+
+def _resolve_target(arg: ast.AST, mod: _Module) -> List[ast.AST]:
+    """Function-def nodes a jit first-argument may denote."""
+    if isinstance(arg, ast.Lambda):
+        return [arg]
+    if isinstance(arg, ast.Name):
+        return list(mod.funcs.get(arg.id, ()))
+    if isinstance(arg, ast.Attribute):
+        return list(mod.funcs.get(arg.attr, ()))
+    if isinstance(arg, ast.Call):
+        # functools.partial(fn, ...) / shard_map(fn, ...): first arg
+        if arg.args:
+            return _resolve_target(arg.args[0], mod)
+    return []
+
+
+def _scan_fn(fn: ast.AST) -> _Impurity:
+    if isinstance(fn, ast.Lambda):
+        imp = _Impurity.__new__(_Impurity)
+        imp.root, imp.impure, imp.calls = fn, [], []
+        imp.visit(fn.body)
+        return imp
+    return _Impurity(fn).run()
+
+
+def check(ctx: Ctx) -> List[Violation]:
+    violations: List[Violation] = []
+    files = {sf.rel: sf for sf in ctx.package_files}
+    modules = {sf.rel: _Module(sf) for sf in ctx.package_files}
+    # module path index for from-import resolution:
+    #   distributed_llm_inferencing_tpu/ops/rope.py  ->  "....ops.rope"
+    by_modname: Dict[str, _Module] = {}
+    for rel, mod in modules.items():
+        name = rel[:-3].replace(os.sep, ".")
+        if name.endswith(".__init__"):
+            name = name[: -len(".__init__")]
+        by_modname[name] = mod
+
+    def resolve_import(mod: _Module, called: str) -> List[Tuple[_Module, ast.AST]]:
+        ent = mod.imports.get(called)
+        if not ent:
+            return []
+        imod, orig = ent
+        # relative imports were flattened by ast (module keeps dots off);
+        # match by suffix against package module names
+        for name, m2 in by_modname.items():
+            if name == imod or name.endswith("." + imod):
+                return [(m2, fn) for fn in m2.funcs.get(orig, ())]
+        return []
+
+    for sf in ctx.package_files:
+        if sf.tree is None:
+            continue
+        mod = modules[sf.rel]
+
+        # --- jit-in-loop: jax.jit(...) lexically under For/While ------
+        loop_stack: List[ast.AST] = []
+
+        def walk_loops(node):
+            in_loop = bool(loop_stack)
+            if isinstance(node, ast.Call) and _is_jit_call(node) and in_loop:
+                violations.append(Violation(
+                    "jit-in-loop", sf.rel, node.lineno,
+                    "jax.jit(...) constructed inside a loop — every "
+                    "iteration builds a fresh traced callable (memoize "
+                    "it, or hoist outside)"))
+            is_loop = isinstance(node, (ast.For, ast.While, ast.AsyncFor))
+            if is_loop:
+                loop_stack.append(node)
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    # a def inside a loop restarts the loop context
+                    saved, loop_stack[:] = list(loop_stack), []
+                    walk_loops(child)
+                    loop_stack[:] = saved
+                else:
+                    walk_loops(child)
+            if is_loop:
+                loop_stack.pop()
+
+        walk_loops(sf.tree)
+
+        # --- jit-impure: reachability from jit/pallas roots ------------
+        roots: List[Tuple[ast.AST, int]] = []
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call) \
+                    and (_is_jit_call(node) or _is_pallas_call(node)) \
+                    and node.args:
+                roots.extend((fn, node.lineno)
+                             for fn in _resolve_target(node.args[0], mod))
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    dn = dotted_name(dec) or ""
+                    if dn in ("jax.jit", "jit") or dn.endswith(".jit"):
+                        roots.append((node, node.lineno))
+                    elif isinstance(dec, ast.Call):
+                        ddn = dotted_name(dec.func) or ""
+                        if ddn.endswith("partial") and dec.args:
+                            adn = dotted_name(dec.args[0]) or ""
+                            if adn in ("jax.jit", "jit") \
+                                    or adn.endswith(".jit"):
+                                roots.append((node, node.lineno))
+
+        seen: Set[int] = set()
+        queue: List[Tuple[_Module, ast.AST, int, int]] = [
+            (mod, fn, root_line, 0) for fn, root_line in roots]
+        while queue:
+            cmod, fn, root_line, depth = queue.pop()
+            if id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            imp = _scan_fn(fn)
+            for line, why in imp.impure:
+                violations.append(Violation(
+                    "jit-impure", cmod.sf.rel, line,
+                    f"{why} (reachable from the jit/pallas_call site at "
+                    f"{sf.rel}:{root_line})"))
+            if depth >= MAX_DEPTH:
+                continue
+            for call in imp.calls:
+                targets: List[Tuple[_Module, ast.AST]] = []
+                f = call.func
+                if isinstance(f, ast.Name):
+                    targets += [(cmod, t) for t in cmod.funcs.get(f.id, ())]
+                    targets += resolve_import(cmod, f.id)
+                elif isinstance(f, ast.Attribute) and \
+                        isinstance(f.value, ast.Name) and f.value.id == "self":
+                    targets += [(cmod, t)
+                                for t in cmod.funcs.get(f.attr, ())]
+                for tmod, t in targets:
+                    queue.append((tmod, t, root_line, depth + 1))
+
+    return filter_suppressed(violations, files)

@@ -1,0 +1,387 @@
+"""Metrics checker: every referenced ``dli_*`` name is registered.
+
+The PR 5 rule: a scrape (or the TSDB catalog behind it) must never
+confuse "no events yet" with "metric not exported", so counters the
+dashboards/benches/docs key off are pre-registered at 0 near the owning
+subsystem's init. This checker machine-checks both halves:
+
+- ``metric-unregistered``   — a metric name referenced by the dashboard
+  (``TS_METRICS`` + literal ``dli_*`` strings), the bench/TSDB smoke
+  gates, or the docs, with no registration call in code.
+- ``metric-counter-no-total`` — a counter referenced in exposition form
+  without its ``_total`` suffix (the exposition always appends it, so
+  the bare name can never exist on the wire).
+- ``metric-not-preregistered`` — a counter or gauge the dashboard's
+  ``TS_METRICS`` charts that is never pre-registered at 0
+  (``inc(name, 0)`` / ``gauge(name, 0)``), so its series would not
+  exist until the first event.
+
+Registration sites are found by AST over the whole package:
+``.inc(name, ...)`` / ``.gauge(name, ...)`` / ``.observe(name, ...)``
+calls with a literal name, an f-string name (holes become wildcards), or
+a loop variable over a literal tuple (the pre-registration idiom); plus
+direct TSDB series records ``.record(node, name, ...)``.
+
+Reference sites, per source:
+
+- dashboard: entries of the ``TS_METRICS`` JS array (TSDB series names)
+  and any ``dli_*`` string (exposition names);
+- bench.py / telemetry_smoke.py: ``params={"metric": ...}`` values,
+  ``delta("...")`` / ``q("...", ...)`` helper calls, ``.get("...")`` on
+  counter/gauge snapshot dicts, and ``dli_*`` literals;
+- docs/*.md: ``dli_*`` tokens, with ``{a,b,c}`` brace alternation
+  expanded and ``<placeholder>``/``*`` treated as wildcards.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Set, Tuple
+
+from .core import Ctx, SourceFile, Violation, const_num, const_str, \
+    filter_suppressed, joined_str_pattern
+
+RULES = ("metric-unregistered", "metric-counter-no-total",
+         "metric-not-preregistered")
+
+_NAME_SAN = re.compile(r"[^a-zA-Z0-9_:]")
+_DLI_TOKEN = re.compile(r"dli_[a-zA-Z0-9_{},<>*]+")
+_TS_METRICS_RE = re.compile(
+    r"TS_METRICS\s*=\s*\[(.*?)\];", re.S)
+_TS_ENTRY_RE = re.compile(r"\[\s*'([a-z0-9_]+)'")
+# dict-snapshot receivers whose .get()/[] keys are metric names
+_SNAPSHOT_RECEIVERS = {"mc", "wc", "counters", "gauges", "cm"}
+_GATE_HELPERS = {"delta", "q"}
+
+
+def _san(name: str) -> str:
+    s = _NAME_SAN.sub("_", name)
+    if not s or s[0].isdigit():
+        s = "_" + s
+    return s
+
+
+class Registrations:
+    """Metric names registered in code, exact + patterns."""
+
+    def __init__(self):
+        self.counters: Set[str] = set()
+        self.gauges: Set[str] = set()
+        self.hists: Dict[str, str] = {}     # base -> unit ("" = none)
+        self.series: Set[str] = set()       # direct tsdb.record names
+        self.counter_patterns: List[str] = []   # regex on base name
+        self.gauge_patterns: List[str] = []
+        self.hist_patterns: List[str] = []
+        self.prereg_zero: Set[str] = set()  # inc(x, 0)/gauge(x, 0) bases
+        self.prereg_patterns: List[str] = []
+
+    # ---- queries ------------------------------------------------------
+
+    def _match(self, base: str, exact: Set[str], patterns: List[str]) -> bool:
+        if base in exact:
+            return True
+        return any(re.fullmatch(p, base) for p in patterns)
+
+    def is_counter(self, base: str) -> bool:
+        return self._match(base, self.counters, self.counter_patterns)
+
+    def is_gauge(self, base: str) -> bool:
+        return self._match(base, self.gauges, self.gauge_patterns)
+
+    def is_hist(self, base: str) -> bool:
+        return self._match(base, set(self.hists), self.hist_patterns)
+
+    def preregistered(self, base: str) -> bool:
+        return self._match(base, self.prereg_zero, self.prereg_patterns)
+
+    def series_exists(self, name: str) -> bool:
+        """A registry/series name: stripped counter base (rates), gauge
+        base, a histogram base (bench gates read ``snapshot()``
+        percentiles by the same name), or a direct TSDB record."""
+        return (name in self.series or self.is_counter(name)
+                or self.is_gauge(name) or self.is_hist(name))
+
+    def exposition_exists(self, token: str) -> bool:
+        """``token`` (wire form ``dli_...``, possibly with wildcards
+        from docs placeholders) resolves against some registered
+        family."""
+        if not token.startswith("dli_"):
+            return False
+        body = token[4:]
+        if "*" in body or "<" in body:
+            rx = re.escape(body).replace(r"\*", "[A-Za-z0-9_]*")
+            rx = re.sub(r"\\<[^>]*\\>", "[A-Za-z0-9_]+", rx)
+            return self._exposition_rx(rx)
+        # counter: dli_<base>_total
+        if body.endswith("_total") and self.is_counter(body[:-6]):
+            return True
+        # gauge: dli_<base>
+        if self.is_gauge(body):
+            return True
+        # histogram families: dli_<base>[_<unit>][_bucket|_sum|_count]
+        for suffix in ("", "_bucket", "_sum", "_count"):
+            if suffix and body.endswith(suffix):
+                body2 = body[: -len(suffix)]
+            elif suffix:
+                continue
+            else:
+                body2 = body
+            for base, unit in self.hists.items():
+                if body2 == (f"{_san(base)}_{unit}" if unit else _san(base)):
+                    return True
+            if any(re.fullmatch(p + r"(_[a-z]+)?", body2)
+                   for p in self.hist_patterns):
+                return True
+        return False
+
+    def _exposition_rx(self, rx: str) -> bool:
+        for base in self.counters:
+            if re.fullmatch(rx, _san(base) + "_total"):
+                return True
+        for base in self.gauges:
+            if re.fullmatch(rx, _san(base)):
+                return True
+        for base, unit in self.hists.items():
+            family = f"{_san(base)}_{unit}" if unit else _san(base)
+            for sfx in ("", "_bucket", "_sum", "_count"):
+                if re.fullmatch(rx, family + sfx):
+                    return True
+        return False
+
+
+def _loop_const_names(fn_node: ast.AST) -> Dict[str, List[str]]:
+    """loop-var -> constants for the registration idioms
+    ``for name in ("a", "b"):`` and
+    ``for key, mname in (("k1", "m1"), ("k2", "m2")):`` anywhere under
+    ``fn_node``."""
+    out: Dict[str, List[str]] = {}
+    for node in ast.walk(fn_node):
+        if not (isinstance(node, ast.For)
+                and isinstance(node.iter, (ast.Tuple, ast.List))):
+            continue
+        if isinstance(node.target, ast.Name):
+            vals = [const_str(e) for e in node.iter.elts]
+            if all(v is not None for v in vals):
+                out.setdefault(node.target.id, []).extend(vals)
+        elif isinstance(node.target, ast.Tuple) and all(
+                isinstance(t, ast.Name) for t in node.target.elts):
+            width = len(node.target.elts)
+            rows = [e for e in node.iter.elts
+                    if isinstance(e, (ast.Tuple, ast.List))
+                    and len(e.elts) == width]
+            if len(rows) == len(node.iter.elts):
+                for i, t in enumerate(node.target.elts):
+                    vals = [const_str(r.elts[i]) for r in rows]
+                    if all(v is not None for v in vals):
+                        out.setdefault(t.id, []).extend(vals)
+    return out
+
+
+def collect_registrations(files) -> Registrations:
+    reg = Registrations()
+    for sf in files:
+        if sf.tree is None:
+            continue
+        loops = _loop_const_names(sf.tree)
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if not isinstance(fn, ast.Attribute) or not node.args:
+                continue
+            meth = fn.attr
+            if meth in ("inc", "gauge", "observe"):
+                arg = node.args[0]
+                names, pattern = [], None
+                s = const_str(arg)
+                if s is not None:
+                    names = [s]
+                elif isinstance(arg, ast.JoinedStr):
+                    pattern = joined_str_pattern(arg)[0]
+                elif isinstance(arg, ast.Name) and arg.id in loops:
+                    names = loops[arg.id]
+                else:
+                    continue
+                zero = (len(node.args) > 1
+                        and const_num(node.args[1]) == 0.0)
+                if meth == "inc":
+                    reg.counters.update(names)
+                    if pattern:
+                        reg.counter_patterns.append(pattern)
+                    # a bare inc() (no value) at init is not a
+                    # pre-registration; inc(x, 0) is
+                    if zero:
+                        reg.prereg_zero.update(names)
+                        if pattern:
+                            reg.prereg_patterns.append(pattern)
+                elif meth == "gauge":
+                    reg.gauges.update(names)
+                    if pattern:
+                        reg.gauge_patterns.append(pattern)
+                    if zero:
+                        reg.prereg_zero.update(names)
+                        if pattern:
+                            reg.prereg_patterns.append(pattern)
+                else:
+                    unit = "seconds"
+                    for kw in node.keywords:
+                        if kw.arg == "unit":
+                            unit = const_str(kw.value) or ""
+                    for n in names:
+                        reg.hists[n] = unit
+                    if pattern:
+                        reg.hist_patterns.append(pattern)
+            elif meth == "record" and len(node.args) >= 3:
+                s = const_str(node.args[1])
+                if s is not None:
+                    reg.series.add(s)
+    return reg
+
+
+# ---- reference extraction ---------------------------------------------
+
+def dashboard_refs(sf: SourceFile) -> Tuple[List[Tuple[int, str]],
+                                            List[Tuple[int, str]]]:
+    """(series_refs, exposition_refs) as (line, name) pairs."""
+    series, expo = [], []
+    m = _TS_METRICS_RE.search(sf.text)
+    if m:
+        base_line = sf.text[: m.start()].count("\n") + 1
+        for e in _TS_ENTRY_RE.finditer(m.group(1)):
+            line = base_line + m.group(1)[: e.start()].count("\n")
+            series.append((line, e.group(1)))
+    for i, line_text in enumerate(sf.text.splitlines(), 1):
+        for tok in re.finditer(r"\bdli_[a-z0-9_]+", line_text):
+            expo.append((i, tok.group(0)))
+    return series, expo
+
+
+def gate_refs(sf: SourceFile) -> Tuple[List[Tuple[int, str]],
+                                       List[Tuple[int, str]]]:
+    """Metric names the bench/smoke gates key off."""
+    series, expo = [], []
+    if sf.tree is None:
+        return series, expo
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            # counters.get("name") / mc.get("name", 0)
+            if (isinstance(fn, ast.Attribute) and fn.attr == "get"
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id in _SNAPSHOT_RECEIVERS and node.args):
+                s = const_str(node.args[0])
+                if s is not None:
+                    series.append((node.lineno, s))
+            # delta("name") / q("name", ...)
+            elif (isinstance(fn, ast.Name) and fn.id in _GATE_HELPERS
+                    and node.args):
+                s = const_str(node.args[0])
+                if s is not None:
+                    series.append((node.lineno, s))
+            # requests.get(..., params={"metric": "name"}) — a
+            # /api/timeseries query; a bare {"metric": ...} dict
+            # elsewhere is just someone's result schema
+            for kw in node.keywords:
+                if kw.arg == "params" and isinstance(kw.value, ast.Dict):
+                    for k, v in zip(kw.value.keys, kw.value.values):
+                        if k is not None and const_str(k) == "metric":
+                            s = const_str(v)
+                            if s is not None:
+                                series.append((v.lineno, s))
+        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+            if re.fullmatch(r"dli_[a-z0-9_]+", node.value):
+                expo.append((node.lineno, node.value))
+    return series, expo
+
+
+def doc_refs(path: str) -> List[Tuple[int, str]]:
+    out = []
+    with open(path, encoding="utf-8") as f:
+        for i, line in enumerate(f, 1):
+            for m in _DLI_TOKEN.finditer(line):
+                # skip path components (~/.cache/dli_models) — a metric
+                # reference is never preceded by / . or -
+                if m.start() and line[m.start() - 1] in "/.-":
+                    continue
+                tok = m.group(0).rstrip("_")
+                for expanded in _expand_braces(tok):
+                    out.append((i, expanded))
+    return out
+
+
+def _expand_braces(tok: str) -> List[str]:
+    """``dli_cost_{queue,prefill}_x`` -> both concrete names."""
+    m = re.search(r"\{([^{}]*)\}", tok)
+    if not m:
+        return [tok]
+    alts = m.group(1).split(",") or [""]
+    out = []
+    for a in alts:
+        out.extend(_expand_braces(tok[: m.start()] + a + tok[m.end():]))
+    return out
+
+
+# ---- the check --------------------------------------------------------
+
+def check(ctx: Ctx) -> List[Violation]:
+    violations: List[Violation] = []
+    files = {sf.rel: sf for sf in
+             ctx.package_files + ctx.gate_files
+             + ([ctx.dashboard_file] if ctx.dashboard_file else [])}
+    reg = collect_registrations(ctx.package_files)
+
+    ts_series_refs: List[Tuple[str, int, str]] = []
+    if ctx.dashboard_file is not None:
+        series, expo = dashboard_refs(ctx.dashboard_file)
+        rel = ctx.dashboard_file.rel
+        ts_series_refs += [(rel, ln, n) for ln, n in series]
+        for ln, tok in expo:
+            if not reg.exposition_exists(tok):
+                violations.append(Violation(
+                    "metric-unregistered", rel, ln,
+                    f"dashboard references {tok}, never registered"))
+    for sf in ctx.gate_files:
+        series, expo = gate_refs(sf)
+        for ln, name in series:
+            if not reg.series_exists(name):
+                violations.append(Violation(
+                    "metric-unregistered", sf.rel, ln,
+                    f"gate keys off series {name!r}, never registered "
+                    f"(no inc/gauge/record site)"))
+        for ln, tok in expo:
+            if not reg.exposition_exists(tok):
+                violations.append(Violation(
+                    "metric-unregistered", sf.rel, ln,
+                    f"gate references {tok}, never registered"))
+    for path in ctx.doc_paths:
+        rel = path[len(ctx.root) + 1:] if path.startswith(ctx.root) else path
+        for ln, tok in doc_refs(path):
+            if reg.exposition_exists(tok):
+                continue
+            body = tok[4:]
+            if reg.is_counter(body):
+                violations.append(Violation(
+                    "metric-counter-no-total", rel, ln,
+                    f"doc references counter {tok} without _total — the "
+                    f"exposed name is {tok}_total"))
+            else:
+                violations.append(Violation(
+                    "metric-unregistered", rel, ln,
+                    f"doc references {tok}, never registered"))
+
+    # TS_METRICS chart names: must exist as series AND (counters/gauges)
+    # be pre-registered at 0
+    for rel, ln, name in ts_series_refs:
+        if not reg.series_exists(name):
+            violations.append(Violation(
+                "metric-unregistered", rel, ln,
+                f"TS_METRICS charts series {name!r}, never registered"))
+        elif name not in reg.series and not reg.preregistered(name):
+            violations.append(Violation(
+                "metric-not-preregistered", rel, ln,
+                f"TS_METRICS charts {name!r} but no inc({name!r}, 0) / "
+                f"gauge({name!r}, 0) pre-registration exists — the "
+                f"series is invisible until the first event (PR 5 rule)"))
+
+    return filter_suppressed(violations, files)

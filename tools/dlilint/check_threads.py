@@ -1,0 +1,213 @@
+"""Thread hygiene: static lock-order graph + silent exception swallows.
+
+The runtime holds 20+ locks across master/state/batcher/kvtier/tsdb/
+worker, and every new background loop (telemetry scrape, group-commit
+flusher, disagg threads — and next the AMP planner and live-migration
+movers) threads through several of them. Two rules:
+
+- ``lock-order-cycle`` — build the static acquisition graph: nodes are
+  ``Class.attr`` lock attributes (``self._x = threading.Lock()`` or the
+  ``utils.locks`` factories), edges ``A -> B`` when a ``with self._b:``
+  (or a call to a method that takes it) appears inside a
+  ``with self._a:`` body. Calls are followed one level: ``self.m()``
+  into same-class methods, ``self.obj.m()`` into the class assigned to
+  ``self.obj`` in ``__init__`` when resolvable. A cycle fails the
+  build. The dynamic twin of this rule is ``utils/locks.py``
+  (``DLI_LOCK_CHECK=1``), armed during the chaos suite.
+- ``silent-except`` — an ``except``/``except Exception`` whose body is
+  only ``pass`` inside the runtime modules swallows faults from
+  scheduler/dispatcher/flusher threads with no trace. Log at least at
+  warning level, or carry a ``# dlilint: disable=silent-except`` pragma
+  with a justifying comment.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Ctx, SourceFile, Violation, dotted_name, filter_suppressed
+
+RULES = ("lock-order-cycle", "silent-except")
+
+_LOCK_CTORS = ("threading.Lock", "threading.RLock", "threading.Condition",
+               "Lock", "RLock", "Condition",
+               "locks.lock", "locks.rlock", "locks.condition")
+
+
+def _is_lock_ctor(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    dn = dotted_name(node.func) or ""
+    return dn in _LOCK_CTORS or dn.endswith((".locks.lock", ".locks.rlock",
+                                             ".locks.condition"))
+
+
+class _ClassInfo:
+    def __init__(self, name: str, sf: SourceFile, node: ast.ClassDef):
+        self.name = name
+        self.sf = sf
+        self.node = node
+        self.lock_attrs: Set[str] = set()
+        self.attr_types: Dict[str, str] = {}    # self.x = ClassName(...)
+        self.methods: Dict[str, ast.AST] = {}
+        # method -> self-lock attrs it acquires anywhere in its body
+        self.acquires: Dict[str, Set[str]] = {}
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name) and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _collect_classes(files) -> Dict[str, _ClassInfo]:
+    classes: Dict[str, _ClassInfo] = {}
+    for sf in files:
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            ci = _ClassInfo(node.name, sf, node)
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    ci.methods[item.name] = item
+            for meth in ci.methods.values():
+                for sub in ast.walk(meth):
+                    if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                        attr = _self_attr(sub.targets[0])
+                        if attr is None:
+                            continue
+                        if _is_lock_ctor(sub.value):
+                            ci.lock_attrs.add(attr)
+                        elif isinstance(sub.value, ast.Call):
+                            dn = dotted_name(sub.value.func)
+                            if dn and dn[0].isupper():
+                                ci.attr_types[attr] = dn.split(".")[-1]
+            classes[ci.name] = ci
+    for ci in classes.values():
+        for mname, meth in ci.methods.items():
+            acq = set()
+            for sub in ast.walk(meth):
+                if isinstance(sub, ast.With):
+                    for item in sub.items:
+                        attr = _self_attr(item.context_expr)
+                        if attr in ci.lock_attrs:
+                            acq.add(attr)
+            ci.acquires[mname] = acq
+    return classes
+
+
+def _build_edges(classes: Dict[str, _ClassInfo]
+                 ) -> Dict[Tuple[str, str], List]:
+    """(A, B) -> [witness (file, line), ...] where B acquired under A."""
+    edges: Dict[Tuple[str, str], List] = {}
+
+    def note(a: str, b: str, sf: SourceFile, line: int):
+        if a != b:
+            edges.setdefault((a, b), []).append((sf.rel, line))
+
+    for ci in classes.values():
+        for meth in ci.methods.values():
+            _walk_held(ci, meth, [], classes, note)
+    return edges
+
+
+def _walk_held(ci: _ClassInfo, node: ast.AST, held: List[str],
+               classes, note):
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, ast.With):
+            acquired = []
+            for item in child.items:
+                attr = _self_attr(item.context_expr)
+                if attr in ci.lock_attrs:
+                    name = f"{ci.name}.{attr}"
+                    for h in held:
+                        note(h, name, ci.sf, item.context_expr.lineno)
+                    acquired.append(name)
+            held.extend(acquired)
+            _walk_held(ci, child, held, classes, note)
+            del held[len(held) - len(acquired):]
+        elif isinstance(child, ast.Call) and held:
+            f = child.func
+            # self.m() -> same-class method's acquisitions
+            if isinstance(f, ast.Attribute) and \
+                    isinstance(f.value, ast.Name) and f.value.id == "self":
+                for attr in ci.acquires.get(f.attr, ()):
+                    for h in held:
+                        note(h, f"{ci.name}.{attr}", ci.sf, child.lineno)
+                # self.obj.m() -> the attr's class, when its ctor was seen
+            elif isinstance(f, ast.Attribute):
+                oattr = _self_attr(f.value)
+                if oattr is not None:
+                    tcls = classes.get(ci.attr_types.get(oattr, ""))
+                    if tcls is not None:
+                        for attr in tcls.acquires.get(f.attr, ()):
+                            for h in held:
+                                note(h, f"{tcls.name}.{attr}",
+                                     ci.sf, child.lineno)
+            _walk_held(ci, child, held, classes, note)
+        elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+            # nested defs run later, not under the current hold
+            _walk_held(ci, child, [], classes, note)
+        else:
+            _walk_held(ci, child, held, classes, note)
+
+
+def _find_cycles(edges: Dict[Tuple[str, str], List]) -> List[List[str]]:
+    graph: Dict[str, Set[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+    cycles, seen_keys = [], set()
+    for start in sorted(graph):
+        stack = [(start, [start])]
+        while stack:
+            node, path = stack.pop()
+            for nxt in sorted(graph.get(node, ())):
+                if nxt == start:
+                    cyc = path + [start]
+                    key = frozenset(cyc)
+                    if key not in seen_keys:
+                        seen_keys.add(key)
+                        cycles.append(cyc)
+                elif nxt not in path and len(path) < 8:
+                    stack.append((nxt, path + [nxt]))
+    return cycles
+
+
+def check(ctx: Ctx) -> List[Violation]:
+    violations: List[Violation] = []
+    files = {sf.rel: sf for sf in ctx.package_files}
+
+    # ---- silent-except (runtime modules only) -------------------------
+    for sf in ctx.runtime_files:
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            broad = node.type is None or (
+                isinstance(node.type, ast.Name)
+                and node.type.id in ("Exception", "BaseException"))
+            if broad and len(node.body) == 1 \
+                    and isinstance(node.body[0], ast.Pass):
+                violations.append(Violation(
+                    "silent-except", sf.rel, node.lineno,
+                    "bare `except Exception: pass` swallows faults from "
+                    "runtime threads silently — log at warning (or carry "
+                    "a justifying pragma)"))
+
+    # ---- static lock-order graph --------------------------------------
+    classes = _collect_classes(ctx.package_files)
+    edges = _build_edges(classes)
+    for cyc in _find_cycles(edges):
+        a, b = cyc[0], cyc[1]
+        rel, line = edges[(a, b)][0]
+        violations.append(Violation(
+            "lock-order-cycle", rel, line,
+            "static lock-acquisition cycle: " + " -> ".join(cyc)))
+
+    return filter_suppressed(violations, files)

@@ -1,0 +1,232 @@
+"""Shared infrastructure for the dlilint checkers.
+
+Everything is plain ``ast`` + file IO — no third-party deps, no imports
+of the runtime package except ``utils.knobs`` (a pure-data module). A
+checker is a function ``check(ctx) -> list[Violation]`` over a
+:class:`Ctx` describing which files play which role; tests build tiny
+synthetic ``Ctx`` objects around seeded-violation fixtures, CI builds
+the real one with :meth:`Ctx.for_repo`.
+
+Suppression: append ``# dlilint: disable=<rule>[,<rule>...]`` to the
+offending line (or the line directly above it), or put
+``# dlilint: disable-file=<rule>`` on any line to waive a rule for the
+whole file. Suppressions are for *reviewed* exceptions — the pragma is
+greppable precisely so a reviewer can audit every waiver.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_PRAGMA_RE = re.compile(r"#\s*dlilint:\s*disable=([a-z0-9_,\- ]+)")
+_PRAGMA_FILE_RE = re.compile(r"#\s*dlilint:\s*disable-file=([a-z0-9_,\- ]+)")
+
+
+@dataclass(frozen=True)
+class Violation:
+    rule: str
+    path: str          # repo-relative
+    line: int
+    msg: str
+
+    def __str__(self):
+        return f"{self.path}:{self.line}: [{self.rule}] {self.msg}"
+
+
+@dataclass
+class SourceFile:
+    """One parsed python file: AST + per-line pragma index."""
+
+    path: str                       # absolute
+    rel: str                        # repo-relative (for reports)
+    text: str
+    tree: Optional[ast.AST]
+    parse_error: Optional[str] = None
+    _line_pragmas: Dict[int, set] = field(default_factory=dict)
+    _file_pragmas: set = field(default_factory=set)
+
+    @classmethod
+    def load(cls, path: str, root: str) -> "SourceFile":
+        with open(path, "r", encoding="utf-8", errors="replace") as f:
+            text = f.read()
+        tree, err = None, None
+        try:
+            tree = ast.parse(text, filename=path)
+        except SyntaxError as e:
+            err = str(e)
+        sf = cls(path=path, rel=os.path.relpath(path, root), text=text,
+                 tree=tree, parse_error=err)
+        for i, line in enumerate(text.splitlines(), 1):
+            m = _PRAGMA_RE.search(line)
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+                sf._line_pragmas[i] = rules
+            m = _PRAGMA_FILE_RE.search(line)
+            if m:
+                sf._file_pragmas |= {r.strip()
+                                     for r in m.group(1).split(",")
+                                     if r.strip()}
+        return sf
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if rule in self._file_pragmas or "all" in self._file_pragmas:
+            return True
+        for ln in (line, line - 1):
+            rules = self._line_pragmas.get(ln)
+            if rules and (rule in rules or "all" in rules):
+                return True
+        return False
+
+    # ---- AST conveniences ---------------------------------------------
+
+    def module_constants(self) -> Dict[str, str]:
+        """Module-level ``NAME = "string"`` assignments — used to
+        resolve env-var names read through a constant."""
+        out: Dict[str, str] = {}
+        if self.tree is None:
+            return out
+        for node in self.tree.body:
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, str)):
+                out[node.targets[0].id] = node.value.value
+        return out
+
+
+@dataclass
+class Ctx:
+    """What the checkers scan. Paths are absolute; ``root`` anchors the
+    repo-relative names in reports."""
+
+    root: str
+    package_files: List[SourceFile] = field(default_factory=list)
+    runtime_files: List[SourceFile] = field(default_factory=list)
+    gate_files: List[SourceFile] = field(default_factory=list)
+    dashboard_file: Optional[SourceFile] = None
+    doc_paths: List[str] = field(default_factory=list)
+    shell_paths: List[str] = field(default_factory=list)
+    serving_md: Optional[str] = None
+    knob_registry: Optional[dict] = None     # name -> Knob (or test dict)
+
+    @classmethod
+    def for_repo(cls, root: Optional[str] = None) -> "Ctx":
+        root = os.path.abspath(root or repo_root())
+        pkg = os.path.join(root, "distributed_llm_inferencing_tpu")
+        package_files = [SourceFile.load(p, root)
+                         for p in iter_py_files(pkg)]
+        runtime_files = [sf for sf in package_files
+                         if os.sep + "runtime" + os.sep in sf.path]
+        gates = [os.path.join(root, "bench.py"),
+                 os.path.join(root, "scripts", "telemetry_smoke.py")]
+        gate_files = [SourceFile.load(p, root) for p in gates
+                      if os.path.exists(p)]
+        dash = os.path.join(pkg, "runtime", "dashboard_html.py")
+        dashboard = (SourceFile.load(dash, root)
+                     if os.path.exists(dash) else None)
+        docs_dir = os.path.join(root, "docs")
+        doc_paths = sorted(
+            os.path.join(docs_dir, f) for f in os.listdir(docs_dir)
+            if f.endswith(".md")) if os.path.isdir(docs_dir) else []
+        serving = os.path.join(docs_dir, "serving.md")
+        scripts_dir = os.path.join(root, "scripts")
+        shell_paths = sorted(
+            os.path.join(scripts_dir, f) for f in os.listdir(scripts_dir)
+            if f.endswith(".sh")) if os.path.isdir(scripts_dir) else []
+        from distributed_llm_inferencing_tpu.utils import knobs
+        return cls(root=root, package_files=package_files,
+                   runtime_files=runtime_files, gate_files=gate_files,
+                   dashboard_file=dashboard, doc_paths=doc_paths,
+                   shell_paths=shell_paths,
+                   serving_md=serving if os.path.exists(serving) else None,
+                   knob_registry=knobs.registry())
+
+
+def repo_root() -> str:
+    """tools/dlilint/core.py -> two dirs up."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def iter_py_files(*dirs: str) -> List[str]:
+    out = []
+    for d in dirs:
+        for base, subdirs, files in os.walk(d):
+            subdirs[:] = [s for s in subdirs if s != "__pycache__"]
+            out.extend(os.path.join(base, f) for f in files
+                       if f.endswith(".py"))
+    return sorted(out)
+
+
+# ---- small AST helpers shared by checkers -----------------------------
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def const_num(node: ast.AST) -> Optional[float]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, (int, float)) \
+            and not isinstance(node.value, bool):
+        return float(node.value)
+    return None
+
+
+def joined_str_pattern(node: ast.JoinedStr) -> Tuple[str, str]:
+    """(regex, prefix) for an f-string metric name: constant parts kept
+    verbatim, formatted holes become ``[A-Za-z0-9_.:-]+``."""
+    rx, prefix, prefix_done = "", "", False
+    for part in node.values:
+        if isinstance(part, ast.Constant) and isinstance(part.value, str):
+            rx += re.escape(part.value)
+            if not prefix_done:
+                prefix += part.value
+        else:
+            rx += r"[A-Za-z0-9_.:\-]+"
+            prefix_done = True
+    return rx, prefix
+
+
+def walk_functions(tree: ast.AST):
+    """Yield every FunctionDef/AsyncFunctionDef with its enclosing class
+    name (or None)."""
+    stack: List[Tuple[ast.AST, Optional[str]]] = [(tree, None)]
+    while stack:
+        node, cls = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                stack.append((child, child.name))
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield child, cls
+                stack.append((child, cls))
+            else:
+                stack.append((child, cls))
+
+
+def filter_suppressed(violations: Sequence[Violation],
+                      files: Dict[str, SourceFile]) -> List[Violation]:
+    """Drop violations whose file carries a matching pragma."""
+    out = []
+    for v in violations:
+        sf = files.get(v.path)
+        if sf is not None and sf.suppressed(v.rule, v.line):
+            continue
+        out.append(v)
+    return out
