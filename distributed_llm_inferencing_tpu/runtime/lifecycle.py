@@ -1,0 +1,186 @@
+"""Declared request-lifecycle state machine — the protocol contract
+behind every ``UPDATE requests SET status=...`` in runtime/state.py.
+
+Until this table existed, the request state machine lived only in
+reviewer memory: which function may write which status, from which
+source states, whether the write must sit behind the group-commit
+durability barrier, and which transitions burn the attempt budget.
+``tools/dlilint/check_lifecycle.py`` verifies every status-write site
+in ``state.py`` against this table — an undeclared transition, a
+terminal status written without the declared durability mechanism, or a
+WHERE-guard that doesn't match the declared source set fails CI — and
+the table generates the byte-checked lifecycle diagram embedded in
+``docs/robustness.md`` (same discipline as the generated knob table:
+regenerate with ``python -m tools.dlilint --write-lifecycle-diagram``).
+
+This module is pure data + string rendering: no imports from the rest
+of the runtime, importable by the checker without pulling in sqlite or
+jax.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import NamedTuple, Tuple
+
+# The four request states. ``pending`` and ``processing`` are live;
+# the two terminal states are client-visible endpoints a request must
+# reach exactly once (the dliverify ``single_terminal`` invariant).
+STATES = ("pending", "processing", "completed", "failed")
+TERMINAL = ("completed", "failed")
+
+# Markers delimiting the generated diagram in docs/robustness.md.
+DOC_BEGIN = ("<!-- BEGIN GENERATED LIFECYCLE DIAGRAM "
+             "(python -m tools.dlilint --write-lifecycle-diagram) -->")
+DOC_END = "<!-- END GENERATED LIFECYCLE DIAGRAM -->"
+DOC_PATH = os.path.join("docs", "robustness.md")
+
+
+class Transition(NamedTuple):
+    name: str            # stable id, used in reports and the diagram
+    source: Tuple[str, ...]  # declared source state(s); () = row creation
+    target: str
+    fn: str              # state.py function owning the write site
+    # How the source set is enforced at the SQL site:
+    #   "where"         WHERE constrains status to exactly `source`
+    #   "not-terminal"  WHERE excludes the terminal states (first
+    #                   terminal write wins; a later one no-ops)
+    #   "locked-select" the UPDATE flips rows a SELECT picked under the
+    #                   same store lock (claim's atomicity)
+    #   "none"          unguarded by design (multi-source, id-keyed)
+    #   "insert"        row creation, not an UPDATE
+    guard: str
+    # Durability mechanism the site must use:
+    #   "barrier"   routed through Store._submit_write (group-commit
+    #               buffer; committed before client visibility)
+    #   "sync-txn"  direct execute inside `with self._lock, self._db`
+    durability: str
+    counts_attempt: bool  # SQL must contain attempts=attempts+1
+    note: str             # annotation rendered into the diagram table
+
+
+TRANSITIONS = (
+    Transition(
+        "submit", (), "pending", "submit_request", "insert", "sync-txn",
+        False,
+        "row created with attempts=0; claim-visible immediately"),
+    Transition(
+        "claim", ("pending",), "processing", "claim_next_pending_many",
+        "locked-select", "sync-txn", False,
+        "oldest due rows only (next_attempt_at<=now); one locked "
+        "SELECT + executemany flip keeps claims disjoint across "
+        "dispatchers"),
+    Transition(
+        "requeue", ("processing", "pending"), "pending", "requeue",
+        "none", "barrier", True,
+        "failover retry: failed node appended to excluded_nodes "
+        "(unless the timeout was sticky), next_attempt_at parks the "
+        "backoff; re-parking an already-parked row is legal"),
+    Transition(
+        "complete", ("processing", "pending"), "completed",
+        "mark_completed", "not-terminal", "barrier", False,
+        "terminal; result+cost ride the same UPDATE so row and ledger "
+        "commit atomically; a request that already reached a terminal "
+        "state is never overwritten"),
+    Transition(
+        "fail", ("processing", "pending"), "failed", "mark_failed",
+        "not-terminal", "barrier", False,
+        "terminal; covers dispatch failures, MAX_ATTEMPTS exhaustion "
+        "and user cancel of a pending row; never overwrites an "
+        "existing terminal state"),
+    Transition(
+        "recover_fail", ("processing",), "failed",
+        "recover_stale_processing", "where", "sync-txn", False,
+        "startup crash recovery: a poison request at the attempt "
+        "budget (attempts+1>=max) fails instead of re-entering the "
+        "queue"),
+    Transition(
+        "recover_requeue", ("processing",), "pending",
+        "recover_stale_processing", "where", "sync-txn", True,
+        "startup crash recovery: stranded rows re-enter the queue "
+        "with the recovery counted as an attempt"),
+)
+
+
+def _check_table() -> None:
+    """The table must be self-consistent before anything trusts it."""
+    names = [t.name for t in TRANSITIONS]
+    assert len(names) == len(set(names)), "duplicate transition names"
+    # (fn, target) is the key check_lifecycle resolves SQL sites by —
+    # two transitions sharing it would leave one silently unchecked
+    sites = [(t.fn, t.target) for t in TRANSITIONS if t.guard != "insert"]
+    assert len(sites) == len(set(sites)), \
+        "two transitions share (fn, target) — sites would be ambiguous"
+    for t in TRANSITIONS:
+        assert t.target in STATES, f"{t.name}: unknown target {t.target}"
+        for s in t.source:
+            assert s in STATES, f"{t.name}: unknown source {s}"
+        assert t.guard in ("where", "not-terminal", "locked-select",
+                           "none", "insert"), t.name
+        assert t.durability in ("barrier", "sync-txn"), t.name
+        if t.target in TERMINAL:
+            # terminal visibility requires a durability mechanism —
+            # either the group-commit barrier or a synchronous locked
+            # transaction; declared here, verified at the site by
+            # check_lifecycle
+            assert t.durability in ("barrier", "sync-txn"), t.name
+
+
+_check_table()
+
+
+def by_name(name: str) -> Transition:
+    for t in TRANSITIONS:
+        if t.name == name:
+            return t
+    raise KeyError(name)
+
+
+def mermaid() -> str:
+    """Deterministic mermaid state diagram of the declared machine."""
+    lines = ["stateDiagram-v2"]
+    for t in TRANSITIONS:
+        label = t.name
+        if t.counts_attempt:
+            label += " (attempts+1)"
+        if t.durability == "barrier":
+            label += " [barrier]"
+        if not t.source:
+            lines.append(f"    [*] --> {t.target}: {label}")
+            continue
+        for s in t.source:
+            lines.append(f"    {s} --> {t.target}: {label}")
+    for s in TERMINAL:
+        lines.append(f"    {s} --> [*]")
+    return "\n".join(lines)
+
+
+def transition_table() -> str:
+    """Markdown table of every declared transition, rendered under the
+    diagram so the guard/durability/attempt semantics are readable
+    without opening state.py."""
+    rows = ["| Transition | From | To | Site (`state.py`) | Guard | "
+            "Durability | Attempt | Notes |",
+            "| --- | --- | --- | --- | --- | --- | --- | --- |"]
+    for t in TRANSITIONS:
+        src = ", ".join(t.source) if t.source else "(new row)"
+        rows.append(
+            f"| `{t.name}` | {src} | {t.target} | `{t.fn}` | {t.guard} "
+            f"| {t.durability} | {'+1' if t.counts_attempt else '—'} "
+            f"| {t.note} |")
+    return "\n".join(rows)
+
+
+def generated_block() -> str:
+    """Marker-delimited block for docs/robustness.md; the dlilint
+    lifecycle checker fails when the committed block != this string."""
+    return (f"{DOC_BEGIN}\n\n"
+            "This diagram and table are generated from "
+            "`runtime/lifecycle.py` — edit the declared\ntransition "
+            "table, then run `python -m tools.dlilint "
+            "--write-lifecycle-diagram`.\nHand edits here are "
+            "overwritten and fail the `lifecycle` checker.\n\n"
+            "```mermaid\n"
+            f"{mermaid()}\n"
+            "```\n\n"
+            f"{transition_table()}\n\n{DOC_END}")

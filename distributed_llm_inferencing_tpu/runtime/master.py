@@ -35,7 +35,7 @@ from distributed_llm_inferencing_tpu.runtime import tsdb as tsdb_mod
 from distributed_llm_inferencing_tpu.runtime.kvtier import (
     estimate_cached_tokens)
 from distributed_llm_inferencing_tpu.runtime.state import Store
-from distributed_llm_inferencing_tpu.utils import locks, trace
+from distributed_llm_inferencing_tpu.utils import faults, locks, trace
 from distributed_llm_inferencing_tpu.utils.logging import setup_logging
 from distributed_llm_inferencing_tpu.utils.metrics import (
     Metrics, hist_quantile, parse_prometheus, sanitize_name)
@@ -1163,6 +1163,12 @@ class Master:
                 nodes = ok
         with self._inflight_lock:
             def probe_ok(n):
+                if faults.mutation_enabled("half_open_probe"):
+                    # dliverify mutation gate (docs/static_analysis.md):
+                    # drop the half-open single-probe guard — the PR 2
+                    # bug where two dispatchers could both probe a
+                    # recovering node. Test-only flag, never set in prod.
+                    return True
                 return ((n.get("breaker_state") or "closed") != "half_open"
                         or self._inflight.get(n["id"], 0) == 0)
 

@@ -25,8 +25,15 @@ import time
 from typing import Any, Callable, Dict, List, Optional
 
 from distributed_llm_inferencing_tpu.utils import locks
+from distributed_llm_inferencing_tpu.utils.faults import mutation_enabled
 
 log = logging.getLogger("dli_tpu.state")
+
+# Every status write below is an instance of a transition DECLARED in
+# runtime/lifecycle.py; tools/dlilint/check_lifecycle.py verifies the
+# SQL sites against that table (source guard, durability mechanism,
+# attempt accounting), so a new status or an edit to a WHERE clause
+# must update the declared machine — or fail CI.
 
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS nodes (
@@ -423,6 +430,12 @@ class Store:
         in-flight status op at a time."""
         extra = ""
         args: list = []
+        if mutation_enabled("requeue_exclusion"):
+            # dliverify mutation gate (docs/static_analysis.md): drop
+            # the failed-node exclusion — the PR 2 bug where a retry
+            # could land straight back on the node it just failed on.
+            # Test-only; DLI_VERIFY_MUTATIONS is never set in prod.
+            excluded_node_id = None
         if excluded_node_id is not None:
             row = self._one("SELECT excluded_nodes FROM requests "
                             "WHERE id=?", (req_id,))
@@ -480,20 +493,28 @@ class Store:
         # reading the next result line off the stream. The cost record
         # rides the same UPDATE, so row and ledger commit atomically
         # (group-commit safe: one op, one transaction slot).
+        # NOT IN terminal guard: a request reaches exactly ONE terminal
+        # state — the first terminal write wins and a later racer
+        # (e.g. a user cancel's mark_failed racing this completion)
+        # no-ops instead of flipping a client-visible verdict. The
+        # dliverify `terminal_once` scenario model-checks this under
+        # every interleaving.
         self._submit_write(
             "UPDATE requests SET status='completed', result=?, node_id=?, "
             "completed_at=?, execution_time=?, tokens_per_s=?, cost=? "
-            "WHERE id=?",
+            "WHERE id=? AND status NOT IN ('completed','failed')",
             (result, node_id, time.time(), execution_time, tokens_per_s,
              json.dumps(cost) if cost is not None else None,
              req_id), barrier=barrier)
 
     def mark_failed(self, req_id: int, error: str, barrier: bool = True):
         # ≙ InferenceRequest.mark_failed (reference models.py:58-62);
-        # terminal — same barrier semantics as mark_completed
+        # terminal — same barrier semantics and NOT IN terminal guard
+        # as mark_completed (first terminal write wins)
         self._submit_write(
             "UPDATE requests SET status='failed', error=?, completed_at=? "
-            "WHERE id=?", (error, time.time(), req_id), barrier=barrier)
+            "WHERE id=? AND status NOT IN ('completed','failed')",
+            (error, time.time(), req_id), barrier=barrier)
 
     def recent_requests(self, limit: int = 20):
         return self._all(

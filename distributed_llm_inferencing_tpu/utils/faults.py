@@ -65,6 +65,24 @@ SERVER_MODES = ("latency", "reset", "disconnect", "corrupt", "error",
 CLIENT_MODES = ("latency", "reset", "timeout")
 MODES = tuple(sorted(set(SERVER_MODES) | set(CLIENT_MODES)))
 
+# Known mutation names for ``mutation_enabled`` (the dliverify mutation
+# gate, docs/static_analysis.md): each re-introduces one HISTORICAL bug
+# behind a test-only flag so the interleaving model checker can prove
+# it still produces a counterexample trace. Never set in production.
+MUTATIONS = ("half_open_probe", "requeue_exclusion")
+
+
+def mutation_enabled(name: str) -> bool:
+    """Test-only fault flag: is the named historical bug re-armed via
+    ``DLI_VERIFY_MUTATIONS`` (comma list)? Read per call — the
+    dliverify mutation-gate tests flip the env around in-process
+    explorations. Always False when the env is unset, so production
+    code paths pay one dict lookup."""
+    raw = os.environ.get("DLI_VERIFY_MUTATIONS")
+    if not raw:
+        return False
+    return name in {s.strip() for s in raw.split(",") if s.strip()}
+
 
 class FaultSpec:
     """One armed fault: match state + firing budget."""

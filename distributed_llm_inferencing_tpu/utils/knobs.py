@@ -263,6 +263,16 @@ KNOBS = (
     Knob("DLI_LOCK_HELD_WARN_MS", "5000", "float",
          "Held-too-long threshold for the lock watchdog's reports.",
          f"{_P}/utils/locks.py"),
+    Knob("DLI_VERIFY_BUDGET", "20", "float",
+         "Wall-clock seconds the `dliverify` interleaving explorer may "
+         "spend per run (`scripts/check.sh` step; exploration past the "
+         "budget is reported, never silently truncated).",
+         "scripts/check.sh"),
+    Knob("DLI_VERIFY_MUTATIONS", "unset", "str",
+         "TEST-ONLY comma list re-arming historical bugs "
+         "(`half_open_probe`, `requeue_exclusion`) so the dliverify "
+         "mutation gate can prove the explorer catches them. Never set "
+         "in production.", f"{_P}/utils/faults.py"),
     # ---- auth ---------------------------------------------------------
     Knob("DLI_AUTH_ENABLED", "unset", "bool",
          "`1` enables bearer-token auth on worker endpoints.",

@@ -248,16 +248,43 @@ class _Instrumented:
                 f"{self.name!r}>")
 
 
+# ---- factory interposition (the dliverify narrow waist) ---------------
+#
+# tools/dliverify's deterministic-scheduler explorer needs every runtime
+# lock created DURING a modeled scenario to be a scheduler-gated wrapper
+# so thread interleavings can be serialized and enumerated at lock
+# boundaries. These factories are already the single place all runtime
+# locks are born, so one process-global hook is the entire integration
+# surface: when set, lock()/rlock() return hook(kind, name) instead of
+# a stock primitive. The hook is consulted per factory CALL (locks made
+# before/after an exploration are stock), and it wins over the
+# DLI_LOCK_CHECK watchdog — the two instrumentations never compose.
+
+_factory_hook = None
+
+
+def set_factory_hook(hook):
+    """Install (or clear, with None) the factory interposition. Returns
+    the previous hook so callers can restore it in a finally block."""
+    global _factory_hook
+    prev, _factory_hook = _factory_hook, hook
+    return prev
+
+
 def lock(name: str):
     """A named mutex: ``threading.Lock()`` normally, instrumented when
     ``DLI_LOCK_CHECK=1``. ``name`` is the lock's *role* ("master.inflight"),
     shared by every instance filling that role."""
+    if _factory_hook is not None:
+        return _factory_hook("lock", name)
     if enabled():
         return _Instrumented(name, reentrant=False)
     return threading.Lock()
 
 
 def rlock(name: str):
+    if _factory_hook is not None:
+        return _factory_hook("rlock", name)
     if enabled():
         return _Instrumented(name, reentrant=True)
     return threading.RLock()

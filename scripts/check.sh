@@ -62,9 +62,37 @@ echo "== dlilint (repo-native invariant checkers) =="
 # AST-checked invariants (docs/static_analysis.md): metrics registered +
 # pre-registered at 0, DLI_* knobs in code == utils/knobs.py == docs,
 # no host work inside jitted code, no silent except-pass in runtime
-# threads, no static lock-order cycles. Prints per-checker counts;
-# any violation fails the build here.
+# threads, no static lock-order cycles — plus the protocol half
+# (dliproto): every master->worker RPC path/method/body-key against the
+# route tables, every fault point against a live intercept site, and
+# every request-status write against the declared lifecycle machine
+# (runtime/lifecycle.py, with the byte-checked diagram in
+# docs/robustness.md). Prints per-checker counts; any violation fails
+# the build here.
 python -m tools.dlilint || exit 1
+
+echo "== dliverify (exhaustive-interleaving model checker) =="
+# Deterministic-scheduler exploration of the REAL breaker/idempotency/
+# drain/claim code over every thread interleaving of its bounded
+# scenarios (docs/static_analysis.md "dliverify"): half-open admits one
+# probe, a tag executes once, claims are disjoint, terminal states
+# never flip, drain strands nothing, exclusions are honored. The
+# mutation gate then re-arms two historical bugs and REQUIRES a
+# counterexample trace for each — proving the explorer still catches
+# regressions. Seconds-scale; budget per scenario via DLI_VERIFY_BUDGET.
+# The outer timeout scales with the budget (6 scenarios + import slack)
+# so a raised budget can't be SIGTERMed into a diagnostic-free exit 124
+# before the explorer's own INCOMPLETE reporting fires.
+VB="${DLI_VERIFY_BUDGET:-20}"
+VT=$(python -c "print(int(float('$VB') * 8 + 180))")
+timeout -k 10 "$VT" env JAX_PLATFORMS=cpu \
+    python -m tools.dliverify --budget "$VB" || exit 1
+timeout -k 10 "$VT" env JAX_PLATFORMS=cpu \
+    python -m tools.dliverify --mutate half_open_probe --budget "$VB" \
+    || exit 1
+timeout -k 10 "$VT" env JAX_PLATFORMS=cpu \
+    python -m tools.dliverify --mutate requeue_exclusion --budget "$VB" \
+    || exit 1
 
 echo "== native kernels (threaded GEMV/GEMM must build; no silent fallback) =="
 # The decode hot path leans on the -pthread row-pool kernel

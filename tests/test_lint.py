@@ -18,9 +18,9 @@ import pytest
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from tools.dlilint import CHECKERS, run_all
-from tools.dlilint.core import Ctx, SourceFile
-from tools.dlilint import check_jit, check_knobs, check_metrics, \
-    check_threads
+from tools.dlilint.core import Ctx, SourceFile, load_lifecycle, repo_root
+from tools.dlilint import check_jit, check_knobs, check_lifecycle, \
+    check_metrics, check_rpc, check_threads
 
 
 def _sf(tmp_path, rel, source):
@@ -413,6 +413,294 @@ def test_threads_locks_factory_recognized(tmp_path):
         "import threading", "from pkg.utils import locks"))
     out = check_threads.check(_ctx(tmp_path, package_files=[sf]))
     assert _rules(out) == ["lock-order-cycle"]
+
+
+# ---- rpc contract checker ----------------------------------------------
+
+_RPC_WORKER_MOD = """\
+    class W:
+        def __init__(self, s):
+            s.add("GET", "/health", self.health)
+            s.add("POST", "/work", self.work)
+            s.add("POST", "/work/<job_id>/retry", self.retry)
+            s.add("POST", "/never_called", self.nope)
+
+        def health(self, body):
+            return {}
+
+        def work(self, body):
+            used = body.get("used")
+            phantom = body.get("phantom_key_nobody_sends")
+            return {"used": used, "phantom": phantom}
+
+        def retry(self, body, job_id):
+            return {}
+
+        def nope(self, body):
+            return {}
+    """
+
+_RPC_MASTER_MOD = """\
+    class M:
+        def _worker_get(self, node, path, timeout):
+            pass
+
+        def _worker_post(self, node, path, body, timeout):
+            pass
+
+        def go(self, node, jid):
+            self._worker_get(node, "/health", 5)
+            self._worker_post(node, "/work",
+                              {"used": 1, "ghost": 2}, 5)
+            self._worker_post(node, f"/work/{jid}/retry", {}, 5)
+            self._worker_post(node, "/missing", {}, 5)
+            self._worker_get(node, "/work", 5)
+    """
+
+
+def _rpc_ctx(tmp_path, worker_src=_RPC_WORKER_MOD,
+             master_src=_RPC_MASTER_MOD, **kw):
+    worker = _sf(tmp_path, "pkg/runtime/workerish.py", worker_src)
+    master = _sf(tmp_path, "pkg/runtime/masterish.py", master_src)
+    return _ctx(tmp_path, package_files=[worker, master], **kw), \
+        worker, master
+
+
+def test_rpc_unknown_path_and_method_mismatch_caught(tmp_path):
+    ctx, _w, master = _rpc_ctx(tmp_path)
+    out = check_rpc.check(ctx)
+    rules = _rules(out)
+    assert "rpc-unknown-path" in rules       # POST /missing
+    assert "rpc-method-mismatch" in rules    # GET /work (POST-only)
+    unknown = [v for v in out if v.rule == "rpc-unknown-path"]
+    assert unknown[0].path == master.rel
+    assert "/missing" in unknown[0].msg
+
+
+def test_rpc_param_segments_match(tmp_path):
+    """f-string path holes match <param> route segments — no false
+    unknown-path on /work/<job_id>/retry."""
+    ctx, *_ = _rpc_ctx(tmp_path)
+    out = check_rpc.check(ctx)
+    assert not any("retry" in v.msg for v in out
+                   if v.rule == "rpc-unknown-path")
+
+
+def test_rpc_dead_route_caught_and_doc_reference_clears(tmp_path):
+    ctx, *_ = _rpc_ctx(tmp_path)
+    out = check_rpc.check(ctx)
+    dead = [v for v in out if v.rule == "rpc-dead-route"]
+    assert len(dead) == 1 and "/never_called" in dead[0].msg
+    # a doc mention is a reference: operator-facing routes live in docs
+    doc = tmp_path / "docs" / "ops.md"
+    doc.parent.mkdir(exist_ok=True)
+    doc.write_text("Operators may `POST /never_called` to win.\n")
+    ctx2, *_ = _rpc_ctx(tmp_path, doc_paths=[str(doc)])
+    out2 = check_rpc.check(ctx2)
+    assert not [v for v in out2 if v.rule == "rpc-dead-route"]
+
+
+def test_rpc_quiet_set_typo_caught(tmp_path):
+    quiet = _sf(tmp_path, "pkg/runtime/httpdish.py", """\
+        QUIET_TRACE_PATHS = frozenset({"/health", "/helth_typo"})
+        """)
+    ctx, *_ = _rpc_ctx(tmp_path)
+    ctx.package_files.append(quiet)
+    out = check_rpc.check(ctx)
+    quiets = [v for v in out if v.rule == "rpc-quiet-unknown"]
+    assert len(quiets) == 1 and "/helth_typo" in quiets[0].msg
+
+
+def test_rpc_fault_point_without_intercept_caught(tmp_path):
+    tests = _sf(tmp_path, "tests/test_x.py", """\
+        GOOD = {"point": "/work", "mode": "error"}
+        ALSO_GOOD = {"point": "rpc:/work", "mode": "timeout"}
+        GLOB = {"point": "/wor*", "mode": "reset"}
+        BAD = {"point": "/work_typo", "mode": "error"}
+        """)
+    ctx, *_ = _rpc_ctx(tmp_path, test_files=[tests])
+    out = check_rpc.check(ctx)
+    faults = [v for v in out if v.rule == "rpc-fault-unknown"]
+    assert len(faults) == 1 and "/work_typo" in faults[0].msg
+
+
+def test_rpc_body_unread_and_unsent_caught(tmp_path):
+    ctx, worker, master = _rpc_ctx(tmp_path)
+    out = check_rpc.check(ctx)
+    unread = [v for v in out if v.rule == "rpc-body-unread"]
+    assert len(unread) == 1
+    assert "'ghost'" in unread[0].msg and unread[0].path == master.rel
+    unsent = [v for v in out if v.rule == "rpc-body-unsent"]
+    assert len(unsent) == 1
+    assert "phantom_key_nobody_sends" in unsent[0].msg
+    assert unsent[0].path == worker.rel
+
+
+def test_rpc_body_reads_follow_helpers(tmp_path):
+    """Keys read by a helper the handler hands the body to count as
+    read — no false unread on builder/validator splits."""
+    worker_src = """\
+        class W:
+            def __init__(self, s):
+                s.add("POST", "/work", self.work)
+
+            def work(self, body):
+                return self._inner(dict(body))
+
+            def _inner(self, body):
+                return body.get("used")
+        """
+    ctx, *_ = _rpc_ctx(tmp_path, worker_src=worker_src)
+    out = check_rpc.check(ctx)
+    # 'used' is read through dict(body) -> self._inner; 'ghost' (which
+    # nothing reads) still fires
+    unread = [v for v in out if v.rule == "rpc-body-unread"]
+    assert not any("'used'" in v.msg for v in unread)
+    assert any("'ghost'" in v.msg for v in unread)
+
+
+def test_rpc_pragma_suppresses(tmp_path):
+    master_src = _RPC_MASTER_MOD.replace(
+        'self._worker_post(node, "/missing", {}, 5)',
+        'self._worker_post(node, "/missing", {}, 5)  '
+        '# dlilint: disable=rpc-unknown-path')
+    ctx, *_ = _rpc_ctx(tmp_path, master_src=master_src)
+    out = check_rpc.check(ctx)
+    assert not any("/missing" in v.msg for v in out
+                   if v.rule == "rpc-unknown-path")
+
+
+# ---- lifecycle checker -------------------------------------------------
+
+_LIFECYCLE = load_lifecycle(repo_root())
+
+
+def _t(name, source, target, fn, guard, durability, counts_attempt):
+    return _LIFECYCLE.Transition(name, source, target, fn, guard,
+                                 durability, counts_attempt, "")
+
+
+_LIFE_STATE_MOD = """\
+    class Store:
+        def mark_completed(self, rid):
+            self._submit_write(
+                "UPDATE requests SET status='completed' WHERE id=? "
+                "AND status NOT IN ('completed','failed')", (rid,),
+                barrier=True)
+
+        def mark_failed(self, rid):
+            self._exec(
+                "UPDATE requests SET status='failed' WHERE id=?",
+                (rid,))
+
+        def vanish(self, rid):
+            self._exec(
+                "UPDATE requests SET status='vanished' WHERE id=?",
+                (rid,))
+
+        def requeue(self, rid):
+            self._submit_write(
+                "UPDATE requests SET status='pending' WHERE id=?",
+                (rid,), barrier=True)
+    """
+
+_LIFE_TABLE = (
+    _t("complete", ("processing",), "completed", "mark_completed",
+       "not-terminal", "barrier", False),
+    # declared barrier + where-guard, but the site uses _exec with no
+    # WHERE status constraint -> lifecycle-barrier AND lifecycle-guard
+    _t("fail", ("processing",), "failed", "mark_failed", "where",
+       "barrier", False),
+    # declared attempt accounting the SQL lacks -> lifecycle-attempts
+    _t("requeue", ("processing",), "pending", "requeue", "none",
+       "barrier", True),
+    # declared transition with no site -> lifecycle-unused
+    _t("ghost", ("pending",), "failed", "cancel_pending", "where",
+       "sync-txn", False),
+)
+
+
+def test_lifecycle_fixture_catches_each_rule(tmp_path):
+    sf = _sf(tmp_path, "pkg/runtime/state.py", _LIFE_STATE_MOD)
+    out = check_lifecycle.check_sites(sf, _LIFE_TABLE)
+    rules = _rules(out)
+    assert "lifecycle-undeclared" in rules    # status='vanished'
+    assert "lifecycle-barrier" in rules       # fail via _exec
+    assert "lifecycle-guard" in rules         # fail without WHERE guard
+    assert "lifecycle-attempts" in rules      # requeue w/o attempts+1
+    assert "lifecycle-unused" in rules        # ghost
+    # the correct site is NOT flagged
+    assert not any("mark_completed" in v.msg or v.line == 2
+                   for v in out if v.rule != "lifecycle-unused")
+
+
+def test_lifecycle_clean_fixture_passes(tmp_path):
+    sf = _sf(tmp_path, "pkg/runtime/state.py", """\
+        class Store:
+            def mark_completed(self, rid):
+                self._submit_write(
+                    "UPDATE requests SET status='completed' "
+                    "WHERE id=? AND status NOT IN "
+                    "('completed','failed')", (rid,), barrier=True)
+        """)
+    table = (_t("complete", ("processing",), "completed",
+                "mark_completed", "not-terminal", "barrier", False),)
+    assert check_lifecycle.check_sites(sf, table) == []
+
+
+def test_lifecycle_locked_select_guard(tmp_path):
+    src = """\
+        class Store:
+            def claim(self):
+                with self._lock:
+                    rows = self._all(
+                        "SELECT * FROM requests WHERE "
+                        "status='pending' LIMIT 1")
+                    with self._db:
+                        self._db.executemany(
+                            "UPDATE requests SET status='processing' "
+                            "WHERE id=?", [(1,)])
+        """
+    sf = _sf(tmp_path, "pkg/runtime/state.py", src)
+    table = (_t("claim", ("pending",), "processing", "claim",
+                "locked-select", "sync-txn", False),)
+    assert check_lifecycle.check_sites(sf, table) == []
+    # drop the lock: the locked-select guard must fail
+    sf2 = _sf(tmp_path, "pkg/runtime/state2.py", src.replace(
+        "with self._lock:", "if True:"))
+    out = check_lifecycle.check_sites(sf2, table)
+    # losing the lock breaks BOTH the locked-select guard and the
+    # sync-txn durability claim
+    assert _rules(out) == ["lifecycle-barrier", "lifecycle-guard"]
+
+
+def test_lifecycle_diagram_byte_checked(tmp_path):
+    doc = tmp_path / "robustness.md"
+    doc.write_text("# Robustness\n\nno diagram yet\n")
+    out = check_lifecycle.check_diagram(str(doc), _LIFECYCLE)
+    assert _rules(out) == ["lifecycle-diagram-stale"]
+    assert check_lifecycle.write_lifecycle_diagram(str(doc), _LIFECYCLE)
+    assert check_lifecycle.check_diagram(str(doc), _LIFECYCLE) == []
+    # drift by one byte -> stale again
+    doc.write_text(doc.read_text().replace("pending", "pending ", 1))
+    out = check_lifecycle.check_diagram(str(doc), _LIFECYCLE)
+    assert _rules(out) == ["lifecycle-diagram-stale"]
+    # idempotent regenerate restores byte equality
+    assert check_lifecycle.write_lifecycle_diagram(str(doc), _LIFECYCLE)
+    assert not check_lifecycle.write_lifecycle_diagram(str(doc),
+                                                       _LIFECYCLE)
+
+
+def test_lifecycle_declared_machine_is_sane():
+    """The committed table covers the four states, reaches both
+    terminals, and every terminal transition declares a durability
+    mechanism."""
+    ts = _LIFECYCLE.TRANSITIONS
+    assert {t.target for t in ts} == set(_LIFECYCLE.STATES)
+    for t in ts:
+        if t.target in _LIFECYCLE.TERMINAL:
+            assert t.durability in ("barrier", "sync-txn")
+    assert any(t.counts_attempt for t in ts)
 
 
 # ---- the real tree is the fixture for "runs clean" ---------------------

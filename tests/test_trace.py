@@ -226,7 +226,8 @@ def test_error_response_carries_trace_headers(cluster):
     assert r.status_code == 400
     assert r.headers.get(trace.TRACE_HEADER) == tid
     assert r.headers.get(trace.SPAN_HEADER)
-    # 404s too
+    # 404s too (deliberate unknown path)
+    # dlilint: disable=rpc-unknown-path
     r = requests.get(_url(mport, "/no/such/path"),
                      headers={trace.TRACE_HEADER: tid})
     assert r.status_code == 404
@@ -235,14 +236,17 @@ def test_error_response_carries_trace_headers(cluster):
 
 def test_405_wrong_method_gets_allow_header(cluster):
     _, wport, _, mport = cluster
-    # /health is GET-only on the worker
+    # /health is GET-only on the worker (deliberate wrong method)
+    # dlilint: disable=rpc-method-mismatch
     r = requests.post(_url(wport, "/health"), json={})
     assert r.status_code == 405
     assert "GET" in r.headers.get("Allow", "")
     assert r.json()["status"] == "error"
     # /api/inference/submit is POST-only on the master
+    # dlilint: disable=rpc-method-mismatch
     r = requests.get(_url(mport, "/api/inference/submit"))
     assert r.status_code == 405
     assert "POST" in r.headers.get("Allow", "")
     # unregistered path still 404s
+    # dlilint: disable=rpc-unknown-path
     assert requests.get(_url(wport, "/nope")).status_code == 404

@@ -15,6 +15,13 @@ metrics             metric-unregistered, metric-counter-no-total,
                     metric-not-preregistered
 jit                 jit-impure, jit-in-loop
 threads             lock-order-cycle, silent-except
+rpc                 rpc-unknown-path, rpc-method-mismatch,
+                    rpc-dead-route, rpc-quiet-unknown,
+                    rpc-fault-unknown, rpc-body-unread,
+                    rpc-body-unsent
+lifecycle           lifecycle-undeclared, lifecycle-guard,
+                    lifecycle-barrier, lifecycle-attempts,
+                    lifecycle-unused, lifecycle-diagram-stale
 ==================  ===================================================
 
 Run: ``python -m tools.dlilint`` (exit 0 = clean). Suppress a reviewed
@@ -28,7 +35,8 @@ from __future__ import annotations
 
 from typing import Dict, List
 
-from . import check_jit, check_knobs, check_metrics, check_threads
+from . import (check_jit, check_knobs, check_lifecycle, check_metrics,
+               check_rpc, check_threads)
 from .core import Ctx, Violation
 
 CHECKERS = {
@@ -36,6 +44,8 @@ CHECKERS = {
     "metrics": check_metrics.check,
     "jit": check_jit.check,
     "threads": check_threads.check,
+    "rpc": check_rpc.check,
+    "lifecycle": check_lifecycle.check,
 }
 
 

@@ -26,6 +26,9 @@ def main(argv=None) -> int:
     ap.add_argument("--write-knob-table", action="store_true",
                     help="regenerate the docs/serving.md knob table from "
                          "utils/knobs.py, then check")
+    ap.add_argument("--write-lifecycle-diagram", action="store_true",
+                    help="regenerate the docs/robustness.md lifecycle "
+                         "diagram from runtime/lifecycle.py, then check")
     args = ap.parse_args(argv)
 
     ctx = Ctx.for_repo(args.root)
@@ -37,6 +40,16 @@ def main(argv=None) -> int:
         changed = write_knob_table(ctx.serving_md)
         print(f"knob table: {'rewritten' if changed else 'already current'}")
         ctx = Ctx.for_repo(args.root)   # re-read the docs we just wrote
+    if args.write_lifecycle_diagram:
+        from .check_lifecycle import write_lifecycle_diagram
+        if ctx.robustness_md is None:
+            print("dlilint: docs/robustness.md not found", file=sys.stderr)
+            return 2
+        changed = write_lifecycle_diagram(ctx.robustness_md,
+                                          ctx.lifecycle_mod)
+        print(f"lifecycle diagram: "
+              f"{'rewritten' if changed else 'already current'}")
+        ctx = Ctx.for_repo(args.root)
 
     only = {s.strip() for s in args.only.split(",") if s.strip()} or None
     bad = sorted((only or set()) - set(CHECKERS))

@@ -1,0 +1,390 @@
+"""Lifecycle checker: every ``UPDATE requests SET status=...`` site in
+``runtime/state.py`` must instantiate a transition DECLARED in
+``runtime/lifecycle.py`` — and the generated diagram in
+``docs/robustness.md`` must match the table byte-for-byte.
+
+Rules
+-----
+``lifecycle-undeclared``     a status write with no declared transition
+                             (function + target status must match a row)
+``lifecycle-guard``          the SQL WHERE constrains the source state
+                             differently than the declared guard: a
+                             ``where`` transition must name exactly the
+                             declared source set, ``not-terminal`` must
+                             exclude exactly the terminal states,
+                             ``locked-select`` must sit under the store
+                             lock next to a SELECT of the source state,
+                             and ``none`` must not constrain status
+``lifecycle-barrier``        durability mismatch: a ``barrier``
+                             transition's UPDATE must flow through
+                             ``Store._submit_write`` (the group-commit
+                             durability barrier) and a ``sync-txn`` one
+                             through a direct locked transaction
+``lifecycle-attempts``       ``counts_attempt`` vs the presence of
+                             ``attempts=attempts+1`` in the SQL disagree
+``lifecycle-unused``         a declared (non-insert) transition with no
+                             matching write site — table drift
+``lifecycle-diagram-stale``  the marker-delimited block in
+                             docs/robustness.md differs from
+                             ``lifecycle.generated_block()`` (regenerate
+                             with ``--write-lifecycle-diagram``)
+
+How sites are found: the AST of state.py is scanned for string
+constants (f-string constant parts included) containing
+``UPDATE requests SET``; each is resolved to its enclosing function and
+its delivery mechanism (``self._submit_write(...)`` argument vs
+``self._db.execute/executemany`` under ``with self._lock`` vs
+``self._exec``). Status literals are parsed out of the SET and WHERE
+clauses textually — state.py writes statuses as SQL literals on
+purpose, and a parameterized ``status=?`` would itself be flagged as
+undeclared (the checker cannot prove it).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import List, Optional, Tuple
+
+from .core import Ctx, SourceFile, Violation, dotted_name
+
+_UPDATE_RE = re.compile(r"UPDATE\s+requests\s+SET", re.I)
+_SET_STATUS_RE = re.compile(r"SET\s+status\s*=\s*'(\w+)'", re.I)
+_WHERE_RE = re.compile(r"\bWHERE\b(.*)$", re.I | re.S)
+_W_STATUS_EQ = re.compile(r"status\s*=\s*'(\w+)'", re.I)
+_W_STATUS_NOTIN = re.compile(
+    r"status\s+NOT\s+IN\s*\(([^)]*)\)", re.I)
+_W_STATUS_IN = re.compile(r"status\s+IN\s*\(([^)]*)\)", re.I)
+_ATTEMPTS_RE = re.compile(r"attempts\s*=\s*attempts\s*\+\s*1", re.I)
+_QUOTED = re.compile(r"'(\w+)'")
+
+
+class Site:
+    """One UPDATE-requests write site resolved from the AST."""
+
+    def __init__(self, sf: SourceFile, line: int, sql: str, fn: str,
+                 mechanism: str, under_store_lock: bool,
+                 fn_source: str):
+        self.sf = sf
+        self.line = line
+        self.sql = sql
+        self.fn = fn                    # enclosing function name
+        self.mechanism = mechanism      # submit_write | db-direct | exec
+        self.under_store_lock = under_store_lock
+        self.fn_source = fn_source      # full source of the function
+
+    @property
+    def target(self) -> Optional[str]:
+        m = _SET_STATUS_RE.search(self.sql)
+        return m.group(1) if m else None
+
+    def where_status(self) -> Tuple[str, frozenset]:
+        """(kind, states) the WHERE clause constrains status to:
+        ("eq", {s}) / ("in", {..}) / ("not-in", {..}) / ("none", {})."""
+        m = _WHERE_RE.search(self.sql)
+        if not m:
+            return "none", frozenset()
+        where = m.group(1)
+        m = _W_STATUS_NOTIN.search(where)
+        if m:
+            return "not-in", frozenset(_QUOTED.findall(m.group(1)))
+        m = _W_STATUS_IN.search(where)
+        if m:
+            return "in", frozenset(_QUOTED.findall(m.group(1)))
+        m = _W_STATUS_EQ.search(where)
+        if m:
+            return "eq", frozenset([m.group(1)])
+        return "none", frozenset()
+
+    @property
+    def counts_attempt(self) -> bool:
+        return bool(_ATTEMPTS_RE.search(self.sql))
+
+
+def _string_parts(node: ast.AST) -> Optional[str]:
+    """Concatenated constant text of a Str / f-string / implicit-concat
+    expression (formatted holes contribute nothing — the status and
+    WHERE literals this checker reads are always in the constants)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        return "".join(p.value for p in node.values
+                       if isinstance(p, ast.Constant)
+                       and isinstance(p.value, str))
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        left, right = _string_parts(node.left), _string_parts(node.right)
+        if left is not None and right is not None:
+            return left + right
+    return None
+
+
+def _call_mechanism(call: ast.Call) -> Optional[str]:
+    name = dotted_name(call.func) or ""
+    if name.endswith("._submit_write"):
+        return "submit_write"
+    if name.endswith("._db.execute") or name.endswith("._db.executemany"):
+        return "db-direct"
+    if name.endswith("._exec"):
+        return "exec"
+    return None
+
+
+def _with_holds_store_lock(with_node: ast.With) -> bool:
+    for item in with_node.items:
+        name = dotted_name(item.context_expr) or ""
+        if name.endswith("._lock"):
+            return True
+    return False
+
+
+def collect_sites(sf: SourceFile) -> List[Site]:
+    """Every UPDATE-requests string in ``sf`` with its enclosing
+    function, delivery call, and lock context."""
+    sites: List[Site] = []
+    if sf.tree is None:
+        return sites
+
+    class V(ast.NodeVisitor):
+        def __init__(self):
+            self.fn_stack: List[ast.AST] = []
+            self.call_stack: List[ast.Call] = []
+            self.with_stack: List[ast.With] = []
+
+        def visit_FunctionDef(self, node):
+            self.fn_stack.append(node)
+            self.generic_visit(node)
+            self.fn_stack.pop()
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_With(self, node):
+            self.with_stack.append(node)
+            self.generic_visit(node)
+            self.with_stack.pop()
+
+        def visit_Call(self, node):
+            self.call_stack.append(node)
+            self.generic_visit(node)
+            self.call_stack.pop()
+
+        def _note(self, node, text):
+            mech = None
+            for call in reversed(self.call_stack):
+                mech = _call_mechanism(call)
+                if mech is not None:
+                    break
+            under = any(_with_holds_store_lock(w)
+                        for w in self.with_stack)
+            fn = self.fn_stack[-1] if self.fn_stack else None
+            sites.append(Site(
+                sf, node.lineno, text,
+                fn.name if fn is not None else "<module>",
+                mech or "unknown", under,
+                ast.get_source_segment(sf.text, fn) or "" if fn
+                else sf.text))
+
+        def visit_Constant(self, node):
+            if isinstance(node.value, str) and _UPDATE_RE.search(
+                    node.value):
+                self._note(node, node.value)
+
+        def visit_JoinedStr(self, node):
+            text = _string_parts(node) or ""
+            if _UPDATE_RE.search(text):
+                self._note(node, text)
+            # don't recurse: the constants inside would double-report
+
+    V().visit(sf.tree)
+    return sites
+
+
+def _guard_violation(site: Site, t, terminal) -> Optional[str]:
+    kind, states = site.where_status()
+    declared = frozenset(t.source)
+    if t.guard == "where":
+        if kind == "eq" and states == declared:
+            return None
+        if kind == "in" and states == declared:
+            return None
+        return (f"declared guard 'where' over {sorted(declared)} but the "
+                f"WHERE clause constrains status as {kind} "
+                f"{sorted(states) or '(nothing)'}")
+    if t.guard == "not-terminal":
+        if kind == "not-in" and states == frozenset(terminal):
+            return None
+        return ("declared guard 'not-terminal' but the WHERE clause "
+                f"constrains status as {kind} {sorted(states) or '∅'} "
+                f"(want NOT IN {sorted(terminal)})")
+    if t.guard == "locked-select":
+        if kind != "none":
+            return ("declared guard 'locked-select' but the UPDATE "
+                    "itself constrains status — declare 'where' instead")
+        if not site.under_store_lock:
+            return ("declared guard 'locked-select' but the UPDATE does "
+                    "not run under `with self._lock`")
+        want = "|".join(sorted(declared))
+        if not re.search(r"SELECT\b.*status\s*=\s*'(%s)'" % want,
+                         site.fn_source, re.I | re.S):
+            return ("declared guard 'locked-select' but no SELECT of "
+                    f"status in {sorted(declared)} found in "
+                    f"{site.fn}()")
+        return None
+    if t.guard == "none":
+        if kind != "none":
+            return (f"declared guard 'none' but the WHERE clause "
+                    f"constrains status ({kind} {sorted(states)}) — "
+                    "declare the guard")
+        return None
+    return f"unknown declared guard kind {t.guard!r}"
+
+
+def _barrier_violation(site: Site, t) -> Optional[str]:
+    if t.durability == "barrier":
+        if site.mechanism != "submit_write":
+            return (f"transition '{t.name}' declares the group-commit "
+                    "durability barrier but the UPDATE is delivered via "
+                    f"{site.mechanism!r}, not Store._submit_write")
+        return None
+    # sync-txn: a direct locked transaction (db-direct under the store
+    # lock) or the _exec helper (which takes lock + txn itself)
+    if site.mechanism == "exec":
+        return None
+    if site.mechanism == "db-direct" and site.under_store_lock:
+        return None
+    return (f"transition '{t.name}' declares sync-txn durability but "
+            f"the UPDATE is delivered via {site.mechanism!r}"
+            + ("" if site.under_store_lock
+               else " outside `with self._lock`"))
+
+
+def check_sites(state_sf: SourceFile, transitions,
+                states=("pending", "processing", "completed", "failed"),
+                terminal=("completed", "failed")) -> List[Violation]:
+    """Core site check, unit-testable against fixture files/tables."""
+    out: List[Violation] = []
+    sites = collect_sites(state_sf)
+    matched = set()
+    for site in sites:
+        target = site.target
+        if target is None:
+            # UPDATE requests that doesn't touch status (e.g. a future
+            # cost-only write) is outside the machine
+            continue
+        if target not in states:
+            out.append(Violation(
+                "lifecycle-undeclared", state_sf.rel, site.line,
+                f"status {target!r} written in {site.fn}() is not a "
+                "declared lifecycle state"))
+            continue
+        cands = [t for t in transitions
+                 if t.guard != "insert" and t.target == target
+                 and t.fn == site.fn]
+        if not cands:
+            out.append(Violation(
+                "lifecycle-undeclared", state_sf.rel, site.line,
+                f"UPDATE in {site.fn}() sets status='{target}' but no "
+                "declared transition covers (function, target) — add it "
+                "to runtime/lifecycle.py TRANSITIONS or move the write"))
+            continue
+        # disambiguate recover_stale_processing's two writes by target;
+        # (fn, target) is unique in the declared table by construction
+        t = cands[0]
+        matched.add(t.name)
+        msg = _guard_violation(site, t, terminal)
+        if msg:
+            out.append(Violation("lifecycle-guard", state_sf.rel,
+                                 site.line, msg))
+        msg = _barrier_violation(site, t)
+        if msg:
+            out.append(Violation("lifecycle-barrier", state_sf.rel,
+                                 site.line, msg))
+        if t.counts_attempt != site.counts_attempt:
+            out.append(Violation(
+                "lifecycle-attempts", state_sf.rel, site.line,
+                f"transition '{t.name}' declares "
+                f"counts_attempt={t.counts_attempt} but the SQL "
+                f"{'has' if site.counts_attempt else 'lacks'} "
+                "attempts=attempts+1"))
+    for t in transitions:
+        if t.guard == "insert" or t.name in matched:
+            continue
+        out.append(Violation(
+            "lifecycle-unused", state_sf.rel, 1,
+            f"declared transition '{t.name}' ({'/'.join(t.source)} -> "
+            f"{t.target} in {t.fn}()) matches no UPDATE site — stale "
+            "table row?"))
+    return out
+
+
+def _extract_block(text: str, begin: str, end: str) -> Optional[str]:
+    i = text.find(begin)
+    if i < 0:
+        return None
+    j = text.find(end, i)
+    if j < 0:
+        return None
+    return text[i:j + len(end)]
+
+
+def check_diagram(robustness_md: str, lifecycle_mod) -> List[Violation]:
+    rel = os.path.join("docs", "robustness.md")
+    try:
+        with open(robustness_md, encoding="utf-8") as f:
+            text = f.read()
+    except OSError as e:
+        return [Violation("lifecycle-diagram-stale", rel, 1,
+                          f"docs/robustness.md unreadable: {e}")]
+    cur = _extract_block(text, lifecycle_mod.DOC_BEGIN,
+                         lifecycle_mod.DOC_END)
+    want = lifecycle_mod.generated_block()
+    if cur is None:
+        return [Violation(
+            "lifecycle-diagram-stale", rel, 1,
+            "generated lifecycle diagram block missing — run "
+            "`python -m tools.dlilint --write-lifecycle-diagram`")]
+    if cur != want:
+        return [Violation(
+            "lifecycle-diagram-stale", rel,
+            text[:text.find(lifecycle_mod.DOC_BEGIN)].count("\n") + 1,
+            "lifecycle diagram drifted from runtime/lifecycle.py — run "
+            "`python -m tools.dlilint --write-lifecycle-diagram`")]
+    return []
+
+
+def write_lifecycle_diagram(robustness_md: str, lifecycle_mod) -> bool:
+    """Regenerate the marker-delimited diagram block in place (appends
+    the block if the markers are absent). Returns True if the file
+    changed."""
+    with open(robustness_md, encoding="utf-8") as f:
+        text = f.read()
+    want = lifecycle_mod.generated_block()
+    cur = _extract_block(text, lifecycle_mod.DOC_BEGIN,
+                         lifecycle_mod.DOC_END)
+    if cur is None:
+        new = text.rstrip("\n") + "\n\n" + want + "\n"
+    elif cur == want:
+        return False
+    else:
+        new = text.replace(cur, want)
+    with open(robustness_md, "w", encoding="utf-8") as f:
+        f.write(new)
+    return True
+
+
+def check(ctx: Ctx) -> List[Violation]:
+    out: List[Violation] = []
+    transitions = ctx.lifecycle_transitions
+    if transitions is None:
+        return out
+    state_sf = next(
+        (sf for sf in ctx.package_files
+         if sf.rel.replace(os.sep, "/").endswith("runtime/state.py")),
+        None)
+    if state_sf is not None:
+        out.extend(check_sites(state_sf, transitions))
+    if ctx.robustness_md and ctx.lifecycle_mod is not None:
+        out.extend(check_diagram(ctx.robustness_md, ctx.lifecycle_mod))
+    files = {sf.rel: sf for sf in ctx.package_files}
+    from .core import filter_suppressed
+    return filter_suppressed(out, files)

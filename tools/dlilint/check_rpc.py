@@ -1,0 +1,701 @@
+"""RPC contract checker: the master↔worker wire protocol, statically.
+
+The protocol between the master's RPC client (``_worker_get`` /
+``_worker_post`` / ``_scrape_workers``), the kvwire peer-fetch client,
+the bench/test/script HTTP drivers, and the services' ``Server.add``
+route tables was enforced only by reviewer memory — a renamed path or a
+method flip surfaced as a runtime 404 in chaos CI at best. This checker
+cross-references every statically-visible call site against every
+registered route.
+
+Rules
+-----
+``rpc-unknown-path``      a call site names a path no service registers
+``rpc-method-mismatch``   the path exists, but only under another method
+``rpc-dead-route``        a registered route no caller, script, shell
+                          fetcher, dashboard page, or doc reaches
+``rpc-quiet-unknown``     an entry in httpd's QUIET_TRACE_PATHS open-set
+                          matches no registered route (a typo there
+                          silently un-quiets a poll path)
+``rpc-fault-unknown``     a fault point armed in tests or docs matches
+                          no live intercept site (route paths server-
+                          side, ``rpc:<path>`` client-side)
+``rpc-body-unread``       a master-side POST body key the handler (and
+                          the helpers it hands the body to) never reads
+``rpc-body-unsent``       a handler-read body key no caller, test,
+                          bench, or doc ever mentions
+
+Conservatism: only literal paths and literal/locally-built dict bodies
+are checked; a dynamically computed path or a body that escapes into
+unresolvable code is skipped, never guessed. Fully dynamic route
+patterns (multihost's ``f"/{op}"`` rebinds) are ignored. Test files
+contribute their own locally-registered routes to the match universe
+(httpd unit tests register synthetic paths) but not to the dead-route
+universe.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import os
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import (Ctx, SourceFile, Violation, dotted_name, const_str,
+                   filter_suppressed)
+
+_METHODS = ("GET", "POST", "PUT", "DELETE")
+_HTTP_ATTRS = ("get", "post", "put", "delete")
+# responses the tests drive through requests.request(...) etc are rare
+# enough to skip; .get is also dict.get — a call only counts as HTTP
+# when a path/URL literal is actually found in its arguments.
+
+_PARAM_SEG = re.compile(r"^(<\w+>|\{\w*\}|\*)$")
+_DOC_PATH_RE = re.compile(
+    r"""(?:^|[\s"'`=(])(/[A-Za-z_][A-Za-z0-9_/<>{}*.-]*)""")
+_DOC_POINT_RE = re.compile(r'"point"\s*:\s*"([^"]+)"')
+_WORD_RE = re.compile(r"[A-Za-z_][A-Za-z0-9_]*")
+
+
+def canon(path: str) -> Optional[Tuple[str, ...]]:
+    """Path -> canonical segment tuple; param-ish segments become '*'.
+    Returns None for paths that are not route-shaped."""
+    path = path.partition("?")[0].strip()
+    if not path.startswith("/"):
+        return None
+    segs = [s for s in path.split("/") if s]
+    out = []
+    for s in segs:
+        out.append("*" if _PARAM_SEG.match(s) or "*" in s or "{" in s
+                   or "<" in s else s)
+    return tuple(out)
+
+
+def _segs_match(a: Sequence[str], b: Sequence[str]) -> bool:
+    return len(a) == len(b) and all(
+        x == "*" or y == "*" or x == y for x, y in zip(a, b))
+
+
+@dataclass
+class RouteDef:
+    method: str
+    pattern: str                 # as registered
+    segs: Optional[Tuple[str, ...]]   # None = fully dynamic (ignored)
+    sf: SourceFile
+    line: int
+    handler: Optional[str]       # dotted handler expr ("self.health")
+
+
+@dataclass
+class CallSite:
+    method: str                  # GET/POST/... or "" when unknowable
+    path: str
+    segs: Tuple[str, ...]
+    sf: SourceFile
+    line: int
+    body: Optional[ast.expr] = None     # POST body expression
+    fn: Optional[ast.AST] = None        # enclosing function node
+
+
+# ---- route tables -----------------------------------------------------
+
+def collect_routes(files) -> List[RouteDef]:
+    out: List[RouteDef] = []
+    for sf in files:
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func) or ""
+            args = node.args
+            if name.endswith(".add") and len(args) == 3:
+                method, pattern = const_str(args[0]), args[1]
+            elif name.endswith("_replace_route") and len(args) >= 4:
+                method, pattern = const_str(args[1]), args[2]
+            else:
+                continue
+            if method not in _METHODS:
+                continue
+            pat = const_str(pattern)
+            if pat is None:
+                # f-string pattern (multihost f"/{op}"): fully dynamic,
+                # recorded as unmatched-anything (segs=None)
+                out.append(RouteDef(method, "<dynamic>", None, sf,
+                                    node.lineno,
+                                    dotted_name(args[-1])))
+                continue
+            out.append(RouteDef(method, pat, canon(pat) or (), sf,
+                                node.lineno, dotted_name(args[-1])))
+    return out
+
+
+# ---- call sites -------------------------------------------------------
+
+def _joined_path(j: ast.JoinedStr) -> Optional[str]:
+    parts: List[str] = []
+    started = False
+    for v in j.values:
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            s = v.value
+            if not started:
+                if "://" in s:
+                    s = s.split("://", 1)[1]
+                    i = s.find("/")
+                    if i < 0:
+                        continue
+                    s = s[i:]
+                elif not s.startswith("/"):
+                    continue
+                started = True
+            parts.append(s)
+        elif started:
+            parts.append("*")
+    return "".join(parts) if parts else None
+
+
+def _expr_path(node: ast.AST) -> Optional[str]:
+    s = const_str(node)
+    if s is not None:
+        if "://" in s:
+            s = s.split("://", 1)[1]
+            i = s.find("/")
+            return s[i:] if i >= 0 else None
+        return s if s.startswith("/") else None
+    if isinstance(node, ast.JoinedStr):
+        return _joined_path(node)
+    return None
+
+
+def _call_path(call: ast.Call) -> Optional[str]:
+    for arg in call.args[:1]:
+        p = _expr_path(arg)
+        if p is not None:
+            return p
+    # nested helper (_url(port, "/x")) or keyword url=...
+    for sub in ast.walk(call):
+        if sub is call:
+            continue
+        p = _expr_path(sub) if isinstance(
+            sub, (ast.Constant, ast.JoinedStr)) else None
+        if p is not None:
+            return p
+    return None
+
+
+def _enclosing_functions(tree) -> List[Tuple[ast.AST, ast.AST]]:
+    """(function_node, call_node) pairs are awkward with ast.walk; we
+    instead map every node to its enclosing function via a visit."""
+    pairs = []
+
+    class V(ast.NodeVisitor):
+        def __init__(self):
+            self.stack = []
+
+        def visit_FunctionDef(self, node):
+            self.stack.append(node)
+            self.generic_visit(node)
+            self.stack.pop()
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Call(self, node):
+            pairs.append((self.stack[-1] if self.stack else None, node))
+            self.generic_visit(node)
+
+    V().visit(tree)
+    return pairs
+
+
+def collect_calls(files) -> List[CallSite]:
+    """HTTP call sites: the master RPC client helpers
+    (``_worker_get/_worker_post/_scrape_workers``) plus generic
+    ``X.get/post/...`` calls with a literal path/URL (tests, bench,
+    kvwire)."""
+    out: List[CallSite] = []
+    for sf in files:
+        if sf.tree is None:
+            continue
+        for fn, call in _enclosing_functions(sf.tree):
+            name = dotted_name(call.func) or ""
+            short = name.rsplit(".", 1)[-1]
+            method, path, body = None, None, None
+            if short in ("_worker_get", "_worker_post") and call.args:
+                if len(call.args) >= 2:
+                    path = _expr_path(call.args[1]) or (
+                        const_str(call.args[1]))
+                method = "GET" if short == "_worker_get" else "POST"
+                if method == "POST" and len(call.args) >= 3:
+                    body = call.args[2]
+            elif short == "_scrape_workers" and call.args:
+                path = const_str(call.args[0])
+                method = "GET"
+            elif short in _HTTP_ATTRS and name != short:
+                path = _call_path(call)
+                method = short.upper()
+                for kw in call.keywords:
+                    if kw.arg == "json":
+                        body = kw.value
+            else:
+                continue
+            if path is None:
+                continue
+            segs = canon(path)
+            if segs is None:
+                continue
+            out.append(CallSite(method, path.partition("?")[0], segs,
+                                sf, call.lineno, body, fn))
+    return out
+
+
+# ---- reference universes ----------------------------------------------
+
+def text_path_refs(text: str) -> Set[Tuple[str, ...]]:
+    refs: Set[Tuple[str, ...]] = set()
+    for m in _DOC_PATH_RE.finditer(text):
+        tok = m.group(1).rstrip(".,;:)`'\"")
+        c = canon(tok)
+        if c:
+            refs.add(c)
+    return refs
+
+
+def collect_quiet_set(files) -> List[Tuple[SourceFile, int, str]]:
+    """QUIET_TRACE_PATHS literal entries (httpd's open-set of unrecorded
+    poll paths)."""
+    out = []
+    for sf in files:
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == "QUIET_TRACE_PATHS"):
+                for sub in ast.walk(node.value):
+                    s = const_str(sub)
+                    if s is not None and s.startswith("/"):
+                        out.append((sf, sub.lineno, s))
+    return out
+
+
+def collect_armed_points(test_files, doc_paths
+                         ) -> List[Tuple[str, int, str]]:
+    """Fault points armed in tests (dict literals with a "point" key)
+    and in DLI_FAULTS examples in the docs. Returns (rel, line, point)
+    — rel is repo-relative for the report."""
+    out = []
+    for sf in test_files:
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Dict):
+                continue
+            for k, v in zip(node.keys, node.values):
+                if k is not None and const_str(k) == "point":
+                    p = const_str(v)
+                    if p:
+                        out.append((sf.rel, v.lineno, p))
+    for path in doc_paths:
+        try:
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+        except OSError:
+            continue
+        rel = os.path.basename(os.path.dirname(path)) + "/" + \
+            os.path.basename(path)
+        for i, line in enumerate(text.splitlines(), 1):
+            for m in _DOC_POINT_RE.finditer(line):
+                out.append((rel, i, m.group(1)))
+    return out
+
+
+def collect_rpc_fault_sites(files) -> Set[str]:
+    """Literals passed to ``_rpc_fault("<path>")`` — each is a live
+    client-side intercept point ``rpc:<path>``."""
+    sites: Set[str] = set()
+    for sf in files:
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func) or ""
+                if name.rsplit(".", 1)[-1] == "_rpc_fault" and node.args:
+                    s = const_str(node.args[0])
+                    if s:
+                        sites.add("rpc:" + s)
+    return sites
+
+
+# ---- body-key analysis ------------------------------------------------
+
+def _func_index(files) -> Dict[str, ast.AST]:
+    """name -> FunctionDef for every function/method in the scanned
+    files (methods indexed by bare name; the protocol surface has no
+    colliding handler names across services that read bodies
+    differently enough to matter — collisions mark the entry None and
+    the checker skips, never guesses)."""
+    idx: Dict[str, ast.AST] = {}
+    dupes: Set[str] = set()
+    for sf in files:
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if node.name in idx:
+                    dupes.add(node.name)
+                idx[node.name] = node
+    for d in dupes:
+        idx.pop(d, None)
+    return idx
+
+
+def built_keys(fn_node: ast.AST, var: Optional[str] = None
+               ) -> Tuple[Set[str], bool]:
+    """Literal keys a function assembles into the dict it returns (or
+    into local ``var``): dict literals, ``x["k"] = ``, ``x.update(...)``
+    with literal keys/kwargs, ``x.setdefault("k", ...)``. Returns
+    (keys, complete) — complete=False when a ``**`` splat or an
+    unresolvable update makes the set open."""
+    keys: Set[str] = set()
+    complete = True
+    names = {var} if var else None
+
+    def dict_keys(d: ast.Dict):
+        nonlocal complete
+        for k in d.keys:
+            if k is None:
+                complete = False
+                continue
+            s = const_str(k)
+            if s is None:
+                complete = False
+            else:
+                keys.add(s)
+
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Return) and isinstance(node.value,
+                                                       ast.Dict):
+            dict_keys(node.value)
+        elif isinstance(node, ast.Assign) and isinstance(node.value,
+                                                         ast.Dict):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and (names is None
+                                                or t.id in names):
+                    dict_keys(node.value)
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if (isinstance(t, ast.Subscript)
+                        and isinstance(t.value, ast.Name)
+                        and (names is None or t.value.id in names)):
+                    s = const_str(t.slice)
+                    if s is None:
+                        complete = False
+                    else:
+                        keys.add(s)
+        elif isinstance(node, ast.Call):
+            name = dotted_name(node.func) or ""
+            base, _, attr = name.rpartition(".")
+            if attr in ("update", "setdefault") and (
+                    names is None or base in names):
+                for kw in node.keywords:
+                    if kw.arg is None:
+                        complete = False
+                    else:
+                        keys.add(kw.arg)
+                for a in node.args:
+                    if isinstance(a, ast.Dict):
+                        dict_keys(a)
+                    elif attr == "setdefault" and const_str(a):
+                        keys.add(const_str(a))
+                        break
+                    else:
+                        complete = False
+    return keys, complete
+
+
+def resolve_body_keys(site: CallSite, funcs: Dict[str, ast.AST]
+                      ) -> Tuple[Set[str], bool]:
+    """Keys of a POST site's body expression. (keys, known)."""
+    b = site.body
+    if b is None:
+        return set(), False
+    if isinstance(b, ast.Dict):
+        keys: Set[str] = set()
+        for k in b.keys:
+            s = const_str(k) if k is not None else None
+            if s is None:
+                return keys, False
+            keys.add(s)
+        return keys, True
+    if isinstance(b, ast.Call):
+        name = (dotted_name(b.func) or "").rsplit(".", 1)[-1]
+        fn = funcs.get(name)
+        if fn is not None:
+            return built_keys(fn)
+        return set(), False
+    if isinstance(b, ast.Name) and site.fn is not None:
+        keys, complete = built_keys(site.fn, var=b.id)
+        # the var may have been seeded from a builder method:
+        #   body = self._infer_body(req); body.update(...)
+        for node in ast.walk(site.fn):
+            if (isinstance(node, ast.Assign)
+                    and any(isinstance(t, ast.Name) and t.id == b.id
+                            for t in node.targets)
+                    and isinstance(node.value, ast.Call)):
+                name = (dotted_name(node.value.func)
+                        or "").rsplit(".", 1)[-1]
+                fn = funcs.get(name)
+                if fn is not None:
+                    k2, c2 = built_keys(fn)
+                    keys |= k2
+                    complete = complete and c2
+                elif name != "dict":
+                    complete = False
+        return keys, complete
+    return set(), False
+
+
+def handler_read_keys(handler: ast.AST, funcs: Dict[str, ast.AST],
+                      depth: int = 4) -> Tuple[Set[str], bool]:
+    """Literal body keys the handler reads, following the body object
+    through same-module helper calls (``self._do_load(body)``,
+    ``dict(body)`` copies, renames) up to ``depth`` hops. Returns
+    (keys, complete): complete=False when the body escapes into code we
+    can't see (the checker then skips unread-key reasoning)."""
+    keys: Set[str] = set()
+    complete = True
+    seen: Set[str] = set()
+
+    def body_param(fn: ast.AST) -> Optional[str]:
+        args = [a.arg for a in fn.args.args if a.arg != "self"]
+        return args[0] if args else None
+
+    def walk_fn(fn: ast.AST, var: str, hops: int):
+        nonlocal complete
+        if fn.name in seen:
+            return
+        seen.add(fn.name)
+        aliases = {var}
+        for node in ast.walk(fn):
+            # aliases: x = body / x = dict(body)
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                v = node.value
+                if isinstance(v, ast.Name) and v.id in aliases:
+                    aliases.add(node.targets[0].id)
+                elif (isinstance(v, ast.Call)
+                      and (dotted_name(v.func) or "") == "dict"
+                      and v.args and isinstance(v.args[0], ast.Name)
+                      and v.args[0].id in aliases):
+                    aliases.add(node.targets[0].id)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Subscript) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id in aliases:
+                s = const_str(node.slice)
+                if s is not None:
+                    keys.add(s)
+            elif isinstance(node, ast.Compare) and node.ops and \
+                    isinstance(node.ops[0], (ast.In, ast.NotIn)) and \
+                    isinstance(node.comparators[0], ast.Name) and \
+                    node.comparators[0].id in aliases:
+                s = const_str(node.left)
+                if s is not None:
+                    keys.add(s)
+            elif isinstance(node, ast.Call):
+                name = dotted_name(node.func) or ""
+                base, _, attr = name.rpartition(".")
+                if not base and isinstance(node.func, ast.Attribute):
+                    # the `(body or {}).get("k")` defensive idiom
+                    recv = node.func.value
+                    if isinstance(recv, ast.BoolOp):
+                        for v in recv.values:
+                            if isinstance(v, ast.Name) and \
+                                    v.id in aliases:
+                                base, attr = v.id, node.func.attr
+                                break
+                if base in aliases and attr in ("get", "pop",
+                                                "setdefault"):
+                    s = const_str(node.args[0]) if node.args else None
+                    if s is not None:
+                        keys.add(s)
+                    continue
+                # propagation: body handed to another callable
+                passed = [
+                    i for i, a in enumerate(node.args)
+                    if (isinstance(a, ast.Name) and a.id in aliases)
+                    or (isinstance(a, ast.Call)
+                        and (dotted_name(a.func) or "") == "dict"
+                        and a.args and isinstance(a.args[0], ast.Name)
+                        and a.args[0].id in aliases)]
+                if not passed:
+                    continue
+                callee_name = name.rsplit(".", 1)[-1]
+                callee = funcs.get(callee_name)
+                if callee_name == "dict" or base in aliases:
+                    continue
+                if callee is None or hops <= 0:
+                    complete = False
+                    continue
+                callee_args = [a.arg for a in callee.args.args
+                               if a.arg != "self"]
+                idx = passed[0]
+                if idx < len(callee_args):
+                    walk_fn(callee, callee_args[idx], hops - 1)
+                else:
+                    complete = False
+
+    var = body_param(handler)
+    if var is None:
+        return keys, False
+    walk_fn(handler, var, depth)
+    return keys, complete
+
+
+# ---- the checker ------------------------------------------------------
+
+def check(ctx: Ctx) -> List[Violation]:
+    out: List[Violation] = []
+    pkg = ctx.package_files
+    routes = collect_routes(pkg)
+    test_routes = collect_routes(ctx.test_files)
+    static_routes = [r for r in routes if r.segs is not None]
+    match_routes = static_routes + [r for r in test_routes
+                                    if r.segs is not None]
+
+    def find(segs) -> Tuple[bool, Set[str]]:
+        methods: Set[str] = set()
+        for r in match_routes:
+            if _segs_match(segs, r.segs):
+                methods.add(r.method)
+        return bool(methods), methods
+
+    # -- call sites vs routes ------------------------------------------
+    rpc_calls = collect_calls(pkg)
+    ext_calls = collect_calls(ctx.gate_files + ctx.test_files)
+    for c in rpc_calls + ext_calls:
+        known, methods = find(c.segs)
+        if not known:
+            out.append(Violation(
+                "rpc-unknown-path", c.sf.rel, c.line,
+                f"{c.method} {c.path}: no service registers this path"))
+        elif c.method and c.method not in methods:
+            out.append(Violation(
+                "rpc-method-mismatch", c.sf.rel, c.line,
+                f"{c.method} {c.path}: path is registered under "
+                f"{'/'.join(sorted(methods))} only"))
+
+    # -- dead routes ----------------------------------------------------
+    refs: Set[Tuple[str, ...]] = set()
+    for c in rpc_calls + ext_calls:
+        refs.add(c.segs)
+    text_sources: List[str] = []
+    for p in list(ctx.doc_paths) + list(ctx.shell_paths):
+        try:
+            with open(p, encoding="utf-8") as f:
+                text_sources.append(f.read())
+        except OSError:
+            pass
+    if ctx.dashboard_file is not None:
+        text_sources.append(ctx.dashboard_file.text)
+    for text in text_sources:
+        refs |= text_path_refs(text)
+    for r in static_routes:
+        if r.segs == ():       # the dashboard root page
+            continue
+        if any(_segs_match(r.segs, ref) for ref in refs):
+            continue
+        out.append(Violation(
+            "rpc-dead-route", r.sf.rel, r.line,
+            f"{r.method} {r.pattern}: no caller, test, bench, script, "
+            "dashboard page, or doc reaches this route"))
+
+    # -- quiet open-set -------------------------------------------------
+    for sf, line, path in collect_quiet_set(pkg):
+        c = canon(path)
+        if c is None or not find(c)[0]:
+            out.append(Violation(
+                "rpc-quiet-unknown", sf.rel, line,
+                f"QUIET_TRACE_PATHS entry {path!r} matches no "
+                "registered route"))
+
+    # -- fault points ----------------------------------------------------
+    intercepts: Set[str] = {r.pattern for r in static_routes}
+    intercepts |= {"rpc:" + c.path for c in rpc_calls}
+    intercepts |= collect_rpc_fault_sites(pkg)
+    for rel, line, point in collect_armed_points(ctx.test_files,
+                                                 ctx.doc_paths):
+        if any(fnmatch.fnmatchcase(site, point) for site in intercepts):
+            continue
+        out.append(Violation(
+            "rpc-fault-unknown", rel, line,
+            f"fault point {point!r} matches no live intercept site "
+            "(route path or rpc:<path> client point)"))
+
+    # -- body keys -------------------------------------------------------
+    funcs = _func_index(pkg)
+    handler_reads: Dict[Tuple[str, ...], Tuple[Set[str], bool, RouteDef]] = {}
+    for r in static_routes:
+        if r.method != "POST" or r.handler is None:
+            continue
+        h = funcs.get(r.handler.rsplit(".", 1)[-1])
+        if h is None:
+            continue
+        keys, complete = handler_read_keys(h, funcs)
+        prev = handler_reads.get(r.segs)
+        if prev is not None:
+            keys = keys | prev[0]
+            complete = complete and prev[1]
+        handler_reads[r.segs] = (keys, complete, r)
+
+    mentions: Set[str] = set()
+    for sf in list(ctx.test_files) + list(ctx.gate_files):
+        mentions |= set(_WORD_RE.findall(sf.text))
+    for text in text_sources:
+        mentions |= set(_WORD_RE.findall(text))
+    # package files count as protocol users too — a key the master
+    # forwards by name (api_deploy_plan's tokenizer_path relay) is
+    # sent, even though the relayed body itself is dynamic. Kept
+    # per-file so a handler's OWN file never vouches for its reads.
+    pkg_words: Dict[str, Set[str]] = {
+        sf.rel: set(_WORD_RE.findall(sf.text)) for sf in pkg}
+
+    sent_by_path: Dict[Tuple[str, ...], Set[str]] = {}
+    for c in rpc_calls:
+        if c.method != "POST" or c.body is None:
+            continue
+        entry = handler_reads.get(
+            next((segs for segs in handler_reads
+                  if _segs_match(c.segs, segs)), c.segs))
+        keys, known = resolve_body_keys(c, funcs)
+        if known:
+            sent_by_path.setdefault(c.segs, set()).update(keys)
+        if entry is None:
+            continue
+        reads, complete, _r = entry
+        if known and complete:
+            for k in sorted(keys - reads):
+                out.append(Violation(
+                    "rpc-body-unread", c.sf.rel, c.line,
+                    f"POST {c.path}: body key {k!r} is sent but the "
+                    "handler (and its helpers) never reads it"))
+
+    for segs, (reads, complete, r) in sorted(handler_reads.items()):
+        senders = set()
+        for ssegs, keys in sent_by_path.items():
+            if _segs_match(segs, ssegs):
+                senders |= keys
+        for rel, words in pkg_words.items():
+            if rel != r.sf.rel:
+                senders |= words
+        for k in sorted(reads - senders - mentions):
+            out.append(Violation(
+                "rpc-body-unsent", r.sf.rel, r.line,
+                f"POST {r.pattern}: handler reads body key {k!r} but "
+                "no caller, test, bench, or doc ever mentions it"))
+
+    files = {sf.rel: sf for sf in list(pkg) + list(ctx.test_files)
+             + list(ctx.gate_files)}
+    return filter_suppressed(out, files)

@@ -107,11 +107,15 @@ class Ctx:
     package_files: List[SourceFile] = field(default_factory=list)
     runtime_files: List[SourceFile] = field(default_factory=list)
     gate_files: List[SourceFile] = field(default_factory=list)
+    test_files: List[SourceFile] = field(default_factory=list)
     dashboard_file: Optional[SourceFile] = None
     doc_paths: List[str] = field(default_factory=list)
     shell_paths: List[str] = field(default_factory=list)
     serving_md: Optional[str] = None
+    robustness_md: Optional[str] = None
     knob_registry: Optional[dict] = None     # name -> Knob (or test dict)
+    lifecycle_transitions: Optional[tuple] = None   # runtime/lifecycle.py
+    lifecycle_mod: Optional[object] = None   # the module (diagram check)
 
     @classmethod
     def for_repo(cls, root: Optional[str] = None) -> "Ctx":
@@ -125,6 +129,10 @@ class Ctx:
                  os.path.join(root, "scripts", "telemetry_smoke.py")]
         gate_files = [SourceFile.load(p, root) for p in gates
                       if os.path.exists(p)]
+        tests_dir = os.path.join(root, "tests")
+        test_files = ([SourceFile.load(p, root)
+                       for p in iter_py_files(tests_dir)]
+                      if os.path.isdir(tests_dir) else [])
         dash = os.path.join(pkg, "runtime", "dashboard_html.py")
         dashboard = (SourceFile.load(dash, root)
                      if os.path.exists(dash) else None)
@@ -138,18 +146,39 @@ class Ctx:
             os.path.join(scripts_dir, f) for f in os.listdir(scripts_dir)
             if f.endswith(".sh")) if os.path.isdir(scripts_dir) else []
         from distributed_llm_inferencing_tpu.utils import knobs
+        lifecycle = load_lifecycle(root)
+        robustness = os.path.join(docs_dir, "robustness.md")
         return cls(root=root, package_files=package_files,
                    runtime_files=runtime_files, gate_files=gate_files,
+                   test_files=test_files,
                    dashboard_file=dashboard, doc_paths=doc_paths,
                    shell_paths=shell_paths,
                    serving_md=serving if os.path.exists(serving) else None,
-                   knob_registry=knobs.registry())
+                   robustness_md=(robustness if os.path.exists(robustness)
+                                  else None),
+                   knob_registry=knobs.registry(),
+                   lifecycle_transitions=lifecycle.TRANSITIONS,
+                   lifecycle_mod=lifecycle)
 
 
 def repo_root() -> str:
     """tools/dlilint/core.py -> two dirs up."""
     return os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
+
+
+def load_lifecycle(root: str):
+    """Import runtime/lifecycle.py by FILE PATH — the declared state
+    machine is pure data, but ``runtime/__init__`` imports the engine
+    (and with it jax); loading by path keeps ``python -m tools.dlilint``
+    a sub-second stdlib-only gate."""
+    import importlib.util
+    path = os.path.join(root, "distributed_llm_inferencing_tpu",
+                        "runtime", "lifecycle.py")
+    spec = importlib.util.spec_from_file_location("_dli_lifecycle", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
 def iter_py_files(*dirs: str) -> List[str]:

@@ -1,0 +1,48 @@
+"""dliverify — an exhaustive-interleaving model checker for the
+control plane's concurrency code.
+
+The chaos suite (PR 2) exercises the breaker/idempotency/drain/claim
+machinery under *some* interleavings — whichever the OS scheduler
+happens to produce. dliverify removes the luck: the ``utils/locks.py``
+factories (the narrow waist every runtime lock is born through, PR 9)
+are interposed with scheduler-gated wrappers, the scenario's threads
+are serialized so exactly one runs at a time, and a DFS explorer
+enumerates every order in which the threads can pass their lock-
+acquisition points — running the REAL master/worker/store code, not a
+model of it — asserting machine-checked invariants after every step:
+
+- ``single_claim``            no request claimed by two dispatchers
+- ``single_terminal``         a terminal status, once observed, never
+                              changes (no completed<->failed flip)
+- ``half_open_single_probe``  a half-open breaker admits exactly one
+                              in-flight probe
+- ``inflight_nonnegative``    the master's per-node in-flight counts
+                              never go negative
+- ``tag_exactly_once``        one request_tag executes exactly once
+                              (idempotent claim/join/replay)
+- ``no_strand_on_drain``      drain never reports idle while an
+                              admitted request is still running
+- ``exclusion_honored``       a connection-faulted node is not
+                              re-picked while an alternative exists
+
+Granularity and soundness: threads yield at every runtime-lock
+acquisition (and at explicit scenario markers); a step runs from one
+yield point to the next. Sleep-set pruning (DPOR-style) skips
+re-exploring orders of adjacent steps whose decision points touch
+different locks — sound exactly when cross-thread shared state is
+lock-protected, which is the discipline PR 9's checkers enforce; run
+with ``prune=False`` for the unreduced tree. Unregistered threads
+(store flushers, pool workers) pass through the instrumented locks
+untouched and never create decision points, so schedule counts are
+deterministic and reproducible.
+
+Run: ``python -m tools.dliverify`` (exit 0 = every scenario explored
+with zero violations). ``--mutate <name>`` re-arms a historical bug
+(utils/faults.py MUTATIONS) and expects a counterexample — the
+mutation gate proving the explorer can actually catch regressions.
+Full docs: docs/static_analysis.md.
+"""
+
+from .sched import (Explorer, ExplorationResult, Scheduler,  # noqa: F401
+                    Violation)
+from .scenarios import SCENARIOS  # noqa: F401

@@ -1,0 +1,135 @@
+"""CLI: ``python -m tools.dliverify [--scenario s] [--budget S]
+[--mutate name] [--prune] [--list]``.
+
+Exit 0: every selected scenario fully explored, zero violations (or,
+with ``--mutate``, a counterexample was produced — the mutation gate
+PASSES by finding the bug). Exit 1: an invariant violation (or a
+mutation the explorer failed to catch). Exit 2: usage / hang.
+
+Budget: ``--budget`` seconds per scenario (default: the
+``DLI_VERIFY_BUDGET`` knob, 20). Exploration stopped by the budget is
+reported loudly (explored N schedules, INCOMPLETE) and fails the run —
+a bounded gate must either finish or say so, never silently pass on a
+truncated tree.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.dliverify",
+        description="Exhaustive-interleaving model checker for the "
+                    "control plane (docs/static_analysis.md)")
+    ap.add_argument("--scenario", default="",
+                    help="comma list of scenarios (default: all)")
+    ap.add_argument("--budget", type=float,
+                    default=float(os.environ.get("DLI_VERIFY_BUDGET",
+                                                 20)),
+                    help="seconds of exploration per scenario")
+    ap.add_argument("--mutate", default="",
+                    help="arm a historical bug (utils/faults.py "
+                         "MUTATIONS) and REQUIRE a counterexample")
+    ap.add_argument("--prune", action="store_true",
+                    help="DPOR-style sleep-set pruning (heuristic "
+                         "accelerator; the CI gate runs the full tree)")
+    ap.add_argument("--list", action="store_true",
+                    help="list scenarios and invariants, then exit")
+    args = ap.parse_args(argv)
+
+    # scenario threads log expected failures (injected faults) loudly;
+    # the explorer's report is the artifact, not the log stream. The
+    # env default covers the not-yet-configured case (setup_logging
+    # honors it at first import), the setLevel the already-configured
+    # one (an earlier import in the same process).
+    os.environ.setdefault("DLI_LOG_LEVEL", "ERROR")
+    logging.getLogger("dli_tpu").setLevel(logging.ERROR)
+
+    from . import SCENARIOS
+    from .scenarios import MUTATION_SCENARIOS
+    from .sched import Explorer, run_scenario_once
+
+    if args.list:
+        for s in SCENARIOS.values():
+            print(f"{s.name}: {s.description} "
+                  f"[{', '.join(s.invariants)}; {s.threads} threads]")
+        return 0
+
+    if args.mutate:
+        from distributed_llm_inferencing_tpu.utils.faults import (
+            MUTATIONS)
+        if args.mutate not in MUTATIONS:
+            print(f"dliverify: unknown mutation {args.mutate!r} "
+                  f"(known: {', '.join(MUTATIONS)})", file=sys.stderr)
+            return 2
+        names = [MUTATION_SCENARIOS[args.mutate]]
+    elif args.scenario:
+        names = [s.strip() for s in args.scenario.split(",")
+                 if s.strip()]
+        bad = sorted(set(names) - set(SCENARIOS))
+        if bad:
+            print(f"dliverify: unknown scenario(s): {', '.join(bad)}",
+                  file=sys.stderr)
+            return 2
+    else:
+        names = list(SCENARIOS)
+
+    prev_env = os.environ.get("DLI_VERIFY_MUTATIONS")
+    if args.mutate:
+        os.environ["DLI_VERIFY_MUTATIONS"] = args.mutate
+    failed = False
+    try:
+        for name in names:
+            scenario = SCENARIOS[name]
+            exp = Explorer(
+                lambda prefix, s=scenario: run_scenario_once(s, prefix),
+                budget_s=args.budget, prune=args.prune)
+            res = exp.explore(name)
+            tag = (f"{res.schedules} schedule(s), "
+                   f"{res.decision_points} max decision points, "
+                   f"{res.elapsed_s:.2f}s")
+            if args.mutate:
+                if res.violation is not None:
+                    print(f"dliverify {name} [mutation {args.mutate}]: "
+                          f"counterexample found as required ({tag})")
+                    print(res.violation.render())
+                else:
+                    print(f"dliverify {name} [mutation {args.mutate}]: "
+                          f"NO counterexample ({tag}) — the explorer "
+                          "failed to catch the re-armed bug",
+                          file=sys.stderr)
+                    failed = True
+                continue
+            if res.violation is not None:
+                print(f"dliverify {name}: FAIL ({tag})")
+                print(res.violation.render())
+                failed = True
+            elif res.hung is not None:
+                print(f"dliverify {name}: HANG — {res.hung} ({tag})",
+                      file=sys.stderr)
+                failed = True
+            elif not res.complete:
+                print(f"dliverify {name}: INCOMPLETE — budget "
+                      f"exhausted after {tag}; raise "
+                      "DLI_VERIFY_BUDGET or bound the scenario",
+                      file=sys.stderr)
+                failed = True
+            else:
+                print(f"dliverify {name}: exhaustively explored, "
+                      f"0 violations ({tag})")
+    finally:
+        if args.mutate:
+            if prev_env is None:
+                os.environ.pop("DLI_VERIFY_MUTATIONS", None)
+            else:
+                os.environ["DLI_VERIFY_MUTATIONS"] = prev_env
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

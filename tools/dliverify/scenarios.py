@@ -1,0 +1,435 @@
+"""Bounded scenarios the explorer enumerates — each drives the REAL
+control-plane code (``runtime/master.py`` / ``runtime/worker.py`` /
+``runtime/state.py``), not a model of it.
+
+Determinism rules every scenario obeys:
+
+- registered threads touch shared state only through code whose yield
+  points are runtime-lock acquisitions (the interposed factories);
+- no registered thread takes a branch on wall-clock or RNG state that
+  changes its *lock-acquisition sequence* (backoff bases are pinned to
+  0, claim delays to 0);
+- master scenarios that exercise buffered status writes swap the
+  group-commit store for a synchronous one (``group_commit=False``) —
+  the write-behind flusher is an environment thread whose timing would
+  otherwise make decision-point counts racy. The REAL requeue/claim/
+  terminal SQL still runs; only the delivery is synchronous (the
+  barrier semantics themselves are model-checked via ``terminal_once``
+  ordering, and dynamically exercised by the chaos suite).
+
+Each scenario declares the invariants it checks; ``check_step`` runs
+after every scheduled step (all registered threads quiescent),
+``check_final`` after the schedule completes. Returning
+``(invariant, detail)`` aborts exploration with a counterexample
+trace.
+"""
+
+from __future__ import annotations
+
+import types
+from typing import Optional, Tuple
+
+Bad = Optional[Tuple[str, str]]
+
+
+def _fresh_store(path=":memory:"):
+    from distributed_llm_inferencing_tpu.runtime.state import Store
+    return Store(path, group_commit=False)
+
+
+def _fresh_master(**kw):
+    from distributed_llm_inferencing_tpu.runtime.master import Master
+    return Master(":memory:", **kw)
+
+
+def _swap_sync_store(m):
+    """Replace the master's group-commit store with a synchronous one
+    (scenario determinism — see module docstring). Re-wires nothing
+    else: the master holds the only reference."""
+    from distributed_llm_inferencing_tpu.runtime.state import Store
+    m.store.close()
+    m.store = Store(":memory:", group_commit=False)
+    return m.store
+
+
+class Scenario:
+    name = ""
+    description = ""
+    invariants: Tuple[str, ...] = ()
+    threads = 0
+
+    def build(self, sched):
+        raise NotImplementedError
+
+    def check_step(self, ctx) -> Bad:
+        return None
+
+    def check_final(self, ctx) -> Bad:
+        return None
+
+    def cleanup(self, ctx):
+        m = getattr(ctx, "master", None)
+        if m is not None:
+            m.stop()
+        s = getattr(ctx, "store", None)
+        if s is not None:
+            s.close()
+
+
+def _inflight_bad(master) -> Bad:
+    for nid, v in list(master._inflight.items()):
+        if v < 0:
+            return ("inflight_nonnegative",
+                    f"node {nid} in-flight count is {v}")
+    return None
+
+
+class BreakerHalfOpenProbe(Scenario):
+    """Two dispatchers race ``_pick_node(reserve=True)`` against one
+    half-open node: the breaker must admit exactly one probe. The
+    ``half_open_probe`` mutation (skip the probe_ok guard — the PR 2
+    bug) makes both reservations succeed and is the first mutation-gate
+    counterexample."""
+
+    name = "breaker_half_open_probe"
+    description = "half-open breaker admits exactly one probe"
+    invariants = ("half_open_single_probe", "inflight_nonnegative")
+    threads = 2
+
+    def build(self, sched):
+        m = _fresh_master(health_interval=0.05)
+        nid = m.store.add_node("n1", "127.0.0.1", 9001, is_active=True)
+        m.store.update_node(nid, breaker_state="half_open", is_active=1)
+        rows = m.store.list_nodes(active_only=True)
+        ctx = types.SimpleNamespace(master=m, nid=nid, picks=[],
+                                    sched=sched)
+
+        def probe(idx):
+            node = m._pick_node(model=None, reserve=True,
+                                nodes=[dict(r) for r in rows])
+            got = node["id"] if node else None
+            ctx.picks.append((idx, got))
+            sched.mark(f"pick -> {got}")
+
+        sched.spawn("probe-1", probe, 1)
+        sched.spawn("probe-2", probe, 2)
+        return ctx
+
+    def check_step(self, ctx) -> Bad:
+        n = ctx.master._inflight.get(ctx.nid, 0)
+        if n > 1:
+            return ("half_open_single_probe",
+                    f"half-open node {ctx.nid} holds {n} concurrent "
+                    "in-flight probes (must be exactly 1)")
+        return _inflight_bad(ctx.master)
+
+    def check_final(self, ctx) -> Bad:
+        bad = self.check_step(ctx)
+        if bad:
+            return bad
+        admitted = [i for i, got in ctx.picks if got == ctx.nid]
+        if len(admitted) != 1:
+            return ("half_open_single_probe",
+                    f"{len(admitted)} of {len(ctx.picks)} probes were "
+                    "admitted to the half-open node (want exactly 1)")
+        return None
+
+
+class RequeueExclusion(Scenario):
+    """Two requests each fail on node A with a connection fault
+    (`_fail_sub` — the real failover tail), are re-claimed, and
+    re-picked: the pick must avoid the excluded node while node B
+    exists. The ``requeue_exclusion`` mutation (drop excluded-node
+    persistence — the PR 2 bug) routes the retry straight back to the
+    faulted node and is the second mutation-gate counterexample."""
+
+    name = "requeue_exclusion"
+    description = "requeued request never returns to the faulted node"
+    invariants = ("exclusion_honored", "inflight_nonnegative")
+    threads = 2
+
+    def build(self, sched):
+        import requests as http
+        m = _fresh_master(retry_backoff_base=0.0)
+        _swap_sync_store(m)
+        a = m.store.add_node("a", "127.0.0.1", 9001, is_active=True)
+        b = m.store.add_node("b", "127.0.0.1", 9002, is_active=True)
+        for rid_ in range(2):
+            m.store.submit_request("m", "hello world")
+        node_a = m.store.get_node(a)
+        snapshot = m.store.list_nodes(active_only=True)
+        ctx = types.SimpleNamespace(master=m, a=a, b=b, picks=[],
+                                    failed_on_a=set(), sched=sched)
+
+        def repick(req):
+            node = m._reserve_node_for(req, nodes=[dict(r)
+                                                   for r in snapshot])
+            got = node["id"] if node else None
+            ctx.picks.append((req["id"], req["excluded_nodes"], got))
+            sched.mark(f"pick for {req['id']} -> {got}")
+
+        def failing_dispatcher():
+            req = m.store.claim_next_pending()
+            if req is None:
+                return
+            sched.mark(f"claimed request {req['id']}")
+            # attempt failed on node A with a connection-level fault;
+            # the GROUND TRUTH of where it failed lives in the scenario
+            # (failed_on_a), independent of what the store persisted —
+            # that is exactly what the requeue_exclusion mutation lies
+            # about
+            req["node_id"] = a
+            ctx.failed_on_a.add(req["id"])
+            m._fail_sub(req, dict(node_a),
+                        http.exceptions.ConnectionError(
+                            "injected connection fault"),
+                        nodes=snapshot)
+            req2 = m.store.claim_next_pending()
+            if req2 is None:
+                return
+            sched.mark(f"re-claimed request {req2['id']}")
+            repick(req2)
+
+        def contending_dispatcher():
+            # a slim contender: its claim can intercept the requeued
+            # request before the failing dispatcher's re-claim — and
+            # whoever wins it must honor the exclusion. Kept to 2-3
+            # lock points so the full tree stays exhaustively small.
+            req = m.store.claim_next_pending()
+            if req is None:
+                return
+            sched.mark(f"claimed request {req['id']}")
+            repick(req)
+
+        sched.spawn("disp-fail", failing_dispatcher)
+        sched.spawn("disp-race", contending_dispatcher)
+        return ctx
+
+    def check_step(self, ctx) -> Bad:
+        return _inflight_bad(ctx.master)
+
+    def check_final(self, ctx) -> Bad:
+        bad = _inflight_bad(ctx.master)
+        if bad:
+            return bad
+        for rid, excluded, got in ctx.picks:
+            if rid in ctx.failed_on_a and got == ctx.a:
+                return ("exclusion_honored",
+                        f"request {rid} re-picked node {ctx.a} right "
+                        "after a connection fault there, while node "
+                        f"{ctx.b} was schedulable "
+                        f"(persisted exclusions: {excluded})")
+        return None
+
+
+class IdemTagRace(Scenario):
+    """Three dispatch attempts race one request_tag through the
+    worker's REAL idempotency plumbing (`_idem_claim`/`_idem_release`):
+    exactly one may own the execution; late claims replay the cached
+    result; a concurrent claim joins. The generation must run exactly
+    once no matter the order."""
+
+    name = "idem_tag_race"
+    description = "one request_tag executes exactly once"
+    invariants = ("tag_exactly_once",)
+    threads = 3
+
+    def build(self, sched):
+        from distributed_llm_inferencing_tpu.runtime.worker import (
+            WorkerAgent)
+        w = WorkerAgent(auth_key=None)
+        ctx = types.SimpleNamespace(worker=w, executions=[], joins=[],
+                                    replays=[], sched=sched)
+
+        def attempt(idx):
+            kind, obj = w._idem_claim("tag-1")
+            sched.mark(f"claim -> {kind}")
+            if kind == "own":
+                # the "generation": exactly-once is the whole point
+                ctx.executions.append(idx)
+                w._idem_release("tag-1", obj,
+                                {"status": "success", "result": "r"})
+            elif kind == "join":
+                ctx.joins.append(idx)
+            else:
+                ctx.replays.append(idx)
+
+        for i in range(3):
+            sched.spawn(f"attempt-{i + 1}", attempt, i + 1)
+        return ctx
+
+    def check_step(self, ctx) -> Bad:
+        if len(ctx.executions) > 1:
+            return ("tag_exactly_once",
+                    f"tag executed {len(ctx.executions)} times "
+                    f"(threads {ctx.executions})")
+        return None
+
+    def check_final(self, ctx) -> Bad:
+        if len(ctx.executions) != 1:
+            return ("tag_exactly_once",
+                    f"tag executed {len(ctx.executions)} times across "
+                    "3 racing attempts (want exactly 1; "
+                    f"joins={ctx.joins} replays={ctx.replays})")
+        return None
+
+
+class DrainNoStrand(Scenario):
+    """One request races the worker's drain: whatever the order, drain
+    must never report idle (``drained=True``) while a request it
+    admitted is still running — the check-and-increment in
+    ``_try_begin_inference`` shares one lock with the drain flag, and
+    this proves that fence under every interleaving."""
+
+    name = "drain_no_strand"
+    description = "drain never strands an admitted request"
+    invariants = ("no_strand_on_drain",)
+    threads = 2
+
+    def build(self, sched):
+        from distributed_llm_inferencing_tpu.runtime.worker import (
+            WorkerAgent)
+        w = WorkerAgent(auth_key=None)
+        ctx = types.SimpleNamespace(worker=w, events=[], sched=sched)
+
+        def request():
+            if w._try_begin_inference():
+                ctx.events.append(("admitted", None))
+                sched.mark("admitted")
+                w._end_inference()
+                ctx.events.append(("ended", None))
+                sched.mark("ended")
+            else:
+                ctx.events.append(("refused", None))
+                sched.mark("refused (draining)")
+
+        def drainer():
+            res = w.drain({"timeout": 0})
+            ctx.events.append(("drain", res))
+            sched.mark(f"drain -> drained={res['drained']} "
+                       f"in_flight={res['in_flight']}")
+
+        sched.spawn("request", request)
+        sched.spawn("drainer", drainer)
+        return ctx
+
+    def check_final(self, ctx) -> Bad:
+        open_reqs = 0
+        for kind, payload in ctx.events:
+            if kind == "admitted":
+                open_reqs += 1
+            elif kind == "ended":
+                open_reqs -= 1
+            elif kind == "drain" and payload["drained"] and \
+                    payload["in_flight"] == 0 and open_reqs > 0:
+                return ("no_strand_on_drain",
+                        "drain reported idle while an admitted "
+                        "request had not finished")
+        return None
+
+
+class ClaimOnce(Scenario):
+    """Two dispatchers race ``claim_next_pending_many`` over three
+    pending rows: the locked SELECT + executemany flip must hand out
+    disjoint claims covering every due row exactly once."""
+
+    name = "claim_once"
+    description = "concurrent claims are disjoint and complete"
+    invariants = ("single_claim",)
+    threads = 2
+
+    def build(self, sched):
+        s = _fresh_store()
+        ids = [s.submit_request("m", f"p{i}") for i in range(3)]
+        ctx = types.SimpleNamespace(store=s, ids=ids, claims={},
+                                    sched=sched)
+
+        def dispatcher(idx):
+            got = s.claim_next_pending_many(2)
+            ctx.claims[idx] = [r["id"] for r in got]
+            sched.mark(f"claimed {[r['id'] for r in got]}")
+
+        sched.spawn("disp-1", dispatcher, 1)
+        sched.spawn("disp-2", dispatcher, 2)
+        return ctx
+
+    def check_final(self, ctx) -> Bad:
+        a = ctx.claims.get(1, [])
+        b = ctx.claims.get(2, [])
+        dup = set(a) & set(b)
+        if dup:
+            return ("single_claim",
+                    f"requests {sorted(dup)} claimed by BOTH "
+                    f"dispatchers (claims: {a} / {b})")
+        if sorted(a + b) != sorted(ctx.ids):
+            return ("single_claim",
+                    f"claims {a}+{b} do not cover the 3 due rows "
+                    f"{ctx.ids} exactly once")
+        return None
+
+
+class TerminalOnce(Scenario):
+    """A completion races a failure (the user-cancel-vs-finish race)
+    on one claimed request: whichever terminal write lands first must
+    WIN — the row's terminal status, once observable, never changes.
+    This is the race the ``NOT IN ('completed','failed')`` guards on
+    ``mark_completed``/``mark_failed`` close; removing either guard
+    makes this scenario produce a counterexample."""
+
+    name = "terminal_once"
+    description = "a request reaches exactly one terminal state"
+    invariants = ("single_terminal",)
+    threads = 2
+
+    def build(self, sched):
+        s = _fresh_store()
+        rid = s.submit_request("m", "p")
+        s.claim_next_pending()
+        ctx = types.SimpleNamespace(store=s, rid=rid, observed=[],
+                                    sched=sched)
+
+        def completer():
+            s.mark_completed(rid, "out", 1, 0.1, 1.0)
+            st = s.get_request(rid)["status"]
+            ctx.observed.append(st)
+            sched.mark(f"completed write; row now {st}")
+
+        def failer():
+            s.mark_failed(rid, "cancelled by user")
+            st = s.get_request(rid)["status"]
+            ctx.observed.append(st)
+            sched.mark(f"failed write; row now {st}")
+
+        sched.spawn("completer", completer)
+        sched.spawn("failer", failer)
+        return ctx
+
+    def check_final(self, ctx) -> Bad:
+        terminal = None
+        for st in ctx.observed:
+            if st in ("completed", "failed"):
+                if terminal is None:
+                    terminal = st
+                elif st != terminal:
+                    return ("single_terminal",
+                            f"request {ctx.rid} observed in terminal "
+                            f"state {terminal!r} and LATER in "
+                            f"{st!r} — a terminal verdict flipped")
+        final = ctx.store.get_request(ctx.rid)["status"]
+        if final not in ("completed", "failed"):
+            return ("single_terminal",
+                    f"request {ctx.rid} ended non-terminal ({final!r}) "
+                    "despite two terminal writes")
+        return None
+
+
+SCENARIOS = {s.name: s for s in (
+    BreakerHalfOpenProbe(), RequeueExclusion(), IdemTagRace(),
+    DrainNoStrand(), ClaimOnce(), TerminalOnce())}
+
+# which scenario proves which re-armed historical bug (the mutation
+# gate): utils/faults.py MUTATIONS -> scenario name
+MUTATION_SCENARIOS = {
+    "half_open_probe": "breaker_half_open_probe",
+    "requeue_exclusion": "requeue_exclusion",
+}

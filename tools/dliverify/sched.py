@@ -1,0 +1,409 @@
+"""Deterministic cooperative scheduler + DFS schedule explorer.
+
+The scheduler serializes a scenario's registered threads: each runs
+until its next *yield point* (a runtime-lock acquisition through the
+interposed ``utils/locks.py`` factories), then parks; the explorer
+decides who runs next. One (prefix-replayed) run of the scenario = one
+schedule; the explorer enumerates schedules breadth-first over the
+divergence depth and re-runs the scenario from scratch per schedule
+(stateless model checking — no snapshotting, the real code really
+executes, and a found counterexample diverges from the default
+schedule as early as possible).
+
+Steps are coarse — run-to-next-lock-acquisition — so the default
+exploration is the FULL tree (that is what "exhaustive" means in the
+CI gate). The optional DPOR-style sleep-set pruning treats two pending
+acquisitions of different lock roles as independent; that is sound
+exactly when cross-thread shared state is lock-protected (the
+discipline PR 9's checkers enforce) and is therefore offered as an
+accelerator (``prune=True``), not the gate default.
+
+Threads NOT spawned through ``Scheduler.spawn`` (group-commit
+flushers, pool executors) acquire the instrumented locks directly and
+never create decision points — they are environment, not model, and
+schedule counts stay deterministic.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from distributed_llm_inferencing_tpu.utils import locks as locks_mod
+
+# How long the explorer waits for the running thread to reach its next
+# yield point before declaring the schedule hung. Generous: a step may
+# legitimately block on environment threads (a group-commit barrier
+# waits out a flush cycle).
+_STEP_TIMEOUT_S = 30.0
+
+
+@dataclass
+class Violation:
+    invariant: str
+    detail: str
+    schedule: Tuple[int, ...]
+    trace: List[str]
+
+    def render(self) -> str:
+        lines = [f"INVARIANT VIOLATED: {self.invariant}",
+                 f"  {self.detail}",
+                 f"  schedule choices: {list(self.schedule)}",
+                 "  counterexample trace (thread-step order):"]
+        for i, step in enumerate(self.trace):
+            lines.append(f"    {i:3d}. {step}")
+        return "\n".join(lines)
+
+
+@dataclass
+class ExplorationResult:
+    scenario: str
+    schedules: int
+    complete: bool           # False = stopped early (budget/violation)
+    violation: Optional[Violation]
+    hung: Optional[str]      # hang/deadlock description, if any
+    elapsed_s: float
+    decision_points: int     # max decision depth seen
+
+
+class _ThreadState:
+    __slots__ = ("name", "go", "parked", "action", "pending", "thread",
+                 "done", "error", "held")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.go = threading.Event()
+        self.parked = threading.Event()
+        self.action: Tuple[str, Optional[str]] = ("start", None)
+        self.pending: Optional["SchedLock"] = None
+        self.thread: Optional[threading.Thread] = None
+        self.done = False
+        self.error: Optional[BaseException] = None
+        self.held: List["SchedLock"] = []
+
+
+class SchedLock:
+    """Scheduler-gated lock handed out by the interposed factory.
+    Registered threads park at blocking ``acquire`` (a decision
+    point); unregistered threads use the underlying primitive
+    directly. Quacks enough like a Lock for ``with``, the Condition
+    fallback protocol, and non-blocking probes."""
+
+    __slots__ = ("name", "_sched", "_reentrant", "_lk", "_owner",
+                 "_count")
+
+    def __init__(self, sched: "Scheduler", kind: str, name: str):
+        self.name = name
+        self._sched = sched
+        self._reentrant = kind == "rlock"
+        self._lk = (threading.RLock() if self._reentrant
+                    else threading.Lock())
+        self._owner: Optional[_ThreadState] = None
+        self._count = 0
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        t = self._sched._current()
+        if t is None or not blocking:
+            got = self._lk.acquire(blocking, timeout)
+            if got and t is not None:
+                self._note_acquired(t)
+            return got
+        if self._reentrant and self._owner is t:
+            # immediately grantable: not a branching point, so skipping
+            # the park keeps the schedule tree at real decisions only
+            self._lk.acquire()
+            self._count += 1
+            return True
+        self._sched._yield_point(t, ("acquire", self.name), self)
+        self._lk.acquire()
+        self._note_acquired(t)
+        return True
+
+    def _note_acquired(self, t: _ThreadState):
+        self._owner = t
+        self._count += 1
+        t.held.append(self)
+
+    def release(self):
+        t = self._sched._current()
+        if t is not None and self._owner is t:
+            self._count -= 1
+            if self._count == 0:
+                self._owner = None
+            for i in range(len(t.held) - 1, -1, -1):
+                if t.held[i] is self:
+                    del t.held[i]
+                    break
+        self._lk.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        fn = getattr(self._lk, "locked", None)
+        return fn() if fn is not None else self._owner is not None
+
+    def __repr__(self):
+        return f"<dliverify.SchedLock {self.name!r}>"
+
+
+class Scheduler:
+    """One scenario run under one prescribed choice prefix."""
+
+    def __init__(self, choices: Tuple[int, ...] = ()):
+        self._threads: List[_ThreadState] = []
+        self._by_ident: Dict[int, _ThreadState] = {}
+        self._choices = choices
+        self.decisions: List[Tuple[int, int]] = []  # (n_enabled, chosen)
+        self.enabled_log: List[List[Tuple[str, str, Optional[str]]]] = []
+        self.trace: List[str] = []
+        self.hung = False
+
+    # ---- scenario-facing API -----------------------------------------
+
+    def spawn(self, name: str, fn: Callable, *args, **kwargs):
+        t = _ThreadState(name)
+
+        def run():
+            self._by_ident[threading.get_ident()] = t
+            t.parked.set()          # parked at ("start", None)
+            t.go.wait()
+            t.go.clear()
+            self.trace.append(f"{t.name}: start")
+            try:
+                fn(*args, **kwargs)
+            except BaseException as e:     # surfaced by the explorer
+                t.error = e
+            finally:
+                t.done = True
+                t.parked.set()
+
+        t.thread = threading.Thread(target=run, daemon=True,
+                                    name=f"dliverify-{name}")
+        self._threads.append(t)
+        return t
+
+    def mark(self, label: str):
+        """Trace annotation from inside a scenario thread (NOT a yield
+        point — it rides the current step)."""
+        t = self._current()
+        if t is not None:
+            self.trace.append(f"{t.name}: {label}")
+
+    def lock_factory(self, kind: str, name: str):
+        return SchedLock(self, kind, name)
+
+    # ---- internals ----------------------------------------------------
+
+    def _current(self) -> Optional[_ThreadState]:
+        return self._by_ident.get(threading.get_ident())
+
+    def _yield_point(self, t: _ThreadState, action, lock: "SchedLock"):
+        t.action = action
+        t.pending = lock
+        t.parked.set()
+        t.go.wait()
+        t.go.clear()
+        t.pending = None
+        self.trace.append(f"{t.name}: {action[0]} {action[1] or ''}"
+                          .rstrip())
+
+    def _runnable(self) -> List[_ThreadState]:
+        out = []
+        for t in self._threads:
+            if t.done or not t.parked.is_set():
+                continue
+            lk = t.pending
+            if lk is not None:
+                owner = lk._owner
+                if owner is not None:
+                    # held by another registered thread — not runnable;
+                    # held by t itself (non-reentrant re-acquire) — a
+                    # guaranteed self-deadlock, also not runnable, and
+                    # reported as a deadlock when nothing else can move
+                    continue
+            out.append(t)
+        return out
+
+    def run(self, step_hook: Optional[Callable[[], bool]] = None
+            ) -> Optional[str]:
+        """Drive the spawned threads to completion under the choice
+        prefix (index 0 past the prefix). Returns an error string on
+        hang/deadlock or a thread exception; ``step_hook`` returning
+        True stops the run (an invariant fired mid-schedule)."""
+        for t in self._threads:
+            t.thread.start()
+        for t in self._threads:
+            if not t.parked.wait(_STEP_TIMEOUT_S):
+                self.hung = True
+                return f"thread {t.name} never reached its start point"
+        depth = 0
+        while True:
+            live = [t for t in self._threads if not t.done]
+            if not live:
+                break
+            runnable = self._runnable()
+            if not runnable:
+                self.hung = True
+                return "deadlock: " + ", ".join(
+                    f"{t.name} waiting on {t.action[1]}" for t in live)
+            if len(runnable) > 1:
+                chosen = (self._choices[depth]
+                          if depth < len(self._choices) else 0)
+                if chosen >= len(runnable):
+                    # replay divergence: the parent run saw more enabled
+                    # threads at this depth than this run does — the
+                    # scenario is nondeterministic. Fail LOUDLY rather
+                    # than clamp onto a different schedule and let the
+                    # gate report a tree it never actually explored.
+                    self.hung = True
+                    return (f"replay diverged at decision {depth}: "
+                            f"prescribed choice {chosen} but only "
+                            f"{len(runnable)} thread(s) enabled — "
+                            "scenario is nondeterministic")
+                self.decisions.append((len(runnable), chosen))
+                self.enabled_log.append(
+                    [(x.name, x.action[0], x.action[1])
+                     for x in runnable])
+                depth += 1
+            else:
+                chosen = 0
+            t = runnable[chosen]
+            t.parked.clear()
+            t.go.set()
+            if not t.parked.wait(_STEP_TIMEOUT_S):
+                self.hung = True
+                return (f"schedule hung: {t.name} neither parked nor "
+                        "finished within the step timeout")
+            if step_hook is not None and step_hook():
+                return None     # invariant violation captured by caller
+        for t in self._threads:
+            if t.error is not None:
+                return (f"thread {t.name} raised "
+                        f"{type(t.error).__name__}: {t.error}")
+        return None
+
+
+def _independent(a: Tuple[str, str, Optional[str]],
+                 b: Tuple[str, str, Optional[str]]) -> bool:
+    """Heuristic commutativity for the optional pruning: two pending
+    decisions commute when both are lock acquisitions on DIFFERENT
+    lock roles. Anything else (thread starts, same lock) is dependent."""
+    _, ka, na = a
+    _, kb, nb = b
+    return (ka == "acquire" and kb == "acquire"
+            and na is not None and nb is not None and na != nb)
+
+
+@dataclass
+class RunOutcome:
+    decisions: List[Tuple[int, int]]
+    enabled: List[List[Tuple[str, str, Optional[str]]]]
+    violation: Optional[Violation]
+    hung: bool = False
+    error: Optional[str] = None
+    trace: List[str] = field(default_factory=list)
+
+
+class Explorer:
+    """Stateless BFS/DFS over the schedule tree. ``make_run`` executes
+    one schedule from scratch and reports its decision points."""
+
+    def __init__(self, make_run: Callable[[Tuple[int, ...]], RunOutcome],
+                 budget_s: float = 20.0, max_schedules: int = 100000,
+                 prune: bool = False):
+        self._make_run = make_run
+        self._budget_s = budget_s
+        self._max = max_schedules
+        self._prune = prune
+
+    def explore(self, scenario_name: str) -> ExplorationResult:
+        t0 = time.monotonic()
+        frontier: List[Tuple[int, ...]] = [()]
+        schedules = 0
+        max_depth = 0
+        while frontier:
+            if time.monotonic() - t0 > self._budget_s or \
+                    schedules >= self._max:
+                return ExplorationResult(
+                    scenario_name, schedules, False, None, None,
+                    time.monotonic() - t0, max_depth)
+            prefix = frontier.pop(0)
+            outcome = self._make_run(prefix)
+            schedules += 1
+            max_depth = max(max_depth, len(outcome.decisions))
+            if outcome.hung:
+                return ExplorationResult(
+                    scenario_name, schedules, False, None,
+                    outcome.error or "hang", time.monotonic() - t0,
+                    max_depth)
+            if outcome.violation is not None:
+                outcome.violation.schedule = tuple(
+                    c for _n, c in outcome.decisions)
+                return ExplorationResult(
+                    scenario_name, schedules, False, outcome.violation,
+                    None, time.monotonic() - t0, max_depth)
+            chosen = [c for _n, c in outcome.decisions]
+            for d in range(len(outcome.decisions) - 1,
+                           len(prefix) - 1, -1):
+                n, _c = outcome.decisions[d]
+                enabled = outcome.enabled[d]
+                for alt in range(1, n):
+                    if self._prune and all(
+                            _independent(enabled[alt], enabled[j])
+                            for j in range(alt)):
+                        continue
+                    frontier.append(tuple(chosen[:d]) + (alt,))
+        return ExplorationResult(scenario_name, schedules, True, None,
+                                 None, time.monotonic() - t0, max_depth)
+
+
+def run_scenario_once(scenario, prefix: Tuple[int, ...]) -> RunOutcome:
+    """Build a fresh Scheduler, interpose the locks factories, run the
+    scenario from scratch under ``prefix``, check its invariants."""
+    sched = Scheduler(choices=prefix)
+    prev = locks_mod.set_factory_hook(sched.lock_factory)
+    ctx = None
+    step_bad: List[Tuple[str, str]] = []
+
+    def hook() -> bool:
+        bad = scenario.check_step(ctx)
+        if bad is not None:
+            step_bad.append(bad)
+            return True
+        return False
+
+    try:
+        ctx = scenario.build(sched)
+        err = sched.run(step_hook=hook)
+        violation = None
+        if step_bad:
+            inv, detail = step_bad[0]
+            violation = Violation(inv, detail, prefix,
+                                  list(sched.trace))
+        elif err is not None and not sched.hung:
+            violation = Violation("scenario-error", err, prefix,
+                                  list(sched.trace))
+        elif not sched.hung:
+            bad = scenario.check_final(ctx)
+            if bad is not None:
+                inv, detail = bad
+                violation = Violation(inv, detail, prefix,
+                                      list(sched.trace))
+        return RunOutcome(sched.decisions, sched.enabled_log, violation,
+                          hung=sched.hung, error=err,
+                          trace=list(sched.trace))
+    finally:
+        locks_mod.set_factory_hook(prev)
+        if ctx is not None:
+            try:
+                scenario.cleanup(ctx)
+            except Exception:
+                pass
