@@ -217,6 +217,49 @@ class AdaptiveSpecController:
                 and self.gamma < self.gamma_max):
             self.gamma = min(self.gamma_max, self.gamma * 2)
 
+    def export_state(self) -> dict:
+        """JSON-safe snapshot of the REQUEST-owned half of the policy
+        state — gamma, mode, and the rolling acceptance window — for a
+        live-migration resume record (runtime/batcher.py migrate_out).
+        The throughput EMAs and probe clocks are deliberately excluded:
+        they measure the HOST, and the destination worker seeds those
+        from its own shared arbitration state (_seed_wave_ctl)."""
+        return {
+            "gamma": int(self.gamma), "mode": str(self.mode),
+            "accept": [[int(a), int(d)] for a, d in self._accept],
+            "spec_chunks": int(self._spec_chunks),
+            "plain_chunks": int(self._plain_chunks),
+        }
+
+    def load_state(self, state: dict) -> None:
+        """Adopt a migrated request's exported policy state. Malformed
+        fields are ignored field-by-field — a resume record must never
+        be able to crash the destination scheduler."""
+        if not isinstance(state, dict):
+            return
+        try:
+            g = int(state.get("gamma", self.gamma))
+            self.gamma = min(self.gamma_max, max(1, g))
+        except (TypeError, ValueError):
+            pass
+        if state.get("mode") in ("spec", "plain"):
+            self.mode = state["mode"]
+        acc = state.get("accept")
+        if isinstance(acc, list):
+            self._accept.clear()
+            for pair in acc[-self.window:]:
+                try:
+                    a, d = pair
+                    self._accept.append((int(a), int(d)))
+                except (TypeError, ValueError):
+                    continue
+        for key, attr in (("spec_chunks", "_spec_chunks"),
+                          ("plain_chunks", "_plain_chunks")):
+            try:
+                setattr(self, attr, max(0, int(state.get(key, 0))))
+            except (TypeError, ValueError):
+                pass
+
     def stats(self) -> dict:
         acc = self.acceptance()
         return {

@@ -129,6 +129,16 @@ class BatchRequest:
     kv_export: bool = False
     _peer_fetch_done: bool = False
     _kv_transfer_bytes: int = 0
+    # Live in-flight migration (docs/robustness.md "Live migration"):
+    # _migrate_requested asks the scheduler to snapshot+evict this
+    # request at the next chunk boundary (migrate_out blocks on done);
+    # resume_record is the JSON-safe handoff — emitted tokens, seed,
+    # sampler position, spec-controller state — a destination batcher
+    # resumes from bitwise-exactly; _migrated marks the terminal
+    # "handed off" outcome (distinct from failed in every account).
+    _migrate_requested: bool = False
+    _migrated: bool = False
+    resume_record: Optional[dict] = None
     # cost-ledger accumulators (freed with the request)
     _gaps: List[float] = dataclasses.field(default_factory=list)
     _cost_cached: int = 0       # prompt tokens served from cache tiers
@@ -343,6 +353,10 @@ class ContinuousBatcher:
         # first submit/step (dlilint metric-not-preregistered)
         self.metrics.gauge("batcher_queue_depth", 0.0)
         self.metrics.gauge("batcher_free_kv_blocks", 0.0)
+        # live-migration handoffs (distinct from failed in every
+        # account); registered at 0 so a scrape can't confuse "no
+        # migrations yet" with "metric not exported"
+        self.metrics.inc("batcher_requests_migrated", 0)
         if self.spec_wave:
             for name in ("spec_wave_dispatches", "spec_wave_drafted_tokens",
                          "spec_wave_accepted_tokens",
@@ -483,12 +497,19 @@ class ContinuousBatcher:
                       kv_source: Optional[dict] = None,
                       kv_export: bool = False,
                       kv_transfer_bytes: int = 0,
+                      resume: Optional[dict] = None,
                       trace_ctx=None) -> BatchRequest:
         """Validate and build one BatchRequest WITHOUT enqueueing it —
         submit()/submit_many() construct first so a bad spec can never
         leave siblings half-enqueued."""
         if not prompt:
             raise ValueError("empty prompt")
+        if isinstance(resume, dict) and resume.get("seed") is not None:
+            # a live-migration resume MUST keep the source's seed: the
+            # position-keyed PRNG ((seed, steps) per emitted position)
+            # is what makes the continued sampled stream draw the same
+            # tokens the unmigrated run would have
+            seed = int(resume["seed"])
         if seed is None:
             seed = time.time_ns() % (1 << 31)
         req = BatchRequest(prompt=list(map(int, prompt)),
@@ -506,6 +527,31 @@ class ContinuousBatcher:
         # cost-ledger seed for a submit-time prefetch (the worker pulls
         # the peer KV on its handler thread, then attributes here)
         req._kv_transfer_bytes = int(kv_transfer_bytes or 0)
+        if isinstance(resume, dict) and resume.get("tokens"):
+            # live-migration resume: pre-seed the emitted tokens. They
+            # are never re-emitted (no _emit pass, so the stream
+            # callback fires only for NEW tokens — zero duplicates) and
+            # admission prefills prompt+tokens exactly like a
+            # preemption re-admission, so the continuation is bitwise
+            # the unmigrated run's tail.
+            req.tokens = [int(t) for t in resume["tokens"]]
+            if len(req.tokens) >= req.max_new_tokens:
+                raise ValueError(
+                    f"resume record carries {len(req.tokens)} emitted "
+                    f"tokens >= max_new_tokens {req.max_new_tokens} — "
+                    "the source should have completed, not migrated")
+            spec_state = resume.get("spec")
+            if (spec_state and self.speculative and self.spec_wave
+                    and self._spec_adaptive and self.spec_gamma >= 1):
+                from distributed_llm_inferencing_tpu.ops.speculative \
+                    import AdaptiveSpecController
+                # request-owned policy state (gamma/mode/acceptance)
+                # migrates; throughput EMAs re-seed from THIS host's
+                # shared arbitration state — they measure the host
+                ctl = self._seed_wave_ctl(
+                    AdaptiveSpecController(self.spec_gamma))
+                ctl.load_state(spec_state)
+                req._spec_ctl = ctl
         if len(req.prompt) + req.max_new_tokens > self.max_seq:
             raise ValueError(
                 f"prompt ({len(req.prompt)}) + max_new_tokens "
@@ -520,11 +566,12 @@ class ContinuousBatcher:
                kv_source: Optional[dict] = None,
                kv_export: bool = False,
                kv_transfer_bytes: int = 0,
+               resume: Optional[dict] = None,
                trace_ctx=None) -> BatchRequest:
         req = self._make_request(prompt, max_new_tokens, sampling,
                                  eos_token_id, stream_cb, seed,
                                  kv_source, kv_export, kv_transfer_bytes,
-                                 trace_ctx)
+                                 resume, trace_ctx)
         with self._lock:
             self.queue.append(req)
             depth = len(self.queue)
@@ -1268,22 +1315,32 @@ class ContinuousBatcher:
         req._kv_transfer_bytes += self._fetch_into_arena(
             url, str(src.get("model") or ""), prompt, limit, start=start)
 
-    def _export_request_kv(self, req):
-        """Finish-time export for a disaggregated prefill pass
-        (``kv_export`` dispatch flag): copy the request's PROMPT blocks'
-        device KV into the host arena under their token-chain digests —
-        the blocks a decode-role peer's ``/kv_fetch`` will ask for. Runs
-        while the request still owns its blocks (before release), so the
-        device bytes are exactly the prefilled prefix. Skips blocks the
-        eviction path already offloaded."""
+    def _export_request_kv(self, req, seq=None, n_ctx=None):
+        """KV export into the host arena under token-chain digests —
+        the blocks a peer's ``/kv_fetch`` will ask for. Two callers:
+
+        - finish-time export for a disaggregated prefill pass
+          (``kv_export`` dispatch flag): ``seq`` defaults to the PROMPT,
+          whose KV the prefill pass just wrote in full;
+        - a mid-generation migration snapshot (``_service_migrations``):
+          ``seq`` is prompt+emitted tokens and ``n_ctx`` the slot's
+          context length — only positions whose KV is actually on
+          device export (the last emitted token's KV lands with the
+          NEXT chunk's input, so it is prefilled on the destination).
+
+        Runs while the request still owns its blocks (before release),
+        so the device bytes are exactly the computed prefix. Skips
+        blocks the eviction path already offloaded."""
         if (self.kvtier is None or self.program_hook is not None
                 or req.error or not req._blocks):
             return
         bs = self.block_size
-        n_full = min(len(req.prompt) // bs, len(req._blocks))
+        seq = list(req.prompt) if seq is None else list(seq)
+        n = len(seq) if n_ctx is None else min(int(n_ctx), len(seq))
+        n_full = min(n // bs, len(req._blocks))
         if n_full <= 0:
             return
-        digs = self.kvtier.block_digests(req.prompt[:n_full * bs])
+        digs = self.kvtier.block_digests(seq[:n_full * bs])
         keep = [i for i in range(n_full)
                 if not self.kvtier.arena.peek(digs[i])]
         if not keep:
@@ -1302,6 +1359,91 @@ class ContinuousBatcher:
         trace.get_tracer().record(
             "batcher.kv_export", w0, time.time(),
             attrs={"blocks": n_full, "stored": stored})
+
+    # ---- live in-flight migration ------------------------------------
+
+    def migrate_out(self, req: BatchRequest,
+                    timeout: float = 10.0) -> Optional[dict]:
+        """Snapshot + evict one in-flight request (worker ``POST
+        /migrate_out``): ask the scheduler to export the request's KV
+        through its last context position into the host arena and hand
+        back a resume record at the next chunk boundary. Blocks until
+        the request is terminal either way; returns the resume record,
+        or None when the request completed/failed first (the
+        migrate-vs-complete race — the caller answers 409 and the
+        normal result stands), cannot migrate (multi-host lockstep), or
+        the scheduler never serviced the flag within ``timeout``."""
+        if self.program_hook is not None:
+            return None          # lockstep: host-side evict can't ride
+        req._migrate_requested = True
+        self._work.set()
+        if not req.done.wait(timeout):
+            req._migrate_requested = False
+            return None
+        return req.resume_record if req._migrated else None
+
+    def _service_migrations(self):
+        """Run at every step boundary: snapshot+evict requests flagged
+        by :meth:`migrate_out`. Active slots export their computed KV
+        (the destination's ``/kv_fetch`` + arena restore turns the
+        resume into a scatter + one-token tail prefill instead of a
+        re-prefill); queued requests hand off their resume record alone
+        — their KV, if any, is radix-resident and exports on eviction
+        like always."""
+        pending = any(a is not None and a._migrate_requested
+                      for a in self.active)
+        with self._lock:
+            queued = [r for r in self.queue if r._migrate_requested]
+            for r in queued:
+                self.queue.remove(r)
+        for req in queued:
+            self._finish_migrated(req)
+        if not pending:
+            return
+        for slot in range(self.slots):
+            req = self.active[slot]
+            if req is None or not req._migrate_requested:
+                continue
+            try:
+                self._export_request_kv(
+                    req, seq=req.prompt + req.tokens,
+                    n_ctx=int(self.context_lens[slot]))
+            except Exception as e:
+                log.warning("migration KV export failed for slot %d "
+                            "(%r); destination will recompute", slot, e)
+            # free like a preemption: the radix keeps refcount-0
+            # leaves warm, the arena holds the export for /kv_fetch
+            self.pool.release(req._blocks)
+            req._blocks = []
+            self.active[slot] = None
+            self.block_tables[slot, :] = self._dummy
+            self.context_lens[slot] = 0
+            if slot in self._admit_order:
+                self._admit_order.remove(slot)
+            self._finish_migrated(req)
+
+    def _finish_migrated(self, req: BatchRequest):
+        """Terminal "handed off" outcome. The resume record is
+        everything a destination batcher needs to continue bitwise-
+        exactly: emitted tokens (the stream cursor — the destination
+        re-emits nothing), the seed whose position-keyed PRNG makes the
+        continued sampled stream draw the same tokens, the sampler
+        budget/eos, and the spec-controller policy state."""
+        req.resume_record = {
+            "prompt_tokens": list(req.prompt),
+            "tokens": list(req.tokens),
+            "seed": int(req.seed),
+            "steps": len(req.tokens),
+            "max_new_tokens": int(req.max_new_tokens),
+            "eos_token_id": req.eos_token_id,
+            "spec": (req._spec_ctl.export_state()
+                     if req._spec_ctl is not None else None),
+        }
+        req._migrated = True
+        req.error = "migrated"
+        req.finished_at = time.time()
+        self._observe_finished(req)
+        req.done.set()
 
     def _gauge_stall_streak(self, req):
         """chunk_prefill_stall_streak = the WORST current streak across
@@ -1753,12 +1895,19 @@ class ContinuousBatcher:
         ambient trace context — the link rides req.trace_ctx), plus the
         cost-ledger record the worker returns with the result."""
         m = self.metrics
-        m.inc("batcher_requests_failed" if req.error
+        m.inc("batcher_requests_migrated" if req._migrated
+              else "batcher_requests_failed" if req.error
               else "batcher_requests_completed")
         end = req.finished_at or time.time()
-        m.observe("batcher_e2e_latency", end - req.submitted_at)
-        if req.first_token_at is not None:
-            m.observe("batcher_ttft", req.first_token_at - req.submitted_at)
+        if not req._migrated:
+            # a migrated-out request's [submit, handoff) span is not a
+            # served request — feeding it into the latency histograms
+            # would skew the SLO inputs low and double-count the request
+            # across the fleet (the destination's sample is the real one)
+            m.observe("batcher_e2e_latency", end - req.submitted_at)
+            if req.first_token_at is not None:
+                m.observe("batcher_ttft",
+                          req.first_token_at - req.submitted_at)
         cost = req.cost = self._cost_record(req, end)
         tr = trace.get_tracer()
         attrs = {"tokens": len(req.tokens), "preemptions": req._preemptions,
@@ -1776,8 +1925,9 @@ class ContinuousBatcher:
                       attrs={"tokens": len(req.tokens)})
         # trace tail-sampling: errored and SLO-violating requests keep
         # their spans in the tracer's retained ring, so the postmortem
-        # doesn't race the main ring's oldest-first eviction
-        if req.error or tsdb_mod.cost_within_slo(
+        # doesn't race the main ring's oldest-first eviction (a
+        # migrated-out request is a handoff, not an error worth a slot)
+        if (req.error and not req._migrated) or tsdb_mod.cost_within_slo(
                 cost, self._slo_targets) is False:
             tr.retain(g.trace_id)
 
@@ -1879,7 +2029,11 @@ class ContinuousBatcher:
             self.profiler.step_end(prof_rec, keep=did_work, active=busy)
 
     def _step_inner(self) -> int:
-        # drop cancelled slots first — frees their blocks for admission
+        # service migration snapshots first: a flagged slot must not
+        # ride another chunk (its exported KV would go stale) and its
+        # freed slot/blocks are admission capacity this same step
+        self._service_migrations()
+        # drop cancelled slots next — frees their blocks for admission
         for slot in range(self.slots):
             req = self.active[slot]
             if req is not None and req._cancelled:

@@ -197,6 +197,7 @@ const TS_METRICS = [
   ['batcher_free_kv_blocks', 'free KV blocks (per node)'],
   ['prefix_hit_ratio', 'prefix-cache hit ratio'],
   ['kv_transfer_bytes', 'KV transfer B/s (rate, per node)'],
+  ['worker_role', 'role (0 mixed / 1 prefill / 2 decode)'],
   ['breaker_state', 'breaker (0 closed / 1 half-open / 2 open)'],
   ['slo_attainment', 'SLO attainment (master)'],
 ];
@@ -361,9 +362,11 @@ async function refresh() {{
     return `<tr><td>${{n.id}}</td><td>${{esc(n.name)}}</td>`+
     `<td>${{esc(n.host)}}:${{esc(n.port)}}</td>`+
     `<td><span class="pill ${{stCls}}">${{stTxt}}</span></td>`+
-    // disaggregation role (DLI_WORKER_ROLE): prefill/decode pools vs
-    // the backward-compatible mixed default
-    `<td>${{esc(n.role || 'mixed')}}</td>`+
+    // disaggregation role (mutable via POST /role — the elastic
+    // rebalancer flips pools at runtime): null means the worker's
+    // advertisement went stale past SCHED_STALE_S, same cutoff as the
+    // queue/arena columns — render the dash, not a frozen role
+    `<td>${{n.role != null ? esc(n.role) : '–'}}</td>`+
     `<td>${{dev}}</td>`+
     `<td>${{n.resources && n.resources.cpu != null ? n.resources.cpu : ''}}</td>`+
     `<td>${{n.resources && n.resources.memory != null ? n.resources.memory : ''}}</td>`+

@@ -203,10 +203,23 @@ class KVFetchClient:
         self._pool_size = max(1, int(pool_size))
         self._sessions: Dict[str, object] = {}
         self._lock = locks.lock("kvwire.peer_sessions")
+        # Peer-fetch concurrency bound: a mass migration off one dying
+        # node turns every destination's submit-time prefetch loose at
+        # once, and an unbounded fan-in would thundering-herd the one
+        # source worker's HTTP threads (and this worker's own handler
+        # threads). Fetches past the bound queue on the semaphore and
+        # count, so the pile-up is visible before it is a timeout.
+        import threading
+        try:
+            conc = int(os.environ.get("DLI_KV_FETCH_CONCURRENCY", 4))
+        except ValueError:
+            conc = 4
+        self._sem = threading.BoundedSemaphore(max(1, conc))
         # pre-register (PR 5 rule): a scrape must be able to tell "no
         # transfers yet" from "metric not exported"
         self.metrics.inc("worker_peer_conns_created", 0)
         self.metrics.inc("worker_peer_conns_reused", 0)
+        self.metrics.inc("kv_fetch_queued", 0)
 
     def _session(self, base_url: str):
         import requests as http
@@ -293,37 +306,45 @@ class KVFetchClient:
         import requests as http
         base_url = base_url.rstrip("/")
         digests = [str(d) for d in digests][:MAX_DIGESTS]
-        self._rpc_fault("/kv_fetch")
-        sess = self._session(base_url)
-        headers = ({"Authorization": f"Bearer {self.auth_key}"}
-                   if self.auth_key else {})
+        if not self._sem.acquire(blocking=False):
+            self.metrics.inc("kv_fetch_queued")
+            self._sem.acquire()
         try:
-            r = sess.post(f"{base_url}/kv_fetch",
-                          json={"model_name": model, "digests": digests},
-                          headers=headers, timeout=self.timeout,
-                          stream=True)
-        except Exception:
-            self.purge(base_url)
-            raise
-        try:
-            if r.status_code != 200:
-                r.close()
-                raise KVFetchError(
-                    f"kv_fetch refused ({r.status_code}): {r.text[:200]}")
-            # no Content-Type gate: an injected corrupt fault (or a
-            # proxy error page) can answer 200 with a JSON/garbage
-            # body — parse it as a wire stream and let the magic
-            # check reject it
+            self._rpc_fault("/kv_fetch")
+            sess = self._session(base_url)
+            headers = ({"Authorization": f"Bearer {self.auth_key}"}
+                       if self.auth_key else {})
             try:
-                blocks, _end = decode_frames(
-                    r.iter_content(chunk_size=1 << 18),
-                    max_total_bytes=self.max_bytes)
-            finally:
-                r.close()
-        except (http.exceptions.RequestException, OSError) as e:
-            # mid-stream disconnect/reset: the pooled socket is dead
-            self.purge(base_url)
-            raise KVFetchError(f"kv_fetch transport failed: {e}")
+                r = sess.post(f"{base_url}/kv_fetch",
+                              json={"model_name": model,
+                                    "digests": digests},
+                              headers=headers, timeout=self.timeout,
+                              stream=True)
+            except Exception:
+                self.purge(base_url)
+                raise
+            try:
+                if r.status_code != 200:
+                    r.close()
+                    raise KVFetchError(
+                        f"kv_fetch refused ({r.status_code}): "
+                        f"{r.text[:200]}")
+                # no Content-Type gate: an injected corrupt fault (or a
+                # proxy error page) can answer 200 with a JSON/garbage
+                # body — parse it as a wire stream and let the magic
+                # check reject it
+                try:
+                    blocks, _end = decode_frames(
+                        r.iter_content(chunk_size=1 << 18),
+                        max_total_bytes=self.max_bytes)
+                finally:
+                    r.close()
+            except (http.exceptions.RequestException, OSError) as e:
+                # mid-stream disconnect/reset: the pooled socket is dead
+                self.purge(base_url)
+                raise KVFetchError(f"kv_fetch transport failed: {e}")
+        finally:
+            self._sem.release()
         self._count_conn_reuse(sess)
         allowed = set(digests)
         return {d: pages for d, pages in blocks.items() if d in allowed}

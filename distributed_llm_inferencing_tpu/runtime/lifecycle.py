@@ -89,6 +89,16 @@ TRANSITIONS = (
         "and user cancel of a pending row; never overwrites an "
         "existing terminal state"),
     Transition(
+        "migrate", ("processing",), "pending", "requeue_migrated",
+        "where", "barrier", False,
+        "live in-flight migration: the worker's 303 handoff carries a "
+        "resume record (tokens emitted, seed, sampler position, "
+        "spec-controller state) persisted on the row with a kv_source "
+        "hint back at the source arena; the re-dispatch resumes "
+        "mid-stream on another node; no attempt burned; the "
+        "status='processing' guard means a handoff racing a terminal "
+        "write never resurrects a finished row"),
+    Transition(
         "recover_fail", ("processing",), "failed",
         "recover_stale_processing", "where", "sync-txn", False,
         "startup crash recovery: a poison request at the attempt "

@@ -112,6 +112,27 @@ DISAGG_RECOMPUTE_FLOOR_MS = float(
 # is fuller than this fraction — a full arena silently evicts the very
 # blocks the decode peer is about to fetch.
 SCHED_ARENA_FULL = float(os.environ.get("DLI_SCHED_ARENA_FULL", 0.9))
+# Elastic rebalancing (docs/robustness.md "Live in-flight migration"):
+# a background master loop reads the TSDB queue-depth and
+# arena-occupancy series per pool and (a) flips workers between
+# prefill/decode roles via the runtime POST /role when the pools'
+# sustained utilization diverges past RATIO — static roles strand
+# capacity in whichever pool the load isn't hitting (BENCH_r07:
+# uniform-mix goodput DROPPED 8.23->5.31 req/s under static
+# disaggregation) — and (b) live-migrates in-flight decodes off
+# draining/hot nodes via POST /migrate_out (the 303 handoff +
+# requeue_migrated resume path). DLI_REBALANCE=0 kills the loop;
+# SUSTAIN_S is both the divergence window and the per-node flip
+# cooldown, so one noisy scrape can never flap a role.
+REBALANCE = os.environ.get("DLI_REBALANCE", "1") not in ("0", "false")
+REBALANCE_INTERVAL_S = float(
+    os.environ.get("DLI_REBALANCE_INTERVAL_S", 5.0))
+REBALANCE_SUSTAIN_S = float(
+    os.environ.get("DLI_REBALANCE_SUSTAIN_S", 30.0))
+REBALANCE_RATIO = float(os.environ.get("DLI_REBALANCE_RATIO", 3.0))
+# /migrate_out RPC budget: must cover the worker-side snapshot wait
+# (worker.MIGRATE_TIMEOUT_S) plus transfer slack.
+MIGRATE_RPC_TIMEOUT = 15.0
 # crude chars-per-token estimate for sizing a prompt the master never
 # tokenizes (same spirit as the prefix-digest byte-fraction estimates)
 _DISAGG_CHARS_PER_TOKEN = 4
@@ -174,6 +195,10 @@ class Master:
                  disagg: Optional[bool] = None,
                  disagg_min_prompt: Optional[int] = None,
                  disagg_recompute_floor_ms: Optional[float] = None,
+                 rebalance: Optional[bool] = None,
+                 rebalance_interval_s: Optional[float] = None,
+                 rebalance_sustain_s: Optional[float] = None,
+                 rebalance_ratio: Optional[float] = None,
                  tsdb_step_s: Optional[float] = None,
                  tsdb_window_s: Optional[float] = None):
         self._stop = threading.Event()
@@ -216,6 +241,27 @@ class Master:
         self._disagg_floor_ms = (DISAGG_RECOMPUTE_FLOOR_MS
                                  if disagg_recompute_floor_ms is None
                                  else float(disagg_recompute_floor_ms))
+        # elastic-rebalancer knobs (instance-level so tests/benches can
+        # A/B elastic-vs-static against one process) + its state: per-
+        # node flip cooldown stamps and the migrated-once request set
+        # (migration must converge, not ping-pong a request around)
+        self._rebalance = REBALANCE if rebalance is None else bool(
+            rebalance)
+        self._rebalance_interval = (REBALANCE_INTERVAL_S
+                                    if rebalance_interval_s is None
+                                    else float(rebalance_interval_s))
+        self._rebalance_sustain = (REBALANCE_SUSTAIN_S
+                                   if rebalance_sustain_s is None
+                                   else float(rebalance_sustain_s))
+        self._rebalance_ratio = (REBALANCE_RATIO
+                                 if rebalance_ratio is None
+                                 else float(rebalance_ratio))
+        self._last_flip: Dict[int, float] = {}
+        self._migrated_reqs: Set[int] = set()
+        # flip-back bookkeeping: disagg plans skipped for want of a
+        # prefill pool since the last sweep (the demand signal that
+        # re-creates one after the rebalancer emptied it)
+        self._no_prefill_prev = 0.0
         # per-model prefill cost EWMA (ms per uncached prompt token),
         # learned from the cost ledger — the recompute side of the
         # transfer-vs-recompute decision
@@ -243,7 +289,11 @@ class Master:
                      "scheduler_pick_arena_full_avoided",
                      "scheduler_disagg_transfer",
                      "scheduler_disagg_recompute",
-                     "disagg_prefill_failed"):
+                     "disagg_prefill_failed",
+                     "scheduler_disagg_no_prefill_pool",
+                     "requests_migrated",
+                     "rebalancer_role_flips",
+                     "rebalancer_migrations"):
             self.metrics.inc(name, 0)
         # same rule for the SLO gauges the dashboard charts: they must
         # exist in the exposition from the first scrape (the telemetry
@@ -524,9 +574,14 @@ class Master:
             nodes.append({
                 "id": n["id"], "name": n["name"], "host": n["host"],
                 "port": n["port"], "is_active": bool(n["is_active"]),
-                # disaggregation role (DLI_WORKER_ROLE, rides /health)
-                # and host-arena fullness — the prefill-pick guard input
-                "role": info.get("role") or "mixed",
+                # serving role (mutable via POST /role) and host-arena
+                # fullness — both honor SCHED_STALE_S exactly like
+                # queue depth: a worker that stopped reporting must not
+                # render its last-known role as current (the rebalancer
+                # and the dashboard read the same answer). Never-
+                # scraped nodes fall back to the registration info.
+                "role": ((rt.get("role") or info.get("role") or "mixed")
+                         if rt_fresh or not rt else None),
                 "arena_occupancy": (rt.get("arena_occ")
                                     if rt_fresh else None),
                 "breaker": n.get("breaker_state") or "closed",
@@ -947,12 +1002,20 @@ class Master:
                     kv.get("occupancy"), (int, float)):
                 entry["arena_occ"] = float(kv["occupancy"])
             models[str(m.get("name") or "")] = entry
+        # current serving role rides the same snapshot: the rebalancer
+        # and the role-pool router must see a flip within one sweep,
+        # and a STALE advertisement must drop out like queue depth does
+        role = info.get("role")
         if merge:
             prev = self._node_runtime.get(node_id)
             if prev and prev.get("models"):
                 merged = dict(prev["models"])
                 merged.update(models)
                 models = merged
+            if prev and role is None:
+                # completion piggybacks carry scheduler stats only —
+                # keep the last full /health body's role
+                role = prev.get("role")
         queue = free = occ = None
         for st in models.values():
             queue = (queue or 0) + st["queue"]
@@ -965,12 +1028,18 @@ class Master:
             occ = float(info["arena_occupancy"])
         self._node_runtime[node_id] = {
             "queue": queue, "free_blocks": free, "arena_occ": occ,
-            "at": time.time(), "models": models}
+            "role": role, "at": time.time(), "models": models}
 
     def _node_role(self, node) -> str:
-        """The worker's declared serving role (prefill|decode|mixed),
-        memoized on the row dict like _node_models — it rides the
-        /health body into the persisted node info."""
+        """The worker's declared serving role (prefill|decode|mixed).
+        The FRESH runtime snapshot wins — a rebalancer flip must steer
+        routing from the next health sweep, not the next registration —
+        with the persisted info blob as the fallback for nodes never
+        scraped this run (memoized on the row dict like _node_models)."""
+        s = self._node_runtime.get(node["id"])
+        if (s and s.get("role")
+                and time.time() - s["at"] <= SCHED_STALE_S):
+            return str(s["role"])
         cached = node.get("_role")
         if cached is None:
             try:
@@ -1295,11 +1364,18 @@ class Master:
             body["max_length"] = req["max_length"]
         else:
             body["max_new_tokens"] = req["max_new_tokens"]
-        if req.get("_kv_source"):
-            # disaggregated dispatch: tell the decode node which prefill
-            # peer holds this prompt's KV (runtime/batcher.py
-            # _restore_from_peer pulls it over /kv_fetch)
-            body["kv_source"] = req["_kv_source"]
+        src = req.get("_kv_source") or req.get("kv_source")
+        if src:
+            # disaggregated/migrated dispatch: tell the decode node
+            # which peer holds this sequence's KV (runtime/batcher.py
+            # prefetch over /kv_fetch). The persisted row column keeps
+            # the hint alive across failover retries — a decode-node
+            # death costs a re-fetch, not a re-prefill (FailSafe).
+            body["kv_source"] = src
+        if isinstance(req.get("resume"), dict) and req["resume"]:
+            # live-migration resume record: the worker pre-seeds the
+            # emitted tokens and continues the stream bitwise-exactly
+            body["resume"] = req["resume"]
         return body
 
     def _complete_request(self, req, node, data) -> None:
@@ -1529,6 +1605,28 @@ class Master:
         self._retain_trace(req)
         self._trace_done(req["id"])
 
+    def _handle_migrated(self, req, node, data) -> None:
+        """303 handoff tail, shared by the single and batched dispatch
+        paths: persist the resume record plus a kv_source hint back at
+        the source worker's arena and requeue. No attempt burned, no
+        strike — the node is healthy, it is being drained of this
+        request. The migrated-off node joins the exclusion set so the
+        re-pick routes elsewhere; requeue_migrated's
+        status='processing' guard means a handoff racing a terminal
+        write changes nothing. The submit-time trace context stays
+        registered: the request's life continues on another node."""
+        resume = data.get("resume")
+        resume = resume if isinstance(resume, dict) else {}
+        self.store.requeue_migrated(
+            req["id"], resume=resume,
+            kv_source={"url": self.store.node_url(node),
+                       "model": req["model_name"]},
+            excluded_node_id=node["id"])
+        self.metrics.inc("requests_migrated")
+        log.info("request %d migrated off node %d (%d tokens resume)",
+                 req["id"], node["id"], len(resume.get("tokens") or []))
+        self._wake.set()
+
     def _ensure_model_loaded(self, node, model, sampling):
         """Lazy-load ``model`` on ``node`` if missing (reference
         views.py:397-401 — random init is NOT silently allowed; the
@@ -1608,6 +1706,16 @@ class Master:
                 raise _NodeUnavailable(
                     f"worker unavailable ({r.status_code}): {r.text[:200]}",
                     in_flight=still)
+            if r.status_code == 303:
+                # live-migration handoff: the worker snapshotted this
+                # request out from under the dispatch (POST /migrate_out)
+                # and the 303 carries the resume record
+                try:
+                    data = r.json()
+                except ValueError:
+                    data = {}
+                self._handle_migrated(req, node, data)
+                return False
             if 400 <= r.status_code < 500:
                 self._reject(req, f"rejected: {r.text[:200]}")
                 return False
@@ -1636,6 +1744,11 @@ class Master:
         status = int(status or 500)
         if status == 200:
             self._complete_request(req, node, body or {})
+            return
+        if status == 303:
+            # live-migration handoff on a batched sub-request: same
+            # semantics as the single-dispatch 303
+            self._handle_migrated(req, node, body or {})
             return
         text = json.dumps(body or {})[:200]
         if status in (503, 408):
@@ -1810,16 +1923,24 @@ class Master:
         exclusion/pin state the two-phase flow would complicate, and
         plain dispatch is the safe degradation everywhere."""
         if (not self._disagg or req["attempts"] > 0
-                or req.get("excluded_nodes")):
+                or req.get("excluded_nodes") or req.get("resume")):
+            # (a migrated-in request already carries its kv_source —
+            # re-disaggregating would re-prefill what the resume record
+            # makes fetchable)
             return None
         prompt = req.get("prompt") or ""
         if not isinstance(prompt, str) \
                 or len(prompt) < self._disagg_min_prompt:
             return None
         # a strict prefill pool must exist — a mixed fleet (the default)
-        # never reaches the decision at all
+        # never reaches the decision at all. The counter is the
+        # rebalancer's flip-BACK signal: disagg-eligible demand arriving
+        # with no prefill pool (e.g. after the rebalancer emptied it on
+        # a uniform mix) is what re-creates one (_maybe_flip_roles).
         if not any(self._node_role(n) == "prefill" for n in nodes
                    if not n.get("draining")):
+            if len(nodes) > 1:
+                self.metrics.inc("scheduler_disagg_no_prefill_pool")
             return None
         model = req["model_name"]
         est_tokens = max(1, len(prompt.encode("utf-8", "replace"))
@@ -1941,6 +2062,11 @@ class Master:
         if ok_prefill:
             req["_kv_source"] = {"url": self.store.node_url(pnode),
                                  "model": req["model_name"]}
+            # persist the hint (FailSafe): if the decode node dies
+            # mid-request, the failover retry still knows which arena
+            # holds the prompt's KV — recovery is a re-fetch, not a
+            # re-prefill
+            self.store.set_kv_source(req["id"], req["_kv_source"])
             self.metrics.observe("disagg_prefill_phase",
                                  time.time() - t0)
         else:
@@ -2010,6 +2136,210 @@ class Master:
             t.start()
         for t in threads:
             t.join()
+
+    # ---- elastic rebalancer ------------------------------------------
+
+    def _sustained_series_mean(self, name: str, metric: str):
+        """Mean of a node's TSDB series over the sustain window, or
+        None without >= 2 points — one sample is noise, not sustained
+        divergence, and a node with no retained history must never
+        drive a flip."""
+        pts = []
+        for s in self.tsdb.query(metric, node=name,
+                                 window=self._rebalance_sustain):
+            pts.extend(v for _, v in s["points"])
+        if len(pts) < 2:
+            return None
+        return sum(pts) / len(pts)
+
+    def _rebalance_loop(self):
+        """Background elastic-rebalancing loop (docs/robustness.md
+        "Live in-flight migration"): reactive drain-migration of
+        in-flight work off draining/hot nodes, proactive role flips on
+        sustained pool-utilization divergence. Survives anything — a
+        failed sweep costs one interval."""
+        while not self._stop.is_set():
+            try:
+                self._rebalance_sweep()
+            except Exception as e:
+                log.debug("rebalance sweep failed: %s", e)
+            self._stop.wait(self._rebalance_interval)
+
+    def _rebalance_sweep(self):
+        self._migrate_inflight_off_hot()
+        self._maybe_flip_roles()
+
+    def _migrate_inflight_off_hot(self):
+        """Reactive leg (FailSafe): live-migrate in-flight requests off
+        nodes that are DRAINING (operator drain / planned shutdown —
+        migrate everything) or sustained-hot relative to the coolest
+        fresh-reporting peer (shed a couple per sweep). The handoff
+        itself rides the original dispatch's 303 (_handle_migrated);
+        this only POSTs /migrate_out. A request migrates at most once
+        per master run — rebalancing must converge, not ping-pong."""
+        procs: Dict[int, list] = {}
+        for rid, node in list(self._processing.items()):
+            procs.setdefault(node["id"], []).append((rid, node))
+        if not procs:
+            return
+        now = time.time()
+        nodes = self.store.list_nodes()
+        draining = {n["id"] for n in nodes if n.get("draining")}
+        alive = [n for n in nodes if n.get("is_active")
+                 and not n.get("draining")]
+        fresh = {}
+        for n in alive:
+            s = self._node_runtime.get(n["id"])
+            if (s and now - s["at"] <= SCHED_STALE_S
+                    and s.get("queue") is not None):
+                fresh[n["id"]] = s["queue"]
+        lo = min(fresh.values()) if fresh else 0
+        for nid, reqs in procs.items():
+            if nid in draining:
+                cap = len(reqs)
+            elif (nid in fresh and len(fresh) > 1
+                  and fresh[nid] >= self._rebalance_ratio * (lo + 1)
+                  and fresh[nid] - lo >= 4):
+                cap = 2
+            else:
+                continue
+            if not any(n["id"] != nid for n in alive):
+                continue            # nowhere for the resume to land
+            for rid, node in reqs[:cap]:
+                if rid in self._migrated_reqs:
+                    continue
+                row = self.store.get_request(rid)
+                if not row or row.get("status") != "processing":
+                    continue
+                try:
+                    r = self._worker_post(
+                        node, "/migrate_out",
+                        {"request_tag": self._tag(rid),
+                         "model_name": row["model_name"]},
+                        MIGRATE_RPC_TIMEOUT)
+                except Exception as e:
+                    # transport hiccup: NOT marked migrated — the next
+                    # sweep retries, or a drain would silently degrade
+                    # to waiting out the whole generation
+                    log.debug("migrate_out of request %d failed: %r",
+                              rid, e)
+                    continue
+                if r.status_code == 404:
+                    # NOT settled: the tag registers with the worker's
+                    # batcher only after the submit-time KV prefetch,
+                    # so a sweep racing a fresh dispatch sees a
+                    # transient 404 — retry next sweep (a 404 for an
+                    # already-finished request self-resolves via the
+                    # row-status check above)
+                    continue
+                if len(self._migrated_reqs) > 8192:
+                    # bounded memory; the once-per-run guard degrades
+                    # to once-per-8k-migrations, which still converges
+                    self._migrated_reqs.clear()
+                # a 200 (handoff under way) or 409 (completed first /
+                # can't migrate, e.g. engine mode) settles it —
+                # re-POSTing a 409 every sweep would spin forever
+                self._migrated_reqs.add(rid)
+                if r.status_code == 200:
+                    self.metrics.inc("rebalancer_migrations")
+
+    def _maybe_flip_roles(self):
+        """Proactive leg (FlowKV economics): when the prefill and
+        decode pools' sustained queue-depth means diverge past the
+        configured ratio, flip ONE worker per sweep toward the starving
+        pool via the runtime POST /role. A strict prefill pool may
+        empty entirely — on a uniform short-prompt mix idle prefill
+        capacity IS the BENCH_r07 goodput regression — but the decode
+        pool never does (every full request needs a decode-capable
+        node). Sustained arena-occupancy thrash on a prefill node
+        counts as pool pressure even at zero queue depth."""
+        now = time.time()
+        nodes = [n for n in self.store.list_nodes(active_only=True)
+                 if not n.get("draining")]
+        if len(nodes) < 2:
+            return
+        loads, roles = {}, {}
+        for n in nodes:
+            mean = self._sustained_series_mean(
+                n["name"], "batcher_queue_depth")
+            if mean is None:
+                continue
+            role = self._node_role(n)
+            if role == "prefill":
+                occ = self._sustained_series_mean(
+                    n["name"], "kvtier_occupancy")
+                if occ is not None and occ > SCHED_ARENA_FULL:
+                    mean += 2.0
+            loads[n["id"]] = mean
+            roles[n["id"]] = role
+        pre = [n for n in nodes if roles.get(n["id"]) == "prefill"]
+        dec = [n for n in nodes
+               if roles.get(n["id"]) in ("decode", "mixed")]
+        if not pre:
+            # flip-BACK path: the rebalancer may have emptied the
+            # strict prefill pool on a uniform mix, but disaggregation
+            # must stay reachable — when disagg-eligible demand has
+            # been arriving with nowhere to prefill (the counter
+            # _plan_disagg bumps), re-create the pool from the idlest
+            # decode-capable spare. Without this, emptying the pool
+            # would disable disaggregation for the master's lifetime.
+            cur = self.metrics.snapshot()["counters"].get(
+                "scheduler_disagg_no_prefill_pool", 0.0)
+            delta, self._no_prefill_prev = (cur - self._no_prefill_prev,
+                                            cur)
+            if delta >= 2 and len(dec) > 1:
+                cand = min(dec, key=lambda n: loads.get(n["id"], 0.0))
+                if now - self._last_flip.get(cand["id"], 0) \
+                        >= self._rebalance_sustain:
+                    self._flip_role(cand, "prefill")
+            return
+        if not dec:
+            return
+
+        def avg(pool):
+            return sum(loads[n["id"]] for n in pool) / len(pool)
+
+        ap, ad = avg(pre), avg(dec)
+        ratio = self._rebalance_ratio
+        if ad >= ratio * (ap + 0.5) and ad - ap >= 2.0:
+            # decode starving while prefill capacity idles: the
+            # uniform-mix case static disaggregation strands
+            flip, new_role = min(pre, key=lambda n: loads[n["id"]]), \
+                "decode"
+        elif (ap >= ratio * (ad + 0.5) and ap - ad >= 2.0
+                and len(dec) > 1):
+            flip, new_role = min(dec, key=lambda n: loads[n["id"]]), \
+                "prefill"
+        else:
+            return
+        if now - self._last_flip.get(flip["id"], 0) \
+                < self._rebalance_sustain:
+            return                   # per-node cooldown: no flapping
+        self._flip_role(flip, new_role)
+
+    def _flip_role(self, node, new_role: str) -> bool:
+        """Execute one role flip: POST /role, refresh the node's
+        snapshot (routing memos + persisted info), and mirror the new
+        role into the runtime view so the very next pick honors it."""
+        try:
+            r = self._worker_post(node, "/role", {"role": new_role}, 10)
+        except Exception as e:
+            log.warning("role flip of node %d to %s failed: %r",
+                        node["id"], new_role, e)
+            return False
+        if r.status_code != 200:
+            log.warning("role flip of node %d to %s refused: %s",
+                        node["id"], new_role, r.text[:200])
+            return False
+        self._last_flip[node["id"]] = time.time()
+        self.metrics.inc("rebalancer_role_flips")
+        log.info("rebalancer flipped node %d (%s) -> role %s",
+                 node["id"], node.get("name"), new_role)
+        s = self._node_runtime.get(node["id"])
+        if s is not None:
+            s["role"] = new_role
+        self._refresh_node(node)
+        return True
 
     # ---- circuit breaker ---------------------------------------------
 
@@ -2166,6 +2496,11 @@ class Master:
                              name="telemetry")
         t.start()
         self._threads.append(t)
+        if self._rebalance:
+            t = threading.Thread(target=self._rebalance_loop,
+                                 daemon=True, name="rebalance")
+            t.start()
+            self._threads.append(t)
 
     def serve(self, host="0.0.0.0", port=8000, background=False):
         self.start_background()

@@ -93,7 +93,15 @@ _MIGRATIONS = {
                  # decode phase ms, cached/uncached prefill tokens, KV
                  # peak, spec accounting — runtime/batcher.py), persisted
                  # at completion and served via /api/requests/<id>/cost
-                 ("cost", "TEXT")),
+                 ("cost", "TEXT"),
+                 # live-migration resume record (JSON: emitted tokens,
+                 # seed, sampler position, spec-controller state) and
+                 # the kv_source transfer hint — persisted so a
+                 # re-dispatch AND any later failover retry resume
+                 # mid-stream instead of re-prefilling (FailSafe,
+                 # arxiv 2511.14116)
+                 ("resume", "TEXT"),
+                 ("kv_source", "TEXT")),
 }
 
 
@@ -368,16 +376,21 @@ class Store:
             (model_name, prompt, max_new_tokens, max_length,
              json.dumps(sampling or {}), time.time()))
 
+    @staticmethod
+    def _parse_json_cols(row):
+        for key in ("cost", "resume", "kv_source"):
+            if row.get(key):
+                try:
+                    row[key] = json.loads(row[key])
+                except ValueError:
+                    row[key] = None
+
     def get_request(self, req_id: int):
         r = self._one("SELECT * FROM requests WHERE id=?", (req_id,))
         if r:
             r["sampling"] = json.loads(r["sampling"] or "{}")
             r["excluded_nodes"] = json.loads(r.get("excluded_nodes") or "[]")
-            if r.get("cost"):
-                try:
-                    r["cost"] = json.loads(r["cost"])
-                except ValueError:
-                    r["cost"] = None
+            self._parse_json_cols(r)
         return r
 
     def claim_next_pending(self) -> Optional[Dict[str, Any]]:
@@ -411,6 +424,7 @@ class Store:
                 row["sampling"] = json.loads(row["sampling"] or "{}")
                 row["excluded_nodes"] = json.loads(
                     row.get("excluded_nodes") or "[]")
+                self._parse_json_cols(row)
             return rows
 
     def requeue(self, req_id: int, excluded_node_id: Optional[int] = None,
@@ -452,6 +466,52 @@ class Store:
             f"next_attempt_at=?{extra} WHERE id=?",
             (time.time() + max(0.0, delay_s), *args, req_id),
             barrier=True)
+
+    def requeue_migrated(self, req_id: int, resume: dict,
+                         kv_source: Optional[dict] = None,
+                         excluded_node_id: Optional[int] = None):
+        """Live-migration handoff (the worker answered the in-flight
+        dispatch with a 303 + resume record): back to pending WITHOUT
+        burning an attempt — a handoff is not a failure — with the
+        resume record and the kv_source hint (the source worker's host
+        arena) persisted on the row, so the re-dispatch AND any later
+        failover retry resume mid-stream instead of re-prefilling
+        (FailSafe, arxiv 2511.14116). The migrated-off node joins
+        ``excluded_nodes`` (the re-pick must not hand the request
+        straight back to the node being drained) and ``node_id`` clears
+        so the sticky-retry pin cannot either — a SOFT steer, not a
+        death sentence: ``_pick_node`` falls back to excluded nodes
+        whenever nothing else is schedulable, so excluding a healthy
+        source can never strand the request. Guarded WHERE
+        status='processing': a handoff racing a terminal write must
+        never resurrect a finished row (the dliverify
+        ``migrate_vs_complete`` scenario model-checks this)."""
+        extra = ""
+        args: list = []
+        if excluded_node_id is not None:
+            row = self._one("SELECT excluded_nodes FROM requests "
+                            "WHERE id=?", (req_id,))
+            seen = json.loads((row or {}).get("excluded_nodes") or "[]")
+            if excluded_node_id not in seen:
+                seen.append(excluded_node_id)
+            extra += ", excluded_nodes=?"
+            args.append(json.dumps(seen))
+        if kv_source is not None:
+            extra += ", kv_source=?"
+            args.append(json.dumps(kv_source))
+        self._submit_write(
+            "UPDATE requests SET status='pending', next_attempt_at=0, "
+            f"node_id=NULL, resume=?{extra} "
+            "WHERE id=? AND status='processing'",
+            (json.dumps(resume or {}), *args, req_id), barrier=True)
+
+    def set_kv_source(self, req_id: int, kv_source: Optional[dict]):
+        """Persist a disaggregated dispatch's transfer hint on the row:
+        if the decode node dies mid-request, the failover retry
+        re-dispatches with the hint intact — recovery costs one KV
+        fetch from the still-alive prefill peer, not a re-prefill."""
+        self._exec("UPDATE requests SET kv_source=? WHERE id=?",
+                   (json.dumps(kv_source) if kv_source else None, req_id))
 
     def recover_stale_processing(self, max_attempts: Optional[int] = None
                                  ) -> int:

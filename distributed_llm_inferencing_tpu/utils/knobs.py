@@ -198,6 +198,24 @@ KNOBS = (
     Knob("DLI_KV_FETCH_MAX_MB", "256", "float",
          "Byte cap on one `/kv_fetch` response (server truncates, "
          "client caps reads).", f"{_P}/runtime/worker.py"),
+    Knob("DLI_KV_FETCH_CONCURRENCY", "4", "int",
+         "Concurrent peer KV fetches per worker; the excess queues on "
+         "a semaphore (`dli_kv_fetch_queued_total`) instead of "
+         "thundering-herding one source worker.",
+         f"{_P}/runtime/kvwire.py"),
+    Knob("DLI_REBALANCE", "1", "bool",
+         "`0` kills the master's elastic rebalancer loop (role flips + "
+         "live in-flight migration).", f"{_P}/runtime/master.py"),
+    Knob("DLI_REBALANCE_INTERVAL_S", "5.0", "float",
+         "Seconds between rebalancer sweeps.",
+         f"{_P}/runtime/master.py"),
+    Knob("DLI_REBALANCE_SUSTAIN_S", "30.0", "float",
+         "TSDB window pool-utilization divergence must persist over "
+         "before a role flip — and the per-node flip cooldown.",
+         f"{_P}/runtime/master.py"),
+    Knob("DLI_REBALANCE_RATIO", "3.0", "float",
+         "Sustained pool queue-depth divergence factor that triggers a "
+         "role flip / hot-node shed.", f"{_P}/runtime/master.py"),
     # ---- prefix-cache tier -------------------------------------------
     Knob("DLI_KV_HOST_MB", "256", "float",
          "Host-RAM KV arena budget per loaded model (MB); `0` disables "

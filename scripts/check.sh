@@ -159,6 +159,19 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu DLI_FAULTS_ENABLE=1 \
 timeout -k 10 600 env JAX_PLATFORMS=cpu \
     python bench.py --scenario disagg --smoke || exit 1
 
+echo "== live migration + elastic rebalancing suite + smoke =="
+# Mid-generation KV snapshot + bitwise resume, /migrate_out + 303
+# handoff, role flips, rebalancer policy (docs/robustness.md "Live
+# in-flight migration"); the smoke drives a live master + role-split
+# fleet and gates one proactive role flip on a uniform mix plus
+# kill-mid-wave recovery with zero lost/duplicated tokens (the bench
+# JSON lands at /tmp/dli_bench_rebalance.json for the CI artifact)
+timeout -k 10 600 env JAX_PLATFORMS=cpu DLI_FAULTS_ENABLE=1 \
+    python -m pytest tests/test_migration.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python bench.py --scenario rebalance --smoke || exit 1
+
 echo "== telemetry plane (TSDB + cost ledger + SLO + profiler) =="
 # Time-series retention, per-request cost ledger, SLO accounting, decode
 # profiler (docs/observability.md "Telemetry plane"); the smoke drives a
@@ -204,6 +217,7 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     --ignore=tests/test_dispatch_batch.py \
     --ignore=tests/test_kvtier.py \
     --ignore=tests/test_disagg.py \
+    --ignore=tests/test_migration.py \
     --ignore=tests/test_tsdb.py \
     2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}

@@ -423,9 +423,74 @@ class TerminalOnce(Scenario):
         return None
 
 
+class MigrateVsComplete(Scenario):
+    """A live-migration handoff (``requeue_migrated`` — the worker's
+    303) races the dispatch's completion on one claimed request.
+    Whichever lands first decides: a completion first must STICK —
+    ``requeue_migrated``'s WHERE status='processing' guard makes the
+    late handoff a no-op instead of resurrecting a finished row — and
+    a handoff first puts the row back to pending with its resume
+    record, after which the (still-valid: output is a pure function of
+    (params, prompt, seed)) completion may finish it. Either way the
+    row ends ``completed`` exactly once and a terminal verdict never
+    flips back to live."""
+
+    name = "migrate_vs_complete"
+    description = "a migration handoff never resurrects a terminal row"
+    invariants = ("migrate_never_resurrects",)
+    threads = 2
+
+    def build(self, sched):
+        s = _fresh_store()
+        rid = s.submit_request("m", "p")
+        s.claim_next_pending()
+        ctx = types.SimpleNamespace(store=s, rid=rid, observed=[],
+                                    sched=sched)
+
+        def completer():
+            s.mark_completed(rid, "out", 1, 0.1, 1.0)
+            st = s.get_request(rid)["status"]
+            ctx.observed.append(st)
+            sched.mark(f"completed write; row now {st}")
+
+        def migrator():
+            # the REAL handoff write, exclusion read-modify-write and
+            # resume/kv_source persistence included
+            s.requeue_migrated(rid,
+                               resume={"tokens": [1, 2], "seed": 7},
+                               kv_source={"url": "http://w0",
+                                          "model": "m"},
+                               excluded_node_id=1)
+            st = s.get_request(rid)["status"]
+            ctx.observed.append(st)
+            sched.mark(f"migrate requeue; row now {st}")
+
+        sched.spawn("completer", completer)
+        sched.spawn("migrator", migrator)
+        return ctx
+
+    def check_final(self, ctx) -> Bad:
+        terminal = None
+        for st in ctx.observed:
+            if terminal is not None and st not in ("completed", "failed"):
+                return ("migrate_never_resurrects",
+                        f"request {ctx.rid} observed terminal "
+                        f"{terminal!r} and LATER live {st!r} — the "
+                        "migration handoff resurrected a finished row")
+            if st in ("completed", "failed"):
+                terminal = st
+        final = ctx.store.get_request(ctx.rid)["status"]
+        if final != "completed":
+            return ("migrate_never_resurrects",
+                    f"request {ctx.rid} ended {final!r} — the "
+                    "completion must land in every interleaving "
+                    "(a handoff never makes the row terminal)")
+        return None
+
+
 SCENARIOS = {s.name: s for s in (
     BreakerHalfOpenProbe(), RequeueExclusion(), IdemTagRace(),
-    DrainNoStrand(), ClaimOnce(), TerminalOnce())}
+    DrainNoStrand(), ClaimOnce(), TerminalOnce(), MigrateVsComplete())}
 
 # which scenario proves which re-armed historical bug (the mutation
 # gate): utils/faults.py MUTATIONS -> scenario name
