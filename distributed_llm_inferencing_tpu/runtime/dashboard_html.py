@@ -122,6 +122,16 @@ DASHBOARD = f"""<!doctype html><html><head><title>Dashboard</title>{_STYLE}
     <div class="label">targets TTFT / ITL p95 (ms)</div></div>
 </div>
 <div class="charts" id="charts"></div>
+<h2 style="margin-top:24px">Flight Recorder
+  <span class="muted" style="font-size:12px">(durable event journal —
+  <a href="/api/events" style="color:var(--accent)">/api/events</a>;
+  per-request journey at /api/requests/&lt;id&gt;/journey; event ticks
+  overlay the sparklines above, so a goodput dip lines up with the
+  flip/migration/trip that caused it)</span></h2>
+<table><thead><tr><th>Time</th><th>Severity</th><th>Type</th><th>Node</th>
+<th>Request</th><th>Detail</th></tr></thead>
+<tbody id="events"><tr><td colspan="6" class="muted">no events</td></tr>
+</tbody></table>
 <h2 style="margin-top:24px">Recent Requests</h2>
 <table><thead><tr><th>ID</th><th>Model</th><th>Status</th><th>tok/s</th>
 <th>Latency (s)</th><th>Node</th></tr></thead>
@@ -203,7 +213,8 @@ const TS_METRICS = [
 ];
 const TS_COLORS = ['#4da3ff','#3fb76f','#e0a33c','#e0565b','#b07cf0',
                    '#52c7d8','#8a939e'];
-function sparkline(series, w, h) {{
+const SEV_COLORS = {{info:'#52c7d8', warning:'#e0a33c', error:'#e0565b'}};
+function sparkline(series, w, h, evts) {{
   // shared y-scale across the metric's nodes so lines are comparable
   let lo = Infinity, hi = -Infinity;
   for (const s of series) for (const [, v] of s.points) {{
@@ -216,6 +227,15 @@ function sparkline(series, w, h) {{
   if (t1 === t0) t1 = t0 + 1;
   const x = t => 2 + (w - 4) * (t - t0) / (t1 - t0);
   const y = v => h - 3 - (h - 6) * (v - lo) / (hi - lo);
+  // flight-recorder overlay: one dashed tick per journal event inside
+  // this chart's time window, colored by severity — the dip and its
+  // cause share an x coordinate
+  const ticks = (evts || []).filter(ev => ev.ts >= t0 && ev.ts <= t1)
+    .map(ev => `<line x1="${{x(ev.ts).toFixed(1)}}" `
+      + `x2="${{x(ev.ts).toFixed(1)}}" y1="0" y2="${{h}}" `
+      + `stroke="${{SEV_COLORS[ev.severity] || '#8a939e'}}" `
+      + `stroke-width="1" stroke-dasharray="2,3" opacity="0.7">`
+      + `<title>${{esc(ev.type)}}</title></line>`).join('');
   const lines = series.map((s, i) =>
     `<polyline fill="none" stroke="${{TS_COLORS[i % TS_COLORS.length]}}"
       stroke-width="1.5" points="${{s.points.map(
@@ -225,7 +245,7 @@ function sparkline(series, w, h) {{
     + `<text x="2" y="10" fill="#8a939e" font-size="9">`
     + `${{hi.toPrecision(3)}}</text>`
     + `<text x="2" y="${{h - 1}}" fill="#8a939e" font-size="9">`
-    + `${{lo.toPrecision(3)}}</text>` + lines + '</svg>';
+    + `${{lo.toPrecision(3)}}</text>` + ticks + lines + '</svg>';
 }}
 async function refreshTelemetry() {{
   try {{
@@ -241,10 +261,14 @@ async function refreshTelemetry() {{
     document.getElementById('slo-targets').textContent =
       `${{t.ttft_ms ?? '–'}} / ${{t.itl_p95_ms ?? '–'}}`;
     // all series fetched in parallel: a refresh costs one RTT, not
-    // sum-of-latencies, and one slow endpoint can't stall the rest
-    const results = await Promise.all(TS_METRICS.map(([m]) =>
+    // sum-of-latencies, and one slow endpoint can't stall the rest —
+    // the flight-recorder journal rides the same parallel fetch
+    const [evResult, ...results] = await Promise.all(
+      [fetch('/api/events?limit=120').then(r => r.json())
+         .catch(() => ({{}}))].concat(TS_METRICS.map(([m]) =>
       fetch('/api/timeseries?metric=' + encodeURIComponent(m))
-        .then(r => r.json()).catch(() => ({{}}))));
+        .then(r => r.json()).catch(() => ({{}})))));
+    const evts = evResult.events || [];
     const cards = TS_METRICS.map(([m, title], j) => {{
       // >= 2: a one-point polyline draws nothing and reads as a broken
       // chart — show the placeholder until a line can exist
@@ -255,11 +279,32 @@ async function refreshTelemetry() {{
         + esc(s.node)).join(' ');
       return `<div class="card chart"><div class="label">`
         + `${{esc(title)}}</div>`
-        + (series.length ? sparkline(series, 260, 64)
+        + (series.length ? sparkline(series, 260, 64, evts)
                          : '<div class="muted">no samples</div>')
         + `<div class="legend">${{legend}}</div></div>`;
     }});
     document.getElementById('charts').innerHTML = cards.join('');
+    // flight-recorder table: newest first, request ids link to the
+    // merged journey view
+    document.getElementById('events').innerHTML =
+      evts.slice(-25).reverse().map(ev => {{
+        const sev = ev.severity || 'info';
+        const cls = sev === 'error' ? 'failed'
+          : sev === 'warning' ? 'pending' : 'processing';
+        const req = ev.request_id != null
+          ? `<a href="/api/requests/${{ev.request_id}}/journey" `
+            + `style="color:var(--accent)">#${{ev.request_id}}</a>` : '–';
+        return `<tr><td>${{new Date(ev.ts * 1000)
+            .toLocaleTimeString()}}</td>`
+          + `<td><span class="pill ${{cls}}">${{esc(sev)}}</span></td>`
+          + `<td>${{esc(ev.type)}}</td>`
+          + `<td>${{ev.node != null ? esc(ev.node)
+                    : (ev.node_id ?? '–')}}</td>`
+          + `<td>${{req}}</td>`
+          + `<td class="muted">${{esc(JSON.stringify(
+              ev.data || {{}}))}}</td></tr>`;
+      }}).join('') ||
+      '<tr><td colspan="6" class="muted">no events</td></tr>';
   }} catch (e) {{ console.error(e); }}
 }}
 refreshTelemetry(); setInterval(refreshTelemetry, 10000);

@@ -29,7 +29,7 @@ from distributed_llm_inferencing_tpu.utils.faults import FaultInjector
 QUIET_TRACE_PATHS = frozenset(
     {"/health", "/metrics", "/api/trace", "/api/cluster_metrics",
      "/api/nodes/status", "/api/inference/recent", "/api/timeseries",
-     "/api/slo", "/api/profile"})
+     "/api/slo", "/api/profile", "/api/events"})
 
 
 class Route:

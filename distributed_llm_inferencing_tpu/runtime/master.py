@@ -31,6 +31,7 @@ from typing import Dict, Optional, Set
 import requests as http
 
 from distributed_llm_inferencing_tpu.runtime import dashboard_html, httpd
+from distributed_llm_inferencing_tpu.runtime import events
 from distributed_llm_inferencing_tpu.runtime import tsdb as tsdb_mod
 from distributed_llm_inferencing_tpu.runtime.kvtier import (
     estimate_cached_tokens)
@@ -133,6 +134,16 @@ REBALANCE_RATIO = float(os.environ.get("DLI_REBALANCE_RATIO", 3.0))
 # /migrate_out RPC budget: must cover the worker-side snapshot wait
 # (worker.MIGRATE_TIMEOUT_S) plus transfer slack.
 MIGRATE_RPC_TIMEOUT = 15.0
+# Flight recorder (runtime/events.py, docs/observability.md "Flight
+# recorder"): how often the TSDB's fine+coarse rings snapshot into the
+# store's meta table so series history — the item-2 planner's training
+# data — survives master restarts. 0 disables durability (history dies
+# with the process, the pre-PR-13 behavior).
+TSDB_SNAPSHOT_S = float(os.environ.get("DLI_TSDB_SNAPSHOT_S", 30.0))
+# Fast-window burn rate at/above which the slo-burn journal event fires
+# (1.0 = consuming exactly the error budget); crossing back below emits
+# the all-clear twin.
+SLO_BURN_ALERT = 1.0
 # crude chars-per-token estimate for sizing a prompt the master never
 # tokenizes (same spirit as the prefix-digest byte-fraction estimates)
 _DISAGG_CHARS_PER_TOKEN = 4
@@ -200,7 +211,10 @@ class Master:
                  rebalance_sustain_s: Optional[float] = None,
                  rebalance_ratio: Optional[float] = None,
                  tsdb_step_s: Optional[float] = None,
-                 tsdb_window_s: Optional[float] = None):
+                 tsdb_window_s: Optional[float] = None,
+                 tsdb_snapshot_s: Optional[float] = None,
+                 events_ring: Optional[int] = None,
+                 events_retain: Optional[int] = None):
         self._stop = threading.Event()
         self._wake = threading.Event()
         # Group-commit store: the dispatch hot path's status writes
@@ -277,6 +291,56 @@ class Master:
         self.slo = tsdb_mod.SLOEvaluator()
         self._cost_models: Set[str] = set()   # per-model cost hist cap
         self._ratio_prev: Dict[str, tuple] = {}   # node -> (hits, misses)
+        # Flight recorder (runtime/events.py): the typed decision
+        # journal — bounded in-memory ring + durable rows through the
+        # store's group-commit path — installed as the process-wide
+        # journal so decision sites outside this class (the store's
+        # flusher, the fault injector) record into it too.
+        self.events = events.EventJournal(store=self.store,
+                                          ring=events_ring,
+                                          retain=events_retain)
+        events.set_journal(self.events)
+        # TSDB durability: restore retained series from the last run's
+        # snapshot (same sqlite file), then re-snapshot on the
+        # telemetry loop's cadence — per-node tok/s and prefill-EWMA
+        # history span restarts instead of dying with the process.
+        self._tsdb_snapshot_s = (TSDB_SNAPSHOT_S if tsdb_snapshot_s is None
+                                 else float(tsdb_snapshot_s))
+        self._tsdb_last_snap = time.time()
+        raw = self.store.get_meta("tsdb_snapshot")
+        if raw:
+            try:
+                snap = json.loads(raw)
+                # a node removed between the last snapshot and the
+                # crash must NOT resurrect: drop_node purged its series
+                # on purpose, and a restored ghost would re-persist on
+                # every future snapshot cycle — forever
+                live = {n["name"] for n in self.store.list_nodes()}
+                live.add("master")
+                if isinstance(snap, dict) and isinstance(
+                        snap.get("nodes"), dict):
+                    snap["nodes"] = {k: v
+                                     for k, v in snap["nodes"].items()
+                                     if k in live}
+                n_series = self.tsdb.restore(snap)
+                if n_series:
+                    log.info("restored %d TSDB series from the last "
+                             "run's snapshot", n_series)
+                else:
+                    # a refused snapshot (step-width/version mismatch —
+                    # e.g. DLI_TSDB_STEP_S changed across the restart)
+                    # loses the retained history BY DESIGN, but it must
+                    # never do so silently
+                    log.warning(
+                        "TSDB snapshot present but restored 0 series "
+                        "(step/version mismatch? snapshot step vs "
+                        "configured %.3gs) — history starts fresh",
+                        self.tsdb.step_s)
+            except Exception as e:
+                log.warning("TSDB snapshot restore failed: %r", e)
+        # slo-burn crossing state (hysteresis: one event per crossing,
+        # not one per sweep above threshold)
+        self._burn_alerting = False
         n = self.store.recover_stale_processing(max_attempts=MAX_ATTEMPTS)
         if n:
             log.info("recovered %d request(s) stranded by a previous run", n)
@@ -353,6 +417,11 @@ class Master:
         s.add("GET", "/api/requests/<req_id>/cost", self.api_request_cost)
         s.add("GET", "/api/slo", self.api_slo)
         s.add("GET", "/api/profile", self.api_profile)
+        # flight recorder: filtered journal reads + the merged
+        # per-request journey (docs/observability.md "Flight recorder")
+        s.add("GET", "/api/events", self.api_events)
+        s.add("GET", "/api/requests/<req_id>/journey",
+              self.api_request_journey)
         s.add("GET", "/health", lambda b: {"status": "online",
                                            "counts": self.store.counts()})
 
@@ -518,6 +587,8 @@ class Master:
                                    consecutive_failures=0,
                                    breaker_state="closed", draining=0,
                                    last_heartbeat=time.time(), info=info)
+            events.emit("node-added", node_id=existing["id"], name=name,
+                        host=host, port=port, readded=True)
             return {"status": "success", "node_id": existing["id"],
                     "message": "node re-activated"}
         import sqlite3
@@ -529,6 +600,8 @@ class Master:
                                     "at a different address"}
         self.store.update_node(node_id, last_heartbeat=time.time(), info=info)
         log.info("node %s added: %s:%d", name, host, port)
+        events.emit("node-added", node_id=node_id, name=name, host=host,
+                    port=port, readded=False)
         return {"status": "success", "node_id": node_id}
 
     def api_remove_node(self, body, node_id):
@@ -553,6 +626,8 @@ class Master:
         # /api/timeseries catalog lists ghosts forever
         self.tsdb.drop_node(node["name"])
         self._ratio_prev.pop(node["name"], None)
+        events.emit("node-removed", node_id=node["id"],
+                    name=node["name"])
         return {"status": "success"}
 
     def api_node_status(self, body):
@@ -878,6 +953,178 @@ class Master:
                 nodes[n["name"]] = {"error": "unparseable body"}
         return {"status": "success", "nodes": nodes}
 
+    # ---- flight recorder (runtime/events.py) -------------------------
+
+    def api_events(self, body):
+        """Filtered read of the durable event journal:
+        ``?type=<event-type>&node=<node_id>&request=<req_id>&since=<epoch>
+        &limit=<n>`` — the postmortem entry point the runbook starts
+        from (docs/robustness.md). Events are oldest-first within the
+        newest ``limit`` matches; node ids are enriched with the
+        registered node name."""
+        try:
+            since = float(body["since"]) if body.get("since") else None
+            limit = int(body.get("limit") or 200)
+            node_id = int(body["node"]) if body.get("node") else None
+            req_id = (int(body["request"]) if body.get("request")
+                      else None)
+        except (TypeError, ValueError):
+            return 400, {"status": "error", "message": "bad filter"}
+        etype = body.get("type")
+        if etype and etype not in events.names():
+            return 400, {"status": "error",
+                         "message": f"unknown event type {etype!r}"}
+        # read-your-writes: an event emitted microseconds ago may still
+        # sit in the group-commit buffer — flush before querying.
+        # Best-effort: a FAILING flush (disk full — the very incident
+        # this endpoint explains) must not 500 the postmortem read;
+        # everything already committed still serves
+        try:
+            self.store.flush()
+        except Exception as e:
+            log.warning("journal flush before /api/events failed: %r", e)
+        evs = self.store.query_events(etype=etype, node_id=node_id,
+                                      request_id=req_id, since=since,
+                                      limit=limit)
+        names = {n["id"]: n["name"] for n in self.store.list_nodes()}
+        for ev in evs:
+            if ev.get("node_id") in names:
+                ev["node"] = names[ev["node_id"]]
+        return {"status": "success", "count": len(evs),
+                "journal": self.events.counts(), "events": evs}
+
+    def api_request_journey(self, body, req_id):
+        """One time-ordered merged view of a request's whole life:
+        lifecycle transitions off the row, every journal event tagged
+        with the request, node-scoped events (breaker trips, drains,
+        role flips) for the nodes it touched within its window,
+        cost-ledger phase segments, and the master-side trace spans of
+        its trace — the disagg two-phase path and a mid-stream
+        migration render as one connected cross-node timeline."""
+        try:
+            rid = int(req_id)
+        except ValueError:
+            return 400, {"status": "error", "message": "bad request id"}
+        r = self.store.get_request(rid)
+        if not r:
+            return 404, {"status": "error", "message": "no such request"}
+        try:
+            # best-effort read-your-writes, like api_events: a failing
+            # flush must not 500 the journey read
+            self.store.flush()
+        except Exception as e:
+            log.warning("journal flush before journey read failed: %r", e)
+        evs = self.store.query_events(request_id=rid, limit=1000)
+        entries = []
+
+        def add(t, kind, name, **kw):
+            if t is None:
+                return
+            e = {"t": float(t), "kind": kind, "name": name}
+            e.update({k: v for k, v in kw.items() if v is not None})
+            entries.append(e)
+
+        add(r["created_at"], "lifecycle", "submitted",
+            model=r["model_name"])
+        if r.get("started_at"):
+            add(r["started_at"], "lifecycle", "claimed",
+                attempts=r.get("attempts"))
+        if r.get("completed_at"):
+            add(r["completed_at"], "lifecycle", r["status"],
+                node_id=r.get("node_id"), error=r.get("error"))
+        trace_id = None
+        involved = set()
+        for ev in evs:
+            add(ev["ts"], "event", ev["type"], severity=ev["severity"],
+                node_id=ev.get("node_id"), data=ev.get("data") or None)
+            trace_id = trace_id or ev.get("trace_id")
+            if ev.get("node_id") is not None:
+                involved.add(ev["node_id"])
+        if r.get("node_id"):
+            involved.add(r["node_id"])
+        # node-scoped context: a breaker trip or drain on a node this
+        # request ran on explains its requeue/migration even though the
+        # event itself carries no request id — merge the ones inside
+        # the request's window (±1s slack for clock/commit skew)
+        t0 = r["created_at"] or 0.0
+        t1 = r.get("completed_at") or time.time()
+        if involved:
+            # both window ends are server-side filters: a newest-N page
+            # since t0 would cut the oldest (= in-window) rows on a
+            # long-lived master and silently empty the context merge
+            for ev in self.store.query_events(since=t0 - 1.0,
+                                              until=t1 + 1.0,
+                                              limit=2000):
+                if (ev.get("request_id") is None
+                        and ev.get("node_id") in involved):
+                    add(ev["ts"], "node-event", ev["type"],
+                        severity=ev["severity"], node_id=ev["node_id"],
+                        data=ev.get("data") or None)
+        # cost-ledger phases, anchored backward from completion (the
+        # ledger partitions the worker-side [submitted, finished) span
+        # exactly into queue/prefill/decode — runtime/batcher.py)
+        phases = []
+        cost = r.get("cost")
+        if isinstance(cost, dict) and r.get("completed_at"):
+            try:
+                end = float(r["completed_at"])
+                for key in ("decode_ms", "prefill_ms", "queue_ms"):
+                    ms = float(cost.get(key) or 0.0)
+                    phases.append({"phase": key[:-3],
+                                   "start": end - ms / 1e3, "end": end,
+                                   "ms": ms})
+                    end -= ms / 1e3
+                phases.reverse()
+            except (TypeError, ValueError):
+                phases = []
+        # master-side trace spans of this request's trace (retained
+        # ring included — an SLO-missing request's spans survive main-
+        # ring eviction precisely for this postmortem read)
+        ctx = self._trace_ctx.get(rid)
+        tid = (ctx.trace_id if ctx is not None else None) or trace_id
+        tracer = trace.get_tracer()
+        if tid is None:
+            # the ctx map frees at terminal states and a clean request
+            # emits no events — recover the trace id from the master's
+            # own execute spans, which carry the request id as an attr
+            for sp in tracer.spans() + tracer.retained_spans():
+                if sp.attrs.get("req_id") == rid:
+                    tid = sp.trace_id
+                    break
+        spans = []
+        if tid:
+            seen = set()
+            for sp in tracer.find(tid) + [
+                    s for s in tracer.retained_spans()
+                    if s.trace_id == tid]:
+                if sp.span_id in seen:
+                    continue
+                seen.add(sp.span_id)
+                spans.append({"name": sp.name, "start": sp.start,
+                              "end": sp.end, "attrs": dict(sp.attrs)})
+            spans.sort(key=lambda s: s["start"])
+        entries.sort(key=lambda e: e["t"])
+        # a journey is CONNECTED when it starts at submission and — for
+        # a finished request — ends at its terminal transition, with
+        # every merged record inside that window (the telemetry smoke
+        # gates on this)
+        life = [e for e in entries if e["kind"] == "lifecycle"]
+        connected = bool(life) and life[0]["name"] == "submitted"
+        if r["status"] in ("completed", "failed"):
+            # the terminal transition must be present too (node-scoped
+            # context events may legitimately sit outside the
+            # submitted..terminal bracket by the ±1s merge slack)
+            connected = connected and any(e["name"] == r["status"]
+                                          for e in life)
+        return {"status": "success", "request_id": rid,
+                "request_status": r["status"],
+                "model_name": r["model_name"],
+                "attempts": r.get("attempts"),
+                "trace_id": tid, "connected": connected,
+                "migrations": sum(1 for ev in evs
+                                  if ev["type"] == "migrate-out"),
+                "entries": entries, "phases": phases, "spans": spans}
+
     def _telemetry_loop(self):
         """Background scrape loop feeding the TSDB: every TSDB step,
         scrape each active node's /metrics (pooled keep-alive sessions,
@@ -935,6 +1182,7 @@ class Master:
         if slo_fresh:
             self.metrics.gauge("slo_attainment", s["attainment_fast"])
             self.metrics.gauge("slo_burn_rate", s["burn_rate_fast"])
+            self._note_burn(s["burn_rate_fast"])
         snap = self.metrics.snapshot()
         for k, v in snap["counters"].items():
             self.tsdb.record("master", k, v, kind="counter", t=now)
@@ -946,6 +1194,37 @@ class Master:
                 # render as a gap here like everywhere else
                 continue
             self.tsdb.record("master", k, v, kind="gauge", t=now)
+        # TSDB durability: periodic ring snapshot into the store's meta
+        # table (restored at the next master start)
+        if (self._tsdb_snapshot_s > 0
+                and now - self._tsdb_last_snap >= self._tsdb_snapshot_s):
+            self._tsdb_last_snap = now
+            self._snapshot_tsdb()
+
+    def _note_burn(self, burn: float) -> None:
+        """slo-burn crossing detector with hysteresis: one journal
+        event when the fast-window burn rate crosses SLO_BURN_ALERT in
+        either direction — not one per sweep spent above it."""
+        above = burn is not None and burn >= SLO_BURN_ALERT
+        if above and not self._burn_alerting:
+            self._burn_alerting = True
+            events.emit("slo-burn", burn_rate=round(float(burn), 3),
+                        direction="above")
+        elif not above and self._burn_alerting:
+            self._burn_alerting = False
+            events.emit("slo-burn",
+                        burn_rate=(round(float(burn), 3)
+                                   if burn is not None else None),
+                        direction="below", severity="info")
+
+    def _snapshot_tsdb(self) -> None:
+        try:
+            self.store.set_meta("tsdb_snapshot",
+                                json.dumps(self.tsdb.dump()))
+        except Exception as e:
+            # durability is best-effort on a failing disk; the in-memory
+            # rings keep serving and the next cycle retries
+            log.warning("TSDB snapshot write failed: %r", e)
 
     # ---- scheduling --------------------------------------------------
 
@@ -1280,9 +1559,12 @@ class Master:
         except Exception as e:
             # dispatch proceeds on the stale snapshot; the health loop
             # refreshes the row next interval — but a store UPDATE
-            # failing is never routine
+            # failing is never routine, so it goes to the journal too
+            # (a log.warning dies with the process; the event survives)
             log.warning("node snapshot refresh failed for node %s: %r",
                         node.get("id"), e)
+            events.emit("node-refresh-failed", node_id=node.get("id"),
+                        error=repr(e)[:200])
 
     def _execute(self, req, node=None) -> bool:
         """Run one request on a chosen (or pre-reserved) node. True on
@@ -1338,13 +1620,21 @@ class Master:
             # draining): park instead of failing — at least a health
             # interval and a half, so the loop's half-open recovery edge
             # gets a chance to run before the attempt budget burns down
+            ctx = self._trace_ctx.get(req["id"])
+            tid = ctx.trace_id if ctx is not None else None
             if req["attempts"] + 1 < MAX_ATTEMPTS:
-                self.store.requeue(req["id"],
-                                   delay_s=max(self._backoff(req["attempts"]),
-                                               self.health_interval * 1.5))
+                delay = max(self._backoff(req["attempts"]),
+                            self.health_interval * 1.5)
+                self.store.requeue(req["id"], delay_s=delay)
                 self.metrics.inc("requests_requeued")
+                events.emit("request-park", request_id=req["id"],
+                            trace_id=tid, attempts=req["attempts"],
+                            terminal=False, delay_s=round(delay, 2))
             else:
                 self.store.mark_failed(req["id"], "no active worker nodes")
+                events.emit("request-park", request_id=req["id"],
+                            trace_id=tid, attempts=req["attempts"],
+                            terminal=True, severity="error")
                 self._note_slo_miss(req)
                 self._trace_done(req["id"])
         return node
@@ -1377,6 +1667,25 @@ class Master:
             # emitted tokens and continues the stream bitwise-exactly
             body["resume"] = req["resume"]
         return body
+
+    def _note_dispatch(self, req, node) -> None:
+        """Journal-worthy dispatch context, shared by the single and
+        batched paths: a resume record on the claimed row means this
+        dispatch attempt carries the migrated request's stream cursor
+        to the chosen node — the journey's receiving half of the
+        migrate-out handoff. Attempt semantics on purpose: a resume
+        dispatch that then fails over emits again on the next node, and
+        the ``attempt`` field keeps the records distinguishable (the
+        terminal lifecycle entry names the node that actually finished
+        the stream)."""
+        if isinstance(req.get("resume"), dict) and req["resume"]:
+            ctx = self._trace_ctx.get(req["id"])
+            events.emit("migrate-resume", request_id=req["id"],
+                        node_id=node["id"],
+                        trace_id=ctx.trace_id if ctx else None,
+                        attempt=req.get("attempts"),
+                        resume_tokens=len(
+                            req["resume"].get("tokens") or []))
 
     def _complete_request(self, req, node, data) -> None:
         """Terminal success tail shared by the single and batched
@@ -1560,6 +1869,13 @@ class Master:
                 excluded_node_id=None if sticky else nid,
                 delay_s=delay, last_node_id=nid)
             self.metrics.inc("requests_requeued")
+            ctx = self._trace_ctx.get(req["id"])
+            events.emit("request-requeued", request_id=req["id"],
+                        node_id=nid,
+                        trace_id=ctx.trace_id if ctx else None,
+                        error=str(e)[:200], attempts=req["attempts"],
+                        sticky=sticky, excluded=not sticky,
+                        delay_s=round(delay, 2))
             self._wake.set()
         else:
             self.store.mark_failed(req["id"], str(e), barrier=False)
@@ -1625,6 +1941,11 @@ class Master:
         self.metrics.inc("requests_migrated")
         log.info("request %d migrated off node %d (%d tokens resume)",
                  req["id"], node["id"], len(resume.get("tokens") or []))
+        ctx = self._trace_ctx.get(req["id"])
+        events.emit("migrate-out", request_id=req["id"],
+                    node_id=node["id"],
+                    trace_id=ctx.trace_id if ctx else None,
+                    resume_tokens=len(resume.get("tokens") or []))
         self._wake.set()
 
     def _ensure_model_loaded(self, node, model, sampling):
@@ -1681,6 +2002,7 @@ class Master:
             # the completed result under it, so a timeout retry
             # replays the generation instead of re-running it
             infer_body = self._infer_body(req)
+            self._note_dispatch(req, node)
             self._processing[req["id"]] = node
             try:
                 # the dispatch span is the parent the worker's HTTP server
@@ -1794,6 +2116,7 @@ class Master:
             sub_bodies = []
             for r_ in reqs:
                 sb = self._infer_body(r_)
+                self._note_dispatch(r_, node)
                 # per-sub trace propagation: the batch RPC carries each
                 # sub-request's own submit-time context in its body, so
                 # the worker's per-sub spans join the request's trace —
@@ -1932,19 +2255,37 @@ class Master:
         if not isinstance(prompt, str) \
                 or len(prompt) < self._disagg_min_prompt:
             return None
+        model = req["model_name"]
+        est_tokens = max(1, len(prompt.encode("utf-8", "replace"))
+                         // _DISAGG_CHARS_PER_TOKEN)
+        # pool census + verdict journaling: every decision this
+        # function reaches is recorded WITH the inputs that decided it
+        # (estimated tokens, warmest advertised prefix, learned prefill
+        # EWMA, pool sizes) — the flight-recorder record a postmortem
+        # replays instead of guessing what the planner saw
+        roles = {n["id"]: self._node_role(n) for n in nodes
+                 if not n.get("draining")}
+        n_prefill = sum(1 for r in roles.values() if r == "prefill")
+        n_decode = sum(1 for r in roles.values()
+                       if r in ("decode", "mixed"))
+        _ctx = self._trace_ctx.get(req["id"])
+
+        def _verdict(verdict, **kw):
+            events.emit("disagg-plan", request_id=req["id"],
+                        trace_id=_ctx.trace_id if _ctx else None,
+                        verdict=verdict, est_tokens=est_tokens,
+                        prefill_pool=n_prefill, decode_pool=n_decode,
+                        **kw)
         # a strict prefill pool must exist — a mixed fleet (the default)
         # never reaches the decision at all. The counter is the
         # rebalancer's flip-BACK signal: disagg-eligible demand arriving
         # with no prefill pool (e.g. after the rebalancer emptied it on
         # a uniform mix) is what re-creates one (_maybe_flip_roles).
-        if not any(self._node_role(n) == "prefill" for n in nodes
-                   if not n.get("draining")):
+        if not n_prefill:
             if len(nodes) > 1:
                 self.metrics.inc("scheduler_disagg_no_prefill_pool")
+                _verdict("no-prefill-pool")
             return None
-        model = req["model_name"]
-        est_tokens = max(1, len(prompt.encode("utf-8", "replace"))
-                         // _DISAGG_CHARS_PER_TOKEN)
         # recompute side: if a decode-eligible node already advertises
         # most of this prompt's prefix warm, affinity routing beats a
         # transfer (the blocks are already where the decode runs) —
@@ -1962,12 +2303,18 @@ class Master:
             entry = (s.get("models") or {}).get(model)
             warm = max(warm, estimate_cached_tokens(
                 prompt, (entry or {}).get("digests"), memo))
+        ewma = self._prefill_ewma.get(str(model))
         if warm * 2 >= est_tokens:
             self.metrics.inc("scheduler_disagg_recompute")
+            _verdict("recompute-warm", warm_tokens=warm,
+                     prefill_ewma_ms_per_tok=(round(ewma, 4)
+                                              if ewma is not None
+                                              else None))
             return None
-        ewma = self._prefill_ewma.get(str(model))
         if ewma is not None and est_tokens * ewma < self._disagg_floor_ms:
             self.metrics.inc("scheduler_disagg_recompute")
+            _verdict("recompute-floor", warm_tokens=warm,
+                     prefill_ewma_ms_per_tok=round(ewma, 4))
             return None
         pnode = self._pick_node(model, reserve=True, nodes=nodes,
                                 role="prefill")
@@ -1980,6 +2327,9 @@ class Master:
                 with self._inflight_lock:
                     self._inflight[pnode["id"]] = max(
                         0, self._inflight.get(pnode["id"], 1) - 1)
+            # the degraded case IS the record a postmortem needs: disagg
+            # demand silently recomputing for want of usable capacity
+            _verdict("no-prefill-capacity", warm_tokens=warm)
             return None
         dnode = self._pick_node(model, exclude={pnode["id"]},
                                 reserve=True, nodes=nodes,
@@ -1991,8 +2341,13 @@ class Master:
                 if dnode is not None:
                     self._inflight[dnode["id"]] = max(
                         0, self._inflight.get(dnode["id"], 1) - 1)
+            _verdict("no-decode-capacity", warm_tokens=warm)
             return None
         self.metrics.inc("scheduler_disagg_transfer")
+        _verdict("transfer", warm_tokens=warm,
+                 prefill_ewma_ms_per_tok=(round(ewma, 4)
+                                          if ewma is not None else None),
+                 prefill_node=pnode["id"], decode_node=dnode["id"])
         return pnode, dnode
 
     def _execute_disagg(self, req, pnode, dnode) -> bool:
@@ -2007,11 +2362,14 @@ class Master:
         tracer = trace.get_tracer()
         ctx = self._trace_ctx.get(req["id"])
         ok_prefill = False
+        fail_error, fail_status = None, None
         t0 = time.time()
         try:
             try:
                 err = self._ensure_model_loaded(pnode, req["model_name"],
                                                 req["sampling"])
+                if err is not None:
+                    fail_error = err[:200]
                 if err is None:
                     body = self._infer_body(req)
                     body.pop("max_length", None)
@@ -2023,6 +2381,8 @@ class Master:
                         r = self._worker_post(pnode, "/inference", body,
                                               self.infer_timeout)
                     ok_prefill = r.status_code == 200
+                    if not ok_prefill:
+                        fail_status = r.status_code
                     if ok_prefill:
                         data = r.json()
                         sch = data.get("scheduler")
@@ -2053,6 +2413,7 @@ class Master:
                 if not (_is_timeout_error(e)
                         or isinstance(e, _NodeUnavailable)):
                     self._node_failure(pnode)
+                fail_error = repr(e)[:200]
                 log.warning("disagg prefill for request %d failed on "
                             "node %d: %s", req["id"], pnode["id"], e)
         finally:
@@ -2071,6 +2432,14 @@ class Master:
                                  time.time() - t0)
         else:
             self.metrics.inc("disagg_prefill_failed")
+            # phase-1 degradation to recompute: journaled with the
+            # failure class (was a log.warning-only path — a chaos run
+            # killing the prefill node left no durable record that the
+            # request silently paid a full re-prefill)
+            events.emit("disagg-prefill-failed", request_id=req["id"],
+                        node_id=pnode["id"],
+                        trace_id=ctx.trace_id if ctx else None,
+                        error=fail_error, status=fail_status)
         # phase 2 (dnode's in-flight slot is released inside): with a
         # kv_source hint when the prefill pass landed, plain recompute
         # dispatch otherwise
@@ -2220,9 +2589,13 @@ class Master:
                 except Exception as e:
                     # transport hiccup: NOT marked migrated — the next
                     # sweep retries, or a drain would silently degrade
-                    # to waiting out the whole generation
+                    # to waiting out the whole generation. Journaled: a
+                    # drain that takes N sweeps to land should show its
+                    # N-1 failed handoff attempts in the postmortem.
                     log.debug("migrate_out of request %d failed: %r",
                               rid, e)
+                    events.emit("migrate-anomaly", request_id=rid,
+                                node_id=nid, error=repr(e)[:200])
                     continue
                 if r.status_code == 404:
                     # NOT settled: the tag registers with the worker's
@@ -2242,6 +2615,13 @@ class Master:
                 self._migrated_reqs.add(rid)
                 if r.status_code == 200:
                     self.metrics.inc("rebalancer_migrations")
+                elif r.status_code == 409:
+                    # completion won the race (or the request is not
+                    # migratable, e.g. engine mode): settled, but the
+                    # journey should say the rebalancer tried
+                    events.emit("migrate-anomaly", request_id=rid,
+                                node_id=nid, status=409,
+                                severity="info")
 
     def _maybe_flip_roles(self):
         """Proactive leg (FlowKV economics): when the prefill and
@@ -2291,7 +2671,8 @@ class Master:
                 cand = min(dec, key=lambda n: loads.get(n["id"], 0.0))
                 if now - self._last_flip.get(cand["id"], 0) \
                         >= self._rebalance_sustain:
-                    self._flip_role(cand, "prefill")
+                    self._flip_role(cand, "prefill",
+                                    reason="no-prefill-pool")
             return
         if not dec:
             return
@@ -2312,15 +2693,26 @@ class Master:
                 "prefill"
         else:
             return
-        if now - self._last_flip.get(flip["id"], 0) \
-                < self._rebalance_sustain:
+        cooled = (now - self._last_flip.get(flip["id"], 0)
+                  < self._rebalance_sustain)
+        # journal the sweep's finding WITH the sustained means that
+        # justified it — a goodput dip on the dashboard is explained by
+        # this record even when the cooldown suppressed the flip
+        events.emit("rebalance-divergence", node_id=flip["id"],
+                    prefill_mean=round(ap, 2), decode_mean=round(ad, 2),
+                    ratio=ratio,
+                    action=("cooldown" if cooled
+                            else f"flip-to-{new_role}"))
+        if cooled:
             return                   # per-node cooldown: no flapping
         self._flip_role(flip, new_role)
 
-    def _flip_role(self, node, new_role: str) -> bool:
+    def _flip_role(self, node, new_role: str,
+                   reason: str = "divergence") -> bool:
         """Execute one role flip: POST /role, refresh the node's
         snapshot (routing memos + persisted info), and mirror the new
         role into the runtime view so the very next pick honors it."""
+        prev_role = self._node_role(node)
         try:
             r = self._worker_post(node, "/role", {"role": new_role}, 10)
         except Exception as e:
@@ -2335,6 +2727,8 @@ class Master:
         self.metrics.inc("rebalancer_role_flips")
         log.info("rebalancer flipped node %d (%s) -> role %s",
                  node["id"], node.get("name"), new_role)
+        events.emit("role-flip", node_id=node["id"], role=new_role,
+                    prev_role=prev_role, reason=reason)
         s = self._node_runtime.get(node["id"])
         if s is not None:
             s["role"] = new_role
@@ -2360,6 +2754,8 @@ class Master:
                 self.metrics.inc("breaker_opened")
                 log.warning("node %d breaker OPEN (%s, %d strikes)",
                             n["id"], state, strikes)
+                events.emit("breaker-open", node_id=n["id"],
+                            strikes=strikes, prev_state=state)
         self.store.update_node(n["id"], **fields)
 
     def _node_success(self, node):
@@ -2375,6 +2771,7 @@ class Master:
             self.metrics.inc("breaker_closed")
             log.info("node %d breaker CLOSED (half-open probe succeeded)",
                      n["id"])
+            events.emit("breaker-closed", node_id=n["id"])
         self.store.update_node(n["id"], breaker_state="closed",
                                consecutive_failures=0, is_active=1)
 
@@ -2458,6 +2855,12 @@ class Master:
                          .get("breaker_state") or "closed")
             else:
                 draining = 1 if info.get("status") == "draining" else 0
+                if draining != (1 if n.get("draining") else 0):
+                    # worker-declared drain state changed: journal the
+                    # transition (this is what explains the burst of
+                    # live migrations the rebalancer fires next sweep)
+                    events.emit("node-drain", node_id=n["id"],
+                                draining=bool(draining))
                 fields = {"info": info, "last_heartbeat": time.time(),
                           "draining": draining}
                 # refresh the queue-aware scheduler's per-node view
@@ -2471,6 +2874,7 @@ class Master:
                     self.metrics.inc("breaker_half_opened")
                     log.info("node %d breaker HALF-OPEN "
                              "(health probe succeeded)", n["id"])
+                    events.emit("breaker-half-open", node_id=n["id"])
                 elif state == "closed":
                     fields.update(is_active=1, consecutive_failures=0)
                 self.store.update_node(n["id"], **fields)
@@ -2511,6 +2915,13 @@ class Master:
         self._stop.set()
         self._wake.set()
         self.service.shutdown()
+        # final TSDB snapshot so a clean shutdown loses zero history
+        # (the periodic one may be most of an interval stale), then
+        # uninstall the journal — but only if it is still the installed
+        # one (benches run several masters in one process)
+        if self._tsdb_snapshot_s > 0:
+            self._snapshot_tsdb()
+        events.clear_journal(self.events)
         # flush the write-behind buffer (any parked requeues commit) and
         # release the keep-alive connection pools
         self.store.close()

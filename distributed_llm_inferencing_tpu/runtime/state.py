@@ -78,6 +78,23 @@ CREATE TABLE IF NOT EXISTS requests (
     execution_time REAL,
     tokens_per_s REAL
 );
+CREATE TABLE IF NOT EXISTS events (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    ts REAL NOT NULL,
+    type TEXT NOT NULL,
+    severity TEXT DEFAULT 'info',
+    node_id INTEGER,
+    request_id INTEGER,
+    trace_id TEXT,
+    data TEXT DEFAULT '{}'
+);
+CREATE INDEX IF NOT EXISTS idx_events_request ON events(request_id);
+CREATE INDEX IF NOT EXISTS idx_events_type ON events(type);
+CREATE TABLE IF NOT EXISTS meta (
+    key TEXT PRIMARY KEY,
+    value TEXT,
+    updated_at REAL
+);
 """
 
 # Columns added after the seed schema: an existing on-disk DB (the
@@ -170,6 +187,11 @@ class Store:
             self._gc_interval = max(0.0, flush_interval)
             self._gc_cv = locks.condition("state.gc")
             self._gc_flush_lock = locks.lock("state.gc_flush")
+            # re-entrancy guard: a write submitted FROM INSIDE a flush
+            # (the flush-failure journal event) must only buffer — the
+            # self-flush fallbacks below would re-acquire the flush
+            # lock this thread already holds
+            self._gc_local = threading.local()
             self._gc_buf: List[tuple] = []
             self._gc_enqueued = 0       # ticket of the newest buffered op
             self._gc_flushed = 0        # ticket of the newest committed op
@@ -194,6 +216,12 @@ class Store:
             self._gc_enqueued += 1
             ticket = self._gc_enqueued
         self._gc_wake.set()
+        if getattr(self._gc_local, "in_flush", False):
+            # submitted from inside this thread's own flush (journal
+            # event on a flush failure): it is buffered; the enclosing
+            # flush's retry cycle — or close()'s final flush — owns it.
+            # Self-flushing here would deadlock on _gc_flush_lock.
+            return
         if self._gc_stop.is_set():
             # flusher gone (a dispatcher finishing its in-flight RPC after
             # close()): without this, a barrier=False write would sit in
@@ -221,27 +249,47 @@ class Store:
         # flush still holds uncommitted ops — the barrier would report
         # durability for writes not yet on disk.
         with self._gc_flush_lock:
-            with self._gc_cv:
-                ops, self._gc_buf = self._gc_buf, []
-                ticket = self._gc_enqueued
-            if ops:
-                try:
-                    with self._lock, self._db:
-                        for sql, args in ops:
-                            self._db.execute(sql, args)
-                except Exception:
-                    # sqlite hiccup (disk full, I/O error): the 'with
-                    # _db' transaction rolled back, so nothing reached
-                    # disk. Put the batch back AHEAD of anything
-                    # buffered since (order preserved) and leave the
-                    # ticket unpublished — barrier waiters correctly
-                    # stay blocked until a later flush succeeds.
-                    with self._gc_cv:
-                        self._gc_buf[:0] = ops
-                    raise
-            with self._gc_cv:
-                self._gc_flushed = max(self._gc_flushed, ticket)
-                self._gc_cv.notify_all()
+            self._gc_local.in_flush = True
+            try:
+                self._flush_locked()
+            finally:
+                self._gc_local.in_flush = False
+
+    def _flush_locked(self):
+        with self._gc_cv:
+            ops, self._gc_buf = self._gc_buf, []
+            ticket = self._gc_enqueued
+        if ops:
+            try:
+                with self._lock, self._db:
+                    for sql, args in ops:
+                        self._db.execute(sql, args)
+            except Exception as e:
+                # sqlite hiccup (disk full, I/O error): the 'with
+                # _db' transaction rolled back, so nothing reached
+                # disk. Put the batch back AHEAD of anything
+                # buffered since (order preserved) and leave the
+                # ticket unpublished — barrier waiters correctly
+                # stay blocked until a later flush succeeds.
+                with self._gc_cv:
+                    self._gc_buf[:0] = ops
+                # flight recorder (runtime/events.py): a durability
+                # failure is exactly the decision record a
+                # postmortem needs. The event's own INSERT lands in
+                # this same (currently failing) buffer — it rides
+                # the ring immediately and the table once a flush
+                # succeeds (the in_flush guard keeps it from
+                # re-entering this flush); one event per failed
+                # flush, so a long outage grows the buffer by one
+                # row per retry cycle, not per blocked write.
+                from distributed_llm_inferencing_tpu.runtime import \
+                    events
+                events.emit("store-flush-failed", error=repr(e)[:200],
+                            ops=len(ops))
+                raise
+        with self._gc_cv:
+            self._gc_flushed = max(self._gc_flushed, ticket)
+            self._gc_cv.notify_all()
         if ops and self._gc_on_flush is not None:
             # e.g. the master's dispatcher wake event: a flushed requeue
             # is now claimable, don't wait out the idle poll to see it
@@ -275,6 +323,15 @@ class Store:
                 n_lost = len(self._gc_buf)
             log.exception("final group-commit flush failed; "
                           "%d op(s) still buffered", n_lost)
+
+    def flush(self):
+        """Synchronously flush the write-behind buffer (no-op when group
+        commit is off). Readers that must see *their own process's*
+        buffered writes — the ``/api/events`` query path reading events
+        emitted microseconds ago — call this instead of sprinkling
+        barriers over every emit."""
+        if self._gc_enabled:
+            self._flush_writes()
 
     def close(self):
         """Flush buffered writes and stop the flusher. Idempotent."""
@@ -592,3 +649,86 @@ class Store:
             "SELECT model_name, COUNT(*) AS n FROM requests "
             "WHERE status='pending' GROUP BY model_name")
         return {r["model_name"]: r["n"] for r in rows}
+
+    # ---- flight-recorder events (runtime/events.py) ------------------
+
+    def append_event(self, ts: float, etype: str, severity: str,
+                     node_id: Optional[int], request_id: Optional[int],
+                     trace_id: Optional[str], data_json: str):
+        """Persist one journal event through the group-commit buffer
+        (barrier=False: an event is durable within a flush cycle; the
+        journal's in-memory ring covers the gap for same-process
+        readers via :meth:`flush`)."""
+        self._submit_write(
+            "INSERT INTO events (ts, type, severity, node_id, "
+            "request_id, trace_id, data) VALUES (?,?,?,?,?,?,?)",
+            (ts, etype, severity, node_id, request_id, trace_id,
+             data_json), barrier=False)
+
+    def prune_events(self, retain: int):
+        """Cap the events table at ``retain`` rows (oldest dropped),
+        through the same buffered path as the inserts so retention
+        costs no extra transaction."""
+        self._submit_write(
+            "DELETE FROM events WHERE id <= "
+            "(SELECT COALESCE(MAX(id), 0) FROM events) - ?",
+            (max(0, int(retain)),), barrier=False)
+
+    def query_events(self, etype: Optional[str] = None,
+                     node_id: Optional[int] = None,
+                     request_id: Optional[int] = None,
+                     since: Optional[float] = None,
+                     until: Optional[float] = None,
+                     limit: int = 500) -> List[Dict[str, Any]]:
+        """Filtered journal read, oldest-first within the newest
+        ``limit`` matches. A bounded window needs BOTH ends server-side:
+        keeping the newest N since ``since`` and post-filtering by end
+        time would drop exactly the in-window rows once enough newer
+        events exist (the journey's node-context bug class). Callers
+        that just emitted (the API handlers) run :meth:`flush` first so
+        reads see their own writes."""
+        where, args = [], []
+        if etype:
+            where.append("type=?")
+            args.append(str(etype))
+        if node_id is not None:
+            where.append("node_id=?")
+            args.append(int(node_id))
+        if request_id is not None:
+            where.append("request_id=?")
+            args.append(int(request_id))
+        if since is not None:
+            where.append("ts>=?")
+            args.append(float(since))
+        if until is not None:
+            where.append("ts<=?")
+            args.append(float(until))
+        sql = "SELECT * FROM events"
+        if where:
+            sql += " WHERE " + " AND ".join(where)
+        sql += " ORDER BY id DESC LIMIT ?"
+        rows = self._all(sql, (*args, max(1, int(limit))))
+        rows.reverse()
+        for r in rows:
+            try:
+                r["data"] = json.loads(r.get("data") or "{}")
+            except ValueError:
+                r["data"] = {}
+        return rows
+
+    def count_events(self) -> int:
+        row = self._one("SELECT COUNT(*) AS n FROM events")
+        return int(row["n"]) if row else 0
+
+    # ---- durable key/value metadata (TSDB snapshots etc.) ------------
+
+    def set_meta(self, key: str, value: str):
+        """Durable master-side metadata (one synchronous transaction —
+        callers are background loops, and a multi-MB TSDB snapshot does
+        not belong in the group-commit buffer ahead of status writes)."""
+        self._exec("INSERT OR REPLACE INTO meta (key, value, updated_at) "
+                   "VALUES (?,?,?)", (key, value, time.time()))
+
+    def get_meta(self, key: str) -> Optional[str]:
+        row = self._one("SELECT value FROM meta WHERE key=?", (key,))
+        return row["value"] if row else None

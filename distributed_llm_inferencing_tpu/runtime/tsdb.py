@@ -163,6 +163,32 @@ class Series:
             out.append((self._acc[0], self._acc[1] / self._acc[2]))
         return out + fine
 
+    def dump(self) -> dict:
+        """JSON-serializable snapshot of the COMPLETE series state:
+        both rings plus the counter-rate baseline and the in-progress
+        coarse accumulator, so a restored series serves byte-identical
+        points AND keeps rating the counter from the pre-restart
+        baseline (no restart spike, no re-baselining gap)."""
+        return {"kind": self.kind,
+                "fine": [[t, v] for t, v in self.fine],
+                "coarse": [[t, v] for t, v in self.coarse],
+                "prev_raw": self._prev_raw, "prev_t": self._prev_t,
+                "acc": list(self._acc) if self._acc is not None else None}
+
+    def load(self, data: dict) -> None:
+        """Inverse of :meth:`dump` (ring capacities stay this series's
+        own — a snapshot from a larger ring keeps its newest points)."""
+        self.fine.clear()
+        self.fine.extend((float(t), float(v))
+                         for t, v in data.get("fine") or [])
+        self.coarse.clear()
+        self.coarse.extend((float(t), float(v))
+                           for t, v in data.get("coarse") or [])
+        self._prev_raw = data.get("prev_raw")
+        self._prev_t = data.get("prev_t")
+        acc = data.get("acc")
+        self._acc = list(acc) if acc else None
+
 
 class TSDB:
     """Bounded per-(node, metric) time-series store."""
@@ -255,6 +281,62 @@ class TSDB:
     def drop_node(self, node: str):
         with self._lock:
             self._series.pop(str(node), None)
+
+    # ---- durability: snapshot/restore (flight recorder, PR 13) -------
+
+    def dump(self) -> dict:
+        """JSON-serializable snapshot of every retained series (fine +
+        coarse rings, counter baselines). The master persists this into
+        the store's ``meta`` table on a ``DLI_TSDB_SNAPSHOT_S`` cadence
+        and restores at startup, so per-node tok/s and prefill-EWMA
+        history span restarts — the measured history the ROADMAP item-2
+        planner trains on.
+
+        Lock granularity: materializing every ring at once can be tens
+        of thousands of points on a fleet near the series caps — held
+        under the global lock, that stalls every concurrent record()
+        (scrape sweep) and query() (dashboard) for the whole walk. So
+        the structure is snapshotted in one brief hold, then each
+        series copies under its own short hold; a series mutating
+        between holds just contributes its freshest state, which is
+        exactly what a periodic snapshot means."""
+        with self._lock:
+            refs = [(node, metric, s)
+                    for node, d in self._series.items()
+                    for metric, s in d.items()]
+        nodes: Dict[str, dict] = {}
+        for node, metric, s in refs:
+            with self._lock:
+                nodes.setdefault(node, {})[metric] = s.dump()
+        return {"v": 1, "step_s": self.step_s, "window_s": self.window_s,
+                "nodes": nodes}
+
+    def restore(self, data: dict) -> int:
+        """Load a :meth:`dump` snapshot; returns the number of series
+        restored. A snapshot taken at a DIFFERENT step width is refused
+        whole (its bucket epochs would misalign with every new sample —
+        a gap is honest, interpolated history is not). Restored series
+        are replaced, not merged; nodes beyond the per-node cap drop
+        the excess exactly like live ingest does."""
+        if not isinstance(data, dict) or data.get("v") != 1:
+            return 0
+        if abs(float(data.get("step_s", -1)) - self.step_s) > 1e-9:
+            return 0
+        restored = 0
+        with self._lock:
+            for node, metrics in (data.get("nodes") or {}).items():
+                per_node = self._series.setdefault(str(node), {})
+                for metric, sd in metrics.items():
+                    s = per_node.get(metric)
+                    if s is None:
+                        if len(per_node) >= self._max_series:
+                            continue
+                        s = per_node[metric] = Series(
+                            str(sd.get("kind") or "gauge"), self.step_s,
+                            self.window_s)
+                    s.load(sd)
+                    restored += 1
+        return restored
 
 
 class SLOEvaluator:

@@ -145,6 +145,25 @@ class FaultInjector:
                 self._specs = parsed
             else:
                 self._specs.extend(parsed)
+        if parsed:
+            # flight recorder (runtime/events.py): an armed chaos
+            # schedule belongs in the post-incident record — "was this
+            # dip organic or an experiment?" should never need a log
+            # archaeology dig. The import is guarded, not just lazy:
+            # importing the runtime package pulls the engine (and jax),
+            # and a utils-only process arming faults via DLI_FAULTS
+            # must degrade to ring-less no-op, never crash in arm().
+            try:
+                from distributed_llm_inferencing_tpu.runtime import \
+                    events
+                events.emit("fault-armed", service=self.service or None,
+                            count=len(parsed),
+                            points=[s.point for s in parsed][:8])
+            except Exception:
+                import logging
+                logging.getLogger("dli_tpu.faults").debug(
+                    "fault-armed journal emit unavailable "
+                    "(runtime package not importable here)")
 
     def clear(self):
         with self._lock:

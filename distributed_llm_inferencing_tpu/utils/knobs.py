@@ -254,6 +254,16 @@ KNOBS = (
     Knob("DLI_TSDB_MAX_SERIES", "512", "int",
          "Per-node series cap — a buggy worker must not grow master "
          "memory without bound.", f"{_P}/runtime/tsdb.py"),
+    Knob("DLI_TSDB_SNAPSHOT_S", "30.0", "float",
+         "Seconds between TSDB ring snapshots into the master store "
+         "(restored at startup, so series history spans restarts); "
+         "`0` disables durability.", f"{_P}/runtime/master.py"),
+    Knob("DLI_EVENTS_RING", "2048", "int",
+         "Bounded in-memory ring of recent flight-recorder events per "
+         "journal.", f"{_P}/runtime/events.py"),
+    Knob("DLI_EVENTS_RETAIN", "20000", "int",
+         "Rows the durable `events` table retains (oldest pruned on "
+         "the journal's cadence).", f"{_P}/runtime/events.py"),
     Knob("DLI_SLO_TTFT_MS", "2000.0", "float",
          "SLO target for TTFT (queue + prefill) per request.",
          f"{_P}/runtime/tsdb.py"),

@@ -172,15 +172,22 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu DLI_FAULTS_ENABLE=1 \
 timeout -k 10 600 env JAX_PLATFORMS=cpu \
     python bench.py --scenario rebalance --smoke || exit 1
 
-echo "== telemetry plane (TSDB + cost ledger + SLO + profiler) =="
+echo "== telemetry plane + flight recorder (TSDB + cost ledger + SLO + events) =="
 # Time-series retention, per-request cost ledger, SLO accounting, decode
-# profiler (docs/observability.md "Telemetry plane"); the smoke drives a
-# live master + in-proc worker, waits two scrape intervals, asserts
-# /api/timeseries serves multi-sample series + the cost ledger
-# round-trips, and leaves a debug bundle at /tmp/dli_debug_bundle.tar.gz
-# (uploaded as a CI artifact on tier-1 failure)
+# profiler (docs/observability.md "Telemetry plane"), and the flight
+# recorder (durable event journal + request journeys + TSDB
+# snapshot/restore, docs/observability.md "Flight recorder"); the smoke
+# drives a live master + in-proc worker, waits two scrape intervals,
+# asserts /api/timeseries serves multi-sample series + the cost ledger
+# round-trips + events flow into /api/events + the journey endpoint
+# returns a connected timeline, and leaves a debug bundle at
+# /tmp/dli_debug_bundle.tar.gz (uploaded as a CI artifact on tier-1
+# failure, together with the /tmp/dli_events.json journal export)
 timeout -k 10 600 env JAX_PLATFORMS=cpu \
     python -m pytest tests/test_tsdb.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+timeout -k 10 900 env JAX_PLATFORMS=cpu DLI_FAULTS_ENABLE=1 \
+    python -m pytest tests/test_events.py -q \
     -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
 timeout -k 10 600 env JAX_PLATFORMS=cpu \
     python scripts/telemetry_smoke.py || exit 1
@@ -219,6 +226,7 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     --ignore=tests/test_disagg.py \
     --ignore=tests/test_migration.py \
     --ignore=tests/test_tsdb.py \
+    --ignore=tests/test_events.py \
     2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \

@@ -47,7 +47,31 @@ fetch /api/slo slo.json
 fetch /api/nodes/status nodes_status.json
 fetch /api/cluster_metrics cluster_metrics.json
 fetch /api/inference/recent recent_requests.json
+fetch /api/events events.json            # flight-recorder journal
 fetch /metrics master_metrics.prom
+
+# Journey of the worst recent SLO-missing request: a terminal failure
+# is an SLO miss by definition; with none in the recent window, take
+# the slowest completion (the likeliest TTFT/ITL violator). Best-effort
+# like every other fetch -- no python3, no journey, bundle still lands.
+RID=$(python3 - "$TMP/recent_requests.json" <<'EOF' 2>/dev/null
+import json, sys
+try:
+    rows = json.load(open(sys.argv[1])).get("requests") or []
+except Exception:
+    rows = []
+bad = [r for r in rows if r.get("status") == "failed"]
+if not bad:
+    bad = sorted((r for r in rows if r.get("status") == "completed"),
+                 key=lambda r: -(r.get("execution_time") or 0))[:1]
+if bad:
+    print(bad[0]["id"])
+EOF
+)
+if [ -n "${RID:-}" ]; then
+    fetch "/api/requests/$RID/journey" worst_request_journey.json
+    fetch "/api/events?request=$RID" worst_request_events.json
+fi
 
 {
     echo "collected_at: $(date -u +%Y-%m-%dT%H:%M:%SZ)"

@@ -7,7 +7,12 @@ and assert the retention layer actually retained:
 - a completed request's cost record round-trips through the worker
   response, the master row, and ``GET /api/requests/<id>/cost``, with
   its phases summing to ~the e2e window;
-- the SLO evaluator saw every completed request.
+- the SLO evaluator saw every completed request;
+- flight-recorder events flow end-to-end (emit -> group-commit store ->
+  ``GET /api/events``, type filter honored) and
+  ``GET /api/requests/<id>/journey`` returns one connected, time-ordered
+  timeline with the cost phases attached (journal exported to
+  /tmp/dli_events.json for the CI failure artifact).
 
 Always finishes by collecting a debug bundle from the live cluster into
 /tmp/dli_debug_bundle.tar.gz — on a later tier-1 failure the workflow
@@ -118,6 +123,32 @@ def main():
         assert prof["nodes"]["w0"]["tiny-llama"]["summary"][
             "enabled"] is False, prof
 
+        # flight recorder: events flow end-to-end (emit -> group-commit
+        # store -> /api/events) and the type filter works
+        ev = requests.get(f"{base}/api/events").json()
+        assert ev["status"] == "success" and ev["events"], ev
+        types = {e["type"] for e in ev["events"]}
+        assert "node-added" in types, types
+        flt = requests.get(f"{base}/api/events",
+                           params={"type": "node-added"}).json()
+        assert flt["events"] and all(e["type"] == "node-added"
+                                     for e in flt["events"]), flt
+        assert flt["events"][0].get("node") == "w0", flt
+        with open("/tmp/dli_events.json", "w") as f:
+            json.dump(ev, f, indent=1)
+        # journey endpoint returns one CONNECTED timeline: starts at
+        # submission, contains the terminal transition, time-ordered,
+        # with the cost phases partitioning the tail
+        jr = requests.get(f"{base}/api/requests/{rid}/journey").json()
+        assert jr["status"] == "success" and jr["connected"], jr
+        entry_ts = [e["t"] for e in jr["entries"]]
+        assert entry_ts == sorted(entry_ts), jr["entries"]
+        life = [e["name"] for e in jr["entries"]
+                if e["kind"] == "lifecycle"]
+        assert life[0] == "submitted" and "completed" in life, life
+        assert [p["phase"] for p in jr["phases"]] == [
+            "queue", "prefill", "decode"], jr["phases"]
+
         out = subprocess.run(
             ["bash", "scripts/collect_debug_bundle.sh", base,
              "/tmp/dli_debug_bundle.tar.gz"],
@@ -128,6 +159,8 @@ def main():
                           "phase_sum_ms": round(phase_sum, 1),
                           "e2e_ms": e2e,
                           "slo_requests": slo["requests_total"],
+                          "events": len(ev["events"]),
+                          "journey_entries": len(jr["entries"]),
                           "bundle": out.stdout.strip()}),
               file=sys.stderr)
         rc = 0

@@ -19,8 +19,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from tools.dlilint import CHECKERS, run_all
 from tools.dlilint.core import Ctx, SourceFile, load_lifecycle, repo_root
-from tools.dlilint import check_jit, check_knobs, check_lifecycle, \
-    check_metrics, check_rpc, check_threads
+from tools.dlilint import check_events, check_jit, check_knobs, \
+    check_lifecycle, check_metrics, check_rpc, check_threads
 
 
 def _sf(tmp_path, rel, source):
@@ -701,6 +701,115 @@ def test_lifecycle_declared_machine_is_sane():
         if t.target in _LIFECYCLE.TERMINAL:
             assert t.durability in ("barrier", "sync-txn")
     assert any(t.counts_attempt for t in ts)
+
+
+# ---- events checker ----------------------------------------------------
+
+class _EvDecl:
+    def __init__(self, doc="documented"):
+        self.doc = doc
+        self.fields = ()
+
+
+def test_events_undeclared_emit_caught(tmp_path):
+    sf = _sf(tmp_path, "pkg/mod.py", """\
+        from distributed_llm_inferencing_tpu.runtime import events
+        events.emit("ghost-event", node_id=1)
+        events.emit("real-event")
+        """)
+    out = check_events.check(_ctx(
+        tmp_path, package_files=[sf],
+        event_registry={"real-event": _EvDecl()}))
+    assert _rules(out) == ["event-undeclared"]
+    assert "ghost-event" in out[0].msg
+
+
+def test_events_self_attribute_emit_resolved(tmp_path):
+    """The master's ``self.events.emit(...)`` form counts as an emit
+    site too (the dotted callee ends in events.emit)."""
+    sf = _sf(tmp_path, "pkg/mod.py", """\
+        class M:
+            def go(self):
+                self.events.emit("real-event", node_id=1)
+        """)
+    out = check_events.check(_ctx(
+        tmp_path, package_files=[sf],
+        event_registry={"real-event": _EvDecl()}))
+    assert out == []
+
+
+def test_events_unemitted_declared_type_caught(tmp_path):
+    sf = _sf(tmp_path, "pkg/mod.py", "x = 1\n")
+    out = check_events.check(_ctx(
+        tmp_path, package_files=[sf],
+        event_registry={"never-fired": _EvDecl()}))
+    assert _rules(out) == ["event-unemitted"]
+    assert "never-fired" in out[0].msg
+
+
+def test_events_undoc_caught(tmp_path):
+    sf = _sf(tmp_path, "pkg/mod.py", """\
+        from distributed_llm_inferencing_tpu.runtime import events
+        events.emit("bare-event")
+        """)
+    out = check_events.check(_ctx(
+        tmp_path, package_files=[sf],
+        event_registry={"bare-event": _EvDecl(doc="  ")}))
+    assert _rules(out) == ["event-undoc"]
+
+
+def test_events_pragma_suppresses(tmp_path):
+    sf = _sf(tmp_path, "pkg/mod.py", """\
+        from distributed_llm_inferencing_tpu.runtime import events
+        # dlilint: disable=event-undeclared
+        events.emit("waived-event")
+        """)
+    out = check_events.check(_ctx(tmp_path, package_files=[sf],
+                                  event_registry={}))
+    assert out == []
+
+
+def test_events_table_stale_caught(tmp_path):
+    """A drifted (or missing) generated block in observability.md fails;
+    write_event_table repairs it to a fixed point."""
+    from tools.dlilint.core import load_events
+    events_mod = load_events(repo_root())
+    doc = tmp_path / "docs" / "observability.md"
+    doc.parent.mkdir()
+    doc.write_text("# Observability\n")
+    sf = _sf(tmp_path, "pkg/mod.py", "\n".join(
+        f'events.emit("{name}")' for name in events_mod.registry()) + "\n")
+    ctx = _ctx(tmp_path, package_files=[sf],
+               event_registry=events_mod.registry(),
+               events_mod=events_mod,
+               observability_md=str(doc))
+    out = check_events.check(ctx)
+    assert _rules(out) == ["event-table-stale"]
+    assert check_events.write_event_table(str(doc), events_mod)
+    assert check_events.check(ctx) == []
+    # idempotent: a second write is a no-op
+    assert not check_events.write_event_table(str(doc), events_mod)
+    # hand edits to the block fail again
+    doc.write_text(doc.read_text().replace("| `breaker-open` |",
+                                           "| `breaker-open!!` |"))
+    out = check_events.check(ctx)
+    assert _rules(out) == ["event-table-stale"]
+
+
+def test_events_real_registry_fully_emitted():
+    """Acceptance: three-way parity on the committed tree — every
+    declared type has a live emit site and the docs appendix is the
+    registry's exact rendering (the byte check runs via
+    test_real_tree_clean; this pins the emit-site leg explicitly)."""
+    ctx = Ctx.for_repo()
+    emitted = {name for _, _, name in
+               check_events.collect_emit_sites(
+                   ctx.package_files + ctx.gate_files)}
+    declared = set(ctx.event_registry)
+    assert declared <= emitted, (
+        f"declared-but-never-emitted: {sorted(declared - emitted)}")
+    assert emitted <= declared, (
+        f"emitted-but-undeclared: {sorted(emitted - declared)}")
 
 
 # ---- the real tree is the fixture for "runs clean" ---------------------

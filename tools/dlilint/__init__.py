@@ -22,6 +22,8 @@ rpc                 rpc-unknown-path, rpc-method-mismatch,
 lifecycle           lifecycle-undeclared, lifecycle-guard,
                     lifecycle-barrier, lifecycle-attempts,
                     lifecycle-unused, lifecycle-diagram-stale
+events              event-undeclared, event-unemitted, event-undoc,
+                    event-table-stale
 ==================  ===================================================
 
 Run: ``python -m tools.dlilint`` (exit 0 = clean). Suppress a reviewed
@@ -35,8 +37,8 @@ from __future__ import annotations
 
 from typing import Dict, List
 
-from . import (check_jit, check_knobs, check_lifecycle, check_metrics,
-               check_rpc, check_threads)
+from . import (check_events, check_jit, check_knobs, check_lifecycle,
+               check_metrics, check_rpc, check_threads)
 from .core import Ctx, Violation
 
 CHECKERS = {
@@ -46,6 +48,7 @@ CHECKERS = {
     "threads": check_threads.check,
     "rpc": check_rpc.check,
     "lifecycle": check_lifecycle.check,
+    "events": check_events.check,
 }
 
 

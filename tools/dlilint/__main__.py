@@ -29,6 +29,9 @@ def main(argv=None) -> int:
     ap.add_argument("--write-lifecycle-diagram", action="store_true",
                     help="regenerate the docs/robustness.md lifecycle "
                          "diagram from runtime/lifecycle.py, then check")
+    ap.add_argument("--write-event-table", action="store_true",
+                    help="regenerate the docs/observability.md event "
+                         "table from runtime/events.py, then check")
     args = ap.parse_args(argv)
 
     ctx = Ctx.for_repo(args.root)
@@ -48,6 +51,16 @@ def main(argv=None) -> int:
         changed = write_lifecycle_diagram(ctx.robustness_md,
                                           ctx.lifecycle_mod)
         print(f"lifecycle diagram: "
+              f"{'rewritten' if changed else 'already current'}")
+        ctx = Ctx.for_repo(args.root)
+    if args.write_event_table:
+        from .check_events import write_event_table
+        if ctx.observability_md is None:
+            print("dlilint: docs/observability.md not found",
+                  file=sys.stderr)
+            return 2
+        changed = write_event_table(ctx.observability_md, ctx.events_mod)
+        print(f"event table: "
               f"{'rewritten' if changed else 'already current'}")
         ctx = Ctx.for_repo(args.root)
 

@@ -1,0 +1,145 @@
+"""Events checker: emit sites == ``runtime/events.py`` registry == docs.
+
+The flight recorder (docs/observability.md "Flight recorder") is only
+trustworthy if the declared event registry IS the set of events the
+cluster can emit — an undeclared emit would throw at the decision site,
+a declared-but-never-emitted type is dead documentation a postmortem
+would wait for forever, and a stale docs table teaches operators event
+semantics the code no longer has. Three-way parity, mirroring the knobs
+checker:
+
+- ``event-undeclared``   — an ``events.emit("<type>", ...)`` call whose
+  literal type has no row in ``runtime.events.EVENT_TYPES``.
+- ``event-unemitted``    — a declared type with no statically-visible
+  emit site in the package or the gate scripts.
+- ``event-undoc``        — a declared type with an empty ``doc`` (the
+  registry's own import-time assertion catches this for the real
+  module; the rule keeps synthetic/test registries honest too).
+- ``event-table-stale``  — the generated appendix block in
+  docs/observability.md does not match ``events.generated_block()``
+  (regenerate with ``python -m tools.dlilint --write-event-table``).
+
+Emit sites are found by AST: any call whose dotted callee ends in
+``events.emit`` (the module helper ``events.emit(...)`` and the
+master's ``self.events.emit(...)`` both match) with a constant first
+argument. A dynamic first argument is invisible to this checker —
+``EventJournal.emit`` raises on undeclared types at runtime, so the
+dynamic case fails loudly in tests instead of silently here.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Tuple
+
+from .core import Ctx, SourceFile, Violation, const_str, dotted_name, \
+    filter_suppressed
+
+RULES = ("event-undeclared", "event-unemitted", "event-undoc",
+         "event-table-stale")
+
+
+def collect_emit_sites(files) -> List[Tuple[SourceFile, int, str]]:
+    """(file, line, type-name) for every statically-visible
+    ``events.emit("<literal>", ...)`` call in ``files``."""
+    out = []
+    for sf in files:
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            dn = dotted_name(node.func)
+            if dn is None or not dn.endswith("events.emit"):
+                continue
+            name = const_str(node.args[0])
+            if name is not None:
+                out.append((sf, node.lineno, name))
+    return out
+
+
+def check(ctx: Ctx) -> List[Violation]:
+    violations: List[Violation] = []
+    files = {sf.rel: sf for sf in ctx.package_files + ctx.gate_files}
+    registry = ctx.event_registry
+    if registry is None:
+        return []
+
+    sites = collect_emit_sites(files.values())
+    emitted = {}
+    for sf, line, name in sites:
+        emitted.setdefault(name, (sf.rel, line))
+    # 1. every emit site declared
+    for sf, line, name in sites:
+        if name not in registry:
+            violations.append(Violation(
+                "event-undeclared", sf.rel, line,
+                f"event type {name!r} emitted here but missing from "
+                f"runtime/events.py EVENT_TYPES"))
+    # 2. every declared type emitted somewhere
+    ev_rel = ("distributed_llm_inferencing_tpu/runtime/events.py")
+    for name in sorted(registry):
+        if name not in emitted:
+            violations.append(Violation(
+                "event-unemitted", ev_rel, 1,
+                f"declared event type {name!r} has no emit site — "
+                "dead documentation a postmortem would wait for "
+                "forever"))
+        decl = registry[name]
+        doc = getattr(decl, "doc", None)
+        if doc is not None and not str(doc).strip():
+            violations.append(Violation(
+                "event-undoc", ev_rel, 1,
+                f"declared event type {name!r} has an empty doc"))
+
+    # 3. generated docs appendix freshness (real registry only — a
+    # synthetic test registry can't match the module's rendering)
+    if ctx.observability_md and ctx.events_mod is not None:
+        real = getattr(ctx.events_mod, "registry", lambda: None)()
+        if real is not None and set(registry) == set(real):
+            with open(ctx.observability_md, encoding="utf-8") as f:
+                text = f.read()
+            block = _extract_block(text, ctx.events_mod.DOC_BEGIN,
+                                   ctx.events_mod.DOC_END)
+            want = ctx.events_mod.generated_block()
+            if block is None:
+                violations.append(Violation(
+                    "event-table-stale", "docs/observability.md", 1,
+                    "generated event table markers missing — run "
+                    "python -m tools.dlilint --write-event-table"))
+            elif block.strip() != want.strip():
+                violations.append(Violation(
+                    "event-table-stale", "docs/observability.md", 1,
+                    "generated event table drifted from "
+                    "runtime/events.py — run python -m tools.dlilint "
+                    "--write-event-table"))
+
+    return filter_suppressed(violations, files)
+
+
+def _extract_block(text: str, begin: str, end: str) -> Optional[str]:
+    i = text.find(begin)
+    j = text.find(end)
+    if i < 0 or j < 0:
+        return None
+    return text[i:j + len(end)]
+
+
+def write_event_table(observability_md: str, events_mod) -> bool:
+    """Rewrite (or append) the generated block in ``observability_md``.
+    Returns True when the file changed."""
+    with open(observability_md, encoding="utf-8") as f:
+        text = f.read()
+    want = events_mod.generated_block()
+    cur = _extract_block(text, events_mod.DOC_BEGIN, events_mod.DOC_END)
+    if cur is None:
+        new = (text.rstrip("\n")
+               + "\n\n### Appendix: declared event types\n\n" + want
+               + "\n")
+    elif cur == want:
+        return False
+    else:
+        new = text.replace(cur, want)
+    with open(observability_md, "w", encoding="utf-8") as f:
+        f.write(new)
+    return True

@@ -116,6 +116,9 @@ class Ctx:
     knob_registry: Optional[dict] = None     # name -> Knob (or test dict)
     lifecycle_transitions: Optional[tuple] = None   # runtime/lifecycle.py
     lifecycle_mod: Optional[object] = None   # the module (diagram check)
+    observability_md: Optional[str] = None
+    event_registry: Optional[dict] = None    # name -> EventType (or test)
+    events_mod: Optional[object] = None      # runtime/events.py (table)
 
     @classmethod
     def for_repo(cls, root: Optional[str] = None) -> "Ctx":
@@ -147,7 +150,9 @@ class Ctx:
             if f.endswith(".sh")) if os.path.isdir(scripts_dir) else []
         from distributed_llm_inferencing_tpu.utils import knobs
         lifecycle = load_lifecycle(root)
+        events = load_events(root)
         robustness = os.path.join(docs_dir, "robustness.md")
+        observability = os.path.join(docs_dir, "observability.md")
         return cls(root=root, package_files=package_files,
                    runtime_files=runtime_files, gate_files=gate_files,
                    test_files=test_files,
@@ -156,9 +161,14 @@ class Ctx:
                    serving_md=serving if os.path.exists(serving) else None,
                    robustness_md=(robustness if os.path.exists(robustness)
                                   else None),
+                   observability_md=(observability
+                                     if os.path.exists(observability)
+                                     else None),
                    knob_registry=knobs.registry(),
                    lifecycle_transitions=lifecycle.TRANSITIONS,
-                   lifecycle_mod=lifecycle)
+                   lifecycle_mod=lifecycle,
+                   event_registry=events.registry(),
+                   events_mod=events)
 
 
 def repo_root() -> str:
@@ -176,6 +186,21 @@ def load_lifecycle(root: str):
     path = os.path.join(root, "distributed_llm_inferencing_tpu",
                         "runtime", "lifecycle.py")
     spec = importlib.util.spec_from_file_location("_dli_lifecycle", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def load_events(root: str):
+    """Import runtime/events.py by FILE PATH, same discipline as
+    :func:`load_lifecycle`: the declared event registry is data + string
+    rendering (its journal half leans only on ``utils.locks``), and
+    loading by path keeps the checker gate off ``runtime/__init__``'s
+    import graph."""
+    import importlib.util
+    path = os.path.join(root, "distributed_llm_inferencing_tpu",
+                        "runtime", "events.py")
+    spec = importlib.util.spec_from_file_location("_dli_events", path)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
