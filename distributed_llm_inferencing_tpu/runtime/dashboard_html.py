@@ -91,6 +91,8 @@ DASHBOARD = f"""<!doctype html><html><head><title>Dashboard</title>{_STYLE}
     <div class="label">processing</div></div>
   <div class="card"><div class="num" id="n-completed">–</div>
     <div class="label">completed</div></div>
+  <div class="card"><div class="num" id="ha-role" style="font-size:18px">–</div>
+    <div class="label" id="ha-detail">control plane (/api/ha)</div></div>
 </div>
 <h2>Batched Serving</h2>
 <table><thead><tr><th>Node</th><th>Model</th><th>Mesh</th>
@@ -142,6 +144,21 @@ async function refresh() {{
     const ns = await (await fetch('/api/nodes/status')).json();
     document.getElementById('n-nodes').textContent =
       ns.nodes.filter(n => n.is_active).length;
+    // replicated control plane (runtime/replication.py): which master
+    // this page is served by, the lease term, and peer replication
+    // state — on a standby this whole dashboard reads the replica
+    try {{
+      const ha = await (await fetch('/api/ha')).json();
+      if (ha.enabled) {{
+        const acked = (ha.peers || []).map(p => p.acked_seq).join('/');
+        document.getElementById('ha-role').textContent =
+          (ha.is_leader ? 'leader' : 'standby') + ' · term ' + ha.term;
+        document.getElementById('ha-detail').textContent =
+          'op-log ' + ha.log_seq + ' · peers acked ' + (acked || '–');
+      }} else {{
+        document.getElementById('ha-role').textContent = 'solo';
+      }}
+    }} catch (e) {{ /* HA surface best-effort */ }}
     // live continuous-batcher internals (runtime/batcher.py stats(),
     // carried on /health -> node info): slots, queue, prefix-cache hits
     const rows = [];

@@ -176,6 +176,41 @@ EVENT_TYPES = (
         "runtime admin API) — chaos experiments are part of the "
         "post-incident record too.",
         ("service", "count", "points")),
+    # ---- replicated control plane (runtime/replication.py) -----------
+    EventType(
+        "lease-acquired", "warning",
+        "A standby's lease deadline expired and it took the leader "
+        "lease at term+1: this master now schedules/dispatches (the "
+        "takeover-recovery event that follows carries the requeue "
+        "count).", ("term", "holder", "prev_applied")),
+    EventType(
+        "lease-lost", "warning",
+        "A leading master observed a higher (or winning) term — via a "
+        "peer frame, a peer ack, or a worker's stale-term fence — and "
+        "stepped down: it stops scheduling immediately and its store "
+        "is resynced from the new leader's snapshot.",
+        ("term", "reason", "holder")),
+    EventType(
+        "takeover-recovery", "warning",
+        "The crash-recovery requeue run at lease takeover: every "
+        "request the dead leader held in 'processing' re-entered the "
+        "queue (attempt counted; poison requests at the budget fail "
+        "instead).", ("term", "recovered")),
+    EventType(
+        "replication-lag", "warning",
+        "Standby acks fell behind the op-log head past the warn "
+        "threshold — or a durability-barrier wait timed out and the "
+        "write degraded to leader-only durability. The info-severity "
+        "twin marks recovery (acks caught back up).",
+        ("ops_behind", "lag_ms", "acked_seq", "log_seq",
+         "barrier_timeout")),
+    EventType(
+        "stale-term-rejected", "warning",
+        "A worker fenced this master's dispatch with 409 + "
+        "X-DLI-Stale-Term: a newer term holds the lease. Emitted by "
+        "the deposed master (to its in-memory ring) as it steps down "
+        "— the paused-then-revived-leader trail a postmortem needs.",
+        ("term", "observed_term")),
 )
 
 _BY_NAME: Dict[str, EventType] = {t.name: t for t in EVENT_TYPES}
@@ -230,6 +265,12 @@ class EventJournal:
         if retain is None:
             retain = int(os.environ.get("DLI_EVENTS_RETAIN", 20000))
         self._store = store
+        # Replicated control plane (runtime/replication.py): a STANDBY
+        # master journals to its in-memory ring only — the durable
+        # journal rows arrive from the leader through op-log
+        # replication, and a replica writing its own would fork the
+        # replicated autoincrement stream. Flipped at promote/demote.
+        self.durable = True
         self._retain = max(1, int(retain))
         self._lock = locks.lock("events.ring")
         self._ring: collections.deque = collections.deque(
@@ -269,7 +310,7 @@ class EventJournal:
             prune = self._since_prune >= self._PRUNE_EVERY
             if prune:
                 self._since_prune = 0
-        if self._store is not None:
+        if self._store is not None and self.durable:
             # one buffered INSERT through the group-commit write-behind
             # path (barrier=False: durability within a flush cycle, no
             # hot-path commit wait); the periodic prune rides the same

@@ -29,7 +29,10 @@ from distributed_llm_inferencing_tpu.utils.faults import FaultInjector
 QUIET_TRACE_PATHS = frozenset(
     {"/health", "/metrics", "/api/trace", "/api/cluster_metrics",
      "/api/nodes/status", "/api/inference/recent", "/api/timeseries",
-     "/api/slo", "/api/profile", "/api/events"})
+     "/api/slo", "/api/profile", "/api/events",
+     # HA peer channel: heartbeat frames land every lease/3 — pure
+     # span noise — and the discovery endpoints are poll surfaces
+     "/replicate", "/api/ha", "/api/leader"})
 
 
 class Route:

@@ -69,7 +69,9 @@ TRANSITIONS = (
         "locked-select", "sync-txn", False,
         "oldest due rows only (next_attempt_at<=now); one locked "
         "SELECT + executemany flip keeps claims disjoint across "
-        "dispatchers"),
+        "dispatchers; claims replicate to HA standbys, so a lease "
+        "takeover's recovery sees exactly the dead leader's in-flight "
+        "set"),
     Transition(
         "requeue", ("processing", "pending"), "pending", "requeue",
         "none", "barrier", True,
@@ -81,7 +83,9 @@ TRANSITIONS = (
         "mark_completed", "not-terminal", "barrier", False,
         "terminal; result+cost ride the same UPDATE so row and ledger "
         "commit atomically; a request that already reached a terminal "
-        "state is never overwritten"),
+        "state is never overwritten — replicated applies "
+        "(Store.apply_ops) replay this exact guarded SQL, so a "
+        "re-delivered frame can never flip a standby's verdict either"),
     Transition(
         "fail", ("processing", "pending"), "failed", "mark_failed",
         "not-terminal", "barrier", False,
@@ -97,18 +101,23 @@ TRANSITIONS = (
         "hint back at the source arena; the re-dispatch resumes "
         "mid-stream on another node; no attempt burned; the "
         "status='processing' guard means a handoff racing a terminal "
-        "write never resurrects a finished row"),
+        "write never resurrects a finished row — on a replica too: a "
+        "replayed migrate frame lands through this same WHERE"),
     Transition(
         "recover_fail", ("processing",), "failed",
         "recover_stale_processing", "where", "sync-txn", False,
-        "startup crash recovery: a poison request at the attempt "
-        "budget (attempts+1>=max) fails instead of re-entering the "
-        "queue"),
+        "crash recovery — master startup AND lease takeover (a "
+        "standby promoting at term+1 runs the same site): a poison "
+        "request at the attempt budget (attempts+1>=max) fails "
+        "instead of re-entering the queue"),
     Transition(
         "recover_requeue", ("processing",), "pending",
         "recover_stale_processing", "where", "sync-txn", True,
-        "startup crash recovery: stranded rows re-enter the queue "
-        "with the recovery counted as an attempt"),
+        "crash recovery — master startup AND lease takeover: rows the "
+        "dead leader held in 'processing' re-enter the queue with the "
+        "recovery counted as an attempt; the re-dispatch presents the "
+        "replicated cluster tag, so a generation the dead leader left "
+        "in flight is joined/replayed, not re-run"),
 )
 
 

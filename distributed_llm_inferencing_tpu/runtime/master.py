@@ -32,6 +32,7 @@ import requests as http
 
 from distributed_llm_inferencing_tpu.runtime import dashboard_html, httpd
 from distributed_llm_inferencing_tpu.runtime import events
+from distributed_llm_inferencing_tpu.runtime import replication
 from distributed_llm_inferencing_tpu.runtime import tsdb as tsdb_mod
 from distributed_llm_inferencing_tpu.runtime.kvtier import (
     estimate_cached_tokens)
@@ -191,6 +192,15 @@ class _NodeUnavailable(Exception):
         self.in_flight = in_flight
 
 
+class _StaleTermError(Exception):
+    """A worker fenced this dispatch with 409 + ``X-DLI-Stale-Term``: a
+    newer master term holds the lease (docs/robustness.md "Replicated
+    control plane"). This master has already stepped down by the time
+    the exception propagates — the dispatch tail must write NOTHING
+    (no requeue, no terminal status, no strike): the current leader
+    owns the request's lifecycle now."""
+
+
 class Master:
     def __init__(self, db_path: str = ":memory:", *,
                  dispatcher_threads: int = DISPATCH_WORKERS,
@@ -214,7 +224,13 @@ class Master:
                  tsdb_window_s: Optional[float] = None,
                  tsdb_snapshot_s: Optional[float] = None,
                  events_ring: Optional[int] = None,
-                 events_retain: Optional[int] = None):
+                 events_retain: Optional[int] = None,
+                 ha_peers=None,
+                 ha_lease_ms: Optional[float] = None,
+                 ha_repl_barrier: Optional[bool] = None,
+                 ha_lag_warn_ms: Optional[float] = None,
+                 ha_leader: Optional[bool] = None,
+                 ha_self_url: Optional[str] = None):
         self._stop = threading.Event()
         self._wake = threading.Event()
         # Group-commit store: the dispatch hot path's status writes
@@ -341,10 +357,31 @@ class Master:
         # slo-burn crossing state (hysteresis: one event per crossing,
         # not one per sweep above threshold)
         self._burn_alerting = False
-        n = self.store.recover_stale_processing(max_attempts=MAX_ATTEMPTS)
-        if n:
-            log.info("recovered %d request(s) stranded by a previous run", n)
         self.metrics = Metrics()
+        # Replicated control plane (runtime/replication.py,
+        # docs/robustness.md "Replicated control plane"): with
+        # DLI_HA_PEERS configured this master is one of a leader-leased
+        # pair — every committed store write ships to the peers as a
+        # sequenced op-log frame, only the lease holder dispatches, and
+        # a standby serves reads from its replica until the lease
+        # expires and it takes over. Solo masters (no peers) keep the
+        # exact pre-HA behavior: permanently leading, zero overhead.
+        self.ha = replication.HAController(
+            self, peers=ha_peers, lease_ms=ha_lease_ms,
+            repl_barrier=ha_repl_barrier, lag_warn_ms=ha_lag_warn_ms,
+            leader=ha_leader, self_url=ha_self_url)
+        self.store.set_op_hook(self.ha.on_ops)
+        self.store.set_repl_barrier(self.ha.repl_barrier)
+        # a standby journals to its in-memory ring only: the durable
+        # journal rows arrive via replication from the leader; writing
+        # its own would fork the replica's autoincrement stream
+        self.events.durable = self.ha.is_leader()
+        if self.ha.is_leader():
+            n = self.store.recover_stale_processing(
+                max_attempts=MAX_ATTEMPTS)
+            if n:
+                log.info("recovered %d request(s) stranded by a "
+                         "previous run", n)
         # pre-register the role/disaggregation decision counters at 0
         # (PR 5 rule: a scrape and the TSDB catalog must see them exist
         # before the first role-split fleet ever forms)
@@ -357,8 +394,24 @@ class Master:
                      "scheduler_disagg_no_prefill_pool",
                      "requests_migrated",
                      "rebalancer_role_flips",
-                     "rebalancer_migrations"):
+                     "rebalancer_migrations",
+                     # replicated-control-plane decision counters
+                     # (runtime/replication.py): pre-registered so a
+                     # scrape/TSDB chart sees them exist before the
+                     # first frame ever ships or a lease ever moves
+                     "repl_frames_shipped",
+                     "repl_ops_shipped",
+                     "repl_ops_applied",
+                     "repl_snapshots_loaded",
+                     "repl_barrier_timeouts",
+                     "repl_stale_term_rejections",
+                     "ha_takeovers",
+                     "ha_lease_lost",
+                     "requests_fenced",
+                     "requests_submit_deduped"):
             self.metrics.inc(name, 0)
+        # ops the peers have not acked yet (0 = fully replicated)
+        self.metrics.gauge("repl_lag_ops", 0.0)
         # same rule for the SLO gauges the dashboard charts: they must
         # exist in the exposition from the first scrape (the telemetry
         # loop still withholds them from the TSDB until the fast window
@@ -366,12 +419,28 @@ class Master:
         self.metrics.gauge("slo_attainment", 0.0)
         self.metrics.gauge("slo_burn_rate", 0.0)
         trace.set_service("master")
-        # Dispatch tags are the worker-side idempotency key, so they must
-        # be unique across master *instances*: request ids restart at 1
-        # for a fresh DB, and a bare id could replay another request's
-        # cached generation out of a long-lived worker.
+        # Dispatch tags are the worker-side idempotency key, so they
+        # must be unique across unrelated masters (request ids restart
+        # at 1 for a fresh DB, and a bare id could replay another
+        # request's cached generation out of a long-lived worker) — but
+        # SHARED across an HA pair: the replicated store shares request
+        # ids, so a post-takeover re-dispatch of request N must present
+        # the SAME tag the dead leader's in-flight dispatch used — the
+        # worker's idempotency cache then joins/replays instead of
+        # generating twice. The nonce therefore lives in the replicated
+        # meta table: the first leader mints it, standbys adopt it at
+        # promotion (on_promote), and a restarted master on the same DB
+        # inherits it (ids continue, so tags still never collide).
         import uuid
-        self._run_nonce = uuid.uuid4().hex[:8]
+        nonce = None
+        try:
+            nonce = self.store.get_meta("tag_nonce")
+        except Exception:
+            nonce = None
+        if nonce is None and self.ha.is_leader():
+            nonce = uuid.uuid4().hex[:8]
+            self.store.set_meta("tag_nonce", nonce)
+        self._run_nonce = nonce or uuid.uuid4().hex[:8]
         self.health_interval = health_interval
         self._worker_auth = auth_key or os.environ.get("DLI_AUTH_KEY")
         self._inflight: Dict[int, int] = {}   # node_id -> in-flight count
@@ -422,8 +491,78 @@ class Master:
         s.add("GET", "/api/events", self.api_events)
         s.add("GET", "/api/requests/<req_id>/journey",
               self.api_request_journey)
+        # replicated control plane (runtime/replication.py): the peer
+        # op-log/lease channel plus the thin leader-discovery surface
+        # that makes either master a valid client entry point
+        s.add("POST", "/replicate", self.api_replicate)
+        s.add("GET", "/api/leader", self.api_leader)
+        s.add("GET", "/api/ha", self.api_ha)
         s.add("GET", "/health", lambda b: {"status": "online",
                                            "counts": self.store.counts()})
+
+    # ---- replicated control plane (runtime/replication.py) -----------
+
+    def max_attempts(self) -> int:
+        return MAX_ATTEMPTS
+
+    def on_promote(self):
+        """Lease takeover tail run by the HA controller BEFORE the
+        recovery requeue: this master's journal becomes the durable
+        one, and it adopts the cluster tag nonce from the replicated
+        meta table — post-takeover re-dispatches present the SAME
+        idempotency tags the dead leader's in-flight dispatches used,
+        so the worker joins/replays instead of generating twice."""
+        self.events.durable = True
+        nonce = None
+        try:
+            nonce = self.store.get_meta("tag_nonce")
+        except Exception:
+            nonce = None
+        if nonce:
+            self._run_nonce = nonce
+        else:
+            self.store.set_meta("tag_nonce", self._run_nonce)
+        self._wake.set()
+
+    def on_demote(self):
+        """Deposed mid-run (a higher term exists): stop journaling
+        durably — the new leader's journal is authoritative, and a
+        divorced store's rows would fork the replica stream."""
+        self.events.durable = False
+
+    def api_replicate(self, body):
+        """Peer channel: sequenced op-log frames + the lease heartbeat
+        (term, holder, expiry) ride every POST; the ack carries our
+        applied high-water mark (see runtime/replication.py)."""
+        return self.ha.handle_replicate(body)
+
+    def api_leader(self, body):
+        """Leader discovery: either master answers with the current
+        lease holder's URL, so clients may submit anywhere and follow
+        one hop."""
+        return {"status": "success", "is_leader": self.ha.is_leader(),
+                "term": self.ha.term, "leader": self.ha.leader_url()}
+
+    def api_ha(self, body):
+        """Replication/lease introspection for the dashboard and the
+        debug bundle: role, term, op-log head, per-peer ack state."""
+        return dict({"status": "success"}, **self.ha.status())
+
+    def _not_leader(self, path: str = ""):
+        """None when this master holds the lease (mutating API calls
+        may proceed); otherwise the 307 redirect to the holder — or a
+        503 when no leader is known yet (mid-failover)."""
+        if self.ha.is_leader():
+            return None
+        url = self.ha.leader_url()
+        if url:
+            return 307, {"status": "redirect", "leader": url,
+                         "message": "this master is a standby; "
+                                    "re-submit to the lease holder"}, \
+                   {"Location": url + path}
+        return 503, {"status": "error",
+                     "message": "standby master with no known leader "
+                                "yet (failover in progress)"}
 
     # ---- worker RPC --------------------------------------------------
 
@@ -434,9 +573,33 @@ class Master:
     def _headers(self):
         h = ({"Authorization": f"Bearer {self._worker_auth}"}
              if self._worker_auth else {})
+        if self.ha.enabled:
+            # lease fencing (docs/robustness.md "Replicated control
+            # plane"): every RPC names the dispatching master's (nonce,
+            # term); workers 409 any term older than the newest they
+            # have seen, so a paused-then-revived old leader can never
+            # double-dispatch. Solo masters send nothing — a worker
+            # never fences an un-termed fleet.
+            h["X-DLI-Master-Nonce"] = self.ha.node_nonce
+            h["X-DLI-Master-Term"] = str(self.ha.term)
         # propagate the active trace onto every worker call, so the
         # worker's server span joins this request's timeline
         return trace.inject(h)
+
+    def _check_fence(self, r, node=None):
+        """A 409 carrying ``X-DLI-Stale-Term`` means a worker fenced us:
+        a newer term holds the lease. Step down immediately (journaling
+        the rejection) and raise so the dispatch tail writes nothing."""
+        if r.status_code == 409 and "X-DLI-Stale-Term" in r.headers:
+            try:
+                t = int(r.headers["X-DLI-Stale-Term"])
+            except (TypeError, ValueError):
+                t = self.ha.term + 1
+            self.ha.observe_stale(
+                t, node_id=(node or {}).get("id"))
+            raise _StaleTermError(
+                f"worker fenced dispatch: current term is {t}, "
+                f"ours was stale")
 
     def _rpc_fault(self, path):
         """Client-side fault point ``rpc:<path>`` (utils/faults.py): lets
@@ -540,10 +703,12 @@ class Master:
             r = http.get(url, headers=self._headers(), timeout=to,
                          stream=stream)
             self.metrics.inc("master_rpc_conns_created")
+            self._check_fence(r, node)
             return r
         r = sess.get(url, headers=self._headers(), timeout=to,
                      stream=stream)
         self._count_conn_reuse(sess)
+        self._check_fence(r, node)
         return r
 
     def _worker_post(self, node, path, body, timeout, stream=False):
@@ -555,10 +720,12 @@ class Master:
             r = http.post(url, json=body, headers=self._headers(),
                           timeout=to, stream=stream)
             self.metrics.inc("master_rpc_conns_created")
+            self._check_fence(r, node)
             return r
         r = sess.post(url, json=body, headers=self._headers(), timeout=to,
                       stream=stream)
         self._count_conn_reuse(sess)
+        self._check_fence(r, node)
         return r
 
     # ---- node API ----------------------------------------------------
@@ -566,6 +733,9 @@ class Master:
     def api_add_node(self, body):
         """≙ add_node (reference views.py:111-165): reachability-gate then
         register."""
+        nl = self._not_leader("/api/nodes/add")
+        if nl:
+            return nl
         name = body.get("name")
         host = body.get("host")
         port = int(body.get("port", 8100))
@@ -606,6 +776,9 @@ class Master:
 
     def api_remove_node(self, body, node_id):
         """≙ remove_node (views.py:167-221): best-effort unload then delete."""
+        nl = self._not_leader(f"/api/nodes/remove/{node_id}")
+        if nl:
+            return nl
         node = self.store.get_node(int(node_id))
         if not node:
             return 404, {"status": "error", "message": "no such node"}
@@ -686,6 +859,9 @@ class Master:
         """The shard_model CLI as an API (reference shard_model.py:16-115):
         produce a placement plan instead of weight files."""
         from distributed_llm_inferencing_tpu.parallel.plan import make_plan
+        nl = self._not_leader("/api/plans/create")
+        if nl:
+            return nl
         try:
             plan = make_plan(body["model_name"], body.get("mesh", {"tp": 1}),
                              max_seq=int(body.get("max_seq", 2048)),
@@ -701,6 +877,9 @@ class Master:
     def api_deploy_plan(self, body, plan_id):
         """Push a plan to a worker via /load_shard — the call the reference
         defined but never made (SURVEY.md §3.2)."""
+        nl = self._not_leader(f"/api/plans/deploy/{plan_id}")
+        if nl:
+            return nl
         plans = [p for p in self.store.list_plans() if p["id"] == int(plan_id)]
         if not plans:
             return 404, {"status": "error", "message": "no such plan"}
@@ -719,6 +898,9 @@ class Master:
 
     def api_load_model(self, body):
         """Explicit model pre-load on a chosen or scheduled node."""
+        nl = self._not_leader("/api/models/load")
+        if nl:
+            return nl
         node = (self.store.get_node(int(body["node_id"]))
                 if body.get("node_id") else self._pick_node(model=None))
         if node is None:
@@ -730,7 +912,12 @@ class Master:
     # ---- inference API -----------------------------------------------
 
     def api_submit(self, body):
-        """≙ submit_inference (views.py:223-258): enqueue + wake dispatcher."""
+        """≙ submit_inference (views.py:223-258): enqueue + wake dispatcher.
+        On a standby: a thin 307 to the lease holder (GET /api/leader
+        names it) — either master is a valid entry point."""
+        nl = self._not_leader("/api/inference/submit")
+        if nl:
+            return nl
         model = body.get("model_name")
         prompt = body.get("prompt")
         if not model or prompt is None:
@@ -745,9 +932,39 @@ class Master:
             max_new, max_length = None, int(body["max_length"])
         else:
             max_new, max_length = 100, None
+        # client-supplied submit idempotency (docs/robustness.md
+        # "Replicated control plane"): a retried submit whose ack was
+        # lost — the HA leader died between committing the row and
+        # answering, or the connection broke — returns the EXISTING
+        # row instead of enqueueing a duplicate that would generate
+        # twice. The store-side dedup inside submit_request closes the
+        # concurrent-retry race; this fast path just lets the response
+        # say so.
+        ctag = body.get("client_tag")
+        ctag = str(ctag) if ctag else None
+        if ctag:
+            existing = self.store.find_client_tag(ctag)
+            if existing is not None:
+                self.metrics.inc("requests_submit_deduped")
+                return {"status": "success", "request_id": existing,
+                        "deduped": True}
         req_id = self.store.submit_request(
             model, prompt, max_new, body.get("sampling"),
-            max_length=max_length)
+            max_length=max_length, client_tag=ctag)
+        # HA durability barrier (DLI_HA_REPL_BARRIER): an acked submit
+        # survives the leader's death — the row is on a standby before
+        # the client sees the request id. Bounded wait; no-op when the
+        # barrier (or HA) is off. A barrier that failed because WE were
+        # deposed in the window is the one case an ack would be silent
+        # loss (the row lives only in a diverged store the new leader
+        # overwrites): 503 so the client retries against the current
+        # leader — client_tag makes the retry exactly-once.
+        if not self.ha.repl_barrier() and not self.ha.is_leader():
+            return 503, {"status": "error",
+                         "message": "leadership lost during submit; "
+                                    "retry against the current leader "
+                                    "(a client_tag makes the retry "
+                                    "safe)"}
         # remember the submit span so the dispatcher thread can parent the
         # execution spans to this HTTP request's trace
         ctx = trace.current()
@@ -774,6 +991,9 @@ class Master:
         (its failures were terminal and its generations uncancellable,
         SURVEY.md §5.3). In-flight: relay to the worker's /cancel (frees
         the batcher slot); pending: fail it before any node picks it up."""
+        nl = self._not_leader(f"/api/inference/cancel/{req_id}")
+        if nl:
+            return nl
         req_id = int(req_id)
         r = self.store.get_request(req_id)
         if not r:
@@ -1195,8 +1415,10 @@ class Master:
                 continue
             self.tsdb.record("master", k, v, kind="gauge", t=now)
         # TSDB durability: periodic ring snapshot into the store's meta
-        # table (restored at the next master start)
-        if (self._tsdb_snapshot_s > 0
+        # table (restored at the next master start). Leader-only: a
+        # standby's store is a replica it must not write, and its own
+        # rings rebuild from scrapes after a restart anyway.
+        if (self._tsdb_snapshot_s > 0 and self.ha.is_leader()
                 and now - self._tsdb_last_snap >= self._tsdb_snapshot_s):
             self._tsdb_last_snap = now
             self._snapshot_tsdb()
@@ -1219,8 +1441,12 @@ class Master:
 
     def _snapshot_tsdb(self) -> None:
         try:
+            # replicate=False: the multi-MB ring dump is this process's
+            # private durability, not control-plane state — shipping it
+            # per cycle would starve the HA op stream
             self.store.set_meta("tsdb_snapshot",
-                                json.dumps(self.tsdb.dump()))
+                                json.dumps(self.tsdb.dump()),
+                                replicate=False)
         except Exception as e:
             # durability is best-effort on a failing disk; the in-memory
             # rings keep serving and the next cycle retries
@@ -1678,6 +1904,17 @@ class Master:
         the ``attempt`` field keeps the records distinguishable (the
         terminal lifecycle entry names the node that actually finished
         the stream)."""
+        # persist the dispatch destination on the row before the RPC
+        # leaves (replicated): a lease takeover's re-dispatch of this
+        # claim pins back to the node holding the in-flight generation
+        # and joins/replays instead of re-running it on a peer. With
+        # the HA durability barrier armed the write waits for a standby
+        # ack, so there is NO kill point where a worker generates a
+        # request whose location the standby does not know — the chaos
+        # gate's exactly-one-execution accounting depends on it.
+        self.store.note_dispatch_node(
+            req["id"], node["id"],
+            barrier=self.ha.enabled and self.ha.barrier_enabled)
         if isinstance(req.get("resume"), dict) and req["resume"]:
             ctx = self._trace_ctx.get(req["id"])
             events.emit("migrate-resume", request_id=req["id"],
@@ -1716,18 +1953,25 @@ class Master:
                                   "failed: %r", e)
                 threading.Thread(target=_cancel, daemon=True,
                                  name="cancel-orphan").start()
-        # barrier=False: the commit still gates client visibility (reads
-        # see only committed state); not blocking here keeps the batch
-        # demultiplexer reading result lines instead of waiting out a
-        # flush per sub-request. The cost-ledger record rides the same
-        # UPDATE, so the row and its ledger commit atomically.
+        # barrier=False (solo): the commit still gates client
+        # visibility (reads see only committed state); not blocking
+        # here keeps the batch demultiplexer reading result lines
+        # instead of waiting out a flush per sub-request. With the HA
+        # durability barrier armed the terminal verdict additionally
+        # waits for a standby ack before this attempt resolves —
+        # failover never loses an acked verdict (bounded wait; a dead
+        # peer degrades loudly, runtime/replication.py). The
+        # cost-ledger record rides the same UPDATE, so the row and its
+        # ledger commit atomically.
         cost = data.get("cost")
         if not isinstance(cost, dict):
             cost = None
         self.store.mark_completed(
             req["id"], data.get("result", ""), nid,
             data.get("execution_time", 0.0),
-            data.get("tokens_per_s", 0.0), barrier=False, cost=cost)
+            data.get("tokens_per_s", 0.0),
+            barrier=self.ha.enabled and self.ha.barrier_enabled,
+            cost=cost)
         self.metrics.inc("requests_completed")
         self._note_cost(req, cost, ttft_ms=data.get("ttft_ms"))
         if data.get("idempotent"):
@@ -1828,6 +2072,14 @@ class Master:
         (one socket failure is one fault event, not N). ``nodes``
         optionally supplies the caller's active-node snapshot so a
         batch-wide fault resolves N subs with one store query."""
+        if isinstance(e, _StaleTermError):
+            # the lease moved mid-dispatch: the CURRENT leader owns
+            # this request's lifecycle (it recovered/re-claimed the row
+            # at takeover). Any write from us — requeue, terminal,
+            # strike — would be a stale-term mutation of state we no
+            # longer own; observe_stale already stepped us down.
+            self.metrics.inc("requests_fenced")
+            return
         nid = node["id"]
         log.warning("request %d failed on node %d: %s", req["id"], nid, e)
         self.metrics.inc("requests_errored")
@@ -1878,7 +2130,9 @@ class Master:
                         delay_s=round(delay, 2))
             self._wake.set()
         else:
-            self.store.mark_failed(req["id"], str(e), barrier=False)
+            self.store.mark_failed(
+                req["id"], str(e),
+                barrier=self.ha.enabled and self.ha.barrier_enabled)
             self._note_slo_miss(req)
             self._trace_done(req["id"])
             if is_timeout:
@@ -1912,8 +2166,12 @@ class Master:
         """Terminal user-error rejection (4xx except 408), identical on
         the single and batched paths: no strike, no retry, no requeue.
         barrier=False for the same reason as _complete_request — client
-        reads only see committed state, so the commit gates visibility."""
-        self.store.mark_failed(req["id"], msg, barrier=False)
+        reads only see committed state, so the commit gates visibility
+        (and the HA barrier, when armed, holds the verdict for a
+        standby ack like every other terminal write)."""
+        self.store.mark_failed(
+            req["id"], msg,
+            barrier=self.ha.enabled and self.ha.barrier_enabled)
         self.metrics.inc("requests_rejected")
         # a user-error rejection is NOT an SLO miss (4xx doesn't burn
         # the service's error budget) — but its trace is still worth
@@ -2216,7 +2474,8 @@ class Master:
                                http.exceptions.ChunkedEncodingError))
                     and not is_timeout):
                 self._purge_session(node)
-            if not (is_timeout or unavailable):
+            if not (is_timeout or unavailable
+                    or isinstance(e, _StaleTermError)):
                 self._node_failure(node)     # once per RPC fault
             # one snapshot for every unanswered sub: their zero-delay
             # failover checks are identical, N queries would hammer the
@@ -2529,7 +2788,9 @@ class Master:
         failed sweep costs one interval."""
         while not self._stop.is_set():
             try:
-                self._rebalance_sweep()
+                if self.ha.is_leader():
+                    # only the lease holder migrates/flips the fleet
+                    self._rebalance_sweep()
             except Exception as e:
                 log.debug("rebalance sweep failed: %s", e)
             self._stop.wait(self._rebalance_interval)
@@ -2785,6 +3046,13 @@ class Master:
         the one-thread-per-blocking-HTTP-call shape (and the reference's
         thread-per-request master before it) is gone."""
         while not self._stop.is_set():
+            if not self.ha.is_leader():
+                # standby: only the lease holder schedules/dispatches —
+                # claiming here would mutate the replica out from under
+                # the leader's op stream. A takeover sets _wake.
+                self._wake.wait(timeout=0.5)
+                self._wake.clear()
+                continue
             reqs = self.store.claim_next_pending_many(self.dispatch_batch)
             if not reqs:
                 self._wake.wait(timeout=0.5)
@@ -2832,6 +3100,12 @@ class Master:
         the breaker state machine's recovery edge — open + reachable ->
         half_open; real request traffic closes it from there — and the
         worker-declared draining flag."""
+        # A standby sweeps READ-ONLY: probes keep its in-memory runtime
+        # view (_note_runtime) warm so a takeover dispatches sensibly
+        # from the first wave, but node rows, breaker transitions and
+        # journal events belong to the lease holder — a replica writing
+        # them would fork the replicated op stream.
+        write = self.ha.is_leader()
         nodes = self.store.list_nodes()
         by_state = {"closed": 0, "half_open": 0, "open": 0}
         draining_n = 0
@@ -2850,34 +3124,40 @@ class Master:
                 # drop them so its comeback probe dials fresh instead of
                 # failing through the stale pool
                 self._purge_session(n)
-                self._node_failure(n)
-                state = ((self.store.get_node(n["id"]) or n)
-                         .get("breaker_state") or "closed")
+                if write:
+                    self._node_failure(n)
+                    state = ((self.store.get_node(n["id"]) or n)
+                             .get("breaker_state") or "closed")
             else:
                 draining = 1 if info.get("status") == "draining" else 0
-                if draining != (1 if n.get("draining") else 0):
-                    # worker-declared drain state changed: journal the
-                    # transition (this is what explains the burst of
-                    # live migrations the rebalancer fires next sweep)
-                    events.emit("node-drain", node_id=n["id"],
-                                draining=bool(draining))
-                fields = {"info": info, "last_heartbeat": time.time(),
-                          "draining": draining}
                 # refresh the queue-aware scheduler's per-node view
                 # (batcher queue depth + free KV blocks ride /health)
                 self._note_runtime(n["id"], info)
-                if state == "open":
-                    # the fault cleared: schedulable again, but only as
-                    # a probe until a real request succeeds
-                    state = "half_open"
-                    fields.update(breaker_state="half_open", is_active=1)
-                    self.metrics.inc("breaker_half_opened")
-                    log.info("node %d breaker HALF-OPEN "
-                             "(health probe succeeded)", n["id"])
-                    events.emit("breaker-half-open", node_id=n["id"])
-                elif state == "closed":
-                    fields.update(is_active=1, consecutive_failures=0)
-                self.store.update_node(n["id"], **fields)
+                if write:
+                    if draining != (1 if n.get("draining") else 0):
+                        # worker-declared drain state changed: journal
+                        # the transition (this is what explains the
+                        # burst of live migrations the rebalancer fires
+                        # next sweep)
+                        events.emit("node-drain", node_id=n["id"],
+                                    draining=bool(draining))
+                    fields = {"info": info,
+                              "last_heartbeat": time.time(),
+                              "draining": draining}
+                    if state == "open":
+                        # the fault cleared: schedulable again, but
+                        # only as a probe until a real request succeeds
+                        state = "half_open"
+                        fields.update(breaker_state="half_open",
+                                      is_active=1)
+                        self.metrics.inc("breaker_half_opened")
+                        log.info("node %d breaker HALF-OPEN "
+                                 "(health probe succeeded)", n["id"])
+                        events.emit("breaker-half-open", node_id=n["id"])
+                    elif state == "closed":
+                        fields.update(is_active=1,
+                                      consecutive_failures=0)
+                    self.store.update_node(n["id"], **fields)
                 draining_n += draining
             by_state[state] = by_state.get(state, 0) + 1
         for s, count in by_state.items():
@@ -2905,21 +3185,36 @@ class Master:
                                  daemon=True, name="rebalance")
             t.start()
             self._threads.append(t)
+        # HA shipper/lease-monitor thread (no-op without peers)
+        self.ha.start()
 
     def serve(self, host="0.0.0.0", port=8000, background=False):
         self.start_background()
         log.info("master on %s:%d", host, port)
-        return self.service.serve(host, port, background=background)
+        # the URL peers redirect clients to and heartbeat frames
+        # advertise (port-0 callers pass ha_self_url explicitly).
+        # Never a wildcard bind address: "http://0.0.0.0:8000" is the
+        # CLIENT'S own host — a multi-host fleet sets DLI_HA_ADVERTISE
+        # (or ha_self_url) to the reachable base URL instead.
+        advertisable = host not in ("0.0.0.0", "::", "")
+        if port and advertisable:
+            self.ha.set_self_url(f"http://{host}:{port}")
+        srv = self.service.serve(host, port, background=background)
+        if background and srv is not None and advertisable:
+            self.ha.set_self_url(
+                f"http://{host}:{srv.server_address[1]}")
+        return srv
 
     def stop(self):
         self._stop.set()
         self._wake.set()
+        self.ha.stop()
         self.service.shutdown()
         # final TSDB snapshot so a clean shutdown loses zero history
         # (the periodic one may be most of an interval stale), then
         # uninstall the journal — but only if it is still the installed
         # one (benches run several masters in one process)
-        if self._tsdb_snapshot_s > 0:
+        if self._tsdb_snapshot_s > 0 and self.ha.is_leader():
             self._snapshot_tsdb()
         events.clear_journal(self.events)
         # flush the write-behind buffer (any parked requeues commit) and
@@ -2984,8 +3279,15 @@ def main(argv=None):
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--port", type=int, default=8000)
     ap.add_argument("--db", default="master.sqlite3")
+    ap.add_argument("--ha-leader", action="store_true",
+                    help="bootstrap this master as the lease holder "
+                         "(peers via DLI_HA_PEERS; without the flag an "
+                         "HA master boots as a standby and takes over "
+                         "only when the lease expires)")
     args = ap.parse_args(argv)
-    Master(args.db).serve(args.host, args.port)
+    Master(args.db,
+           ha_leader=True if args.ha_leader else None).serve(
+        args.host, args.port)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,823 @@
+"""Replicated control plane: leader-leased master pair over op-log
+replication (docs/robustness.md "Replicated control plane").
+
+One master process fronting one SQLite file was the fleet's last single
+point of failure (ROADMAP item 4; FailSafe, arxiv 2511.14116, applied
+to the control plane itself): its death orphaned every request row,
+health probe, breaker transition and rebalance decision. This module
+removes it with three pieces:
+
+1. **Op-log replication through the Store waist.** The leader's
+   :class:`~runtime.state.Store` hands every committed write batch to
+   :meth:`HAController.on_ops`; the shipper assigns monotonically
+   increasing sequence numbers and POSTs sequenced frames to every peer
+   over pooled keep-alive HTTP (``POST /replicate``). A standby applies
+   frames strictly in order into its own store (``Store.apply_ops`` —
+   the leader's original WHERE-guarded SQL, so a replayed frame can
+   never resurrect a terminal row) and acks its high-water mark; a
+   fresh or diverged peer gets a full table snapshot first
+   (``Store.dump_tables``), AUTOINCREMENT counters included, so the
+   stream that follows replays onto identical rowids.
+
+2. **Leader lease + automatic failover.** The lease — (term, holder
+   nonce, expiry) — is heartbeated through the same ``/replicate``
+   channel (empty frames when there is nothing to ship). Only the
+   lease holder schedules/dispatches; when a standby's lease deadline
+   expires it takes over at term+1, runs the crash-recovery requeue,
+   and resumes dispatch. Standby takeover order is rank-deterministic
+   (sorted identity) so N>2 fleets don't race the lease.
+
+3. **Fencing.** Workers validate the dispatching master's (nonce,
+   term) on every state-changing RPC and 409 stale terms
+   (runtime/worker.py), and peers reject replication frames from a
+   stale or competing term — a paused-then-revived old leader can
+   neither double-dispatch nor write into the authoritative store.
+   Split-brain guard: at equal terms the first holder a node saw wins;
+   everyone else must take a HIGHER term to act.
+
+Durability barrier: with ``DLI_HA_REPL_BARRIER=1`` client-visible
+terminal statuses (and submit acks) additionally wait for a standby
+ack — bounded by two lease intervals, after which the write degrades
+to leader-only durability with a journaled ``replication-lag`` event
+instead of ever hanging a dispatch thread.
+
+Knobs (utils/knobs.py, generated table in docs/serving.md):
+``DLI_HA_PEERS``, ``DLI_HA_LEASE_MS``, ``DLI_HA_REPL_BARRIER``,
+``DLI_HA_REPL_LAG_WARN_MS``.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import json
+import logging
+import os
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional, Tuple
+
+from distributed_llm_inferencing_tpu.runtime import events
+from distributed_llm_inferencing_tpu.utils import locks
+
+log = logging.getLogger("dli_tpu.replication")
+
+# Comma list of the OTHER masters' base URLs (http://host:port). Unset
+# = solo master, HA entirely off (byte-for-byte the old behavior).
+HA_PEERS = [u.strip() for u in
+            os.environ.get("DLI_HA_PEERS", "").split(",") if u.strip()]
+# Lease duration: the leader heartbeats every LEASE/3; a standby whose
+# lease deadline (last heartbeat + LEASE) expires takes over.
+HA_LEASE_MS = float(os.environ.get("DLI_HA_LEASE_MS", 3000))
+# Durability barrier: terminal statuses / submit acks wait for a
+# standby ack (bounded at 2 lease intervals, degrading loudly).
+HA_REPL_BARRIER = os.environ.get("DLI_HA_REPL_BARRIER", "0") not in (
+    "0", "false", "")
+# Sustained replication lag above this (ms behind the op-log head)
+# journals a replication-lag warning even without a barrier wait.
+HA_REPL_LAG_WARN_MS = float(
+    os.environ.get("DLI_HA_REPL_LAG_WARN_MS", 1000))
+# The base URL peers/clients should reach THIS master at — distinct
+# from the bind address: a master bound to 0.0.0.0 must not advertise
+# "http://0.0.0.0:8000" as the redirect/heartbeat holder URL.
+HA_ADVERTISE = os.environ.get("DLI_HA_ADVERTISE", "").rstrip("/")
+
+# Ops per /replicate frame: bounds one POST's body; the shipper loops
+# until the peer is caught up.
+_FRAME_OPS = 512
+# Op-log retention: a peer further behind than this gets a snapshot.
+_OPLOG_RETAIN = 1 << 16
+
+
+class OpLog:
+    """Bounded, sequence-numbered log of committed store writes."""
+
+    def __init__(self, retain: int = _OPLOG_RETAIN):
+        self._lock = locks.lock("repl.oplog")
+        self._ops: collections.deque = collections.deque()  # (seq, sql, args)
+        self._seq = 0
+        self._retain = max(1, int(retain))
+
+    def append_new(self, ops) -> int:
+        """Leader side: assign the next sequence numbers. Returns the
+        new high-water mark."""
+        with self._lock:
+            for sql, args in ops:
+                self._seq += 1
+                self._ops.append((self._seq, sql, list(args)))
+            while len(self._ops) > self._retain:
+                self._ops.popleft()
+            return self._seq
+
+    def append_at(self, entries) -> int:
+        """Standby side: advance the sequence counter past applied
+        entries. Only the NUMBERING survives a promotion — `_takeover`
+        resyncs every peer from a snapshot regardless, so storing the
+        mirrored ops would be pure per-frame memory/CPU cost that is
+        never served."""
+        with self._lock:
+            for seq, _sql, _args in entries:
+                if seq > self._seq:
+                    self._seq = seq
+            return self._seq
+
+    def since(self, seq: int, limit: int = _FRAME_OPS
+              ) -> Optional[List[Tuple[int, str, list]]]:
+        """Entries with sequence > ``seq`` (oldest first, capped), or
+        None when ``seq`` predates retention — the caller must snapshot
+        instead."""
+        with self._lock:
+            if seq < 0:
+                return None
+            if seq >= self._seq or not self._ops:
+                # caught up (the steady-state hot path — every shipper
+                # wake while ANY peer lags lands here for the others)
+                return []
+            if seq < self._ops[0][0] - 1:
+                return None
+            # sequence numbers are consecutive: slice by offset rather
+            # than scanning the whole retention window per frame
+            start = seq - self._ops[0][0] + 1
+            return list(itertools.islice(self._ops, start,
+                                         start + limit))
+
+    def seq(self) -> int:
+        with self._lock:
+            return self._seq
+
+    def reset_to(self, seq: int):
+        """After loading a snapshot taken at ``seq``: the log restarts
+        there (older entries are inside the snapshot)."""
+        with self._lock:
+            self._ops.clear()
+            self._seq = int(seq)
+
+
+class _Peer:
+    __slots__ = ("url", "session", "cursor", "acked", "synced",
+                 "last_ack_at", "last_error")
+
+    def __init__(self, url: str):
+        self.url = url.rstrip("/")
+        self.session = None          # requests.Session, built lazily
+        self.cursor = 0              # last seq shipped
+        self.acked = 0               # last seq the peer confirmed applied
+        self.synced = False          # has this peer received a snapshot?
+        self.last_ack_at = 0.0
+        self.last_error: Optional[str] = None
+
+
+class HAController:
+    """One master's half of the replicated control plane: lease state,
+    the op-log shipper/heartbeat thread, the ``/replicate`` apply path,
+    and the standby takeover monitor. With no peers configured it
+    degenerates to a permanently-leading no-op."""
+
+    def __init__(self, master, *, peers: Optional[list] = None,
+                 lease_ms: Optional[float] = None,
+                 repl_barrier: Optional[bool] = None,
+                 lag_warn_ms: Optional[float] = None,
+                 leader: Optional[bool] = None,
+                 self_url: Optional[str] = None):
+        self.master = master
+        self.store = master.store
+        if peers is None:
+            peers = HA_PEERS
+        elif isinstance(peers, str):
+            peers = [u.strip() for u in peers.split(",") if u.strip()]
+        self.enabled = bool(peers)
+        self.lease_s = (HA_LEASE_MS if lease_ms is None
+                        else float(lease_ms)) / 1e3
+        self.barrier_enabled = (HA_REPL_BARRIER if repl_barrier is None
+                                else bool(repl_barrier))
+        self.lag_warn_s = (HA_REPL_LAG_WARN_MS if lag_warn_ms is None
+                           else float(lag_warn_ms)) / 1e3
+        self.node_nonce = uuid.uuid4().hex[:8]
+        self.self_url = ((self_url or "").rstrip("/") or HA_ADVERTISE
+                         or None)
+        self.oplog = OpLog()
+        self._peers: Dict[str, _Peer] = {
+            u.rstrip("/"): _Peer(u) for u in peers}
+        # lease + apply state share one lock; the ack condition wakes
+        # barrier waiters when the shipper records a peer ack
+        self._state_lock = locks.lock("repl.state")
+        # one frame applies at a time: the leader's POST timeout can
+        # re-deliver a frame while the first apply is still running —
+        # the watermark check and the apply must be one critical
+        # section or non-idempotent ops (attempts+1, INSERTs) land
+        # twice and the replica silently diverges
+        self._apply_lock = locks.lock("repl.apply")
+        self._ack_cv = locks.condition("repl.ack")
+        # standby: last applied leader seq. Boots at -1 — DIVERGED —
+        # not 0: a restarted standby holds none of the pre-op-log
+        # state, and if its first resync ack said 0 the leader (whose
+        # peer.synced is still True from the previous incarnation)
+        # would happily rewind and replay from seq 1 onto the fresh
+        # store instead of re-snapshotting it. -1 is the "snapshot me
+        # first" sentinel the shipper already understands.
+        self._applied = -1
+        self._holder: Optional[str] = None
+        self._leader_url: Optional[str] = None
+        self._lease_deadline = 0.0
+        self._lagging = False        # replication-lag event hysteresis
+        self._behind_since = 0.0     # first sweep the best peer lagged
+        # barrier circuit: a timed-out barrier wait disables further
+        # waits until this deadline (or until a peer catches back up
+        # to the op-log head) so one dead peer costs one bounded wait,
+        # not one per write
+        self._barrier_down_until = 0.0
+        self._ship_wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        try:
+            self.term = int(self.store.get_meta("ha_term") or 0)
+        except Exception:
+            self.term = 0
+        if not self.enabled:
+            # solo master: permanently the leader, zero overhead
+            self.leader = True
+            return
+        self.leader = bool(leader)
+        if self.leader:
+            # bootstrap leader asserts a fresh term — and PERSISTS it,
+            # so a restart on the same store comes back ABOVE any term
+            # it held before the crash and a standby that meanwhile
+            # took over is never usurped at an equal term (the
+            # split-brain guard rejects equal-term competitors; higher
+            # terms win cleanly)
+            self.term += 1
+            self._holder = self.node_nonce
+            self._leader_url = self.self_url
+            try:
+                self.store.set_meta("ha_term", str(self.term))
+            except Exception as e:
+                log.warning("could not persist bootstrap term: %r", e)
+        else:
+            # standby boot grace: give an existing leader rank+2 lease
+            # intervals to reach us before the takeover monitor fires
+            self._lease_deadline = time.time() + self.lease_s * (
+                2 + self._rank())
+
+    # ---- identity -----------------------------------------------------
+
+    def _rank(self) -> int:
+        """Deterministic takeover order across standbys: position of
+        our identity in the sorted peer set. Rank 0 takes over first;
+        each higher rank waits one extra lease interval, so N>2 fleets
+        do not race the lease."""
+        me = self.self_url or self.node_nonce
+        return sorted(self._peers.keys() | {me}).index(me)
+
+    def set_self_url(self, url: str):
+        if url and self.self_url is None:
+            self.self_url = url.rstrip("/")
+            if self.leader:
+                self._leader_url = self.self_url
+
+    def is_leader(self) -> bool:
+        return self.leader
+
+    def leader_url(self) -> Optional[str]:
+        return self._leader_url if not self.leader else self.self_url
+
+    # ---- op-log hook (Store -> shipper) -------------------------------
+
+    def on_ops(self, ops) -> None:
+        """Store op hook: committed writes enter the op-log and wake
+        the shipper. Runs under the store lock — cheap append only."""
+        if not self.enabled or not self.leader:
+            return
+        self.oplog.append_new(ops)
+        self._ship_wake.set()
+
+    # ---- durability barrier -------------------------------------------
+
+    def repl_barrier(self) -> bool:
+        """Store barrier hook (leader side): wait until at least one
+        standby acked the current op-log head. Bounded at TWO lease
+        intervals — a wedged peer degrades this write to leader-only
+        durability with a journaled ``replication-lag`` event, it never
+        hangs the dispatch thread (the satellite fix for the unbounded
+        barrier wait)."""
+        if not (self.enabled and self.barrier_enabled):
+            return True
+        if not self.leader:
+            # deposed between the commit and the barrier: the write
+            # exists only in a diverged store the new leader's snapshot
+            # will overwrite. Report the barrier FAILED — acking it as
+            # durable would be silent loss (the caller decides: a
+            # submit 503s so the client retries against the current
+            # leader; a dispatch-tail write is already fenced).
+            return False
+        if time.time() < self._barrier_down_until:
+            # degraded mode (journaled when the wait that armed it
+            # timed out): the peer is effectively dead — paying the
+            # two-lease timeout on EVERY write would wedge throughput
+            # on exactly the failover the barrier exists for. Writes
+            # degrade to leader-only durability immediately; the
+            # barrier re-probes after a cool-down, and a peer ack that
+            # catches back up to the op-log head re-arms it at once.
+            return False
+        target = self.oplog.seq()
+        if target == 0:
+            return True
+        self._ship_wake.set()
+        deadline = time.time() + 2 * self.lease_s
+        with self._ack_cv:
+            while True:
+                if any(p.acked >= target for p in self._peers.values()):
+                    return True
+                if not self.leader:
+                    # deposed while waiting: the ack will never come
+                    # from the new regime — fail NOW (the known-at-
+                    # step_down condition), don't burn the full window
+                    # per blocked thread or arm the degrade circuit
+                    # for a lag that isn't one
+                    return False
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    break
+                self._ack_cv.wait(timeout=min(remaining, 0.05))
+        now = time.time()
+        self._barrier_down_until = now + 2 * self.lease_s
+        self.master.metrics.inc("repl_barrier_timeouts")
+        self._note_lag(now, forced=True)
+        return False
+
+    def _note_lag(self, now: float, forced: bool = False) -> None:
+        """replication-lag journaling with hysteresis: one event per
+        entering-lag edge (or per barrier timeout), one per recovery.
+        Lag = how long the best peer has CONTINUOUSLY been behind the
+        op-log head — not the staleness of its last ack: a standby that
+        acks every frame promptly while applying at half the write rate
+        is falling ever further behind and must still warn."""
+        head = self.oplog.seq()
+        best = max((p.acked for p in self._peers.values()), default=0)
+        behind = head - best
+        if behind > 0:
+            if not self._behind_since:
+                self._behind_since = now
+        else:
+            self._behind_since = 0.0
+        lag_s = (now - self._behind_since) if self._behind_since else 0.0
+        lagging = forced or (behind > 0 and lag_s > self.lag_warn_s)
+        if lagging and not self._lagging:
+            self._lagging = True
+            events.emit("replication-lag", ops_behind=behind,
+                        lag_ms=round(lag_s * 1e3, 1),
+                        acked_seq=best, log_seq=head,
+                        barrier_timeout=forced or None)
+        elif not lagging and self._lagging and behind == 0:
+            self._lagging = False
+            events.emit("replication-lag", ops_behind=0, acked_seq=best,
+                        log_seq=head, severity="info")
+
+    # ---- shipper / lease loop -----------------------------------------
+
+    def start(self):
+        if not self.enabled or self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="ha-repl")
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        self._ship_wake.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=2 * self.lease_s + 1)
+        for p in self._peers.values():
+            if p.session is not None:
+                try:
+                    p.session.close()
+                except Exception as e:
+                    # the pool being closed is usually already dead
+                    log.debug("peer session close failed: %r", e)
+
+    def _loop(self):
+        """Leader: ship op frames / heartbeats every lease/3 (sooner
+        when writes land). Standby: watch the lease deadline and take
+        over when it expires. The loop survives anything — a failed
+        sweep costs one interval."""
+        interval = max(0.02, self.lease_s / 3.0)
+        while not self._stop.is_set():
+            try:
+                if self.leader:
+                    self._ship_all()
+            except Exception as e:
+                log.debug("replication sweep failed: %r", e)
+            try:
+                if not self.leader and time.time() > self._lease_deadline:
+                    self._takeover()
+            except Exception as e:
+                log.warning("lease takeover attempt failed: %r", e)
+            self._ship_wake.wait(timeout=interval)
+            self._ship_wake.clear()
+
+    def _session(self, peer: _Peer):
+        if peer.session is None:
+            import requests as http
+            s = http.Session()
+            adapter = http.adapters.HTTPAdapter(pool_connections=1,
+                                                pool_maxsize=2)
+            s.mount("http://", adapter)
+            s.mount("https://", adapter)
+            peer.session = s
+        return peer.session
+
+    def _headers(self) -> dict:
+        key = os.environ.get("DLI_MASTER_AUTH_KEY")
+        return {"Authorization": f"Bearer {key}"} if key else {}
+
+    def _post(self, peer: _Peer, body: dict):
+        # snapshot frames carry the whole store and the standby applies
+        # them in one transaction: a lease-scale read timeout would
+        # abort the resync every sweep and livelock the peer at
+        # synced=False — give snapshots their own generous budget
+        read = (max(10 * self.lease_s, 30.0) if "snapshot" in body
+                else max(self.lease_s, 2.0))
+        to = (min(2.0, self.lease_s), read)
+        return self._session(peer).post(
+            f"{peer.url}/replicate", json=body, headers=self._headers(),
+            timeout=to)
+
+    def _frame(self, peer: _Peer) -> dict:
+        """The next frame for ``peer``: a snapshot on first contact or
+        after divergence, else the ops past its cursor (empty = pure
+        heartbeat). The cursor advances from the peer's ACK, not from
+        what was shipped."""
+        base = {"term": self.term, "holder": self.node_nonce,
+                "holder_url": self.self_url,
+                "lease_ms": self.lease_s * 1e3}
+        if not peer.synced:
+            # snapshot and op-log head read atomically under the store
+            # lock (the op hook appends there): a write committing
+            # between the two would be labeled into the gap and never
+            # reach the standby. Known cost: the dump holds the store
+            # lock for the walk, stalling writes for its duration —
+            # acceptable because snapshots happen only at first
+            # contact / divergence, never in the steady state.
+            snap, seq = self.store.snapshot_with(self.oplog.seq)
+            return dict(base, snapshot=snap, seq_start=seq + 1, ops=[])
+        entries = self.oplog.since(peer.cursor)
+        if entries is None:
+            # fell behind retention: back to a snapshot
+            peer.synced = False
+            return self._frame(peer)
+        seq_start = entries[0][0] if entries else peer.cursor + 1
+        return dict(base, seq_start=seq_start,
+                    ops=[[sql, args] for _s, sql, args in entries])
+
+    def _ship_all(self):
+        """One replication sweep: every peer gets its frame (ops or
+        heartbeat) CONCURRENTLY — from one sequential loop, a dead
+        peer's connect timeout (up to 2s) would starve the live peers'
+        lease renewals and promote a healthy standby in N>=3 fleets."""
+        now = time.time()
+        peers = list(self._peers.values())
+        if len(peers) <= 1:
+            for peer in peers:
+                self._ship_peer(peer)
+        else:
+            def ship(p):
+                try:
+                    self._ship_peer(p)
+                except Exception as e:
+                    # inline shipping is covered by _loop's handler;
+                    # a thread must not die silently
+                    log.debug("ship to %s failed: %r", p.url, e)
+            ts = [threading.Thread(target=ship, args=(p,),
+                                   daemon=True, name=f"ha-ship-{i}")
+                  for i, p in enumerate(peers)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+        self.master.metrics.gauge(
+            "repl_lag_ops",
+            self.oplog.seq() - max((p.acked
+                                    for p in self._peers.values()),
+                                   default=0))
+        self._note_lag(now)
+
+    def _ship_peer(self, peer: _Peer):
+        while self.leader and not self._stop.is_set():
+            frame = self._frame(peer)
+            try:
+                r = self._post(peer, frame)
+            except Exception as e:
+                peer.last_error = repr(e)[:200]
+                break
+            if r.status_code == 409:
+                # the peer is at a HIGHER (or competing equal) term:
+                # we lost the lease while partitioned — stop acting.
+                # But a peer 409ing at a LOWER term is not a lease
+                # conflict (HA unconfigured on it, or a stale
+                # persisted term): deposing ourselves on its word
+                # would flap leadership forever — every takeover
+                # bumps in-flight attempts until requests are
+                # spuriously failed as poison
+                try:
+                    new_term = int(r.json().get("term") or 0)
+                except ValueError:
+                    # unparseable body: assume a real conflict
+                    new_term = self.term + 1
+                if new_term >= self.term:
+                    self.step_down(new_term, reason="peer-term")
+                    return
+                peer.last_error = f"peer 409 at stale term {new_term}"
+                break
+            if r.status_code != 200:
+                peer.last_error = f"HTTP {r.status_code}"
+                break
+            try:
+                ack = r.json()
+            except ValueError:
+                peer.last_error = "unparseable ack"
+                break
+            peer.last_error = None
+            applied = int(ack.get("applied") or 0)
+            if applied < 0:
+                # the peer declared divergence (a demoted leader's
+                # dirty store): resync it from a snapshot
+                peer.synced = False
+                continue
+            if "snapshot" in frame:
+                peer.synced = True
+            # the peer's ack is the ground truth of what it holds:
+            # ship strictly past it next frame (a "resync" ack
+            # rewinds the cursor; a clean ack advances it)
+            peer.cursor = applied
+            with self._ack_cv:
+                peer.acked = max(peer.acked, applied)
+                peer.last_ack_at = time.time()
+                if peer.acked >= self.oplog.seq():
+                    # caught back up: re-arm the durability barrier
+                    self._barrier_down_until = 0.0
+                self._ack_cv.notify_all()
+            self.master.metrics.inc("repl_frames_shipped")
+            if frame["ops"]:
+                self.master.metrics.inc("repl_ops_shipped",
+                                        len(frame["ops"]))
+            if applied >= self.oplog.seq():
+                break               # caught up; next wake ships more
+            # else: loop immediately with the next frame
+
+    # ---- standby apply path (POST /replicate) -------------------------
+
+    def handle_replicate(self, body: dict):
+        """Apply one leader frame: lease bookkeeping + in-order op
+        application. Returns the (status, payload) the HTTP handler
+        relays. 409 carries OUR term so a stale leader steps down."""
+        if not self.enabled:
+            return 409, {"status": "error", "term": self.term,
+                         "message": "HA not configured on this master"}
+        try:
+            term = int(body.get("term") or 0)
+        except (TypeError, ValueError):
+            return 400, {"status": "error", "message": "bad term"}
+        holder = str(body.get("holder") or "")
+        with self._state_lock:
+            if term < self.term or (
+                    term == self.term and self._holder
+                    and holder != self._holder):
+                # stale or competing claimant: the split-brain guard —
+                # at equal terms the first holder we saw wins; anyone
+                # else must take a HIGHER term to act
+                return 409, {"status": "stale", "term": self.term,
+                             "applied": self._applied}
+            if self.leader and (term > self.term or holder
+                                != self.node_nonce):
+                # a higher-term leader exists: we were deposed while
+                # running (pause/partition) — stop acting immediately
+                self.step_down(term, reason="replicate-frame",
+                               locked=True)
+            self.term = max(self.term, term)
+            self._holder = holder
+            url = body.get("holder_url")
+            if url:
+                self._leader_url = str(url).rstrip("/")
+            try:
+                lease_ms = float(body.get("lease_ms") or 0)
+            except (TypeError, ValueError):
+                lease_ms = 0.0
+            lease_s = lease_ms / 1e3 if lease_ms > 0 else self.lease_s
+            self._lease_deadline = time.time() + lease_s
+        snap = body.get("snapshot")
+        if isinstance(snap, dict):
+            with self._apply_lock:
+                stale = self._stale_for_apply(term, holder)
+                if stale is not None:
+                    return stale
+                try:
+                    seq = int(body.get("seq_start") or 1) - 1
+                    self.store.load_tables(snap)
+                    self.oplog.reset_to(seq)
+                    with self._state_lock:
+                        self._applied = seq
+                    self.master.metrics.inc("repl_snapshots_loaded")
+                    log.info("replication snapshot loaded at seq %d "
+                             "(term %d)", seq, term)
+                except Exception as e:
+                    log.warning("replication snapshot load failed: %r",
+                                e)
+                    return 500, {"status": "error", "applied": -1,
+                                 "term": self.term,
+                                 "message": f"snapshot load failed: {e}"}
+        ops = body.get("ops") or []
+        try:
+            seq_start = int(body.get("seq_start") or 0)
+        except (TypeError, ValueError):
+            return 400, {"status": "error", "message": "bad seq_start"}
+        if ops:
+            # one frame applies at a time: the watermark check and the
+            # apply are one critical section, so a leader-retry
+            # re-delivery racing the still-running first apply cannot
+            # double-apply non-idempotent ops (attempts+1, INSERTs)
+            with self._apply_lock:
+                # re-validate under the apply lock: the lease may have
+                # moved while this frame was in flight (our own
+                # takeover, or a higher term) — admitting the old
+                # leader's ops AFTER takeover recovery ran would flip
+                # recovered rows back to unowned 'processing' and
+                # silently strand them
+                stale = self._stale_for_apply(term, holder)
+                if stale is not None:
+                    return stale
+                with self._state_lock:
+                    applied = self._applied
+                if seq_start > applied + 1:
+                    # gap (we missed frames): ask the leader to rewind
+                    return {"status": "resync", "applied": applied,
+                            "term": self.term}
+                # drop the already-applied prefix (at-least-once
+                # delivery after a leader retry must not double-apply
+                # attempts+1)
+                skip = applied + 1 - seq_start
+                todo = ops[skip:] if skip > 0 else ops
+                if todo:
+                    try:
+                        self.store.apply_ops(todo)
+                    except Exception as e:
+                        log.warning("replicated op apply failed: %r", e)
+                        return 500, {"status": "error",
+                                     "applied": self._applied,
+                                     "term": self.term,
+                                     "message": f"apply failed: {e}"}
+                    last = seq_start + len(ops) - 1
+                    self.oplog.append_at(
+                        [(seq_start + skip + i, sql, args)
+                         for i, (sql, args) in enumerate(todo)])
+                    with self._state_lock:
+                        self._applied = max(self._applied, last)
+                    self.master.metrics.inc("repl_ops_applied",
+                                            len(todo))
+        with self._state_lock:
+            if not self.leader and term == self.term and \
+                    holder == self._holder:
+                # refresh the lease AFTER the apply too: a snapshot
+                # load can legitimately outlast the lease (its read
+                # timeout is deliberately generous), and the leader's
+                # single shipper thread was blocked on this very POST
+                # the whole time — expiring the deadline at the
+                # admission-time stamp would promote this standby the
+                # instant the apply commits, deposing a healthy leader
+                # (and then flapping forever on every resync)
+                self._lease_deadline = time.time() + lease_s
+            return {"status": "success", "applied": self._applied,
+                    "term": self.term}
+
+    def _stale_for_apply(self, term: int, holder: str):
+        """Re-check, under the apply lock, that the frame's (term,
+        holder) is still the lease this node recognizes. The admission
+        check at the top of :meth:`handle_replicate` ran under the
+        state lock and then RELEASED it — by the time the frame holds
+        the apply lock, this node may have taken over itself or
+        observed a higher-term leader. Returns the 409 response to
+        relay when stale, else None."""
+        with self._state_lock:
+            if (self.leader or term < self.term
+                    or (term == self.term and self._holder
+                        and holder != self._holder)):
+                return 409, {"status": "stale", "term": self.term,
+                             "applied": self._applied}
+        return None
+
+    # ---- takeover / step-down -----------------------------------------
+
+    def _takeover(self):
+        """Standby -> leader at term+1: assert the lease, persist the
+        term (a replicated write — the new op-log's first entry is the
+        leadership record itself), adopt the cluster tag nonce, requeue
+        everything the dead leader held in flight, and wake dispatch."""
+        # the apply lock first: an in-flight frame that already passed
+        # _stale_for_apply (a snapshot load can outlive a lease — its
+        # read timeout is deliberately generous) must COMMIT before the
+        # promotion flips `leader`, or the old leader's bytes would land
+        # on top of this takeover's recovery and strand recovered rows
+        # back in ownerless 'processing'. Frames arriving after the
+        # flip re-check _stale_for_apply under this same lock and 409.
+        with self._apply_lock, self._state_lock:
+            if self.leader:
+                return
+            if time.time() <= self._lease_deadline:
+                # a heartbeat frame renewed the lease while the monitor
+                # thread was waiting on this lock: the leader is alive
+                # after all — do NOT depose it
+                return
+            self.term += 1
+            self.leader = True
+            self._holder = self.node_nonce
+            self._leader_url = self.self_url
+            for p in self._peers.values():
+                p.synced = False
+                p.cursor = p.acked = 0
+            # our mirrored op-log numbering continues where the dead
+            # leader's stream stopped
+            self.oplog.reset_to(max(self.oplog.seq(), self._applied))
+        m = self.master
+        m.on_promote()
+        events.emit("lease-acquired", term=self.term,
+                    holder=self.node_nonce, prev_applied=self._applied)
+        self.store.set_meta("ha_term", str(self.term))
+        try:
+            n = self.store.recover_stale_processing(
+                max_attempts=m.max_attempts())
+        except Exception as e:
+            log.warning("takeover recovery failed: %r", e)
+            n = -1
+        events.emit("takeover-recovery", term=self.term, recovered=n)
+        m.metrics.inc("ha_takeovers")
+        log.warning("lease TAKEOVER: this master now leads at term %d "
+                    "(%s requests recovered)", self.term, n)
+        self._ship_wake.set()
+
+    def step_down(self, new_term: int, reason: str = "",
+                  locked: bool = False):
+        """Leader -> standby on observing a higher (or competing
+        winning) term: stop scheduling immediately, mark our store
+        diverged (the next leader resyncs us with a snapshot), and
+        journal the demotion to the in-memory ring — our durable
+        journal is no longer authoritative."""
+        if not locked:
+            with self._state_lock:
+                return self.step_down(new_term, reason, locked=True)
+        was = self.leader
+        self.leader = False
+        self.term = max(self.term, int(new_term))
+        try:
+            # a restart (even with --ha-leader) must assert ABOVE the
+            # term that deposed us, not re-contest it
+            self.store.set_meta("ha_term", str(self.term),
+                                replicate=False)
+        except Exception as e:
+            log.warning("could not persist observed term: %r", e)
+        # acked-but-unreplicated tail writes may exist: declare
+        # divergence so the new leader's first frame snapshots us
+        self._applied = -1
+        self._lease_deadline = time.time() + self.lease_s * (
+            2 + self._rank())
+        with self._ack_cv:
+            # wake barrier waiters so they observe the demotion at
+            # once instead of sleeping out their full timeout window
+            self._ack_cv.notify_all()
+        if was:
+            self.master.on_demote()
+            events.emit("lease-lost", term=self.term, reason=reason,
+                        holder=self._holder)
+            self.master.metrics.inc("ha_lease_lost")
+            log.warning("lease LOST (%s): stepping down at term %d",
+                        reason, self.term)
+
+    def observe_stale(self, worker_term: int, node_id=None):
+        """A worker 409ed our dispatch with a newer term: we lost the
+        lease while acting. Journal the rejection (to the ring — the
+        new leader's journal is the durable one) and step down."""
+        events.emit("stale-term-rejected", term=self.term,
+                    observed_term=int(worker_term), node_id=node_id)
+        self.master.metrics.inc("repl_stale_term_rejections")
+        self.step_down(int(worker_term), reason="worker-fence")
+
+    # ---- introspection (GET /api/ha) ----------------------------------
+
+    def status(self) -> dict:
+        with self._state_lock:
+            peers = [{
+                "url": p.url, "acked_seq": p.acked,
+                "synced": p.synced, "last_error": p.last_error,
+                "last_ack_age_s": (round(time.time() - p.last_ack_at, 3)
+                                   if p.last_ack_at else None),
+            } for p in self._peers.values()]
+            return {
+                "enabled": self.enabled, "is_leader": self.leader,
+                "term": self.term, "nonce": self.node_nonce,
+                "holder": self._holder,
+                "leader_url": self.leader_url(),
+                "lease_ms": self.lease_s * 1e3,
+                "barrier": self.barrier_enabled,
+                "log_seq": self.oplog.seq(),
+                "applied_seq": self._applied,
+                "peers": peers,
+            }

@@ -118,7 +118,13 @@ _MIGRATIONS = {
                  # mid-stream instead of re-prefilling (FailSafe,
                  # arxiv 2511.14116)
                  ("resume", "TEXT"),
-                 ("kv_source", "TEXT")),
+                 ("kv_source", "TEXT"),
+                 # client-supplied submit idempotency key: a submit
+                 # retry (the client's ack was lost — e.g. the leader
+                 # of an HA pair died between committing the row and
+                 # answering) dedupes onto the existing row instead of
+                 # creating a second request that would generate twice
+                 ("client_tag", "TEXT")),
 }
 
 
@@ -149,11 +155,26 @@ def _row_to_dict(cur, row):
 
 
 class Store:
+    # Tables a replication snapshot carries (runtime/replication.py):
+    # the whole persisted control-plane state, in FK-safe load order.
+    REPL_TABLES = ("nodes", "plans", "requests", "events", "meta")
+
     def __init__(self, path: str = ":memory:", *,
                  group_commit: bool = False,
                  flush_interval: Optional[float] = None,
                  on_flush: Optional[Callable[[], None]] = None):
         self._lock = locks.rlock("state.store")
+        # Replicated control plane (runtime/replication.py): when an op
+        # hook is installed, every committed write — synchronous or
+        # group-commit — is handed to it as (sql, args) pairs IN COMMIT
+        # ORDER (the hook runs under the store lock, immediately after
+        # the transaction lands), so a standby replaying the stream in
+        # order reconstructs a byte-identical store, autoincrement ids
+        # included. The replication barrier hook (leader side) runs
+        # after a barriered write's local commit and may wait for a
+        # standby ack — with a timeout, never forever.
+        self._op_hook: Optional[Callable[[list], None]] = None
+        self._repl_barrier: Optional[Callable[[], None]] = None
         self._db = sqlite3.connect(path, check_same_thread=False)
         self._db.execute("PRAGMA journal_mode=WAL")
         with self._lock, self._db:
@@ -165,6 +186,10 @@ class Store:
                     if col not in have:
                         self._db.execute(
                             f"ALTER TABLE {table} ADD COLUMN {col} {decl}")
+            # after the migrations: the index's column must exist first
+            self._db.execute(
+                "CREATE INDEX IF NOT EXISTS idx_requests_client_tag "
+                "ON requests(client_tag)")
         # Group-commit write-behind (the master's dispatch hot path): the
         # per-request status writes (requeue/complete/fail) queue up and
         # land in ONE transaction per flush cycle instead of one
@@ -210,6 +235,8 @@ class Store:
         in front of any client-visible terminal status."""
         if not self._gc_enabled:
             self._exec(sql, args)
+            if barrier and self._repl_barrier is not None:
+                self._repl_barrier()
             return
         with self._gc_cv:
             self._gc_buf.append((sql, args))
@@ -232,14 +259,23 @@ class Store:
             while True:
                 with self._gc_cv:
                     if self._gc_flushed >= ticket:
-                        return
+                        break
                     self._gc_cv.wait(timeout=1.0)
                     if self._gc_flushed >= ticket:
-                        return
+                        break
                 if self._gc_stop.is_set():
                     # flusher gone (close() raced this write): any thread
                     # may flush — _flush_writes is safe to call anywhere
                     self._flush_writes()
+            # Replication half of the durability barrier (HA pairs,
+            # runtime/replication.py): a client-visible terminal status
+            # additionally waits for a standby ack — bounded by the
+            # hook's own timeout, which degrades to leader-only
+            # durability with a journaled `replication-lag` event
+            # rather than ever wedging a dispatch thread on a dead
+            # peer. No-op outside HA or with DLI_HA_REPL_BARRIER off.
+            if self._repl_barrier is not None:
+                self._repl_barrier()
 
     def _flush_writes(self):
         # One flusher at a time: swap -> commit -> publish must be atomic
@@ -261,9 +297,15 @@ class Store:
             ticket = self._gc_enqueued
         if ops:
             try:
-                with self._lock, self._db:
-                    for sql, args in ops:
-                        self._db.execute(sql, args)
+                with self._lock:
+                    with self._db:
+                        for sql, args in ops:
+                            self._db.execute(sql, args)
+                    if self._op_hook is not None:
+                        # committed batch -> one sequenced op-log frame
+                        # (runtime/replication.py). Under the store
+                        # lock so frames observe commit order.
+                        self._op_hook(list(ops))
             except Exception as e:
                 # sqlite hiccup (disk full, I/O error): the 'with
                 # _db' transaction rolled back, so nothing reached
@@ -351,10 +393,118 @@ class Store:
         rows = self._all(sql, args)
         return rows[0] if rows else None
 
-    def _exec(self, sql, args=()) -> int:
+    def _exec(self, sql, args=(), replicate: bool = True) -> int:
+        with self._lock:
+            with self._db:
+                cur = self._db.execute(sql, args)
+                rowid = cur.lastrowid
+            if replicate and self._op_hook is not None:
+                self._op_hook([(sql, args)])
+            return rowid
+
+    # ---- replication (runtime/replication.py) ------------------------
+
+    def set_op_hook(self, hook: Optional[Callable[[list], None]]):
+        """Install the committed-write hook the HA op-log shipper feeds
+        on. Called with [(sql, args), ...] under the store lock, after
+        the transaction committed."""
+        self._op_hook = hook
+
+    def set_repl_barrier(self, hook: Optional[Callable[[], None]]):
+        """Install the standby-ack barrier hook run after a barriered
+        write's local commit (leader side; must be timeout-bounded)."""
+        self._repl_barrier = hook
+
+    def apply_ops(self, ops) -> None:
+        """Standby side: apply one replicated op frame in order, in ONE
+        transaction. The ops are the leader's original parameterized
+        SQL — WHERE guards included — so a replayed frame keeps every
+        lifecycle invariant the leader's write had: a stale requeue or
+        migrate op replayed after a terminal status is a no-op, never a
+        resurrection (frame-level dedup by sequence number lives in the
+        HA controller; this just executes). The op hook deliberately
+        does NOT fire: a replica mirrors the leader's log, it does not
+        re-originate it."""
         with self._lock, self._db:
-            cur = self._db.execute(sql, args)
-            return cur.lastrowid
+            for sql, args in ops:
+                self._db.execute(sql, tuple(args))
+
+    def dump_tables(self) -> Dict[str, dict]:
+        """Full-state snapshot for standby resync: every replicated
+        table's rows, column-named, plus the AUTOINCREMENT high-water
+        marks. The (multi-MB) TSDB snapshot meta row stays out — it is
+        the leader's private ring dump, never replicated, and a standby
+        rebuilds its own TSDB from scrapes."""
+        out: Dict[str, dict] = {}
+        with self._lock:
+            for table in self.REPL_TABLES:
+                cur = self._db.execute(f"SELECT * FROM {table}")
+                cols = [d[0] for d in cur.description]
+                rows = [list(r) for r in cur.fetchall()]
+                if table == "meta":
+                    ki = cols.index("key")
+                    rows = [r for r in rows if r[ki] != "tsdb_snapshot"]
+                out[table] = {"cols": cols, "rows": rows}
+            try:
+                cur = self._db.execute(
+                    "SELECT name, seq FROM sqlite_sequence")
+                out["_sqlite_sequence"] = {
+                    "rows": [list(r) for r in cur.fetchall()]}
+            except sqlite3.OperationalError:
+                # lazily created: absent on a store that never did an
+                # AUTOINCREMENT insert — nothing to carry
+                out["_sqlite_sequence"] = {"rows": []}
+        return out
+
+    def snapshot_with(self, fn):
+        """``(dump_tables(), fn())`` atomically under the store lock.
+        The HA shipper pairs a snapshot with the op-log high-water mark
+        this way: the op hook appends under this same lock right after
+        each commit, so a seq read inside the critical section is
+        exactly the last write the dump contains — read outside it, a
+        write committing between the two would be labeled into the gap
+        and silently never reach the standby."""
+        with self._lock:
+            return self.dump_tables(), fn()
+
+    def load_tables(self, snap: Dict[str, dict]) -> None:
+        """Replace the whole store with a leader snapshot (standby
+        first-contact / post-divergence resync). Explicit ids — AND the
+        replicated ``sqlite_sequence`` high-water marks — keep the
+        AUTOINCREMENT counters in step with the leader (it never reuses
+        an id after a DELETE), so the op stream that follows replays
+        onto identical rowids."""
+        with self._lock, self._db:
+            for table in self.REPL_TABLES:
+                data = snap.get(table)
+                if not isinstance(data, dict):
+                    continue
+                self._db.execute(f"DELETE FROM {table}")
+                cols = data.get("cols") or []
+                if not cols:
+                    continue
+                ph = ",".join("?" for _ in cols)
+                self._db.executemany(
+                    f"INSERT INTO {table} ({','.join(cols)}) "
+                    f"VALUES ({ph})",
+                    [tuple(r) for r in data.get("rows") or []])
+            seqs = (snap.get("_sqlite_sequence") or {}).get("rows") or []
+            # sqlite_sequence only exists after some AUTOINCREMENT
+            # insert — force it into existence with a seed cycle, then
+            # overwrite it with the leader's counters. The clear is
+            # UNCONDITIONAL: a standby on a reused file has counters of
+            # its own, and a fresh leader's empty snapshot must reset
+            # them too or the op stream replays onto diverged rowids.
+            self._db.execute(
+                "INSERT INTO events (ts, type) VALUES (0, '_seed')")
+            self._db.execute(
+                "DELETE FROM events WHERE type='_seed'")
+            self._db.execute("DELETE FROM sqlite_sequence")
+            if seqs:
+                self._db.executemany(
+                    "INSERT INTO sqlite_sequence (name, seq) "
+                    "VALUES (?,?)",
+                    [(str(n), int(s)) for n, s in seqs])
 
     # ---- nodes -------------------------------------------------------
 
@@ -426,12 +576,37 @@ class Store:
     def submit_request(self, model_name: str, prompt: str,
                        max_new_tokens: Optional[int] = 100,
                        sampling: Optional[dict] = None,
-                       max_length: Optional[int] = None) -> int:
-        return self._exec(
-            "INSERT INTO requests (model_name, prompt, max_new_tokens, "
-            "max_length, sampling, created_at) VALUES (?,?,?,?,?,?)",
-            (model_name, prompt, max_new_tokens, max_length,
-             json.dumps(sampling or {}), time.time()))
+                       max_length: Optional[int] = None,
+                       client_tag: Optional[str] = None) -> int:
+        """New request row; ``client_tag`` is the client's submit
+        idempotency key — a tagged re-submit (the ack was lost: an HA
+        leader died between committing the row and answering, or the
+        response connection broke) returns the EXISTING row's id
+        instead of creating a duplicate that would generate twice.
+        SELECT-then-INSERT is atomic under the store lock, and the
+        INSERT replicates with the tag so the dedup holds on the
+        standby that takes over."""
+        with self._lock:
+            if client_tag:
+                row = self._one(
+                    "SELECT id FROM requests WHERE client_tag=?",
+                    (client_tag,))
+                if row:
+                    return row["id"]
+            return self._exec(
+                "INSERT INTO requests (model_name, prompt, "
+                "max_new_tokens, max_length, sampling, created_at, "
+                "client_tag) VALUES (?,?,?,?,?,?,?)",
+                (model_name, prompt, max_new_tokens, max_length,
+                 json.dumps(sampling or {}), time.time(), client_tag))
+
+    def find_client_tag(self, client_tag: str) -> Optional[int]:
+        """The request id a submit idempotency key already names, or
+        None (the api_submit fast path — lets the response mark the
+        dedup explicitly)."""
+        row = self._one("SELECT id FROM requests WHERE client_tag=?",
+                        (client_tag,))
+        return row["id"] if row else None
 
     @staticmethod
     def _parse_json_cols(row):
@@ -472,10 +647,20 @@ class Store:
                 (now, int(limit)))
             if not rows:
                 return []
+            flips = [(now, r["id"]) for r in rows]
             with self._db:
+                # sql is walrus-bound IN the call so the lifecycle
+                # checker resolves the literal's delivery mechanism AND
+                # the op hook ships the identical statement
                 self._db.executemany(
-                    "UPDATE requests SET status='processing', started_at=? "
-                    "WHERE id=?", [(now, r["id"]) for r in rows])
+                    sql := ("UPDATE requests SET status='processing', "
+                            "started_at=? WHERE id=?"), flips)
+            if self._op_hook is not None:
+                # claims replicate too: a standby's dashboard shows the
+                # same processing rows, and takeover's
+                # recover_stale_processing finds exactly the claims the
+                # dead leader held in flight
+                self._op_hook([(sql, a) for a in flips])
             for row in rows:
                 row["started_at"] = now
                 row["sampling"] = json.loads(row["sampling"] or "{}")
@@ -483,6 +668,26 @@ class Store:
                     row.get("excluded_nodes") or "[]")
                 self._parse_json_cols(row)
             return rows
+
+    def note_dispatch_node(self, req_id: int, node_id: int,
+                           barrier: bool = False) -> None:
+        """Record where a claimed request is being dispatched, BEFORE
+        the RPC leaves. Status untouched — this is not a lifecycle
+        transition, just the row's ``node_id`` hint — and the
+        status='processing' guard keeps a slow write off a row that
+        meanwhile went terminal. What it buys: the claim's replicated
+        state names the node holding the in-flight generation, so a
+        lease takeover's re-dispatch (and a restarted solo master's
+        crash recovery) pins back to that node and joins/replays the
+        worker's idempotent generation instead of re-running it on a
+        peer. ``barrier=True`` (the master passes it when the HA
+        durability barrier is armed) additionally waits for a standby
+        ack, closing the last window: there is no kill point where a
+        worker can be generating a request whose location the standby
+        does not know."""
+        self._submit_write(
+            "UPDATE requests SET node_id=? WHERE id=? AND "
+            "status='processing'", (node_id, req_id), barrier=barrier)
 
     def requeue(self, req_id: int, excluded_node_id: Optional[int] = None,
                 delay_s: float = 0.0, last_node_id: Optional[int] = None):
@@ -581,19 +786,31 @@ class Store:
         restarts, so anything at ``max_attempts`` fails permanently here
         instead of re-entering the queue.
         """
-        with self._lock, self._db:
-            failed = 0
-            if max_attempts is not None:
-                cur = self._db.execute(
-                    "UPDATE requests SET status='failed', completed_at=?, "
-                    "error='abandoned after repeated crash recovery "
-                    "(poison request?)' WHERE status='processing' "
-                    "AND attempts+1>=?", (time.time(), max_attempts))
-                failed = cur.rowcount
-            cur = self._db.execute(
-                "UPDATE requests SET status='pending', attempts=attempts+1, "
-                "next_attempt_at=0 WHERE status='processing'")
-            return cur.rowcount + failed
+        with self._lock:
+            applied = []
+            with self._db:
+                failed = 0
+                if max_attempts is not None:
+                    args = (time.time(), max_attempts)
+                    failed = self._db.execute(
+                        sql := ("UPDATE requests SET status='failed', "
+                                "completed_at=?, "
+                                "error='abandoned after repeated crash "
+                                "recovery (poison request?)' "
+                                "WHERE status='processing' "
+                                "AND attempts+1>=?"), args).rowcount
+                    applied.append((sql, args))
+                recovered = self._db.execute(
+                    sql := ("UPDATE requests SET status='pending', "
+                            "attempts=attempts+1, next_attempt_at=0 "
+                            "WHERE status='processing'"), ()).rowcount
+                applied.append((sql, ()))
+            if self._op_hook is not None:
+                # a lease takeover's recovery replicates like any other
+                # write: the WHERE status='processing' guards make the
+                # replayed ops exact on a replica whose rows match
+                self._op_hook(applied)
+            return recovered + failed
 
     def mark_completed(self, req_id: int, result: str, node_id: int,
                        execution_time: float, tokens_per_s: float,
@@ -722,12 +939,17 @@ class Store:
 
     # ---- durable key/value metadata (TSDB snapshots etc.) ------------
 
-    def set_meta(self, key: str, value: str):
+    def set_meta(self, key: str, value: str, replicate: bool = True):
         """Durable master-side metadata (one synchronous transaction —
         callers are background loops, and a multi-MB TSDB snapshot does
-        not belong in the group-commit buffer ahead of status writes)."""
+        not belong in the group-commit buffer ahead of status writes).
+        ``replicate=False`` keeps a key out of the HA op-log — the TSDB
+        ring snapshot is the one user: it is this process's private
+        dump, and shipping megabytes per cycle would starve the status
+        stream for data a standby rebuilds from scrapes anyway."""
         self._exec("INSERT OR REPLACE INTO meta (key, value, updated_at) "
-                   "VALUES (?,?,?)", (key, value, time.time()))
+                   "VALUES (?,?,?)", (key, value, time.time()),
+                   replicate=replicate)
 
     def get_meta(self, key: str) -> Optional[str]:
         row = self._one("SELECT value FROM meta WHERE key=?", (key,))

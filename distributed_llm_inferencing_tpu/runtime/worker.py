@@ -28,6 +28,7 @@ from distributed_llm_inferencing_tpu.parallel.mesh import MeshSpec
 from distributed_llm_inferencing_tpu.runtime import httpd
 from distributed_llm_inferencing_tpu.runtime.engine import InferenceEngine
 from distributed_llm_inferencing_tpu.utils import locks, trace
+from distributed_llm_inferencing_tpu.utils.faults import mutation_enabled
 from distributed_llm_inferencing_tpu.utils.logging import setup_logging
 from distributed_llm_inferencing_tpu.utils.metrics import Metrics
 from distributed_llm_inferencing_tpu.utils.tokenizer import load_tokenizer
@@ -63,6 +64,16 @@ MIGRATE_TIMEOUT_S = 10.0
 # wire): the stream truncates at the cap and reports how many blocks
 # were cut, and the fetching peer recomputes the rest.
 KV_FETCH_MAX_MB = float(os.environ.get("DLI_KV_FETCH_MAX_MB", 256))
+
+# Lease-fencing headers an HA master stamps on every RPC
+# (docs/robustness.md "Replicated control plane"). Workers track the
+# newest (term, holder nonce) they have seen and 409 any state-changing
+# RPC from an older term — a paused-then-revived old leader can never
+# double-dispatch, migrate, drain, or flip roles. Calls WITHOUT the
+# headers (solo masters, direct clients, tests) are never fenced.
+MASTER_TERM_HEADER = "X-DLI-Master-Term"
+MASTER_NONCE_HEADER = "X-DLI-Master-Nonce"
+STALE_TERM_HEADER = "X-DLI-Stale-Term"
 
 
 class LoadedModel:
@@ -155,8 +166,13 @@ class WorkerAgent:
         for name in ("kv_fetch_requests", "kv_fetch_served_blocks",
                      "kv_fetch_served_bytes", "kv_fetch_missing_blocks",
                      "tokens_generated", "role_flips",
-                     "requests_migrated_out"):
+                     "requests_migrated_out",
+                     "stale_term_rejections"):
             self.metrics.inc(name, 0)
+        # worker-side lease validation state: the newest master (term,
+        # holder nonce) observed on any fenced RPC; see _term_guard
+        self._master_term: tuple = (0, None)
+        self._master_term_lock = locks.lock("worker.master_term")
         # numeric role gauge (0 mixed / 1 prefill / 2 decode): the
         # dashboard charts role flips as a TSDB sparkline, so the
         # series must exist from the first scrape. The literal-0 call
@@ -165,6 +181,61 @@ class WorkerAgent:
         # call overwrites it with this worker's actual role.
         self.metrics.gauge("worker_role", 0.0)
         self.metrics.gauge("worker_role", ROLE_CODE.get(self.role, 0.0))
+
+    # ---- worker-side lease validation --------------------------------
+
+    def note_master_term(self, nonce: str, term: int) -> bool:
+        """One fenced RPC's term check (docs/robustness.md "Replicated
+        control plane"): True = current — the caller may proceed and
+        the worker's high-water (term, holder) advanced if newer;
+        False = stale (an older term, or a competing holder at the
+        SAME term — the split-brain guard: whoever presented a term
+        first holds it here, anyone else must take a higher one)."""
+        with self._master_term_lock:
+            cur_term, cur_nonce = self._master_term
+            if term > cur_term:
+                self._master_term = (int(term), str(nonce))
+                return True
+            if term == cur_term and (cur_nonce is None
+                                     or cur_nonce == nonce):
+                if cur_nonce is None:
+                    self._master_term = (int(term), str(nonce))
+                return True
+        if mutation_enabled("stale_term_check"):
+            # dliverify mutation gate (docs/static_analysis.md): skip
+            # the worker-side fence — the double-dispatch bug the
+            # `lease_takeover` scenario must catch. Test-only flag.
+            return True
+        self.metrics.inc("stale_term_rejections")
+        return False
+
+    def master_term(self) -> int:
+        """Newest master term this worker has fenced against."""
+        with self._master_term_lock:
+            return self._master_term[0]
+
+    def _term_guard(self, _request):
+        """None when the caller may proceed; else the 409 refusal for a
+        stale-term dispatch (the ``X-DLI-Stale-Term`` response header
+        tells the old leader which term deposed it, so it steps down
+        instead of striking/requeueing state it no longer owns)."""
+        if _request is None:
+            return None
+        raw = _request.headers.get(MASTER_TERM_HEADER)
+        if not raw:
+            return None       # un-fenced caller (solo master / client)
+        try:
+            term = int(raw)
+        except (TypeError, ValueError):
+            return None
+        nonce = _request.headers.get(MASTER_NONCE_HEADER) or ""
+        if self.note_master_term(nonce, term):
+            return None
+        cur = self.master_term()
+        return 409, {"status": "error", "stale_term": True,
+                     "message": f"master term {term} is stale "
+                                f"(current lease term: {cur})"}, \
+            {STALE_TERM_HEADER: str(cur)}
 
     # ---- endpoints ---------------------------------------------------
 
@@ -418,19 +489,28 @@ class WorkerAgent:
                      "load_time_s": time.time() - t0,
                      "stats": stats}
 
-    def load_model(self, body):
+    def load_model(self, body, _request=None):
+        # lease-fenced like every state-changing RPC: a revived stale
+        # leader must not (re)load models under the current leader
+        stale = self._term_guard(_request)
+        if stale:
+            return stale
         if self._draining:
             return self._refuse_draining()
         with self.metrics.time("load_model"):
             return self._do_load(body)
 
-    def load_shard(self, body):
+    def load_shard(self, body, _request=None):
         """Reference parity (worker/app.py:139-206): registering a 'shard'.
 
         TPU-native meaning: a placement plan (mesh spec + partition specs,
         parallel/plan.py) rather than a weight-file directory — loading a
-        'shard' is loading the model with that plan's mesh.
+        'shard' is loading the model with that plan's mesh. Lease-fenced
+        like /load_model.
         """
+        stale = self._term_guard(_request)
+        if stale:
+            return stale
         if self._draining:
             return self._refuse_draining()
         plan = body.get("plan")
@@ -443,9 +523,15 @@ class WorkerAgent:
         body.setdefault("max_seq", plan.get("max_seq"))
         return self._do_load(body)
 
-    def unload_model(self, body):
+    def unload_model(self, body, _request=None):
         """Parity with worker/app.py:208-250; device buffers are dropped by
-        deleting the engine (XLA frees HBM on GC)."""
+        deleting the engine (XLA frees HBM on GC). Lease-fenced: a
+        revived stale leader's best-effort unload (remove_node tail)
+        must not evict a model the current leader is serving
+        mid-generation."""
+        stale = self._term_guard(_request)
+        if stale:
+            return stale
         name = body.get("model_name")
         with self._models_lock:
             m = self.models.pop(name, None)
@@ -571,14 +657,18 @@ class WorkerAgent:
             time.sleep(0.05)
         return self._busy_count() == 0
 
-    def drain(self, body):
+    def drain(self, body, _request=None):
         """Graceful drain — no reference counterpart (its only lifecycle
         was kill -9). Marks the worker draining: new inference gets 503
         with Retry-After (the master fails over without recording a
         strike, runtime/master.py), in-flight batcher/engine requests
         run to completion, and this call returns once idle (or when
         ``timeout`` seconds elapse, reporting what is still in flight).
-        """
+        Lease-fenced: only the current lease holder may drain this
+        worker — a revived old leader's drain is a 409."""
+        stale = self._term_guard(_request)
+        if stale:
+            return stale
         with self._active_cv:   # fences against _try_begin_inference
             self._draining = True
         self.metrics.gauge("draining", 1)
@@ -586,16 +676,23 @@ class WorkerAgent:
         return {"status": "success", "drained": idle,
                 "in_flight": self._busy_count()}
 
-    def undrain(self, body):
-        """Re-open a drained worker for new inference."""
+    def undrain(self, body, _request=None):
+        """Re-open a drained worker for new inference (lease-fenced
+        like /drain)."""
+        stale = self._term_guard(_request)
+        if stale:
+            return stale
         with self._active_cv:
             self._draining = False
         self.metrics.gauge("draining", 0)
         return {"status": "success"}
 
-    def inference(self, body):
+    def inference(self, body, _request=None):
         # semantic span under the HTTP server span; the batcher/engine
         # below parent their own spans to it (contextvar or req.trace_ctx)
+        stale = self._term_guard(_request)
+        if stale:
+            return stale
         if not self._try_begin_inference():
             return self._refuse_draining()
         try:
@@ -618,6 +715,11 @@ class WorkerAgent:
         owned (fresh-tag) sub-requests through ContinuousBatcher
         .submit_many in wire order, so FIFO survives the multiplexing.
         """
+        stale = self._term_guard(_request)
+        if stale:
+            # whole-batch refusal: every sub came from the same stale
+            # master, and the current leader re-dispatches them all
+            return stale
         subs = body.get("requests")
         if not isinstance(subs, list) or not subs:
             return 400, {"status": "error",
@@ -831,13 +933,17 @@ class WorkerAgent:
             self._end_inference()
             emit(tag, st, pl)
 
-    def set_role(self, body):
+    def set_role(self, body, _request=None):
         """Runtime role flip (the master's elastic rebalancer,
         docs/robustness.md "Live migration"): role becomes mutable
         worker state, re-advertised on the next /health and charted
         via the numeric ``dli_worker_role`` gauge. The routing
         consequences are entirely the master's — this worker serves
-        whatever is dispatched to it either way."""
+        whatever is dispatched to it either way. Lease-fenced: only
+        the current lease holder may flip roles."""
+        stale = self._term_guard(_request)
+        if stale:
+            return stale
         role = str(body.get("role") or "").lower()
         if role not in WORKER_ROLES:
             return 400, {"status": "error",
@@ -850,7 +956,7 @@ class WorkerAgent:
             log.info("worker role flipped %s -> %s", prev, role)
         return {"status": "success", "role": role, "previous": prev}
 
-    def migrate_out(self, body):
+    def migrate_out(self, body, _request=None):
         """Live in-flight migration handoff (master rebalancer): ask
         the owning batcher to snapshot the tagged request — export its
         computed KV through the last context position into the host
@@ -862,7 +968,12 @@ class WorkerAgent:
         in-flight tag. 409: the request completed first (the
         migrate-vs-complete race — the normal result stands, the
         request_tag idempotency cache replays it, nothing double-emits)
-        or the serving mode cannot migrate (engine mode, lockstep)."""
+        or the serving mode cannot migrate (engine mode, lockstep).
+        Lease-fenced: a stale master must not migrate a request the
+        current leader is streaming."""
+        stale = self._term_guard(_request)
+        if stale:
+            return stale
         tag = body.get("request_tag")
         if not tag:
             return 400, {"status": "error",
@@ -1199,6 +1310,9 @@ class WorkerAgent:
 
     def inference_stream(self, body, _request=None):
         """SSE streaming decode — absent from the reference (SURVEY.md §2.3)."""
+        stale = self._term_guard(_request)
+        if stale:
+            return stale
         if not self._try_begin_inference():
             return self._refuse_draining()
         try:
@@ -1261,7 +1375,7 @@ class WorkerAgent:
 
         return httpd.sse_stream(_request, events())
 
-    def cancel(self, body):
+    def cancel(self, body, _request=None):
         """Cancel an in-flight tagged batched request, freeing its slot.
 
         The reference had no cancellation at all — a master-side timeout
@@ -1270,7 +1384,14 @@ class WorkerAgent:
         generate). Engine-mode requests are not cancellable mid-program
         (one jitted chunk runs to completion); the batcher drops the slot
         at its next step.
+
+        Lease-fenced: a revived old leader's timeout path must not
+        cancel a generation the CURRENT leader is waiting on — without
+        the fence, its orphan-cancel would kill the live stream.
         """
+        stale = self._term_guard(_request)
+        if stale:
+            return stale
         tag = body.get("request_tag")
         if not tag:
             return 400, {"status": "error", "message": "request_tag required"}

@@ -69,7 +69,10 @@ MODES = tuple(sorted(set(SERVER_MODES) | set(CLIENT_MODES)))
 # gate, docs/static_analysis.md): each re-introduces one HISTORICAL bug
 # behind a test-only flag so the interleaving model checker can prove
 # it still produces a counterexample trace. Never set in production.
-MUTATIONS = ("half_open_probe", "requeue_exclusion")
+# ``stale_term_check`` skips the worker-side lease fence
+# (runtime/worker.py note_master_term) — the revived-old-leader
+# double-dispatch the ``lease_takeover`` scenario must catch.
+MUTATIONS = ("half_open_probe", "requeue_exclusion", "stale_term_check")
 
 
 def mutation_enabled(name: str) -> bool:

@@ -298,9 +298,36 @@ KNOBS = (
          "scripts/check.sh"),
     Knob("DLI_VERIFY_MUTATIONS", "unset", "str",
          "TEST-ONLY comma list re-arming historical bugs "
-         "(`half_open_probe`, `requeue_exclusion`) so the dliverify "
-         "mutation gate can prove the explorer catches them. Never set "
-         "in production.", f"{_P}/utils/faults.py"),
+         "(`half_open_probe`, `requeue_exclusion`, `stale_term_check`) "
+         "so the dliverify mutation gate can prove the explorer "
+         "catches them. Never set in production.",
+         f"{_P}/utils/faults.py"),
+    # ---- replicated control plane ------------------------------------
+    Knob("DLI_HA_PEERS", "unset", "str",
+         "Comma list of the OTHER masters' base URLs: arms the "
+         "leader-leased replicated control plane (op-log replication "
+         "+ automatic failover). Unset = solo master, HA off.",
+         f"{_P}/runtime/replication.py"),
+    Knob("DLI_HA_ADVERTISE", "unset", "str",
+         "Base URL peers/clients reach THIS master at (heartbeat "
+         "holder URL + standby 307 redirects). Required for a "
+         "multi-host HA pair bound to 0.0.0.0 — a wildcard bind "
+         "address is never advertised.",
+         f"{_P}/runtime/replication.py"),
+    Knob("DLI_HA_LEASE_MS", "3000", "float",
+         "Leader lease duration: heartbeats every lease/3; a standby "
+         "whose lease deadline expires takes over at term+1.",
+         f"{_P}/runtime/replication.py"),
+    Knob("DLI_HA_REPL_BARRIER", "0", "bool",
+         "Durability barrier: client-visible terminal statuses and "
+         "submit acks wait for a standby ack (bounded at 2 lease "
+         "intervals, then degrades to leader-only durability with a "
+         "journaled `replication-lag` event).",
+         f"{_P}/runtime/replication.py"),
+    Knob("DLI_HA_REPL_LAG_WARN_MS", "1000", "float",
+         "Standby-ack lag behind the op-log head that journals a "
+         "`replication-lag` warning (hysteresis: one event per edge).",
+         f"{_P}/runtime/replication.py"),
     # ---- auth ---------------------------------------------------------
     Knob("DLI_AUTH_ENABLED", "unset", "bool",
          "`1` enables bearer-token auth on worker endpoints.",

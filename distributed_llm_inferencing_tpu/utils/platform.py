@@ -143,6 +143,20 @@ def force_platform(platform: str) -> None:
     jax.config.update("jax_platforms", platform)
 
 
+def free_port() -> int:
+    """An OS-assigned free localhost TCP port, for services that must
+    know their address BEFORE binding (an HA master advertises its URL
+    to peers; a subprocess under test is launched with an explicit
+    port). Inherently racy against other binders — fine for tests and
+    local fleets, not a general allocator."""
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
 def probe_default_backend(timeout: float = 75.0) -> Optional[str]:
     """Try default-backend init in a subprocess; return its platform name,
     or None if init failed OR hung past ``timeout`` seconds."""

@@ -93,6 +93,9 @@ timeout -k 10 "$VT" env JAX_PLATFORMS=cpu \
 timeout -k 10 "$VT" env JAX_PLATFORMS=cpu \
     python -m tools.dliverify --mutate requeue_exclusion --budget "$VB" \
     || exit 1
+timeout -k 10 "$VT" env JAX_PLATFORMS=cpu \
+    python -m tools.dliverify --mutate stale_term_check --budget "$VB" \
+    || exit 1
 
 echo "== native kernels (threaded GEMV/GEMM must build; no silent fallback) =="
 # The decode hot path leans on the -pthread row-pool kernel
@@ -172,6 +175,23 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu DLI_FAULTS_ENABLE=1 \
 timeout -k 10 600 env JAX_PLATFORMS=cpu \
     python bench.py --scenario rebalance --smoke || exit 1
 
+echo "== replicated control plane suite + kill-the-leader chaos smoke =="
+# Leader-leased master pair over op-log replication (docs/robustness.md
+# "Replicated control plane"): the suite covers the op-log capture/
+# apply path, lease validation, redirects, and the barrier degradation;
+# the smoke runs a LIVE 2-master/2-worker fleet, SIGKILLs the leader
+# subprocess mid-wave, and gates standby takeover within 2 lease
+# intervals, zero lost/duplicated requests (idempotency-tag
+# accounting), survivor dashboard reads clean throughout, and the
+# takeover reconstructable from the replicated event journal (JSON at
+# /tmp/dli_bench_ha.json for the CI artifact; leader subprocess log at
+# /tmp/dli_ha_leader.log)
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_ha.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+timeout -k 10 900 env JAX_PLATFORMS=cpu \
+    python bench.py --scenario ha --smoke || exit 1
+
 echo "== telemetry plane + flight recorder (TSDB + cost ledger + SLO + events) =="
 # Time-series retention, per-request cost ledger, SLO accounting, decode
 # profiler (docs/observability.md "Telemetry plane"), and the flight
@@ -227,6 +247,7 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     --ignore=tests/test_migration.py \
     --ignore=tests/test_tsdb.py \
     --ignore=tests/test_events.py \
+    --ignore=tests/test_ha.py \
     2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \

@@ -1,19 +1,26 @@
 #!/usr/bin/env bash
-# Snapshot a live master's observability surfaces into one tarball for
-# bug reports (docs/robustness.md "Fault runbook"): retained time-series
-# history, the cluster trace export, decode-profiler readout, SLO
-# rollup, node/breaker state, cluster metrics, and recent request rows.
+# Snapshot a live control plane's observability surfaces into one
+# tarball for bug reports (docs/robustness.md "Fault runbook"):
+# retained time-series history, the cluster trace export,
+# decode-profiler readout, SLO rollup, node/breaker state, cluster
+# metrics, recent request rows, the flight-recorder journal, and — on
+# an HA pair (docs/robustness.md "Replicated control plane") — the
+# replication/lease state of EVERY configured master, so a failover
+# postmortem has both sides' view of the lease and the op-log.
 #
-# Usage: scripts/collect_debug_bundle.sh [MASTER_URL] [OUT_TARBALL]
-#   MASTER_URL   default http://127.0.0.1:8000
+# Usage: scripts/collect_debug_bundle.sh [MASTER_URLS] [OUT_TARBALL]
+#   MASTER_URLS  comma list of master base URLs
+#                (default http://127.0.0.1:8000; an HA pair passes
+#                 "http://m1:8000,http://m2:8000" — each master gets
+#                 its own master_<n>/ directory in the bundle)
 #   OUT_TARBALL  default dli-debug-bundle-<timestamp>.tar.gz
 # Honors DLI_MASTER_AUTH_KEY for a bearer-authed master and
 # DLI_BUNDLE_TIMEOUT (seconds per fetch, default 30). Each fetch is
-# best-effort: an unreachable surface records its error in place instead
-# of sinking the whole bundle.
+# best-effort: an unreachable surface (or a whole dead master) records
+# its error in place instead of sinking the bundle.
 set -uo pipefail
 
-MASTER="${1:-http://127.0.0.1:8000}"
+MASTERS="${1:-http://127.0.0.1:8000}"
 OUT="${2:-dli-debug-bundle-$(date +%Y%m%d-%H%M%S).tar.gz}"
 TMP="$(mktemp -d)"
 trap 'rm -rf "$TMP"' EXIT
@@ -23,38 +30,46 @@ if [ -n "${DLI_MASTER_AUTH_KEY:-}" ]; then
     HDR=(-H "Authorization: Bearer $DLI_MASTER_AUTH_KEY")
 fi
 
-fetch() {  # fetch <path> <outfile>
+fetch() {  # fetch <master> <dir> <path> <outfile>
     # ${HDR[@]+...}: an empty array under `set -u` is an unbound-variable
     # abort on bash < 4.4 (macOS /bin/bash 3.2) — expand only when set
     if ! curl -fsS --max-time "${DLI_BUNDLE_TIMEOUT:-30}" \
             ${HDR[@]+"${HDR[@]}"} \
-            "$MASTER$1" -o "$TMP/$2" 2>"$TMP/$2.err"; then
+            "$1$3" -o "$TMP/$2/$4" 2>"$TMP/$2/$4.err"; then
         printf '{"error": "fetch %s failed: %s"}\n' \
-            "$1" "$(tr -d '"\n' < "$TMP/$2.err" | head -c 200)" > "$TMP/$2"
+            "$3" "$(tr -d '"\n' < "$TMP/$2/$4.err" | head -c 200)" \
+            > "$TMP/$2/$4"
     fi
-    rm -f "$TMP/$2.err"
+    rm -f "$TMP/$2/$4.err"
 }
 
-fetch /api/timeseries timeseries_catalog.json
-for m in tokens_generated batcher_queue_depth batcher_free_kv_blocks \
-         prefix_hit_ratio breaker_state slo_attainment slo_burn_rate \
-         requests_completed; do
-    fetch "/api/timeseries?metric=$m" "timeseries_$m.json"
-done
-fetch /api/trace trace.json              # open in Perfetto
-fetch /api/profile profile.json          # decode-profiler readout
-fetch /api/slo slo.json
-fetch /api/nodes/status nodes_status.json
-fetch /api/cluster_metrics cluster_metrics.json
-fetch /api/inference/recent recent_requests.json
-fetch /api/events events.json            # flight-recorder journal
-fetch /metrics master_metrics.prom
+collect_master() {  # collect_master <master> <dir>
+    local M="$1" D="$2"
+    mkdir -p "$TMP/$D"
+    fetch "$M" "$D" /api/timeseries timeseries_catalog.json
+    for m in tokens_generated batcher_queue_depth batcher_free_kv_blocks \
+             prefix_hit_ratio breaker_state slo_attainment slo_burn_rate \
+             requests_completed; do
+        fetch "$M" "$D" "/api/timeseries?metric=$m" "timeseries_$m.json"
+    done
+    fetch "$M" "$D" /api/trace trace.json        # open in Perfetto
+    fetch "$M" "$D" /api/profile profile.json    # decode-profiler readout
+    fetch "$M" "$D" /api/slo slo.json
+    fetch "$M" "$D" /api/nodes/status nodes_status.json
+    fetch "$M" "$D" /api/cluster_metrics cluster_metrics.json
+    fetch "$M" "$D" /api/inference/recent recent_requests.json
+    fetch "$M" "$D" /api/events events.json      # flight-recorder journal
+    fetch "$M" "$D" /api/ha ha_status.json       # lease/replication state
+    fetch "$M" "$D" /api/leader leader.json      # who this master follows
+    fetch "$M" "$D" /metrics master_metrics.prom
 
-# Journey of the worst recent SLO-missing request: a terminal failure
-# is an SLO miss by definition; with none in the recent window, take
-# the slowest completion (the likeliest TTFT/ITL violator). Best-effort
-# like every other fetch -- no python3, no journey, bundle still lands.
-RID=$(python3 - "$TMP/recent_requests.json" <<'EOF' 2>/dev/null
+    # Journey of the worst recent SLO-missing request: a terminal
+    # failure is an SLO miss by definition; with none in the recent
+    # window, take the slowest completion (the likeliest TTFT/ITL
+    # violator). Best-effort like every other fetch — no python3, no
+    # journey, bundle still lands.
+    local RID
+    RID=$(python3 - "$TMP/$D/recent_requests.json" <<'EOF' 2>/dev/null
 import json, sys
 try:
     rows = json.load(open(sys.argv[1])).get("requests") or []
@@ -68,15 +83,29 @@ if bad:
     print(bad[0]["id"])
 EOF
 )
-if [ -n "${RID:-}" ]; then
-    fetch "/api/requests/$RID/journey" worst_request_journey.json
-    fetch "/api/events?request=$RID" worst_request_events.json
-fi
+    if [ -n "${RID:-}" ]; then
+        fetch "$M" "$D" "/api/requests/$RID/journey" \
+            worst_request_journey.json
+        fetch "$M" "$D" "/api/events?request=$RID" \
+            worst_request_events.json
+    fi
+}
 
 {
     echo "collected_at: $(date -u +%Y-%m-%dT%H:%M:%SZ)"
-    echo "master: $MASTER"
+    echo "masters: $MASTERS"
 } > "$TMP/MANIFEST"
+
+i=0
+IFS=',' read -ra URLS <<< "$MASTERS"
+for M in "${URLS[@]}"; do
+    M="$(echo "$M" | tr -d '[:space:]')"
+    [ -n "$M" ] || continue
+    i=$((i + 1))
+    D="master_$i"
+    echo "master_$i: $M" >> "$TMP/MANIFEST"
+    collect_master "$M" "$D"
+done
 
 tar -czf "$OUT" -C "$TMP" .
 echo "$OUT"

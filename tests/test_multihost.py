@@ -8,7 +8,6 @@ complete AND match the single-process oracle.
 """
 
 import json
-import socket
 import subprocess
 import sys
 import time
@@ -53,12 +52,8 @@ agent.serve("127.0.0.1", wport)
 """
 
 
-def _free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    p = s.getsockname()[1]
-    s.close()
-    return p
+from distributed_llm_inferencing_tpu.utils.platform import \
+    free_port as _free_port  # noqa: E402
 
 
 @pytest.fixture(scope="module")

@@ -488,13 +488,149 @@ class MigrateVsComplete(Scenario):
         return None
 
 
+class LeaseTakeover(Scenario):
+    """A paused-then-revived old leader's dispatch races a standby's
+    lease takeover for one claimed request, through the worker's REAL
+    lease fence (``note_master_term``) + idempotency plumbing and the
+    store's REAL recovery/claim/terminal SQL. The cluster tag is
+    SHARED (replicated meta), so whatever the interleaving the
+    generation runs exactly once and the row reaches exactly one
+    terminal state; the worker-side fence additionally guarantees that
+    once term 2 has been seen, a term-1 dispatch never proceeds — the
+    invariant the ``stale_term_check`` mutation (skip the fence, the
+    revived-old-leader double-dispatch hazard) must break with a
+    printed counterexample."""
+
+    name = "lease_takeover"
+    description = ("old leader paused mid-dispatch vs standby lease "
+                   "takeover: exactly-once, single terminal, stale "
+                   "terms fenced")
+    invariants = ("tag_exactly_once", "single_terminal",
+                  "stale_term_fenced")
+    threads = 2
+
+    def build(self, sched):
+        from distributed_llm_inferencing_tpu.runtime.worker import (
+            WorkerAgent)
+        w = WorkerAgent(auth_key=None)
+        s = _fresh_store()
+        rid = s.submit_request("m", "p")
+        s.claim_next_pending()          # the old leader's claim
+        tag = f"cluster:{rid}"          # the replicated tag nonce
+        ctx = types.SimpleNamespace(worker=w, store=s, rid=rid,
+                                    executions=[], joins=[],
+                                    stale_proceeded=[], observed=[],
+                                    fenced_term=0, sched=sched)
+
+        def run_tag(who):
+            kind, obj = w._idem_claim(tag)
+            sched.mark(f"{who} idem claim -> {kind}")
+            if kind == "own":
+                ctx.executions.append(who)
+                w._idem_release(tag, obj,
+                                {"status": "success", "result": "r"})
+            elif kind == "join":
+                # the real join waits the running execution out; for
+                # the model the claim outcome is what matters (the
+                # IdemTagRace pattern — waiting on the peer's Event
+                # would block outside a scheduler yield point)
+                ctx.joins.append(who)
+            s.mark_completed(rid, "r", 1, 0.1, 1.0)
+            st = s.get_request(rid)["status"]
+            ctx.observed.append(st)
+            sched.mark(f"{who} terminal write; row now {st}")
+
+        def old_leader():
+            # The paused dispatch revives and reaches the worker at
+            # its OLD term. The fence ground truth rides
+            # ctx.fenced_term: the standby publishes it in the SAME
+            # scheduler step as its own term fence (no lock op in
+            # between), and this thread reads it in the same step as
+            # its admission decision — so "admitted while term 2 was
+            # already fenced" is exact, and the benign interleaving
+            # (admitted at term 1, takeover strictly after) never
+            # false-positives.
+            ok = w.note_master_term("nonce-A", 1)
+            if ok and ctx.fenced_term > 1:
+                ctx.stale_proceeded.append(ctx.fenced_term)
+            if not ok:
+                sched.mark("old leader fenced (409) — steps down, "
+                           "writes nothing")
+                return
+            sched.mark("old leader term-1 dispatch admitted")
+            run_tag("old")
+
+        def standby():
+            # takeover: fence term 2 at the worker, recover the dead
+            # leader's in-flight claim, re-claim, re-dispatch with the
+            # SAME replicated tag
+            w.note_master_term("nonce-B", 2)
+            ctx.fenced_term = 2        # same atomic step as the fence
+            sched.mark("standby takes the lease at term 2")
+            s.recover_stale_processing()
+            req = s.claim_next_pending()
+            if req is None:
+                sched.mark("nothing to re-claim (completion won)")
+                return
+            run_tag("new")
+
+        sched.spawn("old-leader", old_leader)
+        sched.spawn("standby", standby)
+        return ctx
+
+    def check_step(self, ctx) -> Bad:
+        if len(ctx.executions) > 1:
+            return ("tag_exactly_once",
+                    f"tag executed {len(ctx.executions)} times "
+                    f"({ctx.executions})")
+        if ctx.stale_proceeded:
+            return ("stale_term_fenced",
+                    "a term-1 dispatch proceeded past worker "
+                    f"validation although term {ctx.stale_proceeded[0]} "
+                    "had already been fenced — the revived old leader "
+                    "double-dispatched")
+        return None
+
+    def check_final(self, ctx) -> Bad:
+        bad = self.check_step(ctx)
+        if bad:
+            return bad
+        if len(ctx.executions) != 1:
+            return ("tag_exactly_once",
+                    f"tag executed {len(ctx.executions)} times across "
+                    "the takeover race (want exactly 1; joins="
+                    f"{ctx.joins})")
+        terminal = None
+        for st in ctx.observed:
+            if st in ("completed", "failed"):
+                if terminal is None:
+                    terminal = st
+                elif st != terminal:
+                    return ("single_terminal",
+                            f"request {ctx.rid} observed terminal "
+                            f"{terminal!r} and LATER {st!r} — the "
+                            "takeover flipped a verdict")
+            elif terminal is not None:
+                return ("single_terminal",
+                        f"request {ctx.rid} observed live {st!r} after "
+                        f"terminal {terminal!r}")
+        final = ctx.store.get_request(ctx.rid)["status"]
+        if final != "completed":
+            return ("single_terminal",
+                    f"request {ctx.rid} ended {final!r} despite a "
+                    "completed generation")
+        return None
+
+
 SCENARIOS = {s.name: s for s in (
     BreakerHalfOpenProbe(), RequeueExclusion(), IdemTagRace(),
-    DrainNoStrand(), ClaimOnce(), TerminalOnce(), MigrateVsComplete())}
+    DrainNoStrand(), ClaimOnce(), TerminalOnce(), MigrateVsComplete(),
+    LeaseTakeover())}
 
 # which scenario proves which re-armed historical bug (the mutation
 # gate): utils/faults.py MUTATIONS -> scenario name
 MUTATION_SCENARIOS = {
     "half_open_probe": "breaker_half_open_probe",
     "requeue_exclusion": "requeue_exclusion",
+    "stale_term_check": "lease_takeover",
 }
