@@ -2007,6 +2007,306 @@ def _decode_speed_scenario(argv, opt, smoke):
     return 0
 
 
+def _sim_scale_scenario(argv, opt, smoke):
+    """--scenario sim_scale [--smoke]: the cluster observatory's SCALE
+    gate (docs/simulator.md). Every leg routes its requests through the
+    REAL ``_pick_node``/breaker/``Store`` on the virtual clock:
+
+    - **scale** — DLI_SIM_NODES x DLI_SIM_REQUESTS diurnal arrivals;
+      gated on <120s wall, every request completed, zero starved, empty
+      invariant-violation list;
+    - **adversarial** — bursty/tie/heavy-tail arrivals with three nodes
+      failing mid-run; breakers must open AND recover, every request
+      must reach a terminal state, invariants stay clean;
+    - **determinism** — two identically-seeded runs must produce the
+      SAME decision-journal hash (the bit-for-bit replay bar);
+    - **sublinear** — per-pick cost at 4x the fleet must stay <2x (the
+      sampled scheduler's O(sample) bar).
+
+    Writes /tmp/dli_bench_sim.json for the CI artifact."""
+    from tools.dlisim import SimConfig, run_sim
+
+    nodes = opt("--nodes", int(os.environ.get("DLI_SIM_NODES", 1000)))
+    reqs = opt("--requests",
+               int(os.environ.get("DLI_SIM_REQUESTS", 100_000)))
+    seed = opt("--seed", int(os.environ.get("DLI_SIM_SEED", 42)))
+    wall_budget = opt("--wall-budget", 120.0, float)
+    result = {"scenario": "sim_scale", "smoke": smoke,
+              "nodes": nodes, "requests": reqs, "seed": seed}
+    failures = []
+
+    def leg(name, rep):
+        entry = {k: getattr(rep, k) for k in (
+            "completed", "failed", "starved", "wall_s", "sim_s",
+            "pick_us_mean", "pick_us_p95", "goodput_req_per_s",
+            "ttft_ms_p50", "queue_depth_mean", "journal_hash")}
+        entry["violations"] = rep.violations[:20]
+        entry["breaker"] = rep.breaker
+        result[name] = entry
+        if rep.violations:
+            failures.append(f"{name}: {len(rep.violations)} invariant "
+                            f"violation(s)")
+        if rep.starved:
+            failures.append(f"{name}: {rep.starved} starved request(s)")
+        return rep
+
+    scale = leg("scale", run_sim(SimConfig(
+        nodes=nodes, requests=reqs, duration_s=600.0,
+        arrival="diurnal", seed=seed)))
+    if scale.completed != reqs or scale.failed:
+        failures.append(f"scale: {scale.completed}/{reqs} completed, "
+                        f"{scale.failed} failed (healthy fleet)")
+    if scale.wall_s >= wall_budget:
+        failures.append(f"scale: wall {scale.wall_s}s >= "
+                        f"{wall_budget}s budget")
+
+    adv_n = max(8, nodes // 5)
+    adv_r = max(1000, reqs // 5)
+    adv = leg("adversarial", run_sim(SimConfig(
+        nodes=adv_n, requests=adv_r, duration_s=600.0,
+        arrival="adversarial", seed=seed,
+        fail_nodes=[(0, 60.0, 180.0), (1, 90.0, 240.0),
+                    (2, 120.0, 210.0)])))
+    if adv.completed + adv.failed != adv_r:
+        failures.append(f"adversarial: {adv.completed}+{adv.failed} "
+                        f"terminal != {adv_r} submitted")
+    if not adv.breaker.get("opened"):
+        failures.append("adversarial: no breaker ever opened despite "
+                        "three mid-run node failures")
+    if not adv.breaker.get("closed"):
+        failures.append("adversarial: no breaker recovered (half-open "
+                        "probe -> closed) after nodes returned")
+
+    twin_cfg = dict(nodes=50, requests=2000, duration_s=120.0,
+                    arrival="bursty", seed=seed)
+    t1 = run_sim(SimConfig(**twin_cfg))
+    t2 = run_sim(SimConfig(**twin_cfg))
+    result["determinism"] = {"hash_a": t1.journal_hash,
+                             "hash_b": t2.journal_hash}
+    if t1.journal_hash != t2.journal_hash:
+        failures.append("determinism: identically-seeded runs diverged "
+                        f"({t1.journal_hash[:12]} != "
+                        f"{t2.journal_hash[:12]})")
+
+    # sub-linearity: the sampled scheduler's per-pick cost must not
+    # track fleet size. ~4x the nodes may cost at most 2x the pick —
+    # in practice both fleets sample the same DLI_SCHED_SAMPLE
+    # candidates and the ratio sits near 1. The small fleet stays
+    # ABOVE the sampling cap on purpose: comparing a sampled pick
+    # against a below-cap full scan would measure the cap, not the
+    # scaling.
+    from distributed_llm_inferencing_tpu.runtime.master import (
+        SCHED_SAMPLE)
+    small_n = min(nodes, max(2 * SCHED_SAMPLE, nodes // 4))
+    small = run_sim(SimConfig(nodes=small_n,
+                              requests=10_000, duration_s=60.0,
+                              arrival="diurnal", seed=seed))
+    ratio = (round(scale.pick_us_mean / small.pick_us_mean, 2)
+             if small.pick_us_mean else None)
+    result["sublinear"] = {"small_nodes": small_n,
+                           "small_pick_us_mean": small.pick_us_mean,
+                           "scale_pick_us_mean": scale.pick_us_mean,
+                           "ratio": ratio}
+    if ratio is None or ratio >= 2.0:
+        failures.append(f"sublinear: pick cost ratio {ratio} at 4x "
+                        f"fleet (>= 2.0)")
+
+    result["failures"] = failures
+    print(json.dumps(result))
+    try:
+        with open("/tmp/dli_bench_sim.json", "w") as f:
+            json.dump(result, f, indent=1)
+    except OSError:
+        pass
+    if failures:
+        print("sim_scale gate FAILED: " + "; ".join(failures),
+              file=sys.stderr)
+        return 1
+    print(f"sim_scale ok: {reqs} requests / {nodes} nodes in "
+          f"{scale.wall_s}s wall (pick {scale.pick_us_mean}us mean, "
+          f"sublinear ratio {ratio}), adversarial "
+          f"{adv.breaker['opened']} breaker-opens all terminal, "
+          f"determinism twin hash {t1.journal_hash[:12]}",
+          file=sys.stderr)
+    return 0
+
+
+def _sim_calibrate_scenario(argv, opt, smoke):
+    """--scenario sim_calibrate [--smoke]: the observatory's
+    CALIBRATION gate (docs/simulator.md). Runs a small REAL cluster
+    (master + in-proc batched worker), captures its arrival trace from
+    the ``request-submitted`` journal and its cost-ledger rows, fits
+    the synthetic worker model from them, replays the EXACT trace
+    through the simulator, and gates on the sim-vs-real divergence of
+    goodput / TTFT p50 / queue depth staying within the documented
+    tolerances (DLI_SIM_TOL_*). Divergence report lands at
+    /tmp/dli_sim_calibration.json either way — CI keeps a history of
+    how faithful the sim is."""
+    import threading as _th
+    import requests as _rq
+    from distributed_llm_inferencing_tpu.runtime.master import Master
+    from tools.dlisim import (DEFAULT_MODEL, SimConfig,
+                              arrival_trace_from_events,
+                              divergence_report, fit_worker_model,
+                              run_sim)
+
+    n = opt("--requests", 48)
+    conc = opt("--concurrency", 6)
+    max_new = opt("--max-new", 8)
+    tolerances = {
+        "goodput_req_per_s": float(
+            os.environ.get("DLI_SIM_TOL_GOODPUT", 0.5)),
+        "ttft_ms_p50": float(os.environ.get("DLI_SIM_TOL_TTFT", 0.75)),
+        "queue_depth_mean": float(
+            os.environ.get("DLI_SIM_TOL_QUEUE", 1.0)),
+    }
+    result = {"scenario": "sim_calibrate", "smoke": smoke,
+              "requests": n, "tolerances": tolerances}
+
+    workers = _control_plane_workers(1, max_new=max_new)
+    m = Master(":memory:", health_interval=2.0)
+    msrv = m.service.serve("127.0.0.1", 0, background=True)
+    mport = msrv.server_address[1]
+    base = f"http://127.0.0.1:{mport}"
+    done, failed, lock = [], [], _th.Lock()
+    next_i = [0]
+    queue_samples = []
+    sampling = _th.Event()
+
+    def qsampler():
+        # the real-side queue_pending series, same signal the sim
+        # samples at its health cadence
+        while not sampling.wait(0.1):
+            c = m.store.counts()
+            queue_samples.append(c.get("pending", 0))
+
+    def prompt_for(i):
+        # varied prompt sizes so the fitted prefill rate sees a spread
+        # and the replayed trace isn't one degenerate length — but
+        # bounded well under the worker's max_seq=64 (byte tokenizer:
+        # chars ~= tokens) with max_new on top, and short enough that
+        # CPU prefill keeps most requests inside the 2s TTFT SLO on
+        # BOTH sides (a goodput of ~zero makes relative error
+        # meaningless)
+        return f"r{i:02d}:" + "x" * (8 + (i * 5) % 24)
+
+    def client():
+        sess = _rq.Session()
+        while True:
+            with lock:
+                if next_i[0] >= n:
+                    return
+                i = next_i[0]
+                next_i[0] += 1
+            rid = sess.post(f"{base}/api/inference/submit", json={
+                "model_name": "tiny-llama", "prompt": prompt_for(i),
+                "max_new_tokens": max_new,
+                "sampling": {"do_sample": False,
+                             "allow_random_init": True},
+            }).json()["request_id"]
+            poll = 0.02
+            while True:
+                st = sess.get(
+                    f"{base}/api/inference/status/{rid}"
+                ).json()["request"]
+                if st["status"] in ("completed", "failed"):
+                    with lock:
+                        (done if st["status"] == "completed"
+                         else failed).append(st)
+                    break
+                time.sleep(poll)
+                poll = min(0.2, poll * 1.5)
+
+    try:
+        r = _rq.post(f"{base}/api/nodes/add", json={
+            "name": "w0", "host": "127.0.0.1",
+            "port": workers[0][1]}).json()
+        assert r["status"] == "success", r
+        m.start_background()
+        qt = _th.Thread(target=qsampler, daemon=True)
+        qt.start()
+        t0 = time.time()
+        threads = [_th.Thread(target=client) for _ in range(conc)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        wall = time.time() - t0
+        sampling.set()
+        qt.join(timeout=5)
+        trace_rows = m.store.query_events(etype="request-submitted",
+                                          limit=10 * n)
+    finally:
+        m.stop()
+        for agent, _ in workers:
+            agent.service.shutdown()
+
+    costs = [st.get("cost") for st in done if st.get("cost")]
+    ttfts = []
+    for cost in costs:
+        if isinstance(cost, str):
+            try:
+                cost = json.loads(cost)
+            except ValueError:
+                continue
+        q = cost.get("queue_ms") or 0.0
+        p = cost.get("prefill_ms")
+        if p is not None:
+            ttfts.append(q + p)
+    ttfts.sort()
+    real = {
+        "completed": len(done), "failed": len(failed),
+        "wall_s": round(wall, 2),
+        "goodput_req_per_s": _goodput(done, wall)["goodput_req_per_s"],
+        "ttft_ms_p50": (round(ttfts[len(ttfts) // 2], 2)
+                        if ttfts else None),
+        "queue_depth_mean": (round(sum(queue_samples)
+                                   / len(queue_samples), 2)
+                             if queue_samples else None),
+    }
+    trace = arrival_trace_from_events(trace_rows)
+    model = fit_worker_model(costs, base=DEFAULT_MODEL)
+    result["fitted_model"] = {
+        "prefill_ms_per_token": round(model.prefill_ms_per_token, 4),
+        "decode_ms_per_token": round(model.decode_ms_per_token, 4),
+        "overhead_ms": round(model.overhead_ms, 3),
+        "source": model.source,
+    }
+    rep = run_sim(SimConfig(nodes=1, requests=len(trace),
+                            arrivals=trace, slots_per_node=8,
+                            model=model, health_interval_s=2.0,
+                            seed=opt("--seed", 42)))
+    sim = {
+        "completed": rep.completed, "failed": rep.failed,
+        "goodput_req_per_s": rep.goodput_req_per_s,
+        "ttft_ms_p50": rep.ttft_ms_p50,
+        "queue_depth_mean": rep.queue_depth_mean,
+    }
+    div = divergence_report(real, sim, tolerances)
+    result.update({"real": real, "sim": sim, "divergence": div,
+                   "trace_requests": len(trace)})
+    ok = (div["ok"] and len(done) == n and not failed
+          and len(trace) == n and rep.completed == len(trace))
+    print(json.dumps(result))
+    try:
+        with open("/tmp/dli_sim_calibration.json", "w") as f:
+            json.dump(result, f, indent=1)
+    except OSError:
+        pass
+    if not ok:
+        print("sim_calibrate gate FAILED: "
+              + json.dumps(div["metrics"]), file=sys.stderr)
+        return 1
+    print(f"sim_calibrate ok: {len(trace)}-request trace replayed, "
+          + ", ".join(
+              f"{k} real {v['real']} vs sim {v['sim']} "
+              f"(rel_err {v['rel_err']}, tol {v['tolerance']})"
+              for k, v in div["metrics"].items()
+              if v["ok"] is not None),
+          file=sys.stderr)
+    return 0
+
+
 def _scenario_main(argv):
     """`bench.py --scenario {control_plane|prefix_cache|decode_speed|disagg}
     [--smoke|--ab] [--requests N] [--concurrency C] [--workers W]` —
@@ -2063,6 +2363,19 @@ def _scenario_main(argv):
         except Exception:
             pass
         return _ha_scenario(argv, opt, "--smoke" in argv)
+    if name == "sim_scale":
+        # pure virtual-clock simulation: no workers, no JAX, no
+        # compilation cache to warm
+        return _sim_scale_scenario(argv, opt, "--smoke" in argv)
+    if name == "sim_calibrate":
+        # real half of the gate runs an in-proc worker: warm compiles
+        try:
+            from distributed_llm_inferencing_tpu.utils.platform import (
+                enable_compilation_cache)
+            enable_compilation_cache()
+        except Exception:
+            pass
+        return _sim_calibrate_scenario(argv, opt, "--smoke" in argv)
     if name != "control_plane":
         print(json.dumps({"error": f"unknown scenario {name!r}"}))
         return 2

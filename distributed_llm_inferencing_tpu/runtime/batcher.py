@@ -62,7 +62,7 @@ from distributed_llm_inferencing_tpu.parallel.mesh import (
     MeshSpec, create_mesh, validate_spec)
 from distributed_llm_inferencing_tpu.runtime import kvtier as kvtier_mod
 from distributed_llm_inferencing_tpu.runtime import tsdb as tsdb_mod
-from distributed_llm_inferencing_tpu.utils import locks, trace
+from distributed_llm_inferencing_tpu.utils import clock, locks, trace
 from distributed_llm_inferencing_tpu.utils.metrics import Metrics
 from distributed_llm_inferencing_tpu.utils.profiler import PhaseProfiler
 
@@ -86,7 +86,7 @@ class BatchRequest:
     error: Optional[str] = None
     done: threading.Event = dataclasses.field(default_factory=threading.Event)
     # timing
-    submitted_at: float = dataclasses.field(default_factory=time.time)
+    submitted_at: float = dataclasses.field(default_factory=clock.now)
     first_token_at: Optional[float] = None
     finished_at: Optional[float] = None
     # cost ledger: when the FIRST admission wave carrying this request
@@ -1072,7 +1072,7 @@ class ContinuousBatcher:
                 if not self.kvtier.arena.peek(d)]
         if not keep:
             return
-        w0 = time.time()
+        w0 = clock.now()
         idx = np.asarray([ev[j][0] for j in keep], np.int32)
         leaves = [lf for lf in self.paged if lf is not None]
         with self.mesh:
@@ -1091,7 +1091,7 @@ class ContinuousBatcher:
             # the device->host traffic it displaced
             self._admitting._arena_offloaded_bytes += nbytes
         trace.get_tracer().record(
-            "batcher.kv_offload", w0, time.time(),
+            "batcher.kv_offload", w0, clock.now(),
             attrs={"blocks": len(ev), "stored": stored})
 
     def _restore_jit(self, b: int, nleaves: int):
@@ -1178,7 +1178,7 @@ class ContinuousBatcher:
             blocks = blocks[:len(pages)]
         if not blocks:
             return prefix_blocks, cached
-        w0 = time.time()
+        w0 = clock.now()
         self._run_restore(blocks, pages)
         end = start + len(blocks)
         self.pool.insert_prefix(prompt[:end * bs], blocks, skip=start)
@@ -1188,7 +1188,7 @@ class ContinuousBatcher:
             self._admitting._arena_restored_bytes += sum(
                 p.nbytes for pg in pages for p in pg)
         trace.get_tracer().record(
-            "batcher.kv_restore", w0, time.time(),
+            "batcher.kv_restore", w0, clock.now(),
             attrs={"blocks": len(blocks), "tokens": len(blocks) * bs})
         return prefix_blocks + blocks, end * bs
 
@@ -1224,13 +1224,13 @@ class ContinuousBatcher:
         fetcher = self._get_kv_fetcher()
         if fetcher is None:
             return 0
-        w0 = time.time()
+        w0 = clock.now()
         try:
             got = fetcher.fetch(url, model, want)
         except Exception as e:
             self.metrics.inc("kv_transfer_failures")
             trace.get_tracer().record(
-                "batcher.kv_fetch", w0, time.time(),
+                "batcher.kv_fetch", w0, clock.now(),
                 attrs={"peer": url, "error": str(e)[:200]})
             return 0
         # shape-check against the live paged leaves BEFORE the arena
@@ -1253,12 +1253,12 @@ class ContinuousBatcher:
             if self.kvtier.arena.put(d, pages, count_offload=False):
                 blocks += 1
                 bytes_in += sum(p.nbytes for p in pages)
-        elapsed = time.time() - w0
+        elapsed = clock.now() - w0
         self.metrics.inc("kv_transfer_blocks", blocks)
         self.metrics.inc("kv_transfer_bytes", bytes_in)
         self.metrics.inc("kv_transfer_ms", elapsed * 1e3)
         trace.get_tracer().record(
-            "batcher.kv_fetch", w0, time.time(),
+            "batcher.kv_fetch", w0, clock.now(),
             attrs={"peer": url, "blocks": blocks, "bytes": bytes_in})
         return bytes_in
 
@@ -1345,7 +1345,7 @@ class ContinuousBatcher:
                 if not self.kvtier.arena.peek(digs[i])]
         if not keep:
             return
-        w0 = time.time()
+        w0 = clock.now()
         idx = np.asarray([req._blocks[i] for i in keep], np.int32)
         leaves = [lf for lf in self.paged if lf is not None]
         with self.mesh:
@@ -1357,7 +1357,7 @@ class ContinuousBatcher:
                 stored += 1
         self.metrics.inc("kvtier_exported_blocks", stored)
         trace.get_tracer().record(
-            "batcher.kv_export", w0, time.time(),
+            "batcher.kv_export", w0, clock.now(),
             attrs={"blocks": n_full, "stored": stored})
 
     # ---- live in-flight migration ------------------------------------
@@ -1441,7 +1441,7 @@ class ContinuousBatcher:
         }
         req._migrated = True
         req.error = "migrated"
-        req.finished_at = time.time()
+        req.finished_at = clock.now()
         self._observe_finished(req)
         req.done.set()
 
@@ -1673,7 +1673,7 @@ class ContinuousBatcher:
             "steps": steps.tolist(), "temps": temps.tolist(),
             "tks": tks.tolist(), "tps": tps.tolist(), "ds": ds.tolist(),
         }
-        w0 = time.time()
+        w0 = clock.now()
         for m in members:
             # cost ledger: queue phase ends when the FIRST wave carrying
             # the request starts dispatching (chunked-prefill passes and
@@ -1685,7 +1685,7 @@ class ContinuousBatcher:
                                       lambda: self._run_admit(admit_args))
         else:
             first = self._run_admit(admit_args)
-        w1 = time.time()
+        w1 = clock.now()
         self.metrics.observe("batcher_admit_wave", w1 - w0)
         trace.get_tracer().record(
             "batcher.admit_wave", w0, w1,
@@ -1778,7 +1778,7 @@ class ContinuousBatcher:
             self._hist[slot, : len(known)] = known
             self._hist_synced[slot] = 0   # row rewritten: full re-sync
         if req.first_token_at is None:
-            req.first_token_at = time.time()
+            req.first_token_at = clock.now()
         self._emit(req, first)
         if self._hist is not None and req.tokens:
             # the fused-sampled first token extends the history
@@ -1799,7 +1799,7 @@ class ContinuousBatcher:
         stop/error) — same metrics/trace accounting as a normal finish, so
         submitted always reconciles with completed+failed."""
         req.error = req.error or error or "failed"
-        req.finished_at = req.finished_at or time.time()
+        req.finished_at = req.finished_at or clock.now()
         self._observe_finished(req)
         req.done.set()
 
@@ -1808,7 +1808,7 @@ class ContinuousBatcher:
         if req.eos_token_id is not None and token == req.eos_token_id:
             self._finish_req(req)
             return
-        now = time.time()
+        now = clock.now()
         if req._last_emit_at is not None:
             # per-GAP inter-token latency: near-zero inside a chunk's
             # burst, chunk-sized at boundaries, and stall-sized across a
@@ -1850,7 +1850,7 @@ class ContinuousBatcher:
                             getattr(req, "request_tag", "?"), e)
         self.pool.release(req._blocks)
         req._blocks = []
-        req.finished_at = time.time()
+        req.finished_at = clock.now()
         self._observe_finished(req)   # before done.set(): a waiter may
         req.done.set()                # scrape /metrics|/api/trace at once
 
@@ -1898,7 +1898,7 @@ class ContinuousBatcher:
         m.inc("batcher_requests_migrated" if req._migrated
               else "batcher_requests_failed" if req.error
               else "batcher_requests_completed")
-        end = req.finished_at or time.time()
+        end = req.finished_at or clock.now()
         if not req._migrated:
             # a migrated-out request's [submit, handoff) span is not a
             # served request — feeding it into the latency histograms
@@ -2120,7 +2120,7 @@ class ContinuousBatcher:
         emitted."""
         k = int(decode_args["k"])
         budget = decode_args["budget"]
-        w0 = time.time()
+        w0 = clock.now()
         if self.program_hook is not None:
             if self._hist is not None:
                 # adaptive fallback under lockstep: a freshly-admitted
@@ -2134,7 +2134,7 @@ class ContinuousBatcher:
         else:
             toks, emits = self._run_decode(decode_args)
         self._step_count += 1
-        w1 = time.time()
+        w1 = clock.now()
         self.metrics.observe("batcher_decode_chunk", w1 - w0)
         trace.get_tracer().record(
             "batcher.decode_chunk", w0, w1,
@@ -2224,7 +2224,7 @@ class ContinuousBatcher:
             cl_b[i] += k
             st_b[i] += k
         args_b = dict(args_a, cl=cl_b, steps=st_b)
-        w0 = time.time()
+        w0 = clock.now()
         toks_a, emits_a = self._run_decode(args_a, sync=False)
         toks_b, emits_b = self._run_decode(args_b, tokens_dev=toks_a[-1],
                                            sync=False)
@@ -2234,7 +2234,7 @@ class ContinuousBatcher:
         with self.profiler.phase("device_wait"):
             toks_a, emits_a, toks_b, emits_b = jax.device_get(
                 (toks_a, emits_a, toks_b, emits_b))  # ONE sync for the pair
-        w1 = time.time()
+        w1 = clock.now()
         self.metrics.observe("batcher_decode_chunk", (w1 - w0) / 2)
         self.metrics.observe("batcher_decode_chunk", (w1 - w0) / 2)
         trace.get_tracer().record(
@@ -2280,11 +2280,11 @@ class ContinuousBatcher:
             k = int(decode_args["k"])
             compiled = (k, self.slots,
                         self.max_blocks) not in self._decode_fns
-            w0 = time.time()
+            w0 = clock.now()
             emitted = self._dispatch_plain_chunk(active, decode_args)
             if ctl is not None:
                 ctl.record("plain", emitted=emitted,
-                           elapsed_s=time.time() - w0, compiled=compiled)
+                           elapsed_s=clock.now() - w0, compiled=compiled)
             return len([a for a in self.active if a is not None])
 
         g1 = gamma + 1
@@ -2293,7 +2293,7 @@ class ContinuousBatcher:
         spec_key = ("spec", k_it, gamma, self.slots, self.max_blocks,
                     self._hist.shape[1])
         compiled = spec_key not in self._decode_fns
-        w0 = time.time()
+        w0 = clock.now()
         if self.program_hook is not None:
             # the lockstep mirror ships JSON: broadcast only per-slot
             # history deltas (non-empty just after admissions); followers
@@ -2308,7 +2308,7 @@ class ContinuousBatcher:
             args["hist"] = self._hist
             toks, keeps, eos_seen = self._run_spec_decode(args)
         self._step_count += 1
-        w1 = time.time()
+        w1 = clock.now()
         self.metrics.observe("batcher_decode_chunk", w1 - w0)
         trace.get_tracer().record(
             "batcher.spec_chunk", w0, w1,
@@ -2330,7 +2330,7 @@ class ContinuousBatcher:
         m.inc("batcher_tokens_emitted", emitted)
         if ctl is not None:
             ctl.record("spec", emitted=emitted,
-                       elapsed_s=time.time() - w0,
+                       elapsed_s=clock.now() - w0,
                        drafted=gamma * live_iters, accepted=accepted,
                        compiled=compiled)
             if ctl.fallbacks:
@@ -2456,9 +2456,9 @@ class ContinuousBatcher:
                         self.max_blocks) not in self._decode_fns
             reqs = {i: self.active[i] for i in active}
             before = {i: len(r.tokens) for i, r in reqs.items()}
-            w0 = time.time()
+            w0 = clock.now()
             self._dispatch_plain_chunk(active, decode_args)
-            dt = time.time() - w0
+            dt = clock.now() - w0
             for i, req in reqs.items():
                 if req._spec_ctl is not None:
                     req._spec_ctl.record(
@@ -2474,7 +2474,7 @@ class ContinuousBatcher:
         spec_key = ("spec", k_it, g_max, self.slots, self.max_blocks,
                     self._hist.shape[1])
         compiled = spec_key not in self._decode_fns
-        w0 = time.time()
+        w0 = clock.now()
         if self.program_hook is not None:
             # lockstep: widths are scheduler decisions, so they ride the
             # broadcast args; history still ships as per-slot deltas
@@ -2488,7 +2488,7 @@ class ContinuousBatcher:
             toks, keeps, eos_seen = self._run_spec_decode(args)
         self._step_count += 1
         self._spec_wave_dispatches += 1
-        w1 = time.time()
+        w1 = clock.now()
         m.inc("spec_wave_dispatches")
         m.observe("batcher_decode_chunk", w1 - w0)
         trace.get_tracer().record(

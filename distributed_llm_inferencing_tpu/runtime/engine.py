@@ -38,7 +38,7 @@ from distributed_llm_inferencing_tpu.ops.sampling import SamplingParams, sample
 from distributed_llm_inferencing_tpu.parallel import sharding as shd
 from distributed_llm_inferencing_tpu.parallel.mesh import (
     MeshSpec, create_mesh, validate_spec)
-from distributed_llm_inferencing_tpu.utils import trace
+from distributed_llm_inferencing_tpu.utils import clock, trace
 from distributed_llm_inferencing_tpu.utils.metrics import Metrics
 
 PREFILL_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192)
@@ -432,7 +432,7 @@ class InferenceEngine:
             if prefill_fresh:
                 self._prefill_fns[s0] = self._build_prefill(s0)
             t0 = time.perf_counter()
-            wt0 = time.time()
+            wt0 = clock.now()
             last_logits, cache = self._prefill_fns[s0](
                 self.params, jnp.asarray(tokens), lengths, cache)
             key = jax.random.PRNGKey(seed)
@@ -448,7 +448,7 @@ class InferenceEngine:
             if incremental:
                 cur.block_until_ready()
             t1 = time.perf_counter()
-            wt1 = time.time()
+            wt1 = clock.now()
 
             steps = 1
             remaining = max_new_tokens - 1
@@ -532,7 +532,7 @@ class InferenceEngine:
                     steps += T
                     remaining -= T
             t2 = time.perf_counter()
-            wt2 = time.time()
+            wt2 = clock.now()
 
         out = out[:n_real]  # drop dp-padding rows
         # trim trailing eos
@@ -620,7 +620,7 @@ class InferenceEngine:
             if prefill_fresh:
                 self._prefill_fns[s0] = self._build_prefill(s0)
             t0 = time.perf_counter()
-            wt0 = time.time()
+            wt0 = clock.now()
             last_logits, cache = self._prefill_fns[s0](
                 self.params, jnp.asarray(tokens),
                 jnp.asarray([len(prompt)], jnp.int32), cache)
@@ -628,7 +628,7 @@ class InferenceEngine:
             key, sub = jax.random.split(key)
             cur = int(sample(last_logits, sub, sp)[0])
             t1 = time.perf_counter()
-            wt1 = time.time()
+            wt1 = clock.now()
 
             hit_eos = eos_token_id is not None and cur == eos_token_id
             out: List[int] = [] if hit_eos else [cur]
@@ -713,7 +713,7 @@ class InferenceEngine:
                     for j, t in enumerate(kept):
                         stream_cb(len(out) - len(kept) + j, [t])
             t2 = time.perf_counter()
-            wt2 = time.time()
+            wt2 = clock.now()
 
         self._observe_generate(
             wt0, wt1, wt2, t1 - t0, t2 - t1, steps,

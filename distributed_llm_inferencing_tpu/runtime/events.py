@@ -38,10 +38,9 @@ import collections
 import json
 import logging
 import os
-import time
 from typing import Dict, NamedTuple, Optional, Tuple
 
-from distributed_llm_inferencing_tpu.utils import locks
+from distributed_llm_inferencing_tpu.utils import clock, locks
 
 log = logging.getLogger("dli_tpu.events")
 
@@ -98,6 +97,15 @@ EVENT_TYPES = (
         "on the stale snapshot (was a log.warning-only path before the "
         "flight recorder).", ("error",)),
     # ---- scheduling / dispatch ---------------------------------------
+    EventType(
+        "request-submitted", "info",
+        "A request entered the queue. The event's own ts is the "
+        "arrival timestamp and the data carries the workload shape "
+        "(prompt length, token budget), so the journal doubles as a "
+        "replayable arrival trace: tools/dlisim reconstructs a real "
+        "run's workload from exactly these rows (a debug bundle is "
+        "sim-replayable because collect_debug_bundle.sh exports them).",
+        ("model", "prompt_chars", "max_new_tokens", "max_length")),
     EventType(
         "request-park", "warning",
         "No schedulable node for a claimed request: parked behind a "
@@ -294,7 +302,7 @@ class EventJournal:
         if sev not in SEVERITIES:
             raise ValueError(f"unknown severity {sev!r}")
         ev = {
-            "ts": time.time() if t is None else float(t),
+            "ts": clock.now() if t is None else float(t),
             "type": etype,
             "severity": sev,
             "node_id": int(node_id) if node_id is not None else None,

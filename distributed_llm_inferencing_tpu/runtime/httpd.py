@@ -13,11 +13,10 @@ import json
 import os
 import re
 import threading
-import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional, Tuple
 
-from distributed_llm_inferencing_tpu.utils import locks, trace
+from distributed_llm_inferencing_tpu.utils import clock, locks, trace
 from distributed_llm_inferencing_tpu.utils.faults import FaultInjector
 
 
@@ -152,10 +151,10 @@ class JsonHTTPService:
                 dispatch should follow."""
                 import socket
                 if f.mode == "latency":
-                    time.sleep(f.delay_s)
+                    clock.sleep(f.delay_s)
                     return False      # then handle the request normally
                 if f.delay_s:
-                    time.sleep(f.delay_s)
+                    clock.sleep(f.delay_s)
                 self.close_connection = True
                 if f.mode == "corrupt":
                     body = b"#!<<injected corrupt body; not JSON>>"

@@ -45,7 +45,7 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from distributed_llm_inferencing_tpu.utils import locks
+from distributed_llm_inferencing_tpu.utils import clock, locks
 
 log = logging.getLogger("dli.kvwire")
 
@@ -288,10 +288,10 @@ class KVFetchClient:
         import time as _time
         import requests as http
         if f.mode == "latency":
-            _time.sleep(f.delay_s)
+            _clock.sleep(f.delay_s)
             return
         if f.delay_s:
-            _time.sleep(f.delay_s)
+            _clock.sleep(f.delay_s)
         if f.mode == "timeout":
             raise http.exceptions.ReadTimeout("injected kv_fetch timeout")
         raise http.exceptions.ConnectionError("injected kv_fetch fault")

@@ -25,7 +25,6 @@ import math
 import os
 import random
 import threading
-import time
 from typing import Dict, Optional, Set
 
 import requests as http
@@ -37,7 +36,7 @@ from distributed_llm_inferencing_tpu.runtime import tsdb as tsdb_mod
 from distributed_llm_inferencing_tpu.runtime.kvtier import (
     estimate_cached_tokens)
 from distributed_llm_inferencing_tpu.runtime.state import Store
-from distributed_llm_inferencing_tpu.utils import faults, locks, trace
+from distributed_llm_inferencing_tpu.utils import clock, faults, locks, trace
 from distributed_llm_inferencing_tpu.utils.logging import setup_logging
 from distributed_llm_inferencing_tpu.utils.metrics import (
     Metrics, hist_quantile, parse_prometheus, sanitize_name)
@@ -93,6 +92,16 @@ SCHED_STALE_S = float(os.environ.get("DLI_SCHED_STALE_S", 30.0))
 # (w * est >= 1 token to act); 0 disables affinity entirely.
 SCHED_PREFIX_WEIGHT = float(os.environ.get("DLI_SCHED_PREFIX_WEIGHT", 1.0))
 SCHED_PREFIX_SLACK = int(os.environ.get("DLI_SCHED_PREFIX_SLACK", 2))
+# Power-of-d-choices candidate sampling: past this fleet size a pick
+# scores a random sample of SAMPLE candidates instead of every node, so
+# per-pick scheduler cost stays O(sample) as the fleet grows (the
+# 1000-node sim scale gate's sub-linearity bar, tools/dlisim). Fleets
+# at or under the cap — every production/test fleet this container can
+# actually run — score every candidate, byte-identically to the
+# pre-sampling policy. A sampled pick that finds no schedulable
+# candidate falls back to the full scan: sampling may cost a pick
+# quality epsilon, never a spurious "no node". 0 disables sampling.
+SCHED_SAMPLE = int(os.environ.get("DLI_SCHED_SAMPLE", 128))
 # Disaggregated prefill/decode pools (FlowKV, docs/architecture.md
 # "Disaggregation"): when the fleet declares role-split workers
 # (DLI_WORKER_ROLE on the worker), a long prompt runs its prefill pass
@@ -213,6 +222,7 @@ class Master:
                  rpc_pool_size: int = RPC_POOL_SIZE,
                  prefix_weight: Optional[float] = None,
                  prefix_slack: Optional[int] = None,
+                 sched_sample: Optional[int] = None,
                  disagg: Optional[bool] = None,
                  disagg_min_prompt: Optional[int] = None,
                  disagg_recompute_floor_ms: Optional[float] = None,
@@ -262,6 +272,14 @@ class Master:
                                else float(prefix_weight))
         self._prefix_slack = (SCHED_PREFIX_SLACK if prefix_slack is None
                               else int(prefix_slack))
+        # power-of-d candidate sampling (instance-level for the same
+        # A/B reason). Its RNG is private and fixed-seeded: the pick
+        # stream must not perturb (or be perturbed by) the global
+        # random module's jitter stream, or two identically-seeded sim
+        # runs would diverge on backoff schedules.
+        self._sched_sample = (SCHED_SAMPLE if sched_sample is None
+                              else int(sched_sample))
+        self._pick_rng = random.Random(0xD11C)
         # disaggregated prefill/decode policy knobs (instance-level so a
         # bench can A/B disagg on/off against one process)
         self._disagg = DISAGG if disagg is None else bool(disagg)
@@ -322,7 +340,7 @@ class Master:
         # history span restarts instead of dying with the process.
         self._tsdb_snapshot_s = (TSDB_SNAPSHOT_S if tsdb_snapshot_s is None
                                  else float(tsdb_snapshot_s))
-        self._tsdb_last_snap = time.time()
+        self._tsdb_last_snap = clock.now()
         raw = self.store.get_meta("tsdb_snapshot")
         if raw:
             try:
@@ -388,6 +406,7 @@ class Master:
         for name in ("scheduler_pick_role_prefill",
                      "scheduler_pick_role_decode",
                      "scheduler_pick_arena_full_avoided",
+                     "scheduler_pick_sampled",
                      "scheduler_disagg_transfer",
                      "scheduler_disagg_recompute",
                      "disagg_prefill_failed",
@@ -609,10 +628,10 @@ class Master:
         if f is None:
             return
         if f.mode == "latency":
-            time.sleep(f.delay_s)
+            clock.sleep(f.delay_s)
             return
         if f.delay_s:
-            time.sleep(f.delay_s)
+            clock.sleep(f.delay_s)
         if f.mode == "timeout":
             raise http.exceptions.ReadTimeout("injected rpc timeout")
         raise http.exceptions.ConnectionError("injected rpc fault")
@@ -756,7 +775,7 @@ class Master:
             self.store.update_node(existing["id"], is_active=1,
                                    consecutive_failures=0,
                                    breaker_state="closed", draining=0,
-                                   last_heartbeat=time.time(), info=info)
+                                   last_heartbeat=clock.now(), info=info)
             events.emit("node-added", node_id=existing["id"], name=name,
                         host=host, port=port, readded=True)
             return {"status": "success", "node_id": existing["id"],
@@ -768,7 +787,7 @@ class Master:
             return 400, {"status": "error",
                          "message": f"node name {name!r} already registered "
                                     "at a different address"}
-        self.store.update_node(node_id, last_heartbeat=time.time(), info=info)
+        self.store.update_node(node_id, last_heartbeat=clock.now(), info=info)
         log.info("node %s added: %s:%d", name, host, port)
         events.emit("node-added", node_id=node_id, name=name, host=host,
                     port=port, readded=False)
@@ -810,7 +829,7 @@ class Master:
         for n in self.store.list_nodes():
             info = json.loads(n.get("info") or "{}")
             rt = self._node_runtime.get(n["id"]) or {}
-            rt_fresh = bool(rt) and (time.time() - rt.get("at", 0)
+            rt_fresh = bool(rt) and (clock.now() - rt.get("at", 0)
                                      <= SCHED_STALE_S)
             ewma = self._node_lat_ewma.get(n["id"])
             # per-node radix prefix-hit ratio (averaged over the node's
@@ -951,6 +970,15 @@ class Master:
         req_id = self.store.submit_request(
             model, prompt, max_new, body.get("sampling"),
             max_length=max_length, client_tag=ctag)
+        # workload capture (docs/simulator.md "Fitting inputs"): the
+        # journal row IS the replayable arrival record — its ts is the
+        # arrival time, its data the workload shape — so any debug
+        # bundle (or live journal read) reconstructs the run's arrival
+        # trace for dlisim without a second bookkeeping path
+        events.emit("request-submitted", request_id=req_id, model=model,
+                    prompt_chars=len(prompt) if isinstance(prompt, str)
+                    else None,
+                    max_new_tokens=max_new, max_length=max_length)
         # HA durability barrier (DLI_HA_REPL_BARRIER): an acked submit
         # survives the leader's death — the row is on a standby before
         # the client sees the request id. Bounded wait; no-op when the
@@ -1178,12 +1206,22 @@ class Master:
     def api_events(self, body):
         """Filtered read of the durable event journal:
         ``?type=<event-type>&node=<node_id>&request=<req_id>&since=<epoch>
-        &limit=<n>`` — the postmortem entry point the runbook starts
-        from (docs/robustness.md). Events are oldest-first within the
-        newest ``limit`` matches; node ids are enriched with the
-        registered node name."""
+        &since_seq=<seq>&limit=<n>`` — the postmortem entry point the
+        runbook starts from (docs/robustness.md). Events are
+        oldest-first within the newest ``limit`` matches; node ids are
+        enriched with the registered node name.
+
+        Pagination chains on ``seq`` (the journal row's autoincrement
+        id, unique and monotone in emit order): pass the response's
+        ``next_seq`` back as ``since_seq`` for the strictly-following
+        page. ``since`` stays accepted for compatibility, but it is a
+        wall-clock ``ts>=`` filter — two events stamped in the same
+        second get skipped or double-served across ``since``-chained
+        pages, which is exactly what ``since_seq`` fixes."""
         try:
             since = float(body["since"]) if body.get("since") else None
+            since_seq = (int(body["since_seq"]) if body.get("since_seq")
+                         else None)
             limit = int(body.get("limit") or 200)
             node_id = int(body["node"]) if body.get("node") else None
             req_id = (int(body["request"]) if body.get("request")
@@ -1205,13 +1243,20 @@ class Master:
             log.warning("journal flush before /api/events failed: %r", e)
         evs = self.store.query_events(etype=etype, node_id=node_id,
                                       request_id=req_id, since=since,
-                                      limit=limit)
+                                      since_seq=since_seq, limit=limit)
         names = {n["id"]: n["name"] for n in self.store.list_nodes()}
         for ev in evs:
             if ev.get("node_id") in names:
                 ev["node"] = names[ev["node_id"]]
+            # the cursor rides every row under its API name; the raw
+            # column stays too (journey/debug consumers read rows as-is)
+            if ev.get("id") is not None:
+                ev["seq"] = ev["id"]
         return {"status": "success", "count": len(evs),
-                "journal": self.events.counts(), "events": evs}
+                "journal": self.events.counts(),
+                "next_seq": (evs[-1]["seq"] if evs
+                             and evs[-1].get("seq") is not None else None),
+                "events": evs}
 
     def api_request_journey(self, body, req_id):
         """One time-ordered merged view of a request's whole life:
@@ -1267,7 +1312,7 @@ class Master:
         # event itself carries no request id — merge the ones inside
         # the request's window (±1s slack for clock/commit skew)
         t0 = r["created_at"] or 0.0
-        t1 = r.get("completed_at") or time.time()
+        t1 = r.get("completed_at") or clock.now()
         if involved:
             # both window ends are server-side filters: a newest-N page
             # since t0 would cut the oldest (= in-window) rows on a
@@ -1353,15 +1398,15 @@ class Master:
         registry. One failed/slow node costs its scrape only — the
         other nodes' samples land regardless."""
         while not self._stop.is_set():
-            t_next = time.time() + self.tsdb.step_s
+            t_next = clock.now() + self.tsdb.step_s
             try:
                 self._telemetry_sweep()
             except Exception as e:   # the loop must survive anything
                 log.debug("telemetry sweep failed: %s", e)
-            self._stop.wait(max(0.05, t_next - time.time()))
+            self._stop.wait(max(0.05, t_next - clock.now()))
 
     def _telemetry_sweep(self):
-        now = time.time()
+        now = clock.now()
         nodes = self.store.list_nodes()
         active = [n for n in nodes if n.get("is_active")]
         for n, r, err in self._scrape_workers("/metrics", nodes=active):
@@ -1522,28 +1567,40 @@ class Master:
                 # keep the last full /health body's role
                 role = prev.get("role")
         queue = free = occ = None
+        digests = False
         for st in models.values():
             queue = (queue or 0) + st["queue"]
             if st["free"] is not None:
                 free = st["free"] if free is None else min(free, st["free"])
             if st.get("arena_occ") is not None:
                 occ = max(occ or 0.0, st["arena_occ"])
+            if "digests" in st:
+                digests = True
         if occ is None and isinstance(
                 info.get("arena_occupancy"), (int, float)):
             occ = float(info["arena_occupancy"])
+        # "any model advertises digest chains" is precomputed here so
+        # _score_pick can skip its whole prefix-affinity scan — an
+        # estimate_cached_tokens call per candidate per pick — when no
+        # candidate has anything warm to advertise (the common case on
+        # engine-mode fleets, and every pick at 1000-node sim scale)
         self._node_runtime[node_id] = {
             "queue": queue, "free_blocks": free, "arena_occ": occ,
-            "role": role, "at": time.time(), "models": models}
+            "role": role, "at": clock.now(), "models": models,
+            "digests_any": digests}
 
-    def _node_role(self, node) -> str:
+    def _node_role(self, node, now: Optional[float] = None) -> str:
         """The worker's declared serving role (prefill|decode|mixed).
         The FRESH runtime snapshot wins — a rebalancer flip must steer
         routing from the next health sweep, not the next registration —
         with the persisted info blob as the fallback for nodes never
-        scraped this run (memoized on the row dict like _node_models)."""
+        scraped this run (memoized on the row dict like _node_models).
+        ``now`` lets a caller scoring a whole candidate pool read the
+        clock once instead of per node."""
         s = self._node_runtime.get(node["id"])
         if (s and s.get("role")
-                and time.time() - s["at"] <= SCHED_STALE_S):
+                and (clock.now() if now is None else now) - s["at"]
+                <= SCHED_STALE_S):
             return str(s["role"])
         cached = node.get("_role")
         if cached is None:
@@ -1563,7 +1620,7 @@ class Master:
 
     def _arena_occ(self, node_id: int) -> Optional[float]:
         s = self._node_runtime.get(node_id)
-        if not s or time.time() - s["at"] > SCHED_STALE_S:
+        if not s or clock.now() - s["at"] > SCHED_STALE_S:
             return None
         return s.get("arena_occ")
 
@@ -1619,24 +1676,45 @@ class Master:
         least-in-flight rule. Returns (node, reason) — the reason feeds
         the ``scheduler_pick_*`` counters so the policy is observable.
         Caller holds ``_inflight_lock``."""
-        now = time.time()
+        now = clock.now()
+        inflight = self._inflight
         rt = {}
+        loads = {}   # primary load per candidate, computed exactly once
+        digests_any = False
         for n in cands:
-            s = self._node_runtime.get(n["id"])
+            nid = n["id"]
+            infl = inflight.get(nid, 0)
+            s = self._node_runtime.get(nid)
             if s and now - s["at"] <= SCHED_STALE_S and \
                     s.get("queue") is not None:
-                rt[n["id"]] = s
+                rt[nid] = s
+                da = s.get("digests_any")
+                if da is None:
+                    # snapshot written directly (tests, older peers)
+                    # without the precomputed flag: derive once and
+                    # memoize on the dict
+                    da = any("digests" in st
+                             for st in (s.get("models") or {}).values())
+                    s["digests_any"] = da
+                if da:
+                    digests_any = True
+                q = s["queue"]
+                loads[nid] = infl if infl > q else q
+            else:
+                loads[nid] = infl
         if not rt:
-            return min(cands, key=lambda n: self._inflight.get(n["id"], 0)), \
+            return min(cands, key=lambda n: inflight.get(n["id"], 0)), \
                 "fallback"
 
         def primary(n):
-            s = rt.get(n["id"])
-            return max(self._inflight.get(n["id"], 0),
-                       s["queue"] if s else 0)
+            return loads[n["id"]]
 
-        lo = min(primary(n) for n in cands)
-        if prompt and model and self._prefix_weight > 0 and len(cands) > 1:
+        lo = min(loads[n["id"]] for n in cands)
+        if prompt and model and digests_any \
+                and self._prefix_weight > 0 and len(cands) > 1:
+            # digests_any gate: with no fresh digest advertisement in
+            # the pool every estimate is zero and the scan below is
+            # pure overhead — skipping it is behavior-identical
             memo: Dict[int, list] = {}   # prompt digest chains per chunk
             aff = []
             for n in cands:
@@ -1655,7 +1733,7 @@ class Master:
                 best = max(e for e, _ in aff)
                 top = [n for e, n in aff if e == best]
                 return min(top, key=primary), "prefix_affinity"
-        tied = [n for n in cands if primary(n) == lo]
+        tied = [n for n in cands if loads[n["id"]] == lo]
         if len(tied) == 1:
             return tied[0], "queue_depth"
         free = {n["id"]: (rt.get(n["id"]) or {}).get("free_blocks")
@@ -1707,10 +1785,41 @@ class Master:
         store query per WAVE replaces one per request (the in-flight
         counts that make picks diverge live in memory, not in the
         snapshot).
+
+        Fleets larger than ``sched_sample`` (DLI_SCHED_SAMPLE) go
+        through power-of-d-choices sampling: the pick scores a
+        fixed-size random sample, so per-pick cost stays O(sample) at
+        1000 nodes (the sim scale gate's sub-linearity bar) while
+        load-awareness degrades only by the usual two-choices epsilon.
+        The pinned node always joins the sample (a sticky retry MUST
+        reach the node holding its in-flight generation), and an empty
+        sampled pick falls back to the full scan — sampling can cost
+        pick quality, never a spurious "no schedulable node".
         """
         exclude = exclude or set()
         if nodes is None:
             nodes = self.store.list_nodes(active_only=True)
+        cap = self._sched_sample
+        if cap and len(nodes) > cap:
+            pool = self._pick_rng.sample(nodes, cap)
+            if prefer is not None \
+                    and all(n["id"] != prefer for n in pool):
+                pool = pool + [n for n in nodes if n["id"] == prefer]
+            chosen = self._pick_from(pool, model, exclude, reserve,
+                                     prefer, prompt, role)
+            if chosen is not None:
+                self.metrics.inc("scheduler_pick_sampled")
+                return chosen
+            # the sample held no schedulable candidate (every sampled
+            # node open/draining/excluded): correctness demands the
+            # full scan before declaring the fleet unschedulable
+        return self._pick_from(nodes, model, exclude, reserve, prefer,
+                               prompt, role)
+
+    def _pick_from(self, nodes, model, exclude, reserve, prefer,
+                   prompt, role):
+        """The pick policy proper, over an explicit candidate list (the
+        whole snapshot, or :meth:`_pick_node`'s sample)."""
         nodes = [n for n in nodes if not n.get("draining")]
         if role:
             # role pools (docs/architecture.md "Disaggregation"): keep
@@ -1719,9 +1828,22 @@ class Master:
             # still holds the in-flight generation), and an empty
             # role-compatible pool falls back to everyone — better a
             # wrong-role node than a spurious terminal failure.
-            keep = [n for n in nodes
-                    if self._role_ok(self._node_role(n), role)
-                    or n["id"] == prefer]
+            now = clock.now()
+            nr = self._node_runtime
+            keep = []
+            for n in nodes:
+                nid = n["id"]
+                # inlined _node_role fast path (fresh runtime snapshot
+                # wins): one method call per candidate per pick is the
+                # single hottest line at 1000-node fleet scale
+                s = nr.get(nid)
+                if s is not None and s.get("role") \
+                        and now - s["at"] <= SCHED_STALE_S:
+                    r = s["role"]
+                else:
+                    r = self._node_role(n, now)
+                if r == "mixed" or r == role or nid == prefer:
+                    keep.append(n)
             if keep:
                 if len(keep) < len(nodes):
                     self.metrics.inc(f"scheduler_pick_role_{role}")
@@ -1736,24 +1858,32 @@ class Master:
                 self.metrics.inc("scheduler_pick_arena_full_avoided")
                 nodes = ok
         with self._inflight_lock:
-            def probe_ok(n):
-                if faults.mutation_enabled("half_open_probe"):
-                    # dliverify mutation gate (docs/static_analysis.md):
-                    # drop the half-open single-probe guard — the PR 2
-                    # bug where two dispatchers could both probe a
-                    # recovering node. Test-only flag, never set in prod.
-                    return True
-                return ((n.get("breaker_state") or "closed") != "half_open"
-                        or self._inflight.get(n["id"], 0) == 0)
-
-            usable = [n for n in nodes if probe_ok(n)]
+            inflight = self._inflight
+            if faults.mutation_enabled("half_open_probe"):
+                # dliverify mutation gate (docs/static_analysis.md):
+                # drop the half-open single-probe guard — the PR 2
+                # bug where two dispatchers could both probe a
+                # recovering node. Test-only flag, never set in prod.
+                # (Checked once per pick, not per candidate: the env
+                # lookup is measurable at 1000-node fleet scale.)
+                usable = list(nodes)
+            else:
+                usable = [n for n in nodes
+                          if (n.get("breaker_state") or "closed")
+                          != "half_open"
+                          or inflight.get(n["id"], 0) == 0]
             for pool in ([n for n in usable if n["id"] not in exclude],
                          usable):
                 if not pool:
                     continue
                 pinned = [n for n in pool if n["id"] == prefer]
+                # n["_models"] inlines _node_models' memo fast path:
+                # the method-call overhead alone is visible when every
+                # pick filters a 128-candidate sample
                 have = pinned or [n for n in pool
-                                  if model and model in self._node_models(n)]
+                                  if model and model in
+                                  (n.get("_models")
+                                   or self._node_models(n))]
                 if pinned:
                     chosen, reason = pinned[0], "pinned"
                 else:
@@ -1781,7 +1911,7 @@ class Master:
             node["info"] = json.dumps(info)
             self.store.update_node(
                 node["id"], info=info, is_active=1,
-                consecutive_failures=0, last_heartbeat=time.time())
+                consecutive_failures=0, last_heartbeat=clock.now())
         except Exception as e:
             # dispatch proceeds on the stale snapshot; the health loop
             # refreshes the row next interval — but a store UPDATE
@@ -1808,7 +1938,7 @@ class Master:
                 # first attempt only (on a failover retry, created_at->now
                 # covers the failed execution, not queueing)
                 tracer.record("master.queued", req["created_at"],
-                              time.time(), parent=trace.current())
+                              clock.now(), parent=trace.current())
             return self._execute_on_node(req, node)
 
     def _trace_done(self, req_id: int):
@@ -1978,7 +2108,7 @@ class Master:
             # a retry hit the worker's completed-result cache: the
             # generation ran exactly once despite >1 dispatch
             self.metrics.inc("requests_idempotent_replayed")
-        now = time.time()
+        now = clock.now()
         self.metrics.observe("request_latency", now - req["created_at"])
         if req.get("started_at"):
             self._note_latency(nid, now - req["started_at"])
@@ -2370,7 +2500,7 @@ class Master:
                 open_subs.clear()
                 return
             tracer = trace.get_tracer()
-            t_dispatch = time.time()
+            t_dispatch = clock.now()
             sub_bodies = []
             for r_ in reqs:
                 sb = self._infer_body(r_)
@@ -2445,7 +2575,7 @@ class Master:
                             # in ITS trace (ctx is freed by _finish_sub
                             # on terminal states — record first)
                             tracer.record(
-                                "master.execute", t_dispatch, time.time(),
+                                "master.execute", t_dispatch, clock.now(),
                                 parent=ctx,
                                 attrs={"req_id": req["id"], "model": model,
                                        "attempt": req["attempts"],
@@ -2552,7 +2682,7 @@ class Master:
         # the decision floor, the transfer round trip isn't worth it
         memo: Dict[int, list] = {}
         warm = 0
-        now = time.time()
+        now = clock.now()
         for n in nodes:
             if not self._role_ok(self._node_role(n), "decode"):
                 continue
@@ -2622,7 +2752,7 @@ class Master:
         ctx = self._trace_ctx.get(req["id"])
         ok_prefill = False
         fail_error, fail_status = None, None
-        t0 = time.time()
+        t0 = clock.now()
         try:
             try:
                 err = self._ensure_model_loaded(pnode, req["model_name"],
@@ -2688,7 +2818,7 @@ class Master:
             # re-prefill
             self.store.set_kv_source(req["id"], req["_kv_source"])
             self.metrics.observe("disagg_prefill_phase",
-                                 time.time() - t0)
+                                 clock.now() - t0)
         else:
             self.metrics.inc("disagg_prefill_failed")
             # phase-1 degradation to recompute: journaled with the
@@ -2812,7 +2942,7 @@ class Master:
             procs.setdefault(node["id"], []).append((rid, node))
         if not procs:
             return
-        now = time.time()
+        now = clock.now()
         nodes = self.store.list_nodes()
         draining = {n["id"] for n in nodes if n.get("draining")}
         alive = [n for n in nodes if n.get("is_active")
@@ -2894,7 +3024,7 @@ class Master:
         pool never does (every full request needs a decode-capable
         node). Sustained arena-occupancy thrash on a prefill node
         counts as pool pressure even at zero queue depth."""
-        now = time.time()
+        now = clock.now()
         nodes = [n for n in self.store.list_nodes(active_only=True)
                  if not n.get("draining")]
         if len(nodes) < 2:
@@ -2984,7 +3114,7 @@ class Master:
             log.warning("role flip of node %d to %s refused: %s",
                         node["id"], new_role, r.text[:200])
             return False
-        self._last_flip[node["id"]] = time.time()
+        self._last_flip[node["id"]] = clock.now()
         self.metrics.inc("rebalancer_role_flips")
         log.info("rebalancer flipped node %d (%s) -> role %s",
                  node["id"], node.get("name"), new_role)
@@ -3010,7 +3140,7 @@ class Master:
         fields = {"consecutive_failures": strikes}
         if state == "half_open" or strikes >= FAILURE_STRIKES:
             fields.update(breaker_state="open", is_active=0,
-                          breaker_opened_at=time.time())
+                          breaker_opened_at=clock.now())
             if state != "open":
                 self.metrics.inc("breaker_opened")
                 log.warning("node %d breaker OPEN (%s, %d strikes)",
@@ -3142,7 +3272,7 @@ class Master:
                         events.emit("node-drain", node_id=n["id"],
                                     draining=bool(draining))
                     fields = {"info": info,
-                              "last_heartbeat": time.time(),
+                              "last_heartbeat": clock.now(),
                               "draining": draining}
                     if state == "open":
                         # the fault cleared: schedulable again, but

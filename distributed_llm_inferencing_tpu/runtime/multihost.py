@@ -44,7 +44,7 @@ from typing import Callable, Dict, List, Optional
 import requests as http
 
 from distributed_llm_inferencing_tpu.runtime import httpd
-from distributed_llm_inferencing_tpu.utils import locks
+from distributed_llm_inferencing_tpu.utils import clock, locks
 from distributed_llm_inferencing_tpu.utils.logging import setup_logging
 
 log = setup_logging("multihost")
@@ -336,7 +336,7 @@ class LockstepLeader:
 
     def _recovery_loop(self):
         while True:
-            time.sleep(RECOVERY_POLL_S)
+            clock.sleep(RECOVERY_POLL_S)
             with self._mirror_lock:
                 if not self._degraded:
                     return

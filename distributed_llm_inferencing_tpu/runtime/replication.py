@@ -54,12 +54,11 @@ import json
 import logging
 import os
 import threading
-import time
 import uuid
 from typing import Dict, List, Optional, Tuple
 
 from distributed_llm_inferencing_tpu.runtime import events
-from distributed_llm_inferencing_tpu.utils import locks
+from distributed_llm_inferencing_tpu.utils import clock, locks
 
 log = logging.getLogger("dli_tpu.replication")
 
@@ -256,7 +255,7 @@ class HAController:
         else:
             # standby boot grace: give an existing leader rank+2 lease
             # intervals to reach us before the takeover monitor fires
-            self._lease_deadline = time.time() + self.lease_s * (
+            self._lease_deadline = clock.now() + self.lease_s * (
                 2 + self._rank())
 
     # ---- identity -----------------------------------------------------
@@ -310,7 +309,7 @@ class HAController:
             # submit 503s so the client retries against the current
             # leader; a dispatch-tail write is already fenced).
             return False
-        if time.time() < self._barrier_down_until:
+        if clock.now() < self._barrier_down_until:
             # degraded mode (journaled when the wait that armed it
             # timed out): the peer is effectively dead — paying the
             # two-lease timeout on EVERY write would wedge throughput
@@ -323,7 +322,7 @@ class HAController:
         if target == 0:
             return True
         self._ship_wake.set()
-        deadline = time.time() + 2 * self.lease_s
+        deadline = clock.now() + 2 * self.lease_s
         with self._ack_cv:
             while True:
                 if any(p.acked >= target for p in self._peers.values()):
@@ -335,11 +334,11 @@ class HAController:
                     # per blocked thread or arm the degrade circuit
                     # for a lag that isn't one
                     return False
-                remaining = deadline - time.time()
+                remaining = deadline - clock.now()
                 if remaining <= 0:
                     break
                 self._ack_cv.wait(timeout=min(remaining, 0.05))
-        now = time.time()
+        now = clock.now()
         self._barrier_down_until = now + 2 * self.lease_s
         self.master.metrics.inc("repl_barrier_timeouts")
         self._note_lag(now, forced=True)
@@ -409,7 +408,7 @@ class HAController:
             except Exception as e:
                 log.debug("replication sweep failed: %r", e)
             try:
-                if not self.leader and time.time() > self._lease_deadline:
+                if not self.leader and clock.now() > self._lease_deadline:
                     self._takeover()
             except Exception as e:
                 log.warning("lease takeover attempt failed: %r", e)
@@ -475,7 +474,7 @@ class HAController:
         heartbeat) CONCURRENTLY — from one sequential loop, a dead
         peer's connect timeout (up to 2s) would starve the live peers'
         lease renewals and promote a healthy standby in N>=3 fleets."""
-        now = time.time()
+        now = clock.now()
         peers = list(self._peers.values())
         if len(peers) <= 1:
             for peer in peers:
@@ -552,7 +551,7 @@ class HAController:
             peer.cursor = applied
             with self._ack_cv:
                 peer.acked = max(peer.acked, applied)
-                peer.last_ack_at = time.time()
+                peer.last_ack_at = clock.now()
                 if peer.acked >= self.oplog.seq():
                     # caught back up: re-arm the durability barrier
                     self._barrier_down_until = 0.0
@@ -604,7 +603,7 @@ class HAController:
             except (TypeError, ValueError):
                 lease_ms = 0.0
             lease_s = lease_ms / 1e3 if lease_ms > 0 else self.lease_s
-            self._lease_deadline = time.time() + lease_s
+            self._lease_deadline = clock.now() + lease_s
         snap = body.get("snapshot")
         if isinstance(snap, dict):
             with self._apply_lock:
@@ -685,7 +684,7 @@ class HAController:
                 # admission-time stamp would promote this standby the
                 # instant the apply commits, deposing a healthy leader
                 # (and then flapping forever on every resync)
-                self._lease_deadline = time.time() + lease_s
+                self._lease_deadline = clock.now() + lease_s
             return {"status": "success", "applied": self._applied,
                     "term": self.term}
 
@@ -722,7 +721,7 @@ class HAController:
         with self._apply_lock, self._state_lock:
             if self.leader:
                 return
-            if time.time() <= self._lease_deadline:
+            if clock.now() <= self._lease_deadline:
                 # a heartbeat frame renewed the lease while the monitor
                 # thread was waiting on this lock: the leader is alive
                 # after all — do NOT depose it
@@ -777,7 +776,7 @@ class HAController:
         # acked-but-unreplicated tail writes may exist: declare
         # divergence so the new leader's first frame snapshots us
         self._applied = -1
-        self._lease_deadline = time.time() + self.lease_s * (
+        self._lease_deadline = clock.now() + self.lease_s * (
             2 + self._rank())
         with self._ack_cv:
             # wake barrier waiters so they observe the demotion at
@@ -807,7 +806,7 @@ class HAController:
             peers = [{
                 "url": p.url, "acked_seq": p.acked,
                 "synced": p.synced, "last_error": p.last_error,
-                "last_ack_age_s": (round(time.time() - p.last_ack_at, 3)
+                "last_ack_age_s": (round(clock.now() - p.last_ack_at, 3)
                                    if p.last_ack_at else None),
             } for p in self._peers.values()]
             return {

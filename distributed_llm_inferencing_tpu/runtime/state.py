@@ -21,10 +21,9 @@ import logging
 import os
 import sqlite3
 import threading
-import time
 from typing import Any, Callable, Dict, List, Optional
 
-from distributed_llm_inferencing_tpu.utils import locks
+from distributed_llm_inferencing_tpu.utils import clock, locks
 from distributed_llm_inferencing_tpu.utils.faults import mutation_enabled
 
 log = logging.getLogger("dli_tpu.state")
@@ -90,6 +89,13 @@ CREATE TABLE IF NOT EXISTS events (
 );
 CREATE INDEX IF NOT EXISTS idx_events_request ON events(request_id);
 CREATE INDEX IF NOT EXISTS idx_events_type ON events(type);
+-- the dispatcher's claim query and the due-time probe both filter on
+-- status; without this, every claim scans the whole requests table,
+-- which turns a long-lived master (or a 100k-request dlisim run) into
+-- an O(n^2) dispatch plane. Pending rows are few at any instant, so
+-- the index keeps both queries proportional to the backlog, not the
+-- history.
+CREATE INDEX IF NOT EXISTS idx_requests_status ON requests(status);
 CREATE TABLE IF NOT EXISTS meta (
     key TEXT PRIMARY KEY,
     value TEXT,
@@ -346,7 +352,7 @@ class Store:
             if self._gc_interval:
                 # the group window: let concurrent dispatchers pile
                 # their writes into this flush's transaction
-                time.sleep(self._gc_interval)
+                clock.sleep(self._gc_interval)
             try:
                 self._flush_writes()
             except Exception:
@@ -357,7 +363,7 @@ class Store:
                 log.exception("group-commit flush failed; "
                               "ops re-buffered, will retry")
                 self._gc_wake.set()
-                time.sleep(0.5)
+                clock.sleep(0.5)
         try:
             self._flush_writes()
         except Exception:
@@ -512,7 +518,7 @@ class Store:
                  is_active: bool = False) -> int:
         return self._exec(
             "INSERT INTO nodes (name, host, port, is_active, added_at) "
-            "VALUES (?,?,?,?,?)", (name, host, port, int(is_active), time.time()))
+            "VALUES (?,?,?,?,?)", (name, host, port, int(is_active), clock.now()))
 
     def get_node(self, node_id: int):
         return self._one("SELECT * FROM nodes WHERE id=?", (node_id,))
@@ -556,7 +562,7 @@ class Store:
         return self._exec(
             "INSERT INTO plans (model_name, plan, node_id, created_at) "
             "VALUES (?,?,?,?)",
-            (model_name, json.dumps(plan), node_id, time.time()))
+            (model_name, json.dumps(plan), node_id, clock.now()))
 
     def list_plans(self, model_name: Optional[str] = None):
         rows = self._all(
@@ -598,7 +604,7 @@ class Store:
                 "max_new_tokens, max_length, sampling, created_at, "
                 "client_tag) VALUES (?,?,?,?,?,?,?)",
                 (model_name, prompt, max_new_tokens, max_length,
-                 json.dumps(sampling or {}), time.time(), client_tag))
+                 json.dumps(sampling or {}), clock.now(), client_tag))
 
     def find_client_tag(self, client_tag: str) -> Optional[int]:
         """The request id a submit idempotency key already names, or
@@ -640,7 +646,7 @@ class Store:
         status flip) — the multiplexed dispatcher's entry point. FIFO:
         the returned order is id order, which is submission order."""
         with self._lock:
-            now = time.time()
+            now = clock.now()
             rows = self._all(
                 "SELECT * FROM requests WHERE status='pending' "
                 "AND next_attempt_at<=? ORDER BY id LIMIT ?",
@@ -726,7 +732,7 @@ class Store:
         self._submit_write(
             "UPDATE requests SET status='pending', attempts=attempts+1, "
             f"next_attempt_at=?{extra} WHERE id=?",
-            (time.time() + max(0.0, delay_s), *args, req_id),
+            (clock.now() + max(0.0, delay_s), *args, req_id),
             barrier=True)
 
     def requeue_migrated(self, req_id: int, resume: dict,
@@ -791,7 +797,7 @@ class Store:
             with self._db:
                 failed = 0
                 if max_attempts is not None:
-                    args = (time.time(), max_attempts)
+                    args = (clock.now(), max_attempts)
                     failed = self._db.execute(
                         sql := ("UPDATE requests SET status='failed', "
                                 "completed_at=?, "
@@ -837,7 +843,7 @@ class Store:
             "UPDATE requests SET status='completed', result=?, node_id=?, "
             "completed_at=?, execution_time=?, tokens_per_s=?, cost=? "
             "WHERE id=? AND status NOT IN ('completed','failed')",
-            (result, node_id, time.time(), execution_time, tokens_per_s,
+            (result, node_id, clock.now(), execution_time, tokens_per_s,
              json.dumps(cost) if cost is not None else None,
              req_id), barrier=barrier)
 
@@ -848,7 +854,7 @@ class Store:
         self._submit_write(
             "UPDATE requests SET status='failed', error=?, completed_at=? "
             "WHERE id=? AND status NOT IN ('completed','failed')",
-            (error, time.time(), req_id), barrier=barrier)
+            (error, clock.now(), req_id), barrier=barrier)
 
     def recent_requests(self, limit: int = 20):
         return self._all(
@@ -866,6 +872,16 @@ class Store:
             "SELECT model_name, COUNT(*) AS n FROM requests "
             "WHERE status='pending' GROUP BY model_name")
         return {r["model_name"]: r["n"] for r in rows}
+
+    def next_pending_due(self) -> Optional[float]:
+        """Earliest ``next_attempt_at`` among pending rows (None when
+        the pending queue is empty). The dispatcher polls on its wake
+        event; a discrete-event driver (tools/dlisim) instead jumps the
+        virtual clock straight to this instant when every due request
+        has been claimed and only parked ones remain."""
+        row = self._one("SELECT MIN(COALESCE(next_attempt_at, 0)) AS t "
+                        "FROM requests WHERE status='pending'")
+        return float(row["t"]) if row and row["t"] is not None else None
 
     # ---- flight-recorder events (runtime/events.py) ------------------
 
@@ -896,6 +912,7 @@ class Store:
                      request_id: Optional[int] = None,
                      since: Optional[float] = None,
                      until: Optional[float] = None,
+                     since_seq: Optional[int] = None,
                      limit: int = 500) -> List[Dict[str, Any]]:
         """Filtered journal read, oldest-first within the newest
         ``limit`` matches. A bounded window needs BOTH ends server-side:
@@ -903,11 +920,21 @@ class Store:
         time would drop exactly the in-window rows once enough newer
         events exist (the journey's node-context bug class). Callers
         that just emitted (the API handlers) run :meth:`flush` first so
-        reads see their own writes."""
+        reads see their own writes.
+
+        ``since_seq`` is the pagination cursor: strictly-after the given
+        autoincrement rowid. ``since`` (a wall-clock ``ts>=`` bound)
+        cannot paginate — two events stamped in the same second are
+        skipped or double-served across pages — so pages chain on the
+        last row's ``id`` instead, which is unique and monotone in
+        emit order."""
         where, args = [], []
         if etype:
             where.append("type=?")
             args.append(str(etype))
+        if since_seq is not None:
+            where.append("id>?")
+            args.append(int(since_seq))
         if node_id is not None:
             where.append("node_id=?")
             args.append(int(node_id))
@@ -948,7 +975,7 @@ class Store:
         dump, and shipping megabytes per cycle would starve the status
         stream for data a standby rebuilds from scrapes anyway."""
         self._exec("INSERT OR REPLACE INTO meta (key, value, updated_at) "
-                   "VALUES (?,?,?)", (key, value, time.time()),
+                   "VALUES (?,?,?)", (key, value, clock.now()),
                    replicate=replicate)
 
     def get_meta(self, key: str) -> Optional[str]:

@@ -36,10 +36,9 @@ from __future__ import annotations
 import collections
 import math
 import os
-import time
 from typing import Dict, List, Optional, Tuple
 
-from distributed_llm_inferencing_tpu.utils import locks
+from distributed_llm_inferencing_tpu.utils import clock, locks
 
 # Retention knobs: total window retained per series, and the fine-ring
 # bucket width. The fine ring is capped at FINE_BUCKETS_MAX buckets;
@@ -210,7 +209,7 @@ class TSDB:
 
     def record(self, node: str, metric: str, value,
                kind: str = "gauge", t: Optional[float] = None):
-        t = time.time() if t is None else t
+        t = clock.now() if t is None else t
         with self._lock:
             per_node = self._series.setdefault(str(node), {})
             s = per_node.get(metric)
@@ -230,7 +229,7 @@ class TSDB:
         (``_total``) are ingested for rate conversion, everything else
         as a gauge. The ``dli_`` prefix is stripped so series names
         match the in-process registry names."""
-        t = time.time() if t is None else t
+        t = clock.now() if t is None else t
         for name, labels, value in samples:
             if labels or name.endswith(("_bucket", "_sum", "_count")):
                 continue
@@ -247,7 +246,7 @@ class TSDB:
         """All nodes' series for ``metric`` (optionally one node), each
         as ``{"node", "metric", "kind", "points": [[t, v], ...]}``.
         Counter series return per-second rates."""
-        now = time.time() if now is None else now
+        now = clock.now() if now is None else now
         window = min(self.window_s,
                      window if window else self.window_s)
         out = []
@@ -359,7 +358,7 @@ class SLOEvaluator:
         self.violations = 0
 
     def record(self, ok: bool, t: Optional[float] = None):
-        t = time.time() if t is None else t
+        t = clock.now() if t is None else t
         with self._lock:
             self._events.append((t, bool(ok)))
             self.total += 1
@@ -368,7 +367,7 @@ class SLOEvaluator:
 
     def attainment(self, window_s: float,
                    now: Optional[float] = None) -> Optional[float]:
-        now = time.time() if now is None else now
+        now = clock.now() if now is None else now
         cutoff = now - window_s
         with self._lock:
             evs = [ok for t, ok in self._events if t >= cutoff]
@@ -385,7 +384,7 @@ class SLOEvaluator:
         return (1.0 - att) / max(budget, 1e-6)
 
     def snapshot(self, now: Optional[float] = None) -> dict:
-        now = time.time() if now is None else now
+        now = clock.now() if now is None else now
         fast = self.attainment(self.fast_window_s, now)
         slow = self.attainment(self.slow_window_s, now)
         # burn derives from the attainments already in hand — snapshot()

@@ -27,7 +27,7 @@ from distributed_llm_inferencing_tpu.ops.sampling import SamplingParams
 from distributed_llm_inferencing_tpu.parallel.mesh import MeshSpec
 from distributed_llm_inferencing_tpu.runtime import httpd
 from distributed_llm_inferencing_tpu.runtime.engine import InferenceEngine
-from distributed_llm_inferencing_tpu.utils import locks, trace
+from distributed_llm_inferencing_tpu.utils import clock, locks, trace
 from distributed_llm_inferencing_tpu.utils.faults import mutation_enabled
 from distributed_llm_inferencing_tpu.utils.logging import setup_logging
 from distributed_llm_inferencing_tpu.utils.metrics import Metrics
@@ -103,7 +103,7 @@ class WorkerAgent:
         self._models_lock = locks.lock("worker.models")
         self._loading: set = set()
         self.metrics = Metrics()
-        self.started = time.time()
+        self.started = clock.now()
         trace.set_service("worker")
         self.service = httpd.JsonHTTPService("worker", auth_key)
         s = self.service
@@ -283,7 +283,7 @@ class WorkerAgent:
                 occ = max(occ or 0.0, float(kv["occupancy"]))
         return {
             "status": "draining" if self._draining else "online",
-            "uptime_s": time.time() - self.started,
+            "uptime_s": clock.now() - self.started,
             "role": self.role,
             "arena_occupancy": occ,
             "resources": {"cpu": cpu, "memory": mem, "devices": devices,
@@ -367,7 +367,7 @@ class WorkerAgent:
         ckpt = body.get("checkpoint_path")
         native = body.get("native_checkpoint")
         mesh = MeshSpec.from_dict(body.get("mesh", {}))
-        t0 = time.time()
+        t0 = clock.now()
         if body.get("serving") == "batched" and any(
                 getattr(mesh, ax) > 1 for ax in ("dp", "sp")):
             # validate BEFORE any (possibly huge) checkpoint restore; the
@@ -483,10 +483,10 @@ class WorkerAgent:
         with self._models_lock:
             self.models[name] = lm
         self.metrics.inc("models_loaded")
-        log.info("loaded %s from %s in %.1fs", name, source, time.time() - t0)
+        log.info("loaded %s from %s in %.1fs", name, source, clock.now() - t0)
         return 200, {"status": "success",
                      "message": f"model {name} loaded",
-                     "load_time_s": time.time() - t0,
+                     "load_time_s": clock.now() - t0,
                      "stats": stats}
 
     def load_model(self, body, _request=None):
@@ -650,11 +650,11 @@ class WorkerAgent:
         return max(n, batched)
 
     def _wait_idle(self, timeout: float) -> bool:
-        deadline = time.time() + timeout
-        while time.time() < deadline:
+        deadline = clock.now() + timeout
+        while clock.now() < deadline:
             if self._busy_count() == 0:
                 return True
-            time.sleep(0.05)
+            clock.sleep(0.05)
         return self._busy_count() == 0
 
     def drain(self, body, _request=None):
@@ -814,7 +814,7 @@ class WorkerAgent:
         never the batch."""
         specs, metas = [], []
         for sub_body, tag, my_ev in owned:
-            t0 = time.time()
+            t0 = clock.now()
             try:
                 _m, prompt, sp, max_new, _gk = self._prep_inference(sub_body)
                 if len(prompt) + max_new > m.batcher.max_seq:
@@ -902,7 +902,7 @@ class WorkerAgent:
                 "status": "success",
                 "result": m.tokenizer.decode(toks),
                 "tokens": toks,
-                "execution_time": time.time() - t0,
+                "execution_time": clock.now() - t0,
                 "ttft_ms": breq.ttft_ms,
                 "cost": breq.cost,
                 "scheduler": m.batcher.stats(),
@@ -1145,7 +1145,7 @@ class WorkerAgent:
         tag = str(body["request_tag"]) if body.get("request_tag") else None
         if tag is None:
             return self._inference_execute(body)
-        deadline = time.time() + float(body.get("timeout", 300))
+        deadline = clock.now() + float(body.get("timeout", 300))
         while True:
             kind, obj = self._idem_claim(tag)
             if kind == "cached":
@@ -1156,7 +1156,7 @@ class WorkerAgent:
                 break
             # join the in-flight execution instead of re-generating
             self.metrics.inc("idempotent_joins")
-            if not obj.wait(timeout=max(0.0, deadline - time.time())):
+            if not obj.wait(timeout=max(0.0, deadline - clock.now())):
                 # in_flight tells the master the generation is STILL
                 # running here — retry this node (join again later), do
                 # not fail over and re-generate on a peer
@@ -1174,7 +1174,7 @@ class WorkerAgent:
                                else None)
 
     def _inference_execute(self, body):
-        t0 = time.time()
+        t0 = clock.now()
         try:
             m, prompt, sp, max_new, gen_kw = self._prep_inference(body)
         except (KeyError, ValueError) as e:
@@ -1224,7 +1224,7 @@ class WorkerAgent:
                 "status": "success",
                 "result": m.tokenizer.decode(toks),
                 "tokens": toks,
-                "execution_time": time.time() - t0,
+                "execution_time": clock.now() - t0,
                 "ttft_ms": req.ttft_ms,
                 "cost": req.cost,
                 "scheduler": m.batcher.stats(),
@@ -1246,7 +1246,7 @@ class WorkerAgent:
             "status": "success",
             "result": text,
             "tokens": res.tokens[0],
-            "execution_time": time.time() - t0,  # parity: worker/app.py:317
+            "execution_time": clock.now() - t0,  # parity: worker/app.py:317
             "prefill_ms": res.prefill_ms,
             "decode_ms": res.decode_ms,
             "tokens_per_s": res.decode_tokens_per_s,

@@ -1,0 +1,140 @@
+"""The runtime's single source of time (``utils/locks.py``'s pattern).
+
+Every wall/monotonic read and every sleep in ``runtime/`` goes through
+the module functions below instead of calling ``time.*`` directly (the
+``time-direct`` dlilint rule enforces it). Normally they delegate
+straight to the stdlib — one attribute hop, no wrappers, no config.
+The point is the seam: ``set_clock()`` interposes a replacement clock
+for the WHOLE runtime in one call, which is what lets tools/dlisim run
+the real control plane — scheduler, breaker, group-commit store, TSDB
+bucketing, rebalancer, lease monitor — over hours of cluster time in
+milliseconds, with every timer firing deterministically.
+
+Same discipline as the locks factory interposition:
+
+- stdlib-only and import-cycle-free (no other dli module is imported),
+  so ``runtime/events.py`` stays loadable by the dlilint checker
+  without dragging in sqlite or jax;
+- the hook is consulted per CALL, not cached at import, so a test can
+  install a clock after modules were imported;
+- callers never hold a clock object — they call ``clock.now()`` — so
+  one ``set_clock`` reaches code that constructed its state long ago.
+
+:class:`VirtualClock` is the interposition everything here exists for:
+a manually-advanced clock owned by one driving thread (the simulator's
+event loop). ``sleep()`` advances virtual time when the owner calls it;
+from any OTHER thread it parks the caller for a moment of real time
+instead — a background daemon (the store's group-commit flusher) must
+never race virtual time forward under the deterministic driver.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class SystemClock:
+    """The stdlib, behind the seam. Stateless; one shared instance."""
+
+    def now(self) -> float:
+        return time.time()
+
+    def monotonic(self) -> float:
+        return time.monotonic()
+
+    def sleep(self, seconds: float) -> None:
+        time.sleep(seconds)
+
+
+class VirtualClock:
+    """Deterministic manually-advanced time for the simulator and the
+    frozen-clock tests.
+
+    ``now()`` and ``monotonic()`` move only via :meth:`advance` (or an
+    owner-thread ``sleep``), so two identically-seeded runs read
+    identical timestamps everywhere — TSDB bucket assignment, breaker
+    ``opened_at``, journal ``ts``, backoff deadlines. The owner is the
+    thread that constructed the clock (override with ``owner=None`` for
+    tests that sleep from nowhere); a non-owner ``sleep`` is a real
+    ~1ms nap so stray daemons idle harmlessly instead of either
+    spinning or corrupting the timeline.
+    """
+
+    #: epoch base: an arbitrary fixed "recent" wall time, so code that
+    #: formats timestamps or subtracts epochs sees plausible values
+    DEFAULT_EPOCH = 1_700_000_000.0
+
+    def __init__(self, start: float = DEFAULT_EPOCH, *, owner=True):
+        self._base = float(start)
+        self._elapsed = 0.0
+        self._lock = threading.Lock()
+        self._owner = threading.current_thread() if owner is True else owner
+
+    def now(self) -> float:
+        with self._lock:
+            return self._base + self._elapsed
+
+    def monotonic(self) -> float:
+        with self._lock:
+            return self._elapsed
+
+    def elapsed(self) -> float:
+        return self.monotonic()
+
+    def advance(self, seconds: float) -> float:
+        """Move virtual time forward; returns the new ``now()``."""
+        if seconds < 0:
+            raise ValueError(f"cannot advance by {seconds!r}")
+        with self._lock:
+            self._elapsed += float(seconds)
+            return self._base + self._elapsed
+
+    def sleep(self, seconds: float) -> None:
+        if self._owner is None or threading.current_thread() is self._owner:
+            if seconds > 0:
+                self.advance(seconds)
+            return
+        # a non-owner thread (background flusher) asked to wait: real
+        # time is the only thing it may consume — virtual time belongs
+        # to the driving loop
+        if seconds > 0:
+            time.sleep(min(0.001, seconds))
+
+
+_SYSTEM = SystemClock()
+_clock = _SYSTEM
+
+
+def set_clock(clock):
+    """Install (or reset, with None) the process-wide clock. Returns
+    the previous one so callers can restore it in a finally block."""
+    global _clock
+    prev, _clock = _clock, (clock if clock is not None else _SYSTEM)
+    return prev if prev is not _SYSTEM else None
+
+
+def get_clock():
+    return _clock
+
+
+def now() -> float:
+    """Wall-clock seconds (``time.time`` behind the seam)."""
+    return _clock.now()
+
+
+def monotonic() -> float:
+    """Monotonic seconds (``time.monotonic`` behind the seam)."""
+    return _clock.monotonic()
+
+
+def sleep(seconds: float) -> None:
+    """``time.sleep`` behind the seam. Under a :class:`VirtualClock`
+    this advances virtual time (owner thread) instead of blocking."""
+    _clock.sleep(seconds)
+
+
+def deadline(timeout: float) -> float:
+    """A monotonic deadline ``timeout`` seconds out; compare against
+    :func:`monotonic`."""
+    return _clock.monotonic() + float(timeout)

@@ -181,6 +181,11 @@ KNOBS = (
     Knob("DLI_SCHED_ARENA_FULL", "0.9", "float",
          "Arena-occupancy fraction above which prefill picks avoid a "
          "node while an alternative exists.", f"{_P}/runtime/master.py"),
+    Knob("DLI_SCHED_SAMPLE", "128", "int",
+         "Fleet size above which a pick scores a power-of-d-choices "
+         "random sample of this many candidates instead of every node "
+         "(per-pick cost stays O(sample) at 1000 nodes; `0` always "
+         "scans the full fleet).", f"{_P}/runtime/master.py"),
     # ---- disaggregation / KV transfer --------------------------------
     Knob("DLI_WORKER_ROLE", "mixed", "enum",
          "This worker's pool: `prefill`, `decode`, or `mixed`.",
@@ -346,6 +351,26 @@ KNOBS = (
          "killed and retried.", "bench.py"),
     Knob("DLI_BENCH_PROBE_WINDOW_S", "300", "float",
          "Backend-probe timeout window before the bench falls back.",
+         "bench.py"),
+    # ---- cluster simulator (tools/dlisim, docs/simulator.md) ---------
+    Knob("DLI_SIM_NODES", "1000", "int",
+         "Fleet size for the sim_scale bench gate's headline leg.",
+         "bench.py"),
+    Knob("DLI_SIM_REQUESTS", "100000", "int",
+         "Request count for the sim_scale bench gate's headline leg.",
+         "bench.py"),
+    Knob("DLI_SIM_SEED", "42", "int",
+         "Deterministic seed for every sim_scale/sim_calibrate leg.",
+         "bench.py"),
+    Knob("DLI_SIM_TOL_GOODPUT", "0.5", "float",
+         "Calibration gate: max relative sim-vs-real goodput error.",
+         "bench.py"),
+    Knob("DLI_SIM_TOL_TTFT", "0.75", "float",
+         "Calibration gate: max relative sim-vs-real TTFT p50 error.",
+         "bench.py"),
+    Knob("DLI_SIM_TOL_QUEUE", "1.0", "float",
+         "Calibration gate: max relative sim-vs-real mean queue-depth "
+         "error (absolute slack of 3 requests applies near zero).",
          "bench.py"),
 )
 

@@ -59,6 +59,12 @@ collect_master() {  # collect_master <master> <dir>
     fetch "$M" "$D" /api/cluster_metrics cluster_metrics.json
     fetch "$M" "$D" /api/inference/recent recent_requests.json
     fetch "$M" "$D" /api/events events.json      # flight-recorder journal
+    # Workload capture (docs/simulator.md): the request-submitted rows
+    # are the replayable arrival trace — feed this file straight to
+    #   python -m tools.dlisim --trace workload_capture.json
+    # to re-drive the incident's exact workload through the simulator.
+    fetch "$M" "$D" "/api/events?type=request-submitted&limit=2000" \
+        workload_capture.json
     fetch "$M" "$D" /api/ha ha_status.json       # lease/replication state
     fetch "$M" "$D" /api/leader leader.json      # who this master follows
     fetch "$M" "$D" /metrics master_metrics.prom

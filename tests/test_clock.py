@@ -1,0 +1,213 @@
+"""Clock-seam determinism suite (docs/simulator.md).
+
+Every timer-bearing runtime subsystem reads time through
+``utils/clock.py``; these tests pin the behaviors the simulator relies
+on by driving them on a frozen/stepped :class:`VirtualClock`: TSDB
+bucket placement, breaker open -> half-open -> closed timing, retry
+backoff schedules, requeue due-time gating, and the HA lease expiry
+decision. If one of these drifts back to ``time.time()`` the dlilint
+``time-direct`` rule catches the source; these tests catch the
+behavior (a site that reads the seam but caches a real-clock value at
+import, say).
+"""
+
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest
+
+from distributed_llm_inferencing_tpu.utils import clock
+from distributed_llm_inferencing_tpu.utils.clock import VirtualClock
+
+
+@pytest.fixture
+def vclock():
+    vc = VirtualClock(1_700_000_000.0, owner=True)
+    prev = clock.set_clock(vc)
+    try:
+        yield vc
+    finally:
+        clock.set_clock(prev)
+
+
+# ---- the clock seam itself --------------------------------------------
+
+def test_virtual_clock_advance_and_elapsed(vclock):
+    t0 = clock.now()
+    assert t0 == 1_700_000_000.0
+    vclock.advance(12.5)
+    assert clock.now() == t0 + 12.5
+    assert vclock.elapsed() == 12.5
+    m0 = clock.monotonic()
+    vclock.advance(0.5)
+    assert clock.monotonic() == m0 + 0.5
+
+
+def test_owner_sleep_advances_virtual_time(vclock):
+    t0 = clock.now()
+    clock.sleep(3.0)          # owner thread: no real waiting
+    assert clock.now() == t0 + 3.0
+
+
+def test_deadline_uses_virtual_monotonic(vclock):
+    d = clock.deadline(10.0)
+    assert d == clock.monotonic() + 10.0
+    vclock.advance(11.0)
+    assert clock.monotonic() > d
+
+
+def test_set_clock_restores_system():
+    vc = VirtualClock(5.0)
+    prev = clock.set_clock(vc)
+    assert clock.get_clock() is vc
+    clock.set_clock(prev)
+    assert clock.get_clock() is not vc
+    # back on the system clock: now() tracks the host again
+    import time
+    assert abs(clock.now() - time.time()) < 5.0
+
+
+# ---- TSDB bucketing ---------------------------------------------------
+
+def test_tsdb_buckets_pinned_by_virtual_clock(vclock):
+    from distributed_llm_inferencing_tpu.runtime.tsdb import TSDB
+    db = TSDB(step_s=10.0, window_s=600.0)
+    t0 = clock.now()
+    db.record("n1", "depth", 3.0)          # t=None -> seam read
+    vclock.advance(4.0)
+    db.record("n1", "depth", 5.0)          # same 10s bucket
+    series = db.query("depth", now=clock.now())
+    assert len(series) == 1
+    pts = series[0]["points"]
+    # same bucket: freshest wins, bucket epoch is the step-aligned
+    # virtual time — fully deterministic, no host time anywhere
+    assert pts == [[t0 - (t0 % 10.0), 5.0]]
+    vclock.advance(10.0)
+    db.record("n1", "depth", 7.0)
+    pts = db.query("depth", now=clock.now())[0]["points"]
+    assert [v for _, v in pts] == [5.0, 7.0]
+    assert pts[1][0] - pts[0][0] == 10.0
+
+
+def test_tsdb_counter_rate_over_virtual_interval(vclock):
+    from distributed_llm_inferencing_tpu.runtime.tsdb import TSDB
+    db = TSDB(step_s=10.0, window_s=600.0)
+    db.record("n1", "reqs", 100.0, kind="counter")
+    vclock.advance(10.0)
+    db.record("n1", "reqs", 150.0, kind="counter")
+    pts = db.query("reqs", now=clock.now())[0]["points"]
+    # 50 increments over exactly 10 virtual seconds = 5.0/s (the fine
+    # bucket plus the in-progress coarse accumulator both report it)
+    assert pts and {v for _, v in pts} == {5.0}
+
+
+# ---- breaker state machine --------------------------------------------
+
+def test_breaker_half_open_probe_cycle_on_virtual_clock(vclock):
+    """Strikes -> OPEN stamps the virtual time; the next health sweep
+    of the recovered node flips to HALF-OPEN; a probe success closes.
+    Same sequence the sim's adversarial leg exercises at fleet scale,
+    pinned here on one node with exact timestamps."""
+    from tools.dlisim import DEFAULT_MODEL, SimMaster, SyntheticFleet
+    fleet = SyntheticFleet.uniform(1, DEFAULT_MODEL)
+    m = SimMaster(fleet, vclock, health_interval=15.0)
+    try:
+        spec = fleet.nodes[0].spec
+        nid = m.store.add_node(spec.name, "sim.invalid", spec.port,
+                               is_active=True)
+        t_open = clock.now()
+        for _ in range(3):
+            m._node_failure(m.store.get_node(nid))
+        row = m.store.get_node(nid)
+        assert row["breaker_state"] == "open"
+        assert not row["is_active"]
+        assert row["breaker_opened_at"] == t_open
+        vclock.advance(15.0)
+        m._health_sweep()                     # node reachable again
+        row = m.store.get_node(nid)
+        assert row["breaker_state"] == "half_open"
+        assert row["is_active"]
+        m._node_success(m.store.get_node(nid))
+        row = m.store.get_node(nid)
+        assert row["breaker_state"] == "closed"
+        assert row["consecutive_failures"] == 0
+        counts = {e["type"] for e in m.store.query_events(limit=50)}
+        assert {"breaker-open", "breaker-half-open",
+                "breaker-closed"} <= counts
+    finally:
+        m.stop()
+
+
+# ---- retry backoff ----------------------------------------------------
+
+def test_backoff_schedule_deterministic_under_seed(vclock):
+    from tools.dlisim import DEFAULT_MODEL, SimMaster, SyntheticFleet
+    fleet = SyntheticFleet.uniform(1, DEFAULT_MODEL)
+    m = SimMaster(fleet, vclock)
+    try:
+        random.seed(1234)
+        a = [m._backoff(i) for i in range(4)]
+        random.seed(1234)
+        b = [m._backoff(i) for i in range(4)]
+        assert a == b
+        # exponential shape: jitter aside, attempt k+1's ceiling
+        # doubles until the cap
+        assert all(x > 0 for x in a)
+    finally:
+        m.stop()
+
+
+# ---- requeue due-time gating ------------------------------------------
+
+def test_requeue_delay_gates_claims_until_virtual_due(vclock):
+    from tools.dlisim import DEFAULT_MODEL, SimMaster, SyntheticFleet
+    fleet = SyntheticFleet.uniform(1, DEFAULT_MODEL)
+    m = SimMaster(fleet, vclock)
+    try:
+        rid = m.store.submit_request("tiny-llama", "hi", 4)
+        claimed = m.store.claim_next_pending_many(8)
+        assert [r["id"] for r in claimed] == [rid]
+        m.store.requeue(rid, delay_s=30.0)
+        m.store.flush()
+        assert m.store.claim_next_pending_many(8) == []
+        due = m.store.next_pending_due()
+        assert due == pytest.approx(clock.now() + 30.0)
+        vclock.advance(29.0)
+        assert m.store.claim_next_pending_many(8) == []
+        vclock.advance(1.5)
+        assert [r["id"] for r in
+                m.store.claim_next_pending_many(8)] == [rid]
+    finally:
+        m.stop()
+
+
+# ---- HA lease expiry --------------------------------------------------
+
+def test_lease_expiry_decision_on_virtual_clock(vclock):
+    """The standby's takeover races a heartbeat renewing the lease:
+    with the deadline in the virtual future the takeover must no-op,
+    one virtual millisecond past it the standby must lead. Wall time
+    plays no part."""
+    from distributed_llm_inferencing_tpu.runtime.master import Master
+    from distributed_llm_inferencing_tpu.runtime.replication import (
+        HAController)
+    m = Master(":memory:")
+    try:
+        r = HAController(m, peers=["http://127.0.0.1:9/"],
+                         leader=False, lease_ms=3000.0,
+                         repl_barrier=False)
+        r._lease_deadline = clock.now() + 3.0
+        term0 = r.term
+        r._takeover()
+        assert not r.leader and r.term == term0   # lease still valid
+        vclock.advance(2.9)
+        r._takeover()
+        assert not r.leader
+        vclock.advance(0.2)                       # now past the deadline
+        r._takeover()
+        assert r.leader and r.term == term0 + 1
+    finally:
+        m.stop()

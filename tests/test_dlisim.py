@@ -1,0 +1,238 @@
+"""Cluster-simulator suite (tools/dlisim, docs/simulator.md).
+
+Small-scale versions of the bench gates (`bench.py --scenario
+sim_scale`): the simulator drives the REAL master control plane —
+`_pick_node`, the breaker state machine, the store's group-commit
+claim path — on a virtual clock, so these tests assert cluster-level
+behavior (deterministic decision journals, invariant-clean scheduling,
+breaker recovery under fault injection, disagg planning) in
+milliseconds of wall time.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest
+
+from tools.dlisim import (DEFAULT_MODEL, DEFAULT_TOLERANCES, SimConfig,
+                          WorkerModel, arrival_trace_from_events,
+                          divergence_report, fit_worker_model, run_sim,
+                          synthetic_arrivals)
+
+
+# ---- end-to-end sim runs ----------------------------------------------
+
+def _small(**kw):
+    cfg = dict(nodes=20, requests=400, duration_s=60.0,
+               arrival="bursty", seed=7)
+    cfg.update(kw)
+    return SimConfig(**cfg)
+
+
+def test_healthy_run_completes_everything_clean():
+    rep = run_sim(_small())
+    assert rep.completed == 400
+    assert rep.failed == 0
+    assert rep.starved == 0
+    assert rep.violations == []
+    assert rep.journal_counts.get("request-submitted") == 400
+    assert rep.pick_us_mean is not None
+
+
+def test_identical_seeds_identical_journals():
+    """The reproducibility bar: same seed, same config -> byte-for-byte
+    identical decision journal (hash over every event the control
+    plane emitted, in order, with virtual timestamps)."""
+    a, b = run_sim(_small()), run_sim(_small())
+    assert a.journal_hash == b.journal_hash
+    assert a.journal_counts == b.journal_counts
+    c = run_sim(_small(seed=8))
+    assert c.journal_hash != a.journal_hash
+
+
+def test_adversarial_faults_open_and_recover_breakers():
+    rep = run_sim(_small(
+        requests=800, arrival="adversarial", duration_s=120.0,
+        fail_nodes=[(0, 20.0, 60.0), (1, 30.0, 80.0)]))
+    # every request reaches a terminal state even with two nodes dark
+    assert rep.completed + rep.failed == 800
+    assert rep.starved == 0
+    assert rep.violations == []
+    assert rep.breaker["opened"] >= 1
+    assert rep.breaker["half_opened"] >= 1
+    assert rep.breaker["closed"] >= 1
+    assert rep.journal_counts.get("breaker-open", 0) >= 1
+    assert rep.journal_counts.get("request-requeued", 0) >= 1
+
+
+def test_disagg_planner_runs_with_prefill_pool():
+    rep = run_sim(_small(nodes=12, prefill_nodes=4,
+                         disagg_min_prompt=16))
+    assert rep.completed == 400
+    assert rep.violations == []
+    # the planner journals a verdict per eligible first attempt
+    assert rep.journal_counts.get("disagg-plan", 0) > 0
+
+
+def test_sim_emits_observability_artifacts():
+    rep = run_sim(_small())
+    # the same counter families the live master exposes
+    assert any(k.startswith("requests_") for k in rep.metrics)
+    assert any(k.startswith("scheduler_pick_") for k in rep.metrics)
+    assert rep.ttft_ms_p50 is not None
+    assert rep.goodput_req_per_s is not None
+    d = rep.to_json()
+    json.dumps(d)      # report is a plain JSON artifact
+
+
+# ---- worker-model fitting ---------------------------------------------
+
+def test_fit_worker_model_medians_and_provenance():
+    rows = [{"prefill_ms": 10.0 * u, "prefill_uncached_tokens": u,
+             "prefill_cached_tokens": 0,
+             "decode_ms": 5.0 * d, "decode_tokens": d}
+            for u, d in [(10, 10), (20, 20), (30, 30)]]
+    m = fit_worker_model(rows)
+    assert m.prefill_ms_per_token == pytest.approx(10.0)
+    assert m.decode_ms_per_token == pytest.approx(5.0)
+    assert m.source["prefill_ms_per_token"] == "cost-ledger(3)"
+    assert m.source["decode_ms_per_token"] == "cost-ledger(3)"
+    assert m.source["overhead_ms"] == "prior"   # no dt==1 rows
+
+
+def test_fit_tolerates_json_strings_and_junk():
+    rows = [json.dumps({"prefill_ms": 8.0, "prefill_uncached_tokens": 4,
+                        "decode_ms": 12.0, "decode_tokens": 6}),
+            "not json", None, 17,
+            {"prefill_ms": None, "decode_tokens": "x"}]
+    m = fit_worker_model(rows)
+    assert m.prefill_ms_per_token == pytest.approx(2.0)
+    assert m.decode_ms_per_token == pytest.approx(2.0)
+
+
+def test_fit_skips_cache_hit_prefills():
+    """Cache-hit prefills say nothing about compute cost — the fitter
+    applies the master's own mostly-uncached filter."""
+    rows = [{"prefill_ms": 1.0, "prefill_uncached_tokens": 2,
+             "prefill_cached_tokens": 100}]
+    m = fit_worker_model(rows)
+    assert m.prefill_ms_per_token == DEFAULT_MODEL.prefill_ms_per_token
+    assert m.source["prefill_ms_per_token"] == "prior"
+
+
+def test_worker_model_service_decomposition():
+    m = WorkerModel(prefill_ms_per_token=2.0, decode_ms_per_token=10.0,
+                    overhead_ms=5.0, chars_per_token=4)
+    prefill, decode, dtoks = m.service(prompt_chars=80,
+                                       max_new_tokens=16)
+    assert prefill == pytest.approx(5.0 + 2.0 * 20)
+    assert decode == pytest.approx(10.0 * 16)
+    assert dtoks == 16
+    # cached tokens shrink the prefill bill
+    cached, _, _ = m.service(prompt_chars=80, max_new_tokens=16,
+                             cached_tokens=19)
+    assert cached == pytest.approx(5.0 + 2.0 * 1)
+
+
+# ---- arrival traces ---------------------------------------------------
+
+@pytest.mark.parametrize("kind", ["uniform", "diurnal", "bursty",
+                                  "adversarial"])
+def test_synthetic_arrivals_shape(kind):
+    a = synthetic_arrivals(kind, 500, 100.0, seed=3)
+    assert len(a) == 500
+    ts = [r["at"] for r in a]
+    assert ts == sorted(ts)
+    assert 0.0 <= ts[0] and ts[-1] <= 100.0
+    assert synthetic_arrivals(kind, 500, 100.0, seed=3) == a
+    assert synthetic_arrivals(kind, 500, 100.0, seed=4) != a
+
+
+def test_adversarial_arrivals_have_ties_and_heavy_tails():
+    a = synthetic_arrivals("adversarial", 2000, 100.0, seed=5)
+    ts = [r["at"] for r in a]
+    assert len(set(ts)) < len(ts)                     # exact ties
+    assert max(r["prompt_chars"] for r in a) >= 512 * 8
+
+
+def test_arrival_trace_from_events_round_trip():
+    rows = [
+        {"type": "request-submitted", "ts": 100.5,
+         "data": {"model": "m", "prompt_chars": 64,
+                  "max_new_tokens": 8}},
+        {"type": "node-drain", "ts": 101.0, "data": {}},   # filtered
+        {"type": "request-submitted", "ts": 102.0,
+         "data": json.dumps({"prompt_chars": 32, "max_length": 24})},
+    ]
+    trace = arrival_trace_from_events(rows)
+    assert [r["at"] for r in trace] == [0.0, 1.5]
+    assert trace[0]["model"] == "m"
+    assert trace[1]["max_new_tokens"] == 24     # max_length fallback
+    assert trace[1]["model"] == "tiny-llama"    # default
+
+
+# ---- calibration ------------------------------------------------------
+
+def test_divergence_report_pass_fail_and_skip():
+    real = {"goodput_req_per_s": 10.0, "ttft_ms_p50": 100.0,
+            "queue_depth_mean": None}
+    sim = {"goodput_req_per_s": 12.0, "ttft_ms_p50": 300.0,
+           "queue_depth_mean": 0.5}
+    rep = divergence_report(real, sim)
+    assert rep["metrics"]["goodput_req_per_s"]["ok"] is True
+    assert rep["metrics"]["ttft_ms_p50"]["ok"] is False   # 200% > 75%
+    assert rep["metrics"]["queue_depth_mean"]["ok"] is None  # skipped
+    assert rep["ok"] is False
+    sim["ttft_ms_p50"] = 130.0
+    assert divergence_report(real, sim)["ok"] is True
+
+
+def test_divergence_queue_depth_absolute_slack():
+    """0.2 vs 0.8 queued requests is a 3x relative error and an
+    operationally identical run — the absolute slack passes it."""
+    real = {"goodput_req_per_s": 1.0, "ttft_ms_p50": 1.0,
+            "queue_depth_mean": 0.2}
+    sim = {"goodput_req_per_s": 1.0, "ttft_ms_p50": 1.0,
+           "queue_depth_mean": 0.8}
+    assert divergence_report(real, sim)["ok"] is True
+    sim["queue_depth_mean"] = 0.2 + DEFAULT_TOLERANCES["queue_depth_abs"] + 1
+    assert divergence_report(real, sim)["ok"] is False
+
+
+# ---- workload capture + journal pagination ----------------------------
+
+def test_submit_journals_workload_and_seq_pagination():
+    """Every api_submit journals a replayable request-submitted event;
+    /api/events pages on seq without loss or double-serve."""
+    from distributed_llm_inferencing_tpu.runtime.master import Master
+    m = Master(":memory:")
+    try:
+        for i in range(5):
+            r = m.api_submit({"model_name": "tiny-llama",
+                              "prompt": "x" * (10 + i),
+                              "max_new_tokens": 4})
+            assert r["status"] == "success"
+        page1 = m.api_events({"type": "request-submitted", "limit": 3})
+        assert page1["status"] == "success"
+        # newest `limit` matches, oldest-first within the page
+        assert [e["data"]["prompt_chars"] for e in page1["events"]] \
+            == [12, 13, 14]
+        assert page1["next_seq"] == page1["events"][-1]["seq"]
+        for i in (5, 6):
+            m.api_submit({"model_name": "tiny-llama",
+                          "prompt": "x" * (10 + i),
+                          "max_new_tokens": 4})
+        # the cursor chains strictly after the last served row: the
+        # follow-up page carries exactly the two new events, no
+        # double-serve even though all of them share a timestamp
+        page2 = m.api_events({"type": "request-submitted",
+                              "since_seq": str(page1["next_seq"])})
+        assert [e["data"]["prompt_chars"] for e in page2["events"]] \
+            == [15, 16]
+        seqs = [e["seq"] for e in page1["events"] + page2["events"]]
+        assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+    finally:
+        m.stop()

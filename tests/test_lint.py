@@ -20,7 +20,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from tools.dlilint import CHECKERS, run_all
 from tools.dlilint.core import Ctx, SourceFile, load_lifecycle, repo_root
 from tools.dlilint import check_events, check_jit, check_knobs, \
-    check_lifecycle, check_metrics, check_rpc, check_threads
+    check_lifecycle, check_metrics, check_rpc, check_threads, check_time
 
 
 def _sf(tmp_path, rel, source):
@@ -813,6 +813,65 @@ def test_events_real_registry_fully_emitted():
 
 
 # ---- the real tree is the fixture for "runs clean" ---------------------
+
+# ---- time checker ------------------------------------------------------
+
+def test_time_direct_call_and_bare_ref_caught(tmp_path):
+    """Calls AND bare references: a ``default_factory=time.time``
+    stamps rows just as directly as a call does."""
+    sf = _sf(tmp_path, "pkg/runtime/mod.py", """\
+        import time
+        t0 = time.time()
+        m = time.monotonic
+        def nap():
+            time.sleep(1.0)
+        """)
+    out = check_time.check(_ctx(tmp_path, runtime_files=[sf]))
+    assert _rules(out) == ["time-direct"] * 3
+
+
+def test_time_from_import_caught(tmp_path):
+    sf = _sf(tmp_path, "pkg/runtime/mod.py", """\
+        from time import sleep, perf_counter
+        """)
+    out = check_time.check(_ctx(tmp_path, runtime_files=[sf]))
+    # sleep is seamed; perf_counter measures the host and stays legal
+    assert _rules(out) == ["time-direct"]
+
+
+def test_time_host_measurement_exempt(tmp_path):
+    """perf_counter/time_ns measure the host, not the cluster
+    timeline — the virtual clock must never warp them."""
+    sf = _sf(tmp_path, "pkg/runtime/mod.py", """\
+        import time
+        a = time.perf_counter()
+        b = time.time_ns()
+        c = time.strftime("%F")
+        """)
+    assert check_time.check(_ctx(tmp_path, runtime_files=[sf])) == []
+
+
+def test_time_outside_runtime_not_scanned(tmp_path):
+    """The seam covers runtime/ only: bench harness, tools and tests
+    legitimately measure wall time."""
+    sf = _sf(tmp_path, "pkg/other/mod.py", """\
+        import time
+        t0 = time.time()
+        """)
+    assert check_time.check(_ctx(tmp_path, runtime_files=[],
+                                 package_files=[sf])) == []
+
+
+def test_time_pragma_suppression(tmp_path):
+    sf = _sf(tmp_path, "pkg/runtime/mod.py", """\
+        import time
+        t0 = time.time()   # dlilint: disable=time-direct
+
+        t1 = time.time()
+        """)
+    out = check_time.check(_ctx(tmp_path, runtime_files=[sf]))
+    assert len(out) == 1 and out[0].line == 4
+
 
 @pytest.fixture(scope="module")
 def repo_results():

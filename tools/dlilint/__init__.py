@@ -24,6 +24,7 @@ lifecycle           lifecycle-undeclared, lifecycle-guard,
                     lifecycle-unused, lifecycle-diagram-stale
 events              event-undeclared, event-unemitted, event-undoc,
                     event-table-stale
+time                time-direct
 ==================  ===================================================
 
 Run: ``python -m tools.dlilint`` (exit 0 = clean). Suppress a reviewed
@@ -38,7 +39,7 @@ from __future__ import annotations
 from typing import Dict, List
 
 from . import (check_events, check_jit, check_knobs, check_lifecycle,
-               check_metrics, check_rpc, check_threads)
+               check_metrics, check_rpc, check_threads, check_time)
 from .core import Ctx, Violation
 
 CHECKERS = {
@@ -49,6 +50,7 @@ CHECKERS = {
     "rpc": check_rpc.check,
     "lifecycle": check_lifecycle.check,
     "events": check_events.check,
+    "time": check_time.check,
 }
 
 

@@ -1,0 +1,69 @@
+"""Time hygiene: the runtime reads the clock only through the seam.
+
+``utils/clock.py`` is the runtime's single source of time — the
+interposition that lets tools/dlisim drive the real control plane
+(scheduler, breaker, store, TSDB bucketing, lease monitor) on a virtual
+clock, hours of cluster time in milliseconds, every timer deterministic.
+One bare ``time.time()`` anywhere in ``runtime/`` punches a hole in
+that seam: the simulator's timeline and the punched site's timeline
+diverge silently, and the byte-identical-journal reproducibility gate
+(tests/test_dlisim.py) rots into flakiness nobody can bisect.
+
+- ``time-direct`` — a direct use of ``time.time``, ``time.monotonic``
+  or ``time.sleep`` (called, referenced as a value, or imported via
+  ``from time import ...``) inside a ``runtime/`` module. Use
+  ``clock.now()`` / ``clock.monotonic()`` / ``clock.sleep()`` /
+  ``clock.deadline()`` instead. ``time.perf_counter`` and
+  ``time.time_ns`` stay legal: profiler deltas and RNG seeds measure
+  the host, not the cluster timeline, and the simulator must not warp
+  them. A reviewed exception (none exist today) carries
+  ``# dlilint: disable=time-direct``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .core import Ctx, Violation, dotted_name, filter_suppressed
+
+RULES = ("time-direct",)
+
+#: the seam-covered names; everything else on the time module is host
+#: measurement (perf_counter, time_ns, strftime) and stays direct
+_SEAMED = ("time", "monotonic", "sleep")
+
+
+def check(ctx: Ctx) -> List[Violation]:
+    violations: List[Violation] = []
+    for sf in ctx.runtime_files:
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Attribute):
+                # catches calls AND bare references (a
+                # ``default_factory=time.time`` stamps rows just as
+                # directly as a call does)
+                if (dotted_name(node) or "") in \
+                        tuple(f"time.{n}" for n in _SEAMED):
+                    violations.append(Violation(
+                        "time-direct", sf.rel, node.lineno,
+                        f"direct `{dotted_name(node)}` in runtime/ "
+                        f"bypasses the utils/clock.py seam — use "
+                        f"`clock.{_seam_name(node.attr)}` so the "
+                        f"simulator's virtual clock reaches this site"))
+            elif isinstance(node, ast.ImportFrom) and node.module == "time":
+                for alias in node.names:
+                    if alias.name in _SEAMED:
+                        violations.append(Violation(
+                            "time-direct", sf.rel, node.lineno,
+                            f"`from time import {alias.name}` in "
+                            f"runtime/ bypasses the utils/clock.py "
+                            f"seam — import utils.clock instead"))
+    files = {sf.rel: sf for sf in ctx.runtime_files}
+    return filter_suppressed(violations, files)
+
+
+def _seam_name(attr: str) -> str:
+    return {"time": "now()", "monotonic": "monotonic()",
+            "sleep": "sleep()"}[attr]

@@ -1,0 +1,43 @@
+"""dlisim — trace-calibrated discrete-event cluster simulator.
+
+Runs the REAL control plane — ``runtime/master.py``'s scheduler
+(``_pick_node``/``_plan_disagg``), circuit breaker, retry/backoff
+machinery, the group-commit ``Store``, the TSDB and the flight
+recorder — against a fleet of *synthetic* workers on a
+``utils/clock.VirtualClock``. Only the two worker RPC methods and the
+scrape fan-out are replaced (``sim.SimMaster``); every scheduling
+decision, journal event, metric and SQL row is produced by the same
+code that runs in production.
+
+What that buys (docs/simulator.md):
+
+- **Scale**: 1000+ nodes and 100k+ requests exercise the scheduler's
+  sampled pick path, breaker sweeps and journal volume in seconds of
+  wall time — hours of cluster time on a laptop CPU.
+- **Determinism**: one seed fixes the arrival trace, the jitter
+  stream and the pick RNG; two runs produce byte-identical decision
+  journals (the ``journal_hash`` in the report is the proof).
+- **Calibration**: ``fit.py`` fits the synthetic workers' service
+  model from the fleet's own telemetry (cost-ledger rows, bench
+  JSONs, the ``request-submitted`` arrival trace) and
+  ``calibrate.py`` replays a recorded real run, failing CI when
+  sim-vs-real divergence exceeds the documented tolerance.
+
+Entry points: ``python -m tools.dlisim`` (CLI),
+``bench.py --scenario sim_scale|sim_calibrate`` (CI gates).
+"""
+
+from .fleet import NodeSpec, SimNode, SyntheticFleet, WorkerModel
+from .fit import (DEFAULT_MODEL, arrival_trace_from_events,
+                  fit_from_artifacts, fit_worker_model,
+                  synthetic_arrivals)
+from .sim import SimConfig, SimMaster, SimReport, run_sim
+from .calibrate import DEFAULT_TOLERANCES, divergence_report
+
+__all__ = [
+    "NodeSpec", "SimNode", "SyntheticFleet", "WorkerModel",
+    "DEFAULT_MODEL", "arrival_trace_from_events", "fit_from_artifacts",
+    "fit_worker_model", "synthetic_arrivals",
+    "SimConfig", "SimMaster", "SimReport", "run_sim",
+    "DEFAULT_TOLERANCES", "divergence_report",
+]

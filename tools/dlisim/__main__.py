@@ -1,0 +1,91 @@
+"""CLI: ``python -m tools.dlisim [options]`` — run one simulation and
+print its JSON report (one line, bench-artifact style).
+
+Examples::
+
+    # 1000 nodes, 100k requests, diurnal arrivals
+    python -m tools.dlisim --nodes 1000 --requests 100000
+
+    # adversarial arrivals with three nodes failing mid-run
+    python -m tools.dlisim --nodes 200 --requests 20000 \\
+        --arrival adversarial --fail 0:100:200 --fail 1:100:300
+
+    # replay a captured workload (debug bundle workload_capture.json
+    # or /api/events?type=request-submitted output)
+    python -m tools.dlisim --trace workload_capture.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .fit import arrival_trace_from_events
+from .sim import SimConfig, run_sim
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.dlisim",
+        description="deterministic cluster simulator over the real "
+                    "control plane (docs/simulator.md)")
+    p.add_argument("--nodes", type=int, default=100)
+    p.add_argument("--requests", type=int, default=10_000)
+    p.add_argument("--duration", type=float, default=600.0,
+                   help="virtual seconds of arrivals (default 600)")
+    p.add_argument("--arrival", default="diurnal",
+                   choices=["uniform", "diurnal", "bursty", "adversarial"])
+    p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--slots", type=int, default=8,
+                   help="batcher slots per synthetic node")
+    p.add_argument("--prefill-nodes", type=int, default=0,
+                   help="strict prefill-role pool size (enables the "
+                        "disagg planner path)")
+    p.add_argument("--fail", action="append", default=[],
+                   metavar="IDX:FROM:UNTIL",
+                   help="take node IDX down over [FROM, UNTIL) virtual "
+                        "seconds; repeatable")
+    p.add_argument("--trace", default=None,
+                   help="JSON file of request-submitted journal rows "
+                        "(or {'events': [...]}) to replay instead of "
+                        "synthetic arrivals")
+    p.add_argument("--out", default=None,
+                   help="also write the report to this path")
+    args = p.parse_args(argv)
+
+    fails = []
+    for spec in args.fail:
+        idx, t0, t1 = spec.split(":")
+        fails.append((int(idx), float(t0), float(t1)))
+    arrivals = None
+    if args.trace:
+        with open(args.trace) as f:
+            raw = json.load(f)
+        rows = raw.get("events", raw) if isinstance(raw, dict) else raw
+        arrivals = arrival_trace_from_events(rows)
+        if not arrivals:
+            print(f"no request-submitted rows in {args.trace}",
+                  file=sys.stderr)
+            return 2
+    cfg = SimConfig(nodes=args.nodes, requests=args.requests,
+                    duration_s=args.duration, arrival=args.arrival,
+                    seed=args.seed, slots_per_node=args.slots,
+                    prefill_nodes=args.prefill_nodes,
+                    fail_nodes=fails, arrivals=arrivals)
+    report = run_sim(cfg).to_json()
+    line = json.dumps(report)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    if report["violations"] or report["starved"]:
+        print(f"sim FAILED: {len(report['violations'])} invariant "
+              f"violation(s), {report['starved']} starved request(s)",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,77 @@
+"""Sim-vs-real calibration: replay a recorded run, measure divergence.
+
+The calibration gate (``bench.py --scenario sim_calibrate``, wired
+into ``scripts/check.sh``) runs a small REAL cluster — master plus
+in-process workers — records its arrival trace (the
+``request-submitted`` journal rows) and its cost-ledger rows, fits a
+:class:`~tools.dlisim.fleet.WorkerModel` from them, replays the exact
+trace through :func:`~tools.dlisim.sim.run_sim`, and compares the
+three headline signals:
+
+- **goodput** (SLO-passing completions per second),
+- **TTFT p50** (queue + prefill, the cost ledger's definition),
+- **mean queue depth** (the ``queue_pending`` gauge's series).
+
+Tolerances are deliberately generous (see ``DEFAULT_TOLERANCES`` and
+docs/simulator.md "Calibration tolerance"): the gate exists to catch
+*rot* — a scheduler change that halves real goodput while the sim
+still predicts the old number, a service-model regression that makes
+the sim useless for capacity questions — not to pretend a
+discrete-event model reproduces a real machine to the percent. A
+divergence report lands next to the bench artifact either way, so CI
+keeps a history of how faithful the sim is.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+#: relative-error ceilings per metric; queue depth also passes within
+#: ``queue_depth_abs`` requests absolute (both sides are near zero in
+#: a healthy small run, where relative error is meaningless)
+DEFAULT_TOLERANCES = {
+    "goodput_req_per_s": 0.50,
+    "ttft_ms_p50": 0.75,
+    "queue_depth_mean": 1.00,
+    "queue_depth_abs": 3.0,
+}
+
+
+def _rel_err(real: float, sim: float) -> Optional[float]:
+    if real is None or sim is None:
+        return None
+    denom = max(abs(real), 1e-9)
+    return abs(sim - real) / denom
+
+
+def divergence_report(real: Dict[str, float], sim: Dict[str, float],
+                      tolerances: Optional[Dict[str, float]] = None
+                      ) -> dict:
+    """Compare real-run metrics against the sim replay's.
+
+    ``real`` and ``sim`` each carry ``goodput_req_per_s``,
+    ``ttft_ms_p50`` and ``queue_depth_mean`` (None = unmeasured; an
+    unmeasured metric is skipped, not failed — a smoke run too short
+    to produce a queue-depth series must not fail the gate on it).
+    Returns ``{"ok": bool, "metrics": {name: {...}}}``."""
+    tol = dict(DEFAULT_TOLERANCES)
+    tol.update(tolerances or {})
+    out: Dict[str, dict] = {}
+    ok = True
+    for key in ("goodput_req_per_s", "ttft_ms_p50", "queue_depth_mean"):
+        r, s = real.get(key), sim.get(key)
+        entry = {"real": r, "sim": s, "tolerance": tol[key]}
+        err = _rel_err(r, s)
+        entry["rel_err"] = round(err, 3) if err is not None else None
+        if err is None:
+            entry["ok"] = None   # unmeasured on a side: skip
+        else:
+            within = err <= tol[key]
+            if key == "queue_depth_mean" and not within:
+                # near-empty queues: a 0.2-vs-0.8 depth is a 3x
+                # relative error and an operationally identical run
+                within = abs(s - r) <= tol["queue_depth_abs"]
+            entry["ok"] = within
+            ok = ok and within
+        out[key] = entry
+    return {"ok": ok, "metrics": out}

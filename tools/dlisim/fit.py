@@ -1,0 +1,252 @@
+"""Fitting the synthetic fleet from the real fleet's telemetry.
+
+Three input families, in decreasing order of fidelity (each documented
+with its provenance in the fitted model's ``source`` map):
+
+1. **Cost-ledger rows** — the per-request phase records the master
+   persists on every completed request row (``runtime/batcher.py``
+   ``_cost_record``). Per-token prefill/decode rates fall straight out
+   as robust medians.
+2. **Bench artifacts** — ``BENCH_*.json`` / ``MULTICHIP_*.json``
+   emitted by ``bench.py``: decode tok/s and TTFT numbers.
+3. **Priors** — CPU tiny-llama-scale defaults, used wherever no
+   recorded telemetry covers a parameter.
+
+The arrival side comes from the flight recorder: every ``api_submit``
+journals a ``request-submitted`` event whose ``ts`` is the arrival
+time and whose data carries the workload shape, so any journal read
+(or debug bundle's ``workload_capture.json``) IS a replayable trace.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import random
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from .fleet import WorkerModel
+
+#: CPU tiny-llama-scale priors; every fitted model starts here and
+#: overrides per parameter as telemetry covers it
+DEFAULT_MODEL = WorkerModel(source={"prefill_ms_per_token": "prior",
+                                    "decode_ms_per_token": "prior",
+                                    "overhead_ms": "prior"})
+
+
+def _median(xs: List[float]) -> Optional[float]:
+    if not xs:
+        return None
+    s = sorted(xs)
+    n = len(s)
+    return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
+def fit_worker_model(cost_rows: Iterable[dict],
+                     base: Optional[WorkerModel] = None) -> WorkerModel:
+    """Fit per-token service rates from cost-ledger records.
+
+    Accepts the ``cost`` dicts off completed request rows (JSON strings
+    tolerated). Median, not mean: a single preempted or cold-compile
+    outlier must not skew the whole fleet's service model."""
+    base = base or DEFAULT_MODEL
+    prefill_rates: List[float] = []
+    decode_rates: List[float] = []
+    overheads: List[float] = []
+    n = 0
+    for cost in cost_rows:
+        if isinstance(cost, str):
+            try:
+                cost = json.loads(cost)
+            except ValueError:
+                continue
+        if not isinstance(cost, dict):
+            continue
+        n += 1
+        pf = cost.get("prefill_ms")
+        unc = cost.get("prefill_uncached_tokens")
+        if isinstance(pf, (int, float)) and isinstance(unc, int) and unc > 0:
+            # the same mostly-uncached filter the master's prefill EWMA
+            # applies: cache-hit prefills say nothing about compute cost
+            cached = cost.get("prefill_cached_tokens") or 0
+            if unc >= cached:
+                prefill_rates.append(float(pf) / unc)
+        dm = cost.get("decode_ms")
+        dt = cost.get("decode_tokens")
+        if isinstance(dm, (int, float)) and isinstance(dt, int) and dt > 1:
+            # first-token cost rides prefill; per-token rate from the
+            # remaining gap keeps the two phases separable
+            decode_rates.append(float(dm) / dt)
+        if isinstance(dm, (int, float)) and isinstance(dt, int) and dt == 1:
+            overheads.append(float(dm))
+    source = dict(base.source)
+    pr = _median(prefill_rates)
+    dr = _median(decode_rates)
+    ov = _median(overheads)
+    if pr is not None:
+        source["prefill_ms_per_token"] = f"cost-ledger({len(prefill_rates)})"
+    if dr is not None:
+        source["decode_ms_per_token"] = f"cost-ledger({len(decode_rates)})"
+    if ov is not None:
+        source["overhead_ms"] = f"cost-ledger({len(overheads)})"
+    return WorkerModel(
+        prefill_ms_per_token=pr if pr is not None
+        else base.prefill_ms_per_token,
+        decode_ms_per_token=dr if dr is not None
+        else base.decode_ms_per_token,
+        overhead_ms=ov if ov is not None else base.overhead_ms,
+        chars_per_token=base.chars_per_token,
+        source=source)
+
+
+def fit_from_artifacts(paths: Iterable[str],
+                       base: Optional[WorkerModel] = None) -> WorkerModel:
+    """Fold bench JSON artifacts (``BENCH_*.json``, ``MULTICHIP_*.json``,
+    ``/tmp/dli_bench_*.json``) into the model: any ``tok_s`` /
+    ``tokens_per_s`` number bounds the decode rate, any ``ttft_ms``
+    the fixed overhead. Liberal by design — artifact schemas differ
+    per scenario and a fitter that rejects unknown shapes would rot
+    with every new bench."""
+    base = base or DEFAULT_MODEL
+    tok_s: List[float] = []
+    ttft_ms: List[float] = []
+
+    def walk(obj):
+        if isinstance(obj, dict):
+            for k, v in obj.items():
+                lk = str(k).lower()
+                if isinstance(v, (int, float)) and v > 0:
+                    if "tok_s" in lk or "tokens_per_s" in lk:
+                        tok_s.append(float(v))
+                    elif "ttft" in lk and "ms" in lk:
+                        ttft_ms.append(float(v))
+                else:
+                    walk(v)
+        elif isinstance(obj, list):
+            for v in obj:
+                walk(v)
+
+    used = []
+    for p in paths:
+        try:
+            with open(p) as f:
+                walk(json.load(f))
+            used.append(p)
+        except (OSError, ValueError):
+            continue
+    source = dict(base.source)
+    dr = _median(tok_s)
+    ov = _median(ttft_ms)
+    decode = base.decode_ms_per_token
+    overhead = base.overhead_ms
+    if dr:
+        decode = 1e3 / dr
+        source["decode_ms_per_token"] = f"bench:{','.join(used)}"
+    if ov:
+        overhead = ov
+        source["overhead_ms"] = f"bench:{','.join(used)}"
+    return WorkerModel(prefill_ms_per_token=base.prefill_ms_per_token,
+                       decode_ms_per_token=decode, overhead_ms=overhead,
+                       chars_per_token=base.chars_per_token, source=source)
+
+
+# ---- arrival traces --------------------------------------------------
+
+def arrival_trace_from_events(rows: Iterable[dict]) -> List[dict]:
+    """Journal rows (``type=request-submitted``, from
+    ``Store.query_events`` or a debug bundle's ``workload_capture.json``)
+    -> replayable arrival trace: relative arrival offset + workload
+    shape per request, submission order preserved."""
+    out: List[dict] = []
+    t0: Optional[float] = None
+    for r in rows:
+        if r.get("type") not in (None, "request-submitted"):
+            continue
+        ts = r.get("ts")
+        if ts is None:
+            continue
+        data = r.get("data") or {}
+        if isinstance(data, str):
+            try:
+                data = json.loads(data)
+            except ValueError:
+                data = {}
+        if t0 is None:
+            t0 = float(ts)
+        out.append({
+            "at": float(ts) - t0,
+            "model": data.get("model") or "tiny-llama",
+            "prompt_chars": int(data.get("prompt_chars") or 16),
+            "max_new_tokens": int(data.get("max_new_tokens")
+                                  or data.get("max_length") or 16),
+        })
+    return out
+
+
+def synthetic_arrivals(kind: str, n: int, duration_s: float,
+                       seed: int = 0, model: str = "tiny-llama",
+                       prompt_chars: Tuple[int, int] = (32, 512),
+                       max_new: Tuple[int, ...] = (8, 16, 32, 64),
+                       ) -> List[dict]:
+    """Deterministic synthetic arrival trace of exactly ``n`` requests
+    over ``duration_s`` virtual seconds.
+
+    - ``uniform``: evenly spaced with jitter;
+    - ``diurnal``: sinusoidal rate (one full day-shaped cycle over the
+      window) — arrival times are the inverse-CDF of the rate curve,
+      so the count is exact and the shape seed-independent;
+    - ``bursty``: on/off square wave — 80% of traffic in 20% of time;
+    - ``adversarial``: bursty arrivals plus heavy-tailed prompts,
+      token-budget spikes and same-instant ties (the scheduler's
+      worst-case inputs).
+    """
+    rng = random.Random(seed)
+    times: List[float] = []
+    if kind == "uniform":
+        for i in range(n):
+            times.append(duration_s * (i + rng.random()) / n)
+    elif kind == "diurnal":
+        # rate(t) = 1 + 0.8*sin(2*pi*t/T); CDF inverted on a grid
+        grid = 2048
+        cdf = [0.0]
+        for g in range(grid):
+            t = duration_s * (g + 0.5) / grid
+            rate = 1.0 + 0.8 * math.sin(2 * math.pi * t / duration_s)
+            cdf.append(cdf[-1] + rate)
+        total = cdf[-1]
+        gi = 0
+        for i in range(n):
+            target = total * (i + rng.random()) / n
+            while gi < grid and cdf[gi + 1] < target:
+                gi += 1
+            # linear interp inside the grid cell
+            lo, hi = cdf[gi], cdf[min(grid, gi + 1)]
+            frac = 0.0 if hi <= lo else (target - lo) / (hi - lo)
+            times.append(duration_s * (gi + frac) / grid)
+    elif kind in ("bursty", "adversarial"):
+        bursts = 8
+        for i in range(n):
+            b = rng.randrange(bursts)
+            window = duration_s / bursts
+            if rng.random() < 0.8:
+                t = b * window + rng.random() * 0.2 * window
+            else:
+                t = b * window + rng.random() * window
+            if kind == "adversarial" and rng.random() < 0.05:
+                t = b * window   # exact tie: same-instant spike
+            times.append(t)
+        times.sort()
+    else:
+        raise ValueError(f"unknown arrival kind {kind!r}")
+    out = []
+    lo, hi = prompt_chars
+    for t in sorted(times):
+        if kind == "adversarial" and rng.random() < 0.03:
+            pc = hi * 8   # heavy tail: pathological prompt
+            mn = max_new[-1] * 4
+        else:
+            pc = rng.randint(lo, hi)
+            mn = rng.choice(max_new)
+        out.append({"at": round(t, 6), "model": model,
+                    "prompt_chars": pc, "max_new_tokens": mn})
+    return out

@@ -1,0 +1,223 @@
+"""Synthetic worker fleet: the data plane the simulator replaces.
+
+A :class:`SimNode` stands in for one ``runtime/worker.py`` process. It
+does no inference — it answers the master's ``/health`` and
+``/metrics`` RPCs with the same body shapes a real worker advertises
+(``_note_runtime``'s contract) and, when the simulator dispatches a
+request to it, computes a *service time* from its fitted
+:class:`WorkerModel` plus a deterministic slot-queueing discipline.
+
+The queueing model mirrors the real batcher's admission shape at the
+fidelity the control plane can observe: ``slots`` concurrent
+sequences, FIFO admission into the earliest-free slot, queue time =
+time spent waiting for a slot. Everything the master's queue-aware
+scheduler reads (queue depth, free blocks, arena occupancy, role,
+prefix advertisements) is synthesized here from that state.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass
+class WorkerModel:
+    """Fitted per-worker service-time model (see ``fit.py``).
+
+    Times are the per-phase costs the cost ledger records for real
+    requests, so a fitted model's replay produces cost rows directly
+    comparable to the originals:
+
+    - ``prefill_ms_per_token``: uncached prompt-token cost;
+    - ``decode_ms_per_token``: per generated token cost;
+    - ``overhead_ms``: fixed per-request overhead (RPC + admission);
+    - ``chars_per_token``: the prompt-chars -> tokens estimate, kept
+      identical to the master's ``_DISAGG_CHARS_PER_TOKEN`` so both
+      sides of a disagg decision price the same token count.
+    """
+
+    prefill_ms_per_token: float = 0.35
+    decode_ms_per_token: float = 18.0
+    overhead_ms: float = 8.0
+    chars_per_token: int = 4
+    #: provenance: where each parameter came from ("prior",
+    #: "cost-ledger", "bench:<file>") — carried into reports so a
+    #: calibration failure names its inputs
+    source: Dict[str, str] = field(default_factory=dict)
+
+    def tokens(self, prompt_chars: int) -> int:
+        return max(1, int(prompt_chars) // max(1, self.chars_per_token))
+
+    def service(self, prompt_chars: int, max_new_tokens: int,
+                cached_tokens: int = 0) -> Tuple[float, float, int]:
+        """(prefill_ms, decode_ms, decode_tokens) for one request."""
+        ptoks = self.tokens(prompt_chars)
+        uncached = max(0, ptoks - int(cached_tokens))
+        prefill_ms = self.overhead_ms + uncached * self.prefill_ms_per_token
+        dtoks = max(1, int(max_new_tokens))
+        decode_ms = dtoks * self.decode_ms_per_token
+        return prefill_ms, decode_ms, dtoks
+
+
+@dataclass
+class NodeSpec:
+    """Static shape of one synthetic node."""
+
+    name: str
+    port: int
+    role: str = "mixed"            # mixed | prefill | decode
+    slots: int = 8
+    blocks_total: int = 256
+    #: host-arena occupancy advertised on /health; non-None means the
+    #: node can export KV (the master's _node_can_export gate)
+    arena_occ: Optional[float] = 0.1
+    #: speed multiplier (>1 = slower node); heterogeneous fleets
+    speed: float = 1.0
+
+
+class SimNode:
+    """One synthetic worker: slot queue + health/metrics synthesis."""
+
+    def __init__(self, spec: NodeSpec, model: WorkerModel,
+                 models: Tuple[str, ...] = ("tiny-llama",)):
+        self.spec = spec
+        self.model = model
+        self.models = models
+        self.role = spec.role
+        # earliest virtual time each batcher slot frees up
+        self._slot_free: List[float] = [0.0] * max(1, spec.slots)
+        self.inflight = 0
+        self.completed = 0
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        # fault injection: [down_from, down_until) virtual-time windows
+        self.down_windows: List[Tuple[float, float]] = []
+        self.draining = False
+
+    # ---- fault injection ---------------------------------------------
+
+    def fail_between(self, start: float, end: float) -> None:
+        self.down_windows.append((float(start), float(end)))
+
+    def is_down(self, now: float) -> bool:
+        return any(s <= now < e for s, e in self.down_windows)
+
+    def went_down_during(self, start: float, end: float) -> bool:
+        """Did a fault window open inside [start, end)? (A generation
+        in flight across the window's opening edge is lost.)"""
+        return any(start < e and s < end for s, e in self.down_windows)
+
+    # ---- service -----------------------------------------------------
+
+    def assign(self, now: float, prompt_chars: int, max_new_tokens: int,
+               cached_tokens: int = 0,
+               prefill_only: bool = False) -> Tuple[float, dict]:
+        """Admit one request at virtual time ``now``: occupy the
+        earliest-free slot, return ``(finish_time, cost_record)``.
+
+        The cost record is the same shape the real batcher's
+        ``_cost_record`` persists (the keys the SLO evaluator and
+        ``fit.py`` read), so simulated ledger rows round-trip through
+        the exact fitting path real rows do."""
+        prefill_ms, decode_ms, dtoks = self.model.service(
+            prompt_chars, max_new_tokens, cached_tokens)
+        if prefill_only:
+            decode_ms, dtoks = 0.0, 0
+        slot = min(range(len(self._slot_free)),
+                   key=lambda i: self._slot_free[i])
+        start = max(now, self._slot_free[slot])
+        service_s = (prefill_ms + decode_ms) * self.spec.speed / 1e3
+        end = start + service_s
+        self._slot_free[slot] = end
+        self.inflight += 1
+        queue_ms = (start - now) * 1e3
+        if cached_tokens > 0:
+            self.prefix_hits += 1
+        else:
+            self.prefix_misses += 1
+        ptoks = self.model.tokens(prompt_chars)
+        cost = {
+            "queue_ms": round(queue_ms, 3),
+            "prefill_ms": round(prefill_ms * self.spec.speed, 3),
+            "decode_ms": round(decode_ms * self.spec.speed, 3),
+            "prefill_cached_tokens": int(cached_tokens),
+            "prefill_uncached_tokens": max(0, ptoks - int(cached_tokens)),
+            "decode_tokens": dtoks,
+            "weight_passes": 1 + dtoks,
+            "kv_blocks_peak": max(1, (ptoks + dtoks) // 8),
+            "preemptions": 0,
+        }
+        return end, cost
+
+    def release(self, now: float) -> None:
+        self.inflight = max(0, self.inflight - 1)
+        self.completed += 1
+
+    def queued(self, now: float) -> int:
+        """Requests admitted but not yet holding a slot at ``now``."""
+        return max(0, self.inflight - len(self._slot_free))
+
+    def blocks_free(self, now: float) -> int:
+        busy = sum(1 for t in self._slot_free if t > now)
+        per_seq = max(1, self.spec.blocks_total // len(self._slot_free))
+        return max(0, self.spec.blocks_total - busy * per_seq)
+
+    # ---- what the master sees ----------------------------------------
+
+    def health_body(self, now: float) -> dict:
+        sched = {
+            "queued": self.queued(now),
+            "blocks_free": self.blocks_free(now),
+            "pool": {"prefix_hits": self.prefix_hits,
+                     "prefix_misses": self.prefix_misses},
+        }
+        if self.spec.arena_occ is not None:
+            sched["kvtier"] = {"occupancy": self.spec.arena_occ}
+        return {
+            "status": "draining" if self.draining else "ok",
+            "role": self.role,
+            "draining": self.draining,
+            "arena_occupancy": self.spec.arena_occ,
+            "loaded_models": [
+                {"name": m, "scheduler": dict(sched)} for m in self.models],
+        }
+
+    def metrics_text(self, now: float) -> str:
+        """Minimal Prometheus exposition — just the series the master's
+        telemetry sweep derives ratios from, plus a depth gauge."""
+        return (
+            f"dli_radix_prefix_hits_total {self.prefix_hits}\n"
+            f"dli_radix_prefix_misses_total {self.prefix_misses}\n"
+            f"dli_batcher_queue_depth {self.queued(now)}\n"
+            f"dli_requests_completed_total {self.completed}\n")
+
+
+class SyntheticFleet:
+    """The full node set, addressable the way the master addresses
+    real workers: by the (host, port) on the registered node row."""
+
+    BASE_PORT = 20000
+
+    def __init__(self, specs: List[NodeSpec], model: WorkerModel):
+        self.model = model
+        self.nodes: List[SimNode] = [SimNode(s, model) for s in specs]
+        self.by_port: Dict[int, SimNode] = {
+            n.spec.port: n for n in self.nodes}
+
+    @classmethod
+    def uniform(cls, n: int, model: WorkerModel, *, slots: int = 8,
+                prefill_nodes: int = 0,
+                arena_occ: Optional[float] = 0.1) -> "SyntheticFleet":
+        """``n`` homogeneous nodes; the first ``prefill_nodes`` declare
+        the strict prefill role (the pool ``_plan_disagg`` requires)."""
+        specs = []
+        for i in range(n):
+            role = "prefill" if i < prefill_nodes else "mixed"
+            specs.append(NodeSpec(name=f"sim{i:04d}",
+                                  port=cls.BASE_PORT + i, role=role,
+                                  slots=slots, arena_occ=arena_occ))
+        return cls(specs, model)
+
+    def __len__(self) -> int:
+        return len(self.nodes)

@@ -1,0 +1,636 @@
+"""The discrete-event engine: real control plane, virtual time.
+
+:class:`SimMaster` subclasses the production ``Master`` and replaces
+exactly three things — the two worker RPC methods and the concurrent
+scrape fan-out — with deterministic, in-process equivalents backed by
+the :class:`~tools.dlisim.fleet.SyntheticFleet`. Everything else (the
+scheduler, breaker state machine, retry/backoff, the group-commit
+``Store``, the TSDB, the flight recorder) is the shipped code.
+
+:func:`run_sim` owns the virtual clock and the event loop. It drives
+the master at function level, mirroring the real thread structure:
+
+- an *arrival* calls ``api_submit`` (journals ``request-submitted``);
+- a *dispatch* pass does what one ``_dispatch_loop`` wave does —
+  ``claim_next_pending_many`` then per request ``_plan_disagg`` /
+  ``_reserve_node_for`` / ``_note_dispatch`` — and hands the request
+  to the synthetic node, scheduling its completion event;
+- a *completion* runs the real terminal tails
+  (``_complete_request`` / ``_fail_sub``) with the same
+  in-flight/processing bookkeeping ``_execute_on_node`` keeps;
+- *health* and *telemetry* events invoke the real sweeps on their
+  configured cadence.
+
+Determinism: the virtual clock only moves in the event loop; the
+global ``random`` seed fixes backoff jitter; the master's private
+pick RNG is fixed-seeded; scrapes run sequentially in node order; the
+store is flushed at every decision point so group-commit visibility
+never depends on the background flusher's real-time race. The
+``journal_hash`` in the report digests every emitted event — two runs
+with the same config and seed must produce the same hash.
+
+Invariant checking rides the dispatch path (see
+:class:`InvariantChecker`): violations are collected, never raised,
+so a gate run reports all of them.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import heapq
+import json
+import random
+import time as _time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from distributed_llm_inferencing_tpu.runtime import master as master_mod
+from distributed_llm_inferencing_tpu.runtime import tsdb as tsdb_mod
+from distributed_llm_inferencing_tpu.utils import clock
+
+from .fit import DEFAULT_MODEL, synthetic_arrivals
+from .fleet import SyntheticFleet, WorkerModel
+
+
+class _FakeResponse:
+    """The minimal surface the master reads off a worker response."""
+
+    def __init__(self, status: int = 200, body: Optional[dict] = None,
+                 text: Optional[str] = None):
+        self.status_code = status
+        self._body = body
+        self.text = (text if text is not None
+                     else (json.dumps(body) if body is not None else ""))
+        self.headers: Dict[str, str] = {}
+
+    def json(self):
+        if self._body is None:
+            raise ValueError("no JSON body")
+        return self._body
+
+    def raise_for_status(self):
+        if self.status_code >= 400:
+            raise RuntimeError(f"sim worker HTTP {self.status_code}")
+
+
+class SimMaster(master_mod.Master):
+    """The production master with its worker I/O redirected at the
+    synthetic fleet. Node rows address fleet members by port."""
+
+    def __init__(self, fleet: SyntheticFleet, vclock, **kw):
+        self._fleet = fleet
+        self._vclock = vclock
+        kw.setdefault("tsdb_snapshot_s", 0.0)   # multi-MB dumps off
+        kw.setdefault("rebalance", False)
+        super().__init__(":memory:", **kw)
+
+    def _sim_node(self, node):
+        sn = self._fleet.by_port.get(node["port"])
+        if sn is None:
+            raise ConnectionError(f"sim: unknown node {node.get('name')}")
+        if sn.is_down(clock.now()):
+            raise ConnectionError(f"sim: {sn.spec.name} unreachable")
+        return sn
+
+    def _worker_get(self, node, path, timeout, stream=False):
+        sn = self._sim_node(node)
+        now = clock.now()
+        if path == "/health":
+            return _FakeResponse(200, sn.health_body(now))
+        if path == "/metrics":
+            return _FakeResponse(200, text=sn.metrics_text(now))
+        return _FakeResponse(404, {"status": "error",
+                                   "message": f"sim: no GET {path}"})
+
+    def _worker_post(self, node, path, body, timeout, stream=False):
+        sn = self._sim_node(node)
+        if path == "/cancel":
+            # orphan cancels (terminal timeout / completed-elsewhere):
+            # acknowledge; the synthetic generation holds no real slot
+            return _FakeResponse(200, {"status": "success"})
+        if path == "/admin/role":
+            sn.role = str((body or {}).get("role") or sn.role)
+            return _FakeResponse(200, {"status": "success",
+                                       "role": sn.role})
+        return _FakeResponse(404, {"status": "error",
+                                   "message": f"sim: no POST {path}"})
+
+    def _scrape_workers(self, path: str, nodes=None):
+        # sequential and in node order — the real thread-pool fan-out
+        # would interleave _note_runtime updates nondeterministically
+        if nodes is None:
+            nodes = self.store.list_nodes(active_only=True)
+        out = []
+        for n in nodes:
+            try:
+                r = self._worker_get(n, path, 1.0)
+                r.raise_for_status()
+                out.append((n, r, None))
+            except Exception as e:
+                out.append((n, None, str(e)[:200]))
+        return out
+
+    def _purge_session(self, node):
+        pass   # no real sockets to purge
+
+
+@dataclass
+class SimConfig:
+    nodes: int = 100
+    requests: int = 10_000
+    duration_s: float = 600.0         # virtual seconds of arrivals
+    arrival: str = "diurnal"          # uniform|diurnal|bursty|adversarial
+    seed: int = 42
+    slots_per_node: int = 8
+    prefill_nodes: int = 0            # >0 enables the disagg planner path
+    model: WorkerModel = field(default_factory=lambda: DEFAULT_MODEL)
+    health_interval_s: float = 15.0
+    telemetry_interval_s: float = 30.0
+    dispatch_batch: Optional[int] = None
+    sched_sample: Optional[int] = None
+    disagg_min_prompt: Optional[int] = None
+    #: fault injection: (node_index, down_from_s, down_until_s) —
+    #: relative virtual time; the node refuses RPCs in the window and
+    #: loses generations in flight across its opening edge
+    fail_nodes: List[Tuple[int, float, float]] = field(default_factory=list)
+    #: explicit arrival trace (fit.arrival_trace_from_events output);
+    #: overrides (requests, duration_s, arrival)
+    arrivals: Optional[List[dict]] = None
+    #: how long past the last arrival to keep draining (virtual s)
+    drain_s: float = 600.0
+
+
+@dataclass
+class SimReport:
+    config: dict
+    requests: int = 0
+    completed: int = 0
+    failed: int = 0
+    wall_s: float = 0.0
+    sim_s: float = 0.0
+    journal_hash: str = ""
+    journal_counts: Dict[str, int] = field(default_factory=dict)
+    metrics: Dict[str, float] = field(default_factory=dict)
+    violations: List[dict] = field(default_factory=list)
+    starved: int = 0
+    pick_us_mean: float = 0.0
+    pick_us_p95: float = 0.0
+    ttft_ms_p50: Optional[float] = None
+    ttft_ms_p95: Optional[float] = None
+    goodput_req_per_s: Optional[float] = None
+    queue_depth_mean: Optional[float] = None
+    queue_depth_max: int = 0
+    breaker: Dict[str, int] = field(default_factory=dict)
+
+    def to_json(self) -> dict:
+        return dict(self.__dict__)
+
+
+class InvariantChecker:
+    """Dispatch-time and end-state invariants, snapshot-consistent:
+    every check compares against the node snapshot the scheduler
+    itself used, so a mid-wave breaker transition (which the real
+    dispatcher also cannot see) is not a false positive."""
+
+    def __init__(self, master):
+        self.m = master
+        self.violations: List[dict] = []
+
+    def _flag(self, kind: str, **ctx):
+        self.violations.append({"kind": kind, "t": clock.now(), **ctx})
+
+    def _by_id(self, nodes) -> dict:
+        # keyed on the snapshot list's identity: the engine hands the
+        # same cached list to every wave until a node row changes, so
+        # this O(fleet) build runs per refresh, not per request
+        if getattr(self, "_by_id_key", None) != id(nodes):
+            self._by_id_key = id(nodes)
+            self._by_id_map = {n["id"]: n for n in nodes}
+        return self._by_id_map
+
+    def _schedulable(self, n) -> bool:
+        if n.get("draining"):
+            return False
+        bs = n.get("breaker_state") or "closed"
+        if bs == "open":
+            return False
+        return not (bs == "half_open"
+                    and self.m._inflight.get(n["id"], 0) > 0)
+
+    def post_pick(self, req, node, nodes) -> None:
+        snap = self._by_id(nodes).get(node["id"])
+        if snap is None:
+            self._flag("pick-outside-snapshot", request_id=req["id"],
+                       node_id=node["id"])
+            return
+        bs = snap.get("breaker_state") or "closed"
+        if bs == "open" or not snap.get("is_active"):
+            self._flag("dispatch-to-open-breaker", request_id=req["id"],
+                       node_id=node["id"], breaker_state=bs)
+        if bs == "half_open":
+            with self.m._inflight_lock:
+                inflight = self.m._inflight.get(node["id"], 0)
+            if inflight > 1:
+                self._flag("half-open-multi-probe", request_id=req["id"],
+                           node_id=node["id"], inflight=inflight)
+        if snap.get("draining"):
+            self._flag("dispatch-to-draining", request_id=req["id"],
+                       node_id=node["id"])
+        excluded = set(req.get("excluded_nodes") or [])
+        if node["id"] in excluded:
+            # the exclusion-fallback pick is legitimate only when no
+            # non-excluded candidate was schedulable; the O(fleet)
+            # re-check runs only on this rare path
+            with self.m._inflight_lock:
+                had_other = any(
+                    n["id"] not in excluded and self._schedulable(n)
+                    and n["id"] != node["id"] for n in nodes)
+            if had_other:
+                self._flag("exclusion-ignored", request_id=req["id"],
+                           node_id=node["id"], excluded=sorted(excluded))
+        if req["attempts"] >= master_mod.MAX_ATTEMPTS:
+            self._flag("attempts-exceeded", request_id=req["id"],
+                       attempts=req["attempts"])
+
+    def end_state(self, store) -> None:
+        for n in store.list_nodes():
+            bs = n.get("breaker_state") or "closed"
+            if bs == "open" and n.get("is_active"):
+                self._flag("open-breaker-active", node_id=n["id"])
+            if bs == "half_open" and not n.get("is_active"):
+                self._flag("half-open-inactive", node_id=n["id"])
+        counts = store.counts()
+        for status in ("pending", "processing"):
+            if counts.get(status, 0):
+                self._flag("non-terminal-requests", status=status,
+                           count=counts[status])
+
+
+# event-kind ordinals: at one virtual instant, completions land before
+# the dispatch pass (a freed slot is claimable by the same wave) and
+# dispatch runs after arrivals
+_K_RELEASE, _K_COMPLETE, _K_ARRIVE, _K_HEALTH, _K_TELEM, _K_DISPATCH = \
+    range(6)
+
+
+def run_sim(cfg: SimConfig) -> SimReport:
+    """Run one simulation to completion and return its report."""
+    vc = clock.VirtualClock()
+    prev = clock.set_clock(vc)
+    m = None
+    try:
+        random.seed(cfg.seed)
+        fleet = SyntheticFleet.uniform(
+            cfg.nodes, cfg.model, slots=cfg.slots_per_node,
+            prefill_nodes=cfg.prefill_nodes)
+        base = vc.now()
+        for idx, down_at, up_at in cfg.fail_nodes:
+            fleet.nodes[idx % len(fleet)].fail_between(
+                base + down_at, base + up_at)
+        kw = {}
+        if cfg.dispatch_batch is not None:
+            kw["dispatch_batch"] = cfg.dispatch_batch
+        if cfg.sched_sample is not None:
+            kw["sched_sample"] = cfg.sched_sample
+        if cfg.disagg_min_prompt is not None:
+            kw["disagg_min_prompt"] = cfg.disagg_min_prompt
+        m = SimMaster(fleet, vc, health_interval=cfg.health_interval_s,
+                      **kw)
+        # register the fleet: active rows with the health body as the
+        # registration info (the pick path's _node_models source), and
+        # the runtime view warmed exactly as a first health sweep would
+        now = vc.now()
+        for sn in fleet.nodes:
+            body = sn.health_body(now)
+            nid = m.store.add_node(sn.spec.name, "sim.invalid",
+                                   sn.spec.port, is_active=True)
+            m.store.update_node(nid, info=body, last_heartbeat=now)
+            m._note_runtime(nid, body)
+
+        digest = hashlib.sha256()
+        jcounts: Dict[str, int] = {}
+        orig_emit = m.events.emit
+
+        def emit(etype, **kwargs):
+            ev = orig_emit(etype, **kwargs)
+            jcounts[etype] = jcounts.get(etype, 0) + 1
+            digest.update(json.dumps(
+                [round(clock.now(), 6), etype, kwargs],
+                sort_keys=True, default=repr).encode())
+            return ev
+
+        m.events.emit = emit
+
+        arrivals = cfg.arrivals
+        if arrivals is None:
+            arrivals = synthetic_arrivals(
+                cfg.arrival, cfg.requests, cfg.duration_s, seed=cfg.seed)
+        engine = _Engine(m, fleet, vc, InvariantChecker(m))
+        wall0 = _time.perf_counter()
+        engine.run(arrivals, base, cfg.drain_s)
+        wall = _time.perf_counter() - wall0
+
+        m.store.flush()
+        engine.inv.end_state(m.store)
+        counts = m.store.counts()
+        snap = m.metrics.snapshot()
+        c = snap["counters"]
+        rep = SimReport(config={
+            "nodes": cfg.nodes, "requests": len(arrivals),
+            "arrival": cfg.arrival if cfg.arrivals is None else "trace",
+            "seed": cfg.seed, "duration_s": cfg.duration_s,
+            "prefill_nodes": cfg.prefill_nodes,
+            "slots_per_node": cfg.slots_per_node,
+            "model_source": dict(cfg.model.source),
+            "fail_nodes": list(cfg.fail_nodes),
+        })
+        rep.requests = len(arrivals)
+        rep.completed = counts.get("completed", 0)
+        rep.failed = counts.get("failed", 0)
+        rep.starved = counts.get("pending", 0) + counts.get("processing", 0)
+        rep.wall_s = round(wall, 3)
+        rep.sim_s = round(vc.now() - base, 3)
+        rep.journal_hash = digest.hexdigest()
+        rep.journal_counts = jcounts
+        rep.violations = engine.inv.violations
+        picks = sorted(engine.pick_times_us)
+        if picks:
+            rep.pick_us_mean = round(sum(picks) / len(picks), 2)
+            rep.pick_us_p95 = round(picks[int(0.95 * (len(picks) - 1))], 2)
+        ttfts = sorted(engine.ttfts_ms)
+        if ttfts:
+            rep.ttft_ms_p50 = round(ttfts[len(ttfts) // 2], 2)
+            rep.ttft_ms_p95 = round(ttfts[int(0.95 * (len(ttfts) - 1))], 2)
+        if engine.queue_samples:
+            rep.queue_depth_mean = round(
+                sum(engine.queue_samples) / len(engine.queue_samples), 2)
+            rep.queue_depth_max = max(engine.queue_samples)
+        if rep.sim_s > 0:
+            rep.goodput_req_per_s = round(
+                engine.within_slo / rep.sim_s, 3)
+        rep.metrics = {k: v for k, v in sorted(c.items())
+                       if k.startswith(("requests_", "scheduler_",
+                                        "breaker_", "slo_"))}
+        rep.breaker = {
+            "opened": int(c.get("breaker_opened", 0)),
+            "half_opened": int(c.get("breaker_half_opened", 0)),
+            "closed": int(c.get("breaker_closed", 0)),
+        }
+        return rep
+    finally:
+        if m is not None:
+            try:
+                m.stop()
+            except Exception:
+                pass
+        clock.set_clock(prev)
+
+
+class _Engine:
+    """The heapq event loop. One instance per run."""
+
+    def __init__(self, m: SimMaster, fleet: SyntheticFleet, vc,
+                 inv: InvariantChecker):
+        self.m = m
+        self.fleet = fleet
+        self.vc = vc
+        self.inv = inv
+        self.heap: List[tuple] = []
+        self._seq = 0
+        self._dispatch_at: Optional[float] = None
+        self.pick_times_us: List[float] = []
+        self.ttfts_ms: List[float] = []
+        self.queue_samples: List[int] = []
+        self.within_slo = 0
+        self._slo_targets = tsdb_mod.slo_targets()
+        # active-node snapshot cache: the real dispatcher re-queries
+        # per wave, but its rows only change when something writes the
+        # nodes table — so the engine intercepts update_node and
+        # re-queries per CHANGE instead of per wave (at 1000 nodes and
+        # 100k requests the per-wave query alone would dominate wall
+        # time without altering a single scheduling outcome)
+        self._nodes_cache: Optional[list] = None
+        orig_update = m.store.update_node
+
+        def _update_node(node_id, **fields):
+            self._nodes_cache = None
+            return orig_update(node_id, **fields)
+
+        m.store.update_node = _update_node
+
+    def _active_nodes(self) -> list:
+        if self._nodes_cache is None:
+            self._nodes_cache = self.m.store.list_nodes(active_only=True)
+        return self._nodes_cache
+
+    def _push(self, t: float, kind: int, payload=None):
+        self._seq += 1
+        heapq.heappush(self.heap, (t, kind, self._seq, payload))
+
+    def _sched_dispatch(self, t: float):
+        if self._dispatch_at is None or t < self._dispatch_at:
+            self._dispatch_at = t
+            self._push(t, _K_DISPATCH)
+
+    def run(self, arrivals: List[dict], base: float, drain_s: float):
+        m, vc = self.m, self.vc
+        last_at = 0.0
+        for i, a in enumerate(arrivals):
+            self._push(base + a["at"], _K_ARRIVE, (i, a))
+            last_at = max(last_at, a["at"])
+        end_guard = base + last_at + drain_s
+        self._push(base + m.health_interval, _K_HEALTH)
+        self._push(base + m.tsdb.step_s, _K_TELEM)
+        while self.heap:
+            t, kind, _, payload = heapq.heappop(self.heap)
+            if t > end_guard:
+                break
+            if t > vc.now():
+                vc.advance(t - vc.now())
+            if kind == _K_ARRIVE:
+                self._on_arrive(payload[0], payload[1])
+            elif kind == _K_COMPLETE:
+                self._on_complete(*payload)
+            elif kind == _K_RELEASE:
+                self._on_release(*payload)
+            elif kind == _K_HEALTH:
+                self._on_health()
+                if self._work_remaining():
+                    self._push(t + m.health_interval, _K_HEALTH)
+            elif kind == _K_TELEM:
+                m._telemetry_sweep()
+                if self._work_remaining():
+                    self._push(t + m.tsdb.step_s, _K_TELEM)
+            elif kind == _K_DISPATCH:
+                if self._dispatch_at is not None and t >= self._dispatch_at:
+                    self._dispatch_at = None
+                    self._dispatch_pass()
+
+    def _work_remaining(self) -> bool:
+        return any(k in (_K_ARRIVE, _K_COMPLETE, _K_DISPATCH)
+                   for _, k, _, _ in self.heap) or bool(self._dispatch_at)
+
+    # ---- event handlers ----------------------------------------------
+
+    def _on_arrive(self, i: int, a: dict):
+        prompt = f"req{i:06d}:" + "x" * max(0, a["prompt_chars"] - 10)
+        resp = self.m.api_submit({
+            "model_name": a["model"], "prompt": prompt,
+            "max_new_tokens": a["max_new_tokens"],
+            "sampling": {"do_sample": False}})
+        if isinstance(resp, tuple) or resp.get("status") != "success":
+            self.inv._flag("submit-rejected", arrival=i, resp=repr(resp))
+            return
+        self._sched_dispatch(self.vc.now())
+
+    def _dispatch_pass(self):
+        m = self.m
+        m.store.flush()
+        parked = False
+        while True:
+            reqs = m.store.claim_next_pending_many(m.dispatch_batch)
+            if not reqs:
+                break
+            for req in reqs:
+                parked |= self._dispatch_one(req, self._active_nodes())
+            m.store.flush()
+        if parked:
+            # a park requeued with a future due time; failure paths
+            # schedule their own follow-up, parks are detected here
+            due = m.store.next_pending_due()
+            if due is not None:
+                self._sched_dispatch(max(due, self.vc.now()))
+
+    def _dispatch_one(self, req, nodes) -> bool:
+        """Dispatch one claimed request; True when the master parked it
+        (nothing schedulable) and a future dispatch wave is needed."""
+        m = self.m
+        now = self.vc.now()
+        plan = None
+        cap = m._sched_sample
+        if m._disagg and (not cap or len(nodes) <= cap):
+            # the disagg planner's census scans the full snapshot per
+            # request; above the sampling cap that scan is exactly the
+            # O(fleet) cost the sampled pick exists to avoid, so
+            # large-fleet sims take the plain path (equivalent to a
+            # mixed fleet, where the planner bails on the empty
+            # strict-prefill pool anyway)
+            plan = m._plan_disagg(req, nodes)
+        if plan is not None:
+            self._dispatch_disagg(req, plan, nodes)
+            return False
+        t0 = _time.perf_counter()
+        node = m._reserve_node_for(req, nodes=nodes)
+        self.pick_times_us.append((_time.perf_counter() - t0) * 1e6)
+        if node is None:
+            return True   # the master parked or terminally failed it
+        self.inv.post_pick(req, node, nodes)
+        sn = self.fleet.by_port[node["port"]]
+        if sn.is_down(now):
+            # the dispatch RPC would fail at connect
+            self._fail_dispatch(req, node, nodes)
+            return False
+        m._note_dispatch(req, node)
+        m._processing[req["id"]] = node
+        end, cost = sn.assign(now, len(req["prompt"] or ""),
+                              req.get("max_new_tokens") or 16)
+        self._push(end, _K_COMPLETE, (req, node, None, cost, now))
+        return False
+
+    def _dispatch_disagg(self, req, plan, nodes):
+        m = self.m
+        now = self.vc.now()
+        pnode, dnode = plan
+        self.inv.post_pick(req, pnode, nodes)
+        self.inv.post_pick(req, dnode, nodes)
+        psn = self.fleet.by_port[pnode["port"]]
+        dsn = self.fleet.by_port[dnode["port"]]
+        if psn.is_down(now) or dsn.is_down(now):
+            # phase-1 failure degrades to plain dispatch in the real
+            # flow; model the cheap equivalent — release both slots and
+            # requeue through the failure tail
+            with m._inflight_lock:
+                for n in (pnode, dnode):
+                    m._inflight[n["id"]] = max(
+                        0, m._inflight.get(n["id"], 1) - 1)
+            self._fail_dispatch(req, pnode if psn.is_down(now) else dnode,
+                                None, release=False)
+            return
+        ptoks = self.fleet.model.tokens(len(req["prompt"] or ""))
+        p_end, _ = psn.assign(now, len(req["prompt"] or ""), 1,
+                              prefill_only=True)
+        self._push(p_end, _K_RELEASE, (pnode, psn))
+        m._note_dispatch(req, dnode)
+        m._processing[req["id"]] = dnode
+        end, cost = dsn.assign(p_end, len(req["prompt"] or ""),
+                               req.get("max_new_tokens") or 16,
+                               cached_tokens=ptoks)
+        cost["queue_ms"] = round(cost["queue_ms"] + (p_end - now) * 1e3, 3)
+        cost["kv_transfer_bytes"] = ptoks * 4096
+        self._push(end, _K_COMPLETE, (req, dnode, None, cost, now))
+
+    def _fail_dispatch(self, req, node, nodes, release=True):
+        m = self.m
+        err = ConnectionError(
+            f"sim: connection to {node.get('name')} refused")
+        m._fail_sub(req, node, err, nodes=nodes)
+        if release:
+            with m._inflight_lock:
+                m._inflight[node["id"]] = max(
+                    0, m._inflight.get(node["id"], 1) - 1)
+        m.store.flush()
+        due = m.store.next_pending_due()
+        if due is not None:
+            self._sched_dispatch(max(due, self.vc.now()))
+
+    def _on_release(self, node_row, sn):
+        sn.release(self.vc.now())
+        with self.m._inflight_lock:
+            self.m._inflight[node_row["id"]] = max(
+                0, self.m._inflight.get(node_row["id"], 1) - 1)
+
+    def _on_complete(self, req, node, _unused, cost, dispatched_at):
+        m = self.m
+        now = self.vc.now()
+        sn = self.fleet.by_port[node["port"]]
+        sn.release(now)
+        with m._inflight_lock:
+            m._inflight[node["id"]] = max(
+                0, m._inflight.get(node["id"], 1) - 1)
+        m._processing.pop(req["id"], None)
+        if sn.went_down_during(dispatched_at, now):
+            # the node died under the generation: the RPC the real
+            # master had in flight dies with the socket
+            m._fail_sub(req, node,
+                        ConnectionError(f"sim: {sn.spec.name} died "
+                                        "mid-generation"))
+            m.store.flush()
+            due = m.store.next_pending_due()
+            if due is not None:
+                self._sched_dispatch(max(due, now))
+            return
+        exec_s = (cost["prefill_ms"] + cost["decode_ms"]) / 1e3
+        tokens = cost.get("decode_tokens") or 1
+        ttft_ms = cost["queue_ms"] + cost["prefill_ms"]
+        data = {
+            "result": f"sim:{tokens}tok",
+            "execution_time": round(exec_s, 6),
+            "tokens_per_s": round(tokens / exec_s, 3) if exec_s else 0.0,
+            "ttft_ms": round(ttft_ms, 3),
+            "cost": cost,
+        }
+        m._complete_request(req, node, data)
+        self.ttfts_ms.append(ttft_ms)
+        if tsdb_mod.cost_within_slo(cost, self._slo_targets):
+            self.within_slo += 1
+
+    def _on_health(self):
+        m = self.m
+        m._health_sweep()
+        # the health loop's queue-depth gauge rides the same cadence;
+        # its samples double as the report's queue-depth series (the
+        # calibration gate compares it against the real master's)
+        m.store.flush()
+        pending = m.store.counts().get("pending", 0)
+        m.metrics.gauge("queue_pending", pending)
+        self.queue_samples.append(pending)
