@@ -2307,6 +2307,436 @@ def _sim_calibrate_scenario(argv, opt, smoke):
     return 0
 
 
+def _overload_leg(workers, master_kw, capacity, duration, max_arrivals,
+                  drain_timeout, max_new=48):
+    """One open-loop overload storm against a fresh master over an
+    already-warm worker set (caller owns worker shutdown). OPEN-loop on
+    purpose: a closed loop self-throttles to whatever the cluster
+    serves and can never push it past capacity, so the front door would
+    have nothing to refuse. ``max_new=48`` (vs the control_plane
+    scenario's 1) keeps the DATA plane the bottleneck: short
+    generations drain as fast as HTTP submits arrive through the same
+    master process, and a generator that shares the server's ceiling
+    cannot outrun it — the workers must be warmed with the SAME token
+    count or the first storm wave measures an XLA compile stall. Arrival times follow a diurnal ramp —
+    ``rate(t) = capacity * (0.5 + 3.5 sin^2(pi t/D))`` — starting under
+    capacity and peaking at 4x mid-window; submits round-robin the
+    three SLO classes and four tenants (``X-DLI-Tenant`` header, the
+    way a real client declares itself).
+
+    The latency-tier SLO rollup folds the MASTER-side pending wait
+    (``started_at - created_at``) into the cost record's ``queue_ms``
+    before evaluating: the worker's ledger starts at its own submit, so
+    under a master-side backlog — the exact thing this scenario
+    manufactures — the raw record would score a request that sat 60s in
+    the master queue as within-SLO."""
+    import math
+    import threading as _th
+    import requests as _rq
+    from distributed_llm_inferencing_tpu.runtime.master import Master
+
+    times = []
+    t = 0.0
+    while t < duration and len(times) < max_arrivals:
+        rate = capacity * (0.5 + 3.5 * math.sin(
+            math.pi * t / duration) ** 2)
+        times.append(t)
+        t += 1.0 / max(rate, 1e-6)
+
+    classes = ("latency", "throughput", "batch")
+    stats = {"submitted": 0, "accepted": 0, "rejected_429": 0,
+             "rejected_no_retry_after": 0, "rejected_by_reason": {},
+             "unexpected_status": 0, "transport_errors": 0,
+             "accepted_by_class": {c: 0 for c in classes}}
+    m = Master(":memory:", **master_kw)
+    msrv = m.service.serve("127.0.0.1", 0, background=True)
+    base = f"http://127.0.0.1:{msrv.server_address[1]}"
+    lock = _th.Lock()
+    next_i = [0]
+    try:
+        for i, (_, wport) in enumerate(workers):
+            r = _rq.post(f"{base}/api/nodes/add", json={
+                "name": f"w{i}", "host": "127.0.0.1",
+                "port": wport}).json()
+            assert r["status"] == "success", r
+        m.start_background()
+        t0 = time.time()
+
+        def submitter():
+            sess = _rq.Session()
+            while True:
+                with lock:
+                    if next_i[0] >= len(times):
+                        return
+                    i = next_i[0]
+                    next_i[0] += 1
+                delay = t0 + times[i] - time.time()
+                if delay > 0:
+                    time.sleep(delay)
+                try:
+                    r = sess.post(f"{base}/api/inference/submit", json={
+                        "model_name": "tiny-llama", "prompt": "hi",
+                        "max_new_tokens": max_new,
+                        "slo_class": classes[i % 3],
+                        "sampling": {"do_sample": False,
+                                     "allow_random_init": True}},
+                        headers={"X-DLI-Tenant": f"t{i % 4}"},
+                        timeout=30)
+                except Exception:
+                    with lock:
+                        stats["transport_errors"] += 1
+                    continue
+                try:
+                    body = r.json()
+                except ValueError:
+                    body = {}
+                with lock:
+                    stats["submitted"] += 1
+                    if r.status_code == 429:
+                        stats["rejected_429"] += 1
+                        if not r.headers.get("Retry-After"):
+                            stats["rejected_no_retry_after"] += 1
+                        reason = body.get("reason", "?")
+                        stats["rejected_by_reason"][reason] = \
+                            stats["rejected_by_reason"].get(reason, 0) + 1
+                    elif r.status_code == 200 and \
+                            body.get("status") == "success":
+                        stats["accepted"] += 1
+                        stats["accepted_by_class"][classes[i % 3]] += 1
+                    else:
+                        stats["unexpected_status"] += 1
+
+        threads = [_th.Thread(target=submitter) for _ in range(8)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=600)
+        submit_wall = time.time() - t0
+
+        # drain: every ADMITTED request must reach a terminal state
+        # before the rows are scored (bounded — the control-off leg
+        # owes ~4x capacity and may time out; recorded, gated only on
+        # the control leg)
+        deadline = time.time() + drain_timeout
+        while time.time() < deadline:
+            c = m.store.counts()
+            if not (c.get("pending", 0) or c.get("processing", 0)):
+                break
+            time.sleep(0.2)
+        wall = time.time() - t0
+
+        # the ladder must also walk back DOWN once the storm passes
+        # (one rung per hold window) before the event trail is read
+        if master_kw.get("overload"):
+            deadline = time.time() + 30.0
+            while time.time() < deadline and m._overload_level:
+                time.sleep(0.25)
+
+        rows = [dict(r) for r in m.store._all("SELECT * FROM requests")]
+        done, failed = [], []
+        for r in rows:
+            cost = r.get("cost")
+            if isinstance(cost, str):
+                try:
+                    cost = json.loads(cost)
+                except ValueError:
+                    cost = None
+            if isinstance(cost, dict) and r.get("started_at"):
+                wait_ms = max(0.0, (float(r["started_at"])
+                                    - float(r["created_at"]))) * 1e3
+                cost = dict(cost,
+                            queue_ms=float(cost.get("queue_ms") or 0.0)
+                            + wait_ms)
+                r = dict(r, cost=cost)
+            (done if r["status"] == "completed"
+             else failed if r["status"] == "failed"
+             else []).append(r)
+        done_latency = [r for r in done if r["slo_class"] == "latency"]
+
+        ev = _rq.get(f"{base}/api/events",
+                     params={"type": "overload-level",
+                             "limit": 1000}).json()
+        ladder = [{"level": e["data"].get("level"),
+                   "prev_level": e["data"].get("prev_level"),
+                   "direction": e["data"].get("direction"),
+                   "queue_depth": e["data"].get("queue_depth"),
+                   "burn_rate": e["data"].get("burn_rate")}
+                  for e in ev.get("events", [])]
+        counters = m.metrics.snapshot()["counters"]
+        return {
+            "arrivals": len(times),
+            "duration_s": round(duration, 1),
+            "submit_wall_s": round(submit_wall, 2),
+            "wall_s": round(wall, 2),
+            **stats,
+            "completed": len(done),
+            "admitted_failed": len(failed),
+            "admitted_unfinished": len(rows) - len(done) - len(failed),
+            "admit_rejected_total": int(
+                counters.get("admit_rejected", 0)),
+            "shed": {k[len("shed_"):]: int(v)
+                     for k, v in counters.items()
+                     if k.startswith("shed_")},
+            "overload_level_max": max(
+                [0] + [e["level"] for e in ladder
+                       if e["level"] is not None]),
+            "ladder_up": sum(1 for e in ladder
+                             if e["direction"] == "up"),
+            "ladder_down": sum(1 for e in ladder
+                               if e["direction"] == "down"),
+            "ladder": ladder[:60],
+            "slo_latency": _goodput(done_latency, wall),
+            "slo_all": _goodput(done, wall),
+        }
+    finally:
+        m.stop()
+
+
+def _overload_capacity_probe(workers, n=150, max_new=48):
+    """SATURATED serving capacity: blast ``n`` open-loop submits at a
+    plain master and measure the steady-state completion slope off the
+    store — from the 25%-drained mark to fully drained, so neither the
+    submit burst nor the batch ramp-up dilutes the estimate. Both
+    matter: the closed-loop control_plane harness throttles on its own
+    status polls, and a lightly-loaded drain measures partial batch
+    occupancy — the worker's throughput RISES with queue depth, so
+    either low-ball makes the storm scale itself to a rate the cluster
+    absorbs without ever overloading."""
+    import threading as _th
+    import requests as _rq
+    from distributed_llm_inferencing_tpu.runtime.master import Master
+
+    m = Master(":memory:", health_interval=2.0)
+    msrv = m.service.serve("127.0.0.1", 0, background=True)
+    base = f"http://127.0.0.1:{msrv.server_address[1]}"
+    try:
+        for i, (_, wport) in enumerate(workers):
+            r = _rq.post(f"{base}/api/nodes/add", json={
+                "name": f"w{i}", "host": "127.0.0.1",
+                "port": wport}).json()
+            assert r["status"] == "success", r
+        m.start_background()
+        lock = _th.Lock()
+        left = [n]
+
+        def blast():
+            sess = _rq.Session()
+            while True:
+                with lock:
+                    if left[0] <= 0:
+                        return
+                    left[0] -= 1
+                sess.post(f"{base}/api/inference/submit", json={
+                    "model_name": "tiny-llama", "prompt": "hi",
+                    "max_new_tokens": max_new,
+                    "sampling": {"do_sample": False,
+                                 "allow_random_init": True}},
+                    timeout=30)
+
+        t0 = time.time()
+        threads = [_th.Thread(target=blast) for _ in range(8)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=120)
+        mark = None              # (time, completed) at the 25% mark
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            c = m.store.counts()
+            done = c.get("completed", 0) + c.get("failed", 0)
+            if mark is None and done >= n // 4:
+                mark = (time.time(), done)
+            if done >= n:
+                break
+            time.sleep(0.05)
+        if mark and done > mark[1] and time.time() > mark[0]:
+            return (done - mark[1]) / (time.time() - mark[0])
+        return n / max(time.time() - t0, 1e-6)
+    finally:
+        m.stop()
+
+
+def _overload_scenario(argv, opt, smoke):
+    """--scenario overload [--smoke] [--ab]: the overload front door's
+    proof gate (docs/robustness.md "Overload control"). Two halves:
+
+    - **real cluster** — a short closed-loop probe measures serving
+      capacity, then an open-loop diurnal generator (_overload_leg)
+      ramps submits to ~4x that capacity with mixed SLO classes and
+      tenants. Gates: every refusal was an honest 429 carrying
+      Retry-After; zero ADMITTED requests failed or stranded; the
+      degradation ladder walked up AND back down, and the whole walk
+      chains consistently from ``/api/events?type=overload-level``
+      alone (each transition's prev_level = the previous transition's
+      level, starting at 0 and ending at 0). ``--ab`` repeats the
+      identical storm with the front door OFF (unbounded queue, no
+      ladder) and gates latency-tier goodput(on) >= 1.5x goodput(off).
+    - **simulated fleet** — the same admission/ladder/claim code at
+      1000 nodes on the virtual clock (tools/dlisim --overload), run
+      twice: byte-identical journal hashes, refusals present, ladder
+      engaged, zero starved/violations, and the claim-wave
+      anti-starvation bound holds (docs/simulator.md).
+
+    Writes /tmp/dli_bench_overload.json for the CI artifact."""
+    import math
+    from distributed_llm_inferencing_tpu.runtime.state import (
+        CLAIM_AGING_S)
+    from tools.dlisim import SimConfig, run_sim
+
+    ab = "--ab" in argv
+    seed = opt("--seed", 42)
+    nw = opt("--workers", 1 if smoke else 2)
+    duration = opt("--duration", 8.0 if smoke else 20.0, float)
+    max_arrivals = opt("--max-arrivals", 2400 if smoke else 8000)
+    result = {"scenario": "overload", "smoke": smoke, "ab": ab,
+              "seed": seed}
+    failures = []
+
+    workers = _control_plane_workers(nw, max_new=48)
+    try:
+        capacity = max(2.0, _overload_capacity_probe(
+            workers, n=100 if smoke else 200))
+        result["capacity_req_per_s"] = round(capacity, 2)
+
+        # queue threshold ~1s of backlog at capacity: the ladder
+        # engages while a latency request behind the queue can still
+        # make its TTFT target; the hard cap is 4 rungs deeper
+        qthr = max(8.0, capacity)
+        on_kw = dict(health_interval=0.5,
+                     admit_max_pending=int(4 * qthr),
+                     overload=True, overload_burn=0.0,
+                     overload_queue=qthr, overload_hold_s=1.0,
+                     overload_interval_s=0.25)
+        on = _overload_leg(workers, on_kw, capacity, duration,
+                           max_arrivals, drain_timeout=60.0)
+        result["control_on"] = on
+
+        # honesty: every refusal an explicit 429 + Retry-After, and no
+        # submit ever failed any other way
+        if on["rejected_no_retry_after"]:
+            failures.append(f"control_on: {on['rejected_no_retry_after']}"
+                            " 429(s) without Retry-After")
+        if on["transport_errors"] or on["unexpected_status"]:
+            failures.append(
+                f"control_on: {on['transport_errors']} transport "
+                f"error(s) + {on['unexpected_status']} non-200/429 "
+                "response(s) — refusals must be honest 429s")
+        if on["rejected_429"] == 0:
+            failures.append("control_on: a 4x-capacity storm produced "
+                            "zero refusals (front door never engaged)")
+        # admitted work is owed: none may fail or strand
+        if on["admitted_failed"] or on["admitted_unfinished"]:
+            failures.append(
+                f"control_on: {on['admitted_failed']} admitted "
+                f"request(s) failed, {on['admitted_unfinished']} never "
+                "reached a terminal state")
+        # the full ladder walk, from the journal alone
+        if on["ladder_up"] == 0 or on["ladder_down"] == 0:
+            failures.append(
+                f"control_on: ladder walked up {on['ladder_up']}x / "
+                f"down {on['ladder_down']}x (need both)")
+        lvl = 0
+        for e in on["ladder"]:
+            if e["prev_level"] != lvl or e["queue_depth"] is None:
+                failures.append(
+                    "control_on: overload-level event trail does not "
+                    f"chain (prev_level {e['prev_level']} at walked "
+                    f"level {lvl}, queue_depth {e['queue_depth']}) — "
+                    "the walk must reconstruct from /api/events alone")
+                break
+            lvl = e["level"]
+        if lvl != 0 and not any(f.startswith("control_on: overload")
+                                for f in failures):
+            failures.append(f"control_on: ladder ended at rung {lvl}, "
+                            "never walked back to 0")
+
+        if ab:
+            off_kw = dict(health_interval=0.5, admit_rate=0.0,
+                          admit_max_pending=0, overload=False)
+            off = _overload_leg(workers, off_kw, capacity, duration,
+                                max_arrivals,
+                                drain_timeout=60.0 if smoke else 120.0)
+            result["control_off"] = off
+            g_on = on["slo_latency"]["goodput_req_per_s"]
+            g_off = off["slo_latency"]["goodput_req_per_s"]
+            result["latency_goodput_ratio"] = (
+                round(g_on / g_off, 2) if g_off else None)
+            if g_off and g_on / g_off < 1.5:
+                failures.append(
+                    f"ab: latency-tier goodput {g_on} req/s with the "
+                    f"front door vs {g_off} without — ratio "
+                    f"{g_on / g_off:.2f} < 1.5")
+    finally:
+        for agent, _ in workers:
+            agent.service.shutdown()
+
+    # -- simulated fleet: the same front door at 1000 nodes, twice ----
+    sim_nodes = 200 if smoke else 1000
+    sim_reqs = 4000 if smoke else 20_000
+    sim_cfg = dict(nodes=sim_nodes, requests=sim_reqs, duration_s=120.0,
+                   arrival="diurnal", seed=seed, slo_mix=True,
+                   overload=True, admit_max_pending=100,
+                   overload_queue=30.0, overload_hold_s=10.0,
+                   claim_interval_s=1.0, dispatch_batch=64)
+    s1 = run_sim(SimConfig(**sim_cfg))
+    s2 = run_sim(SimConfig(**sim_cfg))
+    bound = (math.ceil(2 * CLAIM_AGING_S / sim_cfg["claim_interval_s"])
+             + math.ceil(sim_cfg["admit_max_pending"]
+                         / sim_cfg["dispatch_batch"])
+             + s1.waves_frozen + 2)
+    result["sim"] = {
+        "nodes": sim_nodes, "requests": sim_reqs,
+        "completed": s1.completed, "rejected": s1.rejected,
+        "rejected_by_reason": s1.rejected_by_reason, "shed": s1.shed,
+        "overload_level_max": s1.overload_level_max,
+        "claim_waves": s1.claim_waves,
+        "waves_frozen": s1.waves_frozen,
+        "starvation_max_waves": s1.starvation_max_waves,
+        "starvation_bound": bound, "starved": s1.starved,
+        "violations": s1.violations[:20], "wall_s": s1.wall_s,
+        "hash_a": s1.journal_hash, "hash_b": s2.journal_hash,
+    }
+    if s1.journal_hash != s2.journal_hash:
+        failures.append("sim: identically-seeded overload runs diverged "
+                        f"({s1.journal_hash[:12]} != "
+                        f"{s2.journal_hash[:12]})")
+    if s1.violations or s1.starved:
+        failures.append(f"sim: {len(s1.violations)} invariant "
+                        f"violation(s), {s1.starved} starved")
+    if not s1.rejected or not s1.overload_level_max:
+        failures.append(f"sim: {s1.rejected} refusals at ladder max "
+                        f"{s1.overload_level_max} — the overload sweep "
+                        "never engaged the front door")
+    if s1.starvation_max_waves > bound:
+        failures.append(
+            f"sim: an admitted request sat {s1.starvation_max_waves} "
+            f"claim waves > anti-starvation bound {bound} "
+            "(aging + bounded queue must cap the wait)")
+
+    result["failures"] = failures
+    print(json.dumps(result))
+    try:
+        with open("/tmp/dli_bench_overload.json", "w") as f:
+            json.dump(result, f, indent=1)
+    except OSError:
+        pass
+    if failures:
+        print("overload gate FAILED: " + "; ".join(failures),
+              file=sys.stderr)
+        return 1
+    on = result["control_on"]
+    print(f"overload ok: {on['rejected_429']}/{on['submitted']} honest "
+          f"429s at 4x capacity, ladder to rung "
+          f"{on['overload_level_max']} and back, latency goodput "
+          f"{on['slo_latency']['goodput_req_per_s']} req/s"
+          + (f" ({result['latency_goodput_ratio']}x control-off)"
+             if ab else "")
+          + f"; sim {sim_nodes} nodes: {s1.rejected} refusals, "
+          f"starvation {s1.starvation_max_waves} <= {bound} waves, "
+          f"twin hash {s1.journal_hash[:12]}", file=sys.stderr)
+    return 0
+
+
 def _scenario_main(argv):
     """`bench.py --scenario {control_plane|prefix_cache|decode_speed|disagg}
     [--smoke|--ab] [--requests N] [--concurrency C] [--workers W]` —
@@ -2363,6 +2793,15 @@ def _scenario_main(argv):
         except Exception:
             pass
         return _ha_scenario(argv, opt, "--smoke" in argv)
+    if name == "overload":
+        # real-cluster half spins warm workers: warm compiles
+        try:
+            from distributed_llm_inferencing_tpu.utils.platform import (
+                enable_compilation_cache)
+            enable_compilation_cache()
+        except Exception:
+            pass
+        return _overload_scenario(argv, opt, "--smoke" in argv)
     if name == "sim_scale":
         # pure virtual-clock simulation: no workers, no JAX, no
         # compilation cache to warm

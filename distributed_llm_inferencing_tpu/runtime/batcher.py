@@ -129,6 +129,13 @@ class BatchRequest:
     kv_export: bool = False
     _peer_fetch_done: bool = False
     _kv_transfer_bytes: int = 0
+    # Per-request decode-chunk ceiling (master brownout rung 3 sends
+    # body["decode_chunk_cap"] on latency-class dispatches — see
+    # runtime/master.py _infer_body and docs/robustness.md "Overload
+    # control"). 0 = uncapped. While a capped request is active it
+    # clamps the WHOLE wave's chunk choice in _step_inner: shorter
+    # slices reach scheduling boundaries sooner, which is the point.
+    chunk_cap: int = 0
     # Live in-flight migration (docs/robustness.md "Live migration"):
     # _migrate_requested asks the scheduler to snapshot+evict this
     # request at the next chunk boundary (migrate_out blocks on done);
@@ -498,7 +505,8 @@ class ContinuousBatcher:
                       kv_export: bool = False,
                       kv_transfer_bytes: int = 0,
                       resume: Optional[dict] = None,
-                      trace_ctx=None) -> BatchRequest:
+                      trace_ctx=None,
+                      chunk_cap: Optional[int] = None) -> BatchRequest:
         """Validate and build one BatchRequest WITHOUT enqueueing it —
         submit()/submit_many() construct first so a bad spec can never
         leave siblings half-enqueued."""
@@ -521,6 +529,7 @@ class ContinuousBatcher:
                                                               dict)
                                       else None),
                            kv_export=bool(kv_export),
+                           chunk_cap=max(0, int(chunk_cap or 0)),
                            # explicit ctx for callers submitting from a
                            # helper thread (SSE streams), ambient otherwise
                            trace_ctx=trace_ctx or trace.current())
@@ -567,11 +576,12 @@ class ContinuousBatcher:
                kv_export: bool = False,
                kv_transfer_bytes: int = 0,
                resume: Optional[dict] = None,
-               trace_ctx=None) -> BatchRequest:
+               trace_ctx=None,
+               chunk_cap: Optional[int] = None) -> BatchRequest:
         req = self._make_request(prompt, max_new_tokens, sampling,
                                  eos_token_id, stream_cb, seed,
                                  kv_source, kv_export, kv_transfer_bytes,
-                                 resume, trace_ctx)
+                                 resume, trace_ctx, chunk_cap=chunk_cap)
         with self._lock:
             self.queue.append(req)
             depth = len(self.queue)
@@ -2053,12 +2063,23 @@ class ContinuousBatcher:
             # round trip); otherwise the largest chunk some slot can fill
             max_rem = max(self.active[i].max_new_tokens
                           - len(self.active[i].tokens) for i in active)
-            up = min((c for c in self.decode_chunks if c >= max_rem),
+            chunks = self.decode_chunks
+            # per-request brownout cap (req.chunk_cap, from the master's
+            # rung-3 decode_chunk_cap dispatch field): the tightest cap
+            # among active riders clamps the wave — the filtered set is
+            # a subset of decode_chunks (or its warmed min fallback), so
+            # no unwarmed program shape is ever requested
+            caps = [self.active[i].chunk_cap for i in active
+                    if self.active[i].chunk_cap > 0]
+            if caps:
+                chunks = tuple(c for c in chunks if c <= min(caps)) \
+                    or (min(chunks),)
+            up = min((c for c in chunks if c >= max_rem),
                      default=None)
             if up is not None and up - max_rem <= self.CHUNK_OVERSHOOT_MAX:
                 k = up
             else:
-                k = next(c for c in self.decode_chunks if c <= max_rem)
+                k = next(c for c in chunks if c <= max_rem)
 
             # growth blocks for every position this chunk can write
             for slot in range(self.slots):

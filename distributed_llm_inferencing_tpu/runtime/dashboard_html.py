@@ -227,6 +227,11 @@ const TS_METRICS = [
   ['worker_role', 'role (0 mixed / 1 prefill / 2 decode)'],
   ['breaker_state', 'breaker (0 closed / 1 half-open / 2 open)'],
   ['slo_attainment', 'SLO attainment (master)'],
+  ['queue_pending', 'pending queue depth (master)'],
+  ['overload_level', 'overload ladder rung (master)'],
+  ['admit_rejected', 'admission refusals/s (429 rate, master)'],
+  ['shed_batch', 'shed batch/s (rate, master)'],
+  ['shed_throughput', 'shed throughput/s (rate, master)'],
 ];
 const TS_COLORS = ['#4da3ff','#3fb76f','#e0a33c','#e0565b','#b07cf0',
                    '#52c7d8','#8a939e'];

@@ -105,7 +105,24 @@ EVENT_TYPES = (
         "replayable arrival trace: tools/dlisim reconstructs a real "
         "run's workload from exactly these rows (a debug bundle is "
         "sim-replayable because collect_debug_bundle.sh exports them).",
-        ("model", "prompt_chars", "max_new_tokens", "max_length")),
+        ("model", "prompt_chars", "max_new_tokens", "max_length",
+         "slo_class", "tenant")),
+    EventType(
+        "admission-rejected", "warning",
+        "The overload front door refused a submit — degradation-ladder "
+        "class shed, pending-queue cap, or the tenant's token bucket — "
+        "with an honest 429 + Retry-After. One event per refusal: a "
+        "shed is never a silent drop (docs/robustness.md \"Overload "
+        "control\").",
+        ("tenant", "slo_class", "reason", "retry_after_s", "level")),
+    EventType(
+        "overload-level", "warning",
+        "The overload ladder moved one rung (up under pressure, down "
+        "on recovery), with the gauge values that justified the "
+        "transition — the postmortem reconstructs the whole brownout "
+        "walk from these rows alone.",
+        ("level", "prev_level", "direction", "burn_rate",
+         "queue_depth")),
     EventType(
         "request-park", "warning",
         "No schedulable node for a claimed request: parked behind a "

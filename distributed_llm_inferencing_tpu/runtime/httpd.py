@@ -51,11 +51,20 @@ class JsonHTTPService:
     handled separately).
     """
 
-    def __init__(self, name: str, auth_key: Optional[str] = None):
+    def __init__(self, name: str, auth_key: Optional[str] = None,
+                 max_inflight: Optional[int] = None):
         self.name = name
         self.auth_key = auth_key
         self.routes: List[Route] = []
         self._server: Optional[ThreadingHTTPServer] = None
+        # bounded in-flight request cap (0 = uncapped): thread-per-
+        # request ingress answers 503 + Retry-After once this many
+        # requests are mid-dispatch, so a connection flood hits a wall
+        # BEFORE it can exhaust memory — admission control proper
+        # (master api_submit) only runs after a handler thread exists
+        self.max_inflight = (int(os.environ.get(
+            "DLI_HTTPD_MAX_INFLIGHT", 0)) if max_inflight is None
+            else int(max_inflight))
         # Fault-injection harness (utils/faults.py): armed from DLI_FAULTS
         # at construction or at runtime via the admin endpoints below.
         # Pays one lock acquire per request when nothing is armed. The
@@ -131,6 +140,24 @@ class JsonHTTPService:
                 return hdr == f"Bearer {service.auth_key}"
 
             def _dispatch(self, method: str):
+                # bounded in-flight cap (DLI_HTTPD_MAX_INFLIGHT): the
+                # saturation answer is an honest 503 + Retry-After sent
+                # from the cheapest possible path — no span, no route
+                # scan — so a flood is refused at near-zero cost
+                if not self.server.try_begin_request():
+                    self._drain_body()
+                    return self._send_json(
+                        503, {"status": "error",
+                              "message": "server saturated "
+                                         f"({service.max_inflight} "
+                                         "requests in flight)"},
+                        {"Retry-After": "1"})
+                try:
+                    self._dispatch_capped(method)
+                finally:
+                    self.server.end_request()
+
+            def _dispatch_capped(self, method: str):
                 # Server span for the whole request: adopts the caller's
                 # trace context from X-DLI-Trace-Id/X-DLI-Parent-Span (or
                 # roots a fresh trace), and stays current while the
@@ -298,7 +325,8 @@ class JsonHTTPService:
 
     def serve(self, host: str, port: int, background: bool = False
               ) -> ThreadingHTTPServer:
-        self._server = _TrackingHTTPServer((host, port), self.make_handler())
+        self._server = _TrackingHTTPServer((host, port), self.make_handler(),
+                                           max_inflight=self.max_inflight)
         self._server.daemon_threads = True
         if background:
             t = threading.Thread(target=self._server.serve_forever, daemon=True)
@@ -330,12 +358,35 @@ class JsonHTTPService:
 class _TrackingHTTPServer(ThreadingHTTPServer):
     """ThreadingHTTPServer that remembers live client sockets so
     shutdown can hard-close persistent (keep-alive) connections, not
-    just the listener."""
+    just the listener — and counts in-flight request dispatches so the
+    handler can refuse work past ``max_inflight`` (503 + Retry-After)
+    instead of letting thread-per-request ingress grow without bound."""
 
-    def __init__(self, *a, **kw):
+    def __init__(self, *a, max_inflight: int = 0, **kw):
         self._client_socks: set = set()
         self._client_socks_lock = locks.lock("httpd.client_socks")
+        self._max_inflight = int(max_inflight)
+        self._inflight_reqs = 0
+        self._inflight_lock = locks.lock("httpd.inflight")
         super().__init__(*a, **kw)
+
+    def try_begin_request(self) -> bool:
+        """Reserve one in-flight dispatch slot; False when saturated
+        (cap 0 = uncapped). The handler MUST pair a successful reserve
+        with end_request()."""
+        if self._max_inflight <= 0:
+            return True
+        with self._inflight_lock:
+            if self._inflight_reqs >= self._max_inflight:
+                return False
+            self._inflight_reqs += 1
+            return True
+
+    def end_request(self):
+        if self._max_inflight <= 0:
+            return
+        with self._inflight_lock:
+            self._inflight_reqs -= 1
 
     def get_request(self):
         sock, addr = super().get_request()

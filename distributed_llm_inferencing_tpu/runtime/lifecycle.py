@@ -67,7 +67,9 @@ TRANSITIONS = (
     Transition(
         "claim", ("pending",), "processing", "claim_next_pending_many",
         "locked-select", "sync-txn", False,
-        "oldest due rows only (next_attempt_at<=now); one locked "
+        "due rows only (next_attempt_at<=now), SLO-class priority "
+        "order with deadline-style aging (state.CLAIM_AGING_S) so "
+        "batch cannot starve; one locked "
         "SELECT + executemany flip keeps claims disjoint across "
         "dispatchers; claims replicate to HA standbys, so a lease "
         "takeover's recovery sees exactly the dead leader's in-flight "

@@ -24,8 +24,9 @@ import json
 import math
 import os
 import random
+import re
 import threading
-from typing import Dict, Optional, Set
+from typing import Dict, List, Optional, Set, Tuple
 
 import requests as http
 
@@ -35,7 +36,8 @@ from distributed_llm_inferencing_tpu.runtime import replication
 from distributed_llm_inferencing_tpu.runtime import tsdb as tsdb_mod
 from distributed_llm_inferencing_tpu.runtime.kvtier import (
     estimate_cached_tokens)
-from distributed_llm_inferencing_tpu.runtime.state import Store
+from distributed_llm_inferencing_tpu.runtime.state import (
+    SLO_CLASSES, Store)
 from distributed_llm_inferencing_tpu.utils import clock, faults, locks, trace
 from distributed_llm_inferencing_tpu.utils.logging import setup_logging
 from distributed_llm_inferencing_tpu.utils.metrics import (
@@ -154,6 +156,34 @@ TSDB_SNAPSHOT_S = float(os.environ.get("DLI_TSDB_SNAPSHOT_S", 30.0))
 # (1.0 = consuming exactly the error budget); crossing back below emits
 # the all-clear twin.
 SLO_BURN_ALERT = 1.0
+# Overload-hardened front door (ROADMAP item 3, docs/robustness.md
+# "Overload control"). Admission: per-tenant token bucket at api_submit
+# (X-DLI-Tenant header names the bucket) plus a bounded total pending
+# queue; a rejected submit is an honest 429 + Retry-After, journaled,
+# never a silent drop. RATE 0 disables the bucket (the default keeps
+# every pre-overload test and bench admission-transparent); BURST 0
+# means max(1, rate); MAX_PENDING 0 leaves the queue unbounded.
+ADMIT_RATE = float(os.environ.get("DLI_ADMIT_RATE", 0.0))
+ADMIT_BURST = float(os.environ.get("DLI_ADMIT_BURST", 0.0))
+ADMIT_MAX_PENDING = int(os.environ.get("DLI_ADMIT_MAX_PENDING", 0))
+# Shedding & brownout: a leader-gated _overload_loop watches the PR 6
+# fast-window burn-rate gauge and the TSDB master queue-depth series
+# and walks the degradation ladder one rung per sweep (1 shed batch →
+# 2 shed throughput too → 3 cap latency-tier decode chunks → 4 claim
+# only latency). Escalation needs burn >= BURN (<=0 ignores burn and
+# makes the ladder queue-only) AND sustained queue >= QUEUE;
+# de-escalation needs both back under half their thresholds, and every
+# transition must dwell HOLD_S first (hysteresis — one noisy scrape can
+# never flap a rung). DLI_OVERLOAD=0 kills the loop.
+OVERLOAD = os.environ.get("DLI_OVERLOAD", "1") not in ("0", "false")
+OVERLOAD_INTERVAL_S = float(os.environ.get("DLI_OVERLOAD_INTERVAL_S", 2.0))
+OVERLOAD_BURN = float(os.environ.get("DLI_OVERLOAD_BURN", 1.0))
+OVERLOAD_QUEUE = float(os.environ.get("DLI_OVERLOAD_QUEUE", 64.0))
+OVERLOAD_HOLD_S = float(os.environ.get("DLI_OVERLOAD_HOLD_S", 10.0))
+OVERLOAD_CHUNK_CAP = int(os.environ.get("DLI_OVERLOAD_CHUNK_CAP", 8))
+# tenant names must be shell/url/filename-safe: they land in journal
+# rows, metric labels and postmortem greps verbatim
+_TENANT_RE = re.compile(r"^[A-Za-z0-9._-]{1,64}$")
 # crude chars-per-token estimate for sizing a prompt the master never
 # tokenizes (same spirit as the prefix-digest byte-fraction estimates)
 _DISAGG_CHARS_PER_TOKEN = 4
@@ -230,6 +260,15 @@ class Master:
                  rebalance_interval_s: Optional[float] = None,
                  rebalance_sustain_s: Optional[float] = None,
                  rebalance_ratio: Optional[float] = None,
+                 admit_rate: Optional[float] = None,
+                 admit_burst: Optional[float] = None,
+                 admit_max_pending: Optional[int] = None,
+                 overload: Optional[bool] = None,
+                 overload_interval_s: Optional[float] = None,
+                 overload_burn: Optional[float] = None,
+                 overload_queue: Optional[float] = None,
+                 overload_hold_s: Optional[float] = None,
+                 overload_chunk_cap: Optional[int] = None,
                  tsdb_step_s: Optional[float] = None,
                  tsdb_window_s: Optional[float] = None,
                  tsdb_snapshot_s: Optional[float] = None,
@@ -306,6 +345,39 @@ class Master:
                                  else float(rebalance_ratio))
         self._last_flip: Dict[int, float] = {}
         self._migrated_reqs: Set[int] = set()
+        # overload-control knobs (instance-level so the overload bench
+        # can A/B admission+shedding on/off against one process) + the
+        # admission plane's state: per-tenant token buckets, the
+        # current ladder rung, its last-transition stamp, and the
+        # drain-rate estimate the queue-full Retry-After is computed
+        # from (refreshed each overload sweep off the completed-counter
+        # delta)
+        self._admit_rate = (ADMIT_RATE if admit_rate is None
+                            else float(admit_rate))
+        self._admit_burst = (ADMIT_BURST if admit_burst is None
+                             else float(admit_burst))
+        self._admit_max_pending = (ADMIT_MAX_PENDING
+                                   if admit_max_pending is None
+                                   else int(admit_max_pending))
+        self._overload = OVERLOAD if overload is None else bool(overload)
+        self._overload_interval = (OVERLOAD_INTERVAL_S
+                                   if overload_interval_s is None
+                                   else float(overload_interval_s))
+        self._overload_burn = (OVERLOAD_BURN if overload_burn is None
+                               else float(overload_burn))
+        self._overload_queue = (OVERLOAD_QUEUE if overload_queue is None
+                                else float(overload_queue))
+        self._overload_hold = (OVERLOAD_HOLD_S if overload_hold_s is None
+                               else float(overload_hold_s))
+        self._overload_chunk_cap = (OVERLOAD_CHUNK_CAP
+                                    if overload_chunk_cap is None
+                                    else int(overload_chunk_cap))
+        self._admit_buckets: Dict[str, Tuple[float, float]] = {}
+        self._admit_lock = locks.lock("master.admit")
+        self._overload_level = 0
+        self._overload_last = 0.0
+        self._drain_rate = 0.0
+        self._drain_prev: Optional[Tuple[float, float]] = None
         # flip-back bookkeeping: disagg plans skipped for want of a
         # prefill pool since the last sweep (the demand signal that
         # re-creates one after the rebalancer emptied it)
@@ -427,10 +499,25 @@ class Master:
                      "ha_takeovers",
                      "ha_lease_lost",
                      "requests_fenced",
-                     "requests_submit_deduped"):
+                     "requests_submit_deduped",
+                     # overload-control plane (docs/robustness.md
+                     # "Overload control"): admission 429s and the
+                     # ladder's per-class sheds — pre-registered so the
+                     # dashboard sparklines and the overload bench see
+                     # them exist before the first rejection ever fires
+                     "admit_rejected",
+                     "shed_batch",
+                     "shed_throughput",
+                     "shed_latency"):
             self.metrics.inc(name, 0)
         # ops the peers have not acked yet (0 = fully replicated)
         self.metrics.gauge("repl_lag_ops", 0.0)
+        # current degradation-ladder rung (0 = normal service)
+        self.metrics.gauge("overload_level", 0.0)
+        # pending-queue depth: the ladder's queue signal and the
+        # dashboard sparkline next to it — must exist before the
+        # telemetry loop's first refresh
+        self.metrics.gauge("queue_pending", 0.0)
         # same rule for the SLO gauges the dashboard charts: they must
         # exist in the exposition from the first scrape (the telemetry
         # loop still withholds them from the TSDB until the fast window
@@ -930,10 +1017,21 @@ class Master:
 
     # ---- inference API -----------------------------------------------
 
-    def api_submit(self, body):
+    def api_submit(self, body, _request=None):
         """≙ submit_inference (views.py:223-258): enqueue + wake dispatcher.
         On a standby: a thin 307 to the lease holder (GET /api/leader
-        names it) — either master is a valid entry point."""
+        names it) — either master is a valid entry point.
+
+        Overload front door (docs/robustness.md "Overload control"):
+        the declared ``slo_class`` body field and the ``X-DLI-Tenant``
+        header (body ``tenant`` is the in-process fallback — dlisim
+        calls this handler without an HTTP request) are validated
+        strictly — an unknown value is a structured 400 naming the
+        accepted set, never a silent default. An admitted-looking
+        submit can still be refused by the degradation ladder (class
+        shed at the current rung), the tenant's token bucket, or the
+        pending-depth cap — each an honest 429 + Retry-After, counted,
+        and journaled as an admission-rejected event."""
         nl = self._not_leader("/api/inference/submit")
         if nl:
             return nl
@@ -942,6 +1040,24 @@ class Master:
         if not model or prompt is None:
             return 400, {"status": "error",
                          "message": "model_name and prompt required"}
+        slo_class = body.get("slo_class", "throughput")
+        if slo_class not in SLO_CLASSES:
+            return 400, {"status": "error",
+                         "message": f"unknown slo_class {slo_class!r}; "
+                                    f"accepted: {', '.join(SLO_CLASSES)}",
+                         "accepted": list(SLO_CLASSES)}
+        tenant = None
+        if _request is not None:
+            tenant = _request.headers.get("X-DLI-Tenant")
+        if tenant is None:
+            tenant = body.get("tenant")
+        if tenant is None:
+            tenant = "default"
+        if not isinstance(tenant, str) or not _TENANT_RE.match(tenant):
+            return 400, {"status": "error",
+                         "message": "malformed X-DLI-Tenant: must match "
+                                    "[A-Za-z0-9._-]{1,64}",
+                         "accepted": "[A-Za-z0-9._-]{1,64}"}
         # max_length keeps the reference's prompt+new semantics
         # (views.py:351); it is forwarded verbatim so the worker computes
         # new-token count against the tokenized prompt.
@@ -967,9 +1083,16 @@ class Master:
                 self.metrics.inc("requests_submit_deduped")
                 return {"status": "success", "request_id": existing,
                         "deduped": True}
+        # admission control — AFTER the dedup fast path (a retry of an
+        # already-admitted request must neither burn bucket tokens nor
+        # be shed: the row exists, the work is already owed)
+        refused = self._admission_check(tenant, slo_class)
+        if refused is not None:
+            return refused
         req_id = self.store.submit_request(
             model, prompt, max_new, body.get("sampling"),
-            max_length=max_length, client_tag=ctag)
+            max_length=max_length, client_tag=ctag,
+            slo_class=slo_class, tenant=tenant)
         # workload capture (docs/simulator.md "Fitting inputs"): the
         # journal row IS the replayable arrival record — its ts is the
         # arrival time, its data the workload shape — so any debug
@@ -978,7 +1101,8 @@ class Master:
         events.emit("request-submitted", request_id=req_id, model=model,
                     prompt_chars=len(prompt) if isinstance(prompt, str)
                     else None,
-                    max_new_tokens=max_new, max_length=max_length)
+                    max_new_tokens=max_new, max_length=max_length,
+                    slo_class=slo_class, tenant=tenant)
         # HA durability barrier (DLI_HA_REPL_BARRIER): an acked submit
         # survives the leader's death — the row is on a standby before
         # the client sees the request id. Bounded wait; no-op when the
@@ -1049,6 +1173,167 @@ class Master:
         self.metrics.inc("requests_cancelled")
         self._trace_done(req_id)
         return {"status": "success", "message": "request cancelled"}
+
+    # ---- overload control (docs/robustness.md "Overload control") ----
+
+    def _admission_check(self, tenant: str, slo_class: str):
+        """The front door's three refusal gates, in order: the
+        degradation ladder (class shed at the current rung), the
+        bounded pending queue, the tenant's token bucket — the bucket
+        last so a refused submit never burns a token it would not use.
+        Returns None (admitted) or the full 429 response 3-tuple."""
+        level = self._overload_level
+        if (level >= 1 and slo_class == "batch") or \
+                (level >= 2 and slo_class != "latency"):
+            # sheds clear when the ladder steps down — the soonest
+            # honest retry hint is one hold window away
+            return self._admit_reject(
+                tenant, slo_class, f"shed-{slo_class}",
+                max(1, math.ceil(self._overload_hold)), shed=True)
+        if self._admit_max_pending > 0:
+            pending = self.store.counts().get("pending", 0)
+            if pending >= self._admit_max_pending:
+                # Retry-After from the measured drain rate (completed-
+                # counter delta per overload sweep): how long until the
+                # overage plausibly drains, clamped to something a
+                # polite client can actually honor
+                over = pending - self._admit_max_pending + 1
+                drain = max(self._drain_rate, 0.5)
+                return self._admit_reject(
+                    tenant, slo_class, "queue-full",
+                    min(60, max(1, math.ceil(over / drain))))
+        ok, wait = self._bucket_take(tenant)
+        if not ok:
+            return self._admit_reject(tenant, slo_class,
+                                      "tenant-bucket",
+                                      max(1, math.ceil(wait)))
+        return None
+
+    def _admit_reject(self, tenant: str, slo_class: str, reason: str,
+                      retry_after: int, shed: bool = False):
+        """One honest 429: Retry-After header, counted, journaled with
+        the rung that refused it — never a silent drop."""
+        self.metrics.inc("admit_rejected")
+        if shed:
+            self.metrics.inc(f"shed_{slo_class}")
+        events.emit("admission-rejected", tenant=tenant,
+                    slo_class=slo_class, reason=reason,
+                    retry_after_s=retry_after,
+                    level=self._overload_level)
+        return (429,
+                {"status": "error", "message": f"admission refused "
+                 f"({reason}); retry after {retry_after}s",
+                 "reason": reason, "retry_after_s": retry_after},
+                {"Retry-After": str(retry_after)})
+
+    def _bucket_take(self, tenant: str):
+        """Take one token from ``tenant``'s bucket. Returns (admitted,
+        seconds-until-a-token-refills). Rate <= 0 disables admission
+        rate limiting entirely (the default)."""
+        rate = self._admit_rate
+        if rate <= 0:
+            return True, 0.0
+        burst = self._admit_burst if self._admit_burst > 0 \
+            else max(1.0, rate)
+        now = clock.now()
+        with self._admit_lock:
+            tokens, last = self._admit_buckets.get(tenant, (burst, now))
+            tokens = min(burst, tokens + (now - last) * rate)
+            if tokens >= 1.0:
+                self._admit_buckets[tenant] = (tokens - 1.0, now)
+                return True, 0.0
+            self._admit_buckets[tenant] = (tokens, now)
+            return False, (1.0 - tokens) / rate
+
+    def _claim_max_priority(self) -> Optional[int]:
+        """Ladder rung 4 brownout: the dispatcher claims ONLY latency-
+        class work (state.py claim filter on declared class)."""
+        return 0 if self._overload_level >= 4 else None
+
+    def _overload_signals(self):
+        """(fast-window burn rate, queue depth) — the two pressure
+        signals the ladder walks on. Queue prefers the TSDB's sustained
+        master series mean over one hold window (one noisy instant
+        can't move a rung); falls back to the instantaneous count until
+        the telemetry loop has recorded two points. At rung 4 the
+        dispatcher claims only latency work, so the queue signal
+        narrows to the latency-class backlog — measuring the frozen
+        non-latency rows would hold the ladder at the top on exactly
+        the work the rung deferred (a wedge, not hysteresis)."""
+        burn = self.slo.snapshot(clock.now()).get("burn_rate_fast")
+        if self._overload_level >= 4:
+            return burn, float(
+                self.store.pending_by_class().get("latency", 0))
+        pts: List[float] = []
+        try:
+            for series in self.tsdb.query("queue_pending", node="master",
+                                          window=self._overload_hold):
+                pts.extend(p[1] for p in series.get("points", ()))
+        except Exception:
+            pts = []
+        if len(pts) >= 2:
+            queue = sum(pts) / len(pts)
+        else:
+            queue = float(self.store.counts().get("pending", 0))
+        return burn, queue
+
+    def _overload_sweep(self):
+        """One ladder step, at most, per sweep. Escalate when burn AND
+        sustained queue both exceed their thresholds; de-escalate when
+        both are back under half of them; either way the rung must have
+        dwelt DLI_OVERLOAD_HOLD_S first (hysteresis: a single noisy
+        scrape can neither shed a class nor un-shed one). Every
+        transition is journaled WITH the gauge values that justified it
+        — the postmortem reconstructs the whole walk from /api/events
+        alone. Burn threshold <= 0 drops the burn condition (queue-only
+        ladder — what the deterministic sim sweep drives)."""
+        now = clock.now()
+        # refresh the drain-rate estimate the queue-full Retry-After
+        # uses: completed-counter delta over the sweep gap
+        done = self.metrics.snapshot()["counters"].get(
+            "requests_completed", 0)
+        if self._drain_prev is not None:
+            d_done, d_t = done - self._drain_prev[0], \
+                now - self._drain_prev[1]
+            if d_t > 0 and d_done >= 0:
+                self._drain_rate = d_done / d_t
+        self._drain_prev = (done, now)
+        burn, queue = self._overload_signals()
+        burn_up = self._overload_burn <= 0 or (
+            burn is not None and burn >= self._overload_burn)
+        burn_dn = self._overload_burn <= 0 or burn is None or \
+            burn < self._overload_burn * 0.5
+        queue_up = queue >= self._overload_queue
+        queue_dn = queue < self._overload_queue * 0.5
+        level = self._overload_level
+        target = level
+        if burn_up and queue_up and level < 4:
+            target = level + 1
+        elif burn_dn and queue_dn and level > 0:
+            target = level - 1
+        if target == level or now - self._overload_last < \
+                self._overload_hold:
+            return
+        self._overload_level = target
+        self._overload_last = now
+        self.metrics.gauge("overload_level", float(target))
+        log.warning("overload ladder %d -> %d (burn=%s queue=%.1f)",
+                    level, target, burn, queue)
+        events.emit("overload-level", level=target, prev_level=level,
+                    direction="up" if target > level else "down",
+                    burn_rate=burn, queue_depth=round(queue, 2))
+
+    def _overload_loop(self):
+        """Leader-gated ladder walker (same shape as _rebalance_loop):
+        a standby must not shed — its replica's queue view trails the
+        leader's, and admission belongs to whoever owns dispatch."""
+        while not self._stop.is_set():
+            try:
+                if self.ha.is_leader():
+                    self._overload_sweep()
+            except Exception as e:
+                log.debug("overload sweep failed: %r", e)
+            self._stop.wait(self._overload_interval)
 
     # ---- observability -----------------------------------------------
 
@@ -1651,7 +1936,8 @@ class Master:
         self._node_lat_ewma[node_id] = (
             seconds if prev is None else a * seconds + (1 - a) * prev)
 
-    def _score_pick(self, cands, model=None, prompt=None):
+    def _score_pick(self, cands, model=None, prompt=None,
+                    slo_class=None):
         """Queue-aware choice among schedulable candidates. Primary
         load = max(master-side in-flight, worker-reported batcher queue
         depth) — max, not sum: every request this master dispatched and
@@ -1675,7 +1961,15 @@ class Master:
         worker-reported state at all this degrades to the old
         least-in-flight rule. Returns (node, reason) — the reason feeds
         the ``scheduler_pick_*`` counters so the policy is observable.
-        Caller holds ``_inflight_lock``."""
+        Caller holds ``_inflight_lock``.
+
+        SLO classes bend the policy, never break the load rule
+        (FlowKV): ``latency`` zeroes the affinity slack — a warm
+        prefix never outranks queue depth for latency-tier work, it
+        goes strictly least-loaded; ``batch`` soaks idle KV capacity —
+        among candidates within the slack of the least-loaded it takes
+        the most free KV blocks, filling whichever node has room
+        without convoying the loaded ones."""
         now = clock.now()
         inflight = self._inflight
         rt = {}
@@ -1710,6 +2004,17 @@ class Master:
             return loads[n["id"]]
 
         lo = min(loads[n["id"]] for n in cands)
+        if slo_class == "batch" and len(cands) > 1:
+            pool = [n for n in cands
+                    if loads[n["id"]] <= lo + self._prefix_slack]
+            free = {n["id"]: (rt.get(n["id"]) or {}).get("free_blocks")
+                    for n in pool}
+            known = [v for v in free.values() if v is not None]
+            if len(pool) > 1 and known and len(set(known)) > 1:
+                best = max(known)
+                top = [n for n in pool if free[n["id"]] == best]
+                return min(top, key=primary), "class_batch"
+        slack = 0 if slo_class == "latency" else self._prefix_slack
         if prompt and model and digests_any \
                 and self._prefix_weight > 0 and len(cands) > 1:
             # digests_any gate: with no fresh digest advertisement in
@@ -1723,7 +2028,7 @@ class Master:
                 est = estimate_cached_tokens(
                     prompt, (entry or {}).get("digests"), memo)
                 if (est * self._prefix_weight >= 1
-                        and primary(n) <= lo + self._prefix_slack):
+                        and primary(n) <= lo + slack):
                     aff.append((est, n))
             # affinity must SEPARATE candidates: when every candidate
             # holds the same prefix depth there is nothing to win, and
@@ -1759,7 +2064,8 @@ class Master:
                    prefer: Optional[int] = None,
                    nodes: Optional[list] = None,
                    prompt: Optional[str] = None,
-                   role: Optional[str] = None):
+                   role: Optional[str] = None,
+                   slo_class: Optional[str] = None):
         """Least-loaded schedulable node, preferring ones with the model
         already loaded (reference: always .first(), views.py:389-391).
 
@@ -1806,7 +2112,7 @@ class Master:
                     and all(n["id"] != prefer for n in pool):
                 pool = pool + [n for n in nodes if n["id"] == prefer]
             chosen = self._pick_from(pool, model, exclude, reserve,
-                                     prefer, prompt, role)
+                                     prefer, prompt, role, slo_class)
             if chosen is not None:
                 self.metrics.inc("scheduler_pick_sampled")
                 return chosen
@@ -1814,10 +2120,10 @@ class Master:
             # node open/draining/excluded): correctness demands the
             # full scan before declaring the fleet unschedulable
         return self._pick_from(nodes, model, exclude, reserve, prefer,
-                               prompt, role)
+                               prompt, role, slo_class)
 
     def _pick_from(self, nodes, model, exclude, reserve, prefer,
-                   prompt, role):
+                   prompt, role, slo_class=None):
         """The pick policy proper, over an explicit candidate list (the
         whole snapshot, or :meth:`_pick_node`'s sample)."""
         nodes = [n for n in nodes if not n.get("draining")]
@@ -1887,9 +2193,9 @@ class Master:
                 if pinned:
                     chosen, reason = pinned[0], "pinned"
                 else:
-                    chosen, reason = self._score_pick(have or pool,
-                                                      model=model,
-                                                      prompt=prompt)
+                    chosen, reason = self._score_pick(
+                        have or pool, model=model, prompt=prompt,
+                        slo_class=slo_class)
                 self.metrics.inc(f"scheduler_pick_{reason}")
                 if reserve:
                     self._inflight[chosen["id"]] = \
@@ -1970,7 +2276,8 @@ class Master:
         # mixed fleet is unaffected (the filter falls through)
         node = self._pick_node(req["model_name"], exclude=excluded,
                                reserve=True, prefer=prefer, nodes=nodes,
-                               prompt=req.get("prompt"), role="decode")
+                               prompt=req.get("prompt"), role="decode",
+                               slo_class=req.get("slo_class"))
         if node is None:
             # nothing schedulable right now (all breakers open / nodes
             # draining): park instead of failing — at least a health
@@ -2010,6 +2317,13 @@ class Master:
             body["max_length"] = req["max_length"]
         else:
             body["max_new_tokens"] = req["max_new_tokens"]
+        if (self._overload_level >= 3 and self._overload_chunk_cap > 0
+                and req.get("slo_class") == "latency"):
+            # brownout rung 3: cap latency-tier decode chunks so the
+            # tier that is still admitted interleaves on short slices
+            # instead of inheriting the full convoyed chunk schedule
+            # (runtime/batcher.py filters DECODE_CHUNKS by this cap)
+            body["decode_chunk_cap"] = self._overload_chunk_cap
         src = req.get("_kv_source") or req.get("kv_source")
         if src:
             # disaggregated/migrated dispatch: tell the decode node
@@ -3183,7 +3497,9 @@ class Master:
                 self._wake.wait(timeout=0.5)
                 self._wake.clear()
                 continue
-            reqs = self.store.claim_next_pending_many(self.dispatch_batch)
+            reqs = self.store.claim_next_pending_many(
+                self.dispatch_batch,
+                max_priority=self._claim_max_priority())
             if not reqs:
                 self._wake.wait(timeout=0.5)
                 self._wake.clear()
@@ -3313,6 +3629,11 @@ class Master:
         if self._rebalance:
             t = threading.Thread(target=self._rebalance_loop,
                                  daemon=True, name="rebalance")
+            t.start()
+            self._threads.append(t)
+        if self._overload:
+            t = threading.Thread(target=self._overload_loop,
+                                 daemon=True, name="overload")
             t.start()
             self._threads.append(t)
         # HA shipper/lease-monitor thread (no-op without peers)

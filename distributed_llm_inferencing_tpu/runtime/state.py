@@ -130,8 +130,34 @@ _MIGRATIONS = {
                  # of an HA pair died between committing the row and
                  # answering) dedupes onto the existing row instead of
                  # creating a second request that would generate twice
-                 ("client_tag", "TEXT")),
+                 ("client_tag", "TEXT"),
+                 # overload-control plane (docs/robustness.md "Overload
+                 # control"): declared SLO class drives claim priority
+                 # and the shedding ladder; tenant names the token
+                 # bucket that admitted the request. Defaults keep
+                 # pre-migration rows on the middle tier.
+                 ("slo_class", "TEXT DEFAULT 'throughput'"),
+                 ("tenant", "TEXT DEFAULT 'default'")),
 }
+
+# Declared SLO classes (request body field ``slo_class``) and their
+# claim priorities — lower number claims first. Anything outside the
+# tuple is a structured 400 at api_submit; NULL (pre-migration rows)
+# coalesces to 'throughput' in the CASE below.
+SLO_CLASSES = ("latency", "throughput", "batch")
+_SLO_PRIORITY_SQL = ("CASE slo_class WHEN 'latency' THEN 0 "
+                     "WHEN 'batch' THEN 2 ELSE 1 END")
+
+# Deadline-style aging for the priority claim: a pending request's
+# effective priority drops by one tier per CLAIM_AGING_S seconds of
+# wait, so batch work cannot starve behind a sustained latency-tier
+# stream. The anti-starvation bound this buys (model-checked in
+# tools/dliverify, asserted at 1000 nodes by the dlisim overload
+# sweep): once a request has waited 2x aging (the full priority span),
+# no later submit can sort ahead of it, so with the admission plane's
+# pending-depth cap Q it is claimed within ceil(Q / claim_batch)
+# further waves. <= 0 disables aging (pure class priority, id order).
+CLAIM_AGING_S = float(os.environ.get("DLI_SCHED_AGING_S", "30"))
 
 
 def _strip_ephemeral(info):
@@ -583,7 +609,9 @@ class Store:
                        max_new_tokens: Optional[int] = 100,
                        sampling: Optional[dict] = None,
                        max_length: Optional[int] = None,
-                       client_tag: Optional[str] = None) -> int:
+                       client_tag: Optional[str] = None,
+                       slo_class: str = "throughput",
+                       tenant: str = "default") -> int:
         """New request row; ``client_tag`` is the client's submit
         idempotency key — a tagged re-submit (the ack was lost: an HA
         leader died between committing the row and answering, or the
@@ -602,9 +630,11 @@ class Store:
             return self._exec(
                 "INSERT INTO requests (model_name, prompt, "
                 "max_new_tokens, max_length, sampling, created_at, "
-                "client_tag) VALUES (?,?,?,?,?,?,?)",
+                "client_tag, slo_class, tenant) "
+                "VALUES (?,?,?,?,?,?,?,?,?)",
                 (model_name, prompt, max_new_tokens, max_length,
-                 json.dumps(sampling or {}), clock.now(), client_tag))
+                 json.dumps(sampling or {}), clock.now(), client_tag,
+                 slo_class, tenant))
 
     def find_client_tag(self, client_tag: str) -> Optional[int]:
         """The request id a submit idempotency key already names, or
@@ -639,18 +669,43 @@ class Store:
         rows = self.claim_next_pending_many(1)
         return rows[0] if rows else None
 
-    def claim_next_pending_many(self, limit: int = 1
+    def claim_next_pending_many(self, limit: int = 1,
+                                max_priority: Optional[int] = None
                                 ) -> List[Dict[str, Any]]:
-        """Atomically claim up to ``limit`` due pending requests, oldest
-        first, in ONE locked transaction (single SELECT + executemany
-        status flip) — the multiplexed dispatcher's entry point. FIFO:
-        the returned order is id order, which is submission order."""
+        """Atomically claim up to ``limit`` due pending requests in ONE
+        locked transaction (single SELECT + executemany status flip) —
+        the multiplexed dispatcher's entry point.
+
+        Order is SLO-class priority (latency=0 < throughput=1 <
+        batch=2) with deadline-style aging: every ``CLAIM_AGING_S``
+        seconds of wait lowers a row's effective priority by one tier,
+        ties break by id (submission order). A request that has waited
+        the full priority span (2x aging) therefore outranks ANY fresh
+        submit — the anti-starvation bound dliverify model-checks.
+        With aging disabled the order is pure class priority then id;
+        pre-migration rows (NULL slo_class) sit on the throughput tier.
+
+        ``max_priority`` filters by *declared* class (not the aged
+        value): the overload ladder's final rung passes 0 so a browned-
+        out master claims only latency-tier work. Aging deliberately
+        does not bypass the filter — rung 4 means "nothing but latency
+        runs", and admission of lower tiers was already shut off two
+        rungs earlier, so the filtered backlog is bounded."""
         with self._lock:
             now = clock.now()
-            rows = self._all(
-                "SELECT * FROM requests WHERE status='pending' "
-                "AND next_attempt_at<=? ORDER BY id LIMIT ?",
-                (now, int(limit)))
+            sel = ("SELECT * FROM requests WHERE status='pending' "
+                   "AND next_attempt_at<=?")
+            args: List[Any] = [now]
+            if max_priority is not None:
+                sel += " AND " + _SLO_PRIORITY_SQL + "<=?"
+                args.append(int(max_priority))
+            if CLAIM_AGING_S > 0:
+                sel += (" ORDER BY (" + _SLO_PRIORITY_SQL +
+                        " - (?-created_at)/?), id LIMIT ?")
+                args += [now, CLAIM_AGING_S]
+            else:
+                sel += " ORDER BY " + _SLO_PRIORITY_SQL + ", id LIMIT ?"
+            rows = self._all(sel, (*args, int(limit)))
             if not rows:
                 return []
             flips = [(now, r["id"]) for r in rows]
@@ -864,6 +919,17 @@ class Store:
         rows = self._all(
             "SELECT status, COUNT(*) AS n FROM requests GROUP BY status")
         return {r["status"]: r["n"] for r in rows}
+
+    def pending_by_class(self) -> Dict[str, int]:
+        """Pending-queue depth per SLO class. The overload ladder's
+        rung-4 de-escalation signal (master._overload_signals): at the
+        top rung the dispatcher claims only latency work, so measuring
+        ALL pending would hold the ladder up forever on the very rows
+        the rung froze."""
+        rows = self._all(
+            "SELECT slo_class, COUNT(*) AS n FROM requests "
+            "WHERE status='pending' GROUP BY slo_class")
+        return {r["slo_class"]: r["n"] for r in rows}
 
     def pending_by_model(self) -> Dict[str, int]:
         """Pending-queue depth per model (the per-model ``queue_pending``

@@ -846,6 +846,7 @@ class WorkerAgent:
                           "kv_export": bool(sub_body.get("kv_export")),
                           "resume": (resume if isinstance(resume, dict)
                                      else None),
+                          "chunk_cap": sub_body.get("decode_chunk_cap"),
                           "trace_ctx": trace.extract(sub_body) or ctx})
             self._note_prefix(m, sub_body, prompt)
             metas.append((sub_body, tag, my_ev, t0))
@@ -1196,7 +1197,10 @@ class WorkerAgent:
                         seed=body.get("seed"),
                         kv_transfer_bytes=pre,
                         kv_export=bool(body.get("kv_export")),
-                        resume=resume)
+                        resume=resume,
+                        # master brownout rung 3: per-request decode
+                        # chunk ceiling on latency-class dispatches
+                        chunk_cap=body.get("decode_chunk_cap"))
                     self._note_prefix(m, body, prompt)
                     if tag:
                         with self._tagged_lock:

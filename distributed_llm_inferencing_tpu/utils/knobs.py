@@ -186,6 +186,50 @@ KNOBS = (
          "random sample of this many candidates instead of every node "
          "(per-pick cost stays O(sample) at 1000 nodes; `0` always "
          "scans the full fleet).", f"{_P}/runtime/master.py"),
+    Knob("DLI_SCHED_AGING_S", "30", "float",
+         "Deadline-style aging for the priority claim: one SLO-class "
+         "tier of effective priority per this many seconds of pending "
+         "wait, so `batch` cannot starve (`<=0` = pure class "
+         "priority).", f"{_P}/runtime/state.py"),
+    # ---- overload front door (docs/robustness.md "Overload control") -
+    Knob("DLI_ADMIT_RATE", "0", "float",
+         "Per-tenant token-bucket refill (admitted submits/s per "
+         "`X-DLI-Tenant`); excess gets 429 + Retry-After. `0` disables "
+         "bucket admission.", f"{_P}/runtime/master.py"),
+    Knob("DLI_ADMIT_BURST", "0", "float",
+         "Token-bucket depth (burst headroom) per tenant; `0` = "
+         "max(1, rate).", f"{_P}/runtime/master.py"),
+    Knob("DLI_ADMIT_MAX_PENDING", "0", "int",
+         "Total pending-queue depth cap at admission; past it submits "
+         "get 429 with a Retry-After computed from the measured drain "
+         "rate. `0` = unbounded.", f"{_P}/runtime/master.py"),
+    Knob("DLI_OVERLOAD", "1", "bool",
+         "`0` kills the master's overload ladder loop (shedding/"
+         "brownout; admission knobs still apply).",
+         f"{_P}/runtime/master.py"),
+    Knob("DLI_OVERLOAD_INTERVAL_S", "2.0", "float",
+         "Seconds between overload-ladder sweeps.",
+         f"{_P}/runtime/master.py"),
+    Knob("DLI_OVERLOAD_BURN", "1.0", "float",
+         "Fast-window burn rate the ladder escalates at (with queue "
+         "pressure); `<=0` drops the burn condition (queue-only "
+         "ladder).", f"{_P}/runtime/master.py"),
+    Knob("DLI_OVERLOAD_QUEUE", "64", "float",
+         "Sustained master queue depth the ladder escalates at; "
+         "de-escalation needs both signals under half their "
+         "thresholds.", f"{_P}/runtime/master.py"),
+    Knob("DLI_OVERLOAD_HOLD_S", "10.0", "float",
+         "Minimum dwell between ladder transitions (hysteresis) and "
+         "the sustained-queue averaging window.",
+         f"{_P}/runtime/master.py"),
+    Knob("DLI_OVERLOAD_CHUNK_CAP", "8", "int",
+         "decode_chunk_cap injected into latency-tier dispatches at "
+         "ladder rung 3+ (brownout); `0` skips the cap rung's chunk "
+         "action.", f"{_P}/runtime/master.py"),
+    Knob("DLI_HTTPD_MAX_INFLIGHT", "0", "int",
+         "Bounded in-flight request cap per HTTP service; past it "
+         "ingress answers 503 + Retry-After before any handler runs. "
+         "`0` = uncapped.", f"{_P}/runtime/httpd.py"),
     # ---- disaggregation / KV transfer --------------------------------
     Knob("DLI_WORKER_ROLE", "mixed", "enum",
          "This worker's pool: `prefill`, `decode`, or `mixed`.",

@@ -1,0 +1,382 @@
+"""Overload front-door suite (docs/robustness.md "Overload control").
+
+Covers the acceptance-critical invariants:
+- token-bucket refill math on the virtual clock: burst drains, tokens
+  refill at the configured rate, and the advertised wait is exactly
+  the time until the next token exists,
+- every refusal is an honest 429: Retry-After on the wire, a reasoned
+  body, an ``admission-rejected`` journal event — never a silent drop
+  (shed, queue-full, and tenant-bucket gates alike),
+- unknown ``slo_class`` / malformed ``X-DLI-Tenant`` are structured
+  400s naming the accepted set,
+- priority claim ordering: latency before throughput before batch,
+  the rung-4 ``max_priority`` filter, and deadline-style aging that
+  bounds how long an old batch row can be overtaken,
+- the degradation ladder escalates/de-escalates one rung per dwell
+  with hysteresis, each transition journaled WITH the gauge values
+  that justified it, and rung-3 brownout injects the decode-chunk cap
+  into latency-class dispatch payloads only,
+- the HTTP ingress itself refuses past ``max_inflight`` with
+  503 + Retry-After instead of queueing without bound.
+"""
+
+import json
+import math
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest
+import requests as rq
+
+from distributed_llm_inferencing_tpu.runtime import state
+from distributed_llm_inferencing_tpu.runtime.httpd import JsonHTTPService
+from distributed_llm_inferencing_tpu.runtime.master import Master
+from distributed_llm_inferencing_tpu.runtime.state import Store
+from distributed_llm_inferencing_tpu.utils import clock
+from distributed_llm_inferencing_tpu.utils.clock import VirtualClock
+
+
+@pytest.fixture
+def vclock():
+    vc = VirtualClock(1_700_000_000.0, owner=True)
+    prev = clock.set_clock(vc)
+    try:
+        yield vc
+    finally:
+        clock.set_clock(prev)
+
+
+def _submit_body(slo_class="throughput", tenant=None, **kw):
+    b = {"model_name": "m", "prompt": "p", "max_new_tokens": 4,
+         "slo_class": slo_class}
+    if tenant is not None:
+        b["tenant"] = tenant
+    b.update(kw)
+    return b
+
+
+# ---- token bucket -----------------------------------------------------
+
+def test_bucket_burst_refill_and_wait_math(vclock):
+    m = Master(":memory:", admit_rate=1.0, admit_burst=2.0)
+    try:
+        assert m._bucket_take("t1") == (True, 0.0)
+        assert m._bucket_take("t1") == (True, 0.0)
+        ok, wait = m._bucket_take("t1")
+        assert not ok and wait == pytest.approx(1.0)
+        # refill is linear in elapsed time: half a token after 0.5s
+        vclock.advance(0.5)
+        ok, wait = m._bucket_take("t1")
+        assert not ok and wait == pytest.approx(0.5)
+        vclock.advance(0.5)
+        assert m._bucket_take("t1") == (True, 0.0)
+        # tenants are isolated: t1 empty says nothing about t2
+        assert m._bucket_take("t2") == (True, 0.0)
+        # refill caps at burst, not beyond
+        vclock.advance(60.0)
+        for _ in range(2):
+            assert m._bucket_take("t1") == (True, 0.0)
+        assert not m._bucket_take("t1")[0]
+    finally:
+        m.stop()
+
+
+def test_bucket_refusal_is_honest_429(vclock):
+    m = Master(":memory:", admit_rate=0.5, admit_burst=1.0)
+    try:
+        r = m.api_submit(_submit_body(tenant="acme"))
+        assert r["status"] == "success"
+        refused = m.api_submit(_submit_body(tenant="acme"))
+        assert isinstance(refused, tuple) and refused[0] == 429
+        body, headers = refused[1], refused[2]
+        assert body["reason"] == "tenant-bucket"
+        # 1 token at rate 0.5/s is 2s away; Retry-After must say so
+        assert headers["Retry-After"] == str(body["retry_after_s"]) \
+            == "2"
+        # no row was created for the refused submit
+        assert m.store.counts().get("pending", 0) == 1
+        m.store.flush()
+        evs = m.store.query_events(etype="admission-rejected")
+        assert len(evs) == 1
+        d = evs[0]["data"]
+        assert d["tenant"] == "acme" and d["reason"] == "tenant-bucket"
+        assert d["retry_after_s"] == 2 and d["slo_class"] == "throughput"
+    finally:
+        m.stop()
+
+
+# ---- queue-depth cap --------------------------------------------------
+
+def test_queue_cap_refuses_with_drain_rate_hint(vclock):
+    m = Master(":memory:", admit_max_pending=2)
+    try:
+        assert m.api_submit(_submit_body())["status"] == "success"
+        assert m.api_submit(_submit_body())["status"] == "success"
+        refused = m.api_submit(_submit_body())
+        assert isinstance(refused, tuple) and refused[0] == 429
+        assert refused[1]["reason"] == "queue-full"
+        # no measured drain yet -> the 0.5/s floor prices the overage
+        assert 1 <= int(refused[2]["Retry-After"]) <= 60
+        assert m.store.counts()["pending"] == 2
+    finally:
+        m.stop()
+
+
+# ---- structured 400s --------------------------------------------------
+
+def test_unknown_slo_class_and_bad_tenant_are_structured_400s():
+    m = Master(":memory:")
+    try:
+        r = m.api_submit(_submit_body(slo_class="gold"))
+        assert isinstance(r, tuple) and r[0] == 400
+        assert r[1]["accepted"] == ["latency", "throughput", "batch"]
+        r = m.api_submit(_submit_body(tenant="no spaces allowed"))
+        assert isinstance(r, tuple) and r[0] == 400
+        assert "X-DLI-Tenant" in r[1]["message"]
+    finally:
+        m.stop()
+
+
+def test_http_front_door_headers_and_400s():
+    """The wire-level contract: X-DLI-Tenant header feeds the bucket,
+    refusals carry the Retry-After HEADER, and validation failures are
+    structured 400s — all through the real HTTP stack."""
+    m = Master(":memory:", admit_rate=0.2, admit_burst=1.0)
+    srv = m.service.serve("127.0.0.1", 0, background=True)
+    base = f"http://127.0.0.1:{srv.server_address[1]}"
+    try:
+        ok = rq.post(f"{base}/api/inference/submit",
+                     json=_submit_body(slo_class="latency"),
+                     headers={"X-DLI-Tenant": "acme"})
+        assert ok.status_code == 200 and ok.json()["status"] == "success"
+        refused = rq.post(f"{base}/api/inference/submit",
+                          json=_submit_body(),
+                          headers={"X-DLI-Tenant": "acme"})
+        assert refused.status_code == 429
+        assert int(refused.headers["Retry-After"]) >= 1
+        assert refused.json()["reason"] == "tenant-bucket"
+        # another tenant's bucket is untouched
+        other = rq.post(f"{base}/api/inference/submit",
+                        json=_submit_body(),
+                        headers={"X-DLI-Tenant": "globex"})
+        assert other.status_code == 200
+        bad = rq.post(f"{base}/api/inference/submit",
+                      json=_submit_body(),
+                      headers={"X-DLI-Tenant": "a b"})
+        assert bad.status_code == 400
+        bad = rq.post(f"{base}/api/inference/submit",
+                      json=_submit_body(slo_class="gold"))
+        assert bad.status_code == 400
+        assert bad.json()["accepted"] == ["latency", "throughput",
+                                          "batch"]
+    finally:
+        m.stop()
+
+
+# ---- priority claim + aging ------------------------------------------
+
+def _seed(store, slo_class):
+    return store.submit_request("m", "p", 4, None, slo_class=slo_class)
+
+
+def test_claim_orders_by_class_priority():
+    s = Store(":memory:")
+    try:
+        rb = _seed(s, "batch")
+        rt = _seed(s, "throughput")
+        rl = _seed(s, "latency")
+        claimed = [r["id"] for r in s.claim_next_pending_many(3)]
+        assert claimed == [rl, rt, rb]
+    finally:
+        s.close()
+
+
+def test_claim_max_priority_filters_declared_class():
+    s = Store(":memory:")
+    try:
+        _seed(s, "batch")
+        _seed(s, "throughput")
+        rl = _seed(s, "latency")
+        only = s.claim_next_pending_many(10, max_priority=0)
+        assert [r["id"] for r in only] == [rl]
+        # the filtered rows are untouched and claimable later
+        rest = s.claim_next_pending_many(10)
+        assert len(rest) == 2
+    finally:
+        s.close()
+
+
+def test_aging_bounds_starvation(vclock, monkeypatch):
+    """An old batch row outranks a fresh latency row once it has aged
+    one full priority step per CLAIM_AGING_S window — the anti-
+    starvation bound the dliverify priority_aging scenario model-checks
+    and the dlisim sweep measures in claim waves."""
+    monkeypatch.setattr(state, "CLAIM_AGING_S", 10.0)
+    s = Store(":memory:")
+    try:
+        old_batch = _seed(s, "batch")
+        vclock.advance(25.0)   # 2.5 aging windows: priority 2 -> -0.5
+        fresh_latency = _seed(s, "latency")
+        claimed = [r["id"] for r in s.claim_next_pending_many(2)]
+        assert claimed == [old_batch, fresh_latency]
+    finally:
+        s.close()
+
+
+def test_fresh_batch_does_not_outrank_latency(vclock, monkeypatch):
+    monkeypatch.setattr(state, "CLAIM_AGING_S", 10.0)
+    s = Store(":memory:")
+    try:
+        batch = _seed(s, "batch")
+        vclock.advance(5.0)    # half a window: not enough to overtake
+        latency = _seed(s, "latency")
+        claimed = [r["id"] for r in s.claim_next_pending_many(2)]
+        assert claimed == [latency, batch]
+    finally:
+        s.close()
+
+
+# ---- degradation ladder ----------------------------------------------
+
+def _ladder_master(**kw):
+    kw.setdefault("overload_burn", 0.0)      # queue-only: deterministic
+    kw.setdefault("overload_queue", 10.0)
+    kw.setdefault("overload_hold_s", 5.0)
+    return Master(":memory:", **kw)
+
+
+def test_ladder_escalates_and_deescalates_with_hysteresis(vclock):
+    m = _ladder_master()
+    try:
+        queue = [100.0]
+        m._overload_signals = lambda: (None, queue[0])
+        m._overload_sweep()
+        assert m._overload_level == 1
+        # dwell gate: a second sweep inside the hold must NOT step
+        m._overload_sweep()
+        assert m._overload_level == 1
+        for want in (2, 3, 4):
+            vclock.advance(5.0)
+            m._overload_sweep()
+            assert m._overload_level == want
+            m._overload_sweep()
+            assert m._overload_level == want
+        vclock.advance(5.0)
+        m._overload_sweep()
+        assert m._overload_level == 4, "rung 4 is the ladder's top"
+        # hysteresis: queue under the threshold but NOT under half of
+        # it holds the rung
+        queue[0] = 7.0
+        vclock.advance(5.0)
+        m._overload_sweep()
+        assert m._overload_level == 4
+        queue[0] = 2.0
+        for want in (3, 2, 1, 0):
+            vclock.advance(5.0)
+            m._overload_sweep()
+            assert m._overload_level == want
+        m.store.flush()
+        evs = m.store.query_events(etype="overload-level")
+        walk = [(e["data"]["prev_level"], e["data"]["level"]) for e in evs]
+        assert walk == [(0, 1), (1, 2), (2, 3), (3, 4),
+                        (4, 3), (3, 2), (2, 1), (1, 0)]
+        for e in evs:
+            # every transition journals the gauge values behind it
+            assert e["data"]["queue_depth"] in (100.0, 2.0)
+            assert e["data"]["direction"] in ("up", "down")
+    finally:
+        m.stop()
+
+
+def test_ladder_sheds_classes_in_order(vclock):
+    m = _ladder_master()
+    try:
+        m._overload_level = 1
+        r = m.api_submit(_submit_body(slo_class="batch"))
+        assert isinstance(r, tuple) and r[0] == 429
+        assert r[1]["reason"] == "shed-batch"
+        assert int(r[2]["Retry-After"]) == math.ceil(m._overload_hold)
+        assert m.api_submit(_submit_body("throughput"))["status"] == \
+            "success"
+        m._overload_level = 2
+        r = m.api_submit(_submit_body("throughput"))
+        assert isinstance(r, tuple) and r[0] == 429
+        assert r[1]["reason"] == "shed-throughput"
+        assert m.api_submit(_submit_body("latency"))["status"] == \
+            "success"
+        snap = m.metrics.snapshot()["counters"]
+        assert snap["shed_batch"] == 1 and snap["shed_throughput"] == 1
+        assert snap["admit_rejected"] == 2
+        m.store.flush()
+        evs = m.store.query_events(etype="admission-rejected")
+        assert [e["data"]["level"] for e in evs] == [1, 2]
+    finally:
+        m.stop()
+
+
+def test_rung3_injects_chunk_cap_for_latency_only(vclock):
+    m = _ladder_master(overload_chunk_cap=4)
+    try:
+        latency = {"model_name": "m", "prompt": "p", "max_new_tokens": 4,
+                   "sampling": None, "slo_class": "latency", "id": 1,
+                   "max_length": None}
+        batch = dict(latency, slo_class="batch", id=2)
+        assert "decode_chunk_cap" not in m._infer_body(latency)
+        m._overload_level = 3
+        assert m._infer_body(latency)["decode_chunk_cap"] == 4
+        assert "decode_chunk_cap" not in m._infer_body(batch)
+    finally:
+        m.stop()
+
+
+def test_rung4_claim_filter(vclock):
+    m = _ladder_master()
+    try:
+        assert m._claim_max_priority() is None
+        m._overload_level = 4
+        assert m._claim_max_priority() == 0
+    finally:
+        m.stop()
+
+
+# ---- HTTP ingress saturation -----------------------------------------
+
+def test_httpd_max_inflight_503():
+    svc = JsonHTTPService("test", max_inflight=1)
+    release = threading.Event()
+    entered = threading.Event()
+
+    def slow(body):
+        entered.set()
+        release.wait(10.0)
+        return {"status": "success"}
+
+    svc.add("GET", "/slow", slow)
+    srv = svc.serve("127.0.0.1", 0, background=True)
+    base = f"http://127.0.0.1:{srv.server_address[1]}"
+    try:
+        first = {}
+        t = threading.Thread(
+            target=lambda: first.update(r=rq.get(f"{base}/slow")))
+        t.start()
+        assert entered.wait(5.0)
+        refused = rq.get(f"{base}/slow", timeout=5)
+        assert refused.status_code == 503
+        assert refused.headers["Retry-After"] == "1"
+        release.set()
+        t.join(timeout=10)
+        assert first["r"].status_code == 200
+        # the slot freed: the next request is served again
+        assert rq.get(f"{base}/slow", timeout=5).status_code == 200
+    finally:
+        release.set()
+        svc.shutdown()
+
+
+def test_httpd_inflight_cap_off_by_default():
+    svc = JsonHTTPService("test")
+    assert svc.max_inflight == 0

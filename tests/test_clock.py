@@ -134,6 +134,7 @@ def test_breaker_half_open_probe_cycle_on_virtual_clock(vclock):
         row = m.store.get_node(nid)
         assert row["breaker_state"] == "closed"
         assert row["consecutive_failures"] == 0
+        m.store.flush()   # group commit: see our own buffered events
         counts = {e["type"] for e in m.store.query_events(limit=50)}
         assert {"breaker-open", "breaker-half-open",
                 "breaker-closed"} <= counts

@@ -50,6 +50,19 @@ def main(argv=None) -> int:
                    help="JSON file of request-submitted journal rows "
                         "(or {'events': [...]}) to replay instead of "
                         "synthetic arrivals")
+    p.add_argument("--overload", action="store_true",
+                   help="overload front door: mixed SLO classes/"
+                        "tenants on arrivals, bounded pending queue, "
+                        "queue-only degradation ladder swept on the "
+                        "health cadence (docs/robustness.md)")
+    p.add_argument("--admit-max-pending", type=int, default=512,
+                   help="pending-queue bound under --overload "
+                        "(default 512)")
+    p.add_argument("--overload-queue", type=float, default=64.0,
+                   help="ladder queue threshold under --overload")
+    p.add_argument("--claim-interval", type=float, default=1.0,
+                   help="seconds between claim waves under --overload "
+                        "(one wave per dispatch event)")
     p.add_argument("--out", default=None,
                    help="also write the report to this path")
     args = p.parse_args(argv)
@@ -68,11 +81,18 @@ def main(argv=None) -> int:
             print(f"no request-submitted rows in {args.trace}",
                   file=sys.stderr)
             return 2
+    kw = {}
+    if args.overload:
+        kw = dict(slo_mix=True, overload=True,
+                  admit_max_pending=args.admit_max_pending,
+                  overload_queue=args.overload_queue,
+                  overload_hold_s=30.0,
+                  claim_interval_s=args.claim_interval)
     cfg = SimConfig(nodes=args.nodes, requests=args.requests,
                     duration_s=args.duration, arrival=args.arrival,
                     seed=args.seed, slots_per_node=args.slots,
                     prefill_nodes=args.prefill_nodes,
-                    fail_nodes=fails, arrivals=arrivals)
+                    fail_nodes=fails, arrivals=arrivals, **kw)
     report = run_sim(cfg).to_json()
     line = json.dumps(report)
     print(line)

@@ -158,6 +158,26 @@ class SimConfig:
     arrivals: Optional[List[dict]] = None
     #: how long past the last arrival to keep draining (virtual s)
     drain_s: float = 600.0
+    #: overload front door (docs/robustness.md "Overload control"):
+    #: slo_mix assigns SLO classes and tenants to arrivals
+    #: deterministically (class i%3, tenant t{i%4}); the admit_*
+    #: knobs forward to the Master constructor; overload drives
+    #: _overload_sweep from the health cadence with the burn
+    #: threshold pinned to 0 — a queue-only ladder, so the walk is a
+    #: pure function of the virtual queue series (byte-deterministic)
+    slo_mix: bool = False
+    admit_rate: float = 0.0
+    admit_burst: float = 0.0
+    admit_max_pending: int = 0
+    overload: bool = False
+    overload_queue: float = 64.0
+    overload_hold_s: float = 10.0
+    #: >0: ONE claim wave per dispatch event, the next wave at
+    #: +interval — pending accumulates between waves, which is what
+    #: makes starvation_max_waves (claim waves a request sat pending)
+    #: a meaningful anti-starvation measurement; 0 keeps the legacy
+    #: drain-the-queue dispatch pass
+    claim_interval_s: float = 0.0
 
 
 @dataclass
@@ -181,6 +201,20 @@ class SimReport:
     queue_depth_mean: Optional[float] = None
     queue_depth_max: int = 0
     breaker: Dict[str, int] = field(default_factory=dict)
+    # overload front door: honest refusals (429 + Retry-After) by
+    # reason, class sheds, the highest rung the ladder reached, and
+    # the anti-starvation measurement (max claim waves any admitted
+    # request sat pending; bounded when admission bounds the queue)
+    rejected: int = 0
+    rejected_by_reason: Dict[str, int] = field(default_factory=dict)
+    shed: Dict[str, int] = field(default_factory=dict)
+    overload_level_max: int = 0
+    claim_waves: int = 0
+    #: claim waves run with the rung-4 gate closed (latency-only):
+    #: waves non-latency work could not have been claimed in, so the
+    #: anti-starvation bound adds them on top of the aging span
+    waves_frozen: int = 0
+    starvation_max_waves: int = 0
 
     def to_json(self) -> dict:
         return dict(self.__dict__)
@@ -294,6 +328,17 @@ def run_sim(cfg: SimConfig) -> SimReport:
             kw["sched_sample"] = cfg.sched_sample
         if cfg.disagg_min_prompt is not None:
             kw["disagg_min_prompt"] = cfg.disagg_min_prompt
+        if cfg.admit_rate:
+            kw["admit_rate"] = cfg.admit_rate
+            kw["admit_burst"] = cfg.admit_burst
+        if cfg.admit_max_pending:
+            kw["admit_max_pending"] = cfg.admit_max_pending
+        if cfg.overload:
+            # queue-only ladder (burn threshold 0): deterministic on
+            # the virtual queue series; swept from the health cadence
+            kw["overload_burn"] = 0.0
+            kw["overload_queue"] = cfg.overload_queue
+            kw["overload_hold_s"] = cfg.overload_hold_s
         m = SimMaster(fleet, vc, health_interval=cfg.health_interval_s,
                       **kw)
         # register the fleet: active rows with the health body as the
@@ -325,7 +370,12 @@ def run_sim(cfg: SimConfig) -> SimReport:
         if arrivals is None:
             arrivals = synthetic_arrivals(
                 cfg.arrival, cfg.requests, cfg.duration_s, seed=cfg.seed)
-        engine = _Engine(m, fleet, vc, InvariantChecker(m))
+        if cfg.slo_mix:
+            classes = ("latency", "throughput", "batch")
+            for i, a in enumerate(arrivals):
+                a.setdefault("slo_class", classes[i % 3])
+                a.setdefault("tenant", f"t{i % 4}")
+        engine = _Engine(m, fleet, vc, InvariantChecker(m), cfg)
         wall0 = _time.perf_counter()
         engine.run(arrivals, base, cfg.drain_s)
         wall = _time.perf_counter() - wall0
@@ -343,6 +393,11 @@ def run_sim(cfg: SimConfig) -> SimReport:
             "slots_per_node": cfg.slots_per_node,
             "model_source": dict(cfg.model.source),
             "fail_nodes": list(cfg.fail_nodes),
+            "slo_mix": cfg.slo_mix, "admit_rate": cfg.admit_rate,
+            "admit_max_pending": cfg.admit_max_pending,
+            "overload": cfg.overload,
+            "overload_queue": cfg.overload_queue,
+            "claim_interval_s": cfg.claim_interval_s,
         })
         rep.requests = len(arrivals)
         rep.completed = counts.get("completed", 0)
@@ -370,7 +425,18 @@ def run_sim(cfg: SimConfig) -> SimReport:
                 engine.within_slo / rep.sim_s, 3)
         rep.metrics = {k: v for k, v in sorted(c.items())
                        if k.startswith(("requests_", "scheduler_",
-                                        "breaker_", "slo_"))}
+                                        "breaker_", "slo_", "admit_",
+                                        "shed_"))}
+        rep.rejected = engine.rejected
+        rep.rejected_by_reason = dict(sorted(
+            engine.rejected_by_reason.items()))
+        rep.shed = {k[len("shed_"):]: int(v)
+                    for k, v in sorted(c.items())
+                    if k.startswith("shed_") and v}
+        rep.overload_level_max = engine.overload_level_max
+        rep.claim_waves = engine.claim_waves
+        rep.waves_frozen = engine.waves_frozen
+        rep.starvation_max_waves = engine.starvation_max_waves
         rep.breaker = {
             "opened": int(c.get("breaker_opened", 0)),
             "half_opened": int(c.get("breaker_half_opened", 0)),
@@ -390,7 +456,7 @@ class _Engine:
     """The heapq event loop. One instance per run."""
 
     def __init__(self, m: SimMaster, fleet: SyntheticFleet, vc,
-                 inv: InvariantChecker):
+                 inv: InvariantChecker, cfg: SimConfig):
         self.m = m
         self.fleet = fleet
         self.vc = vc
@@ -403,6 +469,17 @@ class _Engine:
         self.queue_samples: List[int] = []
         self.within_slo = 0
         self._slo_targets = tsdb_mod.slo_targets()
+        # overload front door (SimConfig doc): claim-wave accounting
+        # for the anti-starvation bound + honest-refusal bookkeeping
+        self._overload = cfg.overload
+        self._claim_interval = cfg.claim_interval_s
+        self.rejected = 0
+        self.rejected_by_reason: Dict[str, int] = {}
+        self.overload_level_max = 0
+        self.claim_waves = 0
+        self.waves_frozen = 0
+        self.starvation_max_waves = 0
+        self._submit_wave: Dict[int, int] = {}
         # active-node snapshot cache: the real dispatcher re-queries
         # per wave, but its rows only change when something writes the
         # nodes table — so the engine intercepts update_node and
@@ -474,26 +551,68 @@ class _Engine:
 
     def _on_arrive(self, i: int, a: dict):
         prompt = f"req{i:06d}:" + "x" * max(0, a["prompt_chars"] - 10)
-        resp = self.m.api_submit({
-            "model_name": a["model"], "prompt": prompt,
-            "max_new_tokens": a["max_new_tokens"],
-            "sampling": {"do_sample": False}})
-        if isinstance(resp, tuple) or resp.get("status") != "success":
+        body = {"model_name": a["model"], "prompt": prompt,
+                "max_new_tokens": a["max_new_tokens"],
+                "sampling": {"do_sample": False}}
+        if a.get("slo_class"):
+            body["slo_class"] = a["slo_class"]
+        if a.get("tenant"):
+            body["tenant"] = a["tenant"]
+        resp = self.m.api_submit(body)
+        if isinstance(resp, tuple):
+            if resp[0] == 429:
+                # an honest admission refusal is a legitimate outcome,
+                # not a violation — UNLESS it forgot the Retry-After
+                # contract (the client could never back off honestly)
+                headers = resp[2] if len(resp) > 2 else {}
+                if not (headers or {}).get("Retry-After"):
+                    self.inv._flag("reject-without-retry-after",
+                                   arrival=i, resp=repr(resp))
+                self.rejected += 1
+                reason = (resp[1] or {}).get("reason", "?")
+                self.rejected_by_reason[reason] = \
+                    self.rejected_by_reason.get(reason, 0) + 1
+                return
             self.inv._flag("submit-rejected", arrival=i, resp=repr(resp))
             return
-        self._sched_dispatch(self.vc.now())
+        if resp.get("status") != "success":
+            self.inv._flag("submit-rejected", arrival=i, resp=repr(resp))
+            return
+        self._submit_wave[resp["request_id"]] = self.claim_waves
+        # claim-interval mode paces the waves: an arrival must not pull
+        # a wave forward (that would drain the queue per-arrival and no
+        # backlog could ever form), it only ensures the NEXT wave is
+        # scheduled. Legacy mode keeps the immediate dispatch.
+        self._sched_dispatch(self.vc.now() + self._claim_interval)
 
     def _dispatch_pass(self):
         m = self.m
         m.store.flush()
         parked = False
         while True:
-            reqs = m.store.claim_next_pending_many(m.dispatch_batch)
+            mp = m._claim_max_priority()
+            reqs = m.store.claim_next_pending_many(
+                m.dispatch_batch, max_priority=mp)
             if not reqs:
                 break
+            # wave accounting: starvation_max_waves is the most claim
+            # waves any admitted request sat pending before one took it
+            # — the bound the aging claim order must keep
+            self.claim_waves += 1
+            if mp is not None:
+                self.waves_frozen += 1
             for req in reqs:
+                waited = self.claim_waves - self._submit_wave.pop(
+                    req["id"], self.claim_waves)
+                if waited > self.starvation_max_waves:
+                    self.starvation_max_waves = waited
                 parked |= self._dispatch_one(req, self._active_nodes())
             m.store.flush()
+            if self._claim_interval > 0:
+                break   # one wave per dispatch event (SimConfig doc)
+        if self._claim_interval > 0 and \
+                m.store.counts().get("pending", 0):
+            self._sched_dispatch(self.vc.now() + self._claim_interval)
         if parked:
             # a park requeued with a future due time; failure paths
             # schedule their own follow-up, parks are detected here
@@ -634,3 +753,14 @@ class _Engine:
         pending = m.store.counts().get("pending", 0)
         m.metrics.gauge("queue_pending", pending)
         self.queue_samples.append(pending)
+        if self._overload:
+            # the ladder walks on the health cadence (the real
+            # _overload_loop is a thread; the sim drives the same
+            # sweep at deterministic instants)
+            m._overload_sweep()
+            if m._overload_level > self.overload_level_max:
+                self.overload_level_max = m._overload_level
+            if pending:
+                # a rung change can unfreeze claims (e.g. 4 -> 3
+                # reopens non-latency work): make sure a wave runs
+                self._sched_dispatch(self.vc.now())

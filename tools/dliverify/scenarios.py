@@ -622,10 +622,140 @@ class LeaseTakeover(Scenario):
         return None
 
 
+class ShedVsSubmit(Scenario):
+    """A submit races the overload ladder's shed-threshold crossing
+    (``_overload_sweep`` escalating 0 -> 1 sheds batch-class work): in
+    EVERY interleaving the submit is either admitted — its row exists,
+    pending, owed an answer — or refused with an honest 429 carrying a
+    Retry-After header and NO row. The hazard either way is a lie at
+    the front door: admitted-then-dropped (row missing after a success
+    ack) or refused-but-enqueued (a 429'd client retries into a
+    duplicate). Pressure signals are pinned (no TSDB/wall-clock reads
+    on a registered thread — determinism rules) and the queue-only
+    ladder (burn threshold 0) with hold 0 makes the sweep's one rung
+    step unconditional."""
+
+    name = "shed_vs_submit"
+    description = ("submit racing a shed crossing is admitted-and-owed "
+                   "or honestly 429'd — never silently dropped")
+    invariants = ("shed_honest",)
+    threads = 2
+
+    def build(self, sched):
+        m = _fresh_master(overload_burn=0.0, overload_queue=1.0,
+                          overload_hold_s=0.0)
+        _swap_sync_store(m)
+        m._overload_signals = lambda: (None, 10.0)
+        ctx = types.SimpleNamespace(master=m, resp=[], sched=sched)
+
+        def submitter():
+            r = m.api_submit({"model_name": "m", "prompt": "p",
+                              "slo_class": "batch"})
+            ctx.resp.append(r)
+            sched.mark("submit -> "
+                       f"{r[0] if isinstance(r, tuple) else 'admitted'}")
+
+        def shedder():
+            m._overload_sweep()
+            sched.mark(f"ladder at level {m._overload_level}")
+
+        sched.spawn("submitter", submitter)
+        sched.spawn("shedder", shedder)
+        return ctx
+
+    def check_final(self, ctx) -> Bad:
+        if not ctx.resp:
+            return ("shed_honest", "submit thread never resolved")
+        r = ctx.resp[0]
+        if isinstance(r, tuple):
+            if r[0] != 429:
+                return ("shed_honest",
+                        f"refusal status {r[0]} (want an honest 429)")
+            if len(r) < 3 or "Retry-After" not in (r[2] or {}):
+                return ("shed_honest",
+                        "429 without a Retry-After header — the client "
+                        "cannot back off honestly")
+            if ctx.master.store.recent_requests(10):
+                return ("shed_honest",
+                        "a 429'd submit still enqueued a row — the "
+                        "refused client's retry would duplicate it")
+            return None
+        rid = r.get("request_id")
+        row = ctx.master.store.get_request(rid) if rid else None
+        if row is None:
+            return ("shed_honest",
+                    f"success ack for request {rid} but no row exists "
+                    "— admitted-and-dropped")
+        if row["status"] != "pending":
+            return ("shed_honest",
+                    f"admitted request {rid} is {row['status']!r} with "
+                    "no dispatcher running — the shed touched an "
+                    "admitted row")
+        return None
+
+
+class PriorityAging(Scenario):
+    """Two dispatchers claim one request each from three pending rows
+    (latency / throughput / batch) where the batch row has waited past
+    the full priority span (>= 2 x DLI_SCHED_AGING_S): its aged
+    effective priority now outranks every fresh submit, so it MUST be
+    among the claimed set — the deadline-style-aging anti-starvation
+    bound the claim ORDER BY encodes (state.py _SLO_PRIORITY_SQL)."""
+
+    name = "priority_aging"
+    description = ("an aged batch request outranks fresh latency work "
+                   "(deadline-style aging anti-starvation)")
+    invariants = ("no_starvation", "single_claim")
+    threads = 2
+
+    def build(self, sched):
+        from distributed_llm_inferencing_tpu.runtime import state
+        s = _fresh_store()
+        s.submit_request("m", "p-lat", slo_class="latency")
+        s.submit_request("m", "p-thr", slo_class="throughput")
+        old = s.submit_request("m", "p-old", slo_class="batch")
+        # backdate the batch row well past 2x the aging constant (the
+        # point where no later submit can sort ahead of it) — direct
+        # SQL because created_at is claim-visible state, not API state
+        with s._lock, s._db:
+            s._db.execute(
+                "UPDATE requests SET created_at=created_at-? WHERE id=?",
+                (10 * max(state.CLAIM_AGING_S, 1.0), old))
+        ctx = types.SimpleNamespace(store=s, old=old, claims={},
+                                    sched=sched)
+
+        def dispatcher(idx):
+            got = s.claim_next_pending_many(1)
+            ctx.claims[idx] = [r["id"] for r in got]
+            sched.mark(f"claimed {[r['id'] for r in got]}")
+
+        sched.spawn("disp-1", dispatcher, 1)
+        sched.spawn("disp-2", dispatcher, 2)
+        return ctx
+
+    def check_final(self, ctx) -> Bad:
+        from distributed_llm_inferencing_tpu.runtime import state
+        a = ctx.claims.get(1, [])
+        b = ctx.claims.get(2, [])
+        if set(a) & set(b):
+            return ("single_claim",
+                    f"rows {sorted(set(a) & set(b))} claimed by BOTH "
+                    f"dispatchers (claims: {a} / {b})")
+        if state.CLAIM_AGING_S <= 0:
+            return None     # aging disabled by env — bound not claimed
+        claimed = a + b
+        if len(claimed) == 2 and ctx.old not in claimed:
+            return ("no_starvation",
+                    f"aged batch request {ctx.old} passed over by both "
+                    f"claims ({claimed}) although it outranks every "
+                    "fresh row after 2x DLI_SCHED_AGING_S")
+        return None
+
+
 SCENARIOS = {s.name: s for s in (
     BreakerHalfOpenProbe(), RequeueExclusion(), IdemTagRace(),
     DrainNoStrand(), ClaimOnce(), TerminalOnce(), MigrateVsComplete(),
-    LeaseTakeover())}
+    LeaseTakeover(), ShedVsSubmit(), PriorityAging())}
 
 # which scenario proves which re-armed historical bug (the mutation
 # gate): utils/faults.py MUTATIONS -> scenario name
