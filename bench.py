@@ -1559,6 +1559,250 @@ def _rebalance_scenario(argv, opt, smoke):
     return 0
 
 
+def _plan_workers(delay_s):
+    """Heterogeneous 3-worker fleet for the planner scenario: three
+    identical in-proc tiny-llama workers, one throttled via a
+    server-side latency fault on its /inference point — the same
+    injection surface the chaos gates use, so the slowdown is visible
+    exactly where the planner must see it (the master's latency EWMA
+    and the node's tok/s TSDB series), not hardcoded into the model."""
+    workers = _rebalance_workers(("mixed", "mixed", "mixed"))
+    agent0, _ = workers[0]
+    agent0.service.faults.arm(
+        [{"point": "/inference", "mode": "latency", "delay_s": delay_s}],
+        seed=0, replace=True)
+    return workers
+
+
+def bench_plan_hetero(planned, workers, delay_s, n=36, clients=4,
+                      ramp=12, max_new=24, bound_s=None):
+    """One leg of the planner A/B on the live heterogeneous fleet.
+
+    ``planned=False`` is the naive-uniform baseline: every node serves
+    mixed, the scheduler spreads work across all three — closed-loop
+    clients that land on the throttled worker sit out its injected
+    delay, wasting concurrency the fast nodes never see.
+    ``planned=True`` asks ``POST /api/plans/auto`` for a decision after
+    the warmup ramp has taught the master its EWMAs/TSDB rates, then
+    lets the rebalancer steer roles to the planner's target (the
+    throttled node quarantined into the strict prefill pool, out of
+    the short-prompt dispatch path).
+
+    Goodput = measured requests completing within ``bound_s`` / wall.
+    The bound is derived from the leg's own ramp when not given (p25
+    of ramp e2e — a fast-node service time — plus half the injected
+    delay): fast completions clear it, throttled ones cannot."""
+    import threading as _th
+    import requests as _rq
+    from distributed_llm_inferencing_tpu.runtime.master import Master
+
+    m = Master(":memory:", health_interval=0.5, rebalance=planned,
+               rebalance_interval_s=0.3, rebalance_sustain_s=0.8,
+               rebalance_ratio=2.0, tsdb_step_s=0.3)
+    msrv = m.service.serve("127.0.0.1", 0, background=True)
+    base = f"http://127.0.0.1:{msrv.server_address[1]}"
+    try:
+        for i, (_, wport) in enumerate(workers):
+            r = _rq.post(f"{base}/api/nodes/add", json={
+                "name": f"w{i}", "host": "127.0.0.1",
+                "port": wport}).json()
+            assert r["status"] == "success", r
+        m.start_background()
+        time.sleep(1.2)          # one health sweep: roles fresh
+        done, failed, lock = [], [], _th.Lock()
+
+        def run_one(sess, i, sink=None):
+            body = {"model_name": _REBAL_MODEL,
+                    "prompt": _disagg_prompt_short(3000 + i),
+                    "max_new_tokens": max_new,
+                    "sampling": {"do_sample": False,
+                                 "allow_random_init": True}}
+            t0 = time.time()
+            rid = sess.post(f"{base}/api/inference/submit",
+                            json=body).json()["request_id"]
+            poll = 0.02
+            while True:
+                st = sess.get(f"{base}/api/inference/status/{rid}"
+                              ).json()["request"]
+                if st["status"] in ("completed", "failed"):
+                    el = time.time() - t0
+                    if sink is not None:
+                        with lock:
+                            sink.append((st["status"], el))
+                    return
+                time.sleep(poll)
+                poll = min(0.2, poll * 1.5)
+
+        def wave(count, sink):
+            nxt = [0]
+
+            def client():
+                sess = _rq.Session()
+                while True:
+                    with lock:
+                        if nxt[0] >= count:
+                            return
+                        i = nxt[0]
+                        nxt[0] += 1
+                    run_one(sess, i, sink)
+
+            ts = [_th.Thread(target=client) for _ in range(clients)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=900)
+
+        ramp_rows = []
+        wave(ramp, ramp_rows)    # untimed: teaches EWMAs + TSDB rates
+        if bound_s is None:
+            els = sorted(el for _, el in ramp_rows) or [0.5]
+            bound_s = els[len(els) // 4] * 2.0 + delay_s * 0.5
+        decision = None
+        if planned:
+            time.sleep(1.0)      # a few TSDB steps past the ramp
+            # the quarantine signal (the throttled node's latency EWMA
+            # crossing the SLO bound) can lag the ramp when its last
+            # throttled completion raced the telemetry sweep; the
+            # search is deterministic on settled inputs, so give the
+            # signal a bounded window to land before measuring
+            for attempt in range(3):
+                r = _rq.post(f"{base}/api/plans/auto", json={
+                    "model_name": _REBAL_MODEL,
+                    "est_prompt_tokens": 8,
+                    "est_decode_tokens": max_new,
+                    "slo_e2e_ms": bound_s * 1e3,
+                    "force": attempt > 0}).json()
+                assert r.get("status") == "success", r
+                decision = r["decision"]
+                if (decision.get("chosen") or {}).get("prefill_nodes"):
+                    break
+                time.sleep(2.0)
+            # the rebalancer steers toward the planner's target split;
+            # wait for the quarantine flip to land before measuring
+            deadline = time.time() + 15.0
+            while time.time() < deadline:
+                st = _rq.get(f"{base}/api/nodes/status").json()["nodes"]
+                if any(nd.get("role") == "prefill" for nd in st):
+                    break
+                time.sleep(0.25)
+        rows = []
+        t0 = time.time()
+        wave(n, rows)
+        wall = time.time() - t0
+        completed = [el for s2, el in rows if s2 == "completed"]
+        within = sum(1 for el in completed if el <= bound_s)
+        roles = {nd["name"]: nd.get("role")
+                 for nd in _rq.get(f"{base}/api/nodes/status"
+                                   ).json()["nodes"]}
+        leg = {
+            "mode": "planned" if planned else "naive-uniform",
+            "requests": n, "ramp": ramp, "clients": clients,
+            "completed": len(completed),
+            "failed": len(rows) - len(completed),
+            "wall_s": round(wall, 2),
+            "bound_s": round(bound_s, 3),
+            "within_bound": within,
+            "goodput_req_s": round(within / max(wall, 1e-6), 2),
+            "req_per_s": round(len(completed) / max(wall, 1e-6), 2),
+            "roles": roles,
+        }
+        if decision is not None:
+            chosen = decision.get("chosen") or {}
+            leg["planner"] = {
+                "plan_id": decision.get("plan_id"),
+                "mesh": chosen.get("mesh"),
+                "role_split": chosen.get("role_split"),
+                "prefill_nodes": chosen.get("prefill_nodes"),
+                "score_goodput_req_s":
+                    chosen.get("score_goodput_req_s"),
+                "candidates": decision.get("candidates"),
+                "scored": decision.get("scored"),
+                # the fitted classes (rates, latencies) explain WHY the
+                # split was chosen — keep them in the CI artifact
+                "classes": (decision.get("inputs") or {}).get("classes"),
+            }
+        return leg
+    finally:
+        m.stop()
+
+
+def _plan_scenario(argv, opt, smoke):
+    """--scenario plan [--smoke|--ab]: heterogeneity-aware planner on a
+    live fleet — three workers, one throttled by an injected /inference
+    latency fault. The A/B runs naive-uniform first (also calibrating
+    the shared within-bound SLO from its ramp), then the planner leg,
+    gating planner goodput >= 1.15x naive (DLI_BENCH_PLAN_MIN_X) with
+    zero failures on both legs. The smoke runs the planner leg only
+    and gates the full decision->steering path: a persisted decision,
+    the throttled worker steered into the prefill pool, zero failures.
+    Writes /tmp/dli_bench_plan.json for the CI artifact."""
+    # 6s ≈ 60x a fast-node service time: deep enough that requests
+    # landing on the throttled worker bust the SLO bound AND strand a
+    # closed-loop client, which is the regime where quarantining it
+    # (what the planner chooses) measurably beats keeping its capacity
+    delay_s = opt("--delay", 6.0, float)
+    if smoke:
+        n, ramp = opt("--requests", 10), 8
+    else:
+        n, ramp = opt("--requests", 36), opt("--ramp", 12)
+    clients = opt("--clients", 4)
+    result = {"scenario": "plan", "smoke": smoke, "delay_s": delay_s}
+    workers = _plan_workers(delay_s)
+    try:
+        if "--ab" in argv:
+            naive = bench_plan_hetero(False, workers, delay_s, n=n,
+                                      clients=clients, ramp=ramp)
+            planned = bench_plan_hetero(True, workers, delay_s, n=n,
+                                        clients=clients, ramp=ramp,
+                                        bound_s=naive["bound_s"])
+            result.update(naive=naive, planned=planned)
+            result["planned_vs_naive_x"] = round(
+                planned["goodput_req_s"]
+                / max(naive["goodput_req_s"], 1e-6), 3)
+            min_x = float(os.environ.get("DLI_BENCH_PLAN_MIN_X", "1.15"))
+            result["min_x"] = min_x
+            ok = (naive["failed"] == 0 and planned["failed"] == 0
+                  and naive["completed"] == n
+                  and planned["completed"] == n
+                  and planned.get("planner") is not None
+                  and result["planned_vs_naive_x"] >= min_x)
+        else:
+            planned = bench_plan_hetero(True, workers, delay_s, n=n,
+                                        clients=clients, ramp=ramp)
+            result.update(planned=planned)
+            pl = planned.get("planner") or {}
+            ok = (planned["failed"] == 0
+                  and planned["completed"] == n
+                  and pl.get("plan_id") is not None
+                  and "prefill" in planned["roles"].values())
+    finally:
+        for agent, _ in workers:
+            agent.service.shutdown()
+    print(json.dumps(result))
+    try:
+        with open("/tmp/dli_bench_plan.json", "w") as f:
+            json.dump(result, f, indent=1)
+    except OSError:
+        pass
+    if not ok:
+        print("plan gate FAILED", file=sys.stderr)
+        return 1
+    if "--ab" in argv:
+        print(f"plan A/B ok: planner {result['planned_vs_naive_x']}x "
+              f"naive-uniform goodput "
+              f"({result['planned']['goodput_req_s']} vs "
+              f"{result['naive']['goodput_req_s']} req/s within "
+              f"{result['naive']['bound_s']}s), 0 failures both legs",
+              file=sys.stderr)
+    else:
+        print(f"plan smoke ok: plan {planned['planner']['plan_id']} "
+              f"chosen ({planned['planner']['scored']} candidates "
+              f"scored), throttled worker steered to prefill, "
+              f"goodput {planned['goodput_req_s']} req/s, 0 failures",
+              file=sys.stderr)
+    return 0
+
+
 def _free_port():
     from distributed_llm_inferencing_tpu.utils.platform import free_port
     return free_port()
@@ -2738,7 +2982,8 @@ def _overload_scenario(argv, opt, smoke):
 
 
 def _scenario_main(argv):
-    """`bench.py --scenario {control_plane|prefix_cache|decode_speed|disagg}
+    """`bench.py --scenario {control_plane|prefix_cache|decode_speed|disagg
+    |rebalance|plan|ha|overload|sim_scale|sim_calibrate}
     [--smoke|--ab] [--requests N] [--concurrency C] [--workers W]` —
     standalone scenario entry, one JSON line on stdout, nonzero rc on
     smoke/gate failure."""
@@ -2784,6 +3029,15 @@ def _scenario_main(argv):
         except Exception:
             pass
         return _rebalance_scenario(argv, opt, "--smoke" in argv)
+    if name == "plan":
+        # planner A/B spins fresh worker sets: warm compiles
+        try:
+            from distributed_llm_inferencing_tpu.utils.platform import (
+                enable_compilation_cache)
+            enable_compilation_cache()
+        except Exception:
+            pass
+        return _plan_scenario(argv, opt, "--smoke" in argv)
     if name == "ha":
         # replicated control plane: kill-the-leader chaos gate
         try:

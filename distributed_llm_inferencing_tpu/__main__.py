@@ -62,8 +62,10 @@ def main(argv=None):
     p = sub.add_parser("plan", help="compute a placement plan "
                                     "(shard_model equivalent)")
     p.add_argument("--model_name", required=True)
-    p.add_argument("--mesh", default="tp=1",
-                   help="e.g. 'tp=4,dp=2' or 'pp=4'")
+    p.add_argument("--mesh", default=None,
+                   help="e.g. 'tp=4,dp=2' or 'pp=4'; omit to let the "
+                        "auto-parallelism planner search this host's "
+                        "devices (docs/architecture.md)")
     p.add_argument("--max_seq", type=int, default=2048)
     p.add_argument("--batch", type=int, default=1)
 
@@ -171,10 +173,42 @@ def main(argv=None):
         from distributed_llm_inferencing_tpu.runtime.master import Master
         Master(args.db).serve(args.host, args.port)
     elif args.cmd == "plan":
-        from distributed_llm_inferencing_tpu.parallel.plan import make_plan
-        mesh = dict(kv.split("=") for kv in args.mesh.split(",") if kv)
-        plan = make_plan(args.model_name, mesh, max_seq=args.max_seq,
-                         batch=args.batch)
+        if args.mesh:
+            from distributed_llm_inferencing_tpu.parallel.plan import \
+                make_plan
+            mesh = dict(kv.split("=") for kv in args.mesh.split(",")
+                        if kv)
+            plan = make_plan(args.model_name, mesh, max_seq=args.max_seq,
+                             batch=args.batch)
+        else:
+            # no explicit mesh: the auto-parallelism planner searches
+            # this host's device inventory (one node class — the
+            # fleet-wide search needs the master's measured views and
+            # lives behind POST /api/plans/auto)
+            import jax
+            from distributed_llm_inferencing_tpu.parallel import planner
+            devs = []
+            for d in jax.devices():
+                entry = {"kind": getattr(d, "device_kind", d.platform)}
+                try:
+                    ms = d.memory_stats()
+                    if ms:
+                        entry["memory_bytes"] = ms.get("bytes_limit")
+                except Exception:
+                    pass
+                devs.append(entry)
+            classes = planner.fit_node_classes(
+                [{"id": 0, "devices": devs}])
+            decision = planner.search(
+                args.model_name, classes,
+                max_seq=args.max_seq, batch=args.batch)
+            if not decision.get("chosen"):
+                print(json.dumps(decision), file=sys.stderr)
+                sys.exit(1)
+            plan = dict(decision["chosen"]["plan"],
+                        planner={"mesh": decision["chosen"]["mesh"],
+                                 "candidates": decision["candidates"],
+                                 "scored": decision["scored"]})
         json.dump(plan, sys.stdout, indent=2)
         print()
     elif args.cmd == "admin":

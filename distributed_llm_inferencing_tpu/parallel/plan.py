@@ -98,3 +98,27 @@ def make_plan(model: str | ModelConfig, mesh: Dict[str, int] | MeshSpec,
 
 def plan_to_json(plan: Dict[str, Any]) -> str:
     return json.dumps(plan, indent=2)
+
+
+#: every key make_plan emits — plan_from_json refuses a payload missing
+#: any of them, so a persisted planner decision either reloads to a
+#: deployable plan or fails loudly at load time, not at /load_shard
+PLAN_KEYS = frozenset((
+    "model", "mesh", "num_devices", "param_bytes_total",
+    "param_bytes_per_device", "kv_cache_bytes_per_device",
+    "hbm_per_device_estimate", "max_seq", "batch", "partition_specs"))
+
+
+def plan_from_json(text: str) -> Dict[str, Any]:
+    """Inverse of :func:`plan_to_json`, schema-checked. Round-trips
+    bitwise: ``plan_to_json(plan_from_json(plan_to_json(p))) ==
+    plan_to_json(p)`` for every plan ``make_plan`` can produce (JSON
+    objects preserve key order, and the values are plain ints/strings/
+    lists — tests/test_planner.py proves it over the whole registry)."""
+    plan = json.loads(text)
+    if not isinstance(plan, dict):
+        raise ValueError("plan JSON must be an object")
+    missing = PLAN_KEYS - set(plan)
+    if missing:
+        raise ValueError(f"plan JSON missing keys: {sorted(missing)}")
+    return plan

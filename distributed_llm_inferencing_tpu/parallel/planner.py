@@ -1,0 +1,438 @@
+"""Heterogeneity-aware auto-parallelism planner (ROADMAP item 2).
+
+Plan choice used to be manual: a human picked the mesh shape and the
+prefill/decode role split per deployment. This module closes the loop
+from the measured data the cluster already collects — the per-model
+prefill ms-per-uncached-token EWMAs the master learns from its cost
+ledger, per-node ``dli_tokens_generated_total`` rate series in the
+TSDB, the ``dli_decode_tokens_per_weight_pass`` gauge, and the device
+inventory workers report on ``/health`` — to an analytic cost model
+(AMP, arxiv 2210.07297) plus a bounded candidate search:
+
+- :func:`fit_node_classes` groups a mixed fleet into *node classes*
+  (device kind × count × memory × measured-rate bucket) so a fast host
+  and a throttled host are priced separately, not as a fleet average.
+- :func:`score_candidate` prices one (mesh shape × role split)
+  candidate: prefill throughput from the learned EWMA, decode step
+  rate from the measured tok/s, a GPipe bubble term ``(mb+pp-1)/mb``
+  and a per-way collective-efficiency term for tp×sp — the two levers
+  the pjit/TPUv4 experience (arxiv 2204.06514) shows decide whether a
+  sharded model runs at hardware speed.
+- :func:`search` enumerates candidates under memory feasibility
+  (``make_plan``'s per-device weight + KV bytes vs the class's
+  reported device memory), scores them, and emits a ranked decision
+  record carrying the actual inputs that drove it — the
+  ``_plan_disagg`` flight-recorder discipline, so the choice is
+  reconstructable from ``/api/events`` alone.
+
+The module imports neither jax nor the runtime at import time: mesh
+validation and ``make_plan`` (which need jax) load lazily inside
+:func:`enumerate_meshes`, so the master's control plane can import the
+planner the way it already imports ``make_plan`` — per call.
+
+Modeling notes (deliberate simplifications, all recorded in the
+decision): measured ``decode_tok_s`` is treated as the class's
+one-device serving rate; tensor parallelism scales it by ``tp`` times
+the collective efficiency; requests served by a class whose estimated
+latency violates the SLO bound count zero goodput AND waste dispatch
+concurrency proportional to their capacity share — which is why
+quarantining a pathologically slow class into the (idle) prefill pool
+can beat keeping it in the serving path even though raw capacity
+drops. The dlisim planner sweep measures exactly that trade.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+# ---- knobs (docs/serving.md; registered in utils/knobs.py) ------------
+
+#: master-side master switch: `0` keeps every auto-plan surface inert
+#: (explicit plans and the divergence rebalancer behave as before)
+PLANNER_ENABLE = (os.environ.get("DLI_PLANNER_ENABLE", "1").lower()
+                  not in ("0", "false", "no"))
+#: search budget: max candidates score_candidate prices per search
+PLANNER_BUDGET = int(os.environ.get("DLI_PLANNER_BUDGET", "128"))
+#: sim-agreement tolerance: the dlisim sweep asserts the planner's top
+#: choice reaches >= (1 - tolerance) of the sim-measured best goodput
+PLANNER_TOLERANCE = float(os.environ.get("DLI_PLANNER_TOLERANCE", "0.25"))
+
+DECISION_VERSION = 1
+
+#: priors used when a class has no measured rate yet — the same decode
+#: step cost tools/dlisim's DEFAULT_MODEL carries (18 ms/token), so an
+#: unmeasured fleet prices like the simulator's synthetic one
+PRIOR_DECODE_TOK_S = 1000.0 / 18.0
+PRIOR_PREFILL_MS_PER_TOK = 0.35
+
+
+@dataclasses.dataclass(frozen=True)
+class NodeClass:
+    """One equivalence class of a mixed fleet: same reported hardware
+    shape and the same measured-throughput bucket."""
+
+    key: str
+    kind: str
+    device_count: int
+    memory_bytes: int            # per device; 0 = unknown
+    node_ids: Tuple[int, ...]
+    decode_tok_s: float          # measured per-node generated-token rate
+    latency_ms: Optional[float]  # master-observed e2e EWMA (median)
+    measured: bool               # False = priors, nothing measured yet
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["node_ids"] = list(self.node_ids)
+        return d
+
+
+@dataclasses.dataclass(frozen=True)
+class CostInputs:
+    """The workload shape + learned rates one search prices against."""
+
+    est_prompt_tokens: int = 512
+    est_decode_tokens: int = 128
+    prefill_ms_per_tok: float = PRIOR_PREFILL_MS_PER_TOK
+    decode_tokens_per_weight_pass: float = 1.0
+    #: fractional collective overhead per extra tp×sp way (0 = perfect
+    #: scaling — the monotonicity property tests pin it there)
+    coll_overhead_per_way: float = 0.02
+    #: microbatches the pipeline bubble amortizes over
+    bubble_microbatches: int = 8
+    #: SLO bounds; None disables the violation/goodput accounting
+    slo_e2e_ms: Optional[float] = None
+    slo_itl_ms: Optional[float] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+def _rate_bucket(v: float) -> int:
+    return int(round(math.log2(max(v, 1e-6))))
+
+
+def _median(vals: Sequence[float]) -> Optional[float]:
+    vals = sorted(vals)
+    if not vals:
+        return None
+    return vals[len(vals) // 2]
+
+
+def fit_node_classes(views: Iterable[dict]) -> List[NodeClass]:
+    """Group per-node observations into :class:`NodeClass` rows.
+
+    Each view is one node's planner-relevant state::
+
+        {"id": 3, "devices": [{"kind": "TPU v4", "memory_bytes": N}],
+         "decode_tok_s": 37.2,          # tokens_generated rate, or None
+         "latency_ms": 210.0}           # master e2e EWMA, or None
+
+    The class key folds in a log2 bucket of the measured rate (and of
+    the latency EWMA) so two hosts with identical inventories but a 4x
+    throughput gap — a throttled worker, a thermally limited host —
+    land in different classes. Unmeasured nodes fall back to priors
+    and share one bucket per hardware shape.
+    """
+    groups: Dict[tuple, List[dict]] = {}
+    for v in views:
+        devs = v.get("devices") or []
+        kind = str(devs[0].get("kind", "unknown")) if devs else "unknown"
+        count = len(devs) or 1
+        mem = max((int(d.get("memory_bytes") or 0) for d in devs),
+                  default=0)
+        rate = v.get("decode_tok_s")
+        lat = v.get("latency_ms")
+        key = (kind, count,
+               _rate_bucket(mem) if mem else -1,
+               _rate_bucket(rate) if rate else None,
+               _rate_bucket(lat) if lat else None)
+        groups.setdefault(key, []).append(
+            dict(v, _kind=kind, _count=count, _mem=mem))
+    out = []
+    used: Dict[str, int] = {}
+    for key in sorted(groups, key=repr):
+        members = groups[key]
+        rates = [m["decode_tok_s"] for m in members
+                 if m.get("decode_tok_s")]
+        lats = [m["latency_ms"] for m in members if m.get("latency_ms")]
+        rate = _median(rates)
+        lat = _median(lats)
+        kind, count = members[0]["_kind"], members[0]["_count"]
+        label = f"{kind} x{count}"
+        if rate is not None:
+            label += f" ~{rate:.1f}tok/s"
+        elif lat is not None:
+            label += f" ~{lat:.0f}ms"
+        # the label is the role_split dict's key: it MUST be unique per
+        # class (two latency buckets of identical hardware would
+        # otherwise collapse into one split entry)
+        used[label] = used.get(label, 0) + 1
+        if used[label] > 1:
+            label += f" #{used[label]}"
+        out.append(NodeClass(
+            key=label, kind=kind, device_count=count,
+            memory_bytes=members[0]["_mem"],
+            node_ids=tuple(sorted(int(m["id"]) for m in members)),
+            decode_tok_s=rate if rate is not None else PRIOR_DECODE_TOK_S,
+            latency_ms=_median(lats),
+            measured=rate is not None))
+    return out
+
+
+# ---- analytic cost model ----------------------------------------------
+
+def class_rates(mesh: Dict[str, int], klass: NodeClass,
+                inputs: CostInputs) -> Dict[str, float]:
+    """Per-NODE token rates of ``klass`` under ``mesh``.
+
+    ``replicas`` is how many model replicas the node's devices host
+    (0 = the mesh does not fit this class at all). The measured decode
+    rate is the class's one-device baseline; tp×sp divide per-token
+    work at ``eff`` collective efficiency, the pipeline runs at the
+    GPipe utilization ``mb / (mb + pp - 1)``, and dp replicas within
+    the mesh multiply throughput like extra replicas do.
+    """
+    n = 1
+    for a in ("dp", "pp", "sp", "tp", "ep"):
+        n *= int(mesh.get(a, 1))
+    replicas = klass.device_count // max(1, n)
+    if replicas <= 0:
+        return {"replicas": 0, "prefill_tok_s": 0.0, "decode_tok_s": 0.0,
+                "itl_ms": float("inf")}
+    intra = int(mesh.get("tp", 1)) * int(mesh.get("sp", 1))
+    eff = 1.0 / (1.0 + inputs.coll_overhead_per_way * (intra - 1))
+    pp = int(mesh.get("pp", 1))
+    mb = max(1, inputs.bubble_microbatches)
+    pipe = pp * mb / (mb + pp - 1)   # GPipe: pp stages, bubble-taxed
+    dp = int(mesh.get("dp", 1))
+    scale = intra * eff * pipe * dp * replicas
+    # scale the class prefill rate off the fleet-learned per-token EWMA,
+    # slowed in proportion to the class's measured decode gap (a
+    # throttled host is slow for prefill too)
+    slow = (PRIOR_DECODE_TOK_S / klass.decode_tok_s
+            if klass.measured and klass.decode_tok_s > 0 else 1.0)
+    prefill_ms = inputs.prefill_ms_per_tok * max(slow, 1e-3)
+    dtwp = (max(1.0, inputs.decode_tokens_per_weight_pass)
+            if not klass.measured else 1.0)
+    decode_tok_s = klass.decode_tok_s * dtwp * scale
+    # ITL is a PER-STREAM latency: tp×sp genuinely shrink the per-token
+    # step; dp/replicas/pp only add concurrent streams (a pipelined
+    # token still crosses every stage, a replica serves someone else)
+    stream_tok_s = klass.decode_tok_s * dtwp * intra * eff
+    return {
+        "replicas": replicas,
+        "prefill_tok_s": (1000.0 / prefill_ms) * scale,
+        "decode_tok_s": decode_tok_s,
+        "itl_ms": 1000.0 / stream_tok_s if stream_tok_s > 0
+        else float("inf"),
+    }
+
+
+def class_violates_slo(mesh: Dict[str, int], klass: NodeClass,
+                       inputs: CostInputs) -> bool:
+    """Would a request served end-to-end by this class miss the SLO?"""
+    r = class_rates(mesh, klass, inputs)
+    if r["replicas"] <= 0:
+        return True
+    if inputs.slo_itl_ms is not None and r["itl_ms"] > inputs.slo_itl_ms:
+        return True
+    if inputs.slo_e2e_ms is not None and klass.latency_ms is not None \
+            and klass.latency_ms > inputs.slo_e2e_ms:
+        return True
+    return False
+
+
+def score_candidate(mesh: Dict[str, int], split: Dict[str, int],
+                    classes: Sequence[NodeClass],
+                    inputs: CostInputs) -> Dict[str, Any]:
+    """Goodput estimate (requests/s) of one (mesh, role split).
+
+    ``split`` maps class key -> nodes of that class assigned the strict
+    prefill role; the rest serve mixed. A mixed node's request rate is
+    ``1 / (P/prefill_rate + D/decode_rate)`` (it must run both phases);
+    with a strict prefill pool, disagg-eligible prefill moves there —
+    modeled as the min of pool-capacity bounds when both pools exist.
+    Classes violating the SLO contribute zero goodput, and their share
+    of the serving path's capacity additionally scales goodput down:
+    finite client concurrency spent on a too-slow node is concurrency
+    the fast nodes never see.
+    """
+    P = max(1, inputs.est_prompt_tokens)
+    D = max(1, inputs.est_decode_tokens)
+    total_cap = good_cap = 0.0
+    prefill_pool_tok_s = 0.0
+    mixed_nodes = 0
+    for klass in classes:
+        r = class_rates(mesh, klass, inputs)
+        pre = min(len(klass.node_ids), max(0, split.get(klass.key, 0)))
+        mixed = len(klass.node_ids) - pre
+        prefill_pool_tok_s += pre * r["prefill_tok_s"]
+        if r["replicas"] <= 0 or mixed <= 0:
+            continue
+        mixed_nodes += mixed
+        per_node = 1.0 / (P / max(r["prefill_tok_s"], 1e-9)
+                          + D / max(r["decode_tok_s"], 1e-9))
+        cap = mixed * per_node
+        total_cap += cap
+        if not class_violates_slo(mesh, klass, inputs):
+            good_cap += cap
+    if mixed_nodes == 0 or total_cap <= 0:
+        # the decode pool never empties (every request needs a
+        # decode-capable node): all-prefill is not servable
+        return {"goodput_req_s": 0.0, "feasible": False,
+                "total_cap_req_s": 0.0, "prefill_pool_tok_s": round(
+                    prefill_pool_tok_s, 3)}
+    goodput = good_cap * (good_cap / total_cap)
+    return {"goodput_req_s": round(goodput, 6), "feasible": True,
+            "total_cap_req_s": round(total_cap, 6),
+            "prefill_pool_tok_s": round(prefill_pool_tok_s, 3)}
+
+
+# ---- candidate enumeration --------------------------------------------
+
+def _factor_assignments(n: int) -> List[Dict[str, int]]:
+    """All (dp, pp, sp, tp, ep) products equal to ``n``."""
+    out = []
+
+    def rec(axes, left, acc):
+        if not axes:
+            if left == 1:
+                out.append(dict(acc))
+            return
+        a = axes[0]
+        f = 1
+        while f <= left:
+            if left % f == 0:
+                acc[a] = f
+                rec(axes[1:], left // f, acc)
+            f += 1
+        acc.pop(axes[0], None)
+
+    rec(["dp", "pp", "sp", "tp", "ep"], n, {})
+    return out
+
+
+def enumerate_meshes(model_name: str, max_devices: int,
+                     max_seq: int = 2048, batch: int = 1,
+                     memory_bytes: int = 0) -> List[Dict[str, Any]]:
+    """Valid (mesh, plan) candidates for ``model_name`` on nodes with
+    ``max_devices`` devices of ``memory_bytes`` HBM each. Validity =
+    ``validate_spec`` accepts the shape AND the per-device footprint
+    fits (when the device memory is known). Imports jax lazily — this
+    is the one planner stage that needs real parameter shapes."""
+    from distributed_llm_inferencing_tpu.models.registry import get_config
+    from distributed_llm_inferencing_tpu.parallel.mesh import MeshSpec, \
+        validate_spec
+    from distributed_llm_inferencing_tpu.parallel.plan import make_plan
+    cfg = get_config(model_name)
+    out = []
+    for n in range(1, max(1, int(max_devices)) + 1):
+        if max_devices % n:
+            continue           # ragged replica packing wastes devices
+        for mesh in _factor_assignments(n):
+            spec = MeshSpec.from_dict(mesh)
+            try:
+                validate_spec(spec, cfg)
+            except (ValueError, NotImplementedError):
+                continue
+            plan = make_plan(cfg, spec, max_seq=max_seq, batch=batch)
+            if memory_bytes and plan["hbm_per_device_estimate"] > \
+                    memory_bytes:
+                continue
+            out.append({"mesh": spec.axis_sizes(), "plan": plan})
+    return out
+
+
+def enumerate_splits(classes: Sequence[NodeClass],
+                     cap: int = 4) -> List[Dict[str, int]]:
+    """Candidate role splits: per class, prefill counts drawn from
+    {0, 1, n/2, n} (deduped, capped), crossed over classes. Always
+    contains the all-mixed split (the naive-uniform baseline)."""
+    per_class = []
+    for klass in classes:
+        n = len(klass.node_ids)
+        opts = sorted({0, min(1, n), n // 2, n})[:max(1, cap)]
+        per_class.append((klass.key, opts))
+    splits: List[Dict[str, int]] = [{}]
+    for key, opts in per_class:
+        splits = [dict(s, **{key: o}) for s in splits for o in opts]
+    # every request needs a decode-capable node: drop all-prefill
+    total = {k.key: len(k.node_ids) for k in classes}
+    return [s for s in splits
+            if sum(total.values()) - sum(s.values()) > 0] or [{}]
+
+
+def search(model_name: str, classes: Sequence[NodeClass],
+           inputs: Optional[CostInputs] = None, *,
+           budget: Optional[int] = None, max_seq: int = 2048,
+           batch: int = 1, now: float = 0.0) -> Dict[str, Any]:
+    """Enumerate × score × rank. Returns the decision record — the
+    chosen (mesh, plan, role split) plus the ranked runners-up and
+    every input that drove the choice (flight-recorder discipline:
+    the record alone must reconstruct the decision)."""
+    inputs = inputs or CostInputs()
+    budget = PLANNER_BUDGET if budget is None else int(budget)
+    classes = sorted(classes, key=lambda c: c.key)
+    max_dev = max((c.device_count for c in classes), default=1)
+    mem = min((c.memory_bytes for c in classes if c.memory_bytes),
+              default=0)
+    mesh_cands = enumerate_meshes(model_name, max_dev, max_seq=max_seq,
+                                  batch=batch, memory_bytes=mem)
+    splits = enumerate_splits(classes)
+    total = len(mesh_cands) * len(splits)
+    scored = []
+    for mc in mesh_cands:
+        for split in splits:
+            if len(scored) >= budget:
+                break
+            s = score_candidate(mc["mesh"], split, classes, inputs)
+            if not s["feasible"]:
+                continue
+            scored.append({"mesh": mc["mesh"], "split": split,
+                           "plan": mc["plan"], **s})
+    # rank: goodput desc, then fewer devices, then a stable key — a
+    # byte-deterministic order per identical inputs
+    scored.sort(key=lambda c: (-c["goodput_req_s"],
+                               sum(c["mesh"].values()),
+                               json.dumps(c["split"], sort_keys=True),
+                               json.dumps(c["mesh"], sort_keys=True)))
+    if not scored:
+        return {"version": DECISION_VERSION, "model": model_name,
+                "at": now, "error": "no feasible candidate",
+                "candidates": total, "scored": 0,
+                "inputs": _inputs_dict(classes, inputs)}
+    best = scored[0]
+    prefill_nodes: List[int] = []
+    for klass in classes:
+        take = min(len(klass.node_ids), best["split"].get(klass.key, 0))
+        prefill_nodes.extend(klass.node_ids[:take])
+    return {
+        "version": DECISION_VERSION,
+        "model": model_name,
+        "at": now,
+        "chosen": {
+            "mesh": best["mesh"],
+            "role_split": best["split"],
+            "prefill_nodes": sorted(prefill_nodes),
+            "score_goodput_req_s": best["goodput_req_s"],
+            "plan": best["plan"],
+        },
+        "candidates": total,
+        "scored": len(scored),
+        "ranked": [{"mesh": c["mesh"], "role_split": c["split"],
+                    "goodput_req_s": c["goodput_req_s"]}
+                   for c in scored[:5]],
+        "inputs": _inputs_dict(classes, inputs),
+        "budget": budget,
+        "tolerance": PLANNER_TOLERANCE,
+    }
+
+
+def _inputs_dict(classes: Sequence[NodeClass],
+                 inputs: CostInputs) -> Dict[str, Any]:
+    return {"classes": [c.to_dict() for c in classes],
+            **inputs.to_dict()}

@@ -411,8 +411,19 @@ async function refresh() {{
   refreshPlans();
   const r = await (await fetch('/api/nodes/status')).json();
   document.getElementById('nodes').innerHTML = r.nodes.map(n => {{
-    const dev = esc((n.resources && n.resources.devices || [])
-      .map(d => d.kind || d.platform).join(', '));
+    // device inventory: prefer the stale-gated live snapshot (n.devices,
+    // nulled past SCHED_STALE_S like queue depth), fall back to the
+    // registration-time resources blob for never-scraped nodes
+    const devList = n.devices || (n.resources && n.resources.devices) || [];
+    const byKind = {{}};
+    devList.forEach(d => {{
+      const kind = d.kind || d.platform || 'dev';
+      const mem = d.memory_bytes ? ' '+gib(d.memory_bytes) : '';
+      const k = kind + mem;
+      byKind[k] = (byKind[k] || 0) + 1;
+    }});
+    const dev = esc(Object.entries(byKind)
+      .map(e => `${{e[1]}}x ${{e[0]}}`).join(', '));
     const models = n.loaded_models.map(m =>
       `${{esc(m.name)}} [${{esc(m.serving === 'batched' ? 'batched'
         : Object.entries(m.mesh || {{}}).filter(e=>e[1]>1)

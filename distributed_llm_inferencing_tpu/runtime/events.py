@@ -178,6 +178,19 @@ EVENT_TYPES = (
         "on disagg demand).",
         ("role", "prev_role", "reason")),
     EventType(
+        "plan-chosen", "info",
+        "The auto-parallelism planner (parallel/planner.py) chose a "
+        "deployment plan: mesh shape + prefill/decode role split, "
+        "ranked over the enumerated candidates by the profile-fed "
+        "cost model. The data carries the full decision inputs — "
+        "fitted node classes, workload shape, learned rates — so the "
+        "choice is reconstructable from the journal alone.",
+        ("model", "plan_id", "mesh", "role_split", "prefill_nodes",
+         "candidates", "scored", "score", "classes",
+         "est_prompt_tokens", "est_decode_tokens",
+         "prefill_ewma_ms_per_tok", "decode_tokens_per_weight_pass",
+         "slo_e2e_ms", "reason")),
+    EventType(
         "rebalance-divergence", "info",
         "A rebalancer sweep found sustained pool-utilization "
         "divergence past the configured ratio, with the pool means "

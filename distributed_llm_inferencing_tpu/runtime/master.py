@@ -143,6 +143,11 @@ REBALANCE_INTERVAL_S = float(
 REBALANCE_SUSTAIN_S = float(
     os.environ.get("DLI_REBALANCE_SUSTAIN_S", 30.0))
 REBALANCE_RATIO = float(os.environ.get("DLI_REBALANCE_RATIO", 3.0))
+# Auto-parallelism planner (parallel/planner.py, ROADMAP item 2):
+# /api/plans/auto returns the persisted decision unchanged while it is
+# younger than the cooldown (callers pass `force` to override) — the
+# fleet's roles must not flap on every deploy-time consult.
+PLANNER_COOLDOWN_S = float(os.environ.get("DLI_PLANNER_COOLDOWN_S", 300.0))
 # /migrate_out RPC budget: must cover the worker-side snapshot wait
 # (worker.MIGRATE_TIMEOUT_S) plus transfer slack.
 MIGRATE_RPC_TIMEOUT = 15.0
@@ -508,8 +513,17 @@ class Master:
                      "admit_rejected",
                      "shed_batch",
                      "shed_throughput",
-                     "shed_latency"):
+                     "shed_latency",
+                     # auto-parallelism planner (parallel/planner.py):
+                     # searches run + candidates scored — pre-registered
+                     # so the dashboard and the plan bench gate see them
+                     # exist before the first search ever runs
+                     "planner_searches",
+                     "planner_candidates"):
             self.metrics.inc(name, 0)
+        # cost-model score (goodput req/s) of the planner's latest
+        # chosen plan — 0 until the first search lands
+        self.metrics.gauge("planner_chosen_score", 0.0)
         # ops the peers have not acked yet (0 = fully replicated)
         self.metrics.gauge("repl_lag_ops", 0.0)
         # current degradation-ladder rung (0 = normal service)
@@ -547,6 +561,12 @@ class Master:
             nonce = uuid.uuid4().hex[:8]
             self.store.set_meta("tag_nonce", nonce)
         self._run_nonce = nonce or uuid.uuid4().hex[:8]
+        # Auto-parallelism planner decision (parallel/planner.py): the
+        # chosen plan + its decision record live in the REPLICATED meta
+        # table (tag_nonce discipline) — a restarted master on the same
+        # DB reloads it here, and a promoted standby re-adopts it in
+        # on_promote, so the rebalancer's role target survives failover.
+        self._planner_decision = self._load_planner_decision()
         self.health_interval = health_interval
         self._worker_auth = auth_key or os.environ.get("DLI_AUTH_KEY")
         self._inflight: Dict[int, int] = {}   # node_id -> in-flight count
@@ -579,6 +599,7 @@ class Master:
         # beyond reference
         s.add("GET", "/api/plans", self.api_list_plans)
         s.add("POST", "/api/plans/create", self.api_create_plan)
+        s.add("POST", "/api/plans/auto", self.api_plan_auto)
         s.add("POST", "/api/plans/deploy/<plan_id>", self.api_deploy_plan)
         s.add("POST", "/api/models/load", self.api_load_model)
         s.add("GET", "/api/metrics", lambda b: self.metrics.snapshot())
@@ -628,6 +649,10 @@ class Master:
             self._run_nonce = nonce
         else:
             self.store.set_meta("tag_nonce", self._run_nonce)
+        # adopt the replicated planner decision (same rule as the tag
+        # nonce): the new leader's rebalancer steers toward the role
+        # split the dead leader chose, not back to a hardcoded balance
+        self._planner_decision = self._load_planner_decision()
         self._wake.set()
 
     def on_demote(self):
@@ -956,6 +981,11 @@ class Master:
                                     if ewma is not None else None),
                 "prefix_hit_ratio": (round(sum(ratios) / len(ratios), 3)
                                      if ratios else None),
+                # live device inventory (planner node-class input;
+                # nodes dashboard Devices column) — stale-gated like
+                # queue depth; registration-info devices remain under
+                # `resources` for never-scraped nodes
+                "devices": (rt.get("devices") if rt_fresh else None),
             })
         return {"status": "success", "nodes": nodes}
 
@@ -980,12 +1010,148 @@ class Master:
     def api_list_plans(self, body):
         return {"status": "success", "plans": self.store.list_plans()}
 
+    def _load_planner_decision(self):
+        try:
+            raw = self.store.get_meta("planner_decision")
+            return json.loads(raw) if raw else None
+        except Exception:
+            return None
+
+    def _planner_views(self) -> list:
+        """Per-node planner inputs: /health device inventory (the
+        stale-gated runtime snapshot, registration info as fallback),
+        the node's generated-token rate from its TSDB counter series,
+        and the master-observed e2e latency EWMA."""
+        rates: Dict[str, float] = {}
+        # TSDB series names are registry names: ingest strips the
+        # dli_/_total exposition affixes (tsdb.ingest_prometheus)
+        for s in self.tsdb.query("tokens_generated", window=600.0):
+            # counters come back as per-second rates; idle buckets are
+            # zero — average the serving-time points only, so a node
+            # that was busy 10% of the window still prices at its
+            # actual serving speed
+            pts = [v for _, v in (s.get("points") or []) if v and v > 0]
+            if pts:
+                rates[s["node"]] = sum(pts) / len(pts)
+        views = []
+        now = clock.now()
+        for n in self.store.list_nodes(active_only=True):
+            if n.get("draining"):
+                continue
+            rt = self._node_runtime.get(n["id"]) or {}
+            fresh = bool(rt) and now - rt.get("at", 0) <= SCHED_STALE_S
+            devices = rt.get("devices") if fresh else None
+            if devices is None:
+                info = json.loads(n.get("info") or "{}")
+                devices = (info.get("resources") or {}).get("devices")
+            ewma = self._node_lat_ewma.get(n["id"])
+            views.append({
+                "id": n["id"], "name": n["name"],
+                "devices": devices or [],
+                "decode_tok_s": rates.get(n["name"]),
+                "latency_ms": (round(ewma * 1e3, 1)
+                               if ewma is not None else None)})
+        return views
+
+    def api_plan_auto(self, body):
+        """Profile-fed auto-planning (parallel/planner.py): fit node
+        classes from the fleet's measured state, search (mesh x role
+        split) candidates, persist the chosen plan + decision record
+        in the replicated meta table, and journal `plan-chosen`. The
+        rebalancer then steers roles toward the chosen split."""
+        from distributed_llm_inferencing_tpu.parallel import planner
+        nl = self._not_leader("/api/plans/auto")
+        if nl:
+            return nl
+        if not planner.PLANNER_ENABLE:
+            return 403, {"status": "error",
+                         "message": "planner disabled "
+                                    "(DLI_PLANNER_ENABLE=0)"}
+        model = body.get("model_name")
+        if not model:
+            return 400, {"status": "error",
+                         "message": "model_name required"}
+        now = clock.now()
+        dec = self._planner_decision
+        if dec and dec.get("model") == model and dec.get("chosen") \
+                and not body.get("force") \
+                and now - float(dec.get("at") or 0) < PLANNER_COOLDOWN_S:
+            return {"status": "success", "cached": True,
+                    "plan_id": dec.get("plan_id"), "decision": dec}
+        views = self._planner_views()
+        if not views:
+            return 503, {"status": "error", "message": "no active nodes"}
+        classes = planner.fit_node_classes(views)
+        dtwp = [v for s in self.tsdb.query(
+                    "decode_tokens_per_weight_pass", window=600.0)
+                for _, v in (s.get("points") or []) if v and v > 0]
+        inputs = planner.CostInputs(
+            est_prompt_tokens=int(body.get("est_prompt_tokens", 512)),
+            est_decode_tokens=int(body.get("est_decode_tokens", 128)),
+            prefill_ms_per_tok=(self._prefill_ewma.get(str(model))
+                                or planner.PRIOR_PREFILL_MS_PER_TOK),
+            decode_tokens_per_weight_pass=(
+                sum(dtwp) / len(dtwp) if dtwp else 1.0),
+            slo_e2e_ms=(float(body["slo_e2e_ms"])
+                        if body.get("slo_e2e_ms") else None),
+            slo_itl_ms=(float(body["slo_itl_ms"])
+                        if body.get("slo_itl_ms") else None))
+        try:
+            decision = planner.search(
+                model, classes, inputs, budget=body.get("budget"),
+                max_seq=int(body.get("max_seq", 2048)),
+                batch=int(body.get("batch", 1)), now=now)
+        except (KeyError, ValueError) as e:
+            return 400, {"status": "error", "message": str(e)}
+        self.metrics.inc("planner_searches")
+        self.metrics.inc("planner_candidates",
+                         decision.get("scored") or 0)
+        if not decision.get("chosen"):
+            return 409, {"status": "error",
+                         "message": decision.get("error",
+                                                 "no feasible candidate"),
+                         "decision": decision}
+        chosen = decision["chosen"]
+        self.metrics.gauge("planner_chosen_score",
+                           chosen["score_goodput_req_s"])
+        plan_id = self.store.add_plan(str(model), chosen["plan"])
+        decision["plan_id"] = plan_id
+        # replicated meta row (tag_nonce discipline): the decision —
+        # and with it the rebalancer's role target — survives restart
+        # AND failover; the standby re-adopts it at promotion
+        self.store.set_meta("planner_decision", json.dumps(decision))
+        self._planner_decision = decision
+        events.emit(
+            "plan-chosen", model=str(model), plan_id=plan_id,
+            mesh=chosen["mesh"], role_split=chosen["role_split"],
+            prefill_nodes=chosen["prefill_nodes"],
+            candidates=decision["candidates"],
+            scored=decision["scored"],
+            score=chosen["score_goodput_req_s"],
+            classes=decision["inputs"]["classes"],
+            est_prompt_tokens=inputs.est_prompt_tokens,
+            est_decode_tokens=inputs.est_decode_tokens,
+            prefill_ewma_ms_per_tok=round(inputs.prefill_ms_per_tok, 4),
+            decode_tokens_per_weight_pass=round(
+                inputs.decode_tokens_per_weight_pass, 3),
+            slo_e2e_ms=inputs.slo_e2e_ms,
+            reason="force" if body.get("force") else "api")
+        return {"status": "success", "plan_id": plan_id,
+                "decision": decision}
+
     def api_deploy_plan(self, body, plan_id):
         """Push a plan to a worker via /load_shard — the call the reference
-        defined but never made (SURVEY.md §3.2)."""
+        defined but never made (SURVEY.md §3.2). ``plan_id`` may be the
+        literal ``auto``: no explicit plan given, so the planner is
+        consulted first and its chosen plan deployed."""
         nl = self._not_leader(f"/api/plans/deploy/{plan_id}")
         if nl:
             return nl
+        if str(plan_id) == "auto":
+            r = self.api_plan_auto(body)
+            if isinstance(r, tuple) or r.get("status") != "success":
+                return r
+            plan_id = r["plan_id"]
         plans = [p for p in self.store.list_plans() if p["id"] == int(plan_id)]
         if not plans:
             return 404, {"status": "error", "message": "no such plan"}
@@ -1841,6 +2007,12 @@ class Master:
         # and the role-pool router must see a flip within one sweep,
         # and a STALE advertisement must drop out like queue depth does
         role = info.get("role")
+        # device inventory (planner node-class input): the /health body
+        # reports jax.devices() count/kind/memory under resources —
+        # stale-gated with the rest of the snapshot, so a worker that
+        # stopped reporting cannot class-ify on frozen hardware claims
+        devices = (info.get("resources") or {}).get("devices") \
+            if isinstance(info.get("resources"), dict) else None
         if merge:
             prev = self._node_runtime.get(node_id)
             if prev and prev.get("models"):
@@ -1851,6 +2023,8 @@ class Master:
                 # completion piggybacks carry scheduler stats only —
                 # keep the last full /health body's role
                 role = prev.get("role")
+            if prev and devices is None:
+                devices = prev.get("devices")
         queue = free = occ = None
         digests = False
         for st in models.values():
@@ -1872,7 +2046,7 @@ class Master:
         self._node_runtime[node_id] = {
             "queue": queue, "free_blocks": free, "arena_occ": occ,
             "role": role, "at": clock.now(), "models": models,
-            "digests_any": digests}
+            "digests_any": digests, "devices": devices}
 
     def _node_role(self, node, now: Optional[float] = None) -> str:
         """The worker's declared serving role (prefill|decode|mixed).
@@ -3343,6 +3517,11 @@ class Master:
                  if not n.get("draining")]
         if len(nodes) < 2:
             return
+        if self._planner_steer(nodes, now):
+            # a planner decision exists: ITS role split is the target —
+            # the divergence heuristic below would fight the profile-fed
+            # choice (e.g. un-quarantine a throttled node)
+            return
         loads, roles = {}, {}
         for n in nodes:
             mean = self._sustained_series_mean(
@@ -3411,6 +3590,33 @@ class Master:
         if cooled:
             return                   # per-node cooldown: no flapping
         self._flip_role(flip, new_role)
+
+    def _planner_steer(self, nodes, now: float) -> bool:
+        """Rebalancer leg of the auto-planner: when a planner decision
+        is installed (API call, restart reload, or failover adoption),
+        the recommended role split REPLACES the hardcoded divergence
+        balance as the rebalancer's target. One flip per sweep, same
+        per-node cooldown as divergence flips. Returns True when the
+        planner owns role policy (a decision exists and the planner is
+        enabled), False to fall through to the divergence heuristic."""
+        from distributed_llm_inferencing_tpu.parallel import planner
+        dec = self._planner_decision
+        if not planner.PLANNER_ENABLE or not dec or not dec.get("chosen"):
+            return False
+        want_prefill = set(dec["chosen"].get("prefill_nodes") or [])
+        for n in sorted(nodes, key=lambda n: n["id"]):
+            want = "prefill" if n["id"] in want_prefill else "mixed"
+            if self._node_role(n) == want:
+                continue
+            if now - self._last_flip.get(n["id"], 0) \
+                    < self._rebalance_sustain:
+                continue
+            events.emit("rebalance-divergence", node_id=n["id"],
+                        ratio=self._rebalance_ratio,
+                        action=f"planner-target-{want}")
+            self._flip_role(n, want, reason="planner-target")
+            return True
+        return True
 
     def _flip_role(self, node, new_role: str,
                    reason: str = "divergence") -> bool:

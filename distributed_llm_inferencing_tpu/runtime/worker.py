@@ -251,6 +251,10 @@ class WorkerAgent:
                 if ms:
                     entry["bytes_in_use"] = ms.get("bytes_in_use")
                     entry["bytes_limit"] = ms.get("bytes_limit")
+                    # the planner's memory-feasibility input (node-class
+                    # fitting, parallel/planner.py): per-device HBM a
+                    # candidate plan's weights + KV must fit under
+                    entry["memory_bytes"] = ms.get("bytes_limit")
             except Exception as e:
                 # CPU backends raise per scrape — stats stay best-effort
                 log.debug("device memory_stats unavailable: %r", e)

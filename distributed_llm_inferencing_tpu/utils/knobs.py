@@ -396,6 +396,9 @@ KNOBS = (
     Knob("DLI_BENCH_PROBE_WINDOW_S", "300", "float",
          "Backend-probe timeout window before the bench falls back.",
          "bench.py"),
+    Knob("DLI_BENCH_PLAN_MIN_X", "1.15", "float",
+         "Planner A/B gate: minimum planner-chosen vs naive-uniform "
+         "goodput ratio on the heterogeneous fleet.", "bench.py"),
     # ---- cluster simulator (tools/dlisim, docs/simulator.md) ---------
     Knob("DLI_SIM_NODES", "1000", "int",
          "Fleet size for the sim_scale bench gate's headline leg.",
@@ -416,6 +419,23 @@ KNOBS = (
          "Calibration gate: max relative sim-vs-real mean queue-depth "
          "error (absolute slack of 3 requests applies near zero).",
          "bench.py"),
+    # ---- auto-parallelism planner (parallel/planner.py) --------------
+    Knob("DLI_PLANNER_ENABLE", "1", "bool",
+         "Master switch for the heterogeneity-aware auto-parallelism "
+         "planner: `0` keeps `/api/plans/auto` refusing and the "
+         "rebalancer on its divergence heuristic.",
+         f"{_P}/parallel/planner.py"),
+    Knob("DLI_PLANNER_BUDGET", "128", "int",
+         "Search budget: max (mesh x role-split) candidates one "
+         "planner search scores.", f"{_P}/parallel/planner.py"),
+    Knob("DLI_PLANNER_TOLERANCE", "0.25", "float",
+         "Sim-agreement tolerance: the dlisim planner sweep asserts "
+         "the planner's top choice reaches >= (1 - tolerance) of the "
+         "sim-measured best goodput.", f"{_P}/parallel/planner.py"),
+    Knob("DLI_PLANNER_COOLDOWN_S", "300", "float",
+         "Re-plan cooldown: `/api/plans/auto` returns the persisted "
+         "decision unchanged when it is younger than this (pass "
+         "`force` to override).", f"{_P}/runtime/master.py"),
 )
 
 _BY_NAME: Dict[str, Knob] = {k.name: k for k in KNOBS}

@@ -246,6 +246,26 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu \
 timeout -k 10 900 env JAX_PLATFORMS=cpu \
     python bench.py --scenario overload --smoke || exit 1
 
+echo "== auto-parallelism planner (cost model + sim sweep + live smoke) =="
+# Heterogeneity-aware plan search (docs/architecture.md "Auto-
+# parallelism planner"): analytic cost model over fleet-fitted node
+# classes, (mesh x role split) enumeration under memory feasibility,
+# decision records persisted in the replicated meta table. The sim
+# sweep replays a 120-node two-class fleet through tools/dlisim and
+# fails if the planner's top choice falls outside DLI_PLANNER_TOLERANCE
+# of the sim-measured best split; the smoke drives a live 3-worker
+# fleet with one fault-throttled node and gates the full
+# decision->persistence->rebalancer-steering path (JSON artifacts:
+# /tmp/dli_planner_sweep.json, /tmp/dli_bench_plan.json)
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_planner.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python -m tools.dlisim --planner-sweep --nodes 120 --requests 2000 \
+    --duration 200 --seed 42 --out /tmp/dli_planner_sweep.json || exit 1
+timeout -k 10 900 env JAX_PLATFORMS=cpu \
+    python bench.py --scenario plan --smoke || exit 1
+
 echo "== chaos suite (fault injection + self-healing dispatch + lock watchdog) =="
 # Deterministic fault schedules: a failure here reproduces locally with
 #   DLI_FAULTS_SEED=0 JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q
@@ -285,6 +305,7 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     --ignore=tests/test_clock.py \
     --ignore=tests/test_dlisim.py \
     --ignore=tests/test_admission.py \
+    --ignore=tests/test_planner.py \
     2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \

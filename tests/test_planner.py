@@ -1,0 +1,379 @@
+"""Heterogeneity-aware auto-parallelism planner suite
+(parallel/planner.py, docs/architecture.md "Auto-parallelism
+planner").
+
+Covers the analytic cost model's basic sanity properties
+(monotonicity under perfect scaling, memory-feasibility rejection),
+the search's behavior on a measured two-class fleet (quarantine the
+SLO-violating class), the decision-record contract (schema, journal
+reconstructability, replicated-meta persistence across restart), the
+``plan_from_json`` round-trip over the whole model registry, and a
+small sim-agreement sweep against tools/dlisim ground truth.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import pytest
+
+from distributed_llm_inferencing_tpu.models.registry import (get_config,
+                                                             list_models)
+from distributed_llm_inferencing_tpu.parallel import planner
+from distributed_llm_inferencing_tpu.parallel.mesh import (MeshSpec,
+                                                           validate_spec)
+from distributed_llm_inferencing_tpu.parallel.plan import (PLAN_KEYS,
+                                                           make_plan,
+                                                           plan_from_json,
+                                                           plan_to_json)
+
+MESH1 = {"dp": 1, "pp": 1, "sp": 1, "tp": 1, "ep": 1}
+
+
+def _klass(n_nodes=2, device_count=1, decode_tok_s=50.0,
+           latency_ms=None, measured=True, key="k",
+           memory_bytes=16 << 30, first_id=1):
+    return planner.NodeClass(
+        key=key, kind="test", device_count=device_count,
+        memory_bytes=memory_bytes,
+        node_ids=tuple(range(first_id, first_id + n_nodes)),
+        decode_tok_s=decode_tok_s, latency_ms=latency_ms,
+        measured=measured)
+
+
+def _views(n_fast=8, n_slow=4, slow_x=24.0):
+    """Two-class fleet: ``n_slow`` throttled nodes first (the id
+    ordering the sim's speeds list uses), then ``n_fast`` healthy."""
+    views = []
+    for i in range(n_slow + n_fast):
+        x = slow_x if i < n_slow else 1.0
+        views.append({
+            "id": i + 1, "name": f"n{i}",
+            "devices": [{"kind": "tpu", "memory_bytes": 16 << 30}],
+            "decode_tok_s": round(1000.0 / (18.0 * x), 3),
+            "latency_ms": 8.0 * x})
+    return views
+
+
+# ---- cost model -------------------------------------------------------
+
+def test_more_devices_never_worse_under_perfect_scaling():
+    """Monotonicity: with zero collective overhead (perfect scaling),
+    adding devices to a class never lowers the scored goodput, for
+    every mesh shape that fits the smaller class."""
+    inputs = planner.CostInputs(coll_overhead_per_way=0.0)
+    for mesh in ({"tp": 1}, {"tp": 2}, {"dp": 2}, {"tp": 2, "dp": 2}):
+        mesh = dict(MESH1, **mesh)
+        prev = None
+        for d in (1, 2, 4, 8, 16):
+            k = _klass(device_count=d)
+            s = score = planner.score_candidate(mesh, {}, [k], inputs)
+            if not s["feasible"]:
+                continue   # mesh larger than the class: skip, not worse
+            if prev is not None:
+                assert score["goodput_req_s"] >= prev - 1e-9, \
+                    (mesh, d, score, prev)
+            prev = score["goodput_req_s"]
+
+
+def test_rates_scale_linearly_with_replicas():
+    inputs = planner.CostInputs(coll_overhead_per_way=0.0)
+    r1 = planner.class_rates(MESH1, _klass(device_count=1), inputs)
+    r4 = planner.class_rates(MESH1, _klass(device_count=4), inputs)
+    assert r4["replicas"] == 4
+    assert r4["decode_tok_s"] == pytest.approx(4 * r1["decode_tok_s"])
+
+
+def test_pipeline_bubble_penalizes_pp():
+    inputs = planner.CostInputs(coll_overhead_per_way=0.0,
+                                bubble_microbatches=4)
+    k = _klass(device_count=2)
+    r_tp = planner.class_rates(dict(MESH1, tp=2), k, inputs)
+    r_pp = planner.class_rates(dict(MESH1, pp=2), k, inputs)
+    # same device budget: the pp=2 pipeline pays the GPipe bubble
+    # mb/(mb+pp-1) = 4/5, tp=2 at zero overhead does not
+    assert r_pp["decode_tok_s"] == pytest.approx(
+        0.8 * r_tp["decode_tok_s"])
+
+
+def test_memory_infeasible_rejected():
+    """A class whose per-device memory cannot hold even tiny-llama
+    yields no mesh candidate: search reports no feasible candidate."""
+    k = _klass(memory_bytes=1)   # 1 byte of HBM
+    decision = planner.search("tiny-llama", [k])
+    assert "chosen" not in decision
+    assert decision["error"] == "no feasible candidate"
+    assert decision["scored"] == 0
+
+
+def test_all_prefill_split_infeasible():
+    k = _klass(n_nodes=2)
+    s = planner.score_candidate(MESH1, {k.key: 2}, [k],
+                                planner.CostInputs())
+    assert not s["feasible"]
+    assert s["goodput_req_s"] == 0.0
+
+
+# ---- two-class fleet --------------------------------------------------
+
+def test_two_class_fleet_quarantines_measured_slow_class():
+    """The heterogeneous case the planner exists for: a throttled
+    class whose estimated ITL violates the SLO is steered into the
+    strict prefill pool (zero goodput AND wasted dispatch concurrency
+    if it stays mixed); the healthy class keeps serving."""
+    views = _views(n_fast=8, n_slow=4, slow_x=24.0)
+    classes = planner.fit_node_classes(views)
+    assert len(classes) == 2
+    inputs = planner.CostInputs(est_prompt_tokens=64,
+                                est_decode_tokens=16,
+                                slo_itl_ms=250.0)
+    decision = planner.search("tiny-llama", classes, inputs)
+    chosen = decision["chosen"]
+    # the slow nodes (ids 1..4) — and only them — go prefill
+    assert chosen["prefill_nodes"] == [1, 2, 3, 4]
+    ranked = decision["ranked"]
+    assert ranked[0]["goodput_req_s"] >= ranked[-1]["goodput_req_s"]
+
+
+def test_fit_node_classes_splits_identical_hardware_by_rate():
+    """Same device inventory, 24x measured-rate gap: two classes (the
+    throttled-host case device info alone cannot see)."""
+    views = _views(n_fast=2, n_slow=2)
+    classes = planner.fit_node_classes(views)
+    assert len(classes) == 2
+    assert {len(c.node_ids) for c in classes} == {2}
+
+
+def test_unmeasured_fleet_prices_at_priors():
+    classes = planner.fit_node_classes(
+        [{"id": 1, "devices": [{"kind": "tpu"}]}])
+    assert len(classes) == 1
+    assert not classes[0].measured
+    assert classes[0].decode_tok_s == planner.PRIOR_DECODE_TOK_S
+
+
+# ---- decision record --------------------------------------------------
+
+def test_decision_record_schema_and_json_clean():
+    views = _views(n_fast=4, n_slow=2)
+    classes = planner.fit_node_classes(views)
+    decision = planner.search("tiny-llama", classes,
+                              planner.CostInputs(slo_itl_ms=250.0),
+                              now=123.0)
+    for key in ("version", "model", "at", "chosen", "candidates",
+                "scored", "ranked", "inputs", "budget", "tolerance"):
+        assert key in decision, key
+    chosen = decision["chosen"]
+    for key in ("mesh", "role_split", "prefill_nodes",
+                "score_goodput_req_s", "plan"):
+        assert key in chosen, key
+    assert set(chosen["plan"]) >= PLAN_KEYS
+    assert decision["at"] == 123.0
+    # the inputs block alone must reconstruct the choice: re-scoring
+    # the chosen candidate from the recorded classes + inputs lands on
+    # the recorded score (flight-recorder discipline)
+    rec = decision["inputs"]
+    classes2 = [planner.NodeClass(**dict(c, node_ids=tuple(c["node_ids"])))
+                for c in rec["classes"]]
+    inputs2 = planner.CostInputs(**{
+        f.name: rec[f.name]
+        for f in planner.CostInputs.__dataclass_fields__.values()})
+    s = planner.score_candidate(chosen["mesh"], chosen["role_split"],
+                                classes2, inputs2)
+    assert s["goodput_req_s"] == pytest.approx(
+        chosen["score_goodput_req_s"])
+    # survives a JSON round-trip bitwise (what the meta row stores)
+    text = json.dumps(decision, sort_keys=True)
+    assert json.dumps(json.loads(text), sort_keys=True) == text
+
+
+def test_search_deterministic():
+    views = _views()
+    a = planner.search("tiny-llama", planner.fit_node_classes(views),
+                       planner.CostInputs(slo_itl_ms=250.0), now=1.0)
+    b = planner.search("tiny-llama", planner.fit_node_classes(views),
+                       planner.CostInputs(slo_itl_ms=250.0), now=1.0)
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+
+
+# ---- plan_from_json round-trip over the registry ----------------------
+
+def _mesh_candidates():
+    for n in (1, 2, 4, 8):
+        yield from planner._factor_assignments(n)
+
+
+def test_plan_from_json_roundtrip_every_model_and_mesh():
+    """Property test: every registry model x every mesh factorization
+    of 1/2/4/8 devices that validate_spec accepts survives
+    plan -> JSON -> plan_from_json -> JSON bitwise."""
+    rounds = 0
+    for name in list_models():
+        cfg = get_config(name)
+        for mesh in _mesh_candidates():
+            spec = MeshSpec.from_dict(mesh)
+            try:
+                validate_spec(spec, cfg)
+            except (ValueError, NotImplementedError):
+                continue
+            plan = make_plan(cfg, spec, max_seq=128)
+            text = plan_to_json(plan)
+            back = plan_from_json(text)
+            assert back == plan, (name, mesh)
+            assert plan_to_json(back) == text, (name, mesh)
+            rounds += 1
+    assert rounds > len(list_models())   # the loop really exercised
+
+
+def test_plan_from_json_rejects_truncated_payload():
+    plan = make_plan(get_config("tiny-llama"),
+                     MeshSpec.from_dict({"tp": 1}), max_seq=128)
+    broken = {k: v for k, v in plan.items() if k != "partition_specs"}
+    with pytest.raises(ValueError, match="partition_specs"):
+        plan_from_json(json.dumps(broken))
+    with pytest.raises(ValueError, match="object"):
+        plan_from_json("[1, 2]")
+
+
+# ---- master integration: persistence + journal ------------------------
+
+def _seed_nodes(m, n=2):
+    for i in range(n):
+        nid = m.store.add_node(f"pn{i}", "127.0.0.1", 9000 + i,
+                               is_active=True)
+        m.store.update_node(nid, info={
+            "resources": {"devices": [{"kind": "tpu",
+                                       "memory_bytes": 16 << 30}]}})
+    m.store.flush()
+
+
+def test_api_plan_auto_persists_and_survives_restart(tmp_path):
+    """The deploy-time contract: one /api/plans/auto call persists the
+    chosen plan (plans table) AND the decision record (replicated meta
+    row), journals `plan-chosen` with the full inputs, and a fresh
+    master over the same database reloads the decision — the
+    rebalancer's steering target survives restart/failover."""
+    from distributed_llm_inferencing_tpu.runtime.master import Master
+    db = str(tmp_path / "planner.sqlite3")
+    m = Master(db)
+    try:
+        _seed_nodes(m)
+        r = m.api_plan_auto({"model_name": "tiny-llama",
+                             "est_prompt_tokens": 8,
+                             "est_decode_tokens": 8})
+        assert r["status"] == "success", r
+        plan_id = r["plan_id"]
+        decision = r["decision"]
+        assert decision["plan_id"] == plan_id
+        # persisted plan row round-trips through plan_from_json
+        row = next(p for p in m.store.list_plans()
+                   if p["id"] == plan_id)
+        raw = row["plan"]
+        plan = plan_from_json(raw if isinstance(raw, str)
+                              else json.dumps(raw))
+        assert plan["model"] == "tiny-llama"
+        # journal: decision reconstructable from the event alone
+        evs = [e for e in m.events.tail(50) if e["type"] == "plan-chosen"]
+        assert len(evs) == 1
+        data = evs[0]["data"]
+        for key in ("model", "plan_id", "mesh", "role_split",
+                    "prefill_nodes", "candidates", "scored", "score",
+                    "classes", "est_prompt_tokens", "est_decode_tokens",
+                    "prefill_ewma_ms_per_tok",
+                    "decode_tokens_per_weight_pass", "reason"):
+            assert key in data, key
+        # metrics moved off their pre-registered zeros
+        snap = m.metrics.snapshot()
+        assert snap["counters"]["planner_searches"] == 1
+        assert snap["counters"]["planner_candidates"] >= 1
+        assert snap["gauges"]["planner_chosen_score"] > 0
+        # cooldown: an identical ask inside the window is served from
+        # the persisted decision, not a re-search
+        r2 = m.api_plan_auto({"model_name": "tiny-llama"})
+        assert r2.get("cached") is True
+        assert r2["plan_id"] == plan_id
+        assert m.metrics.snapshot()["counters"]["planner_searches"] == 1
+        # force re-plans
+        r3 = m.api_plan_auto({"model_name": "tiny-llama", "force": True})
+        assert r3.get("cached") is None
+        assert m.metrics.snapshot()["counters"]["planner_searches"] == 2
+    finally:
+        m.stop()
+    m2 = Master(db)
+    try:
+        dec = m2._planner_decision
+        assert dec is not None
+        assert dec["model"] == "tiny-llama"
+        assert dec["chosen"]["prefill_nodes"] == \
+            decision["chosen"]["prefill_nodes"]
+    finally:
+        m2.stop()
+
+
+def test_planner_metrics_preregistered_at_zero():
+    from distributed_llm_inferencing_tpu.runtime.master import Master
+    m = Master(":memory:")
+    try:
+        snap = m.metrics.snapshot()
+        assert snap["counters"]["planner_searches"] == 0
+        assert snap["counters"]["planner_candidates"] == 0
+        assert snap["gauges"]["planner_chosen_score"] == 0.0
+    finally:
+        m.stop()
+
+
+def test_plan_auto_requires_model_and_nodes():
+    from distributed_llm_inferencing_tpu.runtime.master import Master
+    m = Master(":memory:")
+    try:
+        code, body = m.api_plan_auto({})
+        assert code == 400
+        code, body = m.api_plan_auto({"model_name": "tiny-llama"})
+        assert code == 503   # empty fleet: nothing to plan over
+    finally:
+        m.stop()
+
+
+def test_planner_steer_targets_decision_split():
+    """The rebalancer reads the planner's split as its role target:
+    given a persisted decision quarantining node 1, the steer loop
+    flips node 1 to prefill (and leaves the rest mixed)."""
+    from distributed_llm_inferencing_tpu.runtime.master import Master
+    m = Master(":memory:")
+    try:
+        _seed_nodes(m, n=2)
+        flips = []
+        m._flip_role = lambda node, role, reason=None: flips.append(
+            (node["id"], role, reason))
+        m._planner_decision = {
+            "model": "tiny-llama",
+            "chosen": {"prefill_nodes": [1], "role_split": {}}}
+        nodes = m.store.list_nodes(active_only=True)
+        assert m._planner_steer(nodes, now=1000.0) is True
+        assert flips == [(1, "prefill", "planner-target")]
+        # converged fleet: steer still owns the policy (returns True,
+        # keeping the divergence heuristic out) but flips nothing
+        m._node_role = lambda n: ("prefill" if n["id"] == 1
+                                  else "mixed")
+        flips.clear()
+        assert m._planner_steer(nodes, now=2000.0) is True
+        assert flips == []
+    finally:
+        m.stop()
+
+
+# ---- sim agreement ----------------------------------------------------
+
+def test_sim_sweep_agrees_with_planner_choice():
+    """Small instance of the `--planner-sweep` gate: the planner's top
+    choice must land within DLI_PLANNER_TOLERANCE of the sim-measured
+    best goodput over the candidate quarantine sizes."""
+    from tools.dlisim.planner import sweep
+    r = sweep(nodes=18, requests=240, duration_s=90.0, seed=11)
+    assert r["ok"], r
+    assert r["planner"]["prefill_nodes"] == r["slow_nodes"]
+    hashes = {c["journal_hash"] for c in r["candidates"]}
+    assert len(hashes) == len(r["candidates"])   # distinct topologies

@@ -63,9 +63,20 @@ def main(argv=None) -> int:
     p.add_argument("--claim-interval", type=float, default=1.0,
                    help="seconds between claim waves under --overload "
                         "(one wave per dispatch event)")
+    p.add_argument("--planner-sweep", action="store_true",
+                   help="planner validation sweep: simulate each "
+                        "candidate prefill-quarantine size on a "
+                        "heterogeneous fleet and assert the "
+                        "auto-parallelism planner's top choice lands "
+                        "within DLI_PLANNER_TOLERANCE of the "
+                        "sim-measured best (docs/architecture.md)")
     p.add_argument("--out", default=None,
                    help="also write the report to this path")
     args = p.parse_args(argv)
+
+    if args.planner_sweep:
+        from .planner import main as planner_main
+        return planner_main(args)
 
     fails = []
     for spec in args.fail:

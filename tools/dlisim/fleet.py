@@ -148,6 +148,14 @@ class SimNode:
             "kv_blocks_peak": max(1, (ptoks + dtoks) // 8),
             "preemptions": 0,
         }
+        if dtoks:
+            # the real batcher's cost record carries the request's p95
+            # inter-token gap (batcher.py _cost_record); sim decode is
+            # a uniform token cadence, so p95 == the mean gap — without
+            # this the SLO evaluator judges TTFT only and a slow node's
+            # decode tail is invisible to the goodput accounting
+            cost["itl_p95_ms"] = round(
+                decode_ms * self.spec.speed / dtoks, 3)
         return end, cost
 
     def release(self, now: float) -> None:

@@ -1,0 +1,136 @@
+"""Planner sweep: validate the auto-parallelism planner against the
+simulator (``python -m tools.dlisim --planner-sweep``).
+
+The sweep builds one heterogeneous synthetic fleet — a slow tail of
+nodes whose per-token service time violates the ITL SLO — and measures
+the ground truth the planner only *estimates*: for each candidate
+prefill-quarantine size ``k`` (slowest ``k`` nodes flipped to the
+strict prefill role, exactly what the rebalancer does when it steers
+toward a planner target) it runs a full virtual-clock simulation and
+reads the within-SLO goodput off the journal.
+
+The planner then prices the same fleet from the same worker models the
+simulator executes (decode rate = ``1000 / (decode_ms_per_token x
+speed)``), and the sweep asserts its top choice lands within
+``DLI_PLANNER_TOLERANCE`` of the sim-measured best. Everything is a
+pure function of the seed — per-candidate journal hashes land in the
+report so two runs can be diffed byte-for-byte.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List
+
+from distributed_llm_inferencing_tpu.parallel import planner as _planner
+from distributed_llm_inferencing_tpu.runtime.tsdb import slo_targets
+
+from .fit import DEFAULT_MODEL
+from .sim import SimConfig, run_sim
+
+
+def _fleet_views(nodes: int, slow_nodes: int, slow_speed: float,
+                 model=None) -> List[dict]:
+    """Planner-side node views replaying the sim's fitted worker
+    models: what the master's ``_planner_views`` would report after the
+    TSDB has seen this fleet serve (rate = the worker model's actual
+    decode step rate, latency = its e2e service time)."""
+    model = model or DEFAULT_MODEL
+    views = []
+    for i in range(nodes):
+        speed = slow_speed if i < slow_nodes else 1.0
+        decode_ms = model.decode_ms_per_token * speed
+        views.append({
+            "id": i + 1,   # sim registration order: slow nodes first
+            "name": f"sim-{i}",
+            "devices": [{"kind": "sim-tpu", "memory_bytes": 16 << 30}],
+            "decode_tok_s": 1000.0 / decode_ms,
+            "latency_ms": model.overhead_ms * speed,
+        })
+    return views
+
+
+def sweep(nodes: int = 120, slow_frac: float = 1.0 / 3.0,
+          slow_speed: float = 24.0, requests: int = 3000,
+          duration_s: float = 300.0, seed: int = 42,
+          model_name: str = "tiny-llama") -> Dict[str, Any]:
+    """Run the sweep; returns the report dict (``ok`` = planner's top
+    choice within tolerance of the sim-measured best)."""
+    slow_nodes = max(1, int(nodes * slow_frac))
+    speeds = [slow_speed] * slow_nodes          # slowest first: a k-node
+    # prefill pool == quarantining the k slowest (planner picks whole
+    # slow classes, whose node ids are exactly this prefix)
+    targets = slo_targets()
+
+    # ---- planner side: price the fleet from the worker models --------
+    views = _fleet_views(nodes, slow_nodes, slow_speed)
+    classes = _planner.fit_node_classes(views)
+    inputs = _planner.CostInputs(
+        est_prompt_tokens=64, est_decode_tokens=16,
+        prefill_ms_per_tok=DEFAULT_MODEL.prefill_ms_per_token,
+        slo_itl_ms=targets["itl_p95_ms"])
+    decision = _planner.search(model_name, classes, inputs, now=0.0)
+    chosen = decision.get("chosen") or {}
+    planner_k = len(chosen.get("prefill_nodes") or [])
+
+    # ---- sim side: measure each candidate quarantine size ------------
+    cand_ks = sorted({0, 1, slow_nodes // 2, slow_nodes, planner_k})
+    candidates = []
+    for k in cand_ks:
+        rep = run_sim(SimConfig(
+            nodes=nodes, requests=requests, duration_s=duration_s,
+            arrival="uniform", seed=seed, speeds=speeds,
+            prefill_nodes=k))
+        candidates.append({
+            "prefill_nodes": k,
+            "goodput_req_per_s": rep.goodput_req_per_s or 0.0,
+            "completed": rep.completed, "failed": rep.failed,
+            "journal_hash": rep.journal_hash,
+        })
+    best = max(candidates, key=lambda c: c["goodput_req_per_s"])
+    planner_row = next(c for c in candidates
+                       if c["prefill_nodes"] == planner_k)
+    tol = _planner.PLANNER_TOLERANCE
+    ok = (planner_row["goodput_req_per_s"]
+          >= (1.0 - tol) * best["goodput_req_per_s"])
+    # strip the bulky partition-spec plan: the report compares scores,
+    # the full decision record lives in the master's meta row / journal
+    slim = {k2: v for k2, v in decision.items() if k2 != "chosen"}
+    if chosen:
+        slim["chosen"] = {k2: v for k2, v in chosen.items()
+                          if k2 != "plan"}
+    return {
+        "scenario": "planner-sweep",
+        "model": model_name,
+        "nodes": nodes, "slow_nodes": slow_nodes,
+        "slow_speed": slow_speed,
+        "requests": requests, "duration_s": duration_s, "seed": seed,
+        "slo": {"itl_p95_ms": targets["itl_p95_ms"],
+                "ttft_ms": targets["ttft_ms"]},
+        "planner": {"decision": slim, "prefill_nodes": planner_k,
+                    "goodput_req_per_s":
+                        planner_row["goodput_req_per_s"]},
+        "candidates": candidates,
+        "sim_best": best,
+        "tolerance": tol,
+        "ok": ok,
+    }
+
+
+def main(args) -> int:
+    report = sweep(nodes=args.nodes, requests=args.requests,
+                   duration_s=args.duration, seed=args.seed)
+    line = json.dumps(report, sort_keys=True)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    if not report["ok"]:
+        import sys
+        print(f"planner sweep FAILED: planner choice "
+              f"k={report['planner']['prefill_nodes']} reached "
+              f"{report['planner']['goodput_req_per_s']} req/s vs "
+              f"sim best {report['sim_best']['goodput_req_per_s']} "
+              f"(tolerance {report['tolerance']})", file=sys.stderr)
+        return 1
+    return 0

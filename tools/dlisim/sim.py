@@ -108,7 +108,9 @@ class SimMaster(master_mod.Master):
             # orphan cancels (terminal timeout / completed-elsewhere):
             # acknowledge; the synthetic generation holds no real slot
             return _FakeResponse(200, {"status": "success"})
-        if path == "/admin/role":
+        if path in ("/role", "/admin/role"):
+            # the real master's _flip_role posts /role; accept the
+            # legacy /admin/role spelling too
             sn.role = str((body or {}).get("role") or sn.role)
             return _FakeResponse(200, {"status": "success",
                                        "role": sn.role})
@@ -172,6 +174,11 @@ class SimConfig:
     overload: bool = False
     overload_queue: float = 64.0
     overload_hold_s: float = 10.0
+    #: per-node speed multipliers (>1 = slower), applied index-wise
+    #: over the synthetic fleet — the heterogeneity input for the
+    #: planner sweep (tools/dlisim/planner.py); shorter lists leave
+    #: the tail at 1.0
+    speeds: Optional[List[float]] = None
     #: >0: ONE claim wave per dispatch event, the next wave at
     #: +interval — pending accumulates between waves, which is what
     #: makes starvation_max_waves (claim waves a request sat pending)
@@ -317,6 +324,9 @@ def run_sim(cfg: SimConfig) -> SimReport:
         fleet = SyntheticFleet.uniform(
             cfg.nodes, cfg.model, slots=cfg.slots_per_node,
             prefill_nodes=cfg.prefill_nodes)
+        for i, sp in enumerate(cfg.speeds or []):
+            if i < len(fleet.nodes):
+                fleet.nodes[i].spec.speed = float(sp)
         base = vc.now()
         for idx, down_at, up_at in cfg.fail_nodes:
             fleet.nodes[idx % len(fleet)].fail_between(
