@@ -1084,12 +1084,88 @@ def bench_disagg_probe(disagg=True, rounds=6):
             agent.service.shutdown()
 
 
+def bench_disagg_compression(host_dtype="native", rounds=3):
+    """One (prefill, decode) pair under ``DLI_KV_HOST_DTYPE=
+    host_dtype``: export ``rounds`` unique long prompts on the prefill
+    node, pull each over the wire to the decode node (direct
+    ``kv_source`` dispatch), and return the wire/restore counters plus
+    every greedy completion. Run once per dtype and compare: the int8
+    leg must ship >=3x fewer wire bytes than native at zero transfer
+    failures with identical greedy outputs (the ``--ab`` compression
+    gate). Counters are diffed against the post-warmup snapshot so the
+    warm-path transfer in ``_disagg_workers`` doesn't pollute the
+    measurement."""
+    import requests as _rq
+
+    prev = os.environ.get("DLI_KV_HOST_DTYPE")
+    os.environ["DLI_KV_HOST_DTYPE"] = host_dtype
+    try:
+        workers = _disagg_workers(("prefill", "decode"))
+    finally:
+        if prev is None:
+            os.environ.pop("DLI_KV_HOST_DTYPE", None)
+        else:
+            os.environ["DLI_KV_HOST_DTYPE"] = prev
+    (pagent, pport), (dagent, dport) = workers
+    base0 = {}
+    for agent in (pagent, dagent):
+        for k, v in agent.metrics.snapshot()["counters"].items():
+            base0[k] = base0.get(k, 0.0) + v
+    try:
+        outs, fails = [], 0
+        for k in range(rounds):
+            prompt = _disagg_prompt_long(800 + k)
+            r = _rq.post(f"http://127.0.0.1:{pport}/inference", json={
+                "model_name": _DISAGG_MODEL, "prompt": prompt,
+                "max_new_tokens": 1, "kv_export": True,
+                "sampling": {"do_sample": False}}, timeout=600)
+            if r.status_code != 200:
+                fails += 1
+                continue
+            r = _rq.post(f"http://127.0.0.1:{dport}/inference", json={
+                "model_name": _DISAGG_MODEL, "prompt": prompt,
+                "max_new_tokens": 8,
+                "kv_source": {"url": f"http://127.0.0.1:{pport}",
+                              "model": _DISAGG_MODEL},
+                "sampling": {"do_sample": False}}, timeout=600)
+            if r.status_code != 200:
+                fails += 1
+                continue
+            outs.append([int(t) for t in r.json()["tokens"]])
+        wc = {}
+        for agent in (pagent, dagent):
+            for k, v in agent.metrics.snapshot()["counters"].items():
+                wc[k] = wc.get(k, 0.0) + v
+        delta = {k: wc.get(k, 0.0) - base0.get(k, 0.0) for k in wc}
+        gauges = dagent.metrics.snapshot()["gauges"]
+        return {
+            "host_dtype": host_dtype, "rounds": rounds, "failed": fails,
+            "tokens": outs,
+            "kv_wire_sent_bytes": int(delta.get("kv_wire_sent_bytes", 0)),
+            "kv_wire_raw_bytes": int(delta.get("kv_wire_raw_bytes", 0)),
+            "kv_transfer_blocks": int(delta.get("kv_transfer_blocks", 0)),
+            "kv_transfer_failures": int(
+                delta.get("kv_transfer_failures", 0)),
+            "kv_prefetch_coalesced": int(
+                delta.get("kv_prefetch_coalesced", 0)),
+            "kv_restore_overlap_ratio": round(float(
+                gauges.get("kv_restore_overlap_ratio", 0.0)), 3),
+        }
+    finally:
+        for agent, _ in workers:
+            agent.service.shutdown()
+
+
 def _disagg_scenario(argv, opt, smoke):
     """--scenario disagg [--smoke|--ab]: disaggregated prefill/decode
     pools vs the colocated baseline. The smoke gates zero failures plus
     at least one real cross-node transfer; the A/B additionally reports
     the short stream's TTFT p50 and decode ITL p95 improvement ratios
-    (colocated / disaggregated — above 1.0 means disaggregation wins)."""
+    (colocated / disaggregated — above 1.0 means disaggregation wins)
+    and runs the compression legs (native vs DLI_KV_HOST_DTYPE=int8
+    through the same transfer path), gating >=3x fewer wire bytes at
+    zero failures with greedy outputs matching the native leg. Writes
+    /tmp/dli_bench_disagg.json for the CI artifact."""
     if smoke:
         n_long, n_short, lc, sc = (opt("--long", 4), opt("--short", 8),
                                    2, 2)
@@ -1109,8 +1185,21 @@ def _disagg_scenario(argv, opt, smoke):
         dis = bench_disagg(n_long, n_short, lc, sc, disagg=True)
         p_colo = bench_disagg_probe(disagg=False)
         p_dis = bench_disagg_probe(disagg=True)
+        # compression leg: same transfer path twice, native vs int8
+        # arena storage — wire bytes must shrink >=3x at zero failures
+        # with greedy outputs matching the native leg token-for-token
+        c_nat = bench_disagg_compression("native")
+        c_q8 = bench_disagg_compression("int8")
         result.update(colocated=colo, disagg=dis,
-                      probe_colocated=p_colo, probe_disagg=p_dis)
+                      probe_colocated=p_colo, probe_disagg=p_dis,
+                      compress_native=c_nat, compress_int8=c_q8)
+        if c_q8.get("kv_wire_sent_bytes"):
+            result["wire_bytes_x"] = round(
+                c_nat.get("kv_wire_sent_bytes", 0)
+                / max(c_q8["kv_wire_sent_bytes"], 1), 2)
+        result["greedy_match"] = (bool(c_nat.get("tokens"))
+                                  and c_nat.get("tokens")
+                                  == c_q8.get("tokens"))
         if p_colo.get("probe_short_ttft_ms_p50") \
                 and p_dis.get("probe_short_ttft_ms_p50"):
             result["ttft_p50_x"] = round(
@@ -1129,19 +1218,35 @@ def _disagg_scenario(argv, opt, smoke):
               and p_colo.get("failed") == 0 and p_dis.get("failed") == 0
               and dis.get("kv_transfer_blocks", 0) >= 1
               and result.get("ttft_p50_x", 0) > 1.0
-              and result.get("itl_stall_x", 0) > 1.0)
+              and result.get("itl_stall_x", 0) > 1.0
+              and c_nat.get("failed") == 0 and c_q8.get("failed") == 0
+              and c_nat.get("kv_transfer_failures") == 0
+              and c_q8.get("kv_transfer_failures") == 0
+              and result.get("wire_bytes_x", 0) >= 3.0
+              and result["greedy_match"])
         print(json.dumps(result))
+        try:
+            with open("/tmp/dli_bench_disagg.json", "w") as f:
+                json.dump(result, f, indent=1)
+        except OSError:
+            pass
         if not ok:
             print("disagg A/B gate FAILED", file=sys.stderr)
             return 1
         print(f"disagg A/B ok: arriving-short TTFT p50 "
               f"{result['ttft_p50_x']}x, in-flight decode stall "
               f"{result['itl_stall_x']}x, workload ITL tail "
-              f"{result.get('workload_itl_p95_x')}x, 0 failures both "
-              f"legs", file=sys.stderr)
+              f"{result.get('workload_itl_p95_x')}x, int8 wire bytes "
+              f"{result['wire_bytes_x']}x smaller (greedy outputs "
+              f"match), 0 failures all legs", file=sys.stderr)
         return 0
     result.update(bench_disagg(n_long, n_short, lc, sc, disagg=True))
     print(json.dumps(result))
+    try:
+        with open("/tmp/dli_bench_disagg.json", "w") as f:
+            json.dump(result, f, indent=1)
+    except OSError:
+        pass
     if smoke:
         run = result
         n = n_long + n_short

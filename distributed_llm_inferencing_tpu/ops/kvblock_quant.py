@@ -1,0 +1,220 @@
+"""Per-(block, head) symmetric int8 quantization for paged-KV pages.
+
+The KV plane moves blocks two ways — host-RAM offload into the
+``HostKVArena`` and cross-node transfer over ``runtime/kvwire.py`` —
+and both paid full-precision freight: a float32 tiny-llama block is
+4 B/element on a LAN and against a fixed ``DLI_KV_HOST_MB`` budget.
+Storing KV as int8 with per-(layer, head) scales packs ~3.9x more
+prefix tokens into the same arena and cuts wire bytes the same factor,
+which is exactly the lever FlowKV (arxiv 2504.03775) pulls to widen
+the regime where disaggregated prefill beats recompute.
+
+Scheme (the KV twin of ops/quant.py's per-output-channel weights): an
+arena page is one paged-cache leaf sliced at a block,
+``[num_layers, block_size, num_kv_heads, head_dim]``. Per (layer, head)
+— the axes attention contracts within — ``scale[l, h] =
+max|page[l, :, h, :]| / 127``, ``q = round(page / scale)`` clipped.
+Per-head (not per-tensor) because K/V magnitudes vary strongly across
+heads; per-block because blocks quantize independently, so a partial
+prefix restore needs no cross-block state. Everything here is numpy on
+host threads: quantization happens at offload/fetch time, never inside
+a jitted step.
+
+A quantized *block record* is ``{"kvq8": 1, "pages": [entry, ...]}``
+with one entry per paged-cache leaf: ``{"kind": "q8", "q": int8,
+"scale": f32 [L, H], "dtype": <logical dtype str>}`` for float pages,
+or ``{"kind": "raw", "data": arr}`` passthrough for pages that are
+already integer (a kv-quantized device cache ships int8 k/v plus small
+float scale leaves — re-quantizing either would be lossy-on-lossy for
+zero density win). Records are self-describing, so one arena can hold
+native tuples and quantized records side by side (e.g. blocks fetched
+from an int8 peer into a native-mode node).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+LEVELS = 127.0
+# logical dtypes a q8 entry may restore to (wire meta is untrusted; an
+# unknown name must fail validation, not reach np.dtype())
+_FLOAT_NAMES = ("float32", "float16", "float64", "bfloat16")
+
+
+def _np_dtype(name: str) -> np.dtype:
+    if name == "bfloat16":
+        import ml_dtypes  # jax dependency, always present
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+def _is_float(dtype) -> bool:
+    return np.dtype(dtype).name in _FLOAT_NAMES
+
+
+def _scale_shape(qshape: Tuple[int, ...]) -> Tuple[int, int]:
+    """Scale dims for a q8 page: (layers, heads) == (axis 0, axis -2)."""
+    return (qshape[0], qshape[-2])
+
+
+def _broadcast(scale: np.ndarray, qshape: Tuple[int, ...]) -> np.ndarray:
+    keep = (0, len(qshape) - 2)
+    return scale.reshape([qshape[i] if i in keep else 1
+                          for i in range(len(qshape))])
+
+
+def quantize_page(page: np.ndarray) -> Dict:
+    """One paged-cache page -> a record entry (q8 or raw passthrough).
+
+    Only float pages with the full [layers, pos, heads, dim] rank
+    quantize; integer pages (kv-quantized device caches) and the small
+    low-rank float scale leaves that ride with them pass through."""
+    a = np.ascontiguousarray(page)
+    if a.ndim < 4 or not _is_float(a.dtype):
+        return {"kind": "raw", "data": a}
+    f = np.asarray(a, dtype=np.float32)
+    keep = (0, a.ndim - 2)
+    red = tuple(i for i in range(a.ndim) if i not in keep)
+    amax = np.max(np.abs(f), axis=red)                 # [L, H]
+    scale = (np.maximum(amax, 1e-8) / LEVELS).astype(np.float32)
+    q = np.clip(np.rint(f / _broadcast(scale, a.shape)), -127, 127)
+    return {"kind": "q8", "q": q.astype(np.int8), "scale": scale,
+            "dtype": np.dtype(a.dtype).name}
+
+
+def dequantize_page(entry: Dict) -> np.ndarray:
+    if entry["kind"] == "raw":
+        return entry["data"]
+    q = entry["q"]
+    deq = q.astype(np.float32) * _broadcast(entry["scale"], q.shape)
+    return np.ascontiguousarray(deq.astype(_np_dtype(entry["dtype"])))
+
+
+def quantize_block(pages: Sequence[np.ndarray]) -> Dict:
+    """All of one block's pages -> a self-describing block record."""
+    return {"kvq8": 1, "pages": [quantize_page(p) for p in pages]}
+
+
+def dequantize_block(record: Dict) -> tuple:
+    """Block record -> logical pages (the scatter-ready layout)."""
+    return tuple(dequantize_page(e) for e in record["pages"])
+
+
+def is_quantized_block(obj) -> bool:
+    return (isinstance(obj, dict) and "kvq8" in obj
+            and isinstance(obj.get("pages"), list))
+
+
+def stored_nbytes(record: Dict) -> int:
+    """Bytes the record actually occupies (q + scales + raw pages) —
+    what arena occupancy and wire accounting must count."""
+    n = 0
+    for e in record["pages"]:
+        if e["kind"] == "raw":
+            n += e["data"].nbytes
+        else:
+            n += e["q"].nbytes + e["scale"].nbytes
+    return n
+
+
+def logical_nbytes(record: Dict) -> int:
+    """Bytes of the full-precision pages the record restores to."""
+    n = 0
+    for e in record["pages"]:
+        if e["kind"] == "raw":
+            n += e["data"].nbytes
+        else:
+            n += e["q"].size * _np_dtype(e["dtype"]).itemsize
+    return n
+
+
+def logical_specs(record: Dict) -> List[Tuple[Tuple[int, ...], np.dtype]]:
+    """(shape, dtype) per restored page — what the fetch path checks
+    against the live paged-cache leaves before admitting a record."""
+    out = []
+    for e in record["pages"]:
+        if e["kind"] == "raw":
+            out.append((tuple(e["data"].shape), e["data"].dtype))
+        else:
+            out.append((tuple(e["q"].shape), _np_dtype(e["dtype"])))
+    return out
+
+
+# ----------------------------------------------------------------------
+# Wire flattening: a record crosses kvwire as a flat array list plus a
+# per-page meta list in the frame header. Reassembly validates every
+# declared shape/dtype relationship BEFORE the record is trusted — the
+# meta came off a socket.
+# ----------------------------------------------------------------------
+
+
+def wire_arrays(record: Dict) -> List[np.ndarray]:
+    """Flat stored-array list in page order (raw -> [data]; q8 ->
+    [q, scale]). Ships the arena representation as-is: no requantize,
+    no dequantize on send."""
+    out: List[np.ndarray] = []
+    for e in record["pages"]:
+        if e["kind"] == "raw":
+            out.append(e["data"])
+        else:
+            out.extend((e["q"], e["scale"]))
+    return out
+
+
+def wire_meta(record: Dict) -> List[Dict]:
+    """JSON-safe per-page meta for the frame header."""
+    out = []
+    for e in record["pages"]:
+        if e["kind"] == "raw":
+            out.append({"kind": "raw"})
+        else:
+            out.append({"kind": "q8", "dtype": e["dtype"]})
+    return out
+
+
+def block_from_wire(meta: List[Dict], arrays: List[np.ndarray]) -> Dict:
+    """Reassemble a block record from decoded wire arrays + header meta.
+
+    Raises ValueError on any inconsistency — unknown page kind, array
+    count mismatch, non-int8 q / non-f32 scale, a scale whose shape
+    disagrees with its q page, an unknown logical dtype, or non-finite
+    scale values (a NaN scale would silently poison every element it
+    dequantizes). Callers map ValueError to the codec's WireError so a
+    corrupt frame degrades to recompute, never a crash."""
+    pages: List[Dict] = []
+    i = 0
+    for m in meta:
+        kind = m.get("kind") if isinstance(m, dict) else None
+        if kind == "raw":
+            if i + 1 > len(arrays):
+                raise ValueError("kvq8 meta/payload count mismatch")
+            pages.append({"kind": "raw", "data": arrays[i]})
+            i += 1
+        elif kind == "q8":
+            if i + 2 > len(arrays):
+                raise ValueError("kvq8 meta/payload count mismatch")
+            q, scale = arrays[i], arrays[i + 1]
+            i += 2
+            dtype = m.get("dtype")
+            if dtype not in _FLOAT_NAMES:
+                raise ValueError(f"kvq8 bad logical dtype {dtype!r}")
+            if q.dtype != np.int8:
+                raise ValueError(f"kvq8 q page dtype {q.dtype}, want int8")
+            if scale.dtype != np.float32:
+                raise ValueError(
+                    f"kvq8 scale dtype {scale.dtype}, want float32")
+            if q.ndim < 4 or tuple(scale.shape) != _scale_shape(q.shape):
+                raise ValueError(
+                    f"kvq8 scale shape {tuple(scale.shape)} does not "
+                    f"match q page {tuple(q.shape)}")
+            if not np.isfinite(scale).all():
+                raise ValueError("kvq8 non-finite scale payload")
+            pages.append({"kind": "q8", "q": q, "scale": scale,
+                          "dtype": dtype})
+        else:
+            raise ValueError(f"kvq8 unknown page kind {kind!r}")
+    if i != len(arrays):
+        raise ValueError("kvq8 meta/payload count mismatch")
+    return {"kvq8": 1, "pages": pages}

@@ -44,7 +44,7 @@ import logging
 import os
 import threading
 import time
-from typing import Callable, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -54,6 +54,7 @@ from distributed_llm_inferencing_tpu.models import transformer
 from distributed_llm_inferencing_tpu.models.config import ModelConfig
 from distributed_llm_inferencing_tpu.models.params import init_params
 from distributed_llm_inferencing_tpu.native import BlockPool
+from distributed_llm_inferencing_tpu.ops import kvblock_quant as kvq
 from distributed_llm_inferencing_tpu.ops.paged_kvcache import init_paged_cache
 from distributed_llm_inferencing_tpu.ops.sampling import (
     SamplingParams, sample_batch)
@@ -61,6 +62,7 @@ from distributed_llm_inferencing_tpu.parallel import sharding as shd
 from distributed_llm_inferencing_tpu.parallel.mesh import (
     MeshSpec, create_mesh, validate_spec)
 from distributed_llm_inferencing_tpu.runtime import kvtier as kvtier_mod
+from distributed_llm_inferencing_tpu.runtime import kvwire as kvwire_mod
 from distributed_llm_inferencing_tpu.runtime import tsdb as tsdb_mod
 from distributed_llm_inferencing_tpu.utils import clock, locks, trace
 from distributed_llm_inferencing_tpu.utils.metrics import Metrics
@@ -427,9 +429,17 @@ class ContinuousBatcher:
                     "DLI_KV_HOST_MB", kvtier_mod.DEFAULT_HOST_MB))
             except ValueError:
                 kv_host_mb = kvtier_mod.DEFAULT_HOST_MB
+        # Arena storage dtype (ops/kvblock_quant.py): "native" keeps the
+        # exact device bytes (bitwise restore), "int8" packs ~3.9x more
+        # prefix tokens per MB and ships ~3.9x fewer wire bytes, at a
+        # bounded dequant error per restored block.
+        kv_dtype = os.environ.get("DLI_KV_HOST_DTYPE", "native")
+        if kv_dtype not in kvtier_mod.HOST_DTYPES:
+            kv_dtype = "native"
         self.kvtier = (kvtier_mod.KVTier(
             block_size, kv_host_mb,
-            digest_chunk=kv_digest_chunk or kvtier_mod.DIGEST_CHUNK)
+            digest_chunk=kv_digest_chunk or kvtier_mod.DIGEST_CHUNK,
+            dtype=kv_dtype)
             if kv_host_mb and kv_host_mb > 0 else None)
         if self.kvtier is not None:
             self.pool.set_evict_hook(self._offload_evicted)
@@ -438,13 +448,28 @@ class ContinuousBatcher:
         # conn accounting in the worker registry); a standalone batcher
         # builds its own lazily at the first kv_source admission.
         self.kv_fetcher = kv_fetcher
+        # Receive-overlapped restore (DLI_KV_WIRE_OVERLAP, default on):
+        # peer fetches stream through kvwire.FetchStream so the device
+        # scatter of block N overlaps the receive of block N+1; 0 falls
+        # back to the serial fetch-then-scatter path.
+        self._wire_overlap = os.environ.get(
+            "DLI_KV_WIRE_OVERLAP", "1") not in ("0", "false", "no", "")
+        # Single-flight prefetch registry: concurrent fetches to the
+        # same (peer, model) — shared-prefix fan-in, a dying node's mass
+        # drain — coalesce onto one leader transfer with the digest
+        # union deduped; waiters block on the leader's round and find
+        # the blocks arena-resident.
+        self._kvf_lock = locks.lock("batcher.kvfetch")
+        self._kvf_inflight: Dict[tuple, dict] = {}
         if self.kvtier is not None:
             # pre-register the transfer plane at 0 (PR 5 rule): the TSDB
             # catalog and a first scrape must see the counters exist
             for name in ("kv_transfer_blocks", "kv_transfer_bytes",
                          "kv_transfer_ms", "kv_transfer_failures",
-                         "kvtier_exported_blocks"):
+                         "kvtier_exported_blocks",
+                         "kv_prefetch_coalesced"):
                 self.metrics.inc(name, 0)
+            self.metrics.gauge("kv_restore_overlap_ratio", 0.0)
         self._restore_fns = {}        # restore-scatter jits per row bucket
         self._last_pool_stats = {}    # radix counter -> metrics delta base
         # cost-ledger attribution: the request whose admission prep is
@@ -1157,9 +1182,10 @@ class ContinuousBatcher:
         radix match, register them in the radix tree, and return the
         extended (prefix_blocks, cached). Opportunistic — any failure
         (no free device blocks, arena LRU race) simply falls back to
-        prefilling that span. Restored bytes are the exact evicted
-        bytes, so downstream outputs are bitwise identical to a cold
-        prefill."""
+        prefilling that span. In native arena mode the restored bytes
+        are the exact evicted bytes, so downstream outputs are bitwise
+        identical to a cold prefill; in int8 mode they are the
+        bounded-error dequant (ops/kvblock_quant.py)."""
         bs = self.block_size
         start = cached // bs
         limit = (n - 1) // bs   # >=1 token must remain for the tail
@@ -1216,60 +1242,141 @@ class ContinuousBatcher:
         return self.kv_fetcher
 
     def _fetch_into_arena(self, url, model, prompt, limit,
-                          start: int = 0) -> int:
+                          start: int = 0, progress=None) -> int:
         """Pull the arena-missing chain digests of ``prompt``'s blocks
         ``[start, limit)`` from the peer at ``url`` into the LOCAL host
-        arena. Fetched bytes are the peer's exact evicted/exported
-        device bytes, so a restore from them stays bitwise identical to
-        a cold prefill. Strictly opportunistic: ANY failure —
+        arena. A native peer's bytes are its exact evicted/exported
+        device bytes (restore stays bitwise identical to a cold
+        prefill); an int8 peer ships quantized records that restore to
+        a bounded-error dequant. Strictly opportunistic: ANY failure —
         transport, corrupt frame, peer missing the blocks, shape drift
         — degrades to recompute, never to a request failure. Returns
-        the bytes stored (0 on failure)."""
+        the wire bytes stored (0 on failure).
+
+        Single-flight: concurrent calls against the same (peer, model)
+        — shared-prefix fan-in, the drain of a dying node's whole
+        resident set — coalesce. The first caller leads and fetches the
+        deduped union of every caller's still-missing digests (one
+        socket, batched rounds while new waiters keep arriving);
+        waiters block on the leader and find their blocks
+        arena-resident, so each digest crosses the wire exactly once.
+        ``progress(stream)``, if given, runs on the LEADER's thread
+        after each block lands (the receive-overlap consumer hook)."""
         bs = self.block_size
         digs = self.kvtier.block_digests(prompt[:limit * bs])
         want = [d for d in digs[start:limit]
                 if not self.kvtier.arena.peek(d)]
         if not want:
             return 0
+        key = (str(url), str(model))
+        with self._kvf_lock:
+            fl = self._kvf_inflight.get(key)
+            leader = fl is None
+            if leader:
+                # dict-as-ordered-set: consecutive digest order survives
+                # the dedup, so the leader's batch streams in scatter
+                # order
+                fl = {"pending": dict.fromkeys(want, True),
+                      "event": threading.Event()}
+                self._kvf_inflight[key] = fl
+            else:
+                for d in want:
+                    fl["pending"].setdefault(d, True)
+        if not leader:
+            self.metrics.inc("kv_prefetch_coalesced")
+            # leader guarantees the event fires (finally below); the
+            # timeout is a backstop so a stuck transfer can only stall
+            # this caller as long as its own fetch could have
+            fl["event"].wait(timeout=90.0)
+            return 0
+        total = 0
+        try:
+            while True:
+                with self._kvf_lock:
+                    batch = [d for d in fl["pending"]
+                             if not self.kvtier.arena.peek(d)]
+                    fl["pending"].clear()
+                if not batch:
+                    break
+                total += self._wire_fetch(url, model, batch,
+                                          progress=progress)
+                # digests still missing after the round (peer didn't
+                # have them / validation refused them) were cleared
+                # above: only NEW waiters' digests survive into the
+                # next round, so the loop terminates when arrivals do
+        finally:
+            with self._kvf_lock:
+                self._kvf_inflight.pop(key, None)
+            fl["event"].set()
+        return total
+
+    def _admit_fetched(self, digest, obj, expect) -> bool:
+        """Shape/dtype-check one fetched block against the live paged
+        leaves BEFORE the arena sees it: a buggy/mismatched peer
+        (different model or cache config) must degrade to recompute,
+        not crash the scheduler thread inside the restore scatter.
+        Quantized records check their LOGICAL specs — what they will
+        dequantize to at restore time."""
+        if kvq.is_quantized_block(obj):
+            specs = kvq.logical_specs(obj)
+        else:
+            specs = [(tuple(p.shape), p.dtype) for p in obj]
+        if (len(specs) != len(expect)
+                or any(shp != eshp or dt != edt
+                       for (shp, dt), (eshp, edt) in zip(specs, expect))):
+            self.metrics.inc("kv_transfer_failures")
+            return False
+        return self.kvtier.arena.put(digest, obj, count_offload=False)
+
+    def _wire_fetch(self, url, model, want, progress=None) -> int:
+        """One wire transfer of ``want`` digests (single-flight leader
+        body). Streams frames through kvwire.FetchStream when
+        DLI_KV_WIRE_OVERLAP is on — each block is validated and
+        arena-admitted as its frame decodes, with ``progress`` driving
+        the caller's overlap consumer — else one blocking fetch.
+        Mid-stream faults keep the blocks that already landed (valid
+        arena entries); the rest recomputes."""
         fetcher = self._get_kv_fetcher()
         if fetcher is None:
             return 0
-        w0 = clock.now()
-        try:
-            got = fetcher.fetch(url, model, want)
-        except Exception as e:
-            self.metrics.inc("kv_transfer_failures")
-            trace.get_tracer().record(
-                "batcher.kv_fetch", w0, clock.now(),
-                attrs={"peer": url, "error": str(e)[:200]})
-            return 0
-        # shape-check against the live paged leaves BEFORE the arena
-        # sees anything: a buggy/mismatched peer (different model or
-        # cache config) must degrade to recompute here, not crash the
-        # scheduler thread inside the restore scatter
         live = [lf for lf in self.paged if lf is not None]
         expect = [((lf.shape[0],) + tuple(lf.shape[2:]), lf.dtype)
                   for lf in live]
+        w0 = clock.now()
         blocks = bytes_in = 0
-        for d in want:
-            pages = got.get(d)
-            if pages is None:
-                continue           # peer didn't have it: plain recompute
-            if (len(pages) != len(expect)
-                    or any(tuple(p.shape) != shp or p.dtype != dt
-                           for p, (shp, dt) in zip(pages, expect))):
-                self.metrics.inc("kv_transfer_failures")
-                continue
-            if self.kvtier.arena.put(d, pages, count_offload=False):
-                blocks += 1
-                bytes_in += sum(p.nbytes for p in pages)
+        err = None
+        try:
+            # injected fetchers may implement only the blocking API;
+            # overlap is an optimization, not a contract
+            if self._wire_overlap and hasattr(fetcher, "fetch_stream"):
+                stream = fetcher.fetch_stream(url, model, want)
+                for d, obj in stream:
+                    if self._admit_fetched(d, obj, expect):
+                        blocks += 1
+                        bytes_in += kvwire_mod.stored_nbytes(obj)
+                        if progress is not None:
+                            progress(stream)
+            else:
+                got = fetcher.fetch(url, model, want)
+                for d in want:
+                    obj = got.get(d)
+                    if obj is None:
+                        continue   # peer didn't have it: plain recompute
+                    if self._admit_fetched(d, obj, expect):
+                        blocks += 1
+                        bytes_in += kvwire_mod.stored_nbytes(obj)
+        except Exception as e:
+            self.metrics.inc("kv_transfer_failures")
+            err = str(e)[:200]
         elapsed = clock.now() - w0
         self.metrics.inc("kv_transfer_blocks", blocks)
         self.metrics.inc("kv_transfer_bytes", bytes_in)
         self.metrics.inc("kv_transfer_ms", elapsed * 1e3)
+        attrs = {"peer": url, "blocks": blocks, "bytes": bytes_in}
+        if err:
+            attrs["error"] = err
         trace.get_tracer().record(
-            "batcher.kv_fetch", w0, clock.now(),
-            attrs={"peer": url, "blocks": blocks, "bytes": bytes_in})
+            "batcher.kv_fetch", w0, clock.now(), attrs=attrs)
         return bytes_in
 
     def prefetch_kv(self, prompt: Sequence[int], kv_source) -> int:
@@ -1298,32 +1405,66 @@ class ContinuousBatcher:
             self.metrics.inc("kv_transfer_failures")
             return 0
 
-    def _restore_from_peer(self, req, prompt, n, cached):
+    def _restore_from_peer(self, req, prompt, n, prefix_blocks, cached):
         """Scheduler-thread fallback of :meth:`prefetch_kv` for direct
         batcher users (the worker prefetches at submit time instead and
         clears ``kv_source``): pull the request's missing block digests
-        from its designated peer into the local arena, then let the
-        ordinary ``_restore_from_arena`` scatter take over. One peer
-        RPC per request."""
+        from its designated peer into the local arena. With
+        DLI_KV_WIRE_OVERLAP (the default) the transfer is
+        receive-overlapped: as frames land in the arena, every ~8
+        blocks the consecutive run scatters to device through the
+        ordinary ``_restore_from_arena`` machinery WHILE the receiver
+        thread keeps pulling later frames off the socket — scatter of
+        block N overlaps receive of block N+1 instead of paying
+        fetch-then-scatter serially. The achieved overlap (scatter
+        seconds inside the transfer wall, as a fraction) lands in the
+        ``kv_restore_overlap_ratio`` gauge. Returns the (possibly
+        extended) ``(prefix_blocks, cached)``."""
         src = req.kv_source
         if (src is None or req._peer_fetch_done or self.kvtier is None
                 or self.program_hook is not None):
-            return
+            return prefix_blocks, cached
         url = src.get("url") if isinstance(src, dict) else None
         if not url:
             req._peer_fetch_done = True
-            return
+            return prefix_blocks, cached
         bs = self.block_size
         start = cached // bs
         limit = (n - 1) // bs
         if start >= limit:
-            return
+            return prefix_blocks, cached
         digs = self.kvtier.block_digests(prompt[:limit * bs])
         if all(self.kvtier.arena.peek(d) for d in digs[start:limit]):
-            return                  # nothing missing: no RPC, no flag
+            return prefix_blocks, cached   # nothing missing: no RPC, no flag
         req._peer_fetch_done = True
-        req._kv_transfer_bytes += self._fetch_into_arena(
-            url, str(src.get("model") or ""), prompt, limit, start=start)
+        state = {"pb": prefix_blocks, "cached": cached,
+                 "arrived": 0, "overlap_s": 0.0}
+
+        def scatter_ready(stream):
+            # the overlap consumer: runs on THIS (scheduler) thread
+            # between the leader's frame decodes; ~8-block chunks
+            # amortize the per-scatter digest walk and jit dispatch
+            state["arrived"] += 1
+            if state["arrived"] < 8 and not stream.receiving_done:
+                return
+            state["arrived"] = 0
+            t0 = clock.now()
+            receiving = not stream.receiving_done
+            state["pb"], state["cached"] = self._restore_from_arena(
+                prompt, n, state["pb"], state["cached"])
+            if receiving:
+                state["overlap_s"] += clock.now() - t0
+
+        w0 = clock.now()
+        got = self._fetch_into_arena(
+            url, str(src.get("model") or ""), prompt, limit, start=start,
+            progress=scatter_ready if self._wire_overlap else None)
+        req._kv_transfer_bytes += got
+        wall = clock.now() - w0
+        if got and self._wire_overlap and wall > 0:
+            self.metrics.gauge("kv_restore_overlap_ratio",
+                               min(1.0, state["overlap_s"] / wall))
+        return state["pb"], state["cached"]
 
     def _export_request_kv(self, req, seq=None, n_ctx=None):
         """KV export into the host arena under token-chain digests —
@@ -1486,7 +1627,11 @@ class ContinuousBatcher:
         if self.kvtier is not None:
             a = self.kvtier.arena.stats()
             self.metrics.gauge("kvtier_host_blocks", a["blocks"])
+            # stored (possibly quantized) bytes — the honest budget
+            # fraction; logical_bytes is the full-precision equivalent,
+            # so stored/logical exposes the arena's compression ratio
             self.metrics.gauge("kvtier_host_bytes", a["bytes"])
+            self.metrics.gauge("kvtier_logical_bytes", a["logical_bytes"])
             self.metrics.gauge(
                 "kvtier_occupancy",
                 a["bytes"] / max(1, a["capacity_bytes"]))
@@ -1507,13 +1652,17 @@ class ContinuousBatcher:
         prefix_blocks, cached = self.pool.match_prefix(prompt[:n - 1])
         if self.kvtier is not None and self.program_hook is None:
             # tier 2b: a disaggregated request pulls its missing prefix
-            # blocks from the prefill peer into the local arena first
-            # (runtime/kvwire.py; any failure degrades to recompute) ...
-            self._restore_from_peer(req, prompt, n, cached)
+            # blocks from the prefill peer, receive-overlapped — the
+            # consecutive runs scatter while later frames are still on
+            # the wire (runtime/kvwire.py; any failure degrades to
+            # recompute) ...
+            prefix_blocks, cached = self._restore_from_peer(
+                req, prompt, n, prefix_blocks, cached)
             # ... then tier 2: extend the radix match from the host
-            # arena before falling back to recompute (multi-host
-            # lockstep opts out — a host-initiated scatter cannot ride
-            # the program broadcast)
+            # arena — the streamed tail plus anything already resident —
+            # before falling back to recompute (multi-host lockstep opts
+            # out: a host-initiated scatter cannot ride the program
+            # broadcast)
             prefix_blocks, cached = self._restore_from_arena(
                 prompt, n, prefix_blocks, cached)
         tail_alloc = []

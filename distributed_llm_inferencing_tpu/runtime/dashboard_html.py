@@ -224,6 +224,7 @@ const TS_METRICS = [
   ['batcher_free_kv_blocks', 'free KV blocks (per node)'],
   ['prefix_hit_ratio', 'prefix-cache hit ratio'],
   ['kv_transfer_bytes', 'KV transfer B/s (rate, per node)'],
+  ['kv_wire_compression', 'KV wire compression (logical/sent, per node)'],
   ['worker_role', 'role (0 mixed / 1 prefill / 2 decode)'],
   ['breaker_state', 'breaker (0 closed / 1 half-open / 2 open)'],
   ['slo_attainment', 'SLO attainment (master)'],

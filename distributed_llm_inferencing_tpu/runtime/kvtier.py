@@ -46,10 +46,16 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from distributed_llm_inferencing_tpu.ops import kvblock_quant as kvq
 from distributed_llm_inferencing_tpu.utils import locks
 
 # Host arena budget (MB). 0 disables the offload tier entirely.
 DEFAULT_HOST_MB = 256.0
+# Arena storage dtype: "native" keeps the exact device bytes (bitwise
+# restore guarantee); "int8" stores blocks per-(layer, head) quantized
+# (ops/kvblock_quant.py) — ~3.9x more prefix tokens per MB, restores
+# are dequantized (lossy) approximations of the evicted KV.
+HOST_DTYPES = ("native", "int8")
 # Prompt-text chunk size (bytes of the UTF-8 encoding) for prefix-digest
 # chains. Master and workers must agree — both read this env.
 DIGEST_CHUNK = max(1, int(os.environ.get("DLI_PREFIX_DIGEST_CHUNK", 256)))
@@ -107,13 +113,27 @@ class HostKVArena:
     LRU order; inserting past the byte budget drops the LRU entry.
     Thread-safe: the batcher thread offloads/restores while HTTP handler
     threads read ``stats()``.
+
+    ``dtype="int8"`` stores each inserted block as a quantized record
+    (ops/kvblock_quant.py) instead of the raw pages: ~3.9x more blocks
+    in the same budget, at the cost of the bitwise-restore guarantee
+    for arena-served blocks. Whatever the mode, entries are
+    self-describing — an already-quantized record (fetched from an int8
+    peer) is stored as-is, never requantized — and ``_bytes`` /
+    ``occupancy`` count STORED bytes, so the arena-full routing guard
+    (DLI_SCHED_ARENA_FULL) sees the honest budget either way.
     """
 
-    def __init__(self, capacity_bytes: int):
+    def __init__(self, capacity_bytes: int, dtype: str = "native"):
+        if dtype not in HOST_DTYPES:
+            raise ValueError(
+                f"unknown arena dtype {dtype!r}; known: {HOST_DTYPES}")
         self.capacity_bytes = int(capacity_bytes)
+        self.dtype = dtype
         self._lock = locks.lock("kvtier.arena")
         self._entries: "OrderedDict[str, Tuple[tuple, int]]" = OrderedDict()
         self._bytes = 0
+        self._logical_bytes = 0
         self.hits = 0
         self.misses = 0
         self.offloaded = 0
@@ -132,29 +152,45 @@ class HostKVArena:
         export) out of the ``offloaded`` counter — that stat means
         device-eviction offloads, and the TSDB series charting it must
         not spike when a decode node merely pulls blocks over the
-        wire."""
-        pages = tuple(np.ascontiguousarray(p) for p in pages)
-        nbytes = sum(p.nbytes for p in pages)
-        if nbytes > self.capacity_bytes:
+        wire. ``pages`` may be raw device pages OR an already-quantized
+        block record (a peer fetch from an int8 node) — records store
+        as-is; raw pages quantize first when this arena is int8."""
+        if kvq.is_quantized_block(pages):
+            obj = pages
+            stored = kvq.stored_nbytes(obj)
+            logical = kvq.logical_nbytes(obj)
+        elif self.dtype == "int8":
+            obj = kvq.quantize_block(pages)
+            stored = kvq.stored_nbytes(obj)
+            logical = kvq.logical_nbytes(obj)
+        else:
+            obj = tuple(np.ascontiguousarray(p) for p in pages)
+            stored = logical = sum(p.nbytes for p in obj)
+        if stored > self.capacity_bytes:
             return False
         with self._lock:
             old = self._entries.pop(digest, None)
             if old is not None:
                 self._bytes -= old[1]
-            self._entries[digest] = (pages, nbytes)
-            self._bytes += nbytes
+                self._logical_bytes -= old[2]
+            self._entries[digest] = (obj, stored, logical)
+            self._bytes += stored
+            self._logical_bytes += logical
             if count_offload:
                 self.offloaded += 1
             while self._bytes > self.capacity_bytes and self._entries:
-                _, (_, freed) = self._entries.popitem(last=False)
+                _, (_, freed, lfreed) = self._entries.popitem(last=False)
                 self._bytes -= freed
+                self._logical_bytes -= lfreed
                 self.dropped += 1
         return True
 
     def get(self, digest: str) -> Optional[tuple]:
         """Pages for ``digest`` (LRU-touched), or None. The entry STAYS
         in the arena: a restored block may be radix-evicted again later,
-        and re-offloading identical content would be wasted copies."""
+        and re-offloading identical content would be wasted copies.
+        Quantized entries dequantize here — the caller always sees
+        scatter-ready logical pages."""
         with self._lock:
             hit = self._entries.get(digest)
             if hit is None:
@@ -163,7 +199,10 @@ class HostKVArena:
             self._entries.move_to_end(digest)
             self.hits += 1
             self.restored += 1
-            return hit[0]
+            obj = hit[0]
+        if kvq.is_quantized_block(obj):
+            return kvq.dequantize_block(obj)
+        return obj
 
     def peek(self, digest: str) -> bool:
         """Membership without touching hit/miss accounting (used to size
@@ -177,6 +216,19 @@ class HostKVArena:
         peer's behalf, and counting that as a local restore would make
         the arena's own tiering stats lie. LRU order is still touched:
         a block peers keep pulling is a block worth keeping resident."""
+        obj = self.peek_stored(digest)
+        if obj is None:
+            return None
+        if kvq.is_quantized_block(obj):
+            return kvq.dequantize_block(obj)
+        return obj
+
+    def peek_stored(self, digest: str):
+        """The STORED object for ``digest`` — raw page tuple or
+        quantized record — without hit/miss accounting. The /kv_fetch
+        export path ships this representation as-is: a quantized block
+        crosses the wire quantized (no requantize, no dequantize on
+        send), so the sender's CPU cost is a memcpy either way."""
         with self._lock:
             hit = self._entries.get(digest)
             if hit is None:
@@ -195,8 +247,14 @@ class HostKVArena:
                     # occupancy fraction rides /health into the master's
                     # runtime snapshot: the scheduler keeps prefill off
                     # nodes whose arena would evict what a decode peer
-                    # is about to fetch (DLI_SCHED_ARENA_FULL)
+                    # is about to fetch (DLI_SCHED_ARENA_FULL). Counts
+                    # STORED (possibly quantized) bytes — the honest
+                    # budget fraction; logical_bytes carries the
+                    # full-precision equivalent so the compression
+                    # ratio is derivable fleet-wide.
                     "occupancy": self._bytes / max(1, self.capacity_bytes),
+                    "dtype": self.dtype,
+                    "logical_bytes": self._logical_bytes,
                     "hits": self.hits, "misses": self.misses,
                     "offloaded": self.offloaded, "restored": self.restored,
                     "dropped": self.dropped}
@@ -321,9 +379,11 @@ class KVTier:
 
     def __init__(self, block_size: int, capacity_mb: float,
                  digest_chunk: int = DIGEST_CHUNK,
-                 digest_top_k: int = DIGEST_TOP_K):
+                 digest_top_k: int = DIGEST_TOP_K,
+                 dtype: str = "native"):
         self.block_size = int(block_size)
-        self.arena = HostKVArena(int(capacity_mb * 1024 * 1024))
+        self.arena = HostKVArena(int(capacity_mb * 1024 * 1024),
+                                 dtype=dtype)
         self.index = PrefixDigestIndex(digest_chunk, digest_top_k)
 
     def block_digests(self, tokens: Sequence[int]) -> List[str]:

@@ -16,16 +16,27 @@ Wire format (one chunked ``application/octet-stream`` response)::
                 | hdr(JSON, hdr_len bytes) | payload(payload_len bytes)
     hdr      := {"digest": str, "pages": [{"dtype": str, "shape": [...]},
                  ...]}                          # one block's pages
-              | {"end": true, "served": int, "missing": [...],
-                 "truncated": int}              # terminal frame, no payload
+              | {"digest": str, "quant": "kvq8", "pages": [...specs...],
+                 "meta": [{"kind": "raw"|"q8", ...}, ...]}
+                                                # int8-quantized block
+              | {"end": true, "served": int, "served_bytes": int,
+                 "missing": [...], "truncated": int}
+                                                # terminal frame, no payload
     payload  := concatenated C-order page bytes, in hdr order
 
 The payload is the arena entry's exact bytes — the same bytes the radix
 cache evicted on the source — so a restore from a fetched block stays
-bitwise identical to a cold prefill. Every structural surprise (bad
-magic, over-cap lengths, short read, shape/dtype drift) raises
-:class:`WireError`; the caller treats any failure as "recompute", never
-as a request failure.
+bitwise identical to a cold prefill. An int8 arena (DLI_KV_HOST_DTYPE)
+ships its blocks as ``kvq8`` frames: the stored q/scale arrays as-is
+(no requantize on send), with per-page meta the receiver validates
+(ops/kvblock_quant.py ``block_from_wire``) before trusting a record.
+Every structural surprise (bad magic, over-cap lengths, short read,
+shape/dtype drift, inconsistent quant meta) raises :class:`WireError`;
+the caller treats any failure as "recompute", never as a request
+failure. The terminal frame carries ``served``/``served_bytes`` so a
+size-capped partial (clean close after N blocks) is distinguishable
+from a mid-stream disconnect and the recompute fallback can be sized
+to what is actually missing.
 
 :class:`KVFetchClient` is the pull side: per-peer pooled keep-alive
 ``requests.Session`` with ``(connect, read)`` timeout tuples, breaker-
@@ -45,6 +56,7 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from distributed_llm_inferencing_tpu.ops import kvblock_quant as kvq
 from distributed_llm_inferencing_tpu.utils import clock, locks
 
 log = logging.getLogger("dli.kvwire")
@@ -80,15 +92,55 @@ def encode_frame(digest: str, pages: Sequence[np.ndarray]) -> bytes:
     return MAGIC + _HDR_STRUCT.pack(len(hdr), len(payload)) + hdr + payload
 
 
+def encode_kvq_frame(digest: str, record: dict) -> bytes:
+    """One int8-quantized block record as a ``kvq8`` frame: the stored
+    q/scale arrays ship as-is (no requantize on send), the header's
+    ``meta`` tells the receiver how to reassemble and validate them."""
+    arrays = [np.ascontiguousarray(a) for a in kvq.wire_arrays(record)]
+    hdr = json.dumps({
+        "digest": str(digest), "quant": "kvq8",
+        "pages": [{"dtype": a.dtype.str, "shape": list(a.shape)}
+                  for a in arrays],
+        "meta": kvq.wire_meta(record)}).encode()
+    payload = b"".join(a.tobytes() for a in arrays)
+    return MAGIC + _HDR_STRUCT.pack(len(hdr), len(payload)) + hdr + payload
+
+
+def encode_stored(digest: str, obj) -> bytes:
+    """Frame for whatever representation the arena stored — raw page
+    tuple or quantized record — without converting either way."""
+    if kvq.is_quantized_block(obj):
+        return encode_kvq_frame(digest, obj)
+    return encode_frame(digest, obj)
+
+
+def stored_nbytes(obj) -> int:
+    """Payload bytes ``encode_stored`` will ship for an arena entry."""
+    if kvq.is_quantized_block(obj):
+        return kvq.stored_nbytes(obj)
+    return sum(int(p.nbytes) for p in obj)
+
+
+def logical_nbytes(obj) -> int:
+    """Full-precision bytes the entry restores to (the raw-wire cost a
+    quantized transfer avoided — the compression accounting's numerator)."""
+    if kvq.is_quantized_block(obj):
+        return kvq.logical_nbytes(obj)
+    return sum(int(p.nbytes) for p in obj)
+
+
 def encode_end(served: int, missing: Sequence[str],
-               truncated: int = 0) -> bytes:
+               truncated: int = 0, served_bytes: int = 0) -> bytes:
     """Terminal frame: how the stream ended, so a short-but-clean close
     is distinguishable from a mid-stream disconnect. The missing LIST is
     capped (a 4096-digest fetch against a cold arena would otherwise
     build a header past the decoder's MAX_HDR_BYTES and fail the whole
-    stream); ``missing_count`` always carries the true total."""
+    stream); ``missing_count`` always carries the true total, and
+    ``served``/``served_bytes`` carry what actually crossed the wire so
+    a size-capped partial sizes its recompute fallback honestly."""
     missing = list(missing)
     hdr = json.dumps({"end": True, "served": int(served),
+                      "served_bytes": int(served_bytes),
                       "missing": missing[:256],
                       "missing_count": len(missing),
                       "truncated": int(truncated)}).encode()
@@ -117,15 +169,18 @@ class _StreamReader:
         return out
 
 
-def decode_frames(chunks: Iterable[bytes],
-                  max_total_bytes: Optional[int] = None
-                  ) -> Tuple[Dict[str, List[np.ndarray]], dict]:
-    """Parse a /kv_fetch response stream into {digest: pages} plus the
-    terminal frame's header. Raises :class:`WireError` on any structural
-    problem — including a stream that ends without its terminal frame
-    (a mid-stream disconnect must not pass for a clean short answer)."""
+def iter_frames(chunks: Iterable[bytes],
+                max_total_bytes: Optional[int] = None):
+    """Incrementally decode a /kv_fetch stream: yields
+    ``("block", digest, obj)`` per block frame — ``obj`` is the page
+    list for raw frames or the quantized record for ``kvq8`` frames —
+    then ``("end", hdr)`` for the terminal frame, exactly once. Raises
+    :class:`WireError` on any structural problem, including a stream
+    that ends without its terminal frame (a mid-stream disconnect must
+    not pass for a clean short answer). The streaming restore path
+    consumes this a frame at a time so scatter of block N can overlap
+    receive of block N+1."""
     rd = _StreamReader(chunks)
-    out: Dict[str, List[np.ndarray]] = {}
     total = 0
     while True:
         head = rd.read(4 + _HDR_STRUCT.size)
@@ -141,7 +196,8 @@ def decode_frames(chunks: Iterable[bytes],
         if not isinstance(hdr, dict):
             raise WireError("frame header is not an object")
         if hdr.get("end"):
-            return out, hdr
+            yield ("end", hdr)
+            return
         total += payload_len
         if max_total_bytes is not None and total > max_total_bytes:
             raise WireError(f"stream exceeds byte cap ({max_total_bytes})")
@@ -170,7 +226,128 @@ def decode_frames(chunks: Iterable[bytes],
             off += nbytes
         if off != len(payload):
             raise WireError("frame payload longer than page specs")
-        out[digest] = pages
+        if hdr.get("quant") is not None:
+            if hdr["quant"] != "kvq8":
+                raise WireError(
+                    f"unknown frame quant scheme {hdr['quant']!r}")
+            meta = hdr.get("meta")
+            if not isinstance(meta, list):
+                raise WireError("kvq8 frame missing meta")
+            # the meta crossed the wire: every shape/dtype relationship
+            # it declares is validated before the record is trusted
+            try:
+                obj = kvq.block_from_wire(meta, pages)
+            except ValueError as e:
+                raise WireError(str(e))
+            yield ("block", digest, obj)
+        else:
+            yield ("block", digest, pages)
+
+
+def decode_frames(chunks: Iterable[bytes],
+                  max_total_bytes: Optional[int] = None
+                  ) -> Tuple[Dict[str, object], dict]:
+    """Parse a whole /kv_fetch response stream into {digest: block}
+    (pages list or quantized record) plus the terminal frame's header."""
+    out: Dict[str, object] = {}
+    for item in iter_frames(chunks, max_total_bytes=max_total_bytes):
+        if item[0] == "end":
+            return out, item[1]
+        out[item[1]] = item[2]
+    raise WireError("stream ended without terminal frame")
+
+
+class FetchStream:
+    """One in-flight streaming /kv_fetch: a receiver thread pumps the
+    socket through the frame decoder into a bounded queue while the
+    caller consumes blocks — so the caller's device scatter of block N
+    overlaps the receive+decode of block N+1 instead of paying
+    fetch-then-scatter serially.
+
+    Iterate to get ``(digest, block)`` pairs (block = page list or
+    quantized record); after clean exhaustion ``end`` holds the
+    terminal-frame header. Transport/stream faults re-raise in the
+    consumer as :class:`KVFetchError`/:class:`WireError` (after purging
+    the peer's pooled session). ``receiving_done`` flips True the
+    moment the socket side finishes — the consumer samples it per
+    scatter to measure the overlap fraction it actually achieved.
+    Abandoning the iterator early (consumer exception) closes the
+    response and drains the queue so the receiver thread always exits
+    and the client's concurrency slot is always released."""
+
+    def __init__(self, client: "KVFetchClient", base_url: str, resp,
+                 sess, allowed, depth: int):
+        import queue
+        import threading
+        self._client = client
+        self._base_url = base_url
+        self._resp = resp
+        self._sess = sess
+        self._allowed = allowed
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, int(depth)))
+        self.end: Optional[dict] = None
+        self.receiving_done = False
+        self._finished = False
+        self._thread = threading.Thread(
+            target=self._pump, name="dli-kvwire-recv", daemon=True)
+        self._thread.start()
+
+    def _pump(self) -> None:
+        import requests as http
+        try:
+            for item in iter_frames(
+                    self._resp.iter_content(chunk_size=1 << 18),
+                    max_total_bytes=self._client.max_bytes):
+                if item[0] == "end":
+                    self.receiving_done = True
+                self._q.put(item)
+        except WireError as e:
+            self.receiving_done = True
+            self._q.put(e)
+        except (http.exceptions.RequestException, OSError) as e:
+            self.receiving_done = True
+            self._q.put(KVFetchError(f"kv_fetch transport failed: {e}"))
+        finally:
+            self.receiving_done = True
+            try:
+                self._resp.close()
+            except Exception as e:
+                log.debug("kv_fetch stream close failed: %r", e)
+
+    def __iter__(self):
+        try:
+            while True:
+                item = self._q.get()
+                if isinstance(item, Exception):
+                    self._client.purge(self._base_url)
+                    raise item
+                if item[0] == "end":
+                    self.end = item[1]
+                    self._client._count_conn_reuse(self._sess)
+                    return
+                _, digest, obj = item
+                if digest in self._allowed:
+                    yield digest, obj
+        finally:
+            self._finish()
+
+    def _finish(self) -> None:
+        if self._finished:
+            return
+        self._finished = True
+        import queue
+        try:
+            self._resp.close()
+        except Exception as e:
+            log.debug("kv_fetch stream close failed: %r", e)
+        # drain until the receiver exits: it may be blocked on a full
+        # queue, and the semaphore slot must not leak with it
+        while self._thread.is_alive():
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                self._thread.join(timeout=0.05)
+        self._client._sem.release()
 
 
 class KVFetchClient:
@@ -215,6 +392,16 @@ class KVFetchClient:
         except ValueError:
             conc = 4
         self._sem = threading.BoundedSemaphore(max(1, conc))
+        # Streaming-restore handoff depth (blocks) between the socket-
+        # receiver thread and the scatter consumer: deep enough to ride
+        # out scatter jitter, shallow enough that a slow consumer
+        # backpressures the socket instead of buffering the whole
+        # transfer in host RAM twice.
+        try:
+            qd = int(os.environ.get("DLI_KV_WIRE_QUEUE", 4))
+        except ValueError:
+            qd = 4
+        self.queue_depth = max(1, qd)
         # pre-register (PR 5 rule): a scrape must be able to tell "no
         # transfers yet" from "metric not exported"
         self.metrics.inc("worker_peer_conns_created", 0)
@@ -285,13 +472,12 @@ class KVFetchClient:
         f = self.faults.intercept(f"rpc:{path}")
         if f is None:
             return
-        import time as _time
         import requests as http
         if f.mode == "latency":
-            _clock.sleep(f.delay_s)
+            clock.sleep(f.delay_s)
             return
         if f.delay_s:
-            _clock.sleep(f.delay_s)
+            clock.sleep(f.delay_s)
         if f.mode == "timeout":
             raise http.exceptions.ReadTimeout("injected kv_fetch timeout")
         raise http.exceptions.ConnectionError("injected kv_fetch fault")
@@ -348,3 +534,43 @@ class KVFetchClient:
         self._count_conn_reuse(sess)
         allowed = set(digests)
         return {d: pages for d, pages in blocks.items() if d in allowed}
+
+    def fetch_stream(self, base_url: str, model: str,
+                     digests: Sequence[str]) -> FetchStream:
+        """Streaming twin of :meth:`fetch`: returns a
+        :class:`FetchStream` whose iterator hands blocks over as their
+        frames decode, receive running ahead on a bounded queue.
+        Connect-time refusals raise here exactly like ``fetch``;
+        mid-stream faults surface from the iterator. The concurrency
+        slot is held until the stream finishes (clean, faulted, or
+        abandoned) — a streaming fetch is still one in-flight fetch."""
+        import requests as http
+        base_url = base_url.rstrip("/")
+        digests = [str(d) for d in digests][:MAX_DIGESTS]
+        if not self._sem.acquire(blocking=False):
+            self.metrics.inc("kv_fetch_queued")
+            self._sem.acquire()
+        try:
+            self._rpc_fault("/kv_fetch")
+            sess = self._session(base_url)
+            headers = ({"Authorization": f"Bearer {self.auth_key}"}
+                       if self.auth_key else {})
+            try:
+                r = sess.post(f"{base_url}/kv_fetch",
+                              json={"model_name": model,
+                                    "digests": digests},
+                              headers=headers, timeout=self.timeout,
+                              stream=True)
+            except Exception:
+                self.purge(base_url)
+                raise
+            if r.status_code != 200:
+                body = r.text[:200]
+                r.close()
+                raise KVFetchError(
+                    f"kv_fetch refused ({r.status_code}): {body}")
+        except BaseException:
+            self._sem.release()
+            raise
+        return FetchStream(self, base_url, r, sess, set(digests),
+                           self.queue_depth)

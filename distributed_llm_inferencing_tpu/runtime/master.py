@@ -391,6 +391,16 @@ class Master:
         # learned from the cost ledger — the recompute side of the
         # transfer-vs-recompute decision
         self._prefill_ewma: Dict[str, float] = {}
+        # the transfer side of the same decision, learned from the new
+        # KV-compression counters: per-model logical KV bytes per
+        # restored prompt token (from the cost ledger's restore bytes)
+        # and a cluster wire-throughput EWMA (bytes/ms, from the
+        # kv_transfer counter deltas each telemetry sweep). Effective
+        # wire bytes = logical bytes / the prefill peer's advertised
+        # compression ratio — int8 arenas widen the transfer regime.
+        self._kv_bpt_ewma: Dict[str, float] = {}
+        self._kv_wire_bpms: Optional[float] = None
+        self._kv_wire_prev: Dict[str, tuple] = {}  # node -> (bytes, ms)
         self._pending_models: Set[str] = set()
         # Telemetry plane (runtime/tsdb.py, docs/observability.md): a
         # bounded in-memory TSDB fed by the background scrape loop
@@ -402,6 +412,7 @@ class Master:
         self.slo = tsdb_mod.SLOEvaluator()
         self._cost_models: Set[str] = set()   # per-model cost hist cap
         self._ratio_prev: Dict[str, tuple] = {}   # node -> (hits, misses)
+        self._wire_ratio_prev: Dict[str, tuple] = {}  # node -> (raw, sent)
         # Flight recorder (runtime/events.py): the typed decision
         # journal — bounded in-memory ring + durable rows through the
         # store's group-commit path — installed as the process-wide
@@ -1883,6 +1894,33 @@ class Master:
                 if dh + dm > 0:
                     self.tsdb.record(name, "prefix_hit_ratio",
                                      dh / (dh + dm), t=now)
+            # derived: KV wire compression ratio (logical bytes served
+            # per byte actually sent this interval) — the two raw
+            # counters chart poorly, the ratio is the sparkline
+            raw_b = vals.get("dli_kv_wire_raw_bytes_total")
+            sent_b = vals.get("dli_kv_wire_sent_bytes_total")
+            if raw_b is not None and sent_b is not None:
+                pr, ps = self._wire_ratio_prev.get(name, (raw_b, sent_b))
+                dr, ds = max(0.0, raw_b - pr), max(0.0, sent_b - ps)
+                self._wire_ratio_prev[name] = (raw_b, sent_b)
+                if ds > 0:
+                    self.tsdb.record(name, "kv_wire_compression",
+                                     dr / ds, t=now)
+            # learned wire throughput (bytes/ms EWMA over transfer
+            # counter deltas): the speed side of the planner's
+            # transfer-vs-recompute pricing
+            tb = vals.get("dli_kv_transfer_bytes_total")
+            tm = vals.get("dli_kv_transfer_ms_total")
+            if tb is not None and tm is not None:
+                pb, pm2 = self._kv_wire_prev.get(name, (tb, tm))
+                db, dms = max(0.0, tb - pb), max(0.0, tm - pm2)
+                self._kv_wire_prev[name] = (tb, tm)
+                if db > 0 and dms > 0:
+                    bpms = db / dms
+                    prev = self._kv_wire_bpms
+                    a = self._ewma_alpha
+                    self._kv_wire_bpms = (bpms if prev is None
+                                          else a * bpms + (1 - a) * prev)
         # master-observed per-node state: breaker position as a numeric
         # series (0 closed / 1 half-open / 2 open) for every node, dead
         # ones included — that is exactly when the series matters
@@ -2002,6 +2040,16 @@ class Master:
             if isinstance(kv, dict) and isinstance(
                     kv.get("occupancy"), (int, float)):
                 entry["arena_occ"] = float(kv["occupancy"])
+            # arena wire-compression ratio (logical / stored bytes): an
+            # int8 arena (DLI_KV_HOST_DTYPE) ships ~3.9x fewer wire
+            # bytes per block, so the disagg/migration cost model
+            # prices transfers FROM this node by effective bytes
+            if isinstance(kv, dict):
+                lb = kv.get("logical_bytes")
+                sb = kv.get("bytes")
+                if (isinstance(lb, (int, float)) and lb > 0
+                        and isinstance(sb, (int, float)) and sb > 0):
+                    entry["kv_wire_ratio"] = float(lb) / float(sb)
             models[str(m.get("name") or "")] = entry
         # current serving role rides the same snapshot: the rebalancer
         # and the role-pool router must see a flip within one sweep,
@@ -2025,7 +2073,7 @@ class Master:
                 role = prev.get("role")
             if prev and devices is None:
                 devices = prev.get("devices")
-        queue = free = occ = None
+        queue = free = occ = wire_ratio = None
         digests = False
         for st in models.values():
             queue = (queue or 0) + st["queue"]
@@ -2033,6 +2081,11 @@ class Master:
                 free = st["free"] if free is None else min(free, st["free"])
             if st.get("arena_occ") is not None:
                 occ = max(occ or 0.0, st["arena_occ"])
+            if st.get("kv_wire_ratio") is not None:
+                # conservative: price transfers with the LEAST
+                # compressed model arena the node reports
+                wire_ratio = min(wire_ratio or float("inf"),
+                                 st["kv_wire_ratio"])
             if "digests" in st:
                 digests = True
         if occ is None and isinstance(
@@ -2045,6 +2098,7 @@ class Master:
         # engine-mode fleets, and every pick at 1000-node sim scale)
         self._node_runtime[node_id] = {
             "queue": queue, "free_blocks": free, "arena_occ": occ,
+            "kv_wire_ratio": wire_ratio,
             "role": role, "at": clock.now(), "models": models,
             "digests_any": digests, "devices": devices}
 
@@ -2648,6 +2702,21 @@ class Master:
                 a = self._ewma_alpha
                 self._prefill_ewma[model] = (
                     per_tok if prev is None else a * per_tok + (1 - a) * prev)
+            # logical KV bytes per restored prompt token — the size side
+            # of the transfer-vs-recompute decision. Restore bytes are
+            # full-precision scatter bytes regardless of how the arena
+            # stores them, so dividing by the peer's advertised
+            # compression ratio later yields honest wire bytes.
+            rb = cost.get("arena_restored_bytes")
+            cah2 = cost.get("prefill_cached_tokens")
+            if (isinstance(rb, (int, float)) and rb > 0
+                    and isinstance(cah2, int) and cah2 > 0):
+                bpt = float(rb) / cah2
+                model = str(req["model_name"])
+                prev = self._kv_bpt_ewma.get(model)
+                a = self._ewma_alpha
+                self._kv_bpt_ewma[model] = (
+                    bpt if prev is None else a * bpt + (1 - a) * prev)
         ok = tsdb_mod.cost_within_slo(cost, self.slo.targets)
         if ok is None and ttft_ms is not None:
             # engine-mode/legacy workers: fall back to the worker's own
@@ -2809,10 +2878,31 @@ class Master:
         registered: the request's life continues on another node."""
         resume = data.get("resume")
         resume = resume if isinstance(resume, dict) else {}
+        model = str(req["model_name"])
+        kv_source = {"url": self.store.node_url(node), "model": model}
+        # migration-leg transfer pricing, same learned inputs as
+        # _plan_disagg: the resume's whole context (prompt + generated
+        # tokens) would fetch from the source arena at EFFECTIVE wire
+        # bytes (logical bytes / the source's advertised compression
+        # ratio). When that priced fetch exceeds the recompute cost on
+        # the destination, drop the kv_source hint so the resume
+        # recomputes — a cold ledger keeps the hint (today's default).
+        n_tok = (len(resume.get("tokens") or [])
+                 + max(1, len((req.get("prompt") or "")
+                              .encode("utf-8", "replace"))
+                       // _DISAGG_CHARS_PER_TOKEN))
+        bpt = self._kv_bpt_ewma.get(model)
+        ewma = self._prefill_ewma.get(model)
+        src = self._node_runtime.get(node["id"]) or {}
+        wire_ratio = src.get("kv_wire_ratio") or 1.0
+        fetch_priced_out = False
+        if bpt and self._kv_wire_bpms and ewma is not None:
+            eff_ms = n_tok * bpt / max(1.0, wire_ratio) \
+                / self._kv_wire_bpms
+            fetch_priced_out = eff_ms >= n_tok * ewma
         self.store.requeue_migrated(
             req["id"], resume=resume,
-            kv_source={"url": self.store.node_url(node),
-                       "model": req["model_name"]},
+            kv_source=None if fetch_priced_out else kv_source,
             excluded_node_id=node["id"])
         self.metrics.inc("requests_migrated")
         log.info("request %d migrated off node %d (%d tokens resume)",
@@ -2821,7 +2911,9 @@ class Master:
         events.emit("migrate-out", request_id=req["id"],
                     node_id=node["id"],
                     trace_id=ctx.trace_id if ctx else None,
-                    resume_tokens=len(resume.get("tokens") or []))
+                    resume_tokens=len(resume.get("tokens") or []),
+                    kv_fetch_priced_out=fetch_priced_out,
+                    kv_wire_ratio=round(float(wire_ratio), 3))
         self._wake.set()
 
     def _ensure_model_loaded(self, node, model, sampling):
@@ -3208,6 +3300,35 @@ class Master:
             # demand silently recomputing for want of usable capacity
             _verdict("no-prefill-capacity", warm_tokens=warm)
             return None
+        # transfer pricing by EFFECTIVE wire bytes: logical KV bytes
+        # (per-model EWMA from the cost ledger) discounted by THIS
+        # prefill peer's advertised arena compression ratio, priced at
+        # the learned cluster wire throughput. An int8 peer quotes
+        # ~3.9x fewer bytes, so compression directly widens the regime
+        # where the transfer beats recompute. Unlearned inputs skip the
+        # gate — pricing must never block disagg on a cold ledger.
+        bpt = self._kv_bpt_ewma.get(str(model))
+        peer_rt = self._node_runtime.get(pnode["id"]) or {}
+        wire_ratio = peer_rt.get("kv_wire_ratio") or 1.0
+        eff_bytes = eff_ms = None
+        if bpt and self._kv_wire_bpms:
+            eff_bytes = est_tokens * bpt / max(1.0, wire_ratio)
+            eff_ms = eff_bytes / self._kv_wire_bpms
+        if (eff_ms is not None and ewma is not None
+                and eff_ms >= est_tokens * ewma):
+            # moving the bytes costs more than recomputing the prefix
+            # where the decode runs — release the reservation and take
+            # the plain path, with the priced inputs on the record
+            with self._inflight_lock:
+                self._inflight[pnode["id"]] = max(
+                    0, self._inflight.get(pnode["id"], 1) - 1)
+            self.metrics.inc("scheduler_disagg_recompute")
+            _verdict("recompute-transfer-cost", warm_tokens=warm,
+                     prefill_ewma_ms_per_tok=round(ewma, 4),
+                     est_wire_bytes=int(eff_bytes),
+                     est_transfer_ms=round(eff_ms, 3),
+                     kv_wire_ratio=round(float(wire_ratio), 3))
+            return None
         dnode = self._pick_node(model, exclude={pnode["id"]},
                                 reserve=True, nodes=nodes,
                                 prompt=prompt, role="decode")
@@ -3224,6 +3345,11 @@ class Master:
         _verdict("transfer", warm_tokens=warm,
                  prefill_ewma_ms_per_tok=(round(ewma, 4)
                                           if ewma is not None else None),
+                 est_wire_bytes=(int(eff_bytes)
+                                 if eff_bytes is not None else None),
+                 est_transfer_ms=(round(eff_ms, 3)
+                                  if eff_ms is not None else None),
+                 kv_wire_ratio=round(float(wire_ratio), 3),
                  prefill_node=pnode["id"], decode_node=dnode["id"])
         return pnode, dnode
 

@@ -165,6 +165,12 @@ class WorkerAgent:
         # charts (PR 5 rule — dlilint metric-not-preregistered)
         for name in ("kv_fetch_requests", "kv_fetch_served_blocks",
                      "kv_fetch_served_bytes", "kv_fetch_missing_blocks",
+                     # compression accounting: raw = full-precision bytes
+                     # the served blocks restore to, sent = the stored
+                     # (possibly int8-quantized) bytes that actually
+                     # crossed the wire — raw/sent is the wire
+                     # compression ratio the planner prices with
+                     "kv_wire_raw_bytes", "kv_wire_sent_bytes",
                      "tokens_generated", "role_flips",
                      "requests_migrated_out",
                      "stale_term_rejections"):
@@ -1052,12 +1058,15 @@ class WorkerAgent:
             sent = served = truncated = 0
             missing = []
             for i, d in enumerate(digests):
-                pages = arena.peek_pages(d)
-                if pages is None:
+                # ship the STORED representation as-is: an int8 arena's
+                # block crosses the wire as its quantized record (kvq8
+                # frame), never requantized or inflated on send
+                obj = arena.peek_stored(d)
+                if obj is None:
                     missing.append(d)
                     self.metrics.inc("kv_fetch_missing_blocks")
                     continue
-                frame = kvwire.encode_frame(d, pages)
+                frame = kvwire.encode_stored(d, obj)
                 if sent + len(frame) > cap:
                     truncated = len(digests) - i
                     break
@@ -1065,8 +1074,16 @@ class WorkerAgent:
                 served += 1
                 self.metrics.inc("kv_fetch_served_blocks")
                 self.metrics.inc("kv_fetch_served_bytes", len(frame))
+                self.metrics.inc("kv_wire_sent_bytes",
+                                 kvwire.stored_nbytes(obj))
+                self.metrics.inc("kv_wire_raw_bytes",
+                                 kvwire.logical_nbytes(obj))
                 yield frame
-            yield kvwire.encode_end(served, missing, truncated)
+            # served_bytes: what actually crossed, so a size-capped
+            # partial is distinguishable from a disconnect and the
+            # peer's recompute fallback is sized to the true shortfall
+            yield kvwire.encode_end(served, missing, truncated,
+                                    served_bytes=sent)
 
         return httpd.binary_stream(_request, frames())
 

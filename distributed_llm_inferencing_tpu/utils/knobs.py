@@ -252,6 +252,19 @@ KNOBS = (
          "a semaphore (`dli_kv_fetch_queued_total`) instead of "
          "thundering-herding one source worker.",
          f"{_P}/runtime/kvwire.py"),
+    Knob("DLI_KV_HOST_DTYPE", "native", "enum",
+         "Host-arena KV storage: `native` keeps full-precision pages "
+         "(bitwise restore), `int8` stores per-(layer, head) symmetric "
+         "int8 blocks (~3.9x more prefix tokens per MB, same bytes on "
+         "the wire).", f"{_P}/runtime/batcher.py"),
+    Knob("DLI_KV_WIRE_OVERLAP", "1", "bool",
+         "Receive-overlapped KV restore: device scatter of arrived "
+         "blocks overlaps the socket read of the rest; `0` = fetch "
+         "fully, then restore.", f"{_P}/runtime/batcher.py"),
+    Knob("DLI_KV_WIRE_QUEUE", "4", "int",
+         "Decoded-frame queue depth between the KV fetch receiver "
+         "thread and the restore consumer (bounds memory while "
+         "overlapping).", f"{_P}/runtime/kvwire.py"),
     Knob("DLI_REBALANCE", "1", "bool",
          "`0` kills the master's elastic rebalancer loop (role flips + "
          "live in-flight migration).", f"{_P}/runtime/master.py"),

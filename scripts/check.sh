@@ -159,6 +159,12 @@ echo "== disaggregated prefill/decode + KV transfer suite + smoke =="
 timeout -k 10 600 env JAX_PLATFORMS=cpu DLI_FAULTS_ENABLE=1 \
     python -m pytest tests/test_disagg.py -q \
     -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+# int8 KV tier differential suite: per-(layer, head) quantize/dequant
+# bounds, wire-frame corruption rejection, arena byte honesty, and the
+# greedy-match gate for decode continued from quantized transferred KV
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_kvblock_quant.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
 timeout -k 10 600 env JAX_PLATFORMS=cpu \
     python bench.py --scenario disagg --smoke || exit 1
 
@@ -298,6 +304,7 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     --ignore=tests/test_dispatch_batch.py \
     --ignore=tests/test_kvtier.py \
     --ignore=tests/test_disagg.py \
+    --ignore=tests/test_kvblock_quant.py \
     --ignore=tests/test_migration.py \
     --ignore=tests/test_tsdb.py \
     --ignore=tests/test_events.py \

@@ -46,7 +46,8 @@ def _pages():
 def test_frame_roundtrip():
     frames = (kvwire.encode_frame("d1", _pages())
               + kvwire.encode_frame("d2", [np.ones((3,), np.int32)])
-              + kvwire.encode_end(2, ["gone"], truncated=1))
+              + kvwire.encode_end(2, ["gone"], truncated=1,
+                                  served_bytes=84))
     # feed in awkward chunk sizes: the reader must reassemble across
     # chunk boundaries
     chunks = [frames[i:i + 7] for i in range(0, len(frames), 7)]
@@ -55,8 +56,9 @@ def test_frame_roundtrip():
     for got, want in zip(blocks["d1"], _pages()):
         assert got.dtype == want.dtype and got.shape == want.shape
         np.testing.assert_array_equal(got, want)
-    assert end == {"end": True, "served": 2, "missing": ["gone"],
-                   "missing_count": 1, "truncated": 1}
+    assert end == {"end": True, "served": 2, "served_bytes": 84,
+                   "missing": ["gone"], "missing_count": 1,
+                   "truncated": 1}
     # the missing LIST is capped so the end-frame header can never blow
     # the decoder's MAX_HDR_BYTES; the count stays exact
     big = kvwire.encode_end(0, [f"{i:016x}" for i in range(4096)])
@@ -92,6 +94,91 @@ def test_decode_frames_byte_cap():
     frames = kvwire.encode_frame("d", [np.zeros((1024,), np.float32)])
     with pytest.raises(kvwire.WireError):
         kvwire.decode_frames([frames], max_total_bytes=64)
+
+
+# ---- quantized (kvq8) frames --------------------------------------------
+
+def _q8_record():
+    from distributed_llm_inferencing_tpu.ops import kvblock_quant as kvq
+    rng = np.random.default_rng(9)
+    return kvq.quantize_block(
+        [rng.standard_normal((2, 8, 2, 4)).astype(np.float32),
+         rng.integers(0, 7, (5,)).astype(np.int8)])
+
+
+def test_kvq8_frame_roundtrip():
+    from distributed_llm_inferencing_tpu.ops import kvblock_quant as kvq
+    rec = _q8_record()
+    frames = (kvwire.encode_stored("q1", rec)
+              + kvwire.encode_end(1, [], served_bytes=len(rec)))
+    chunks = [frames[i:i + 7] for i in range(0, len(frames), 7)]
+    blocks, _ = kvwire.decode_frames(chunks)
+    got = blocks["q1"]
+    assert kvq.is_quantized_block(got)
+    for a, b in zip(kvq.dequantize_block(got), kvq.dequantize_block(rec)):
+        np.testing.assert_array_equal(a, b)
+    # stored/logical accounting dispatches on the representation
+    assert kvwire.stored_nbytes(rec) == kvq.stored_nbytes(rec)
+    assert kvwire.logical_nbytes(rec) > kvwire.stored_nbytes(rec)
+    pages = _pages()
+    assert kvwire.stored_nbytes(pages) == sum(p.nbytes for p in pages)
+
+
+def _reframe(frame, mutate_hdr=None, mutate_payload=None):
+    """Unpack one encoded frame, apply mutations, re-pack with
+    consistent lengths — corruption the length prefixes can't catch,
+    so the VALIDATION layer has to."""
+    import struct
+    hl, pl = struct.unpack(">II", frame[4:12])
+    hdr = json.loads(frame[12:12 + hl])
+    payload = frame[12 + hl:12 + hl + pl]
+    if mutate_hdr:
+        hdr = mutate_hdr(hdr)
+    if mutate_payload:
+        payload = mutate_payload(payload, hdr)
+    h = json.dumps(hdr).encode()
+    return (kvwire.MAGIC + struct.pack(">II", len(h), len(payload))
+            + h + payload)
+
+
+@pytest.mark.parametrize("mangle", [
+    "quant_scheme", "meta_missing", "meta_count", "bad_dtype",
+    "scale_truncated", "nonfinite_scale"])
+def test_kvq8_frame_corruption_raises(mangle):
+    """Quantized-frame corruption classes — bad scale lengths, dtype
+    drift, truncated scale payloads, NaN scales — all raise WireError
+    (-> recompute on the fetching side), never crash or yield a record
+    that would silently poison a dequant."""
+    rec = _q8_record()
+    frame = kvwire.encode_stored("q", rec)
+    q_nbytes = rec["pages"][0]["q"].nbytes
+
+    def hdr_mut(hdr):
+        if mangle == "quant_scheme":
+            hdr["quant"] = "kvq9"
+        elif mangle == "meta_missing":
+            del hdr["meta"]
+        elif mangle == "meta_count":
+            hdr["meta"] = hdr["meta"] + [{"kind": "raw"}]
+        elif mangle == "bad_dtype":
+            hdr["meta"][0]["dtype"] = "int64"
+        elif mangle == "scale_truncated":
+            # scale page shorter than the q page's (layers, heads)
+            hdr["pages"][1]["shape"] = [1, 2]
+        return hdr
+
+    def payload_mut(payload, hdr):
+        if mangle == "scale_truncated":
+            return payload[:q_nbytes + 8]   # 1x2 float32 scales
+        if mangle == "nonfinite_scale":
+            import struct
+            return (payload[:q_nbytes] + struct.pack("<f", float("nan"))
+                    + payload[q_nbytes + 4:])
+        return payload
+
+    bad = _reframe(frame, hdr_mut, payload_mut) + kvwire.encode_end(1, [])
+    with pytest.raises(kvwire.WireError):
+        kvwire.decode_frames([bad])
 
 
 # ---- live workers -------------------------------------------------------
@@ -169,6 +256,7 @@ def test_kv_fetch_endpoint_serves_exported_blocks(prefill_worker):
     blocks, end = kvwire.decode_frames(r.iter_content(chunk_size=4096))
     assert set(blocks) == set(digs)
     assert end["served"] == len(digs) and end["truncated"] == 0
+    assert end["served_bytes"] > 0      # honest partial-fetch sizing
     assert end["missing"] == ["feedfacefeedface"]
     # frames carry the exact arena bytes
     for d in digs:
@@ -207,6 +295,20 @@ def test_kv_fetch_size_cap(prefill_worker, monkeypatch):
                 stream=True, timeout=30)
     blocks, end = kvwire.decode_frames(r.iter_content(chunk_size=4096))
     assert not blocks and end["truncated"] == len(digs)
+    assert end["served"] == 0 and end["served_bytes"] == 0
+    # cap fitting exactly one frame: the terminal frame reports the
+    # blocks AND bytes actually served, so the peer can size its
+    # recompute fallback to the true shortfall
+    one = len(kvwire.encode_stored(
+        digs[0], m.batcher.kvtier.arena.peek_stored(digs[0])))
+    monkeypatch.setattr(worker_mod, "KV_FETCH_MAX_MB", one / (1 << 20))
+    r = rq.post(f"http://127.0.0.1:{port}/kv_fetch",
+                json={"model_name": "tiny-llama", "digests": digs},
+                stream=True, timeout=30)
+    blocks, end = kvwire.decode_frames(r.iter_content(chunk_size=4096))
+    assert len(blocks) == 1 and end["served"] == 1
+    assert end["served_bytes"] == one
+    assert end["truncated"] == len(digs) - 1
 
 
 @pytest.fixture(scope="module")
@@ -413,6 +515,133 @@ def test_chaos_disagg_source_death_no_breaker_storm():
         m.stop()
         src.service.shutdown()
         dst.service.shutdown()
+
+
+# ---- int8 wire tier + single-flight prefetch ----------------------------
+
+def test_int8_worker_transfer_greedy_match_and_compression(
+        trio, monkeypatch):
+    """End-to-end int8 transfer between live workers: the decode
+    continued from quantized fetched KV emits the exact greedy tokens
+    of a cold native run, the wire ships >=3.5x fewer bytes than the
+    logical pages, and the arena advertises honest stored bytes."""
+    monkeypatch.setenv("DLI_KV_HOST_DTYPE", "int8")
+    src, src_port = _mk_worker(role="prefill")
+    dst, dst_port = _mk_worker(role="decode")
+    (_, _), (_, _), (cold, cold_port) = trio   # native cold reference
+    prompt = f"<q8> {LONG_PROMPT}"
+    try:
+        ref = _infer(cold_port, prompt, max_new=8, seed=31)
+        _infer(src_port, prompt, max_new=1, seed=31, kv_export=True)
+        got = _infer(dst_port, prompt, max_new=8, seed=31,
+                     kv_source={"url": f"http://127.0.0.1:{src_port}",
+                                "model": "tiny-llama"})
+        assert got["tokens"] == ref["tokens"]
+        sc, dc = _counters(src), _counters(dst)
+        assert sc["kv_wire_sent_bytes"] > 0
+        assert sc["kv_wire_sent_bytes"] < sc["kv_wire_raw_bytes"] / 3.5
+        assert dc["kv_transfer_failures"] == 0
+        assert dc["kv_transfer_blocks"] > 0
+        # transfer accounting counts STORED (compressed) wire bytes
+        assert dc["kv_transfer_bytes"] == sc["kv_wire_sent_bytes"]
+        st = src.models["tiny-llama"].batcher.kvtier.stats()
+        assert st["dtype"] == "int8"
+        assert st["logical_bytes"] > st["bytes"] * 3.5
+    finally:
+        src.service.shutdown()
+        dst.service.shutdown()
+
+
+def test_single_flight_prefetch_coalesces():
+    """Seeded concurrent prefetches of the same digest set coalesce
+    onto ONE wire transfer: the first caller leads, the rest register
+    as waiters (kv_prefetch_coalesced), and every caller finds the
+    blocks arena-resident afterward."""
+    import threading
+    import jax
+    import jax.numpy as jnp
+    from distributed_llm_inferencing_tpu.models.params import init_params
+    from distributed_llm_inferencing_tpu.models.registry import get_config
+    from distributed_llm_inferencing_tpu.ops.sampling import SamplingParams
+    from distributed_llm_inferencing_tpu.runtime.batcher import (
+        ContinuousBatcher)
+    cfg = get_config("tiny-llama").replace(dtype="float32",
+                                           attn_backend="xla")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    prompt = list(range(40))
+    # source batcher: run + export so its arena holds the blocks
+    b1 = ContinuousBatcher(cfg, params, num_blocks=32, block_size=8,
+                           slots=2, max_seq=128)
+    r = b1.submit(list(prompt), max_new_tokens=2,
+                  sampling=SamplingParams.greedy(), seed=5,
+                  kv_export=True)
+    for _ in range(200):
+        b1.step()
+        if r.done.is_set():
+            break
+    r.wait()
+    bs = b1.block_size
+    digs = b1.kvtier.block_digests(prompt[:len(prompt) // bs * bs])
+    served = {d: tuple(np.asarray(p)
+                       for p in b1.kvtier.arena.peek_pages(d))
+              for d in digs}
+
+    class Peer:
+        calls = 0
+
+        def fetch(self, url, model, digests):
+            self.calls += 1
+            return {d: served[d] for d in digests if d in served}
+
+    fetcher = Peer()
+    b2 = ContinuousBatcher(cfg, params, num_blocks=32, block_size=8,
+                           slots=2, max_seq=128, kv_fetcher=fetcher)
+    b2._wire_overlap = False
+    started, release = threading.Event(), threading.Event()
+    wire_calls = []
+    orig = b2._wire_fetch
+
+    def gated(url, model, want, progress=None):
+        wire_calls.append(list(want))
+        started.set()
+        release.wait(30)    # hold the leader in flight so the waiters
+        return orig(url, model, want, progress=progress)   # must queue
+
+    b2._wire_fetch = gated
+    src = {"url": "http://peer", "model": "tiny-llama"}
+    results = []
+
+    def prefetch():
+        results.append(b2.prefetch_kv(list(prompt), src))
+
+    leader = threading.Thread(target=prefetch)
+    leader.start()
+    assert started.wait(10)
+    waiters = [threading.Thread(target=prefetch) for _ in range(4)]
+    for t in waiters:
+        t.start()
+    # every waiter must have REGISTERED (seen the in-flight entry and
+    # counted itself) before the leader is released — that is the race
+    # the registry exists for
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        c = b2.metrics.snapshot()["counters"]
+        if c.get("kv_prefetch_coalesced", 0) >= 4:
+            break
+        time.sleep(0.01)
+    release.set()
+    leader.join(timeout=30)
+    for t in waiters:
+        t.join(timeout=30)
+    c = b2.metrics.snapshot()["counters"]
+    assert c["kv_prefetch_coalesced"] == 4
+    assert fetcher.calls == 1           # exactly one wire transfer
+    assert len(wire_calls) == 1
+    want = digs[:(len(prompt) - 1) // bs]
+    assert wire_calls[0] == want        # the deduped union, in order
+    assert all(b2.kvtier.arena.peek(d) for d in want)
+    assert sorted(results, reverse=True)[0] > 0     # leader got bytes
+    assert sorted(results)[:4] == [0, 0, 0, 0]      # waiters shared
 
 
 # ---- role-aware routing -------------------------------------------------

@@ -80,6 +80,36 @@ def test_arena_lru_bound_under_pressure():
     assert not arena.put("huge", [np.zeros((1024,), np.float64)])
 
 
+def test_arena_int8_lru_counts_stored_bytes():
+    """An int8 arena's LRU bound and occupancy run on STORED
+    (quantized) bytes, so the same budget holds ~4x the blocks of a
+    native arena — and the occupancy the arena-full routing guard
+    (DLI_SCHED_ARENA_FULL) sees is the honest quantized budget, while
+    logical_bytes still carries the full-precision equivalent."""
+    page = RNG.standard_normal((2, 8, 2, 4)).astype(np.float32)  # 512 B
+    native = kvtier.HostKVArena(capacity_bytes=4 * page.nbytes)
+    int8 = kvtier.HostKVArena(capacity_bytes=4 * page.nbytes,
+                              dtype="int8")
+    for i in range(16):
+        assert native.put(f"d{i}", [page])
+        assert int8.put(f"d{i}", [page])
+    sn, sq = native.stats(), int8.stats()
+    assert sn["blocks"] == 4 and sn["dropped"] == 12
+    assert sq["blocks"] > sn["blocks"] * 3      # the density claim
+    assert sq["dropped"] == 16 - sq["blocks"]
+    for st in (sn, sq):
+        assert st["bytes"] <= st["capacity_bytes"]
+        assert st["occupancy"] == st["bytes"] / st["capacity_bytes"]
+    # honest accounting: int8 stores fewer bytes than it represents
+    assert sq["bytes"] < sq["logical_bytes"] / 3.5
+    assert sn["bytes"] == sn["logical_bytes"]
+    # restore path dequantizes to the logical page, bounded error
+    got = int8.get("d15")
+    assert got is not None and got[0].shape == page.shape
+    assert got[0].dtype == np.float32
+    assert float(np.max(np.abs(got[0] - page))) < 0.05
+
+
 def test_estimate_survives_malformed_advertisement():
     """The advertisement crossed the wire from a worker: malformed
     shapes must score 0, never raise — estimate_cached_tokens runs on
