@@ -755,6 +755,257 @@ def _prefix_cache_scenario(argv, opt, smoke):
     return 0
 
 
+# ---- multi-LoRA adapter serving ---------------------------------------
+
+# synth: adapters at scale ~0.8: strong enough that the rank-r delta
+# actually flips greedy argmax on the random-init tiny model (the
+# checkpoint-realistic 0.05 default produces a ~0.25% relative delta
+# that greedy decoding never sees — the A/B would be vacuous)
+_LORA_ADAPTERS = (("ad-alpha", "synth:rank=4,seed=3,scale=0.8"),
+                  ("ad-beta", "synth:rank=8,seed=9,scale=0.8"))
+
+
+def _lora_workers(n_workers):
+    """In-proc batched workers for the multi-LoRA scenario. The warm
+    inference compiles the base (``use_lora=False``) admission/decode
+    shapes; the first adapter wave pays the one LoRA-program compile."""
+    import requests as _rq
+    from distributed_llm_inferencing_tpu.runtime.worker import WorkerAgent
+
+    workers = []
+    for _ in range(n_workers):
+        agent = WorkerAgent()
+        srv = agent.serve("127.0.0.1", 0, background=True)
+        wport = srv.server_address[1]
+        r = _rq.post(f"http://127.0.0.1:{wport}/load_model", json={
+            "model_name": "tiny-llama", "allow_random_init": True,
+            "dtype": "float32", "serving": "batched", "slots": 4,
+            "kv_blocks": 128, "kv_block_size": 8, "max_seq": 128},
+            timeout=600)
+        assert r.status_code == 200, r.text
+        rr = _rq.post(f"http://127.0.0.1:{wport}/inference", json={
+            "model_name": "tiny-llama", "prompt": "warm the base path",
+            "max_new_tokens": 4, "sampling": {"do_sample": False}},
+            timeout=600)
+        assert rr.status_code == 200, rr.text
+        workers.append((agent, wport))
+    return workers
+
+
+def bench_multi_lora_smoke(n_requests=24, concurrency=4, n_workers=2):
+    """Mixed-adapter serving through a live master: register two
+    adapters in the replicated registry, interleave base / ad-alpha /
+    ad-beta submits, and verify the full control-plane story — lazy
+    dispatch-time loads (``dli_adapter_lazy_loads_total``), adapter-
+    affinity picks after residency lands, the adapter-loaded /
+    adapter-evicted decision trail in ``/api/events``, and zero
+    failures (an adapter problem FAILS the request, never silently
+    serves base weights)."""
+    import threading as _th
+    import requests as _rq
+    from distributed_llm_inferencing_tpu.runtime.master import Master
+
+    workers = _lora_workers(n_workers)
+    m = Master(":memory:", health_interval=1.0)
+    msrv = m.service.serve("127.0.0.1", 0, background=True)
+    base = f"http://127.0.0.1:{msrv.server_address[1]}"
+    try:
+        for i, (_, wport) in enumerate(workers):
+            r = _rq.post(f"{base}/api/nodes/add", json={
+                "name": f"w{i}", "host": "127.0.0.1",
+                "port": wport}).json()
+            assert r["status"] == "success", r
+        for name, source in _LORA_ADAPTERS:
+            r = _rq.post(f"{base}/api/adapters/register", json={
+                "adapter": name, "source": source,
+                "model_name": "tiny-llama"}).json()
+            assert r["status"] == "success", r
+        m.start_background()
+        time.sleep(1.2)   # one health sweep: snapshots are fresh
+        done, failed, lock = [], [], _th.Lock()
+        next_i = [0]
+        rotation = (None,) + tuple(n for n, _ in _LORA_ADAPTERS)
+
+        def client():
+            sess = _rq.Session()
+            while True:
+                with lock:
+                    if next_i[0] >= n_requests:
+                        return
+                    i = next_i[0]
+                    next_i[0] += 1
+                body = {"model_name": "tiny-llama",
+                        "prompt": f"<q{i:03d}> tell me about item {i}",
+                        "max_new_tokens": 4,
+                        "sampling": {"do_sample": False,
+                                     "allow_random_init": True}}
+                adapter = rotation[i % len(rotation)]
+                if adapter:
+                    body["adapter"] = adapter
+                rid = sess.post(f"{base}/api/inference/submit",
+                                json=body).json()["request_id"]
+                poll = 0.02
+                while True:
+                    st = sess.get(
+                        f"{base}/api/inference/status/{rid}"
+                    ).json()["request"]
+                    if st["status"] in ("completed", "failed"):
+                        with lock:
+                            (done if st["status"] == "completed"
+                             else failed).append(st)
+                        break
+                    time.sleep(poll)
+                    poll = min(0.2, poll * 1.5)
+
+        t0 = time.time()
+        threads = [_th.Thread(target=client) for _ in range(concurrency)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        wall = time.time() - t0
+        mc = m.metrics.snapshot()["counters"]
+        loaded_evts = _rq.get(f"{base}/api/events",
+                              params={"type": "adapter-loaded"}).json()
+        resident = _rq.get(f"{base}/api/adapters").json()
+        return {
+            "requests": n_requests, "completed": len(done),
+            "failed": len(failed), "wall_s": round(wall, 2),
+            "affinity_picks": int(
+                mc.get("scheduler_pick_adapter_affinity", 0)),
+            "lazy_loads": int(mc.get("adapter_lazy_loads", 0)),
+            "load_failures": int(mc.get("adapter_load_failures", 0)),
+            "adapter_loaded_events": int(loaded_evts.get("count", 0)),
+            "residency": resident.get("residency", {}),
+        }
+    finally:
+        m.stop()
+        for agent, _ in workers:
+            agent.service.shutdown()
+
+
+def bench_multi_lora_ab(n_requests=18, tokens=24):
+    """The tentpole's zero-cost-mixing claim, measured on direct
+    in-proc batchers sharing ONE base param tree: a mixed-adapter
+    stream (base + two adapters interleaved in the same waves) must
+    sustain >= 0.9x the tokens-per-weight-pass of a base-only stream —
+    batching is preserved, adapters never split the wave — and every
+    adapter's greedy output must be bitwise-equal to a dedicated
+    single-adapter batcher's (the gathered per-slot delta is exact,
+    not an approximation)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as _np
+    from distributed_llm_inferencing_tpu.models.params import init_params
+    from distributed_llm_inferencing_tpu.models.registry import get_config
+    from distributed_llm_inferencing_tpu.ops.sampling import SamplingParams
+    from distributed_llm_inferencing_tpu.runtime.batcher import (
+        ContinuousBatcher)
+
+    cfg = get_config("tiny-llama").replace(dtype="float32",
+                                           attn_backend="xla")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    rng = _np.random.default_rng(23)
+    prompts = [rng.integers(0, 256, 6 + (i % 5)).tolist()
+               for i in range(n_requests)]
+    rotation = (None,) + tuple(n for n, _ in _LORA_ADAPTERS)
+
+    def mk():
+        return ContinuousBatcher(cfg, params, num_blocks=256, block_size=8,
+                                 slots=4, max_seq=96)
+
+    def run(b, assign):
+        counters = b.metrics.snapshot()["counters"]
+        t0 = (counters.get("batcher_tokens_emitted", 0),
+              counters.get("batcher_weight_passes", 0))
+        reqs = [b.submit(prompts[i], max_new_tokens=tokens,
+                         sampling=SamplingParams.greedy(), seed=700 + i,
+                         adapter=ad)
+                for i, ad in assign]
+        for _ in range(6000):
+            b.step()
+            if all(r.done.is_set() for r in reqs):
+                break
+        for r in reqs:
+            assert r.error is None, r.error
+        counters = b.metrics.snapshot()["counters"]
+        emitted = counters.get("batcher_tokens_emitted", 0) - t0[0]
+        passes = counters.get("batcher_weight_passes", 0) - t0[1]
+        return {(i, ad): r.tokens for (i, ad), r in zip(assign, reqs)}, \
+            emitted / max(passes, 1)
+
+    # base-only leg: every request on the shared base weights
+    _, base_tpp = run(mk(), [(i, None) for i in range(n_requests)])
+    # mixed leg: base + both adapters interleaved in the same waves
+    mixed = mk()
+    for name, source in _LORA_ADAPTERS:
+        mixed.load_adapter(name, source)
+    assign = [(i, rotation[i % len(rotation)]) for i in range(n_requests)]
+    mixed_out, mixed_tpp = run(mixed, assign)
+    # dedicated legs: one batcher per adapter serving ONLY that
+    # adapter's slice of the workload — the bitwise reference
+    bitwise_equal = True
+    for name, source in _LORA_ADAPTERS:
+        ded = mk()
+        ded.load_adapter(name, source)
+        sub = [(i, ad) for i, ad in assign if ad == name]
+        ded_out, _ = run(ded, sub)
+        for key in sub:
+            if ded_out[key] != mixed_out[key]:
+                bitwise_equal = False
+    return {
+        "requests": n_requests, "tokens_each": tokens,
+        "base_tokens_per_pass": round(base_tpp, 3),
+        "mixed_tokens_per_pass": round(mixed_tpp, 3),
+        "mixing_cost_x": round(mixed_tpp / max(base_tpp, 1e-9), 3),
+        "bitwise_equal_vs_dedicated": bitwise_equal,
+    }
+
+
+def _multi_lora_scenario(argv, opt, smoke):
+    """--scenario multi_lora [--smoke|--ab]: multi-adapter serving.
+    ``--ab`` gates mixed-adapter batching efficiency (>= 0.9x base
+    tokens-per-weight-pass) and per-adapter bitwise equality against
+    dedicated single-adapter batchers; ``--smoke`` gates the routed
+    path — adapter-affinity picks > 0, lazy load -> serve, the
+    adapter-loaded trail in /api/events, zero failures. Writes
+    /tmp/dli_bench_multi_lora.json for the CI artifact."""
+    result = {"scenario": "multi_lora", "smoke": smoke}
+    rc = 0
+    if "--ab" in argv:
+        ab = bench_multi_lora_ab(opt("--requests", 18),
+                                 opt("--tokens", 24))
+        result["ab"] = ab
+        ok = (ab["mixing_cost_x"] >= 0.9
+              and ab["bitwise_equal_vs_dedicated"])
+        if not ok:
+            print("multi-lora A/B FAILED", file=sys.stderr)
+            rc = 1
+    if smoke or "--ab" not in argv:
+        run = bench_multi_lora_smoke(opt("--requests", 24),
+                                     opt("--concurrency", 4),
+                                     opt("--workers", 2))
+        result.update(run)
+        if smoke:
+            ok = (run["completed"] == result["requests"]
+                  and run["failed"] == 0
+                  and run["affinity_picks"] > 0
+                  and run["lazy_loads"] > 0
+                  and run["adapter_loaded_events"] > 0)
+            if not ok:
+                print("multi-lora smoke FAILED", file=sys.stderr)
+                rc = 1
+            else:
+                print(f"multi-lora smoke ok: affinity picks "
+                      f"{run['affinity_picks']}, lazy loads "
+                      f"{run['lazy_loads']}, loaded events "
+                      f"{run['adapter_loaded_events']}", file=sys.stderr)
+    with open("/tmp/dli_bench_multi_lora.json", "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result))
+    return rc
+
+
 _DISAGG_MODEL = "tiny-llama-long"     # 1k-context tiny llama (registry)
 
 
@@ -3087,8 +3338,9 @@ def _overload_scenario(argv, opt, smoke):
 
 
 def _scenario_main(argv):
-    """`bench.py --scenario {control_plane|prefix_cache|decode_speed|disagg
-    |rebalance|plan|ha|overload|sim_scale|sim_calibrate}
+    """`bench.py --scenario {control_plane|prefix_cache|multi_lora
+    |decode_speed|disagg|rebalance|plan|ha|overload|sim_scale
+    |sim_calibrate}
     [--smoke|--ab] [--requests N] [--concurrency C] [--workers W]` —
     standalone scenario entry, one JSON line on stdout, nonzero rc on
     smoke/gate failure."""
@@ -3115,6 +3367,16 @@ def _scenario_main(argv):
         except Exception:
             pass
         return _prefix_cache_scenario(argv, opt, "--smoke" in argv)
+    if name == "multi_lora":
+        # both halves spin fresh batchers/workers: warm compiles reuse
+        # the persistent cache across legs and repeat CI runs
+        try:
+            from distributed_llm_inferencing_tpu.utils.platform import (
+                enable_compilation_cache)
+            enable_compilation_cache()
+        except Exception:
+            pass
+        return _multi_lora_scenario(argv, opt, "--smoke" in argv)
     if name == "disagg":
         # compilation cache: the two legs' fresh worker sets (and repeat
         # CI runs) reuse compiled executables

@@ -1,0 +1,359 @@
+"""Multi-LoRA adapter format, host store, and device pack building.
+
+An adapter is a set of per-layer low-rank (A, B) pairs for the attention
+and MLP projections of a BASE model — rank r in the tens against hidden
+sizes in the thousands, so thousands of tenant fine-tunes fit where one
+extra dense copy would not. Three layers of machinery live here:
+
+- **Format**: ``LoRAAdapter`` (host numpy), loadable from a checkpoint
+  directory (``lora_config.json`` + ``lora.npz``) or synthesized for
+  tests/benches (``synthesize`` — deterministic in (cfg, name, seed)).
+- **Host store**: ``LoRAHostStore``, a bounded LRU-by-bytes tier
+  (``DLI_LORA_HOST_MB``) mirroring the HostKVArena discipline —
+  occupancy/hit/eviction accounting, never evicting adapters pinned to
+  device slots.
+- **Device pack**: ``build_pack`` stacks up to S resident adapters into
+  ``[L, S, din, rmax]`` / ``[L, S, rmax, dout]`` arrays per projection.
+  Slot 0 is the base model (all zeros — an exact-zero delta), ranks are
+  zero-padded to ``rmax`` (padding rows of A contribute nothing), and
+  the ``alpha / rank`` scale is folded into B — so the serving delta
+  (ops/lora.py gathered_delta) is two einsums with a STATIC shape:
+  loading, evicting, or re-mixing adapters changes pack DATA, never the
+  compiled program.
+
+Model classes whose projection layout the delta hook does not cover —
+MLA (latent-bottleneck attention), MoE (expert-stacked MLP), DeepSeek
+dense-prefix hybrids — are rejected at load time: a request must fail
+loudly rather than silently serve base weights.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from distributed_llm_inferencing_tpu.models.config import ModelConfig
+from distributed_llm_inferencing_tpu.utils import locks
+
+# serving defaults; the DLI_LORA_* knobs (utils/knobs.py) override them
+DEFAULT_HOST_MB = 64.0     # DLI_LORA_HOST_MB
+DEFAULT_SLOTS = 4          # DLI_LORA_SLOTS (device-resident adapters)
+DEFAULT_MAX_RANK = 16      # DLI_LORA_MAX_RANK (pack's static rmax)
+
+
+def host_mb_from_env() -> float:
+    try:
+        return float(os.environ.get("DLI_LORA_HOST_MB", DEFAULT_HOST_MB))
+    except ValueError:
+        return DEFAULT_HOST_MB
+
+
+def slots_from_env() -> int:
+    try:
+        return max(1, int(os.environ.get("DLI_LORA_SLOTS", DEFAULT_SLOTS)))
+    except ValueError:
+        return DEFAULT_SLOTS
+
+
+def max_rank_from_env() -> int:
+    try:
+        return max(1, int(os.environ.get("DLI_LORA_MAX_RANK",
+                                         DEFAULT_MAX_RANK)))
+    except ValueError:
+        return DEFAULT_MAX_RANK
+
+
+def validate_base_model(cfg: ModelConfig):
+    """Refuse model classes the delta hook does not cover. Raising here
+    (load time) is what keeps the hard rule — a request NEVER silently
+    serves base weights — cheap to enforce everywhere downstream."""
+    if cfg.mla:
+        raise ValueError(
+            "LoRA serving does not support MLA attention (the latent "
+            "bottleneck replaces the q/k/v projections the delta targets)")
+    if cfg.num_experts > 0:
+        raise ValueError(
+            "LoRA serving does not support MoE MLPs (expert-stacked "
+            "weights need a routed delta formulation)")
+    if getattr(cfg, "dense_prefix_layers", 0):
+        raise ValueError(
+            "LoRA serving does not support dense-prefix hybrid stacks "
+            "(two layer segments would need two packs)")
+
+
+def lora_targets(cfg: ModelConfig) -> Tuple[str, ...]:
+    """The projections an adapter may target for this architecture."""
+    base = ("q", "k", "v", "o", "up", "down")
+    return base + ("gate",) if cfg.gated_mlp else base
+
+
+def target_dims(cfg: ModelConfig, target: str) -> Tuple[int, int]:
+    """(din, dout) of the dense projection ``target`` adapts."""
+    h, hd = cfg.hidden_size, cfg.head_dim
+    dims = {
+        "q": (h, cfg.num_heads * hd),
+        "k": (h, cfg.num_kv_heads * hd),
+        "v": (h, cfg.num_kv_heads * hd),
+        "o": (cfg.num_heads * hd, h),
+        "gate": (h, cfg.intermediate_size),
+        "up": (h, cfg.intermediate_size),
+        "down": (cfg.intermediate_size, h),
+    }
+    if target not in dims or target not in lora_targets(cfg):
+        raise ValueError(f"unknown LoRA target {target!r}")
+    return dims[target]
+
+
+@dataclasses.dataclass
+class LoRAAdapter:
+    """One adapter: per-layer {target: (A [din, r], B [r, dout])} in
+    float32 host numpy, plus the metadata routing/packing needs."""
+    name: str
+    rank: int
+    alpha: float
+    targets: Tuple[str, ...]
+    layers: List[Dict[str, Tuple[np.ndarray, np.ndarray]]]
+    nbytes: int = 0
+
+    def __post_init__(self):
+        if not self.nbytes:
+            self.nbytes = sum(a.nbytes + b.nbytes
+                              for lp in self.layers
+                              for (a, b) in lp.values())
+
+    @property
+    def scale(self) -> float:
+        return self.alpha / float(self.rank)
+
+
+def _check_adapter(cfg: ModelConfig, ad: LoRAAdapter,
+                   max_rank: Optional[int] = None) -> LoRAAdapter:
+    validate_base_model(cfg)
+    cap = max_rank or max_rank_from_env()
+    if ad.rank < 1 or ad.rank > cap:
+        raise ValueError(f"adapter {ad.name!r} rank {ad.rank} outside "
+                         f"[1, {cap}] (DLI_LORA_MAX_RANK)")
+    if len(ad.layers) != cfg.num_layers:
+        raise ValueError(f"adapter {ad.name!r} has {len(ad.layers)} "
+                         f"layers, model has {cfg.num_layers}")
+    ok = set(lora_targets(cfg))
+    for li, lp in enumerate(ad.layers):
+        for t, (a, b) in lp.items():
+            if t not in ok:
+                raise ValueError(f"adapter {ad.name!r} targets {t!r}, "
+                                 f"not a projection of {cfg.name}")
+            din, dout = target_dims(cfg, t)
+            if a.shape != (din, ad.rank) or b.shape != (ad.rank, dout):
+                raise ValueError(
+                    f"adapter {ad.name!r} layer {li} target {t!r}: "
+                    f"A{a.shape}/B{b.shape} do not match "
+                    f"({din}, {ad.rank})/({ad.rank}, {dout})")
+    return ad
+
+
+def synthesize(cfg: ModelConfig, name: str, rank: int = 8,
+               alpha: Optional[float] = None, seed: int = 0,
+               scale: float = 0.05,
+               targets: Optional[Tuple[str, ...]] = None) -> LoRAAdapter:
+    """Deterministic test/bench adapter: both A and B non-zero (real
+    checkpoints zero-init B; a zero delta would make every differential
+    test vacuous), small enough that greedy decoding stays stable."""
+    validate_base_model(cfg)
+    targets = tuple(targets or lora_targets(cfg))
+    rng = np.random.default_rng(
+        np.random.SeedSequence([seed, len(name)]
+                               + [ord(c) for c in name[:16]]))
+    layers = []
+    for _ in range(cfg.num_layers):
+        lp = {}
+        for t in targets:
+            din, dout = target_dims(cfg, t)
+            a = rng.standard_normal((din, rank)).astype(np.float32)
+            a *= scale / np.sqrt(din)
+            b = rng.standard_normal((rank, dout)).astype(np.float32)
+            b *= scale / np.sqrt(rank)
+            lp[t] = (a, b)
+        layers.append(lp)
+    ad = LoRAAdapter(name=name, rank=rank,
+                     alpha=float(alpha if alpha is not None else rank),
+                     targets=targets, layers=layers)
+    return _check_adapter(cfg, ad)
+
+
+def save_adapter(ad: LoRAAdapter, path: str):
+    """Checkpoint-directory format: lora_config.json + lora.npz with
+    ``{layer}.{target}.a/.b`` keys — load_adapter's inverse."""
+    os.makedirs(path, exist_ok=True)
+    arrays = {}
+    for li, lp in enumerate(ad.layers):
+        for t, (a, b) in lp.items():
+            arrays[f"{li}.{t}.a"] = a
+            arrays[f"{li}.{t}.b"] = b
+    np.savez(os.path.join(path, "lora.npz"), **arrays)
+    with open(os.path.join(path, "lora_config.json"), "w") as f:
+        json.dump({"name": ad.name, "rank": ad.rank, "alpha": ad.alpha,
+                   "targets": list(ad.targets),
+                   "num_layers": len(ad.layers)}, f)
+
+
+def load_adapter(cfg: ModelConfig, name: str, source: str,
+                 max_rank: Optional[int] = None) -> LoRAAdapter:
+    """Load one adapter from a checkpoint directory and validate it
+    against the base model's shapes. Any problem raises ValueError —
+    the caller turns that into a structured 400 / failed request."""
+    cfg_path = os.path.join(source, "lora_config.json")
+    npz_path = os.path.join(source, "lora.npz")
+    if not (os.path.isfile(cfg_path) and os.path.isfile(npz_path)):
+        raise ValueError(f"adapter {name!r}: {source!r} is not a LoRA "
+                         "checkpoint dir (lora_config.json + lora.npz)")
+    with open(cfg_path) as f:
+        meta = json.load(f)
+    data = np.load(npz_path)
+    layers: List[Dict[str, Tuple[np.ndarray, np.ndarray]]] = []
+    for li in range(int(meta["num_layers"])):
+        lp = {}
+        for t in meta["targets"]:
+            lp[t] = (np.asarray(data[f"{li}.{t}.a"], np.float32),
+                     np.asarray(data[f"{li}.{t}.b"], np.float32))
+        layers.append(lp)
+    ad = LoRAAdapter(name=name, rank=int(meta["rank"]),
+                     alpha=float(meta.get("alpha", meta["rank"])),
+                     targets=tuple(meta["targets"]), layers=layers)
+    return _check_adapter(cfg, ad, max_rank=max_rank)
+
+
+def resolve(cfg: ModelConfig, name: str, source: str,
+            max_rank: Optional[int] = None) -> LoRAAdapter:
+    """Turn a registry ``source`` into a validated adapter: either a
+    ``synth:`` URI (``synth:rank=8,seed=3,scale=0.05`` — the bench/test
+    path, deterministic in (cfg, name, params)) or a checkpoint
+    directory for ``load_adapter``. ValueError on any problem."""
+    if source == "synth" or source.startswith("synth:"):
+        kw = {}
+        spec = source.partition(":")[2]
+        for part in filter(None, spec.split(",")):
+            k, _, v = part.partition("=")
+            if k not in ("rank", "seed", "alpha", "scale"):
+                raise ValueError(
+                    f"adapter {name!r}: unknown synth param {k!r}")
+            kw[k] = float(v) if k in ("alpha", "scale") else int(v)
+        return _check_adapter(cfg, synthesize(cfg, name, **kw),
+                              max_rank=max_rank)
+    return load_adapter(cfg, name, source, max_rank=max_rank)
+
+
+def build_pack(cfg: ModelConfig, slot_adapters: List[Optional[LoRAAdapter]],
+               max_rank: int) -> Dict[str, Dict[str, np.ndarray]]:
+    """Stack slot adapters into the device pack: for every target,
+    ``{"a": [L, S, din, rmax], "b": [L, S, rmax, dout]}`` float32.
+    ``slot_adapters[0]`` must be None (the base model's zero slot);
+    empty slots and un-targeted projections are zeros. The alpha/rank
+    scale is folded into B here so the hot path never multiplies it."""
+    S, L = len(slot_adapters), cfg.num_layers
+    pack: Dict[str, Dict[str, np.ndarray]] = {}
+    for t in lora_targets(cfg):
+        din, dout = target_dims(cfg, t)
+        pack[t] = {"a": np.zeros((L, S, din, max_rank), np.float32),
+                   "b": np.zeros((L, S, max_rank, dout), np.float32)}
+    for s, ad in enumerate(slot_adapters):
+        if ad is None:
+            continue
+        if s == 0:
+            raise ValueError("slot 0 is reserved for the base model")
+        for li, lp in enumerate(ad.layers):
+            for t, (a, b) in lp.items():
+                pack[t]["a"][li, s, :, :ad.rank] = a
+                pack[t]["b"][li, s, :ad.rank, :] = b * ad.scale
+    return pack
+
+
+class LoRAHostStore:
+    """Bounded host-RAM adapter tier: LRU by bytes, HostKVArena
+    discipline (runtime/kvtier.py) — occupancy + hit/miss/eviction
+    counters, oldest-first eviction under ``put`` pressure, and a
+    caller-supplied pinned set (device-slotted adapters with live
+    requests) that eviction must skip. A put that cannot fit even
+    after evicting every unpinned adapter raises ValueError."""
+
+    def __init__(self, capacity_mb: Optional[float] = None):
+        if capacity_mb is None:
+            capacity_mb = host_mb_from_env()
+        self.capacity_bytes = int(max(0.0, float(capacity_mb)) * 2**20)
+        self._adapters: "collections.OrderedDict[str, LoRAAdapter]" = \
+            collections.OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self._lock = locks.lock("lora.host_store")
+
+    def get(self, name: str) -> Optional[LoRAAdapter]:
+        with self._lock:
+            ad = self._adapters.get(name)
+            if ad is None:
+                self.misses += 1
+                return None
+            self._adapters.move_to_end(name)
+            self.hits += 1
+            return ad
+
+    def peek(self, name: str) -> Optional[LoRAAdapter]:
+        """Lookup WITHOUT touching recency or hit/miss accounting — for
+        internal rebuilds (device-pack refresh) that must not distort
+        the LRU order serving traffic establishes."""
+        with self._lock:
+            return self._adapters.get(name)
+
+    def put(self, ad: LoRAAdapter, pinned=()) -> List[str]:
+        """Insert (or refresh) an adapter; returns evicted names."""
+        if ad.nbytes > self.capacity_bytes:
+            raise ValueError(
+                f"adapter {ad.name!r} ({ad.nbytes} B) exceeds the host "
+                f"store budget ({self.capacity_bytes} B, DLI_LORA_HOST_MB)")
+        evicted: List[str] = []
+        with self._lock:
+            old = self._adapters.pop(ad.name, None)
+            if old is not None:
+                self._bytes -= old.nbytes
+            while self._bytes + ad.nbytes > self.capacity_bytes:
+                victim = next((n for n in self._adapters
+                               if n not in pinned), None)
+                if victim is None:
+                    # roll back: nothing unpinned left to evict
+                    if old is not None:
+                        self._adapters[ad.name] = old
+                        self._bytes += old.nbytes
+                    raise ValueError(
+                        f"adapter {ad.name!r} does not fit: every "
+                        "resident adapter is pinned by live requests")
+                v = self._adapters.pop(victim)
+                self._bytes -= v.nbytes
+                self.evictions += 1
+                evicted.append(victim)
+            self._adapters[ad.name] = ad
+            self._bytes += ad.nbytes
+        return evicted
+
+    def drop(self, name: str) -> bool:
+        with self._lock:
+            ad = self._adapters.pop(name, None)
+            if ad is None:
+                return False
+            self._bytes -= ad.nbytes
+            return True
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return list(self._adapters)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"adapters": len(self._adapters), "bytes": self._bytes,
+                    "capacity_bytes": self.capacity_bytes,
+                    "hits": self.hits, "misses": self.misses,
+                    "evictions": self.evictions}

@@ -44,6 +44,7 @@ import jax
 import jax.numpy as jnp
 
 from distributed_llm_inferencing_tpu.models.config import ModelConfig
+from distributed_llm_inferencing_tpu.ops import lora as lora_ops
 from distributed_llm_inferencing_tpu.ops.attention import (
     attend_decode, attend_prefill, resolve_backend)
 from distributed_llm_inferencing_tpu.ops.kvcache import KVCache, write_block
@@ -130,12 +131,33 @@ def _act(x, kind: str):
     return jax.nn.gelu(x, approximate=True)  # gpt2 uses gelu_new
 
 
-def _mlp(x, lp, cfg: ModelConfig):
+def _lora_apply(y, x, lp, name, lora_ids):
+    """Add the slot-gathered LoRA delta for projection ``name`` when the
+    layer tree carries an adapter pack (``params["layers"]["lora"]``,
+    sliced per layer by the scan/unroll like every other leaf).
+    ``lora_ids`` [B] selects each row's adapter slot — 0 is the base
+    model's all-zero slot, an exact-zero delta. None (the dense/engine
+    path, where one adapter serves the whole batch) defaults every row
+    to slot 0 of the attached pack. Base trees carry no ``lora`` key, so
+    the base program traces no delta code at all."""
+    lo = lp.get("lora") if isinstance(lp, dict) else None
+    if lo is None or name not in lo:
+        return y
+    ids = (lora_ids if lora_ids is not None
+           else jnp.zeros((x.shape[0],), jnp.int32))
+    return y + lora_ops.gathered_delta(x, lo[name], ids)
+
+
+def _mlp(x, lp, cfg: ModelConfig, lora_ids=None):
     if cfg.gated_mlp:
-        h = _act(_linear(x, lp["gate"]), cfg.activation) * _linear(x, lp["up"])
+        h = _act(_lora_apply(_linear(x, lp["gate"]), x, lp, "gate",
+                             lora_ids), cfg.activation) \
+            * _lora_apply(_linear(x, lp["up"]), x, lp, "up", lora_ids)
     else:
-        h = _act(_linear(x, lp["up"]), cfg.activation)
-    return _linear(h, lp["down"], row_sharded=cfg.tp_row_sharded)
+        h = _act(_lora_apply(_linear(x, lp["up"]), x, lp, "up", lora_ids),
+                 cfg.activation)
+    y = _linear(h, lp["down"], row_sharded=cfg.tp_row_sharded)
+    return _lora_apply(y, h, lp, "down", lora_ids)
 
 
 def _ew(operand, p, eq):
@@ -636,7 +658,8 @@ def _mla_latent_attn(h, lp, cfg: ModelConfig, q_positions, cache_k,
 
 
 def _block_body(x, lp, cfg: ModelConfig, q_positions, attend_write,
-                mla_latent_attend=None, fused_q_attend=None):
+                mla_latent_attend=None, fused_q_attend=None,
+                lora_ids=None):
     """One transformer block: norm → QKV (+RoPE) → attend → norm → MLP/MoE.
 
     The single definition of the block structure, shared by the dense path
@@ -691,9 +714,14 @@ def _block_body(x, lp, cfg: ModelConfig, q_positions, attend_write,
     if cfg.mla:
         q, k, v = _mla_qkv(h, lp, cfg, q_positions)   # rope applied inside
     else:
-        q = _linear(h, lp["q"]).reshape(B, s, cfg.num_heads, cfg.head_dim)
-        k = _linear(h, lp["k"]).reshape(B, s, cfg.num_kv_heads, cfg.head_dim)
-        v = _linear(h, lp["v"]).reshape(B, s, cfg.num_kv_heads, cfg.head_dim)
+        # LoRA deltas on the flat projection outputs (models/lora.py
+        # rejects MLA/MoE bases, so the arms above never carry a pack)
+        q = _lora_apply(_linear(h, lp["q"]), h, lp, "q", lora_ids) \
+            .reshape(B, s, cfg.num_heads, cfg.head_dim)
+        k = _lora_apply(_linear(h, lp["k"]), h, lp, "k", lora_ids) \
+            .reshape(B, s, cfg.num_kv_heads, cfg.head_dim)
+        v = _lora_apply(_linear(h, lp["v"]), h, lp, "v", lora_ids) \
+            .reshape(B, s, cfg.num_kv_heads, cfg.head_dim)
 
         if cfg.qkv_clip is not None:   # dbrx clip_qkv activation clamp
             q = jnp.clip(q, -cfg.qkv_clip, cfg.qkv_clip)
@@ -730,12 +758,14 @@ def _block_body(x, lp, cfg: ModelConfig, q_positions, attend_write,
     vd = cfg.v_head_dim_effective
     if vd < cfg.head_dim:   # MLA: v rode the cache zero-padded
         attn = attn[..., :vd]
-    attn = _linear(attn.reshape(B, s, cfg.num_heads * vd), lp["o"],
-                   row_sharded=cfg.tp_row_sharded)
-    return _block_tail(x, h, attn, cache_out, lp, cfg)
+    attn_flat = attn.reshape(B, s, cfg.num_heads * vd)
+    attn = _lora_apply(
+        _linear(attn_flat, lp["o"], row_sharded=cfg.tp_row_sharded),
+        attn_flat, lp, "o", lora_ids)
+    return _block_tail(x, h, attn, cache_out, lp, cfg, lora_ids=lora_ids)
 
 
-def _block_tail(x, h, attn, cache_out, lp, cfg: ModelConfig):
+def _block_tail(x, h, attn, cache_out, lp, cfg: ModelConfig, lora_ids=None):
     """Post-attention half of the block: residual topology + MLP/MoE
     (shared by the materialized and MLA-latent attention dispatches)."""
     if cfg.post_block_norms:   # gemma2 sandwich: norm BEFORE the residual
@@ -748,7 +778,8 @@ def _block_tail(x, h, attn, cache_out, lp, cfg: ModelConfig):
     if cfg.parallel_residual:
         h2 = h if cfg.shared_attn_mlp_norm else norm(
             x, lp["mlp_norm"], cfg.norm_type, cfg.norm_eps)
-        mlp_out = _moe(h2, lp, cfg) if cfg.is_moe else _mlp(h2, lp, cfg)
+        mlp_out = _moe(h2, lp, cfg) if cfg.is_moe \
+            else _mlp(h2, lp, cfg, lora_ids=lora_ids)
         if cfg.residual_scale is not None:
             mlp_out = mlp_out * cfg.residual_scale
         return x + attn + mlp_out, cache_out
@@ -759,7 +790,8 @@ def _block_tail(x, h, attn, cache_out, lp, cfg: ModelConfig):
 
     h = x if (cfg.post_norm or cfg.sublayer_postnorm_only) else norm(
         x, lp["mlp_norm"], cfg.norm_type, cfg.norm_eps)
-    moe_out = _moe(h, lp, cfg) if cfg.is_moe else _mlp(h, lp, cfg)
+    moe_out = _moe(h, lp, cfg) if cfg.is_moe \
+        else _mlp(h, lp, cfg, lora_ids=lora_ids)
     if cfg.post_block_norms:
         moe_out = norm(moe_out, lp["mlp_post_norm"], cfg.norm_type,
                        cfg.norm_eps)
@@ -952,7 +984,7 @@ def decode_step(params, cfg: ModelConfig, tokens, cache: KVCache,
 # ----------------------------------------------------------------------
 
 def paged_decode_step(params, cfg: ModelConfig, tokens, paged,
-                      block_tables, context_lens):
+                      block_tables, context_lens, lora_ids=None):
     """One decode step over the paged cache for R serving slots.
 
     tokens: [R] next token per slot; paged: ops.paged_kvcache.PagedKVCache;
@@ -977,7 +1009,13 @@ def paged_decode_step(params, cfg: ModelConfig, tokens, paged,
     # round-trips HBM. Interpret mode off-TPU (the differential oracle
     # path the parity suite exercises); the unfused formulation below
     # stays bitwise-authoritative everywhere the gate declines.
-    use_fused = fused_decode.eligible(cfg, quantized)
+    # the fused kernel owns q end-to-end, so a wave carrying LoRA rows —
+    # explicit ids, or an adapter pack riding the layer tree — must run
+    # the unfused formulation where the q/o deltas have a seam
+    has_lora = (isinstance(params.get("layers"), dict)
+                and "lora" in params["layers"])
+    use_fused = (fused_decode.eligible(cfg, quantized)
+                 and lora_ids is None and not has_lora)
     fused_interpret = jax.default_backend() != "tpu"
     rope_cos = rope_sin = None
     if use_fused and cfg.position_embedding == "rope":
@@ -1033,7 +1071,8 @@ def paged_decode_step(params, cfg: ModelConfig, tokens, paged,
                     sinks=_sinks(seg_cfg, lp))
                 return attn, (nk, nv)
 
-            return _block_body(x, lp, seg_cfg, q_pos, attend_write)
+            return _block_body(x, lp, seg_cfg, q_pos, attend_write,
+                               lora_ids=lora_ids)
         return body
 
     xs = (paged.k, paged.v) + (
@@ -1051,7 +1090,8 @@ _PREGATHER_MAX_BYTES = 256 * 1024 * 1024
 
 def paged_decode_chunk(params, cfg: ModelConfig, k: int, tokens, paged,
                        block_tables, context_lens, seeds, steps0, temps,
-                       tks, tps, ds, budget, eos_ids, dummy_block: int):
+                       tks, tps, ds, budget, eos_ids, dummy_block: int,
+                       lora_ids=None):
     """Run K decode steps + sampling entirely on device for R serving slots.
 
     The continuous batcher's throughput lever: one dispatched program
@@ -1104,7 +1144,7 @@ def paged_decode_chunk(params, cfg: ModelConfig, k: int, tokens, paged,
         return _paged_decode_chunk_stepwise(
             params, cfg, k, tokens, paged, block_tables, context_lens,
             seeds, steps0, temps, tks, tps, ds, budget, eos_ids,
-            dummy_block)
+            dummy_block, lora_ids=lora_ids)
 
     r = tokens.shape[0]
     L = cfg.num_layers
@@ -1184,7 +1224,8 @@ def paged_decode_chunk(params, cfg: ModelConfig, k: int, tokens, paged,
                     return attn, (sk2, sv2)
 
                 x, (sk2, sv2) = _block_body(x, lp, seg_cfg, q_pos,
-                                            attend_write)
+                                            attend_write,
+                                            lora_ids=lora_ids)
                 return x, (sk2, sv2)
             return layer
 
@@ -1231,7 +1272,7 @@ def paged_decode_chunk(params, cfg: ModelConfig, k: int, tokens, paged,
 def _paged_decode_chunk_stepwise(params, cfg: ModelConfig, k: int, tokens,
                                  paged, block_tables, context_lens, seeds,
                                  steps0, temps, tks, tps, ds, budget,
-                                 eos_ids, dummy_block: int):
+                                 eos_ids, dummy_block: int, lora_ids=None):
     """K decode steps via per-step ``paged_decode_step`` (pool writes and
     the backend-dispatched paged attention every step). Semantically
     identical to the side-buffer formulation in ``paged_decode_chunk``;
@@ -1244,7 +1285,7 @@ def _paged_decode_chunk_stepwise(params, cfg: ModelConfig, k: int, tokens,
         bt_eff = jnp.where(alive[:, None], block_tables, dummy_block)
         cl_eff = jnp.where(alive, cl, 0)
         logits, paged = paged_decode_step(params, cfg, cur, paged, bt_eff,
-                                          cl_eff)
+                                          cl_eff, lora_ids=lora_ids)
         nxt = sample_batch(logits, seeds, steps0 + t, temps, tks, tps, ds)
         is_eos = alive & (eos_ids >= 0) & (nxt == eos_ids)
         emit = alive & ~is_eos
@@ -1262,7 +1303,7 @@ def paged_speculative_chunk(params, cfg: ModelConfig, k: int, gamma: int,
                             tokens, history, paged, block_tables,
                             context_lens, seeds, steps0, temps, tks, tps,
                             ds, budget, eos_ids, dummy_block: int,
-                            gammas=None):
+                            gammas=None, lora_ids=None):
     """K speculative iterations on device for R serving slots: draft
     gamma tokens per slot by on-device prompt lookup
     (ops/speculative.py propose_ngram_device), score [cur, drafts] in one
@@ -1411,7 +1452,8 @@ def paged_speculative_chunk(params, cfg: ModelConfig, k: int, gamma: int,
                     return attn, (sk2, sv2)
 
                 x, (sk2, sv2) = _block_body(x, lp, seg_cfg, qp,
-                                            attend_write)
+                                            attend_write,
+                                            lora_ids=lora_ids)
                 return x, (sk2, sv2)
             return layer
 
@@ -1505,7 +1547,8 @@ def paged_speculative_chunk(params, cfg: ModelConfig, k: int, gamma: int,
 
 
 def paged_prefill_tail(params, cfg: ModelConfig, tokens, tail_len,
-                       tail_blocks, prefix_blocks, prefix_len, paged):
+                       tail_blocks, prefix_blocks, prefix_len, paged,
+                       lora_ids=None):
     """Prefill a WAVE of prompt tails into paged blocks, each attending its
     own cached prefix.
 
@@ -1571,7 +1614,8 @@ def paged_prefill_tail(params, cfg: ModelConfig, tokens, tail_len,
                     sinks=_sinks(seg_cfg, lp))
                 return attn, (nk, nv)
 
-            return _block_body(x, lp, seg_cfg, q_pos, attend_write)
+            return _block_body(x, lp, seg_cfg, q_pos, attend_write,
+                               lora_ids=lora_ids)
         return body
 
     xs = (paged.k, paged.v) + (

@@ -1,0 +1,47 @@
+"""Gathered batched LoRA delta (BGMV-style) for multi-adapter waves.
+
+One shared base weight pass serves every slot in a wave; each slot then
+adds its OWN adapter's rank-r delta ``x @ A_s @ B_s`` where ``s`` is the
+slot's adapter id riding the ints pack as data. The adapter id indexes a
+stacked device pack — ``A [S, din, rmax]`` / ``B [S, rmax, dout]`` per
+projection, per layer — so a wave mixing any assignment of the S resident
+adapters runs ONE compiled program: adapter mixes change gather indices,
+never shapes. Slot 0 is the base model (all-zero A/B — an exact-zero
+delta), and the ``alpha / rank`` scale is folded into B at pack-build
+time (models/lora.py), keeping the hot path two einsums.
+
+The delta is two skinny matmuls (din·r + r·dout FLOPs per token vs
+din·dout for the base projection), so at rank <= 64 the wave's cost is
+dominated by the shared base pass — the amortization multi-LoRA serving
+exists for. Plain ``jnp.einsum`` formulation: XLA fuses the gather into
+the batched dots on TPU and CPU alike, and rank-r contractions are too
+skinny for a custom pallas kernel to beat the MXU path.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def gathered_delta(x, pack, ids):
+    """Per-row LoRA delta: ``out[b] = x[b] @ A[ids[b]] @ B[ids[b]]``.
+
+    x: [B, T, din] activations (T may be 1 — decode — or a padded tail).
+    pack: {"a": [S, din, rmax], "b": [S, rmax, dout]} stacked adapters
+        (ONE layer's slice of the [L, S, ...] device pack; the layer
+        scan/unroll slices the leading axis like every other leaf).
+    ids: [B] int32 adapter slot per row; 0 = base (zero delta).
+    Returns [B, T, dout] in x.dtype.
+    """
+    a = pack["a"][ids].astype(x.dtype)          # [B, din, rmax]
+    b = pack["b"][ids].astype(x.dtype)          # [B, rmax, dout]
+    h = jnp.einsum("btd,bdr->btr", x, a)
+    return jnp.einsum("btr,brf->btf", h, b)
+
+
+def merge_into_dense(w, a, b, scale: float):
+    """Reference merge for differential tests: the dense weight a LoRA
+    pair is equivalent to — ``w + scale * (a @ b)`` with
+    ``scale = alpha / rank`` (the same factor build_pack folds into B).
+    Test-path only; serving never materializes merged weights."""
+    return w + scale * (a.astype(w.dtype) @ b.astype(w.dtype))

@@ -50,6 +50,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from distributed_llm_inferencing_tpu.models import lora as lora_mod
 from distributed_llm_inferencing_tpu.models import transformer
 from distributed_llm_inferencing_tpu.models.config import ModelConfig
 from distributed_llm_inferencing_tpu.models.params import init_params
@@ -131,6 +132,15 @@ class BatchRequest:
     kv_export: bool = False
     _peer_fetch_done: bool = False
     _kv_transfer_bytes: int = 0
+    # Multi-LoRA serving (models/lora.py): the adapter this request's
+    # tokens run through (None = base weights) and the device-pack slot
+    # its wave rows gather (0 = base; assigned at admission prep and
+    # stable while the adapter's refcount pins the slot). The refcount
+    # is taken at submit and released exactly once at the terminal
+    # accounting point (_observe_finished).
+    adapter: Optional[str] = None
+    _lora_slot: int = 0
+    _lora_released: bool = False
     # Per-request decode-chunk ceiling (master brownout rung 3 sends
     # body["decode_chunk_cap"] on latency-class dispatches — see
     # runtime/master.py _infer_body and docs/robustness.md "Overload
@@ -479,6 +489,30 @@ class ContinuousBatcher:
         # declarative SLO targets (runtime/tsdb.py): used worker-side
         # only to flag SLO-violating requests for trace tail-retention
         self._slo_targets = tsdb_mod.slo_targets()
+        # Multi-LoRA serving (models/lora.py): a bounded host adapter
+        # tier (LRU by bytes, DLI_LORA_HOST_MB) feeding DLI_LORA_SLOTS
+        # device pack slots (+ reserved slot 0 = base). Loading or
+        # evicting an adapter rebuilds the stacked device pack DATA —
+        # shapes are static in (slots, max_rank), so adapter mixes
+        # never recompile. Refcounts pin a slotted adapter while any
+        # submitted request still references it.
+        self._lora_lock = locks.lock("batcher.lora")
+        self._lora_store = lora_mod.LoRAHostStore()
+        self._lora_max_rank = lora_mod.max_rank_from_env()
+        self._lora_slot_names: List[Optional[str]] = \
+            [None] * (lora_mod.slots_from_env() + 1)
+        self._lora_refs: Dict[str, int] = {}
+        self._lora_last_use: Dict[str, int] = {}
+        self._lora_seq = 0
+        self._params_lora = None   # params tree + layers["lora"] pack
+        # pre-register the adapter plane at 0 (PR 5 rule): the TSDB
+        # catalog and a first scrape must see the series exist before
+        # the first load/submit
+        self.metrics.gauge("lora_host_bytes", 0.0)
+        self.metrics.gauge("lora_host_adapters", 0.0)
+        for name in ("lora_loads", "lora_evictions", "lora_load_failures",
+                     "lora_requests"):
+            self.metrics.inc(name, 0)
         # opt-in sampling phase profiler for this step loop
         # (utils/profiler.py; DLI_PROFILE=1 or worker POST /api/profile)
         self.profiler = PhaseProfiler.from_env()
@@ -531,12 +565,18 @@ class ContinuousBatcher:
                       kv_transfer_bytes: int = 0,
                       resume: Optional[dict] = None,
                       trace_ctx=None,
-                      chunk_cap: Optional[int] = None) -> BatchRequest:
+                      chunk_cap: Optional[int] = None,
+                      adapter: Optional[str] = None) -> BatchRequest:
         """Validate and build one BatchRequest WITHOUT enqueueing it —
         submit()/submit_many() construct first so a bad spec can never
         leave siblings half-enqueued."""
         if not prompt:
             raise ValueError("empty prompt")
+        if isinstance(resume, dict) and resume.get("adapter"):
+            # a migrated-in request keeps its source adapter: serving
+            # the continuation on base weights would silently change
+            # the model mid-stream
+            adapter = str(resume["adapter"])
         if isinstance(resume, dict) and resume.get("seed") is not None:
             # a live-migration resume MUST keep the source's seed: the
             # position-keyed PRNG ((seed, steps) per emitted position)
@@ -554,6 +594,7 @@ class ContinuousBatcher:
                                                               dict)
                                       else None),
                            kv_export=bool(kv_export),
+                           adapter=(str(adapter) if adapter else None),
                            chunk_cap=max(0, int(chunk_cap or 0)),
                            # explicit ctx for callers submitting from a
                            # helper thread (SSE streams), ambient otherwise
@@ -590,6 +631,11 @@ class ContinuousBatcher:
             raise ValueError(
                 f"prompt ({len(req.prompt)}) + max_new_tokens "
                 f"({req.max_new_tokens}) exceeds max_seq {self.max_seq}")
+        if req.adapter:
+            # LAST validation: pinning is the only step with a side
+            # effect, so an earlier raise can never leak a refcount
+            self._pin_lora(req.adapter)   # ValueError when not loaded
+            self.metrics.inc("lora_requests")
         return req
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 100,
@@ -602,11 +648,13 @@ class ContinuousBatcher:
                kv_transfer_bytes: int = 0,
                resume: Optional[dict] = None,
                trace_ctx=None,
-               chunk_cap: Optional[int] = None) -> BatchRequest:
+               chunk_cap: Optional[int] = None,
+               adapter: Optional[str] = None) -> BatchRequest:
         req = self._make_request(prompt, max_new_tokens, sampling,
                                  eos_token_id, stream_cb, seed,
                                  kv_source, kv_export, kv_transfer_bytes,
-                                 resume, trace_ctx, chunk_cap=chunk_cap)
+                                 resume, trace_ctx, chunk_cap=chunk_cap,
+                                 adapter=adapter)
         with self._lock:
             self.queue.append(req)
             depth = len(self.queue)
@@ -622,7 +670,17 @@ class ContinuousBatcher:
         append them under ONE lock acquisition with one scheduler wake,
         preserving the caller's order end-to-end. One master dispatch
         batch therefore admits FIFO, exactly as submitted."""
-        reqs = [self._make_request(**spec) for spec in specs]
+        reqs: List[BatchRequest] = []
+        try:
+            for spec in specs:
+                reqs.append(self._make_request(**spec))
+        except Exception:
+            # all-or-nothing: drop the adapter refcounts the already-
+            # built siblings pinned, or a failing batch would pin its
+            # adapters forever
+            for r in reqs:
+                self._release_lora(r)
+            raise
         if not reqs:
             return []
         with self._lock:
@@ -696,6 +754,10 @@ class ContinuousBatcher:
                        if self.kvtier is not None else None),
             "prefix_digests": (self.kvtier.index.advertise()
                                if self.kvtier is not None else None),
+            # resident-adapter advertisement: rides the worker's /health
+            # body into the master's runtime snapshot the same way the
+            # prefix digests do, feeding adapter-affinity routing
+            "adapters": self.lora_stats(),
         }
 
     def _spec_wave_stats(self) -> Optional[dict]:
@@ -717,6 +779,165 @@ class ContinuousBatcher:
                                  2) if ctls else None),
         }
 
+    # ---- multi-LoRA adapters (models/lora.py) -------------------------
+
+    def load_adapter(self, name: str, source: str) -> dict:
+        """Make an adapter host-resident (worker ``POST /load_adapter``
+        and the master's lazy dispatch-time load land here). Device slot
+        assignment is deferred to the first admission that needs it.
+        Idempotent for an already-resident name. Returns
+        ``{name, rank, nbytes, evicted}`` — the caller emits the
+        adapter-loaded / adapter-evicted events. ValueError on any
+        problem (bad source, shape mismatch, store full of pinned
+        adapters) — the request path NEVER falls back to base weights."""
+        if self.mesh_spec.pp > 1:
+            raise ValueError(
+                "LoRA serving does not support pp > 1 (the pipelined "
+                "chunk programs re-stage layers without the delta pack)")
+        lora_mod.validate_base_model(self.cfg)
+        with self._lora_lock:
+            ad = self._lora_store.get(name)
+            evicted: List[str] = []
+            if ad is None:
+                try:
+                    ad = lora_mod.resolve(self.cfg, name, source,
+                                          max_rank=self._lora_max_rank)
+                    pinned = {n for n, c in self._lora_refs.items() if c}
+                    evicted = self._lora_store.put(ad, pinned=pinned)
+                except ValueError:
+                    self.metrics.inc("lora_load_failures")
+                    raise
+                self.metrics.inc("lora_loads")
+                self.metrics.inc("lora_evictions", len(evicted))
+                # a host-evicted adapter cannot back a device slot: clear
+                # its slot (refcount 0 by the pinned set) and rebuild
+                dirty = False
+                for i in range(1, len(self._lora_slot_names)):
+                    if self._lora_slot_names[i] in evicted:
+                        self._lora_slot_names[i] = None
+                        dirty = True
+                if dirty:
+                    self._rebuild_lora_pack()
+            self._gauge_lora()
+            return {"name": ad.name, "rank": ad.rank, "nbytes": ad.nbytes,
+                    "evicted": evicted}
+
+    def unload_adapter(self, name: str) -> bool:
+        """Drop an adapter from the host store and its device slot.
+        Refuses (ValueError) while live requests reference it."""
+        with self._lora_lock:
+            if self._lora_refs.get(name, 0):
+                raise ValueError(
+                    f"adapter {name!r} has live requests; drain first")
+            dirty = False
+            for i in range(1, len(self._lora_slot_names)):
+                if self._lora_slot_names[i] == name:
+                    self._lora_slot_names[i] = None
+                    dirty = True
+            dropped = self._lora_store.drop(name)
+            if dirty:
+                self._rebuild_lora_pack()
+            self._gauge_lora()
+            return dropped
+
+    def lora_stats(self) -> dict:
+        with self._lora_lock:
+            return {
+                "resident": sorted(self._lora_store.names()),
+                "slotted": [n for n in self._lora_slot_names[1:] if n],
+                "slots": len(self._lora_slot_names) - 1,
+                "host": self._lora_store.stats(),
+                "active_refs": {n: c for n, c in self._lora_refs.items()
+                                if c},
+            }
+
+    def _gauge_lora(self):
+        st = self._lora_store.stats()
+        self.metrics.gauge("lora_host_bytes", st["bytes"])
+        self.metrics.gauge("lora_host_adapters", st["adapters"])
+
+    def _pin_lora(self, name: str):
+        """Submit-time refcount: pins the adapter against host eviction
+        (and its slot, once assigned, against slot reuse) from the
+        moment the request exists. ValueError when not host-resident —
+        an unknown adapter is the caller's structured 400."""
+        if self.program_hook is not None:
+            raise ValueError(
+                "LoRA adapters cannot ride multi-host lockstep serving "
+                "(followers hold no adapter store to replay against)")
+        with self._lora_lock:
+            if self._lora_store.get(name) is None:
+                raise ValueError(
+                    f"unknown adapter {name!r} (POST /load_adapter first)")
+            self._lora_refs[name] = self._lora_refs.get(name, 0) + 1
+
+    def _release_lora(self, req: BatchRequest):
+        """Exactly-once refcount release at the terminal accounting
+        point (_observe_finished serves every outcome: finished, failed,
+        migrated). The slot itself stays resident for affinity reuse —
+        only slot pressure from a new adapter reclaims it."""
+        if not req.adapter or req._lora_released:
+            return
+        req._lora_released = True
+        with self._lora_lock:
+            n = self._lora_refs.get(req.adapter, 0)
+            if n > 1:
+                self._lora_refs[req.adapter] = n - 1
+            else:
+                self._lora_refs.pop(req.adapter, None)
+
+    def _assign_lora_slot(self, name: str) -> int:
+        """Bind an adapter to a device pack slot at admission prep.
+        Reuses the existing slot (refcounts keep it stable while any
+        request references it), else takes a free slot, else evicts the
+        least-recently-used refcount-0 slot. All pinned -> ValueError
+        (the admission path fails the request with a clear error)."""
+        with self._lora_lock:
+            ad = self._lora_store.get(name)
+            if ad is None:
+                raise ValueError(
+                    f"adapter {name!r} evicted from the host store "
+                    "before admission (DLI_LORA_HOST_MB)")
+            names = self._lora_slot_names
+            if name in names:
+                s = names.index(name)
+            else:
+                free = [i for i in range(1, len(names))
+                        if names[i] is None]
+                if free:
+                    s = free[0]
+                else:
+                    idle = [i for i in range(1, len(names))
+                            if not self._lora_refs.get(names[i], 0)]
+                    if not idle:
+                        raise ValueError(
+                            f"adapter {name!r}: all {len(names) - 1} "
+                            "device adapter slots are pinned by live "
+                            "requests (DLI_LORA_SLOTS)")
+                    s = min(idle, key=lambda i: self._lora_last_use.get(
+                        names[i], 0))
+                    self.metrics.inc("lora_evictions")
+                names[s] = name
+                self._rebuild_lora_pack()
+            self._lora_seq += 1
+            self._lora_last_use[name] = self._lora_seq
+            return s
+
+    def _rebuild_lora_pack(self):
+        """Re-stack the device pack from the current slot assignment and
+        swap the lora params tree. Shapes depend only on (slots,
+        max_rank) — every rebuild hits the same compiled programs.
+        Caller holds _lora_lock."""
+        slot_ads = [None] + [
+            (self._lora_store.peek(n) if n else None)
+            for n in self._lora_slot_names[1:]]
+        pack = lora_mod.build_pack(self.cfg, slot_ads, self._lora_max_rank)
+        with self.mesh:
+            pack_dev = jax.tree_util.tree_map(jnp.asarray, pack)
+        p = dict(self.params)
+        p["layers"] = dict(self.params["layers"], lora=pack_dev)
+        self._params_lora = p
+
     # ---- compiled steps ----------------------------------------------
 
     # Args cross host->device as TWO packed arrays (int32 + f32) per
@@ -724,10 +945,14 @@ class ContinuousBatcher:
     # eager transfer pays a network round trip, and 13 tiny arrays per
     # chunk cost more than the chunk itself.
 
-    def _admit_jit(self, t: int, pb: int, b: int):
+    def _admit_jit(self, t: int, pb: int, b: int, use_lora: bool = False):
         """Wave-admission program: batched tail prefill + fused first-token
-        sampling — one dispatch per (tail-bucket, prefix-bucket) group."""
-        key = (t, pb, b)
+        sampling — one dispatch per (tail-bucket, prefix-bucket) group.
+        ``use_lora`` variants append per-row adapter slot ids to the ints
+        pack and gather the rank-r delta per row (ops/lora.py); base
+        waves keep the base program — a zero-cost skip, not a masked
+        delta."""
+        key = (t, pb, b, use_lora)
         fn = self._prefill_fns.get(key)
         if fn is None:
             cfg = self.cfg
@@ -738,8 +963,13 @@ class ContinuousBatcher:
                 toks = ints[:b * t].reshape(b, t)
                 tb = ints[b * t:b * (t + nb)].reshape(b, nb)
                 pfb = ints[b * (t + nb):b * (t + nb + pb)].reshape(b, pb)
-                tl, pfl, seeds, steps, tks, ds = (
-                    ints[b * (t + nb + pb):].reshape(6, b))
+                rest = ints[b * (t + nb + pb):]
+                if use_lora:
+                    tl, pfl, seeds, steps, tks, ds, aids = \
+                        rest.reshape(7, b)
+                else:
+                    tl, pfl, seeds, steps, tks, ds = rest.reshape(6, b)
+                    aids = None
                 temps, tps = floats
                 if pp > 1:
                     from distributed_llm_inferencing_tpu.parallel import (
@@ -749,7 +979,8 @@ class ContinuousBatcher:
                         mesh=mesh)
                 else:
                     last, paged = transformer.paged_prefill_tail(
-                        p, cfg, toks, tl, tb, pfb, pfl, paged)
+                        p, cfg, toks, tl, tb, pfb, pfl, paged,
+                        lora_ids=aids)
                 first = sample_batch(last, seeds, steps, temps, tks, tps,
                                      ds.astype(bool))
                 return first, paged
@@ -758,21 +989,27 @@ class ContinuousBatcher:
             self._prefill_fns[key] = fn
         return fn
 
-    def _decode_jit(self, k: int, r: int, mb: int):
+    def _decode_jit(self, k: int, r: int, mb: int, use_lora: bool = False):
         """K-token decode chunk (transformer.paged_decode_chunk), one host
         sync per K tokens for all slots. ``tokens`` rides as its own
         argument — not packed into ``ints`` — so a double-buffered step
         can feed chunk N+1 the device-resident last tokens of chunk N
-        without a host round trip (_step_overlapped)."""
-        fn = self._decode_fns.get((k, r, mb))
+        without a host round trip (_step_overlapped). ``use_lora``
+        variants append per-slot adapter ids to the ints pack."""
+        fn = self._decode_fns.get((k, r, mb, use_lora))
         if fn is None:
             cfg, dummy = self.cfg, self._dummy
             pp, mesh = self.mesh_spec.pp, self.mesh
 
             def chunk(p, tokens, ints, floats, paged):
                 bt = ints[:r * mb].reshape(r, mb)
-                (cl, seeds, steps0, tks, budget, eos_ids,
-                 ds) = ints[r * mb:].reshape(7, r)
+                if use_lora:
+                    (cl, seeds, steps0, tks, budget, eos_ids, ds,
+                     aids) = ints[r * mb:].reshape(8, r)
+                else:
+                    (cl, seeds, steps0, tks, budget, eos_ids,
+                     ds) = ints[r * mb:].reshape(7, r)
+                    aids = None
                 temps, tps = floats
                 if pp > 1:
                     from distributed_llm_inferencing_tpu.parallel import (
@@ -783,20 +1020,23 @@ class ContinuousBatcher:
                         dummy, mesh=mesh)
                 return transformer.paged_decode_chunk(
                     p, cfg, k, tokens, paged, bt, cl, seeds, steps0, temps,
-                    tks, tps, ds.astype(bool), budget, eos_ids, dummy)
+                    tks, tps, ds.astype(bool), budget, eos_ids, dummy,
+                    lora_ids=aids)
 
             fn = jax.jit(chunk, donate_argnums=(4,))
-            self._decode_fns[(k, r, mb)] = fn
+            self._decode_fns[(k, r, mb, use_lora)] = fn
         return fn
 
-    def _spec_jit(self, k: int, g: int, r: int, mb: int, hh: int):
+    def _spec_jit(self, k: int, g: int, r: int, mb: int, hh: int,
+                  use_lora: bool = False):
         """K speculative verify iterations
         (transformer.paged_speculative_chunk): up to (g+1)K tokens per
         slot per host sync. ``g`` is the compiled STATIC maximum draft
         width; the per-slot effective widths ride the ints pack as data
         (wave-level speculation), so one compiled program serves every
-        width mix the per-request controllers produce."""
-        key = ("spec", k, g, r, mb, hh)
+        width mix the per-request controllers produce. ``use_lora``
+        variants append per-slot adapter ids after the widths."""
+        key = ("spec", k, g, r, mb, hh, use_lora)
         fn = self._decode_fns.get(key)
         if fn is None:
             cfg, dummy = self.cfg, self._dummy
@@ -805,8 +1045,14 @@ class ContinuousBatcher:
             def chunk(p, ints, floats, paged):
                 bt = ints[:r * mb].reshape(r, mb)
                 hist = ints[r * mb:r * (mb + hh)].reshape(r, hh)
-                (tokens, cl, seeds, steps0, tks, budget, eos_ids,
-                 ds, gammas) = ints[r * (mb + hh):].reshape(9, r)
+                rest = ints[r * (mb + hh):]
+                if use_lora:
+                    (tokens, cl, seeds, steps0, tks, budget, eos_ids,
+                     ds, gammas, aids) = rest.reshape(10, r)
+                else:
+                    (tokens, cl, seeds, steps0, tks, budget, eos_ids,
+                     ds, gammas) = rest.reshape(9, r)
+                    aids = None
                 temps, tps = floats
                 if pp > 1:
                     from distributed_llm_inferencing_tpu.parallel import (
@@ -818,7 +1064,7 @@ class ContinuousBatcher:
                 return transformer.paged_speculative_chunk(
                     p, cfg, k, g, tokens, hist, paged, bt, cl, seeds,
                     steps0, temps, tks, tps, ds.astype(bool), budget,
-                    eos_ids, dummy, gammas=gammas)
+                    eos_ids, dummy, gammas=gammas, lora_ids=aids)
 
             fn = jax.jit(chunk, donate_argnums=(3,))
             self._decode_fns[key] = fn
@@ -854,7 +1100,7 @@ class ContinuousBatcher:
                 fn = self._decode_jit(k, r, mb)
                 if hasattr(fn, "lower"):   # not yet AOT-compiled
                     ints = jax.ShapeDtypeStruct((r * (mb + 7),), jnp.int32)
-                    self._decode_fns[(k, r, mb)] = fn.lower(
+                    self._decode_fns[(k, r, mb, False)] = fn.lower(
                         self.params, toks, ints, floats,
                         paged_sds).compile()
                     n += 1
@@ -873,7 +1119,8 @@ class ContinuousBatcher:
                     if hasattr(sfn, "lower"):
                         ints = jax.ShapeDtypeStruct(
                             (r * (mb + hh + 9),), jnp.int32)
-                        self._decode_fns[("spec", k_it, g, r, mb, hh)] = \
+                        self._decode_fns[("spec", k_it, g, r, mb, hh,
+                                          False)] = \
                             sfn.lower(self.params, ints, floats,
                                       paged_sds).compile()
                         n += 1
@@ -890,6 +1137,7 @@ class ContinuousBatcher:
         tb = np.asarray(a["tail_alloc"], np.int32)
         pfb = np.asarray(a["pfb"], np.int32)
         b = toks.shape[0]
+        use_lora = "aids" in a
         ints = np.concatenate([
             toks.reshape(-1), tb.reshape(-1), pfb.reshape(-1),
             np.asarray(a["tail_len"], np.int32),
@@ -897,12 +1145,14 @@ class ContinuousBatcher:
             np.asarray(a["seeds"], np.int32),
             np.asarray(a["steps"], np.int32),
             np.asarray(a["tks"], np.int32),
-            np.asarray(a["ds"], np.int32)])
+            np.asarray(a["ds"], np.int32)] + (
+            [np.asarray(a["aids"], np.int32)] if use_lora else []))
         floats = np.stack([np.asarray(a["temps"], np.float32),
                            np.asarray(a["tps"], np.float32)])
-        fn = self._admit_jit(toks.shape[1], pfb.shape[1], b)
+        fn = self._admit_jit(toks.shape[1], pfb.shape[1], b, use_lora)
         with self.mesh:
-            first, self.paged = fn(self.params, jnp.asarray(ints),
+            first, self.paged = fn(self._wave_params(use_lora),
+                                   jnp.asarray(ints),
                                    jnp.asarray(floats), self.paged)
             return np.asarray(first)   # ONE host sync per admission wave
 
@@ -916,18 +1166,21 @@ class ContinuousBatcher:
         without ever visiting the host."""
         bt = np.asarray(a["bt"], np.int32)
         r, mb = bt.shape
+        use_lora = "aids" in a
         ints = np.concatenate([bt.reshape(-1)] + [
             np.asarray(a[key], np.int32) for key in
-            ("cl", "seeds", "steps", "tks", "budget", "eos", "ds")])
+            ("cl", "seeds", "steps", "tks", "budget", "eos", "ds")] + (
+            [np.asarray(a["aids"], np.int32)] if use_lora else []))
         floats = np.stack([np.asarray(a["temps"], np.float32),
                            np.asarray(a["tps"], np.float32)])
-        fn = self._decode_jit(int(a["k"]), r, mb)
+        fn = self._decode_jit(int(a["k"]), r, mb, use_lora)
         with self.mesh:
             with self.profiler.phase("dispatch"):
                 tokens = (tokens_dev if tokens_dev is not None
                           else jnp.asarray(np.asarray(a["tokens"],
                                                       np.int32)))
-                toks, emits, self.paged = fn(self.params, tokens,
+                toks, emits, self.paged = fn(self._wave_params(use_lora),
+                                             tokens,
                                              jnp.asarray(ints),
                                              jnp.asarray(floats),
                                              self.paged)
@@ -1001,23 +1254,38 @@ class ContinuousBatcher:
         r, mb = bt.shape
         gammas = np.asarray(
             a.get("gammas") or [int(a["gamma"])] * r, np.int32)
+        use_lora = "aids" in a
         ints = np.concatenate([bt.reshape(-1), hist.reshape(-1)] + [
             np.asarray(a[key], np.int32) for key in
             ("tokens", "cl", "seeds", "steps", "tks", "budget", "eos", "ds")
-        ] + [gammas])
+        ] + [gammas] + (
+            [np.asarray(a["aids"], np.int32)] if use_lora else []))
         floats = np.stack([np.asarray(a["temps"], np.float32),
                            np.asarray(a["tps"], np.float32)])
         fn = self._spec_jit(int(a["k"]), int(a["gamma"]), r, mb,
-                            hist.shape[1])
+                            hist.shape[1], use_lora)
         # draft+verify run fused in one device program; the profiler
         # attributes the whole dispatch+sync to the verify phase (the
         # host-side drafting state prep is tagged spec_draft by the step)
         with self.mesh:
             with self.profiler.phase("spec_verify"):
                 toks, keeps, eos_seen, self.paged = fn(
-                    self.params, jnp.asarray(ints), jnp.asarray(floats),
-                    self.paged)
+                    self._wave_params(use_lora), jnp.asarray(ints),
+                    jnp.asarray(floats), self.paged)
                 return jax.device_get((toks, keeps, eos_seen))
+
+    def _wave_params(self, use_lora: bool):
+        """The parameter tree a wave's program runs against: the base
+        tree, or — when any slot in the wave carries an adapter id — the
+        LoRA-augmented tree whose ``layers`` dict gains the stacked
+        device pack. Same structure and shapes every rebuild, so the
+        use_lora=True program never recompiles across adapter mixes."""
+        if not use_lora:
+            return self.params
+        if self._params_lora is None:
+            raise RuntimeError(
+                "wave carries adapter ids but no LoRA pack is built")
+        return self._params_lora
 
     def replay(self, kind: str, args: dict):
         """Re-execute a program the lockstep leader broadcast. SPMD
@@ -1589,6 +1857,7 @@ class ContinuousBatcher:
             "eos_token_id": req.eos_token_id,
             "spec": (req._spec_ctl.export_state()
                      if req._spec_ctl is not None else None),
+            "adapter": req.adapter,
         }
         req._migrated = True
         req.error = "migrated"
@@ -1644,6 +1913,13 @@ class ContinuousBatcher:
         the prefill (generation resumes where it left off — streamed
         tokens are never re-emitted).
         """
+        if req.adapter:
+            # bind the adapter to a device slot now (not at submit):
+            # slots are a wave-level resource, and admission is where
+            # the request joins a wave. All-slots-pinned raises — the
+            # caller fails the request rather than silently serving
+            # base weights.
+            req._lora_slot = self._assign_lora_slot(req.adapter)
         bs = self.block_size
         prompt = req.prompt + req.tokens
         n = len(prompt)
@@ -1809,6 +2085,7 @@ class ContinuousBatcher:
         tks = np.zeros((b,), np.int32)
         tps = np.ones((b,), np.float32)
         ds = np.zeros((b,), bool)
+        aids = np.zeros((b,), np.int32)
         for j, m in enumerate(members):
             req = m["req"]
             toks[j, :m["tail_len"]] = \
@@ -1824,6 +2101,7 @@ class ContinuousBatcher:
             tks[j] = sp.top_k
             tps[j] = sp.top_p
             ds[j] = sp.do_sample
+            aids[j] = req._lora_slot
 
         admit_args = {
             "toks": toks.tolist(), "tail_len": tail_len.tolist(),
@@ -1832,6 +2110,11 @@ class ContinuousBatcher:
             "steps": steps.tolist(), "temps": temps.tolist(),
             "tks": tks.tolist(), "tps": tps.tolist(), "ds": ds.tolist(),
         }
+        if aids.any():
+            # the key's PRESENCE selects the lora program variant — a
+            # base-only wave compiles/runs the unaugmented program, and
+            # lockstep followers replaying the args pick the same one
+            admit_args["aids"] = aids.tolist()
         w0 = clock.now()
         for m in members:
             # cost ledger: queue phase ends when the FIRST wave carrying
@@ -2053,6 +2336,7 @@ class ContinuousBatcher:
         from the request's own timestamps (the scheduler thread has no
         ambient trace context — the link rides req.trace_ctx), plus the
         cost-ledger record the worker returns with the result."""
+        self._release_lora(req)   # every terminal outcome funnels here
         m = self.metrics
         m.inc("batcher_requests_migrated" if req._migrated
               else "batcher_requests_failed" if req.error
@@ -2255,6 +2539,7 @@ class ContinuousBatcher:
             ds = np.zeros((r,), bool)
             budget = np.zeros((r,), np.int32)
             eos = np.full((r,), -1, np.int32)
+            aids = np.zeros((r,), np.int32)
             for i in active:
                 req = self.active[i]
                 tokens[i] = req.tokens[-1]
@@ -2267,6 +2552,7 @@ class ContinuousBatcher:
                 budget[i] = min(k, req.max_new_tokens - len(req.tokens))
                 if req.eos_token_id is not None:
                     eos[i] = req.eos_token_id
+                aids[i] = req._lora_slot
 
             decode_args = {
                 "k": int(k),
@@ -2276,6 +2562,10 @@ class ContinuousBatcher:
                 "tks": tks.tolist(), "tps": tps.tolist(), "ds": ds.tolist(),
                 "budget": budget.tolist(), "eos": eos.tolist(),
             }
+            if aids.any():
+                # key PRESENCE selects the lora program variant (see
+                # _admit_group); a base-only wave pays zero delta cost
+                decode_args["aids"] = aids.tolist()
         if self.speculative:
             return self._step_speculative(active, decode_args)
         if self._overlap_eligible(active, k):
@@ -2448,8 +2738,8 @@ class ContinuousBatcher:
             # where a degenerate zero-draft chunk has nothing to verify:
             # both run the plain program (ctl may be None in the latter)
             k = int(decode_args["k"])
-            compiled = (k, self.slots,
-                        self.max_blocks) not in self._decode_fns
+            compiled = (k, self.slots, self.max_blocks,
+                        "aids" in decode_args) not in self._decode_fns
             w0 = clock.now()
             emitted = self._dispatch_plain_chunk(active, decode_args)
             if ctl is not None:
@@ -2461,7 +2751,7 @@ class ContinuousBatcher:
         k_it = -(-int(decode_args["k"]) // g1)
         args = dict(decode_args, k=k_it, gamma=gamma)
         spec_key = ("spec", k_it, gamma, self.slots, self.max_blocks,
-                    self._hist.shape[1])
+                    self._hist.shape[1], "aids" in decode_args)
         compiled = spec_key not in self._decode_fns
         w0 = clock.now()
         if self.program_hook is not None:
@@ -2622,8 +2912,8 @@ class ContinuousBatcher:
             # says plain this chunk: run a true plain program and feed
             # each request's controller its own slice of the measurement
             k = int(decode_args["k"])
-            compiled = (k, self.slots,
-                        self.max_blocks) not in self._decode_fns
+            compiled = (k, self.slots, self.max_blocks,
+                        "aids" in decode_args) not in self._decode_fns
             reqs = {i: self.active[i] for i in active}
             before = {i: len(r.tokens) for i, r in reqs.items()}
             w0 = clock.now()
@@ -2642,7 +2932,7 @@ class ContinuousBatcher:
         args = dict(decode_args, k=k_it, gamma=g_max,
                     gammas=gammas.tolist())
         spec_key = ("spec", k_it, g_max, self.slots, self.max_blocks,
-                    self._hist.shape[1])
+                    self._hist.shape[1], "aids" in decode_args)
         compiled = spec_key not in self._decode_fns
         w0 = clock.now()
         if self.program_hook is not None:

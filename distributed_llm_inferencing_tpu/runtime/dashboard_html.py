@@ -223,6 +223,8 @@ const TS_METRICS = [
   ['batcher_queue_depth', 'queue depth (per node)'],
   ['batcher_free_kv_blocks', 'free KV blocks (per node)'],
   ['prefix_hit_ratio', 'prefix-cache hit ratio'],
+  ['lora_requests', 'LoRA adapter requests/s (rate, per node)'],
+  ['lora_host_adapters', 'LoRA adapters resident in host store (per node)'],
   ['kv_transfer_bytes', 'KV transfer B/s (rate, per node)'],
   ['kv_wire_compression', 'KV wire compression (logical/sent, per node)'],
   ['worker_role', 'role (0 mixed / 1 prefill / 2 decode)'],
@@ -340,7 +342,8 @@ NODES = f"""<!doctype html><html><head><title>Nodes</title>{_STYLE}
 <table><thead><tr><th>ID</th><th>Name</th><th>Address</th><th>Status</th>
 <th>Role</th>
 <th>Devices</th><th>CPU %</th><th>Mem %</th><th>Models</th><th>In-flight</th>
-<th>Queue</th><th>Free KV</th><th>Arena</th><th>Lat EWMA</th>
+<th>Queue</th><th>Free KV</th><th>Arena</th><th>Adapters</th>
+<th>Lat EWMA</th>
 <th>Prefix hit</th>
 <th></th></tr></thead><tbody id="nodes"></tbody></table>
 <h2 style="margin-top:24px">Placement Plans</h2>
@@ -457,6 +460,12 @@ async function refresh() {{
     // host-arena occupancy: >90% triggers the prefill-pick avoidance
     `<td>${{n.arena_occupancy != null
         ? Math.round(n.arena_occupancy*100)+'%' : '–'}}</td>`+
+    / resident LoRA adapters (count + host bytes) — stale-gated like
+    // queue depth; the names ride a hover title
+    `<td>${{n.adapters != null && n.adapters.resident.length
+        ? `<span title="${{n.adapters.resident.join(', ')}}">`
+          + n.adapters.resident.length+' ('+gib(n.adapters.bytes)+')</span>'
+        : '–'}}</td>`+
     `<td>${{n.latency_ewma_ms != null ? n.latency_ewma_ms+' ms' : '–'}}</td>`+
     // prefix-cache tier outcome: the node's radix hit ratio (affinity
     // routing should drive this UP on shared-prefix traffic)

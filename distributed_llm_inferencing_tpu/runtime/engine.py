@@ -142,6 +142,11 @@ class InferenceEngine:
             self.mesh, shd.cache_specs(cfg, self.mesh_spec))
         self._prefill_fns = {}  # bucket -> compiled
         self._decode_fns = {}   # SamplingParams -> compiled
+        # LoRA single-stream hook (models/lora.py): name -> adapter
+        # (host numpy) and name -> cached params tree carrying its
+        # delta pack. One adapter per generate() call.
+        self._adapters = {}
+        self._adapter_trees = {}
 
     # Layer-count cap for the CPU unrolled path: past this, the unrolled
     # program's compile time outweighs the per-step win.
@@ -355,6 +360,76 @@ class InferenceEngine:
             self._decode_fns[(sp, T)] = fn
         return fn
 
+    # ---- LoRA adapters (single-stream delta hook) ---------------------
+
+    def load_adapter(self, adapter=None, *, name=None, source=None):
+        """Make a LoRA adapter available to ``generate(adapter=...)``.
+
+        Pass a ``models.lora.LoRAAdapter`` directly, or ``name`` +
+        ``source`` (checkpoint dir, or a ``synth:`` URI for tests).
+        The engine serves one adapter per request by swapping in a
+        params tree whose layers carry the delta pack — the SAME
+        ``_lora_apply`` hook the batcher's gathered path runs, so the
+        single-stream and batched paths agree bitwise per request.
+        """
+        from distributed_llm_inferencing_tpu.models import lora as lora_mod
+        if self.mesh_spec.pp > 1:
+            raise ValueError("LoRA serving does not support pp > 1 "
+                             "(the pipelined executor re-stages the "
+                             "stacked layer tree without the delta pack)")
+        if adapter is None:
+            adapter = lora_mod.resolve(self.cfg, name, source)
+        else:
+            lora_mod._check_adapter(self.cfg, adapter)
+        self._adapters[adapter.name] = adapter
+        self._adapter_trees.pop(adapter.name, None)
+        return adapter
+
+    def unload_adapter(self, name: str) -> bool:
+        self._adapter_trees.pop(name, None)
+        return self._adapters.pop(name, None) is not None
+
+    def adapter_stats(self) -> dict:
+        """Resident-adapter advertisement for the worker's /health (the
+        master's affinity scorer reads it from the node snapshot)."""
+        return {"resident": sorted(self._adapters),
+                "bytes": sum(a.nbytes for a in self._adapters.values())}
+
+    def _params_for(self, adapter: Optional[str]):
+        """Base params, or a shallow-copied tree whose layers carry the
+        adapter's delta pack at slot 0. The dense forward passes no
+        per-row ids, so ``_lora_apply`` gathers row 0 for every row —
+        exactly this adapter. jit retraces once per adapter rank (the
+        tree structure gains a "lora" subtree); the tree is cached so
+        repeat requests reuse the committed device buffers."""
+        if adapter is None:
+            return self.params
+        ad = self._adapters.get(adapter)
+        if ad is None:
+            raise ValueError(
+                f"unknown adapter {adapter!r} (load_adapter first)")
+        tree = self._adapter_trees.get(adapter)
+        if tree is None:
+            # per-layer {target: {"a": [1, din, r], "b": [1, r, dout]}}
+            # with the alpha/rank scale folded into B (ops/lora.py doc)
+            packs = [
+                {t: {"a": a[None], "b": (b * ad.scale)[None]}
+                 for t, (a, b) in lp.items()}
+                for lp in ad.layers]
+            tree = dict(self.params)
+            if self._layers_unrolled:
+                tree["layers"] = [
+                    dict(lp, lora=jax.tree.map(jnp.asarray, packs[i]))
+                    for i, lp in enumerate(tree["layers"])]
+            else:
+                stacked = {
+                    t: {k: jnp.asarray(np.stack([p[t][k] for p in packs]))
+                        for k in ("a", "b")}
+                    for t in packs[0]}
+                tree["layers"] = dict(tree["layers"], lora=stacked)
+            self._adapter_trees[adapter] = tree
+        return tree
+
     # ---- public API --------------------------------------------------
 
     def generate(
@@ -367,6 +442,7 @@ class InferenceEngine:
         stream_cb: Optional[Callable[[int, List[int]], None]] = None,
         speculative: Optional[str] = None,   # "ngram" (ops/speculative.py)
         spec_gamma: int = 4,
+        adapter: Optional[str] = None,       # LoRA adapter name (load_adapter)
     ) -> GenerateResult:
         """Generate continuations for a batch of token-id prompts.
 
@@ -380,9 +456,16 @@ class InferenceEngine:
         greedy; leave-one-out rejection for sampling).
         """
         if speculative is not None:
+            if adapter is not None:
+                raise ValueError(
+                    "LoRA adapters do not combine with speculative "
+                    "decoding (the verify program has no delta hook)")
             return self._generate_speculative(
                 prompts, max_new_tokens, sampling, seed, eos_token_id,
                 stream_cb, speculative, spec_gamma)
+        # raises on unknown adapter — a request NEVER silently serves
+        # base weights (models/lora.py doc)
+        params = self._params_for(adapter)
         cfg = self.cfg
         sp = sampling or SamplingParams()
         n_real = len(prompts)
@@ -434,7 +517,7 @@ class InferenceEngine:
             t0 = time.perf_counter()
             wt0 = clock.now()
             last_logits, cache = self._prefill_fns[s0](
-                self.params, jnp.asarray(tokens), lengths, cache)
+                params, jnp.asarray(tokens), lengths, cache)
             key = jax.random.PRNGKey(seed)
             key, sub = jax.random.split(key)
             cur = sample(last_logits, sub, sp)
@@ -459,7 +542,7 @@ class InferenceEngine:
                     T = next(c for c in self.DECODE_CHUNKS if c <= remaining)
                     decode = self._decode_jitted(sp, T)
                     toks_dev, cur, cache, key = decode(
-                        self.params, cur, cache, key)
+                        params, cur, cache, key)
                     chunks_dev.append(toks_dev)
                     steps += T
                     remaining -= T
@@ -496,7 +579,7 @@ class InferenceEngine:
                                          self.STREAM_CHUNK_MAX))
                     decode = self._decode_jitted(sp, T)
                     toks_dev, cur, cache, key = decode(
-                        self.params, cur, cache, key)
+                        params, cur, cache, key)
                     pipelined.append((toks_dev, T))
                     rem_dispatch -= T
 
@@ -514,7 +597,7 @@ class InferenceEngine:
                                              self.STREAM_CHUNK_MAX))
                         decode = self._decode_jitted(sp, T)
                         toks_dev, cur, cache, key = decode(
-                            self.params, cur, cache, key)
+                            params, cur, cache, key)
                     toks = np.asarray(toks_dev)    # [T, B] — one sync per chunk
                     for t in range(T):
                         # stream exactly what lands in `out` this step;
@@ -736,4 +819,5 @@ class InferenceEngine:
             "param_bytes": param_bytes(self.params),
             "max_seq": self.max_seq,
             "compiled_prefill_buckets": sorted(self._prefill_fns),
+            "adapters": sorted(self._adapters),
         }

@@ -106,7 +106,7 @@ EVENT_TYPES = (
         "run's workload from exactly these rows (a debug bundle is "
         "sim-replayable because collect_debug_bundle.sh exports them).",
         ("model", "prompt_chars", "max_new_tokens", "max_length",
-         "slo_class", "tenant")),
+         "slo_class", "tenant", "adapter")),
     EventType(
         "admission-rejected", "warning",
         "The overload front door refused a submit — degradation-ladder "
@@ -249,6 +249,25 @@ EVENT_TYPES = (
         "the deposed master (to its in-memory ring) as it steps down "
         "— the paused-then-revived-leader trail a postmortem needs.",
         ("term", "observed_term")),
+    # ---- multi-LoRA adapter serving (models/lora.py) ------------------
+    EventType(
+        "adapter-loaded", "info",
+        "A LoRA adapter became host-resident on a worker — an explicit "
+        "operator /load_adapter, or the master's lazy dispatch-time "
+        "load for a request naming an adapter the chosen node lacked.",
+        ("adapter", "model", "rank", "nbytes", "lazy")),
+    EventType(
+        "adapter-evicted", "info",
+        "The bounded host adapter store evicted an idle adapter (LRU "
+        "by bytes) to make room for a newly loaded one — the evicted "
+        "name reloads lazily on its next request.",
+        ("adapter", "model", "evicted_for")),
+    EventType(
+        "adapter-load-failed", "error",
+        "An adapter load was refused (bad source, shape mismatch "
+        "against the base model, store full of pinned adapters): the "
+        "request path fails rather than silently serving base "
+        "weights.", ("adapter", "model", "error")),
 )
 
 _BY_NAME: Dict[str, EventType] = {t.name: t for t in EVENT_TYPES}

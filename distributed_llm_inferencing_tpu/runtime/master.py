@@ -411,6 +411,7 @@ class Master:
                                   step_s=tsdb_step_s)
         self.slo = tsdb_mod.SLOEvaluator()
         self._cost_models: Set[str] = set()   # per-model cost hist cap
+        self._adapter_counters: Set[str] = set()  # per-adapter ctr cap
         self._ratio_prev: Dict[str, tuple] = {}   # node -> (hits, misses)
         self._wire_ratio_prev: Dict[str, tuple] = {}  # node -> (raw, sent)
         # Flight recorder (runtime/events.py): the typed decision
@@ -530,7 +531,14 @@ class Master:
                      # so the dashboard and the plan bench gate see them
                      # exist before the first search ever runs
                      "planner_searches",
-                     "planner_candidates"):
+                     "planner_candidates",
+                     # multi-LoRA serving (models/lora.py): affinity
+                     # picks + lazy dispatch-time loads — pre-registered
+                     # so the affinity bench gate and the dashboard see
+                     # them exist before the first adapter ever loads
+                     "scheduler_pick_adapter_affinity",
+                     "adapter_lazy_loads",
+                     "adapter_load_failures"):
             self.metrics.inc(name, 0)
         # cost-model score (goodput req/s) of the planner's latest
         # chosen plan — 0 until the first search lands
@@ -613,6 +621,8 @@ class Master:
         s.add("POST", "/api/plans/auto", self.api_plan_auto)
         s.add("POST", "/api/plans/deploy/<plan_id>", self.api_deploy_plan)
         s.add("POST", "/api/models/load", self.api_load_model)
+        s.add("GET", "/api/adapters", self.api_adapters)
+        s.add("POST", "/api/adapters/register", self.api_register_adapter)
         s.add("GET", "/api/metrics", lambda b: self.metrics.snapshot())
         s.add("GET", "/metrics", lambda b: (
             self.metrics.prometheus().encode(), "text/plain; version=0.0.4"))
@@ -997,8 +1007,22 @@ class Master:
                 # queue depth; registration-info devices remain under
                 # `resources` for never-scraped nodes
                 "devices": (rt.get("devices") if rt_fresh else None),
+                # resident LoRA adapters aggregated across the node's
+                # models (nodes dashboard Adapters column) — stale-gated
+                # like everything else the affinity scorer reads
+                "adapters": (self._adapters_summary(rt)
+                             if rt_fresh else None),
             })
         return {"status": "success", "nodes": nodes}
+
+    @staticmethod
+    def _adapters_summary(rt: dict) -> dict:
+        names: List[str] = []
+        total = 0
+        for ent in (rt.get("adapters") or {}).values():
+            names.extend(ent.get("resident", ()))
+            total += int(ent.get("bytes") or 0)
+        return {"resident": sorted(set(names)), "bytes": total}
 
     # ---- model/plan API ----------------------------------------------
 
@@ -1192,6 +1216,63 @@ class Master:
         self._refresh_node(node)
         return _relay_json(r)
 
+    # ---- multi-LoRA adapter registry ---------------------------------
+
+    def adapter_registry(self) -> dict:
+        """name -> {source, model, rank} from the replicated meta row.
+        Registration survives failover: the row rides the same op-log
+        replication as every other store write, so the standby that
+        takes over can still lazy-load every registered adapter."""
+        raw = self.store.get_meta("adapter_registry")
+        if not raw:
+            return {}
+        try:
+            reg = json.loads(raw)
+            return reg if isinstance(reg, dict) else {}
+        except ValueError:
+            return {}
+
+    def api_adapters(self, body):
+        """Registry plus live per-node residency (staleness-gated, same
+        window as the scheduler's affinity scan)."""
+        now = clock.now()
+        residency: Dict[str, list] = {}
+        for nid, s in list(self._node_runtime.items()):
+            if now - s["at"] > SCHED_STALE_S:
+                continue
+            for mname, ent in (s.get("adapters") or {}).items():
+                for ad in ent.get("resident", ()):
+                    residency.setdefault(ad, []).append(
+                        {"node_id": nid, "model": mname})
+        return {"status": "success", "adapters": self.adapter_registry(),
+                "residency": residency}
+
+    def api_register_adapter(self, body):
+        """Record an adapter (name -> checkpoint dir or synth: URI) in
+        the replicated registry. Dispatch lazy-loads it on whatever
+        node a request naming it lands on; no weights move here."""
+        nl = self._not_leader("/api/adapters/register")
+        if nl:
+            return nl
+        name = body.get("adapter")
+        source = body.get("source")
+        if not name or not source:
+            return 400, {"status": "error",
+                         "message": "adapter and source required"}
+        if not isinstance(name, str) or not _TENANT_RE.match(name):
+            return 400, {"status": "error",
+                         "message": "malformed adapter name: must match "
+                                    "[A-Za-z0-9._-]{1,64}"}
+        reg = self.adapter_registry()
+        entry = {"source": str(source)}
+        if body.get("model_name"):
+            entry["model"] = str(body["model_name"])
+        if body.get("rank") is not None:
+            entry["rank"] = int(body["rank"])
+        reg[name] = entry
+        self.store.set_meta("adapter_registry", json.dumps(reg))
+        return {"status": "success", "adapter": name, "registered": entry}
+
     # ---- inference API -----------------------------------------------
 
     def api_submit(self, body, _request=None):
@@ -1235,6 +1316,27 @@ class Master:
                          "message": "malformed X-DLI-Tenant: must match "
                                     "[A-Za-z0-9._-]{1,64}",
                          "accepted": "[A-Za-z0-9._-]{1,64}"}
+        adapter = body.get("adapter") or None
+        if adapter is not None:
+            # reject unregistered adapters at the front door: dispatch
+            # would only discover the miss after the request burned a
+            # queue slot and a scheduling pass, and the client would see
+            # a late FAILED row instead of an actionable 400
+            if not isinstance(adapter, str) or not _TENANT_RE.match(adapter):
+                return 400, {"status": "error",
+                             "message": "malformed adapter name: must "
+                                        "match [A-Za-z0-9._-]{1,64}"}
+            reg = self.adapter_registry().get(adapter)
+            if reg is None:
+                return 400, {"status": "error",
+                             "message": f"adapter {adapter!r} is not "
+                                        "registered; POST "
+                                        "/api/adapters/register first"}
+            if reg.get("model") and reg["model"] != model:
+                return 400, {"status": "error",
+                             "message": f"adapter {adapter!r} is "
+                                        f"registered for model "
+                                        f"{reg['model']!r}, not {model!r}"}
         # max_length keeps the reference's prompt+new semantics
         # (views.py:351); it is forwarded verbatim so the worker computes
         # new-token count against the tokenized prompt.
@@ -1269,7 +1371,10 @@ class Master:
         req_id = self.store.submit_request(
             model, prompt, max_new, body.get("sampling"),
             max_length=max_length, client_tag=ctag,
-            slo_class=slo_class, tenant=tenant)
+            slo_class=slo_class, tenant=tenant, adapter=adapter)
+        if adapter:
+            self.metrics.inc(
+                f"lora_adapter_requests_{self._adapter_metric(adapter)}")
         # workload capture (docs/simulator.md "Fitting inputs"): the
         # journal row IS the replayable arrival record — its ts is the
         # arrival time, its data the workload shape — so any debug
@@ -1279,7 +1384,7 @@ class Master:
                     prompt_chars=len(prompt) if isinstance(prompt, str)
                     else None,
                     max_new_tokens=max_new, max_length=max_length,
-                    slo_class=slo_class, tenant=tenant)
+                    slo_class=slo_class, tenant=tenant, adapter=adapter)
         # HA durability barrier (DLI_HA_REPL_BARRIER): an acked submit
         # survives the leader's death — the row is on a standby before
         # the client sees the request id. Bounded wait; no-op when the
@@ -2011,8 +2116,22 @@ class Master:
         with ONE model's view would make a busy multi-model node look
         idle until the next health sweep."""
         models: Dict[str, dict] = {}
+        adapters: Dict[str, dict] = {}
         for m in info.get("loaded_models", []):
             sch = m.get("scheduler")
+            # resident-adapter advertisement (models/lora.py): batched
+            # models report under scheduler.adapters, engine-mode ones
+            # top-level — either way the affinity scorer and the nodes
+            # dashboard read the SAME normalized {resident, bytes} shape
+            adv_ad = (sch.get("adapters") if isinstance(sch, dict)
+                      else m.get("adapters"))
+            if isinstance(adv_ad, dict) and adv_ad.get("resident"):
+                host = adv_ad.get("host")
+                nb = (host.get("bytes") if isinstance(host, dict)
+                      else adv_ad.get("bytes"))
+                adapters[str(m.get("name") or "")] = {
+                    "resident": list(adv_ad["resident"]),
+                    "bytes": int(nb or 0)}
             if not isinstance(sch, dict):
                 continue
             bf = sch.get("blocks_free")
@@ -2067,6 +2186,10 @@ class Master:
                 merged = dict(prev["models"])
                 merged.update(models)
                 models = merged
+            if prev and prev.get("adapters"):
+                merged_ad = dict(prev["adapters"])
+                merged_ad.update(adapters)
+                adapters = merged_ad
             if prev and role is None:
                 # completion piggybacks carry scheduler stats only —
                 # keep the last full /health body's role
@@ -2100,7 +2223,8 @@ class Master:
             "queue": queue, "free_blocks": free, "arena_occ": occ,
             "kv_wire_ratio": wire_ratio,
             "role": role, "at": clock.now(), "models": models,
-            "digests_any": digests, "devices": devices}
+            "digests_any": digests, "devices": devices,
+            "adapters": adapters}
 
     def _node_role(self, node, now: Optional[float] = None) -> str:
         """The worker's declared serving role (prefill|decode|mixed).
@@ -2165,7 +2289,7 @@ class Master:
             seconds if prev is None else a * seconds + (1 - a) * prev)
 
     def _score_pick(self, cands, model=None, prompt=None,
-                    slo_class=None):
+                    slo_class=None, adapter=None):
         """Queue-aware choice among schedulable candidates. Primary
         load = max(master-side in-flight, worker-reported batcher queue
         depth) — max, not sum: every request this master dispatched and
@@ -2243,6 +2367,21 @@ class Master:
                 top = [n for n in pool if free[n["id"]] == best]
                 return min(top, key=primary), "class_batch"
         slack = 0 if slo_class == "latency" else self._prefix_slack
+        if adapter and model and len(cands) > 1:
+            # adapter affinity (outranks prefix warmth: a non-resident
+            # adapter costs a whole host load + device pack rebuild,
+            # not just a prefill): candidates already advertising the
+            # adapter win — under the SAME convoy guard as prefix
+            # affinity, so one adapter-hot node cannot absorb every
+            # request for its tenant; and only while the affinity
+            # SEPARATES candidates (all-resident means nothing to win)
+            aff = [n for n in cands
+                   if adapter in (((rt.get(n["id"]) or {})
+                                   .get("adapters") or {})
+                                  .get(model) or {}).get("resident", ())
+                   and primary(n) <= lo + slack]
+            if aff and len(aff) < len(cands):
+                return min(aff, key=primary), "adapter_affinity"
         if prompt and model and digests_any \
                 and self._prefix_weight > 0 and len(cands) > 1:
             # digests_any gate: with no fresh digest advertisement in
@@ -2293,7 +2432,8 @@ class Master:
                    nodes: Optional[list] = None,
                    prompt: Optional[str] = None,
                    role: Optional[str] = None,
-                   slo_class: Optional[str] = None):
+                   slo_class: Optional[str] = None,
+                   adapter: Optional[str] = None):
         """Least-loaded schedulable node, preferring ones with the model
         already loaded (reference: always .first(), views.py:389-391).
 
@@ -2340,7 +2480,8 @@ class Master:
                     and all(n["id"] != prefer for n in pool):
                 pool = pool + [n for n in nodes if n["id"] == prefer]
             chosen = self._pick_from(pool, model, exclude, reserve,
-                                     prefer, prompt, role, slo_class)
+                                     prefer, prompt, role, slo_class,
+                                     adapter)
             if chosen is not None:
                 self.metrics.inc("scheduler_pick_sampled")
                 return chosen
@@ -2348,10 +2489,10 @@ class Master:
             # node open/draining/excluded): correctness demands the
             # full scan before declaring the fleet unschedulable
         return self._pick_from(nodes, model, exclude, reserve, prefer,
-                               prompt, role, slo_class)
+                               prompt, role, slo_class, adapter)
 
     def _pick_from(self, nodes, model, exclude, reserve, prefer,
-                   prompt, role, slo_class=None):
+                   prompt, role, slo_class=None, adapter=None):
         """The pick policy proper, over an explicit candidate list (the
         whole snapshot, or :meth:`_pick_node`'s sample)."""
         nodes = [n for n in nodes if not n.get("draining")]
@@ -2423,7 +2564,7 @@ class Master:
                 else:
                     chosen, reason = self._score_pick(
                         have or pool, model=model, prompt=prompt,
-                        slo_class=slo_class)
+                        slo_class=slo_class, adapter=adapter)
                 self.metrics.inc(f"scheduler_pick_{reason}")
                 if reserve:
                     self._inflight[chosen["id"]] = \
@@ -2505,7 +2646,8 @@ class Master:
         node = self._pick_node(req["model_name"], exclude=excluded,
                                reserve=True, prefer=prefer, nodes=nodes,
                                prompt=req.get("prompt"), role="decode",
-                               slo_class=req.get("slo_class"))
+                               slo_class=req.get("slo_class"),
+                               adapter=req.get("adapter"))
         if node is None:
             # nothing schedulable right now (all breakers open / nodes
             # draining): park instead of failing — at least a health
@@ -2564,6 +2706,8 @@ class Master:
             # live-migration resume record: the worker pre-seeds the
             # emitted tokens and continues the stream bitwise-exactly
             body["resume"] = req["resume"]
+        if req.get("adapter"):
+            body["adapter"] = req["adapter"]
         return body
 
     def _note_dispatch(self, req, node) -> None:
@@ -2645,6 +2789,10 @@ class Master:
             barrier=self.ha.enabled and self.ha.barrier_enabled,
             cost=cost)
         self.metrics.inc("requests_completed")
+        if req.get("adapter"):
+            self.metrics.inc(
+                f"lora_adapter_tokens_{self._adapter_metric(req['adapter'])}",
+                len(data.get("tokens") or ()))
         self._note_cost(req, cost, ttft_ms=data.get("ttft_ms"))
         if data.get("idempotent"):
             # a retry hit the worker's completed-result cache: the
@@ -2668,6 +2816,18 @@ class Master:
                                          "scheduler": sch}]}, merge=True)
         self._trace_done(req["id"])
         self._node_success(node)
+
+    def _adapter_metric(self, name: str) -> str:
+        """Capped per-adapter counter label — adapter names are
+        client-supplied, so the tracked set is bounded exactly like the
+        per-model gauges (overflow lands in ``other``)."""
+        an = sanitize_name(str(name))[:48]
+        if an not in self._adapter_counters:
+            if len(self._adapter_counters) < MODEL_GAUGES_MAX:
+                self._adapter_counters.add(an)
+            else:
+                an = "other"
+        return an
 
     def _note_cost(self, req, cost, ttft_ms=None) -> None:
         """Completion-side telemetry tail: per-model ``dli_cost_*``
@@ -2953,6 +3113,73 @@ class Master:
         self._refresh_node(node)
         return None
 
+    def _ensure_adapter_loaded(self, node, model, adapter):
+        """Lazy dispatch-time adapter load (mirror of
+        :meth:`_ensure_model_loaded`, same failure classification): a
+        request naming an adapter the chosen node does not advertise
+        triggers ``POST /load_adapter`` with the registry's recorded
+        source before the dispatch proceeds. An unregistered adapter —
+        or a worker-side load refusal — is a terminal client-class
+        rejection: the request FAILS, it never silently serves base
+        weights."""
+        if not adapter:
+            return None
+        nid = node["id"]
+        s = self._node_runtime.get(nid)
+        if s and clock.now() - s["at"] <= SCHED_STALE_S:
+            res = ((s.get("adapters") or {}).get(model)
+                   or {}).get("resident", ())
+            if adapter in res:
+                return None
+        reg = self.adapter_registry().get(adapter)
+        if reg is None:
+            self.metrics.inc("adapter_load_failures")
+            return (f"adapter {adapter!r} is not registered "
+                    "(POST /api/adapters/register first)")
+        if reg.get("model") and reg["model"] != model:
+            self.metrics.inc("adapter_load_failures")
+            return (f"adapter {adapter!r} is registered for model "
+                    f"{reg['model']!r}, not {model!r}")
+        r = self._worker_post(
+            node, "/load_adapter",
+            {"model_name": model, "adapter": adapter,
+             "source": reg["source"], "lazy": True}, LOAD_TIMEOUT)
+        if r.status_code == 503:
+            raise _NodeUnavailable(f"adapter load refused: {r.text[:200]}")
+        if 400 <= r.status_code < 500 and r.status_code != 408:
+            self.metrics.inc("adapter_load_failures")
+            events.emit("adapter-load-failed", node_id=nid,
+                        adapter=adapter, model=model,
+                        error=r.text[:200])
+            return f"adapter load rejected: {r.text[:200]}"
+        if r.status_code != 200:
+            raise RuntimeError(f"load_adapter failed: {r.text[:200]}")
+        self.metrics.inc("adapter_lazy_loads")
+        try:
+            info = r.json()
+        except ValueError:
+            info = {}
+        events.emit("adapter-loaded", node_id=nid, adapter=adapter,
+                    model=model, rank=info.get("rank"),
+                    nbytes=info.get("nbytes"), lazy=True)
+        for ev in info.get("evicted") or []:
+            events.emit("adapter-evicted", node_id=nid, adapter=ev,
+                        model=model, evicted_for=adapter)
+        # fold the new residency into the snapshot immediately: the
+        # next pick's affinity scan must see it without waiting a
+        # health sweep
+        s = self._node_runtime.get(nid)
+        if s is not None:
+            ad = dict(s.get("adapters") or {})
+            ent = dict(ad.get(model) or {"resident": [], "bytes": 0})
+            if adapter not in ent["resident"]:
+                ent = {"resident": sorted(set(ent["resident"])
+                                          | {adapter}),
+                       "bytes": ent.get("bytes", 0)}
+            ad[model] = ent
+            s["adapters"] = ad
+        return None
+
     def _execute_on_node(self, req, node=None) -> bool:
         if node is None:
             node = self._reserve_node_for(req)
@@ -2962,6 +3189,9 @@ class Master:
         try:
             err = self._ensure_model_loaded(node, req["model_name"],
                                             req["sampling"])
+            if err is None:
+                err = self._ensure_adapter_loaded(
+                    node, req["model_name"], req.get("adapter"))
             if err is not None:
                 self._reject(req, err)
                 return False
@@ -3079,6 +3309,32 @@ class Master:
                     self._reject(req, err)
                 open_subs.clear()
                 return
+            # adapters load once per distinct name in the batch; a
+            # refused adapter rejects ONLY the sub-requests naming it —
+            # their base-model (or other-adapter) siblings still ride
+            # the batch RPC
+            ad_err: Dict[str, str] = {}
+            for ad in {r_.get("adapter") for r_ in reqs
+                       if r_.get("adapter")}:
+                e = self._ensure_adapter_loaded(node, model, ad)
+                if e is not None:
+                    ad_err[ad] = e
+            if ad_err:
+                kept = []
+                for req in reqs:
+                    e = ad_err.get(req.get("adapter") or "")
+                    if e is not None:
+                        self._reject(req, e)
+                        open_subs.pop(self._tag(req["id"]), None)
+                        with self._inflight_lock:
+                            self._inflight[nid] = max(
+                                0, self._inflight.get(nid, 1) - 1)
+                        undone.discard(req["id"])
+                    else:
+                        kept.append(req)
+                reqs = kept
+                if not reqs:
+                    return
             tracer = trace.get_tracer()
             t_dispatch = clock.now()
             sub_bodies = []
@@ -3215,10 +3471,13 @@ class Master:
         exclusion/pin state the two-phase flow would complicate, and
         plain dispatch is the safe degradation everywhere."""
         if (not self._disagg or req["attempts"] > 0
-                or req.get("excluded_nodes") or req.get("resume")):
+                or req.get("excluded_nodes") or req.get("resume")
+                or req.get("adapter")):
             # (a migrated-in request already carries its kv_source —
             # re-disaggregating would re-prefill what the resume record
-            # makes fetchable)
+            # makes fetchable; an adapter request's k/v projections
+            # carry the LoRA delta, so a base-weights prefill peer
+            # would export KV the adapter decode could not trust)
             return None
         prompt = req.get("prompt") or ""
         if not isinstance(prompt, str) \

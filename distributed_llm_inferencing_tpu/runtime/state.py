@@ -137,7 +137,12 @@ _MIGRATIONS = {
                  # bucket that admitted the request. Defaults keep
                  # pre-migration rows on the middle tier.
                  ("slo_class", "TEXT DEFAULT 'throughput'"),
-                 ("tenant", "TEXT DEFAULT 'default'")),
+                 ("tenant", "TEXT DEFAULT 'default'"),
+                 # multi-LoRA serving (models/lora.py): the adapter a
+                 # request names rides the row end-to-end — dispatch
+                 # lazily loads it on the chosen node, failover retries
+                 # and migration resumes keep serving the SAME adapter
+                 ("adapter", "TEXT")),
 }
 
 # Declared SLO classes (request body field ``slo_class``) and their
@@ -611,7 +616,8 @@ class Store:
                        max_length: Optional[int] = None,
                        client_tag: Optional[str] = None,
                        slo_class: str = "throughput",
-                       tenant: str = "default") -> int:
+                       tenant: str = "default",
+                       adapter: Optional[str] = None) -> int:
         """New request row; ``client_tag`` is the client's submit
         idempotency key — a tagged re-submit (the ack was lost: an HA
         leader died between committing the row and answering, or the
@@ -630,11 +636,11 @@ class Store:
             return self._exec(
                 "INSERT INTO requests (model_name, prompt, "
                 "max_new_tokens, max_length, sampling, created_at, "
-                "client_tag, slo_class, tenant) "
-                "VALUES (?,?,?,?,?,?,?,?,?)",
+                "client_tag, slo_class, tenant, adapter) "
+                "VALUES (?,?,?,?,?,?,?,?,?,?)",
                 (model_name, prompt, max_new_tokens, max_length,
                  json.dumps(sampling or {}), clock.now(), client_tag,
-                 slo_class, tenant))
+                 slo_class, tenant, adapter))
 
     def find_client_tag(self, client_tag: str) -> Optional[int]:
         """The request id a submit idempotency key already names, or

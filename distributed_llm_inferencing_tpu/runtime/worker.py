@@ -25,7 +25,7 @@ import jax
 from distributed_llm_inferencing_tpu.models.registry import get_config
 from distributed_llm_inferencing_tpu.ops.sampling import SamplingParams
 from distributed_llm_inferencing_tpu.parallel.mesh import MeshSpec
-from distributed_llm_inferencing_tpu.runtime import httpd
+from distributed_llm_inferencing_tpu.runtime import events, httpd
 from distributed_llm_inferencing_tpu.runtime.engine import InferenceEngine
 from distributed_llm_inferencing_tpu.utils import clock, locks, trace
 from distributed_llm_inferencing_tpu.utils.faults import mutation_enabled
@@ -113,6 +113,10 @@ class WorkerAgent:
         s.add("POST", "/load_model", self.load_model)
         s.add("POST", "/load_shard", self.load_shard)
         s.add("POST", "/unload_model", self.unload_model)
+        # multi-LoRA adapter lifecycle (models/lora.py): make an adapter
+        # host-resident / drop it; requests then name it per-submit
+        s.add("POST", "/load_adapter", self.load_adapter)
+        s.add("POST", "/unload_adapter", self.unload_adapter)
         s.add("POST", "/inference", self.inference)
         s.add("POST", "/inference_batch", self.inference_batch)
         # elastic disaggregation (docs/robustness.md "Live migration"):
@@ -282,7 +286,8 @@ class WorkerAgent:
                 else:
                     loaded.append({"name": n, "source": m.source,
                                    "mesh": m.engine.mesh_spec.axis_sizes(),
-                                   "max_seq": m.engine.max_seq})
+                                   "max_seq": m.engine.max_seq,
+                                   "adapters": m.engine.adapter_stats()})
         # host-arena occupancy fraction (worst across batched models):
         # the master's scheduler keeps prefill traffic off nodes whose
         # arena is about to evict the blocks a decode peer needs
@@ -556,6 +561,74 @@ class WorkerAgent:
         self.metrics.inc("models_unloaded")
         return {"status": "success", "message": f"model {name} unloaded"}
 
+    def load_adapter(self, body, _request=None):
+        """Make a LoRA adapter host-resident for a loaded model
+        (lease-fenced like /load_model; the master's lazy dispatch-time
+        load and operator calls both land here). Idempotent for an
+        already-resident name. Any refusal is a structured 400 — a
+        request naming an unloadable adapter FAILS, it never silently
+        serves base weights."""
+        stale = self._term_guard(_request)
+        if stale:
+            return stale
+        if self._draining:
+            return self._refuse_draining()
+        model = body.get("model_name")
+        adapter = body.get("adapter")
+        source = body.get("source")
+        if not (model and adapter and source):
+            return 400, {"status": "error",
+                         "message": "model_name, adapter and source "
+                                    "required"}
+        m = self.models.get(model)
+        if m is None:
+            return 404, {"status": "error",
+                         "message": f"model {model} not loaded"}
+        with self.metrics.time("load_adapter"):
+            try:
+                if m.batcher is not None:
+                    info = m.batcher.load_adapter(adapter, source)
+                else:
+                    ad = m.engine.load_adapter(name=adapter, source=source)
+                    info = {"name": ad.name, "rank": ad.rank,
+                            "nbytes": ad.nbytes, "evicted": []}
+            except ValueError as e:
+                events.emit("adapter-load-failed", adapter=adapter,
+                            model=model, error=str(e))
+                return 400, {"status": "error", "adapter": adapter,
+                             "message": str(e)}
+        for ev in info.get("evicted", []):
+            events.emit("adapter-evicted", adapter=ev, model=model,
+                        evicted_for=adapter)
+        events.emit("adapter-loaded", adapter=adapter, model=model,
+                    rank=info.get("rank"), nbytes=info.get("nbytes"),
+                    lazy=bool(body.get("lazy")))
+        return {"status": "success", **info}
+
+    def unload_adapter(self, body, _request=None):
+        """Drop a host-resident adapter (refused while requests still
+        reference it). Lease-fenced like /unload_model."""
+        stale = self._term_guard(_request)
+        if stale:
+            return stale
+        model = body.get("model_name")
+        adapter = body.get("adapter")
+        m = self.models.get(model)
+        if m is None:
+            return 404, {"status": "error",
+                         "message": f"model {model} not loaded"}
+        try:
+            if m.batcher is not None:
+                dropped = m.batcher.unload_adapter(adapter)
+            else:
+                dropped = m.engine.unload_adapter(adapter)
+        except ValueError as e:
+            return 409, {"status": "error", "message": str(e)}
+        if not dropped:
+            return 404, {"status": "error",
+                         "message": f"adapter {adapter} not resident"}
+        return {"status": "success", "adapter": adapter}
+
     def _prep_inference(self, body):
         name = body.get("model_name")
         m = self.models.get(name)
@@ -613,10 +686,17 @@ class WorkerAgent:
             seed = int(resume["seed"])
         else:
             seed = int(body.get("seed", time.time_ns() % (1 << 31)))
+        # a migrated request must resume under its source ADAPTER too —
+        # same exactness contract as the seed above
+        if resume is not None and resume.get("adapter"):
+            adapter = str(resume["adapter"])
+        else:
+            adapter = body.get("adapter") or None
         gen_kw = {
             "seed": seed,
             "speculative": spec,
             "spec_gamma": gamma,
+            "adapter": adapter,
         }
         return m, prompt, sp, max_new, gen_kw
 
@@ -857,6 +937,7 @@ class WorkerAgent:
                           "resume": (resume if isinstance(resume, dict)
                                      else None),
                           "chunk_cap": sub_body.get("decode_chunk_cap"),
+                          "adapter": sub_body.get("adapter"),
                           "trace_ctx": trace.extract(sub_body) or ctx})
             self._note_prefix(m, sub_body, prompt)
             metas.append((sub_body, tag, my_ev, t0))
@@ -1221,7 +1302,8 @@ class WorkerAgent:
                         resume=resume,
                         # master brownout rung 3: per-request decode
                         # chunk ceiling on latency-class dispatches
-                        chunk_cap=body.get("decode_chunk_cap"))
+                        chunk_cap=body.get("decode_chunk_cap"),
+                        adapter=body.get("adapter"))
                     self._note_prefix(m, body, prompt)
                     if tag:
                         with self._tagged_lock:
@@ -1380,7 +1462,8 @@ class WorkerAgent:
                         prompt, max_new_tokens=max_new, sampling=sp,
                         eos_token_id=m.tokenizer.eos_token_id, stream_cb=cb,
                         seed=body.get("seed"),
-                        kv_transfer_bytes=pre, trace_ctx=ctx)
+                        kv_transfer_bytes=pre, trace_ctx=ctx,
+                        adapter=body.get("adapter"))
                     self._note_prefix(m, body, prompt)
                     toks = req.wait(timeout=float(body.get("timeout", 300)))
                     q.put({"event": "done",

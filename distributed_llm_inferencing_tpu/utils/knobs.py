@@ -282,6 +282,19 @@ KNOBS = (
     Knob("DLI_KV_HOST_MB", "256", "float",
          "Host-RAM KV arena budget per loaded model (MB); `0` disables "
          "the tier.", f"{_P}/runtime/batcher.py"),
+    # ---- multi-LoRA adapter serving ----------------------------------
+    Knob("DLI_LORA_HOST_MB", "64", "float",
+         "Host-RAM budget for the paged LoRA adapter store (MB); LRU "
+         "eviction above it, pinned (in-flight) adapters never evict.",
+         f"{_P}/models/lora.py"),
+    Knob("DLI_LORA_SLOTS", "4", "int",
+         "Device adapter slots per batcher wave (slot 0 is always the "
+         "base model); distinct adapters beyond this queue at admit.",
+         f"{_P}/models/lora.py"),
+    Knob("DLI_LORA_MAX_RANK", "16", "int",
+         "Largest adapter rank a worker accepts; the batched gathered "
+         "pack zero-pads every adapter to one static rank.",
+         f"{_P}/models/lora.py"),
     Knob("DLI_PREFIX_DIGEST_CHUNK", "256", "int",
          "Bytes of prompt text per digest-chain link (master and "
          "workers must agree).", f"{_P}/runtime/kvtier.py"),

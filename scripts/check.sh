@@ -151,6 +151,21 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu \
 timeout -k 10 600 env JAX_PLATFORMS=cpu \
     python bench.py --scenario prefix_cache --smoke || exit 1
 
+echo "== multi-LoRA adapter serving suite + routed smoke =="
+# Paged host adapter store, per-slot batched gathered application
+# (mixed-adapter waves bitwise vs dedicated batchers), adapter-affinity
+# routing with the convoy guard, loud load-failure semantics
+# (docs/serving.md "Multi-LoRA adapter serving"); the smoke drives a
+# live master + 2 in-proc workers over interleaved base/adapter traffic
+# and gates zero failures, lazy dispatch-time loads, affinity picks,
+# and the adapter-loaded trail in /api/events (JSON at
+# /tmp/dli_bench_multi_lora.json for the CI artifact)
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_lora.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python bench.py --scenario multi_lora --smoke || exit 1
+
 echo "== disaggregated prefill/decode + KV transfer suite + smoke =="
 # Role-split pools, /kv_fetch wire, bitwise transferred-decode, chaos on
 # the transfer (docs/architecture.md "Disaggregation"); the smoke drives
@@ -303,6 +318,7 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     --ignore=tests/test_pallas_parity.py \
     --ignore=tests/test_dispatch_batch.py \
     --ignore=tests/test_kvtier.py \
+    --ignore=tests/test_lora.py \
     --ignore=tests/test_disagg.py \
     --ignore=tests/test_kvblock_quant.py \
     --ignore=tests/test_migration.py \
